@@ -1,0 +1,32 @@
+"""comfyui_distributed_tpu — a TPU-native distributed diffusion framework.
+
+A ground-up rebuild of the capabilities of ComfyUI-Distributed
+(reference: /root/reference, a master/worker HTTP job farm for diffusion
+workloads) designed for TPU hardware:
+
+- compute is SPMD over a ``jax.sharding.Mesh`` (data/tensor/sequence axes)
+  instead of one OS process per GPU;
+- the "collector" gather is an on-pod ``all_gather`` over ICI instead of
+  base64-PNG HTTP envelopes (reference ``nodes/collector.py:143-178``);
+- the Ultimate-SD-Upscale tile scatter is a statically sharded computation
+  with host-level requeue, instead of a per-tile HTTP pull queue
+  (reference ``upscale/modes/static.py``);
+- a thin HTTP control plane retains the reference's public API surface
+  (``POST /distributed/queue`` et al., reference ``docs/comfyui-distributed-api.md``)
+  because orchestration/config/health are transport-agnostic.
+
+Subpackages
+-----------
+utils       config / logging / codecs / constants (reference L0, ``utils/``)
+parallel    mesh bootstrap, sharding, RNG, collectives (net-new: TPU substrate)
+models      flax diffusion models (UNet / DiT / VAE) — supplied here because the
+            reference free-rides on ComfyUI for model code
+diffusion   schedules, samplers, guidance, pipelines
+tiles       tile grid math + sharded tile engine (reference L2, ``upscale/``)
+graph       workflow graph: nodes, executor, prompt transforms (reference L3/L4)
+cluster     job store, scheduler, dispatch, orchestration (reference L4, ``api/``)
+api         aiohttp control plane (reference L5, ``api/*_routes.py``)
+workers     host-controller process management (reference L1, ``workers/``)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,206 @@
+"""JSON configuration with cached loads, atomic saves, and async transactions.
+
+Parity: reference ``utils/config.py`` — single JSON file next to the package
+(``:13``), defaults deep-merged with unknown-key preservation (``:47-65``),
+mtime-based read cache (``:75-97``), atomic tmp+fsync+rename save (``:99-116``),
+async-locked read-modify-write transaction (``:119-129``).
+
+Schema differences are deliberate (TPU-first): the reference's per-GPU
+``workers[{cuda_device, port}]`` become per-*host* entries — on a pod, chips
+are mesh slots, not processes (SURVEY §7 translation table) — and a ``mesh``
+section declares topology (shape + axis names) instead of CUDA device pins.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import copy
+import json
+import os
+import tempfile
+import threading
+from pathlib import Path
+from typing import Any, AsyncIterator, Callable
+from contextlib import asynccontextmanager
+
+from .exceptions import ConfigError
+
+CONFIG_ENV = "CDT_CONFIG_PATH"
+_DEFAULT_NAME = "tpu_cluster_config.json"
+
+DEFAULT_CONFIG: dict[str, Any] = {
+    "master": {
+        "host": "",          # advertised callback host ("" = auto-detect)
+        "port": 8288,
+        "delegate_only": False,   # master coordinates but contributes no compute
+    },
+    # One entry per *host controller* (reference: one per GPU process).
+    # On-pod chips are addressed through `mesh`, not through host entries.
+    "hosts": [],
+    "mesh": {
+        # Device mesh shape as {axis_name: size}; -1 means "all remaining
+        # devices". Axis names follow utils.constants AXIS_*.
+        "shape": {"dp": -1},
+        # Which axis collects seed-parallel results (the Collector axis).
+        "collect_axis": "dp",
+    },
+    "settings": {
+        "debug": False,
+        "auto_launch_workers": False,
+        "stop_workers_on_master_exit": True,
+        "master_delegate_only": False,
+        "worker_timeout_seconds": 60,
+        "worker_probe_concurrency": 10,
+        "worker_prep_concurrency": 4,
+        "media_sync_concurrency": 4,
+        "media_sync_timeout_seconds": 120,
+    },
+    "tunnel": {},
+    "managed_processes": {},
+}
+
+_HOST_DEFAULTS: dict[str, Any] = {
+    "id": "",
+    "name": "",
+    "address": "",       # http(s)://host:port of the host controller
+    "enabled": False,
+    "type": "remote",    # "local" | "remote" | "cloud"
+    "mesh_devices": -1,  # chips contributed by this host (-1 = all)
+    "extra_args": "",
+}
+
+
+def config_path() -> Path:
+    override = os.environ.get(CONFIG_ENV)
+    if override:
+        return Path(override)
+    return Path(__file__).resolve().parent.parent / _DEFAULT_NAME
+
+
+def _deep_merge(defaults: dict, loaded: dict) -> dict:
+    """Defaults filled in under loaded values; unknown keys in ``loaded`` are
+    preserved (reference utils/config.py:47-65)."""
+    out = copy.deepcopy(defaults)
+    for k, v in loaded.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = _deep_merge(out[k], v)
+        else:
+            out[k] = copy.deepcopy(v)
+    return out
+
+
+def normalize_host(entry: dict) -> dict:
+    return _deep_merge(_HOST_DEFAULTS, entry)
+
+
+# --- cached load -----------------------------------------------------------
+
+_cache_lock = threading.Lock()
+_cache: tuple[Path, float, dict] | None = None  # (path, mtime, config)
+
+
+def load_config(path: Path | None = None) -> dict[str, Any]:
+    """Load config with defaults merged; cached by (path, mtime)."""
+    global _cache
+    p = path or config_path()
+    with _cache_lock:
+        try:
+            mtime = p.stat().st_mtime
+        except OSError:
+            _cache = None
+            return copy.deepcopy(DEFAULT_CONFIG)
+        if _cache is not None and _cache[0] == p and _cache[1] == mtime:
+            return copy.deepcopy(_cache[2])
+        try:
+            with open(p, "r", encoding="utf-8") as f:
+                loaded = json.load(f)
+        except (OSError, json.JSONDecodeError) as e:
+            raise ConfigError(f"cannot read config {p}: {e}") from e
+        merged = _deep_merge(DEFAULT_CONFIG, loaded)
+        merged["hosts"] = [normalize_host(h) for h in merged.get("hosts", [])]
+        _cache = (p, mtime, merged)
+        return copy.deepcopy(merged)
+
+
+def save_config(config: dict[str, Any], path: Path | None = None) -> None:
+    """Atomic save: tmp file in the same dir + fsync + rename
+    (reference utils/config.py:99-116)."""
+    global _cache
+    p = path or config_path()
+    p.parent.mkdir(parents=True, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=str(p.parent), prefix=".cdt_cfg_")
+    try:
+        with os.fdopen(fd, "w", encoding="utf-8") as f:
+            json.dump(config, f, indent=2, sort_keys=False)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, p)
+    except OSError as e:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise ConfigError(f"cannot write config {p}: {e}") from e
+    with _cache_lock:
+        _cache = None
+
+
+def invalidate_cache() -> None:
+    global _cache
+    with _cache_lock:
+        _cache = None
+
+
+# --- transaction -----------------------------------------------------------
+
+_txn_lock = asyncio.Lock()
+
+
+@asynccontextmanager
+async def config_transaction(path: Path | None = None) -> AsyncIterator[dict]:
+    """Async read-modify-write: mutate the yielded dict; it is saved on exit
+    (reference utils/config.py:119-129)."""
+    async with _txn_lock:
+        cfg = load_config(path)
+        yield cfg
+        save_config(cfg, path)
+
+
+def update_config(mutate: Callable[[dict], None], path: Path | None = None) -> dict:
+    """Synchronous read-modify-write for non-async callers."""
+    cfg = load_config(path)
+    mutate(cfg)
+    save_config(cfg, path)
+    return cfg
+
+
+# --- accessors (reference utils/config.py:141-166) -------------------------
+
+def get_setting(name: str, default: Any = None, path: Path | None = None) -> Any:
+    return load_config(path).get("settings", {}).get(name, default)
+
+
+def get_worker_timeout_seconds(path: Path | None = None) -> float:
+    from . import constants
+    v = get_setting("worker_timeout_seconds", None, path)
+    return float(v) if v else constants.HEARTBEAT_TIMEOUT
+
+
+def is_master_delegate_only(path: Path | None = None) -> bool:
+    cfg = load_config(path)
+    return bool(
+        cfg.get("settings", {}).get("master_delegate_only")
+        or cfg.get("master", {}).get("delegate_only")
+    )
+
+
+def enabled_hosts(config: dict[str, Any] | None = None) -> list[dict]:
+    cfg = config or load_config()
+    return [h for h in cfg.get("hosts", []) if h.get("enabled")]
+
+
+def ensure_config_exists(path: Path | None = None) -> Path:
+    p = path or config_path()
+    if not p.exists():
+        save_config(copy.deepcopy(DEFAULT_CONFIG), p)
+    return p

@@ -1,0 +1,66 @@
+"""Framework tunables, all env-overridable.
+
+Parity with reference ``utils/constants.py:1-68`` (heartbeat cadence, payload
+caps, orchestration concurrencies), re-keyed for the TPU build. Values are
+read once at import; tests may monkeypatch module attributes directly.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except (TypeError, ValueError):
+        return default
+
+
+# --- cluster liveness (reference utils/constants.py:43-68) -----------------
+# Workers heartbeat per processed shard; master requeues work of hosts silent
+# longer than HEARTBEAT_TIMEOUT (reference upscale/job_timeout.py:17-150).
+HEARTBEAT_INTERVAL = _env_float("CDT_HEARTBEAT_INTERVAL", 10.0)
+HEARTBEAT_TIMEOUT = _env_float("CDT_HEARTBEAT_TIMEOUT", 60.0)
+
+# --- payload caps ----------------------------------------------------------
+# Reference caps tile uploads at 50 MB (upscale/job_store.py:12) and audio
+# envelopes at 256 MB (utils/audio_payload.py:11-13).
+MAX_PAYLOAD_SIZE = _env_int("CDT_MAX_PAYLOAD_SIZE", 50 * 1024 * 1024)
+MAX_AUDIO_PAYLOAD_BYTES = _env_int("CDT_MAX_AUDIO_PAYLOAD_BYTES", 256 * 1024 * 1024)
+
+# Max result items per flush from a worker host (reference MAX_BATCH=20,
+# utils/constants.py; upscale/modes/static.py:303-306).
+MAX_BATCH = _env_int("CDT_MAX_BATCH", 20)
+
+# --- orchestration concurrencies (reference utils/config.py:22-45) ---------
+WORKER_PROBE_CONCURRENCY = _env_int("CDT_PROBE_CONCURRENCY", 10)
+WORKER_PREP_CONCURRENCY = _env_int("CDT_PREP_CONCURRENCY", 4)
+MEDIA_SYNC_CONCURRENCY = _env_int("CDT_MEDIA_SYNC_CONCURRENCY", 4)
+
+# --- timeouts --------------------------------------------------------------
+PROBE_TIMEOUT = _env_float("CDT_PROBE_TIMEOUT", 5.0)
+DISPATCH_TIMEOUT = _env_float("CDT_DISPATCH_TIMEOUT", 30.0)
+MEDIA_SYNC_TIMEOUT = _env_float("CDT_MEDIA_SYNC_TIMEOUT", 120.0)
+COLLECT_POLL_TIMEOUT = _env_float("CDT_COLLECT_POLL_TIMEOUT", 5.0)
+JOB_INIT_GRACE = _env_float("CDT_JOB_INIT_GRACE", 10.0)
+WORK_REQUEST_BUDGET = _env_float("CDT_WORK_REQUEST_BUDGET", 30.0)
+
+# --- retries (reference upscale/worker_comms.py:88-104) --------------------
+SEND_MAX_RETRIES = _env_int("CDT_SEND_MAX_RETRIES", 5)
+SEND_BACKOFF_BASE = _env_float("CDT_SEND_BACKOFF_BASE", 0.5)
+
+# --- mesh / sharding defaults ---------------------------------------------
+# Axis names used across the framework. "dp" shards independent jobs/seeds
+# (the reference's worker fan-out), "tp" shards model weights, "sp" shards
+# the sequence/spatial axis (ring attention / tile axis).
+AXIS_DATA = "dp"
+AXIS_TENSOR = "tp"
+AXIS_SEQUENCE = "sp"

@@ -1,0 +1,64 @@
+"""Typed error hierarchy (parity: reference ``utils/exceptions.py:4-43``).
+
+Unlike the reference — where the hierarchy exists but is "barely used in
+practice" (SURVEY §2.5) — these are raised throughout the cluster layer so
+callers can branch on failure class.
+"""
+
+from __future__ import annotations
+
+
+class DistributedError(Exception):
+    """Base class for all framework errors."""
+
+
+class ConfigError(DistributedError):
+    """Invalid or unwritable configuration."""
+
+
+class WorkerError(DistributedError):
+    """A worker host misbehaved (bad payload, bad state transition)."""
+
+    def __init__(self, message: str, worker_id: str | None = None):
+        super().__init__(message)
+        self.worker_id = worker_id
+
+
+class WorkerTimeoutError(WorkerError):
+    """A worker host went silent past the heartbeat timeout."""
+
+
+class WorkerNotAvailableError(WorkerError):
+    """No reachable worker host satisfies the request."""
+
+
+class JobQueueError(DistributedError):
+    """Job store misuse: unknown job, double-init, enqueue on closed job."""
+
+    def __init__(self, message: str, job_id: str | None = None):
+        super().__init__(message)
+        self.job_id = job_id
+
+
+class TileCollectionError(DistributedError):
+    """Tile/shard result collection failed or timed out."""
+
+
+class ProcessError(DistributedError):
+    """Host-controller process management failure."""
+
+
+class TunnelError(DistributedError):
+    """Tunnel (NAT traversal) lifecycle failure."""
+
+
+class ValidationError(DistributedError):
+    """Request/prompt payload failed validation (reference api/schemas.py)."""
+
+    def __init__(self, message: str, field: str | None = None):
+        super().__init__(message)
+        self.field = field
+
+
+class ShardingError(DistributedError):
+    """Mesh/sharding construction failed (axis mismatch, bad device count)."""

@@ -1,0 +1,72 @@
+"""Two-level logging + execution tracing.
+
+Parity: reference ``utils/logging.py:15-43`` (always-on ``log`` and a
+config-gated ``debug_log`` whose gate is cached with a short TTL) and
+``utils/trace_logger.py:4-13`` (per-run trace IDs prefixed
+``[Distributed][exec:<id>]``).
+"""
+
+from __future__ import annotations
+
+import os
+import secrets
+import sys
+import time
+from typing import Callable
+
+_PREFIX = "[Distributed-TPU]"
+
+# TTL cache of the debug gate so hot loops don't re-read config every call
+# (reference utils/logging.py:15-39 uses a 5 s TTL for the same reason).
+_DEBUG_TTL = 5.0
+_debug_cache: tuple[float, bool] | None = None
+_debug_source: Callable[[], bool] | None = None
+
+
+def set_debug_source(fn: Callable[[], bool] | None) -> None:
+    """Install the callable that reports whether debug logging is enabled
+    (normally ``config.get_setting('debug')``); ``None`` resets to env."""
+    global _debug_source, _debug_cache
+    _debug_source = fn
+    _debug_cache = None
+
+
+def _debug_enabled() -> bool:
+    global _debug_cache
+    now = time.monotonic()
+    if _debug_cache is not None and now - _debug_cache[0] < _DEBUG_TTL:
+        return _debug_cache[1]
+    if _debug_source is not None:
+        try:
+            enabled = bool(_debug_source())
+        except Exception:
+            enabled = False
+    else:
+        enabled = os.environ.get("CDT_DEBUG", "") not in ("", "0", "false")
+    _debug_cache = (now, enabled)
+    return enabled
+
+
+def log(msg: str) -> None:
+    print(f"{_PREFIX} {msg}", file=sys.stderr, flush=True)
+
+
+def debug_log(msg: str) -> None:
+    if _debug_enabled():
+        log(f"[DEBUG] {msg}")
+
+
+# --- execution tracing -----------------------------------------------------
+
+def new_trace_id() -> str:
+    """``exec_<ms>_<6hex>`` — same shape as reference trace IDs
+    (``web/executionUtils.js:26`` / ``api/queue_orchestration.py:38-39``)."""
+    return f"exec_{int(time.time() * 1000)}_{secrets.token_hex(3)}"
+
+
+def trace_info(trace_id: str | None, msg: str) -> None:
+    log(f"[exec:{trace_id or '-'}] {msg}")
+
+
+def trace_debug(trace_id: str | None, msg: str) -> None:
+    debug_log(f"[exec:{trace_id or '-'}] {msg}")

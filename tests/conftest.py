@@ -1,0 +1,31 @@
+"""Test harness: force an 8-device virtual CPU mesh before JAX initializes.
+
+The TPU analogue of the reference's stub-package pattern (SURVEY §4): the
+reference tests "multi-node" behavior against in-process asyncio queues; we
+test multi-chip sharding against XLA's virtual CPU devices
+(``--xla_force_host_platform_device_count=8``), so every sharded code path
+compiles and executes without TPU hardware.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def tmp_config(tmp_path, monkeypatch):
+    """Point the config system at a throwaway file."""
+    from comfyui_distributed_tpu.utils import config as config_mod
+
+    path = tmp_path / "tpu_cluster_config.json"
+    monkeypatch.setenv(config_mod.CONFIG_ENV, str(path))
+    config_mod.invalidate_cache()
+    yield path
+    config_mod.invalidate_cache()

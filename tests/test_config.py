@@ -1,0 +1,100 @@
+"""Config system tests (parity model: reference tests/test_config.py — merge,
+cache, atomic save, transaction)."""
+
+import asyncio
+import json
+
+import pytest
+
+from comfyui_distributed_tpu.utils import config as config_mod
+from comfyui_distributed_tpu.utils.exceptions import ConfigError
+
+
+def test_defaults_when_missing(tmp_config):
+    cfg = config_mod.load_config()
+    assert cfg["master"]["port"] == 8288
+    assert cfg["hosts"] == []
+    assert cfg["mesh"]["shape"] == {"dp": -1}
+
+
+def test_deep_merge_preserves_unknown_keys(tmp_config):
+    tmp_config.write_text(json.dumps({
+        "master": {"host": "10.0.0.1"},
+        "custom_section": {"x": 1},
+        "settings": {"debug": True, "unknown_setting": "kept"},
+    }))
+    cfg = config_mod.load_config()
+    assert cfg["master"]["host"] == "10.0.0.1"
+    assert cfg["master"]["port"] == 8288          # default filled in
+    assert cfg["custom_section"] == {"x": 1}       # unknown preserved
+    assert cfg["settings"]["unknown_setting"] == "kept"
+    assert cfg["settings"]["debug"] is True
+
+
+def test_host_normalization(tmp_config):
+    tmp_config.write_text(json.dumps({
+        "hosts": [{"id": "h1", "address": "http://10.0.0.2:8288", "enabled": True}]
+    }))
+    cfg = config_mod.load_config()
+    h = cfg["hosts"][0]
+    assert h["type"] == "remote"
+    assert h["mesh_devices"] == -1
+    assert config_mod.enabled_hosts(cfg) == [h]
+
+
+def test_mtime_cache_and_invalidation(tmp_config):
+    config_mod.save_config({"master": {"host": "a"}})
+    c1 = config_mod.load_config()
+    assert c1["master"]["host"] == "a"
+    # Mutating the returned dict must not poison the cache (deep copies).
+    c1["master"]["host"] = "mutated"
+    assert config_mod.load_config()["master"]["host"] == "a"
+
+
+def test_atomic_save_roundtrip(tmp_config):
+    config_mod.save_config({"settings": {"debug": True}})
+    raw = json.loads(tmp_config.read_text())
+    assert raw["settings"]["debug"] is True
+    # no stray tmp files left behind
+    leftovers = [p for p in tmp_config.parent.iterdir() if p.name.startswith(".cdt_cfg_")]
+    assert leftovers == []
+
+
+def test_corrupt_config_raises(tmp_config):
+    tmp_config.write_text("{not json")
+    with pytest.raises(ConfigError):
+        config_mod.load_config()
+
+
+def test_transaction(tmp_config):
+    async def run():
+        async with config_mod.config_transaction() as cfg:
+            cfg["settings"]["debug"] = True
+            cfg["hosts"].append({"id": "h9", "enabled": True})
+    asyncio.run(run())
+    cfg = config_mod.load_config()
+    assert cfg["settings"]["debug"] is True
+    assert cfg["hosts"][0]["id"] == "h9"
+
+
+def test_worker_timeout_fallback(tmp_config):
+    from comfyui_distributed_tpu.utils import constants
+    assert config_mod.get_worker_timeout_seconds() == constants.HEARTBEAT_TIMEOUT
+    config_mod.update_config(lambda c: c["settings"].update(worker_timeout_seconds=5))
+    assert config_mod.get_worker_timeout_seconds() == 5.0
+
+
+def test_delegate_only_flags(tmp_config):
+    assert not config_mod.is_master_delegate_only()
+    config_mod.update_config(lambda c: c["settings"].update(master_delegate_only=True))
+    assert config_mod.is_master_delegate_only()
+
+
+def test_ensure_config_exists(tmp_config):
+    assert not tmp_config.exists()
+    config_mod.ensure_config_exists()
+    assert tmp_config.exists()
+    # idempotent
+    config_mod.update_config(lambda c: c["settings"].update(debug=True))
+    config_mod.ensure_config_exists()
+    assert config_mod.get_setting("debug") is True

@@ -1,0 +1,37 @@
+from comfyui_distributed_tpu.utils import logging as logmod
+
+
+def test_trace_id_shape():
+    tid = logmod.new_trace_id()
+    assert tid.startswith("exec_")
+    parts = tid.split("_")
+    assert len(parts) == 3 and len(parts[2]) == 6
+    int(parts[1])  # ms timestamp
+
+
+def test_debug_gate_uses_source_and_ttl_cache(capsys, monkeypatch):
+    calls = []
+
+    def source():
+        calls.append(1)
+        return True
+
+    logmod.set_debug_source(source)
+    try:
+        logmod.debug_log("one")
+        logmod.debug_log("two")
+        # TTL cache: source consulted once within the window
+        assert len(calls) == 1
+        err = capsys.readouterr().err
+        assert "one" in err and "two" in err
+    finally:
+        logmod.set_debug_source(None)
+
+
+def test_debug_source_exception_disables(capsys):
+    logmod.set_debug_source(lambda: 1 / 0)
+    try:
+        logmod.debug_log("hidden")
+        assert "hidden" not in capsys.readouterr().err
+    finally:
+        logmod.set_debug_source(None)
