@@ -1,0 +1,22 @@
+"""TPU parallel substrate: mesh bootstrap, sharding, RNG, collectives.
+
+This package is the TPU-native replacement for the reference's entire
+transport/parallelism story (SURVEY §2.10): where the reference fans jobs to
+worker *processes* over HTTP and gathers base64-PNG envelopes
+(``nodes/collector.py``), we shard computations over a ``jax.sharding.Mesh``
+and gather with XLA collectives over ICI.
+"""
+
+from .mesh import (  # noqa: F401
+    MeshSpec,
+    build_mesh,
+    device_census,
+    local_device_count,
+    mesh_from_config,
+)
+from .rng import participant_key, participant_keys, seed_to_key  # noqa: F401
+from .sharding import (  # noqa: F401
+    batch_sharding,
+    replicated_sharding,
+    shard_batch,
+)

@@ -1,0 +1,131 @@
+"""Device census and mesh construction.
+
+The reference enumerates CUDA devices to auto-populate one worker process per
+GPU (``api/worker_routes.py:237-289`` + ``web/masterDetection.js:36-100``).
+The TPU equivalent enumerates ``jax.devices()`` and lays them out as a named
+``Mesh``; "workers" on-pod are mesh slots, not OS processes (SURVEY §7).
+
+Multi-host: when JAX's distributed runtime is initialized, ``jax.devices()``
+returns the global device list and the same mesh spans hosts over DCN; this
+module needs no special casing beyond using global devices.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Mapping, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from ..utils.exceptions import ShardingError
+
+
+def device_census() -> list[dict[str, Any]]:
+    """Describe every visible device — the TPU analogue of the reference's
+    CUDA census used for worker auto-population."""
+    out = []
+    for d in jax.devices():
+        info: dict[str, Any] = {
+            "id": d.id,
+            "platform": d.platform,
+            "kind": getattr(d, "device_kind", "unknown"),
+            "process_index": d.process_index,
+        }
+        coords = getattr(d, "coords", None)
+        if coords is not None:
+            info["coords"] = tuple(coords)
+        out.append(info)
+    return out
+
+
+def local_device_count() -> int:
+    return jax.local_device_count()
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Mesh shape as an ordered mapping of axis name → size.
+
+    At most one axis may be ``-1`` ("all remaining devices"), mirroring the
+    config schema (``utils/config.py`` ``mesh.shape``).
+    """
+
+    shape: tuple[tuple[str, int], ...]
+
+    @classmethod
+    def from_mapping(cls, mapping: Mapping[str, int]) -> "MeshSpec":
+        items = tuple((str(k), int(v)) for k, v in mapping.items())
+        if not items:
+            raise ShardingError("mesh shape must have at least one axis")
+        if sum(1 for _, v in items if v == -1) > 1:
+            raise ShardingError("at most one mesh axis may be -1")
+        for name, v in items:
+            if v == 0 or v < -1:
+                raise ShardingError(f"invalid size {v} for mesh axis {name!r}")
+        return cls(items)
+
+    @property
+    def axis_names(self) -> tuple[str, ...]:
+        return tuple(n for n, _ in self.shape)
+
+    def resolve(self, n_devices: int) -> tuple[int, ...]:
+        """Concrete per-axis sizes for ``n_devices`` total devices."""
+        sizes = [v for _, v in self.shape]
+        known = math.prod(v for v in sizes if v != -1)
+        if -1 in sizes:
+            if n_devices % known:
+                raise ShardingError(
+                    f"{n_devices} devices not divisible by fixed axes product {known}"
+                )
+            sizes[sizes.index(-1)] = n_devices // known
+        elif known > n_devices:
+            raise ShardingError(
+                f"mesh {dict(self.shape)} needs {known} devices, have {n_devices}"
+            )
+        return tuple(sizes)
+
+
+def build_mesh(
+    spec: MeshSpec | Mapping[str, int],
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Build a ``Mesh`` from a spec, using all visible devices by default.
+
+    Devices are laid out in enumeration order reshaped to the spec — on TPU,
+    ``jax.devices()`` order follows the physical torus so contiguous mesh
+    axes ride ICI neighbours; we deliberately do not permute it.
+    """
+    if not isinstance(spec, MeshSpec):
+        spec = MeshSpec.from_mapping(spec)
+    devs = list(devices) if devices is not None else list(jax.devices())
+    sizes = spec.resolve(len(devs))
+    used = math.prod(sizes)
+    grid = np.array(devs[:used], dtype=object).reshape(sizes)
+    return Mesh(grid, spec.axis_names)
+
+
+def mesh_from_config(config: dict, devices: Sequence[jax.Device] | None = None) -> Mesh:
+    """Mesh from the ``mesh.shape`` config section."""
+    shape = (config.get("mesh") or {}).get("shape") or {"dp": -1}
+    return build_mesh(MeshSpec.from_mapping(shape), devices)
+
+
+def axis_size(mesh: Mesh, axis: str) -> int:
+    try:
+        return mesh.shape[axis]
+    except KeyError:
+        raise ShardingError(f"mesh has no axis {axis!r}; axes: {mesh.axis_names}")
+
+
+def describe_mesh(mesh: Mesh) -> dict[str, Any]:
+    """JSON-friendly mesh summary for the control plane's system_info
+    (parity: reference ``api/worker_routes.py:393-430``)."""
+    return {
+        "axes": dict(mesh.shape),
+        "n_devices": mesh.devices.size,
+        "platform": mesh.devices.flat[0].platform,
+        "process_indices": sorted({d.process_index for d in mesh.devices.flat}),
+    }

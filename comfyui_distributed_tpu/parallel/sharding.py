@@ -1,0 +1,40 @@
+"""NamedSharding helpers and host→device placement.
+
+Thin, convention-setting wrappers: batch axis 0 shards over ``dp`` (the
+reference's job fan-out), weights replicate (or shard over ``tp`` when tensor
+parallelism is enabled), everything else replicates.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..utils import constants
+
+
+def batch_sharding(mesh: Mesh, ndim: int, axis: str = constants.AXIS_DATA) -> NamedSharding:
+    """Shard dim 0 over ``axis``, replicate the rest."""
+    return NamedSharding(mesh, P(axis, *([None] * (ndim - 1))))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def shard_batch(mesh: Mesh, tree: Any, axis: str = constants.AXIS_DATA) -> Any:
+    """Place a pytree on the mesh with leaf dim 0 sharded over ``axis``."""
+    return jax.tree.map(
+        lambda x: jax.device_put(x, batch_sharding(mesh, x.ndim, axis)), tree
+    )
+
+
+def replicate(mesh: Mesh, tree: Any) -> Any:
+    sh = replicated_sharding(mesh)
+    return jax.tree.map(lambda x: jax.device_put(x, sh), tree)
+
+
+def batch_spec(ndim: int, axis: str = constants.AXIS_DATA) -> P:
+    return P(axis, *([None] * (ndim - 1)))
