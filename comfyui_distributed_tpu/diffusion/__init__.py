@@ -1,0 +1,18 @@
+"""Diffusion numerics: noise schedules, samplers, guidance, pipelines.
+
+The reference drives ComfyUI's ``common_ksampler`` for all of this
+(``upscale/tile_ops.py:226-229``); here it is native JAX. Samplers operate in
+k-diffusion sigma space (ComfyUI's convention) so step counts/schedules are
+comparable, and every loop is a ``lax.scan`` with static step count — one
+XLA compilation per (shape, steps) pair, fully on-device.
+"""
+
+from .schedules import (  # noqa: F401
+    NoiseSchedule,
+    vp_schedule,
+    sigmas_karras,
+    sigmas_normal,
+    sigmas_flow,
+)
+from .samplers import SAMPLERS, sample  # noqa: F401
+from .guidance import cfg_denoiser  # noqa: F401

@@ -1,0 +1,193 @@
+"""Sharded text→image pipeline — the framework's "distributed txt2img".
+
+Reference parity (SURVEY §3.2): the reference dispatches the same workflow
+to N worker processes with per-worker seed offsets and gathers PNG envelopes
+over HTTP. Here the whole fan-out is ONE SPMD program: ``shard_map`` over
+the ``dp`` mesh axis, per-shard ``fold_in`` of the seed (DistributedSeed
+parity), per-shard sampling + VAE decode, and the sharded output array *is*
+the collected batch (Collector parity) — materializing it performs the
+all-gather over ICI. No serialization, no control-plane round trips inside
+the step.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..models.layers import timestep_embedding
+from ..models.unet import UNet2D, UNetConfig
+from ..models.vae import AutoencoderKL
+from ..parallel.rng import participant_key
+from ..utils import constants
+from .guidance import cfg_denoiser, eps_denoiser
+from .samplers import sample
+from .schedules import NoiseSchedule, sigmas_karras, sigmas_normal, vp_schedule
+
+
+@dataclasses.dataclass(frozen=True)
+class GenerationSpec:
+    height: int = 1024
+    width: int = 1024
+    steps: int = 30
+    sampler: str = "euler"
+    scheduler: str = "karras"      # "karras" | "normal"
+    guidance_scale: float = 5.0
+    per_device_batch: int = 1
+    denoise: float = 1.0           # <1.0: img2img partial ladder (tile engine)
+
+
+def make_sigma_ladder(spec: GenerationSpec, schedule: NoiseSchedule) -> jax.Array:
+    n = max(1, round(spec.steps * spec.denoise))
+    if spec.scheduler == "karras":
+        smin = float(schedule.sigmas[0])
+        smax = float(schedule.sigmas[-1])
+        full = sigmas_karras(spec.steps, smin, smax)
+    elif spec.scheduler == "normal":
+        full = sigmas_normal(spec.steps, schedule)
+    else:
+        raise ValueError(f"unknown scheduler {spec.scheduler!r}")
+    # partial denoise keeps the *tail* of the ladder (img2img convention)
+    return full[-(n + 1):]
+
+
+def sdxl_adm(
+    pooled: jax.Array,
+    orig_size: tuple[int, int],
+    crop: tuple[int, int] = (0, 0),
+    target_size: Optional[tuple[int, int]] = None,
+) -> jax.Array:
+    """SDXL micro-conditioning vector: pooled text ⊕ 6×256-dim Fourier
+    embeddings of (orig_h, orig_w, crop_top, crop_left, tgt_h, tgt_w)."""
+    target_size = target_size or orig_size
+    vals = [orig_size[0], orig_size[1], crop[0], crop[1], target_size[0], target_size[1]]
+    embs = [
+        timestep_embedding(jnp.full((pooled.shape[0],), float(v)), 256) for v in vals
+    ]
+    return jnp.concatenate([pooled] + embs, axis=-1)
+
+
+class Txt2ImgPipeline:
+    """Bundle of UNet + VAE + schedule with compiled sharded generation.
+
+    ``generate_fn(mesh, spec)`` returns a jitted SPMD function
+    ``(key, context, uncond_context, y, uncond_y) -> images`` where images
+    is a globally-sharded ``[n_dp · per_device_batch, H, W, 3]`` array in
+    [0, 1] (ComfyUI IMAGE layout, ``utils/image.py:8-24`` in the reference).
+    """
+
+    def __init__(
+        self,
+        unet: UNet2D,
+        unet_params,
+        vae: AutoencoderKL,
+        schedule: NoiseSchedule | None = None,
+    ):
+        self.unet = unet
+        self.unet_params = unet_params
+        self.vae = vae
+        self.schedule = schedule or vp_schedule()
+
+    @property
+    def latent_channels(self) -> int:
+        return self.unet.config.in_channels
+
+    def _denoiser(self, context, y):
+        def model_fn(x, t, ctx, y_):
+            return self.unet.apply(self.unet_params, x, t, ctx, y_)
+
+        return eps_denoiser(model_fn, self.schedule, context, y)
+
+    def _sample_and_decode(self, key, context, uncond_context, y, uncond_y,
+                           spec: GenerationSpec, batch: int, sigmas: jax.Array):
+        """Single-shard work: noise → sampler scan → VAE decode."""
+        lat_h = spec.height // self.vae.config.downscale
+        lat_w = spec.width // self.vae.config.downscale
+        k_noise, k_samp = jax.random.split(key)
+        x = jax.random.normal(
+            k_noise, (batch, lat_h, lat_w, self.latent_channels), jnp.float32
+        ) * sigmas[0]
+
+        if spec.guidance_scale != 1.0:
+            denoise = cfg_denoiser(
+                lambda ctx, yy: self._denoiser(ctx, yy),
+                jnp.broadcast_to(context, (batch,) + context.shape[1:]),
+                jnp.broadcast_to(uncond_context, (batch,) + uncond_context.shape[1:]),
+                spec.guidance_scale,
+                None if y is None else jnp.broadcast_to(y, (batch,) + y.shape[1:]),
+                None if uncond_y is None else jnp.broadcast_to(uncond_y, (batch,) + uncond_y.shape[1:]),
+            )
+        else:
+            denoise = self._denoiser(
+                jnp.broadcast_to(context, (batch,) + context.shape[1:]),
+                None if y is None else jnp.broadcast_to(y, (batch,) + y.shape[1:]),
+            )
+        x0 = sample(spec.sampler, denoise, x, sigmas, key=k_samp)
+        images = self.vae.decode(x0)
+        return jnp.clip(images / 2.0 + 0.5, 0.0, 1.0)
+
+    def generate_fn(self, mesh: Mesh, spec: GenerationSpec,
+                    axis: str = constants.AXIS_DATA):
+        """Compile the SPMD generator over ``mesh[axis]``.
+
+        Every shard derives its own key via ``fold_in(key, axis_index)`` —
+        shard 0 is the reference's "master", shard N its worker N
+        (``nodes/utilities.py:52-75``) — then samples and decodes its own
+        ``per_device_batch`` images. Output dim 0 is sharded over ``axis``
+        in participant order (Collector ordering contract,
+        ``nodes/collector.py:252-295``).
+        """
+        has_y = self.unet.config.adm_in_channels > 0
+        # ladder is built eagerly (host-side) so it's a compile-time constant
+        sigmas = make_sigma_ladder(spec, self.schedule)
+
+        def per_shard(key, context, uncond_context, y, uncond_y):
+            k = participant_key(key, axis)
+            return self._sample_and_decode(
+                k, context, uncond_context,
+                y if has_y else None, uncond_y if has_y else None,
+                spec, spec.per_device_batch, sigmas,
+            )
+
+        in_specs = (P(), P(None, None, None), P(None, None, None), P(None, None), P(None, None))
+        f = jax.shard_map(
+            per_shard, mesh=mesh, in_specs=in_specs,
+            out_specs=P(axis, None, None, None),
+        )
+        return jax.jit(f)
+
+    def generate(
+        self,
+        mesh: Mesh,
+        spec: GenerationSpec,
+        seed: int,
+        context: jax.Array,
+        uncond_context: jax.Array,
+        y: Optional[jax.Array] = None,
+        uncond_y: Optional[jax.Array] = None,
+    ) -> jax.Array:
+        """Convenience one-shot generate (compiles on first distinct spec)."""
+        fn = self._cached_fn(mesh, spec)
+        if y is None:
+            adm = self.unet.config.adm_in_channels
+            y = jnp.zeros((1, max(adm, 1)), jnp.float32)
+        if uncond_y is None:
+            uncond_y = jnp.zeros_like(y)
+        key = jax.random.key(seed)
+        return fn(key, context, uncond_context, y, uncond_y)
+
+    @functools.lru_cache(maxsize=8)
+    def _cached_fn_impl(self, mesh_key, spec):
+        return self.generate_fn(self._meshes[mesh_key], spec)
+
+    def _cached_fn(self, mesh: Mesh, spec: GenerationSpec):
+        if not hasattr(self, "_meshes"):
+            self._meshes: dict[int, Mesh] = {}
+        mesh_key = id(mesh)
+        self._meshes[mesh_key] = mesh
+        return self._cached_fn_impl(mesh_key, spec)

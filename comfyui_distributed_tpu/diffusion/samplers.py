@@ -1,0 +1,145 @@
+"""Samplers as ``lax.scan`` loops in sigma space.
+
+A sampler advances ``x`` down a sigma ladder using a *denoiser*
+``denoise(x, sigma) -> x0_hat``. The denoiser hides the model
+parameterization (eps-pred UNet, flow DiT) and any guidance — see
+``guidance.py`` and ``pipeline.py``.
+
+All samplers are data-dependent-control-flow-free: fixed step count, fixed
+shapes, stochastic steps derive per-step keys with ``fold_in`` — so a whole
+sampling run compiles to a single XLA while/scan and never returns to the
+host between steps (the reference pays a Python round-trip per *tile* per
+step through ComfyUI's sampler; SURVEY §3.3 "GPU HOT LOOP").
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Protocol
+
+import jax
+import jax.numpy as jnp
+
+Denoiser = Callable[[jax.Array, jax.Array], jax.Array]   # (x, sigma[]) -> x0_hat
+
+
+def _to_d(x: jax.Array, sigma: jax.Array, denoised: jax.Array) -> jax.Array:
+    """Convert x0 prediction to the k-diffusion ODE derivative."""
+    return (x - denoised) / jnp.maximum(sigma, 1e-10)
+
+
+def sample_euler(denoise: Denoiser, x: jax.Array, sigmas: jax.Array,
+                 key: jax.Array | None = None) -> jax.Array:
+    del key
+
+    def step(x, i):
+        sigma, sigma_next = sigmas[i], sigmas[i + 1]
+        denoised = denoise(x, sigma)
+        d = _to_d(x, sigma, denoised)
+        return x + d * (sigma_next - sigma), None
+
+    n = sigmas.shape[0] - 1
+    x, _ = jax.lax.scan(step, x, jnp.arange(n))
+    return x
+
+
+def sample_euler_ancestral(denoise: Denoiser, x: jax.Array, sigmas: jax.Array,
+                           key: jax.Array, eta: float = 1.0) -> jax.Array:
+    def step(x, i):
+        sigma, sigma_next = sigmas[i], sigmas[i + 1]
+        denoised = denoise(x, sigma)
+        var_ratio = jnp.maximum(1.0 - (sigma_next / jnp.maximum(sigma, 1e-10)) ** 2, 0.0)
+        sigma_up = jnp.minimum(sigma_next, eta * sigma_next * jnp.sqrt(var_ratio))
+        sigma_down = jnp.sqrt(jnp.maximum(sigma_next ** 2 - sigma_up ** 2, 0.0))
+        d = _to_d(x, sigma, denoised)
+        x = x + d * (sigma_down - sigma)
+        noise = jax.random.normal(jax.random.fold_in(key, i), x.shape, x.dtype)
+        # last step has sigma_next == 0 → sigma_up == 0 → no noise added
+        return x + noise * sigma_up, None
+
+    n = sigmas.shape[0] - 1
+    x, _ = jax.lax.scan(step, x, jnp.arange(n))
+    return x
+
+
+def sample_heun(denoise: Denoiser, x: jax.Array, sigmas: jax.Array,
+                key: jax.Array | None = None) -> jax.Array:
+    del key
+
+    def step(x, i):
+        sigma, sigma_next = sigmas[i], sigmas[i + 1]
+        denoised = denoise(x, sigma)
+        d = _to_d(x, sigma, denoised)
+        dt = sigma_next - sigma
+        x_euler = x + d * dt
+
+        def heun_correct(_):
+            denoised2 = denoise(x_euler, sigma_next)
+            d2 = _to_d(x_euler, sigma_next, denoised2)
+            return x + (d + d2) / 2 * dt
+
+        # at the final step sigma_next==0: plain euler (no second eval at σ=0)
+        x = jax.lax.cond(sigma_next > 0, heun_correct, lambda _: x_euler, None)
+        return x, None
+
+    n = sigmas.shape[0] - 1
+    x, _ = jax.lax.scan(step, x, jnp.arange(n))
+    return x
+
+
+def sample_dpmpp_2m(denoise: Denoiser, x: jax.Array, sigmas: jax.Array,
+                    key: jax.Array | None = None) -> jax.Array:
+    """DPM-Solver++(2M): second-order multistep on log-sigma."""
+    del key
+
+    def t_of(sigma):
+        return -jnp.log(jnp.maximum(sigma, 1e-10))
+
+    def step(carry, i):
+        x, old_denoised, have_old = carry
+        sigma, sigma_next = sigmas[i], sigmas[i + 1]
+        denoised = denoise(x, sigma)
+
+        def first_order(_):
+            # exact Euler in exponential-integrator form
+            return x * (sigma_next / sigma) + denoised * (1 - sigma_next / sigma)
+
+        def second_order(_):
+            h = t_of(sigma_next) - t_of(sigma)
+            h_last = t_of(sigma) - t_of(sigmas[i - 1])
+            r = h_last / jnp.maximum(h, 1e-10)
+            denoised_d = (1 + 1 / (2 * r)) * denoised - (1 / (2 * r)) * old_denoised
+            return x * (sigma_next / sigma) + denoised_d * (1 - sigma_next / sigma)
+
+        use_second = jnp.logical_and(have_old, sigma_next > 0)
+        x_new = jax.lax.cond(use_second, second_order, first_order, None)
+        # sigma_next == 0: x -> denoised exactly
+        x_new = jnp.where(sigma_next > 0, x_new, denoised)
+        return (x_new, denoised, jnp.array(True)), None
+
+    n = sigmas.shape[0] - 1
+    init = (x, jnp.zeros_like(x), jnp.array(False))
+    (x, _, _), _ = jax.lax.scan(step, init, jnp.arange(n))
+    return x
+
+
+SAMPLERS: dict[str, Callable] = {
+    "euler": sample_euler,
+    "euler_ancestral": sample_euler_ancestral,
+    "heun": sample_heun,
+    "dpmpp_2m": sample_dpmpp_2m,
+}
+
+
+def sample(
+    name: str,
+    denoise: Denoiser,
+    x: jax.Array,
+    sigmas: jax.Array,
+    key: jax.Array | None = None,
+    **kwargs,
+) -> jax.Array:
+    try:
+        fn = SAMPLERS[name]
+    except KeyError:
+        raise ValueError(f"unknown sampler {name!r}; have {sorted(SAMPLERS)}")
+    return fn(denoise, x, sigmas, key, **kwargs)

@@ -1,0 +1,88 @@
+"""Noise schedules and sigma ladders (k-diffusion parameterization).
+
+``NoiseSchedule`` holds the VP training schedule (alphas_cumprod) used to
+map sigma ↔ model timestep for eps-prediction UNets; the ``sigmas_*``
+functions build inference ladders (karras / normal / linear-flow), matching
+the schedule names ComfyUI exposes so reference workflows translate 1:1.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class NoiseSchedule:
+    """VP schedule: sigma_t = sqrt((1 - acp_t) / acp_t) over training steps."""
+
+    alphas_cumprod: jax.Array       # [T] float32
+
+    @property
+    def sigmas(self) -> jax.Array:
+        acp = self.alphas_cumprod
+        return jnp.sqrt((1.0 - acp) / acp)
+
+    @property
+    def sigma_min(self) -> jax.Array:
+        return self.sigmas[0]
+
+    @property
+    def sigma_max(self) -> jax.Array:
+        return self.sigmas[-1]
+
+    def timestep_for_sigma(self, sigma: jax.Array) -> jax.Array:
+        """Continuous timestep index whose table sigma matches ``sigma``
+        (linear interpolation in log-sigma, clipped to the table)."""
+        log_s = jnp.log(jnp.maximum(self.sigmas, 1e-10))
+        t = jnp.interp(
+            jnp.log(jnp.maximum(sigma, 1e-10)), log_s, jnp.arange(log_s.shape[0], dtype=jnp.float32)
+        )
+        return t
+
+
+def vp_schedule(
+    num_steps: int = 1000,
+    beta_start: float = 0.00085,
+    beta_end: float = 0.012,
+    kind: str = "scaled_linear",
+) -> NoiseSchedule:
+    """SD-family betas ("scaled_linear": linear in sqrt(beta))."""
+    if kind == "scaled_linear":
+        betas = jnp.linspace(beta_start ** 0.5, beta_end ** 0.5, num_steps) ** 2
+    elif kind == "linear":
+        betas = jnp.linspace(beta_start, beta_end, num_steps)
+    else:
+        raise ValueError(f"unknown beta schedule {kind!r}")
+    return NoiseSchedule(jnp.cumprod(1.0 - betas))
+
+
+def sigmas_karras(
+    n: int, sigma_min: float, sigma_max: float, rho: float = 7.0
+) -> jax.Array:
+    """Karras et al. (2022) ladder; returns [n+1] descending, last = 0."""
+    ramp = jnp.linspace(0, 1, n)
+    min_inv = sigma_min ** (1 / rho)
+    max_inv = sigma_max ** (1 / rho)
+    sigmas = (max_inv + ramp * (min_inv - max_inv)) ** rho
+    return jnp.concatenate([sigmas, jnp.zeros((1,))])
+
+
+def sigmas_normal(n: int, schedule: NoiseSchedule) -> jax.Array:
+    """Uniform-in-timestep ladder over the VP table ("normal" in ComfyUI)."""
+    table = schedule.sigmas
+    T = table.shape[0]
+    t = jnp.linspace(T - 1, 0, n)
+    sigmas = jnp.interp(t, jnp.arange(T, dtype=jnp.float32), table)
+    return jnp.concatenate([sigmas, jnp.zeros((1,))])
+
+
+def sigmas_flow(n: int, shift: float = 1.0) -> jax.Array:
+    """Rectified-flow ladder: t from 1→0 with resolution shift
+    (sigma' = shift·sigma / (1 + (shift−1)·sigma)); FLUX/SD3 convention."""
+    sigmas = jnp.linspace(1.0, 0.0, n + 1)
+    if shift != 1.0:
+        sigmas = shift * sigmas / (1.0 + (shift - 1.0) * sigmas)
+    return sigmas

@@ -1,0 +1,19 @@
+"""Diffusion model zoo in flax (TPU-native).
+
+The reference delegates all model code to ComfyUI (``comfy.samplers``,
+``comfy.model_management`` — SURVEY "external substrate"); a standalone TPU
+framework must supply it. Models are written flax-linen, bfloat16 compute /
+float32 params, static shapes, MXU-friendly (channels stay multiples of 64,
+attention via fused ``jax.nn.dot_product_attention``).
+
+Families
+--------
+unet     SDXL-class latent UNet (eps-pred, cross-attention conditioning)
+vae      AutoencoderKL encoder/decoder (latent ↔ pixel)
+dit      FLUX-class rectified-flow MMDiT
+text     text conditioning encoders
+video    WAN-class video DiT (frame-axis aware)
+"""
+
+from .unet import UNetConfig, UNet2D  # noqa: F401
+from .vae import VAEConfig, Decoder, Encoder, AutoencoderKL  # noqa: F401

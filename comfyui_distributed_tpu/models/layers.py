@@ -1,0 +1,182 @@
+"""Shared building blocks for the model zoo.
+
+Design notes (TPU):
+- compute in ``bfloat16`` (param storage ``float32``): MXU native dtype;
+- GroupNorm in float32 for numerical stability, cast back after;
+- attention uses ``jax.nn.dot_product_attention`` so XLA picks the fused
+  flash-style lowering;
+- all shapes static; no python control flow depends on values.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+def timestep_embedding(t: jax.Array, dim: int, max_period: float = 10000.0) -> jax.Array:
+    """Sinusoidal timestep embedding, [B] -> [B, dim] (DDPM convention)."""
+    half = dim // 2
+    freqs = jnp.exp(-math.log(max_period) * jnp.arange(half, dtype=jnp.float32) / half)
+    args = t.astype(jnp.float32)[:, None] * freqs[None, :]
+    emb = jnp.concatenate([jnp.cos(args), jnp.sin(args)], axis=-1)
+    if dim % 2:
+        emb = jnp.pad(emb, ((0, 0), (0, 1)))
+    return emb
+
+
+class GroupNorm32(nn.Module):
+    """GroupNorm computed in float32, output cast to the input dtype."""
+
+    num_groups: int = 32
+    epsilon: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        orig = x.dtype
+        x = x.astype(jnp.float32)
+        groups = min(self.num_groups, x.shape[-1])
+        x = nn.GroupNorm(num_groups=groups, epsilon=self.epsilon, dtype=jnp.float32)(x)
+        return x.astype(orig)
+
+
+class TimestepEmbedSequential(nn.Module):
+    """Apply a list of blocks, feeding time/context only to those that take it."""
+
+    blocks: tuple
+
+    def __call__(self, x, emb=None, context=None):
+        for block in self.blocks:
+            if isinstance(block, ResBlock):
+                x = block(x, emb)
+            elif isinstance(block, SpatialTransformer):
+                x = block(x, context)
+            else:
+                x = block(x)
+        return x
+
+
+class ResBlock(nn.Module):
+    """GN→SiLU→conv, time-embedding shift, GN→SiLU→conv, residual.
+
+    Matches the standard latent-diffusion ResBlock topology so published
+    UNet weights can be mapped onto it.
+    """
+
+    out_channels: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array, emb: jax.Array) -> jax.Array:
+        h = GroupNorm32()(x)
+        h = nn.silu(h)
+        h = nn.Conv(self.out_channels, (3, 3), padding=1, dtype=self.dtype, name="conv1")(h)
+        emb_out = nn.Dense(self.out_channels, dtype=self.dtype, name="time_proj")(nn.silu(emb))
+        h = h + emb_out[:, None, None, :]
+        h = GroupNorm32()(h)
+        h = nn.silu(h)
+        h = nn.Conv(self.out_channels, (3, 3), padding=1, dtype=self.dtype, name="conv2")(h)
+        if x.shape[-1] != self.out_channels:
+            x = nn.Conv(self.out_channels, (1, 1), dtype=self.dtype, name="skip")(x)
+        return x + h
+
+
+class Attention(nn.Module):
+    """Multi-head attention over [B, N, C] with optional cross context."""
+
+    num_heads: int
+    head_dim: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array, context: Optional[jax.Array] = None) -> jax.Array:
+        ctx = x if context is None else context
+        inner = self.num_heads * self.head_dim
+        q = nn.Dense(inner, use_bias=False, dtype=self.dtype, name="to_q")(x)
+        k = nn.Dense(inner, use_bias=False, dtype=self.dtype, name="to_k")(ctx)
+        v = nn.Dense(inner, use_bias=False, dtype=self.dtype, name="to_v")(ctx)
+        B, N, _ = q.shape
+        M = k.shape[1]
+        q = q.reshape(B, N, self.num_heads, self.head_dim)
+        k = k.reshape(B, M, self.num_heads, self.head_dim)
+        v = v.reshape(B, M, self.num_heads, self.head_dim)
+        out = jax.nn.dot_product_attention(q, k, v)
+        out = out.reshape(B, N, inner)
+        return nn.Dense(x.shape[-1], dtype=self.dtype, name="to_out")(out)
+
+
+class GEGLU(nn.Module):
+    mult: int = 4
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        dim = x.shape[-1]
+        h = nn.Dense(dim * self.mult * 2, dtype=self.dtype, name="proj_in")(x)
+        h, gate = jnp.split(h, 2, axis=-1)
+        h = h * nn.gelu(gate)
+        return nn.Dense(dim, dtype=self.dtype, name="proj_out")(h)
+
+
+class TransformerBlock(nn.Module):
+    """LN→self-attn, LN→cross-attn, LN→GEGLU-FF, all residual (LDM layout)."""
+
+    num_heads: int
+    head_dim: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array, context: Optional[jax.Array]) -> jax.Array:
+        x = x + Attention(self.num_heads, self.head_dim, self.dtype, name="attn1")(
+            nn.LayerNorm(dtype=self.dtype)(x)
+        )
+        x = x + Attention(self.num_heads, self.head_dim, self.dtype, name="attn2")(
+            nn.LayerNorm(dtype=self.dtype)(x), context
+        )
+        x = x + GEGLU(dtype=self.dtype, name="ff")(nn.LayerNorm(dtype=self.dtype)(x))
+        return x
+
+
+class SpatialTransformer(nn.Module):
+    """Project [B,H,W,C] to tokens, run transformer blocks, project back."""
+
+    num_heads: int
+    depth: int = 1
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array, context: Optional[jax.Array]) -> jax.Array:
+        B, H, W, C = x.shape
+        head_dim = C // self.num_heads
+        h = GroupNorm32()(x)
+        h = nn.Dense(C, dtype=self.dtype, name="proj_in")(h.reshape(B, H * W, C))
+        for i in range(self.depth):
+            h = TransformerBlock(self.num_heads, head_dim, self.dtype, name=f"block_{i}")(
+                h, context
+            )
+        h = nn.Dense(C, dtype=self.dtype, name="proj_out")(h)
+        return x + h.reshape(B, H, W, C)
+
+
+class Downsample(nn.Module):
+    out_channels: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        return nn.Conv(self.out_channels, (3, 3), strides=2, padding=1, dtype=self.dtype)(x)
+
+
+class Upsample(nn.Module):
+    out_channels: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        B, H, W, C = x.shape
+        x = jax.image.resize(x, (B, H * 2, W * 2, C), method="nearest")
+        return nn.Conv(self.out_channels, (3, 3), padding=1, dtype=self.dtype)(x)

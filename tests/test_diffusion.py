@@ -1,0 +1,110 @@
+"""Schedule and sampler numerics tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from comfyui_distributed_tpu.diffusion import (
+    SAMPLERS,
+    sample,
+    sigmas_flow,
+    sigmas_karras,
+    sigmas_normal,
+    vp_schedule,
+)
+from comfyui_distributed_tpu.diffusion.guidance import cfg_denoiser, eps_denoiser
+
+
+def test_vp_schedule_table():
+    sched = vp_schedule()
+    sig = np.asarray(sched.sigmas)
+    assert sig.shape == (1000,)
+    assert np.all(np.diff(sig) > 0)           # monotone increasing in t
+    assert 0.02 < sig[0] < 0.04               # SD-family sigma_min ~0.029
+    assert 10 < sig[-1] < 20                  # sigma_max ~14.6
+
+
+def test_timestep_for_sigma_inverts_table():
+    sched = vp_schedule()
+    ts = np.asarray(sched.timestep_for_sigma(sched.sigmas[jnp.array([0, 500, 999])]))
+    np.testing.assert_allclose(ts, [0.0, 500.0, 999.0], atol=1e-2)
+
+
+def test_karras_ladder():
+    s = np.asarray(sigmas_karras(10, 0.03, 150.0))
+    assert s.shape == (11,)
+    assert s[0] == pytest.approx(150.0)
+    assert s[-1] == 0.0
+    assert np.all(np.diff(s) < 0)
+
+
+def test_normal_ladder():
+    sched = vp_schedule()
+    s = np.asarray(sigmas_normal(10, sched))
+    assert s.shape == (11,)
+    assert s[0] == pytest.approx(float(sched.sigma_max), rel=1e-5)
+    assert s[-1] == 0.0
+
+
+def test_flow_ladder_shift():
+    s1 = np.asarray(sigmas_flow(8))
+    assert s1[0] == 1.0 and s1[-1] == 0.0
+    s3 = np.asarray(sigmas_flow(8, shift=3.0))
+    # shift pushes mass toward high sigma
+    assert np.all(s3[1:-1] >= s1[1:-1])
+
+
+@pytest.mark.parametrize("name", sorted(SAMPLERS))
+def test_samplers_converge_with_perfect_denoiser(name):
+    """With an oracle denoiser D(x,σ)=x0 the probability-flow ODE is linear
+    and every sampler must land exactly on x0 at σ=0."""
+    x0 = jnp.full((2, 4, 4, 1), 3.5)
+    sigmas = sigmas_karras(8, 0.03, 150.0)
+    x_init = jax.random.normal(jax.random.key(0), x0.shape) * sigmas[0]
+    out = sample(name, lambda x, s: x0, x_init, sigmas, key=jax.random.key(1))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x0), rtol=1e-3, atol=1e-3)
+
+
+def test_euler_deterministic_euler_ancestral_stochastic():
+    x0 = jnp.zeros((1, 4, 4, 1))
+    sigmas = sigmas_karras(6, 0.03, 10.0)
+    x = jax.random.normal(jax.random.key(0), x0.shape) * sigmas[0]
+    denoise = lambda xx, s: xx * 0.5
+    e1 = sample("euler", denoise, x, sigmas)
+    e2 = sample("euler", denoise, x, sigmas)
+    np.testing.assert_array_equal(np.asarray(e1), np.asarray(e2))
+    a1 = sample("euler_ancestral", denoise, x, sigmas, key=jax.random.key(1))
+    a2 = sample("euler_ancestral", denoise, x, sigmas, key=jax.random.key(2))
+    assert not np.allclose(np.asarray(a1), np.asarray(a2))
+
+
+def test_unknown_sampler_raises():
+    with pytest.raises(ValueError, match="unknown sampler"):
+        sample("nope", lambda x, s: x, jnp.zeros((1,)), jnp.array([1.0, 0.0]))
+
+
+def test_eps_denoiser_identity_model():
+    """eps ≡ 0 ⇒ denoised == x."""
+    sched = vp_schedule()
+    den = eps_denoiser(lambda x, t, c, y: jnp.zeros_like(x), sched,
+                       context=jnp.zeros((1, 1, 1)))
+    x = jnp.ones((1, 2, 2, 1)) * 5.0
+    out = den(x, jnp.array(1.0))
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x))
+
+
+def test_cfg_denoiser_interpolates():
+    """With scale s: out = uncond + s·(cond−uncond); model returns ±1 per half."""
+    def make(ctx, y):
+        def den(x, sigma):
+            # first half of batch is cond (ctx rows = 1), second uncond (0)
+            flag = ctx[:, 0, 0][:, None, None, None]
+            return jnp.broadcast_to(flag, x.shape)
+        return den
+
+    cond_ctx = jnp.ones((1, 1, 1))
+    uncond_ctx = jnp.zeros((1, 1, 1))
+    den = cfg_denoiser(make, cond_ctx, uncond_ctx, guidance_scale=3.0)
+    out = den(jnp.zeros((1, 2, 2, 1)), jnp.array(1.0))
+    np.testing.assert_allclose(np.asarray(out), 3.0)  # 0 + 3·(1−0)
