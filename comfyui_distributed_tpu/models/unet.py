@@ -60,7 +60,7 @@ class UNetConfig:
         )
 
     @classmethod
-    def tiny(cls) -> "UNetConfig":
+    def tiny(cls, dtype: str = "bfloat16") -> "UNetConfig":
         """2-level toy UNet for tests: ~0.5M params, still exercises every
         block type (res, self/cross attention, up/down, skip concat)."""
         return cls(
@@ -71,6 +71,7 @@ class UNetConfig:
             context_dim=32,
             head_dim=16,
             adm_in_channels=8,
+            dtype=dtype,
         )
 
     @property

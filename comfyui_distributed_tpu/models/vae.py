@@ -33,10 +33,10 @@ class VAEConfig:
         return cls()
 
     @classmethod
-    def tiny(cls) -> "VAEConfig":
+    def tiny(cls, dtype: str = "bfloat16") -> "VAEConfig":
         """2× downscale toy VAE for tests (8× in real configs)."""
         return cls(base_channels=16, channel_mult=(1, 2), num_res_blocks=1,
-                   scaling_factor=1.0)
+                   scaling_factor=1.0, dtype=dtype)
 
     @property
     def jnp_dtype(self) -> jnp.dtype:

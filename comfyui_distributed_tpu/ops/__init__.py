@@ -1,0 +1,6 @@
+"""Array ops: blending, resize — XLA-native replacements for the
+reference's PIL/torch image manipulation (``upscale/tile_ops.py``,
+``utils/usdu_utils.py``)."""
+
+from .blend import feather_mask, composite_tiles  # noqa: F401
+from .resize import upscale_image  # noqa: F401

@@ -1,0 +1,95 @@
+"""Feathered-mask tile compositing.
+
+Parity: the reference blends each returned tile into the working image with
+a Gaussian-blurred rectangular mask and sequential alpha compositing
+(``upscale/tile_ops.py:289-349``, blend order fixed at
+``upscale/modes/static.py:521-553`` to stay deterministic). That design is
+inherently serial. Here each tile gets a *feathered weight mask* (1 inside
+its core cell, smoothstep ramp to 0 across the padding ring) and the canvas
+is the weight-normalized sum of all tiles — commutative and associative, so
+tiles can be produced in any order on any shard and the result is
+deterministic by construction. Every pixel is in some tile's core (weight
+1), so the denominator is always ≥ 1.
+"""
+
+from __future__ import annotations
+
+from typing import TYPE_CHECKING
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+if TYPE_CHECKING:  # avoid a runtime cycle with the tiles package
+    from ..tiles.grid import TileGrid
+
+
+def _ramp(n: int, start_inside: int, width: int, ascending: bool) -> np.ndarray:
+    """1-D smoothstep ramp of length ``n``: reaches 1 at ``start_inside``
+    (from either the left or right edge) over ``width`` pixels."""
+    idx = np.arange(n, dtype=np.float32)
+    d = idx - (start_inside - width) if ascending else (start_inside + width - 1) - idx
+    t = np.clip(d / max(width, 1), 0.0, 1.0)
+    return t * t * (3.0 - 2.0 * t)
+
+
+def feather_mask(grid: "TileGrid", feather: int | None = None) -> jax.Array:
+    """Per-tile weight masks [T, crop_h, crop_w, 1].
+
+    Weight is 1 over the tile's core cell and smoothsteps to 0 across
+    ``feather`` pixels of the padding ring (default: the grid padding, the
+    analogue of the reference's ``mask_blur`` radius). Crop edges that
+    coincide with image borders keep weight 1 (no neighbour to blend with).
+    """
+    f = grid.padding if feather is None else feather
+    masks = np.zeros((grid.num_tiles, grid.crop_h, grid.crop_w), np.float32)
+    for i, reg in enumerate(grid.regions):
+        # horizontal profile
+        wx = np.ones(grid.crop_w, np.float32)
+        if reg.x0 > 0:  # crop's left edge is interior → ramp up into the core
+            wx *= _ramp(grid.crop_w, reg.core_x0, f, ascending=True)
+        if reg.x0 + grid.crop_w < grid.image_w:
+            wx *= _ramp(grid.crop_w, reg.core_x0 + reg.core_w - 1, f, ascending=False)
+        wy = np.ones(grid.crop_h, np.float32)
+        if reg.y0 > 0:
+            wy *= _ramp(grid.crop_h, reg.core_y0, f, ascending=True)
+        if reg.y0 + grid.crop_h < grid.image_h:
+            wy *= _ramp(grid.crop_h, reg.core_y0 + reg.core_h - 1, f, ascending=False)
+        masks[i] = wy[:, None] * wx[None, :]
+    return jnp.asarray(masks)[..., None]
+
+
+def composite_tiles(
+    tiles: jax.Array,          # [T, crop_h, crop_w, C]
+    masks: jax.Array,          # [T, crop_h, crop_w, 1]
+    grid: "TileGrid",
+) -> jax.Array:
+    """Weight-normalized scatter of tiles onto the [H, W, C] canvas.
+
+    Origins are static Python ints, so each accumulation lowers to a
+    ``dynamic_update_slice`` chain XLA can schedule freely.
+    """
+    C = tiles.shape[-1]
+    canvas = jnp.zeros((grid.image_h, grid.image_w, C), tiles.dtype)
+    weight = jnp.zeros((grid.image_h, grid.image_w, 1), tiles.dtype)
+    for i, reg in enumerate(grid.regions):
+        ys = slice(reg.y0, reg.y0 + grid.crop_h)
+        xs = slice(reg.x0, reg.x0 + grid.crop_w)
+        canvas = canvas.at[ys, xs, :].add(tiles[i] * masks[i])
+        weight = weight.at[ys, xs, :].add(masks[i])
+    return canvas / jnp.maximum(weight, 1e-8)
+
+
+def extract_tiles(image: jax.Array, grid: "TileGrid") -> jax.Array:
+    """Gather all crops of one [H, W, C] image → [T, crop_h, crop_w, C]
+    (static origins; parity: ``extract_tile_with_padding``,
+    ``upscale/tile_ops.py:34-155``)."""
+    crops = [
+        jax.lax.dynamic_slice(
+            image,
+            (reg.y0, reg.x0, 0),
+            (grid.crop_h, grid.crop_w, image.shape[-1]),
+        )
+        for reg in grid.regions
+    ]
+    return jnp.stack(crops, axis=0)

@@ -1,0 +1,12 @@
+"""Tile engine — TPU-native Ultimate-SD-Upscale (reference L2, ``upscale/``).
+
+The reference scatters tiles to worker GPUs through an HTTP pull queue and
+blends them back sequentially on the master (``upscale/modes/static.py``).
+Here the tile axis is a *sharded batch axis*: all tiles are extracted with
+static origins, processed in one SPMD img2img program over the mesh, and
+composited with normalized feathered masks — order-independent, so no
+master-side sequential blend loop exists at all.
+"""
+
+from .grid import TileGrid, compute_tile_grid  # noqa: F401
+from .engine import TileUpscaler, UpscaleSpec  # noqa: F401

@@ -1,0 +1,180 @@
+"""Sharded tile upscaler — distributed Ultimate-SD-Upscale, TPU-native.
+
+Reference flow (SURVEY §3.3): master seeds an HTTP pull queue of tile IDs;
+worker processes pull tile IDs, VAE-encode → ksample → decode each tile,
+POST PNGs back; master blends sequentially and re-processes stragglers
+(``upscale/modes/static.py``, ``upscale/tile_ops.py``).
+
+TPU-native flow — ONE compiled SPMD program per (image size, spec):
+  resize → extract all crops (static origins) → pad tile count to the shard
+  multiple → ``shard_map`` img2img over the tile axis (each shard processes
+  ``T/n`` tiles; per-tile noise keys derive from the *global* tile index so
+  results are identical for any shard count) → feather-mask normalized
+  composite. There is no pull queue, no heartbeat, no requeue *inside* the
+  program — host-level failure handling lives in ``cluster/`` and operates
+  at whole-program granularity (static shapes are what make TPUs fast;
+  SURVEY §7 "hard parts" #2).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..diffusion.guidance import cfg_denoiser
+from ..diffusion.pipeline import GenerationSpec, Txt2ImgPipeline, make_sigma_ladder
+from ..diffusion.samplers import sample
+from ..ops.blend import composite_tiles, extract_tiles, feather_mask
+from ..ops.resize import upscale_image
+from ..utils import constants
+from .grid import TileGrid, compute_tile_grid, pad_count_to
+
+
+@dataclasses.dataclass(frozen=True)
+class UpscaleSpec:
+    scale: float = 2.0
+    tile_w: int = 512
+    tile_h: int = 512
+    padding: int = 32
+    feather: Optional[int] = None     # None → padding
+    steps: int = 20
+    denoise: float = 0.3
+    sampler: str = "euler"
+    scheduler: str = "karras"
+    guidance_scale: float = 5.0
+    resize_method: str = "lanczos3"
+
+    def generation_spec(self) -> GenerationSpec:
+        return GenerationSpec(
+            steps=self.steps,
+            denoise=self.denoise,
+            sampler=self.sampler,
+            scheduler=self.scheduler,
+            guidance_scale=self.guidance_scale,
+        )
+
+
+class TileUpscaler:
+    """Drives a ``Txt2ImgPipeline``'s model stack over a sharded tile axis."""
+
+    def __init__(self, pipeline: Txt2ImgPipeline):
+        self.pipeline = pipeline
+
+    def grid_for(self, image_h: int, image_w: int, spec: UpscaleSpec) -> TileGrid:
+        out_h = int(round(image_h * spec.scale))
+        out_w = int(round(image_w * spec.scale))
+        return compute_tile_grid(out_w, out_h, spec.tile_w, spec.tile_h, spec.padding)
+
+    def _img2img_tiles(self, tiles, key, context, uncond_context, y, uncond_y,
+                       spec: UpscaleSpec, sigmas, global_idx):
+        """img2img a [n, ch, cw, C] tile batch on one shard.
+
+        Per-tile noise keys fold in the *global* tile index, so the output
+        for tile i never depends on which shard processed it — the property
+        that lets host-level requeue re-shard freely (reference analogue:
+        tiles carry global IDs through the queue, ``upscale/job_store.py``).
+        """
+        pipe = self.pipeline
+        vae = pipe.vae
+        n = tiles.shape[0]
+        latents = vae.encode(tiles * 2.0 - 1.0)
+
+        keys = jax.vmap(lambda i: jax.random.fold_in(key, i))(global_idx)
+        noise = jax.vmap(
+            lambda k, lat: jax.random.normal(k, lat.shape, lat.dtype)
+        )(keys, latents)
+        noised = latents + noise * sigmas[0]
+
+        gspec = spec.generation_spec()
+        bc = lambda a: jnp.broadcast_to(a, (n,) + a.shape[1:])
+        if gspec.guidance_scale != 1.0:
+            denoise_fn = cfg_denoiser(
+                lambda ctx, yy: pipe._denoiser(ctx, yy),
+                bc(context), bc(uncond_context), gspec.guidance_scale,
+                None if y is None else bc(y),
+                None if uncond_y is None else bc(uncond_y),
+            )
+        else:
+            denoise_fn = pipe._denoiser(bc(context), None if y is None else bc(y))
+        # sampler key uses a sentinel fold well above any global tile index
+        x0 = sample(gspec.sampler, denoise_fn, noised, sigmas,
+                    key=jax.random.fold_in(key, jnp.uint32(0xFFFFFFFF)))
+        out = vae.decode(x0)
+        return jnp.clip(out / 2.0 + 0.5, 0.0, 1.0)
+
+    def upscale_fn(self, mesh: Mesh, image_hw: tuple[int, int], spec: UpscaleSpec,
+                   batch: int = 1, axis: str = constants.AXIS_DATA):
+        """Compile the full upscale: (images, key, ctx, unc, y, unc_y) →
+        upscaled images [B, H·s, W·s, C]."""
+        H, W = image_hw
+        grid = self.grid_for(H, W, spec)
+        n_shards = mesh.shape[axis]
+        total = batch * grid.num_tiles
+        padded = pad_count_to(total, n_shards)
+        per_shard = padded // n_shards
+        sigmas = make_sigma_ladder(spec.generation_spec(), self.pipeline.schedule)
+        masks = feather_mask(grid, spec.feather)
+        has_y = self.pipeline.unet.config.adm_in_channels > 0
+
+        def process_shard(tiles, key, context, uncond_context, y, uncond_y):
+            # tiles: [per_shard, ch, cw, C] block of this shard
+            shard_i = jax.lax.axis_index(axis)
+            global_idx = shard_i * per_shard + jnp.arange(per_shard)
+            return self._img2img_tiles(
+                tiles, key, context, uncond_context,
+                y if has_y else None, uncond_y if has_y else None,
+                spec, sigmas, global_idx,
+            )
+
+        sharded = jax.shard_map(
+            process_shard,
+            mesh=mesh,
+            in_specs=(P(axis, None, None, None), P(), P(None, None, None),
+                      P(None, None, None), P(None, None), P(None, None)),
+            out_specs=P(axis, None, None, None),
+        )
+
+        def run(images, key, context, uncond_context, y, uncond_y):
+            up = upscale_image(images, spec.scale, spec.resize_method)
+            all_tiles = jnp.concatenate(
+                [extract_tiles(up[b], grid) for b in range(batch)], axis=0
+            )
+            if padded > total:
+                pad = jnp.zeros((padded - total,) + all_tiles.shape[1:], all_tiles.dtype)
+                all_tiles = jnp.concatenate([all_tiles, pad], axis=0)
+            done = sharded(all_tiles, key, context, uncond_context, y, uncond_y)
+            done = done[:total]
+            outs = [
+                composite_tiles(
+                    done[b * grid.num_tiles:(b + 1) * grid.num_tiles], masks, grid
+                )
+                for b in range(batch)
+            ]
+            return jnp.stack(outs, axis=0)
+
+        return jax.jit(run)
+
+    def upscale(
+        self,
+        mesh: Mesh,
+        images: jax.Array,
+        spec: UpscaleSpec,
+        seed: int,
+        context: jax.Array,
+        uncond_context: jax.Array,
+        y: Optional[jax.Array] = None,
+        uncond_y: Optional[jax.Array] = None,
+        axis: str = constants.AXIS_DATA,
+    ) -> jax.Array:
+        B, H, W, _ = images.shape
+        fn = self.upscale_fn(mesh, (H, W), spec, batch=B, axis=axis)
+        adm = self.pipeline.unet.config.adm_in_channels
+        if y is None:
+            y = jnp.zeros((1, max(adm, 1)), jnp.float32)
+        if uncond_y is None:
+            uncond_y = jnp.zeros_like(y)
+        return fn(images, jax.random.key(seed), context, uncond_context, y, uncond_y)
