@@ -1,0 +1,20 @@
+"""Workflow graph layer (reference L3/L4: ``nodes/`` + prompt rewriting).
+
+The reference is a ComfyUI *extension*: its graphs execute inside ComfyUI's
+executor and its public API accepts ComfyUI prompt JSON
+(``{node_id: {"class_type", "inputs": {k: value | [src_id, out_idx]}}}``).
+This standalone framework keeps that wire format — so reference workflows
+translate directly — but owns the node registry and executor, and the
+"distributed" node semantics map onto the SPMD substrate instead of HTTP.
+"""
+
+from .node import NODE_REGISTRY, NodeDef, register_node, get_node  # noqa: F401
+from .executor import GraphExecutor, validate_prompt  # noqa: F401
+from .transform import (  # noqa: F401
+    PromptIndex,
+    apply_participant_overrides,
+    generate_job_id_map,
+    prepare_delegate_master_prompt,
+    prune_prompt_for_worker,
+)
+from . import nodes_builtin  # noqa: F401  (registers the node set)

@@ -1,0 +1,478 @@
+"""Built-in node set.
+
+Two groups:
+
+1. **Parity nodes** — the reference's 8 distributed node classes
+   (``nodes/__init__.py:14-22``) with the same names and contracts:
+   DistributedCollector, DistributedSeed, DistributedValue,
+   DistributedModelName, ImageBatchDivider, AudioBatchDivider,
+   DistributedEmptyImage, UltimateSDUpscaleDistributed.
+
+2. **Substrate nodes** — the minimum ComfyUI-core surface reference
+   workflows assume (checkpoint loading, text encode, sampling, VAE,
+   save/preview, primitives). The reference free-rides on ComfyUI for
+   these; a standalone framework supplies them. The TPU twist: sampling
+   nodes execute the *whole* distributed program (shard_map over the mesh
+   in executor context) rather than single-device ops.
+
+Graph value conventions: IMAGE = float32 [B,H,W,C] in [0,1];
+AUDIO = {"waveform": [B,C,S], "sample_rate": int}; CONDITIONING =
+{"context": [1,N,D], "pooled": [1,P]}; MODEL = ModelBundle; LATENT =
+{"samples": [B,h,w,c]}.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils.exceptions import ValidationError
+from ..utils.logging import debug_log, log
+from .node import NodeDef, register_node
+
+
+def _chunk_bounds(total: int, parts: int) -> list[tuple[int, int]]:
+    """Contiguous chunk bounds, sizes differing by ≤1, larger chunks first
+    (reference ``_chunk_bounds``, ``nodes/utilities.py:7-20``)."""
+    parts = max(1, min(parts, total)) if total > 0 else 1
+    base, extra = divmod(total, parts)
+    bounds, start = [], 0
+    for i in range(parts):
+        size = base + (1 if i < extra else 0)
+        bounds.append((start, start + size))
+        start += size
+    return bounds
+
+
+# --------------------------------------------------------------------------
+# Parity nodes
+# --------------------------------------------------------------------------
+
+
+@register_node("DistributedSeed")
+class DistributedSeed(NodeDef):
+    """Master passes ``seed`` through; worker N yields ``seed + N + 1``
+    (reference ``nodes/utilities.py:52-75``). The sharded pipeline uses
+    fold_in internally; this node carries the *visible* seed contract for
+    graph-level fan-out across hosts."""
+
+    INPUTS = {"seed": "INT"}
+    HIDDEN = {"is_worker": "BOOLEAN", "worker_id": "STRING", "worker_index": "INT"}
+    RETURNS = ("INT",)
+
+    def execute(self, seed: int, is_worker: bool = False, worker_id: str = "",
+                worker_index: int = 0, **_):
+        if not is_worker:
+            return (int(seed),)
+        return (int(seed) + int(worker_index) + 1,)
+
+
+@register_node("DistributedValue")
+class DistributedValue(NodeDef):
+    """Per-worker override with typed coercion and default fallback
+    (reference ``nodes/utilities.py:86-162``): ``worker_values`` is a JSON
+    map of 1-indexed worker number → value."""
+
+    INPUTS = {"default_value": "*"}
+    OPTIONAL = {"worker_values": "STRING", "value_type": "STRING"}
+    HIDDEN = {"is_worker": "BOOLEAN", "worker_id": "STRING", "worker_index": "INT"}
+    RETURNS = ("*",)
+
+    _COERCERS = {
+        "INT": lambda v: int(float(v)),
+        "FLOAT": float,
+        "STRING": str,
+        "COMBO": str,
+    }
+
+    def _coerce(self, value: Any, value_type: str) -> Any:
+        fn = self._COERCERS.get(value_type.upper())
+        if fn is None:
+            return value
+        try:
+            return fn(value)
+        except (TypeError, ValueError):
+            raise ValidationError(
+                f"cannot coerce {value!r} to {value_type}", field="worker_values"
+            )
+
+    def execute(self, default_value, worker_values: str = "", value_type: str = "",
+                is_worker: bool = False, worker_id: str = "", worker_index: int = 0,
+                **_):
+        if not is_worker or not worker_values:
+            return (default_value,)
+        try:
+            mapping = json.loads(worker_values)
+        except json.JSONDecodeError:
+            return (default_value,)
+        key = str(int(worker_index) + 1)   # 1-indexed per reference
+        if key not in mapping:
+            return (default_value,)
+        vtype = value_type or mapping.get("_type", "")
+        return (self._coerce(mapping[key], vtype) if vtype else mapping[key],)
+
+
+@register_node("DistributedModelName")
+class DistributedModelName(NodeDef):
+    """OUTPUT_NODE passing model names through as strings so delegate-mode
+    workers can load models the master lacks (reference
+    ``nodes/utilities.py:164-224``)."""
+
+    INPUTS = {"model_name": "*"}
+    HIDDEN = {"is_worker": "BOOLEAN", "worker_id": "STRING"}
+    RETURNS = ("STRING",)
+    OUTPUT_NODE = True
+
+    def execute(self, model_name, **_):
+        return (str(model_name),)
+
+
+@register_node("ImageBatchDivider")
+class ImageBatchDivider(NodeDef):
+    """Split an IMAGE batch into up to 10 contiguous chunks (reference
+    ``nodes/utilities.py:235-268``); chunks beyond the batch repeat the
+    empty image."""
+
+    INPUTS = {"images": "IMAGE", "divide_by": "INT"}
+    RETURNS = tuple(["IMAGE"] * 10)
+
+    def execute(self, images, divide_by: int = 2, **_):
+        divide_by = max(1, min(int(divide_by), 10))
+        arr = jnp.asarray(images)
+        bounds = _chunk_bounds(arr.shape[0], divide_by)
+        chunks = [arr[s:e] for s, e in bounds]
+        empty = arr[:0]
+        while len(chunks) < 10:
+            chunks.append(empty)
+        return tuple(chunks)
+
+
+@register_node("AudioBatchDivider")
+class AudioBatchDivider(NodeDef):
+    """Split AUDIO along the samples dim (reference
+    ``nodes/utilities.py:271-329``)."""
+
+    INPUTS = {"audio": "AUDIO", "divide_by": "INT"}
+    RETURNS = tuple(["AUDIO"] * 10)
+
+    def execute(self, audio, divide_by: int = 2, **_):
+        divide_by = max(1, min(int(divide_by), 10))
+        wf = np.asarray(audio["waveform"])
+        sr = int(audio.get("sample_rate", 44100))
+        bounds = _chunk_bounds(wf.shape[-1], divide_by)
+        chunks = [
+            {"waveform": wf[..., s:e], "sample_rate": sr} for s, e in bounds
+        ]
+        empty = {"waveform": wf[..., :0], "sample_rate": sr}
+        while len(chunks) < 10:
+            chunks.append(empty)
+        return tuple(chunks)
+
+
+@register_node("DistributedEmptyImage")
+class DistributedEmptyImage(NodeDef):
+    """0-batch IMAGE placeholder for delegate-only masters (reference
+    ``nodes/utilities.py:332-354``)."""
+
+    INPUTS = {"height": "INT", "width": "INT"}
+    OPTIONAL = {"channels": "INT"}
+    RETURNS = ("IMAGE",)
+
+    def execute(self, height: int = 64, width: int = 64, channels: int = 3, **_):
+        return (jnp.zeros((0, int(height), int(width), int(channels)), jnp.float32),)
+
+
+@register_node("DistributedCollector")
+class DistributedCollector(NodeDef):
+    """Result gather point (reference ``nodes/collector.py``).
+
+    On-pod, the "gather" already happened inside the SPMD program (the
+    sharded output array), so locally this node is identity. Across hosts
+    the executor context provides a ``collector_bridge`` (cluster layer):
+    worker role pushes its batch to the master; master role drains and
+    concatenates master-first (``nodes/collector.py:252-295``). With
+    ``pass_through`` (downstream of USDU) it is always identity
+    (``nodes/collector.py:121-124``).
+    """
+
+    INPUTS = {"images": "IMAGE"}
+    OPTIONAL = {"audio": "AUDIO"}
+    HIDDEN = {
+        "multi_job_id": "STRING", "is_worker": "BOOLEAN", "worker_id": "STRING",
+        "master_url": "STRING", "enabled_worker_ids": "*",
+        "delegate_only": "BOOLEAN", "pass_through": "BOOLEAN",
+        "collector_bridge": "*",
+    }
+    RETURNS = ("IMAGE", "AUDIO")
+
+    def execute(self, images, audio=None, multi_job_id: str = "",
+                is_worker: bool = False, worker_id: str = "",
+                master_url: str = "", enabled_worker_ids=(),
+                delegate_only: bool = False, pass_through: bool = False,
+                collector_bridge=None, **_):
+        if pass_through or not multi_job_id or collector_bridge is None:
+            return (images, audio)
+        if is_worker:
+            collector_bridge.send(multi_job_id, worker_id, images, audio,
+                                  master_url)
+            return (images, audio)
+        images, audio = collector_bridge.collect(
+            multi_job_id, images, audio,
+            enabled_worker_ids=tuple(enabled_worker_ids),
+            delegate_only=delegate_only,
+        )
+        return (images, audio)
+
+
+@register_node("UltimateSDUpscaleDistributed")
+class UltimateSDUpscaleDistributed(NodeDef):
+    """Tile-sharded upscale (reference ``nodes/distributed_upscale.py``).
+
+    Mode selection collapses on TPU: static/dynamic/single-gpu pull-queues
+    (``:230-267``) become one SPMD program over however many chips the
+    executor's mesh has; the video 4n+1 batch rule (``:131-142``) is a
+    padding rule applied by the video divider, not a constraint here.
+    """
+
+    INPUTS = {
+        "image": "IMAGE", "model": "MODEL",
+        "positive": "CONDITIONING", "negative": "CONDITIONING",
+        "seed": "INT", "steps": "INT", "denoise": "FLOAT",
+        "upscale_by": "FLOAT",
+    }
+    OPTIONAL = {
+        "tile_width": "INT", "tile_height": "INT", "tile_padding": "INT",
+        "cfg": "FLOAT", "sampler_name": "STRING", "scheduler": "STRING",
+    }
+    HIDDEN = {
+        "mesh": "*", "multi_job_id": "STRING", "is_worker": "BOOLEAN",
+        "worker_id": "STRING", "master_url": "STRING",
+        "enabled_worker_ids": "*", "delegate_only": "BOOLEAN",
+    }
+    RETURNS = ("IMAGE",)
+
+    def execute(self, image, model, positive, negative, seed: int, steps: int,
+                denoise: float, upscale_by: float, tile_width: int = 512,
+                tile_height: int = 512, tile_padding: int = 32,
+                cfg: float = 5.0, sampler_name: str = "euler",
+                scheduler: str = "karras", mesh=None, **_):
+        from ..parallel.mesh import build_mesh
+        from ..tiles.engine import TileUpscaler, UpscaleSpec
+
+        if mesh is None:
+            mesh = build_mesh({"dp": len(jax.devices())})
+        spec = UpscaleSpec(
+            scale=float(upscale_by), tile_w=int(tile_width), tile_h=int(tile_height),
+            padding=int(tile_padding), steps=int(steps), denoise=float(denoise),
+            sampler=sampler_name, scheduler=scheduler, guidance_scale=float(cfg),
+        )
+        upscaler = TileUpscaler(model.pipeline)
+        adm = model.pipeline.unet.config.adm_in_channels
+        y = uy = None
+        if adm:
+            y = _adm_from_cond(positive, adm)
+            uy = _adm_from_cond(negative, adm)
+        out = upscaler.upscale(
+            mesh, jnp.asarray(image), spec, int(seed),
+            positive["context"], negative["context"], y, uy,
+        )
+        return (out,)
+
+
+def _adm_from_cond(cond: dict, adm_channels: int) -> jax.Array:
+    """Build the ADM vector from pooled conditioning, zero-padded/truncated
+    to the UNet's expected width (full SDXL micro-conds via
+    ``diffusion.pipeline.sdxl_adm`` when sizes are known)."""
+    pooled = cond.get("pooled")
+    if pooled is None:
+        return jnp.zeros((1, adm_channels), jnp.float32)
+    pooled = jnp.asarray(pooled)
+    pad = adm_channels - pooled.shape[-1]
+    if pad > 0:
+        return jnp.pad(pooled, ((0, 0), (0, pad)))
+    return pooled[:, :adm_channels]
+
+
+# --------------------------------------------------------------------------
+# Substrate nodes (ComfyUI-core surface the reference assumes)
+# --------------------------------------------------------------------------
+
+
+@register_node("CheckpointLoader")
+class CheckpointLoader(NodeDef):
+    INPUTS = {"ckpt_name": "STRING"}
+    HIDDEN = {"model_registry": "*"}
+    RETURNS = ("MODEL", "CLIP", "VAE")
+
+    def execute(self, ckpt_name: str, model_registry=None, **_):
+        if model_registry is None:
+            from ..models.registry import ModelRegistry
+            model_registry = ModelRegistry()
+        bundle = model_registry.get(ckpt_name)
+        return (bundle, bundle.text_encoder, bundle.pipeline.vae)
+
+
+@register_node("CLIPTextEncode")
+class CLIPTextEncode(NodeDef):
+    INPUTS = {"text": "STRING", "clip": "CLIP"}
+    RETURNS = ("CONDITIONING",)
+
+    def execute(self, text: str, clip, **_):
+        ctx, pooled = clip.encode([str(text)])
+        return ({"context": ctx, "pooled": pooled},)
+
+
+@register_node("EmptyLatentImage")
+class EmptyLatentImage(NodeDef):
+    INPUTS = {"width": "INT", "height": "INT"}
+    OPTIONAL = {"batch_size": "INT"}
+    RETURNS = ("LATENT",)
+
+    def execute(self, width: int, height: int, batch_size: int = 1, **_):
+        # latent downscale fixed at 8 for SD-family; tiny VAE uses 2 but
+        # TPUTxt2Img derives sizes from the model, not from this node
+        return ({"samples": jnp.zeros((int(batch_size), int(height) // 8,
+                                       int(width) // 8, 4), jnp.float32),
+                 "height": int(height), "width": int(width)},)
+
+
+@register_node("TPUTxt2Img")
+class TPUTxt2Img(NodeDef):
+    """The distributed sampler node: runs the whole sharded generation
+    (per-shard seeds + sampling + decode + gather) as one SPMD program —
+    the TPU equivalent of the reference's entire dispatch/collect cycle
+    for ``distributed-txt2img.json``."""
+
+    INPUTS = {
+        "model": "MODEL", "positive": "CONDITIONING", "negative": "CONDITIONING",
+        "seed": "INT", "steps": "INT", "cfg": "FLOAT",
+        "width": "INT", "height": "INT",
+    }
+    OPTIONAL = {
+        "sampler_name": "STRING", "scheduler": "STRING", "batch_per_device": "INT",
+    }
+    HIDDEN = {"mesh": "*"}
+    RETURNS = ("IMAGE",)
+
+    def execute(self, model, positive, negative, seed: int, steps: int,
+                cfg: float, width: int, height: int,
+                sampler_name: str = "euler", scheduler: str = "karras",
+                batch_per_device: int = 1, mesh=None, **_):
+        from ..diffusion.pipeline import GenerationSpec
+        from ..parallel.mesh import build_mesh
+
+        if mesh is None:
+            mesh = build_mesh({"dp": len(jax.devices())})
+        spec = GenerationSpec(
+            height=int(height), width=int(width), steps=int(steps),
+            sampler=sampler_name, scheduler=scheduler,
+            guidance_scale=float(cfg), per_device_batch=int(batch_per_device),
+        )
+        adm = model.pipeline.unet.config.adm_in_channels
+        y = _adm_from_cond(positive, adm) if adm else None
+        uy = _adm_from_cond(negative, adm) if adm else None
+        images = model.pipeline.generate(
+            mesh, spec, int(seed), positive["context"], negative["context"], y, uy,
+        )
+        return (images,)
+
+
+@register_node("VAEEncode")
+class VAEEncode(NodeDef):
+    INPUTS = {"pixels": "IMAGE", "vae": "VAE"}
+    RETURNS = ("LATENT",)
+
+    def execute(self, pixels, vae, **_):
+        return ({"samples": vae.encode(jnp.asarray(pixels) * 2.0 - 1.0)},)
+
+
+@register_node("VAEDecode")
+class VAEDecode(NodeDef):
+    INPUTS = {"samples": "LATENT", "vae": "VAE"}
+    RETURNS = ("IMAGE",)
+
+    def execute(self, samples, vae, **_):
+        out = vae.decode(samples["samples"])
+        return (jnp.clip(out / 2.0 + 0.5, 0.0, 1.0),)
+
+
+@register_node("SaveImage")
+class SaveImage(NodeDef):
+    INPUTS = {"images": "IMAGE"}
+    OPTIONAL = {"filename_prefix": "STRING"}
+    HIDDEN = {"output_dir": "STRING"}
+    RETURNS = ()
+    OUTPUT_NODE = True
+
+    def execute(self, images, filename_prefix: str = "output",
+                output_dir: str = "", **_):
+        from ..utils.image import encode_png, to_uint8
+
+        out_dir = Path(output_dir or "output")
+        out_dir.mkdir(parents=True, exist_ok=True)
+        arr = to_uint8(images)
+        paths = []
+        for i in range(arr.shape[0]):
+            p = out_dir / f"{filename_prefix}_{i:05d}.png"
+            p.write_bytes(encode_png(arr[i]))
+            paths.append(str(p))
+        log(f"saved {len(paths)} images to {out_dir}")
+        return ()
+
+
+@register_node("PreviewImage")
+class PreviewImage(NodeDef):
+    INPUTS = {"images": "IMAGE"}
+    RETURNS = ()
+    OUTPUT_NODE = True
+
+    def execute(self, images, **_):
+        debug_log(f"preview: batch of {np.asarray(images).shape[0]}")
+        return ()
+
+
+@register_node("LoadImage")
+class LoadImage(NodeDef):
+    INPUTS = {"image": "STRING"}
+    HIDDEN = {"input_dir": "STRING"}
+    RETURNS = ("IMAGE",)
+
+    def execute(self, image: str, input_dir: str = "", **_):
+        from ..utils.image import decode_png
+
+        path = Path(input_dir or "input") / image
+        if not path.exists():
+            raise ValidationError(f"image file not found: {path}", field="image")
+        return (jnp.asarray(decode_png(path.read_bytes()))[None],)
+
+
+@register_node("PrimitiveInt")
+class PrimitiveInt(NodeDef):
+    INPUTS = {"value": "INT"}
+    RETURNS = ("INT",)
+
+    def execute(self, value, **_):
+        return (int(value),)
+
+
+@register_node("PrimitiveFloat")
+class PrimitiveFloat(NodeDef):
+    INPUTS = {"value": "FLOAT"}
+    RETURNS = ("FLOAT",)
+
+    def execute(self, value, **_):
+        return (float(value),)
+
+
+@register_node("PrimitiveString")
+class PrimitiveString(NodeDef):
+    INPUTS = {"value": "STRING"}
+    RETURNS = ("STRING",)
+
+    def execute(self, value, **_):
+        return (str(value),)

@@ -1,0 +1,102 @@
+"""Named model registry: checkpoint name → assembled pipeline stack.
+
+The reference resolves model names through ComfyUI's ``folder_paths`` and
+ships them to workers by name (``nodes/utilities.py:164-224``,
+``DistributedModelName``). Here a name maps to (architecture preset,
+optional orbax checkpoint dir). Without a checkpoint the stack is
+random-initialized — enough for benchmarks, tests, and architecture work;
+drop real weights into the checkpoint dir to get real outputs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from pathlib import Path
+from typing import Callable, Optional
+
+import jax
+
+from ..utils.exceptions import ValidationError
+from ..utils.logging import log
+from .text import TextEncoder, TextEncoderConfig
+from .unet import UNetConfig, init_unet
+from .vae import AutoencoderKL, VAEConfig
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelPreset:
+    name: str
+    unet: UNetConfig
+    vae: VAEConfig
+    text: TextEncoderConfig
+    sample_hw: tuple[int, int] = (128, 128)   # init-time latent H,W
+
+
+PRESETS: dict[str, ModelPreset] = {
+    "sdxl": ModelPreset("sdxl", UNetConfig.sdxl(), VAEConfig.sdxl(),
+                        TextEncoderConfig()),
+    "sd15": ModelPreset("sd15", UNetConfig.sd15(),
+                        VAEConfig(scaling_factor=0.18215),
+                        TextEncoderConfig(output_dim=768, pooled_dim=768)),
+    "tiny": ModelPreset("tiny", UNetConfig.tiny(), VAEConfig.tiny(),
+                        TextEncoderConfig.tiny(), sample_hw=(8, 8)),
+}
+
+
+class ModelBundle:
+    """Loaded stack: pipeline + text encoder, built lazily and cached."""
+
+    def __init__(self, preset: ModelPreset, checkpoint_dir: Optional[Path] = None,
+                 seed: int = 0):
+        from ..diffusion.pipeline import Txt2ImgPipeline
+
+        self.preset = preset
+        k1, k2, k3 = jax.random.split(jax.random.key(seed), 3)
+        lat_c = preset.unet.in_channels
+        model, params = init_unet(
+            preset.unet, k1,
+            sample_shape=(*preset.sample_hw, lat_c),
+            context_len=preset.text.max_len,
+        )
+        img_hw = (preset.sample_hw[0] * preset.vae.downscale,
+                  preset.sample_hw[1] * preset.vae.downscale)
+        vae = AutoencoderKL(preset.vae).init(k2, image_hw=img_hw)
+        self.text_encoder = TextEncoder(preset.text).init(k3)
+        self.pipeline = Txt2ImgPipeline(model, params, vae)
+        if checkpoint_dir is not None and Path(checkpoint_dir).exists():
+            self._load_checkpoint(Path(checkpoint_dir))
+
+    def _load_checkpoint(self, ckpt: Path) -> None:
+        import orbax.checkpoint as ocp
+
+        targets = {
+            "unet": self.pipeline.unet_params,
+            "vae_enc": self.pipeline.vae.enc_params,
+            "vae_dec": self.pipeline.vae.dec_params,
+            "text": self.text_encoder.params,
+        }
+        with ocp.StandardCheckpointer() as ckptr:
+            restored = ckptr.restore(ckpt.resolve(), targets)
+        self.pipeline.unet_params = restored["unet"]
+        self.pipeline.vae.enc_params = restored["vae_enc"]
+        self.pipeline.vae.dec_params = restored["vae_dec"]
+        self.text_encoder.params = restored["text"]
+        log(f"loaded checkpoint {ckpt}")
+
+
+class ModelRegistry:
+    def __init__(self, checkpoint_root: Optional[Path] = None):
+        self.checkpoint_root = Path(checkpoint_root) if checkpoint_root else None
+        self._cache: dict[str, ModelBundle] = {}
+
+    def available(self) -> list[str]:
+        return sorted(PRESETS)
+
+    def get(self, name: str) -> ModelBundle:
+        if name not in self._cache:
+            preset = PRESETS.get(name)
+            if preset is None:
+                raise ValidationError(f"unknown model {name!r}; have {self.available()}")
+            ckpt = self.checkpoint_root / name if self.checkpoint_root else None
+            self._cache[name] = ModelBundle(preset, ckpt)
+        return self._cache[name]

@@ -1,0 +1,58 @@
+"""Audio envelope codec.
+
+Parity: reference ``utils/audio_payload.py:11-103`` — AUDIO dicts
+(``{"waveform": [B,C,S], "sample_rate": int}``) travel as base64 float32
+with shape/dtype/size validation and a byte cap.
+"""
+
+from __future__ import annotations
+
+import base64
+from typing import Any
+
+import numpy as np
+
+from . import constants
+from .exceptions import ValidationError
+
+
+def encode_audio(audio: dict[str, Any]) -> dict[str, Any]:
+    wf = np.asarray(audio.get("waveform"))
+    if wf.ndim != 3:
+        raise ValidationError(f"waveform must be [B,C,S], got shape {wf.shape}")
+    wf = np.ascontiguousarray(wf.astype(np.float32))
+    if wf.nbytes > constants.MAX_AUDIO_PAYLOAD_BYTES:
+        raise ValidationError(
+            f"audio payload {wf.nbytes} bytes exceeds cap "
+            f"{constants.MAX_AUDIO_PAYLOAD_BYTES}"
+        )
+    return {
+        "data": base64.b64encode(wf.tobytes()).decode("ascii"),
+        "dtype": "float32",
+        "shape": list(wf.shape),
+        "sample_rate": int(audio.get("sample_rate", 44100)),
+    }
+
+
+def decode_audio(envelope: dict[str, Any]) -> dict[str, Any]:
+    for field in ("data", "shape", "sample_rate"):
+        if field not in envelope:
+            raise ValidationError(f"audio envelope missing {field!r}", field=field)
+    if envelope.get("dtype", "float32") != "float32":
+        raise ValidationError(f"unsupported audio dtype {envelope['dtype']!r}")
+    shape = tuple(int(s) for s in envelope["shape"])
+    if len(shape) != 3 or any(s < 0 for s in shape):
+        raise ValidationError(f"invalid audio shape {shape}")
+    expected = int(np.prod(shape)) * 4
+    if expected > constants.MAX_AUDIO_PAYLOAD_BYTES:
+        raise ValidationError("audio envelope exceeds byte cap")
+    try:
+        raw = base64.b64decode(envelope["data"])
+    except Exception as e:
+        raise ValidationError(f"invalid base64 audio payload: {e}") from e
+    if len(raw) != expected:
+        raise ValidationError(
+            f"audio payload size {len(raw)} != expected {expected} for shape {shape}"
+        )
+    wf = np.frombuffer(raw, dtype=np.float32).reshape(shape)
+    return {"waveform": wf, "sample_rate": int(envelope["sample_rate"])}

@@ -1,0 +1,61 @@
+"""Image/audio codec tests (parity: reference utils/image.py +
+utils/audio_payload.py validation behavior)."""
+
+import numpy as np
+import pytest
+
+from comfyui_distributed_tpu.utils import audio_payload, image
+from comfyui_distributed_tpu.utils.exceptions import ValidationError
+
+
+def test_png_roundtrip_exact_uint8():
+    rng = np.random.default_rng(0)
+    img = rng.random((8, 6, 3)).astype(np.float32)
+    decoded = image.decode_png(image.encode_png(img))
+    assert decoded.shape == (8, 6, 3)
+    # PNG is lossless over the uint8 quantization
+    np.testing.assert_array_equal(image.to_uint8(decoded), image.to_uint8(img))
+
+
+def test_b64_roundtrip_and_invalid():
+    img = np.zeros((4, 4, 3), np.float32)
+    s = image.encode_image_b64(img)
+    out = image.decode_image_b64(s)
+    assert out.shape == (4, 4, 3)
+    with pytest.raises(ValidationError):
+        image.decode_image_b64("!!!notbase64!!!")
+
+
+def test_to_uint8_shape_validation():
+    with pytest.raises(ValidationError):
+        image.to_uint8(np.zeros((2, 2)))
+
+
+def test_audio_roundtrip():
+    wf = np.random.default_rng(1).standard_normal((1, 2, 100)).astype(np.float32)
+    env = audio_payload.encode_audio({"waveform": wf, "sample_rate": 22050})
+    back = audio_payload.decode_audio(env)
+    np.testing.assert_array_equal(back["waveform"], wf)
+    assert back["sample_rate"] == 22050
+
+
+@pytest.mark.parametrize("mutate", [
+    lambda e: e.pop("data"),
+    lambda e: e.pop("shape"),
+    lambda e: e.update(shape=[1, 2]),
+    lambda e: e.update(dtype="float64"),
+    lambda e: e.update(data=e["data"][:-8]),
+])
+def test_audio_envelope_validation(mutate):
+    wf = np.zeros((1, 1, 10), np.float32)
+    env = audio_payload.encode_audio({"waveform": wf, "sample_rate": 8000})
+    mutate(env)
+    with pytest.raises(ValidationError):
+        audio_payload.decode_audio(env)
+
+
+def test_audio_cap_enforced(monkeypatch):
+    monkeypatch.setattr(audio_payload.constants, "MAX_AUDIO_PAYLOAD_BYTES", 16)
+    wf = np.zeros((1, 1, 100), np.float32)
+    with pytest.raises(ValidationError):
+        audio_payload.encode_audio({"waveform": wf, "sample_rate": 8000})

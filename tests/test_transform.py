@@ -1,0 +1,173 @@
+"""Prompt transform tests (parity model: reference
+tests/test_prompt_transform.py — 61 tests over index/prune/delegate/ids)."""
+
+import pytest
+
+from comfyui_distributed_tpu.graph.transform import (
+    PromptIndex,
+    apply_participant_overrides,
+    generate_job_id_map,
+    prepare_delegate_master_prompt,
+    prune_prompt_for_worker,
+)
+
+
+def txt2img_prompt():
+    """Reference-shaped workflow: loader → clip ×2 → sampler → collector →
+    save, plus a seed node feeding the sampler."""
+    return {
+        "1": {"class_type": "CheckpointLoader", "inputs": {"ckpt_name": "tiny"}},
+        "2": {"class_type": "CLIPTextEncode", "inputs": {"text": "cat", "clip": ["1", 1]}},
+        "3": {"class_type": "CLIPTextEncode", "inputs": {"text": "", "clip": ["1", 1]}},
+        "4": {"class_type": "DistributedSeed", "inputs": {"seed": 42}},
+        "5": {"class_type": "TPUTxt2Img", "inputs": {
+            "model": ["1", 0], "positive": ["2", 0], "negative": ["3", 0],
+            "seed": ["4", 0], "steps": 2, "cfg": 1.0, "width": 16, "height": 16}},
+        "6": {"class_type": "DistributedCollector", "inputs": {"images": ["5", 0]}},
+        "7": {"class_type": "SaveImage", "inputs": {"images": ["6", 0]}},
+    }
+
+
+def usdu_prompt():
+    p = txt2img_prompt()
+    p["8"] = {"class_type": "UltimateSDUpscaleDistributed", "inputs": {
+        "image": ["6", 0], "model": ["1", 0], "positive": ["2", 0],
+        "negative": ["3", 0], "seed": 1, "steps": 2, "denoise": 0.3,
+        "upscale_by": 2.0}}
+    p["9"] = {"class_type": "DistributedCollector", "inputs": {"images": ["8", 0]}}
+    return p
+
+
+class TestPromptIndex:
+    def test_class_lookup(self):
+        idx = PromptIndex(txt2img_prompt())
+        assert idx.nodes_of_class("CLIPTextEncode") == ["2", "3"]
+        assert idx.nodes_of_class("Missing") == []
+
+    def test_upstream_closure(self):
+        idx = PromptIndex(txt2img_prompt())
+        assert idx.upstream_of("6") == frozenset({"1", "2", "3", "4", "5"})
+        assert idx.upstream_of("1") == frozenset()
+        assert idx.is_upstream("4", "5")
+        assert not idx.is_upstream("7", "5")
+
+    def test_downstream(self):
+        idx = PromptIndex(txt2img_prompt())
+        assert idx.downstream_of("6") == frozenset({"7"})
+        assert idx.downstream_of("1") >= {"2", "3", "5", "6", "7"}
+
+    def test_cycle_safe(self):
+        p = {
+            "a": {"class_type": "PrimitiveInt", "inputs": {"value": ["b", 0]}},
+            "b": {"class_type": "PrimitiveInt", "inputs": {"value": ["a", 0]}},
+        }
+        idx = PromptIndex(p)
+        assert idx.upstream_of("a") == frozenset({"b"})
+        assert idx.upstream_of("b") == frozenset({"a"})
+
+    def test_dangling_link_ignored(self):
+        p = {"a": {"class_type": "PrimitiveInt", "inputs": {"value": ["zz", 0]}}}
+        assert PromptIndex(p).upstream_of("a") == frozenset()
+
+
+class TestJobIdMap:
+    def test_ids_for_distributed_nodes_only(self):
+        m = generate_job_id_map(usdu_prompt(), trace_id="exec_1_aaaaaa")
+        assert set(m) == {"6", "8", "9"}
+        assert m["6"] == "exec_1_aaaaaa_6"
+
+    def test_fresh_base_when_no_trace(self):
+        m1 = generate_job_id_map(txt2img_prompt())
+        m2 = generate_job_id_map(txt2img_prompt())
+        assert m1["6"] != m2["6"]
+        assert m1["6"].startswith("exec_")
+
+
+class TestPruneForWorker:
+    def test_keeps_distributed_plus_upstream(self):
+        pruned = prune_prompt_for_worker(txt2img_prompt())
+        assert set(pruned) == {"1", "2", "3", "4", "5", "6", "_preview_1"}
+        assert "7" not in pruned  # downstream SaveImage cut
+
+    def test_preview_injected_for_unconsumed_collector(self):
+        pruned = prune_prompt_for_worker(txt2img_prompt())
+        pv = pruned["_preview_1"]
+        assert pv["class_type"] == "PreviewImage"
+        assert pv["inputs"]["images"] == ["6", 0]
+
+    def test_no_preview_when_collector_consumed(self):
+        pruned = prune_prompt_for_worker(usdu_prompt())
+        # collector 6 feeds USDU 8 (kept); collector 9 is terminal → preview
+        previews = [n for n in pruned.values() if n["class_type"] == "PreviewImage"]
+        assert len(previews) == 1
+        assert previews[0]["inputs"]["images"] == ["9", 0]
+
+    def test_no_distributed_nodes_prunes_all(self):
+        p = {"1": {"class_type": "PrimitiveInt", "inputs": {"value": 1}}}
+        assert prune_prompt_for_worker(p) == {}
+
+    def test_input_prompt_not_mutated(self):
+        p = txt2img_prompt()
+        snapshot = {k: dict(v["inputs"]) for k, v in p.items()}
+        prune_prompt_for_worker(p)
+        assert {k: dict(v["inputs"]) for k, v in p.items()} == snapshot
+
+
+class TestDelegateMaster:
+    def test_collector_fed_from_empty_image(self):
+        out = prepare_delegate_master_prompt(txt2img_prompt())
+        assert "5" not in out            # producer (sampler) cut
+        assert "7" in out                # downstream save kept
+        assert out["6"]["inputs"]["images"] == ["_delegate_empty", 0]
+        assert out["_delegate_empty"]["class_type"] == "DistributedEmptyImage"
+
+    def test_safe_scalar_branch_kept(self):
+        p = txt2img_prompt()
+        # a primitive feeding SaveImage's prefix — safe to keep
+        p["10"] = {"class_type": "PrimitiveString", "inputs": {"value": "x"}}
+        p["7"]["inputs"]["filename_prefix"] = ["10", 0]
+        out = prepare_delegate_master_prompt(p)
+        assert "10" in out
+
+    def test_unsafe_upstream_dropped(self):
+        out = prepare_delegate_master_prompt(txt2img_prompt())
+        # loader/clip/sampler all unsafe (non-scalar) → gone
+        for nid in ("1", "2", "3", "5"):
+            assert nid not in out
+
+
+class TestParticipantOverrides:
+    def test_master_overrides(self):
+        p = usdu_prompt()
+        ids = generate_job_id_map(p, trace_id="exec_1_ffffff")
+        out = apply_participant_overrides(
+            p, "master", ids, master_url="http://m:8288",
+            enabled_worker_ids=("w1", "w2"), delegate_only=True,
+        )
+        c = out["6"]["inputs"]
+        assert c["multi_job_id"] == "exec_1_ffffff_6"
+        assert c["is_worker"] is False
+        assert c["delegate_only"] is True
+        assert c["enabled_worker_ids"] == ["w1", "w2"]
+        # seed node got role fields
+        assert out["4"]["inputs"]["is_worker"] is False
+
+    def test_worker_overrides_and_index(self):
+        p = txt2img_prompt()
+        ids = generate_job_id_map(p)
+        out = apply_participant_overrides(p, "w1", ids, worker_index=0)
+        assert out["6"]["inputs"]["is_worker"] is True
+        assert out["6"]["inputs"]["worker_id"] == "w1"
+        assert out["4"]["inputs"]["worker_index"] == 0
+        assert "delegate_only" not in out["6"]["inputs"]
+
+    def test_pass_through_for_collector_downstream_of_usdu(self):
+        p = usdu_prompt()
+        out = apply_participant_overrides(p, "master", {})
+        assert out["9"]["inputs"]["pass_through"] is True   # after USDU
+        assert out["6"]["inputs"]["pass_through"] is False  # before USDU
+
+    def test_original_not_mutated(self):
+        p = txt2img_prompt()
+        apply_participant_overrides(p, "w1", {}, worker_index=2)
+        assert "is_worker" not in p["6"]["inputs"]
