@@ -1,0 +1,19 @@
+"""Cluster control plane (reference L4: ``api/queue_orchestration.py``,
+``upscale/job_store.py``, ``api/orchestration/*``).
+
+Scope note (SURVEY §7): on-pod parallelism needs none of this — chips talk
+over ICI inside compiled programs. This layer exists for the *multi-host*
+story (several host controllers, each owning a mesh slice or a whole pod)
+and for parity with the reference's public behavior: job registry, result
+collection across hosts, liveness probing, least-busy selection, heartbeat
+timeout + requeue, and the orchestration pipeline behind
+``POST /distributed/queue``.
+"""
+
+from .job_models import CollectorJob, TileJob, TileTask  # noqa: F401
+from .job_store import JobStore  # noqa: F401
+from .job_timeout import check_and_requeue_timed_out_workers  # noqa: F401
+from .dispatch import probe_host, select_active_hosts, select_least_busy_host  # noqa: F401
+from .collector_bridge import CollectorBridge  # noqa: F401
+from .runtime import PromptQueue  # noqa: F401
+from .orchestration import Orchestrator, OrchestrationResult  # noqa: F401

@@ -1,0 +1,188 @@
+"""Cross-host collector transport.
+
+Parity: reference ``nodes/collector.py`` both roles —
+
+- worker: PNG-encode each image, POST canonical envelopes
+  ``{job_id, worker_id, batch_idx, image, is_last[, audio]}`` to the
+  master's ``/distributed/job_complete`` (``:143-178``);
+- master: drain the job's asyncio queue with sliced timeouts until every
+  expected worker sent ``is_last``, then combine master-first/worker-order
+  (``:252-295,381-499``).
+
+On-pod gathers never touch this path (they're all_gather inside the SPMD
+program); this bridge carries results **between hosts** over DCN/WAN where
+a serialized envelope is genuinely required.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Any, Optional, Sequence
+
+import aiohttp
+import numpy as np
+
+from ..utils import constants
+from ..utils.async_helpers import run_in_loop
+from ..utils.audio_payload import decode_audio, encode_audio
+from ..utils.exceptions import TileCollectionError, WorkerError
+from ..utils.image import decode_image_b64, encode_image_b64, to_uint8, from_uint8
+from ..utils.logging import debug_log, log
+from ..utils.network import get_client_session, normalize_host_url
+from .job_store import JobStore
+
+
+class CollectorBridge:
+    """Bound to a controller's job store + event loop; node code calls the
+    sync methods from the executor thread."""
+
+    def __init__(self, store: JobStore, loop: asyncio.AbstractEventLoop):
+        self.store = store
+        self.loop = loop
+
+    # --- worker role -------------------------------------------------------
+
+    def send(self, job_id: str, worker_id: str, images, audio,
+             master_url: str) -> None:
+        run_in_loop(
+            self.send_async(job_id, worker_id, images, audio, master_url),
+            self.loop,
+            timeout=constants.DISPATCH_TIMEOUT * 4,
+        )
+
+    async def send_async(self, job_id: str, worker_id: str, images, audio,
+                         master_url: str) -> None:
+        url = normalize_host_url(master_url) + "/distributed/job_complete"
+        arr = to_uint8(images) if images is not None else np.zeros((0, 1, 1, 3), np.uint8)
+        n = arr.shape[0]
+        session = get_client_session()
+        for i in range(n):
+            envelope: dict[str, Any] = {
+                "job_id": job_id,
+                "worker_id": worker_id,
+                "batch_idx": i,
+                "image": encode_image_b64(arr[i]),
+                "is_last": i == n - 1,
+            }
+            if i == n - 1 and audio is not None:
+                envelope["audio"] = encode_audio(audio)
+            await self._post_with_retry(session, url, envelope)
+        if n == 0:
+            await self._post_with_retry(session, url, {
+                "job_id": job_id, "worker_id": worker_id, "batch_idx": -1,
+                "image": "", "is_last": True,
+            })
+        debug_log(f"collector[{job_id}] worker {worker_id} sent {n} images")
+
+    async def _post_with_retry(self, session, url: str, payload: dict) -> None:
+        """Exponential backoff ×SEND_MAX_RETRIES (reference
+        ``worker_comms.py:88-104``)."""
+        last: Exception | None = None
+        for attempt in range(constants.SEND_MAX_RETRIES):
+            try:
+                async with session.post(url, json=payload) as resp:
+                    if resp.status < 400:
+                        return
+                    body = await resp.text()
+                    last = WorkerError(f"{resp.status}: {body[:200]}")
+            except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
+                last = e
+            await asyncio.sleep(constants.SEND_BACKOFF_BASE * (2 ** attempt))
+        raise WorkerError(f"send to {url} failed after retries: {last}")
+
+    # --- master role -------------------------------------------------------
+
+    def collect(self, job_id: str, local_images, local_audio,
+                enabled_worker_ids: Sequence[str] = (),
+                delegate_only: bool = False,
+                timeout: float | None = None):
+        return run_in_loop(
+            self.collect_async(job_id, local_images, local_audio,
+                               enabled_worker_ids, delegate_only, timeout),
+            self.loop,
+            timeout=None,
+        )
+
+    async def collect_async(self, job_id: str, local_images, local_audio,
+                            enabled_worker_ids: Sequence[str] = (),
+                            delegate_only: bool = False,
+                            timeout: float | None = None):
+        job = await self.store.prepare_collector_job(
+            job_id, tuple(enabled_worker_ids))
+        overall = timeout or constants.HEARTBEAT_TIMEOUT * 4
+        deadline = time.monotonic() + overall
+        per_worker: dict[str, dict[int, np.ndarray]] = {w: {} for w in job.expected_workers}
+        audio_parts: dict[str, dict] = {}
+        # Completion is judged on the DRAIN side (is_last envelopes actually
+        # consumed), never on arrival flags — otherwise the loop could exit
+        # with envelopes still queued (same discipline as the reference's
+        # drain loop, ``nodes/collector.py:381-499``).
+        drained_done: set[str] = set()
+
+        while not drained_done >= set(job.expected_workers):
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                missing = [w for w in job.expected_workers if w not in drained_done]
+                log(f"collector[{job_id}] timed out waiting for {missing}")
+                break
+            try:
+                envelope = await asyncio.wait_for(
+                    job.results.get(),
+                    timeout=min(constants.COLLECT_POLL_TIMEOUT, remaining),
+                )
+            except asyncio.TimeoutError:
+                continue
+            w = envelope.get("worker_id", "")
+            if envelope.get("image"):
+                per_worker.setdefault(w, {})[int(envelope.get("batch_idx", 0))] = (
+                    decode_image_b64(envelope["image"])
+                )
+            if envelope.get("audio"):
+                audio_parts[w] = decode_audio(envelope["audio"])
+            if envelope.get("is_last"):
+                drained_done.add(w)
+
+        images = self._combine_images(local_images, per_worker, job.expected_workers,
+                                      delegate_only)
+        audio = self._combine_audio(local_audio, audio_parts, job.expected_workers)
+        await self.store.cleanup_job(job_id)
+        return images, audio
+
+    @staticmethod
+    def _combine_images(local_images, per_worker, expected: Sequence[str],
+                        delegate_only: bool):
+        """Master first, then workers in enabled order, batch_idx order
+        within each worker (``nodes/collector.py:252-295``). A delegate-only
+        master contributes nothing (``:329-333``)."""
+        batches: list[np.ndarray] = []
+        if local_images is not None and not delegate_only:
+            local = np.asarray(local_images, dtype=np.float32)
+            if local.size:
+                batches.append(local)
+        for w in expected:
+            imgs = per_worker.get(w, {})
+            for idx in sorted(imgs):
+                batches.append(imgs[idx][None])
+        if not batches:
+            return local_images
+        hw = batches[0].shape[1:3]
+        kept = [b for b in batches if b.shape[1:3] == hw]
+        if len(kept) != len(batches):
+            log(f"collector: dropping {len(batches)-len(kept)} mismatched-size results")
+        return np.concatenate(kept, axis=0)
+
+    @staticmethod
+    def _combine_audio(local_audio, audio_parts, expected: Sequence[str]):
+        """Concatenate waveforms along samples (``:180-233``)."""
+        parts = []
+        if local_audio is not None:
+            parts.append(local_audio)
+        parts.extend(audio_parts[w] for w in expected if w in audio_parts)
+        if not parts:
+            return None
+        sr = parts[0]["sample_rate"]
+        wfs = [np.asarray(p["waveform"]) for p in parts]
+        ch = min(w.shape[1] for w in wfs)
+        wfs = [w[:, :ch, :] for w in wfs]
+        return {"waveform": np.concatenate(wfs, axis=-1), "sample_rate": sr}

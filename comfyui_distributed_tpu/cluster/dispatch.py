@@ -1,0 +1,103 @@
+"""Host probing, selection, and prompt dispatch.
+
+Parity: reference ``api/orchestration/dispatch.py`` — bounded-semaphore
+probe fan-out (``:56-59,144-191``), delegate auto-disable when all hosts
+are offline (``:184-190``), least-busy selection with round-robin among
+idle (``:204-268``), HTTP prompt dispatch with validation-error propagation
+(``:62-141``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import itertools
+from typing import Any, Optional, Sequence
+
+import aiohttp
+
+from ..utils import constants
+from ..utils.logging import debug_log, log, trace_info
+from ..utils.network import build_host_url, get_client_session, probe_host
+
+# Global round-robin cursor for idle-host selection (reference keeps the
+# same module-global index, dispatch.py:28)
+_rr_counter = itertools.count()
+
+
+async def select_active_hosts(
+    hosts: Sequence[dict[str, Any]],
+    probe_concurrency: int | None = None,
+    trace_id: str | None = None,
+) -> tuple[list[dict], list[dict]]:
+    """Probe all enabled hosts concurrently (bounded) → (online, offline).
+
+    Each probe result dict gains ``_probe`` with the health payload.
+    """
+    sem = asyncio.Semaphore(probe_concurrency or constants.WORKER_PROBE_CONCURRENCY)
+
+    async def probe_one(host: dict) -> tuple[dict, Optional[dict]]:
+        async with sem:
+            return host, await probe_host(host)
+
+    results = await asyncio.gather(*(probe_one(h) for h in hosts))
+    online, offline = [], []
+    for host, health in results:
+        if health is None:
+            offline.append(host)
+        else:
+            online.append({**host, "_probe": health})
+    trace_info(trace_id, f"probe: {len(online)} online, {len(offline)} offline")
+    return online, offline
+
+
+def queue_depth(host: dict) -> int:
+    return int((host.get("_probe") or {}).get("queue_remaining", 0))
+
+
+def select_least_busy_host(online_hosts: Sequence[dict]) -> Optional[dict]:
+    """Round-robin among idle hosts; else min queue depth (reference
+    ``select_least_busy_worker``, ``dispatch.py:204-268``)."""
+    if not online_hosts:
+        return None
+    idle = [h for h in online_hosts if queue_depth(h) == 0]
+    if idle:
+        return idle[next(_rr_counter) % len(idle)]
+    return min(online_hosts, key=queue_depth)
+
+
+async def dispatch_prompt(
+    host: dict[str, Any],
+    prompt: dict,
+    client_id: str = "",
+    extra: dict | None = None,
+    trace_id: str | None = None,
+) -> dict:
+    """POST the prompt to a host's queue endpoint; returns its response.
+
+    Raises ``WorkerError`` with the remote validation errors on 4xx
+    (reference propagates node_errors the same way, ``dispatch.py:98-141``).
+    """
+    from ..utils.exceptions import WorkerError
+
+    url = build_host_url(host, "/prompt")
+    payload = {"prompt": prompt, "client_id": client_id, **(extra or {})}
+    session = get_client_session()
+    try:
+        async with session.post(
+            url, json=payload,
+            timeout=aiohttp.ClientTimeout(total=constants.DISPATCH_TIMEOUT),
+        ) as resp:
+            body = await resp.json(content_type=None)
+            if resp.status >= 400:
+                raise WorkerError(
+                    f"dispatch to {host.get('id')} failed "
+                    f"({resp.status}): {body}",
+                    worker_id=host.get("id"),
+                )
+            trace_info(trace_id, f"dispatched to {host.get('id')}")
+            return body
+    except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
+        raise WorkerError(
+            f"dispatch to {host.get('id')} unreachable: {e}",
+            worker_id=host.get("id"),
+        ) from e

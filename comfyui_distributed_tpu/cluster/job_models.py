@@ -1,0 +1,68 @@
+"""Typed job state (parity: reference ``upscale/job_models.py:10-49`` and
+the collector's per-job asyncio queue, ``nodes/collector.py:321-327``)."""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import time
+from typing import Any, Optional
+
+
+@dataclasses.dataclass
+class CollectorJob:
+    """One collector gather: workers push result envelopes, master drains."""
+
+    job_id: str
+    expected_workers: tuple[str, ...] = ()
+    results: asyncio.Queue = dataclasses.field(default_factory=asyncio.Queue)
+    # worker_id → done flag (worker sent its is_last envelope)
+    completed_workers: dict[str, bool] = dataclasses.field(default_factory=dict)
+    created_at: float = dataclasses.field(default_factory=time.monotonic)
+
+    def all_done(self) -> bool:
+        return all(self.completed_workers.get(w) for w in self.expected_workers)
+
+
+@dataclasses.dataclass
+class TileTask:
+    """A unit of tile-engine work at host granularity: one shard-range of
+    the global tile batch (the reference assigns single tile indices,
+    ``upscale/job_store.py:34-80``; the TPU build assigns contiguous
+    ranges so each grant is one SPMD program run)."""
+
+    task_id: int
+    start: int                  # global tile index range [start, end)
+    end: int
+
+    def as_dict(self) -> dict:
+        return {"task_id": self.task_id, "start": self.start, "end": self.end}
+
+
+@dataclasses.dataclass
+class TileJob:
+    """Pull-based tile job (parity: ``TileJobState``/``ImageJobState``)."""
+
+    job_id: str
+    total_tasks: int
+    mode: str = "static"                       # "static" | "dynamic"
+    # task_id → task, for the whole job lifetime (requeue needs ranges back)
+    tasks: dict[int, TileTask] = dataclasses.field(default_factory=dict)
+    pending: list[TileTask] = dataclasses.field(default_factory=list)
+    # task_id → worker_id currently assigned
+    assigned: dict[int, str] = dataclasses.field(default_factory=dict)
+    # task_id → result payload
+    completed: dict[int, Any] = dataclasses.field(default_factory=dict)
+    # worker_id → last heartbeat (monotonic)
+    worker_status: dict[str, float] = dataclasses.field(default_factory=dict)
+    results: asyncio.Queue = dataclasses.field(default_factory=asyncio.Queue)
+    created_at: float = dataclasses.field(default_factory=time.monotonic)
+
+    def remaining(self) -> int:
+        return self.total_tasks - len(self.completed)
+
+    def is_complete(self) -> bool:
+        return self.remaining() <= 0
+
+    def heartbeat(self, worker_id: str, now: Optional[float] = None) -> None:
+        self.worker_status[worker_id] = time.monotonic() if now is None else now

@@ -1,0 +1,187 @@
+"""Lock-guarded job registry.
+
+Parity: reference ``upscale/job_store.py`` (asyncio-locked dicts attached to
+the server) + collector queue management (``api/queue_orchestration.py:42-48``,
+``nodes/collector.py:321-327``). One store instance lives on the controller;
+every mutation happens under the store lock, mirroring the reference's
+race-avoidance discipline (SURVEY §5.2).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Any, Optional
+
+from ..utils import constants
+from ..utils.exceptions import JobQueueError
+from ..utils.logging import debug_log
+from .job_models import CollectorJob, TileJob, TileTask
+
+
+class JobStore:
+    def __init__(self):
+        self.lock = asyncio.Lock()
+        self.collector_jobs: dict[str, CollectorJob] = {}
+        self.tile_jobs: dict[str, TileJob] = {}
+
+    # --- collector jobs ----------------------------------------------------
+
+    async def prepare_collector_job(
+        self, job_id: str, expected_workers: tuple[str, ...] = ()
+    ) -> CollectorJob:
+        """Pre-create the result queue BEFORE any compute is dispatched —
+        closes the init race the reference closes the same way
+        (``nodes/collector.py:321-327``)."""
+        async with self.lock:
+            job = self.collector_jobs.get(job_id)
+            if job is None:
+                job = CollectorJob(job_id, tuple(expected_workers))
+                self.collector_jobs[job_id] = job
+            elif expected_workers:
+                job.expected_workers = tuple(expected_workers)
+            return job
+
+    async def put_collector_result(
+        self, job_id: str, envelope: dict[str, Any],
+        grace: float | None = None,
+    ) -> None:
+        """Enqueue a worker envelope; retries while the job is not yet
+        initialized (reference ``api/job_routes.py:314-333`` 10 s grace)."""
+        grace = constants.JOB_INIT_GRACE if grace is None else grace
+        deadline = time.monotonic() + grace
+        while True:
+            async with self.lock:
+                job = self.collector_jobs.get(job_id)
+            if job is not None:
+                await job.results.put(envelope)
+                if envelope.get("is_last"):
+                    job.completed_workers[envelope.get("worker_id", "")] = True
+                return
+            if time.monotonic() >= deadline:
+                raise JobQueueError(f"collector job {job_id!r} never initialized",
+                                    job_id=job_id)
+            await asyncio.sleep(0.1)
+
+    async def get_collector_job(self, job_id: str) -> Optional[CollectorJob]:
+        async with self.lock:
+            return self.collector_jobs.get(job_id)
+
+    # --- tile jobs ---------------------------------------------------------
+
+    async def init_tile_job(
+        self, job_id: str, total_tasks: int, mode: str = "static",
+        chunk: int = 1,
+    ) -> TileJob:
+        """Seed the pending queue with shard-range tasks (reference
+        ``init_static_job_batched``/``init_dynamic_job``,
+        ``upscale/job_store.py:34-114``)."""
+        async with self.lock:
+            if job_id in self.tile_jobs:
+                raise JobQueueError(f"tile job {job_id!r} already initialized",
+                                    job_id=job_id)
+            tasks = []
+            tid = 0
+            for start in range(0, total_tasks, chunk):
+                tasks.append(TileTask(tid, start, min(start + chunk, total_tasks)))
+                tid += 1
+            job = TileJob(job_id, total_tasks=len(tasks), mode=mode,
+                          tasks={t.task_id: t for t in tasks}, pending=list(tasks))
+            self.tile_jobs[job_id] = job
+            return job
+
+    async def request_work(self, job_id: str, worker_id: str) -> Optional[dict]:
+        """Pull-based assignment (reference ``/distributed/request_image``,
+        ``api/usdu_routes.py:168-215``): pop a pending task, record the
+        assignment + heartbeat; None when drained."""
+        async with self.lock:
+            job = self.tile_jobs.get(job_id)
+            if job is None:
+                return None
+            job.heartbeat(worker_id)
+            if not job.pending:
+                return None
+            task = job.pending.pop(0)
+            job.assigned[task.task_id] = worker_id
+            return {**task.as_dict(), "estimated_remaining": len(job.pending)}
+
+    async def submit_result(
+        self, job_id: str, worker_id: str, task_id: int, payload: Any,
+    ) -> bool:
+        """Record a completed task; idempotent for duplicate submissions
+        (a timed-out-then-revived worker may double-send; the reference's
+        batched-completeness check covers the same case,
+        ``upscale/job_timeout.py:111-150``)."""
+        async with self.lock:
+            job = self.tile_jobs.get(job_id)
+            if job is None:
+                raise JobQueueError(f"unknown tile job {job_id!r}", job_id=job_id)
+            job.heartbeat(worker_id)
+            if task_id in job.completed:
+                debug_log(f"duplicate result for {job_id}:{task_id} ignored")
+                return False
+            job.completed[task_id] = payload
+            job.assigned.pop(task_id, None)
+        await job.results.put((task_id, payload))
+        return True
+
+    async def heartbeat(self, job_id: str, worker_id: str) -> bool:
+        async with self.lock:
+            job = self.tile_jobs.get(job_id)
+            if job is None:
+                return False
+            job.heartbeat(worker_id)
+            return True
+
+    async def job_status(self, job_id: str) -> dict:
+        """Job-ready poll (reference ``/distributed/job_status``,
+        ``api/usdu_routes.py:218-228``)."""
+        async with self.lock:
+            tile = self.tile_jobs.get(job_id)
+            if tile is not None:
+                return {"exists": True, "kind": "tile", "mode": tile.mode,
+                        "pending": len(tile.pending),
+                        "completed": len(tile.completed),
+                        "total": tile.total_tasks}
+            if job_id in self.collector_jobs:
+                return {"exists": True, "kind": "collector"}
+            return {"exists": False}
+
+    async def requeue_worker_tasks(self, job_id: str, worker_id: str) -> list[int]:
+        """Requeue the incomplete tasks of a (presumed dead) worker and
+        evict it (reference ``_check_and_requeue_timed_out_workers`` apply
+        phase, ``upscale/job_timeout.py:111-150``)."""
+        async with self.lock:
+            job = self.tile_jobs.get(job_id)
+            if job is None:
+                return []
+            requeued = []
+            for task_id, owner in list(job.assigned.items()):
+                if owner != worker_id or task_id in job.completed:
+                    continue
+                del job.assigned[task_id]
+                requeued.append(task_id)
+            if requeued:
+                # push to the FRONT so recovered work is picked up first
+                job.pending[:0] = [job.tasks[tid] for tid in requeued]
+            job.worker_status.pop(worker_id, None)
+            return requeued
+
+    async def cleanup_job(self, job_id: str) -> None:
+        async with self.lock:
+            self.collector_jobs.pop(job_id, None)
+            self.tile_jobs.pop(job_id, None)
+
+    async def prune_stale(self, max_age: float = 3600.0) -> list[str]:
+        """Drop jobs older than ``max_age`` (the reference cleans up on
+        collection end, ``upscale/job_store.py:174``; this adds a safety
+        net for abandoned jobs)."""
+        now = time.monotonic()
+        dropped = []
+        async with self.lock:
+            for d in (self.collector_jobs, self.tile_jobs):
+                for jid in [j for j, job in d.items()
+                            if now - job.created_at > max_age]:
+                    del d[jid]
+                    dropped.append(jid)
+        return dropped

@@ -1,0 +1,162 @@
+"""The orchestration pipeline behind ``POST /distributed/queue``.
+
+Parity: reference ``api/queue_orchestration.py:200-418`` — resolve enabled
+workers → bounded probe → (optional) least-busy single selection →
+job-ID map → pre-create collector queues → per-participant payload prep
+under a semaphore → parallel dispatch → queue the master's own prompt.
+Delegate-only auto-disables when no worker is reachable (``:247-252``).
+
+TPU note: "workers" here are *host controllers* (each owning chips/a pod
+slice), not per-GPU processes; a single-host deployment never enters this
+module's fan-out path — the mesh handles its chips inside one program.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+from typing import Any, Optional, Sequence
+
+from ..graph.transform import (
+    apply_participant_overrides,
+    generate_job_id_map,
+    prepare_delegate_master_prompt,
+    prune_prompt_for_worker,
+)
+from ..utils import constants
+from ..utils.config import load_config
+from ..utils.exceptions import WorkerError
+from ..utils.logging import new_trace_id, trace_info
+from ..utils.network import build_master_callback_url
+from .dispatch import dispatch_prompt, select_active_hosts, select_least_busy_host
+from .job_store import JobStore
+from .runtime import PromptQueue
+
+
+@dataclasses.dataclass
+class OrchestrationResult:
+    prompt_id: str
+    node_errors: list
+    worker_count: int
+    dispatched_to: list[str]
+    trace_id: str
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class Orchestrator:
+    def __init__(self, store: JobStore, queue: PromptQueue,
+                 config_loader=load_config):
+        self.store = store
+        self.queue = queue
+        self.load_config = config_loader
+
+    def _resolve_enabled_hosts(
+        self, config: dict, enabled_ids: Optional[Sequence[str]]
+    ) -> list[dict]:
+        """Explicit ids win; else config-enabled hosts
+        (reference ``:63-93`` incl. the legacy ``workers`` alias handled in
+        the API layer)."""
+        hosts = config.get("hosts", [])
+        if enabled_ids is not None:
+            by_id = {h.get("id"): h for h in hosts}
+            return [by_id[i] for i in enabled_ids if i in by_id]
+        return [h for h in hosts if h.get("enabled")]
+
+    async def orchestrate(
+        self,
+        prompt: dict,
+        client_id: str = "",
+        enabled_ids: Optional[Sequence[str]] = None,
+        delegate_master: Optional[bool] = None,
+        load_balance: bool = False,
+        trace_id: str | None = None,
+    ) -> OrchestrationResult:
+        trace_id = trace_id or new_trace_id()
+        config = self.load_config()
+        candidates = self._resolve_enabled_hosts(config, enabled_ids)
+        if delegate_master is None:
+            delegate_master = bool(
+                config.get("settings", {}).get("master_delegate_only")
+            )
+        trace_info(trace_id, f"orchestrating over {len(candidates)} candidate hosts "
+                             f"(delegate={delegate_master})")
+
+        online, offline = await select_active_hosts(
+            candidates,
+            probe_concurrency=config.get("settings", {}).get(
+                "worker_probe_concurrency", constants.WORKER_PROBE_CONCURRENCY),
+            trace_id=trace_id,
+        )
+        if load_balance and online:
+            chosen = select_least_busy_host(online)
+            online = [chosen] if chosen else []
+        if not online and delegate_master:
+            # nobody to delegate to → master must compute after all (:247-252)
+            trace_info(trace_id, "no online workers; delegate mode disabled")
+            delegate_master = False
+
+        job_ids = generate_job_id_map(prompt, trace_id)
+        worker_ids = tuple(h.get("id", f"host{i}") for i, h in enumerate(online))
+        for jid in job_ids.values():
+            await self.store.prepare_collector_job(jid, worker_ids)
+
+        # master payload
+        if delegate_master:
+            master_prompt = prepare_delegate_master_prompt(prompt)
+        else:
+            master_prompt = prompt
+        master_prompt = apply_participant_overrides(
+            master_prompt, "master", job_ids,
+            enabled_worker_ids=worker_ids, delegate_only=delegate_master,
+        )
+
+        # worker payloads + dispatch (prep bounded like reference :367-388)
+        sem = asyncio.Semaphore(
+            config.get("settings", {}).get("worker_prep_concurrency",
+                                           constants.WORKER_PREP_CONCURRENCY))
+
+        async def prep_and_dispatch(index: int, host: dict) -> tuple[str, Optional[str]]:
+            async with sem:
+                wid = host.get("id", f"host{index}")
+                callback = build_master_callback_url(
+                    config.get("master", {}),
+                    for_local=host.get("type") == "local",
+                )
+                wprompt = prune_prompt_for_worker(prompt)
+                if not wprompt:
+                    return wid, "nothing to dispatch (no distributed nodes)"
+                wprompt = apply_participant_overrides(
+                    wprompt, wid, job_ids, master_url=callback,
+                    enabled_worker_ids=worker_ids, worker_index=index,
+                )
+                try:
+                    await dispatch_prompt(host, wprompt, client_id,
+                                          extra={"trace_id": trace_id},
+                                          trace_id=trace_id)
+                    return wid, None
+                except WorkerError as e:
+                    return wid, str(e)
+
+        dispatch_results = await asyncio.gather(
+            *(prep_and_dispatch(i, h) for i, h in enumerate(online))
+        )
+        dispatched = [wid for wid, err in dispatch_results if err is None]
+        failures = {wid: err for wid, err in dispatch_results if err}
+        if failures:
+            trace_info(trace_id, f"dispatch failures: {failures}")
+            # collector must not wait on hosts that never got the job
+            for jid in job_ids.values():
+                await self.store.prepare_collector_job(
+                    jid, tuple(w for w in worker_ids if w in dispatched))
+
+        prompt_id, node_errors = self.queue.enqueue(
+            master_prompt, client_id, trace_id)
+        return OrchestrationResult(
+            prompt_id=prompt_id,
+            node_errors=node_errors,
+            worker_count=len(dispatched),
+            dispatched_to=dispatched,
+            trace_id=trace_id,
+        )

@@ -1,0 +1,136 @@
+"""Host controller runtime: the local prompt queue + execution context.
+
+The reference relies on ComfyUI's PromptServer queue + executor
+(``utils/async_helpers.py:108-149`` pushes into ``prompt_queue``). This is
+the standalone equivalent: an asyncio consumer that validates prompts,
+executes them in a worker thread (JAX compute must not block the loop),
+and exposes ``queue_remaining`` for health probes — the field the
+reference's least-busy scheduler reads (``dispatch.py:225-268``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import secrets
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Optional
+
+from ..graph.executor import GraphExecutor, validate_prompt
+from ..utils.exceptions import ValidationError
+from ..utils.logging import log, trace_info
+
+
+@dataclasses.dataclass
+class PromptJob:
+    prompt_id: str
+    prompt: dict
+    client_id: str = ""
+    trace_id: str | None = None
+    enqueued_at: float = dataclasses.field(default_factory=time.monotonic)
+    future: Optional[asyncio.Future] = None
+
+
+class PromptQueue:
+    """FIFO prompt queue with a single execution worker.
+
+    Execution is serialized per controller (one mesh, one program at a
+    time — the TPU analogue of one ComfyUI executor per GPU process).
+    """
+
+    def __init__(self, context_factory: Callable[[], dict] | None = None):
+        self._queue: asyncio.Queue[PromptJob] = asyncio.Queue()
+        self._context_factory = context_factory or (lambda: {})
+        self._pool = ThreadPoolExecutor(max_workers=1, thread_name_prefix="graph-exec")
+        self._task: Optional[asyncio.Task] = None
+        self._executing: Optional[str] = None
+        self.history: dict[str, dict] = {}
+
+    # --- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        if self._task is None or self._task.done():
+            self._task = asyncio.ensure_future(self._run())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+        self._pool.shutdown(wait=False, cancel_futures=True)
+
+    # --- producer ----------------------------------------------------------
+
+    def enqueue(self, prompt: dict, client_id: str = "",
+                trace_id: str | None = None) -> tuple[str, list]:
+        """Validate + enqueue; returns (prompt_id, node_errors). Mirrors
+        ``queue_prompt_payload``: validation errors reject the prompt
+        before it reaches the queue (``utils/async_helpers.py:108-149``)."""
+        errors = validate_prompt(prompt)
+        if errors:
+            return "", [e.as_dict() for e in errors]
+        prompt_id = f"p_{int(time.time()*1000)}_{secrets.token_hex(3)}"
+        job = PromptJob(prompt_id, prompt, client_id, trace_id)
+        self._queue.put_nowait(job)
+        self.start()
+        return prompt_id, []
+
+    @property
+    def queue_remaining(self) -> int:
+        return self._queue.qsize() + (1 if self._executing else 0)
+
+    @property
+    def executing(self) -> Optional[str]:
+        return self._executing
+
+    # --- consumer ----------------------------------------------------------
+
+    async def _run(self) -> None:
+        loop = asyncio.get_running_loop()
+        while True:
+            job = await self._queue.get()
+            self._executing = job.prompt_id
+            started = time.monotonic()
+            try:
+                context = dict(self._context_factory())
+                executor = GraphExecutor(context)
+                outputs = await loop.run_in_executor(
+                    self._pool, executor.execute, job.prompt
+                )
+                self.history[job.prompt_id] = {
+                    "status": "success",
+                    "duration": time.monotonic() - started,
+                    "outputs": {
+                        nid: out for nid, out in outputs.items()
+                        if _is_terminal(job.prompt, nid)
+                    },
+                }
+                trace_info(job.trace_id,
+                           f"prompt {job.prompt_id} done in "
+                           f"{self.history[job.prompt_id]['duration']:.2f}s")
+            except Exception as e:  # noqa: BLE001 — job isolation barrier
+                self.history[job.prompt_id] = {
+                    "status": "error", "error": str(e),
+                    "duration": time.monotonic() - started,
+                }
+                log(f"prompt {job.prompt_id} failed: {e}")
+            finally:
+                self._executing = None
+
+
+def _is_terminal(prompt: dict, nid: str) -> bool:
+    from ..graph.node import NODE_REGISTRY
+
+    cls = NODE_REGISTRY.get(prompt.get(nid, {}).get("class_type", ""))
+    if cls is None:
+        return False
+    consumed = {
+        v[0] for node in prompt.values()
+        for v in node.get("inputs", {}).values()
+        if isinstance(v, (list, tuple)) and len(v) == 2
+    }
+    return cls.OUTPUT_NODE or nid not in consumed

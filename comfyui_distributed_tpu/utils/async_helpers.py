@@ -1,0 +1,39 @@
+"""Sync↔async bridging for node execution.
+
+Parity: reference ``utils/async_helpers.py:13-54``
+(``run_async_in_server_loop``). Graph execution is synchronous (JAX compute
+blocks a thread); the control plane is an asyncio loop. Nodes that must
+talk to the control plane (collector send/collect) hop onto the loop via
+``run_in_loop``. The controller itself is async-first — this bridge exists
+only at the node-execution boundary (SURVEY §7 hard-part #5).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import concurrent.futures
+from typing import Any, Coroutine, Optional
+
+
+def run_in_loop(
+    coro: Coroutine,
+    loop: asyncio.AbstractEventLoop,
+    timeout: Optional[float] = None,
+) -> Any:
+    """Run ``coro`` on ``loop`` from a non-loop thread and wait for it."""
+    if loop.is_closed():
+        raise RuntimeError("event loop is closed")
+    try:
+        running = asyncio.get_running_loop()
+    except RuntimeError:
+        running = None
+    if running is loop:
+        raise RuntimeError(
+            "run_in_loop called from the loop's own thread; await instead"
+        )
+    fut = asyncio.run_coroutine_threadsafe(coro, loop)
+    try:
+        return fut.result(timeout)
+    except concurrent.futures.TimeoutError:
+        fut.cancel()
+        raise TimeoutError(f"coroutine did not finish within {timeout}s")

@@ -1,0 +1,100 @@
+"""HTTP helpers: pooled session, URL builders, host probing.
+
+Parity: reference ``utils/network.py`` — shared aiohttp session with
+connection limits (``:14-26``), URL builders with cloud-HTTPS heuristics
+(``:88-105,139-183``), ``probe_worker`` (``:108-136``), standardized error
+payloads (``:35-44``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Any, Optional
+
+import aiohttp
+
+from . import constants
+from .logging import debug_log
+
+_session: Optional[aiohttp.ClientSession] = None
+_session_loop: Optional[asyncio.AbstractEventLoop] = None
+
+# Domains that imply TLS regardless of scheme given (reference ``:96-104``)
+_HTTPS_DOMAINS = ("trycloudflare.com", "ngrok.io", "ngrok-free.app", "proxy.runpod.net")
+
+
+def get_client_session() -> aiohttp.ClientSession:
+    """Shared pooled session (limit 100, 30 per host), rebuilt if the
+    running loop changed (tests create fresh loops)."""
+    global _session, _session_loop
+    loop = asyncio.get_event_loop()
+    if _session is None or _session.closed or _session_loop is not loop:
+        _session = aiohttp.ClientSession(
+            connector=aiohttp.TCPConnector(limit=100, limit_per_host=30),
+            timeout=aiohttp.ClientTimeout(total=constants.DISPATCH_TIMEOUT),
+        )
+        _session_loop = loop
+    return _session
+
+
+async def close_client_session() -> None:
+    global _session
+    if _session is not None and not _session.closed:
+        await _session.close()
+    _session = None
+
+
+def normalize_host_url(address: str) -> str:
+    """'host:port' or bare host → full URL; cloud domains force https."""
+    addr = address.strip().rstrip("/")
+    if not addr:
+        return ""
+    if "://" not in addr:
+        scheme = "https" if any(d in addr for d in _HTTPS_DOMAINS) else "http"
+        addr = f"{scheme}://{addr}"
+    if addr.startswith("http://") and any(d in addr for d in _HTTPS_DOMAINS):
+        addr = "https://" + addr[len("http://"):]
+    return addr
+
+
+def build_host_url(host: dict[str, Any], path: str = "") -> str:
+    base = normalize_host_url(host.get("address", ""))
+    return f"{base}{path}"
+
+
+def build_master_callback_url(master_cfg: dict[str, Any], for_local: bool = False) -> str:
+    """URL a worker host uses to reach the master; local workers short-
+    circuit to loopback (reference ``:185-201``)."""
+    port = master_cfg.get("port", 8288)
+    if for_local or not master_cfg.get("host"):
+        return f"http://127.0.0.1:{port}"
+    base = normalize_host_url(str(master_cfg["host"]))
+    if base.rsplit(":", 1)[-1].isdigit() or base.startswith("https://"):
+        return base
+    return f"{base}:{port}"
+
+
+async def probe_host(address_or_host: Any, timeout: float | None = None
+                     ) -> Optional[dict]:
+    """GET /distributed/health → status dict, or None if unreachable
+    (reference ``probe_worker`` GETs ``/prompt``, ``:108-136``)."""
+    url = (
+        build_host_url(address_or_host, "/distributed/health")
+        if isinstance(address_or_host, dict)
+        else normalize_host_url(str(address_or_host)) + "/distributed/health"
+    )
+    try:
+        session = get_client_session()
+        async with session.get(
+            url, timeout=aiohttp.ClientTimeout(total=timeout or constants.PROBE_TIMEOUT)
+        ) as resp:
+            if resp.status != 200:
+                return None
+            return await resp.json()
+    except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
+        debug_log(f"probe {url} failed: {e}")
+        return None
+
+
+def error_payload(message: str, status: int = 400) -> dict:
+    return {"error": message, "status": status}

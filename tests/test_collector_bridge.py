@@ -1,0 +1,177 @@
+"""Collector bridge tests: envelope combine semantics + master drain loop
+against in-process queues (the reference tests its collector the same way —
+no cluster, AsyncMock HTTP; SURVEY §4)."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from comfyui_distributed_tpu.cluster import CollectorBridge, JobStore
+from comfyui_distributed_tpu.utils.audio_payload import encode_audio
+from comfyui_distributed_tpu.utils.image import encode_image_b64
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def img(value, hw=(4, 4)):
+    return np.full((hw[0], hw[1], 3), value, np.float32)
+
+
+class TestCombineImages:
+    def test_master_first_then_worker_order(self):
+        per_worker = {
+            "w2": {0: img(0.8)},
+            "w1": {1: img(0.4), 0: img(0.2)},
+        }
+        out = CollectorBridge._combine_images(
+            img(0.1)[None], per_worker, expected=("w1", "w2"),
+            delegate_only=False)
+        assert out.shape == (4, 4, 4, 3)
+        # master, w1[0], w1[1], w2[0] — enabled order + batch_idx order
+        np.testing.assert_allclose(out[:, 0, 0, 0], [0.1, 0.2, 0.4, 0.8], atol=0.01)
+
+    def test_delegate_only_master_excluded(self):
+        out = CollectorBridge._combine_images(
+            img(0.9)[None], {"w1": {0: img(0.3)}}, ("w1",), delegate_only=True)
+        assert out.shape == (1, 4, 4, 3)
+        np.testing.assert_allclose(out[0, 0, 0, 0], 0.3, atol=0.01)
+
+    def test_mismatched_sizes_dropped(self):
+        out = CollectorBridge._combine_images(
+            img(0.1)[None], {"w1": {0: img(0.5, hw=(8, 8))}}, ("w1",), False)
+        assert out.shape == (1, 4, 4, 3)
+
+    def test_no_results_returns_local(self):
+        local = img(0.5)[None]
+        out = CollectorBridge._combine_images(local, {}, (), False)
+        np.testing.assert_array_equal(out, local)
+
+
+class TestCombineAudio:
+    def test_concat_along_samples(self):
+        local = {"waveform": np.zeros((1, 2, 10), np.float32), "sample_rate": 8000}
+        parts = {"w1": {"waveform": np.ones((1, 2, 5), np.float32), "sample_rate": 8000}}
+        out = CollectorBridge._combine_audio(local, parts, ("w1",))
+        assert out["waveform"].shape == (1, 2, 15)
+
+    def test_channel_mismatch_truncates(self):
+        local = {"waveform": np.zeros((1, 2, 4), np.float32), "sample_rate": 8000}
+        parts = {"w1": {"waveform": np.ones((1, 1, 4), np.float32), "sample_rate": 8000}}
+        out = CollectorBridge._combine_audio(local, parts, ("w1",))
+        assert out["waveform"].shape == (1, 1, 8)
+
+    def test_none_when_no_audio(self):
+        assert CollectorBridge._combine_audio(None, {}, ()) is None
+
+
+class TestCollectDrain:
+    def test_collects_until_all_done(self):
+        async def body():
+            store = JobStore()
+            bridge = CollectorBridge(store, asyncio.get_running_loop())
+
+            async def worker_sends():
+                await asyncio.sleep(0.05)
+                for i in range(2):
+                    await store.put_collector_result("j1", {
+                        "worker_id": "w1", "batch_idx": i,
+                        "image": encode_image_b64(img(0.5)),
+                        "is_last": i == 1,
+                    })
+                await store.put_collector_result("j1", {
+                    "worker_id": "w2", "batch_idx": 0,
+                    "image": encode_image_b64(img(0.9)),
+                    "audio": encode_audio({"waveform": np.zeros((1, 1, 8), np.float32),
+                                           "sample_rate": 8000}),
+                    "is_last": True,
+                })
+
+            await store.prepare_collector_job("j1", ("w1", "w2"))
+            send_task = asyncio.ensure_future(worker_sends())
+            images, audio = await bridge.collect_async(
+                "j1", img(0.1)[None], None, ("w1", "w2"))
+            await send_task
+            assert images.shape == (4, 4, 4, 3)
+            assert audio["waveform"].shape == (1, 1, 8)
+            # job cleaned up after collection
+            assert await store.get_collector_job("j1") is None
+        run(body())
+
+    def test_timeout_returns_partial(self):
+        async def body():
+            store = JobStore()
+            bridge = CollectorBridge(store, asyncio.get_running_loop())
+            await store.prepare_collector_job("j1", ("w1", "dead"))
+            await store.put_collector_result("j1", {
+                "worker_id": "w1", "batch_idx": 0,
+                "image": encode_image_b64(img(0.7)), "is_last": True,
+            })
+            images, _ = await bridge.collect_async(
+                "j1", img(0.2)[None], None, ("w1", "dead"), timeout=0.3)
+            assert images.shape == (2, 4, 4, 3)   # master + w1; dead skipped
+        run(body())
+
+    def test_empty_batch_worker_contributes_nothing(self):
+        async def body():
+            store = JobStore()
+            bridge = CollectorBridge(store, asyncio.get_running_loop())
+            await store.prepare_collector_job("j1", ("w1",))
+            await store.put_collector_result("j1", {
+                "worker_id": "w1", "batch_idx": -1, "image": "", "is_last": True,
+            })
+            images, _ = await bridge.collect_async(
+                "j1", img(0.2)[None], None, ("w1",), timeout=1.0)
+            assert images.shape == (1, 4, 4, 3)
+        run(body())
+
+
+class TestRuntimeQueue:
+    def test_prompt_queue_executes_and_tracks(self):
+        from comfyui_distributed_tpu.cluster import PromptQueue
+
+        async def body():
+            q = PromptQueue()
+            pid, errs = q.enqueue({
+                "1": {"class_type": "PrimitiveInt", "inputs": {"value": 7}},
+                "2": {"class_type": "DistributedSeed", "inputs": {"seed": ["1", 0]}},
+            })
+            assert errs == []
+            for _ in range(100):
+                if pid in q.history:
+                    break
+                await asyncio.sleep(0.02)
+            assert q.history[pid]["status"] == "success"
+            assert q.history[pid]["outputs"]["2"] == (7,)
+            assert q.queue_remaining == 0
+            await q.stop()
+        run(body())
+
+    def test_invalid_prompt_rejected(self):
+        from comfyui_distributed_tpu.cluster import PromptQueue
+
+        async def body():
+            q = PromptQueue()
+            pid, errs = q.enqueue({"1": {"class_type": "Nope", "inputs": {}}})
+            assert pid == "" and errs
+            await q.stop()
+        run(body())
+
+    def test_node_exception_isolated(self):
+        from comfyui_distributed_tpu.cluster import PromptQueue
+
+        async def body():
+            q = PromptQueue()
+            pid, _ = q.enqueue({
+                "1": {"class_type": "LoadImage", "inputs": {"image": "missing.png"}},
+            })
+            for _ in range(100):
+                if pid in q.history:
+                    break
+                await asyncio.sleep(0.02)
+            assert q.history[pid]["status"] == "error"
+            assert "not found" in q.history[pid]["error"]
+            await q.stop()
+        run(body())

@@ -1,0 +1,210 @@
+"""Dispatch selection + orchestration pipeline tests (parity model:
+reference tests/test_dispatch_selection.py — offline filter, delegate
+auto-disable, probe-concurrency bound, RR idle selection, min-queue
+fallback — and orchestration flow against mocked transports)."""
+
+import asyncio
+
+import pytest
+
+from comfyui_distributed_tpu.cluster import (
+    JobStore,
+    Orchestrator,
+    PromptQueue,
+    select_least_busy_host,
+)
+from comfyui_distributed_tpu.cluster import dispatch as dispatch_mod
+from comfyui_distributed_tpu.cluster import orchestration as orch_mod
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def hosts(n, **overrides):
+    return [
+        {"id": f"w{i}", "address": f"http://10.0.0.{i}:8288", "enabled": True,
+         **overrides}
+        for i in range(n)
+    ]
+
+
+class TestSelectActiveHosts:
+    def test_offline_filtered(self, monkeypatch):
+        async def fake_probe(host, timeout=None):
+            return {"queue_remaining": 0} if host["id"] != "w1" else None
+
+        monkeypatch.setattr(dispatch_mod, "probe_host", fake_probe)
+
+        async def body():
+            online, offline = await dispatch_mod.select_active_hosts(hosts(3))
+            assert [h["id"] for h in online] == ["w0", "w2"]
+            assert [h["id"] for h in offline] == ["w1"]
+            assert online[0]["_probe"] == {"queue_remaining": 0}
+        run(body())
+
+    def test_probe_concurrency_bounded(self, monkeypatch):
+        """At most N probes in flight (reference asserts the same bound,
+        tests/test_dispatch_selection.py:167)."""
+        active = 0
+        peak = 0
+
+        async def fake_probe(host, timeout=None):
+            nonlocal active, peak
+            active += 1
+            peak = max(peak, active)
+            await asyncio.sleep(0.02)
+            active -= 1
+            return {}
+
+        monkeypatch.setattr(dispatch_mod, "probe_host", fake_probe)
+
+        async def body():
+            await dispatch_mod.select_active_hosts(hosts(12), probe_concurrency=3)
+        run(body())
+        assert peak <= 3
+
+
+class TestLeastBusy:
+    def test_round_robin_among_idle(self):
+        hs = hosts(3)
+        for h in hs:
+            h["_probe"] = {"queue_remaining": 0}
+        picks = {select_least_busy_host(hs)["id"] for _ in range(6)}
+        assert picks == {"w0", "w1", "w2"}   # RR cycles through all idle
+
+    def test_min_queue_fallback(self):
+        hs = hosts(3)
+        for depth, h in zip([5, 2, 9], hs):
+            h["_probe"] = {"queue_remaining": depth}
+        assert select_least_busy_host(hs)["id"] == "w1"
+
+    def test_empty_returns_none(self):
+        assert select_least_busy_host([]) is None
+
+
+class SpyQueue(PromptQueue):
+    def __init__(self):
+        super().__init__()
+        self.enqueued = []
+
+    def enqueue(self, prompt, client_id="", trace_id=None):
+        self.enqueued.append(prompt)
+        return "p_test", []
+
+
+def distributed_prompt():
+    return {
+        "1": {"class_type": "PrimitiveInt", "inputs": {"value": 1}},
+        "2": {"class_type": "DistributedSeed", "inputs": {"seed": ["1", 0]}},
+        "3": {"class_type": "DistributedEmptyImage",
+              "inputs": {"height": 8, "width": 8}},
+        "4": {"class_type": "DistributedCollector", "inputs": {"images": ["3", 0]}},
+        "5": {"class_type": "SaveImage", "inputs": {"images": ["4", 0]}},
+    }
+
+
+class TestOrchestrator:
+    def _make(self, monkeypatch, cfg_hosts, probe_ok=None, dispatch_log=None):
+        store = JobStore()
+        queue = SpyQueue()
+        config = {
+            "master": {"host": "", "port": 8288},
+            "hosts": cfg_hosts,
+            "settings": {},
+        }
+        orch = Orchestrator(store, queue, config_loader=lambda: config)
+        probe_ok = probe_ok if probe_ok is not None else {h["id"] for h in cfg_hosts}
+
+        async def fake_probe(host, timeout=None):
+            return {"queue_remaining": 0} if host["id"] in probe_ok else None
+
+        async def fake_dispatch(host, prompt, client_id="", extra=None, trace_id=None):
+            if dispatch_log is not None:
+                dispatch_log.append((host["id"], prompt))
+            return {"prompt_id": f"remote_{host['id']}"}
+
+        monkeypatch.setattr(dispatch_mod, "probe_host", fake_probe)
+        monkeypatch.setattr(orch_mod, "dispatch_prompt", fake_dispatch)
+        return orch, store, queue
+
+    def test_full_fanout(self, monkeypatch):
+        sent = []
+        orch, store, queue = self._make(monkeypatch, hosts(2), dispatch_log=sent)
+
+        async def body():
+            return await orch.orchestrate(distributed_prompt(), client_id="c1")
+        res = run(body())
+        assert res.worker_count == 2
+        assert sorted(res.dispatched_to) == ["w0", "w1"]
+        # workers got pruned prompts with role overrides
+        for wid, wprompt in sent:
+            assert "5" not in wprompt                      # SaveImage pruned
+            assert wprompt["4"]["inputs"]["is_worker"] is True
+            assert wprompt["4"]["inputs"]["worker_id"] == wid
+            assert wprompt["4"]["inputs"]["multi_job_id"].endswith("_4")
+        # master prompt queued locally with master role
+        assert queue.enqueued[0]["4"]["inputs"]["is_worker"] is False
+        # collector job pre-created with both workers expected
+        jid = queue.enqueued[0]["4"]["inputs"]["multi_job_id"]
+        assert store.collector_jobs[jid].expected_workers == ("w0", "w1")
+
+    def test_offline_hosts_excluded(self, monkeypatch):
+        orch, store, queue = self._make(monkeypatch, hosts(3), probe_ok={"w1"})
+
+        async def body():
+            return await orch.orchestrate(distributed_prompt())
+        res = run(body())
+        assert res.dispatched_to == ["w1"]
+
+    def test_delegate_disabled_when_all_offline(self, monkeypatch):
+        orch, store, queue = self._make(monkeypatch, hosts(2), probe_ok=set())
+
+        async def body():
+            return await orch.orchestrate(distributed_prompt(), delegate_master=True)
+        res = run(body())
+        assert res.worker_count == 0
+        # master prompt kept its full graph (delegate disabled → it computes)
+        assert "3" in queue.enqueued[0]
+        assert queue.enqueued[0]["4"]["inputs"]["delegate_only"] is False
+
+    def test_delegate_master_prompt_prepared(self, monkeypatch):
+        orch, store, queue = self._make(monkeypatch, hosts(1))
+
+        async def body():
+            return await orch.orchestrate(distributed_prompt(), delegate_master=True)
+        res = run(body())
+        assert res.worker_count == 1
+        mp = queue.enqueued[0]
+        # producer branch cut, collector fed from injected empty image
+        assert mp["4"]["inputs"]["images"] == ["_delegate_empty", 0]
+        assert mp["4"]["inputs"]["delegate_only"] is True
+
+    def test_explicit_enabled_ids_subset(self, monkeypatch):
+        sent = []
+        orch, store, queue = self._make(monkeypatch, hosts(3), dispatch_log=sent)
+
+        async def body():
+            return await orch.orchestrate(distributed_prompt(), enabled_ids=["w2"])
+        res = run(body())
+        assert res.dispatched_to == ["w2"]
+
+    def test_dispatch_failure_shrinks_expected_workers(self, monkeypatch):
+        orch, store, queue = self._make(monkeypatch, hosts(2))
+
+        async def failing_dispatch(host, prompt, client_id="", extra=None,
+                                   trace_id=None):
+            from comfyui_distributed_tpu.utils.exceptions import WorkerError
+            if host["id"] == "w1":
+                raise WorkerError("boom", worker_id="w1")
+            return {}
+
+        monkeypatch.setattr(orch_mod, "dispatch_prompt", failing_dispatch)
+
+        async def body():
+            return await orch.orchestrate(distributed_prompt())
+        res = run(body())
+        assert res.dispatched_to == ["w0"]
+        jid = queue.enqueued[0]["4"]["inputs"]["multi_job_id"]
+        # collector no longer waits on the failed host
+        assert store.collector_jobs[jid].expected_workers == ("w0",)

@@ -1,0 +1,243 @@
+"""Job store + timeout/requeue tests (parity model: reference
+tests/test_job_timeout.py + job store behavior in tests/test_static_mode.py)."""
+
+import asyncio
+import time
+
+import pytest
+
+from comfyui_distributed_tpu.cluster import (
+    JobStore,
+    check_and_requeue_timed_out_workers,
+)
+from comfyui_distributed_tpu.utils.exceptions import JobQueueError
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+class TestCollectorJobs:
+    def test_prepare_then_put_then_done(self):
+        async def body():
+            store = JobStore()
+            job = await store.prepare_collector_job("j1", ("w1", "w2"))
+            await store.put_collector_result("j1", {"worker_id": "w1", "is_last": True})
+            assert not job.all_done()
+            await store.put_collector_result("j1", {"worker_id": "w2", "is_last": True})
+            assert job.all_done()
+            assert job.results.qsize() == 2
+        run(body())
+
+    def test_prepare_idempotent_updates_expected(self):
+        async def body():
+            store = JobStore()
+            await store.prepare_collector_job("j1")
+            job = await store.prepare_collector_job("j1", ("w1",))
+            assert job.expected_workers == ("w1",)
+            assert len(store.collector_jobs) == 1
+        run(body())
+
+    def test_put_waits_for_init_grace(self):
+        """Result arriving before job init is held until init (reference
+        api/job_routes.py:314-333 10 s grace)."""
+        async def body():
+            store = JobStore()
+
+            async def late_init():
+                await asyncio.sleep(0.15)
+                await store.prepare_collector_job("j1", ("w1",))
+
+            t = asyncio.ensure_future(late_init())
+            await store.put_collector_result(
+                "j1", {"worker_id": "w1", "is_last": True}, grace=2.0)
+            await t
+            job = await store.get_collector_job("j1")
+            assert job.results.qsize() == 1
+        run(body())
+
+    def test_put_times_out_without_init(self):
+        async def body():
+            store = JobStore()
+            with pytest.raises(JobQueueError):
+                await store.put_collector_result(
+                    "never", {"worker_id": "w"}, grace=0.2)
+        run(body())
+
+
+class TestTileJobs:
+    def test_init_chunks(self):
+        async def body():
+            store = JobStore()
+            job = await store.init_tile_job("t1", total_tasks=10, chunk=4)
+            assert job.total_tasks == 3
+            assert [(t.start, t.end) for t in job.pending] == [(0, 4), (4, 8), (8, 10)]
+        run(body())
+
+    def test_double_init_raises(self):
+        async def body():
+            store = JobStore()
+            await store.init_tile_job("t1", 4)
+            with pytest.raises(JobQueueError):
+                await store.init_tile_job("t1", 4)
+        run(body())
+
+    def test_pull_assignment_and_depletion(self):
+        async def body():
+            store = JobStore()
+            await store.init_tile_job("t1", 2)
+            a = await store.request_work("t1", "w1")
+            b = await store.request_work("t1", "w2")
+            assert (a["task_id"], b["task_id"]) == (0, 1)
+            assert a["estimated_remaining"] == 1
+            assert await store.request_work("t1", "w1") is None
+            job = store.tile_jobs["t1"]
+            assert job.assigned == {0: "w1", 1: "w2"}
+            assert "w1" in job.worker_status
+        run(body())
+
+    def test_request_unknown_job_returns_none(self):
+        async def body():
+            store = JobStore()
+            assert await store.request_work("zzz", "w1") is None
+        run(body())
+
+    def test_submit_and_duplicate_ignored(self):
+        async def body():
+            store = JobStore()
+            await store.init_tile_job("t1", 1)
+            await store.request_work("t1", "w1")
+            assert await store.submit_result("t1", "w1", 0, "payload")
+            assert not await store.submit_result("t1", "w1", 0, "payload2")
+            job = store.tile_jobs["t1"]
+            assert job.is_complete()
+            assert job.results.qsize() == 1
+        run(body())
+
+    def test_submit_unknown_job_raises(self):
+        async def body():
+            store = JobStore()
+            with pytest.raises(JobQueueError):
+                await store.submit_result("zzz", "w1", 0, None)
+        run(body())
+
+    def test_job_status_shapes(self):
+        async def body():
+            store = JobStore()
+            assert (await store.job_status("x"))["exists"] is False
+            await store.init_tile_job("t1", 3)
+            s = await store.job_status("t1")
+            assert s == {"exists": True, "kind": "tile", "mode": "static",
+                         "pending": 3, "completed": 0, "total": 3}
+            await store.prepare_collector_job("c1")
+            assert (await store.job_status("c1"))["kind"] == "collector"
+        run(body())
+
+    def test_requeue_preserves_task_ranges_and_front_position(self):
+        async def body():
+            store = JobStore()
+            await store.init_tile_job("t1", 6, chunk=2)
+            t0 = await store.request_work("t1", "w1")
+            await store.request_work("t1", "w2")
+            requeued = await store.requeue_worker_tasks("t1", "w1")
+            assert requeued == [t0["task_id"]]
+            job = store.tile_jobs["t1"]
+            # requeued task at the FRONT with its original range
+            assert job.pending[0].task_id == t0["task_id"]
+            assert (job.pending[0].start, job.pending[0].end) == (t0["start"], t0["end"])
+            assert "w1" not in job.worker_status
+        run(body())
+
+    def test_prune_stale(self):
+        async def body():
+            store = JobStore()
+            await store.init_tile_job("t1", 1)
+            store.tile_jobs["t1"].created_at = time.monotonic() - 7200
+            await store.prepare_collector_job("c1")
+            dropped = await store.prune_stale(max_age=3600)
+            assert dropped == ["t1"]
+            assert "c1" in store.collector_jobs
+        run(body())
+
+
+class TestTimeoutRequeue:
+    """Reference tests/test_job_timeout.py parity: requeue-only-incomplete,
+    busy-probe grace, completed-not-requeued."""
+
+    def _aged_store(self):
+        store = JobStore()
+
+        async def setup():
+            await store.init_tile_job("t1", 4)
+            await store.request_work("t1", "w1")   # task 0
+            await store.request_work("t1", "w2")   # task 1
+            await store.request_work("t1", "w1")   # task 2
+            await store.submit_result("t1", "w1", 2, "done")   # w1 completed 2
+            job = store.tile_jobs["t1"]
+            # age w1's heartbeat beyond timeout; keep w2 fresh.
+            # submit_result refreshed w1 — override directly:
+            job.worker_status["w1"] = time.monotonic() - 1000
+        return store, setup
+
+    def test_requeues_only_incomplete_of_timed_out(self):
+        store, setup = self._aged_store()
+
+        async def body():
+            await setup()
+            evicted = await check_and_requeue_timed_out_workers(
+                store, "t1", timeout=60)
+            assert evicted == {"w1": [0]}          # task 2 completed → not requeued
+            job = store.tile_jobs["t1"]
+            assert job.assigned == {1: "w2"}       # w2 untouched
+            assert job.pending[0].task_id == 0
+        run(body())
+
+    def test_busy_probe_grace_spares_worker(self):
+        store, setup = self._aged_store()
+
+        async def probe(worker_id):
+            return {"queue_remaining": 3}
+
+        async def body():
+            await setup()
+            evicted = await check_and_requeue_timed_out_workers(
+                store, "t1", timeout=60, probe_fn=probe)
+            assert evicted == {}
+            job = store.tile_jobs["t1"]
+            assert job.assigned.get(0) == "w1"     # still assigned
+            # heartbeat refreshed → not a suspect next round
+            assert time.monotonic() - job.worker_status["w1"] < 10
+        run(body())
+
+    def test_idle_probe_does_not_spare(self):
+        store, setup = self._aged_store()
+
+        async def probe(worker_id):
+            return {"queue_remaining": 0}
+
+        async def body():
+            await setup()
+            evicted = await check_and_requeue_timed_out_workers(
+                store, "t1", timeout=60, probe_fn=probe)
+            assert evicted == {"w1": [0]}
+        run(body())
+
+    def test_no_suspects_when_nothing_assigned(self):
+        async def body():
+            store = JobStore()
+            await store.init_tile_job("t1", 2)
+            await store.request_work("t1", "w1")
+            r = await store.submit_result("t1", "w1", 0, "x")
+            assert r
+            store.tile_jobs["t1"].worker_status["w1"] = time.monotonic() - 1000
+            # w1 has no incomplete assigned tasks → not a suspect
+            evicted = await check_and_requeue_timed_out_workers(
+                store, "t1", timeout=60)
+            assert evicted == {}
+        run(body())
+
+    def test_unknown_job_noop(self):
+        async def body():
+            assert await check_and_requeue_timed_out_workers(
+                JobStore(), "zzz", timeout=1) == {}
+        run(body())
