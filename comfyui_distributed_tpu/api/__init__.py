@@ -1,0 +1,10 @@
+"""HTTP control plane (reference L5: ``api/*_routes.py``).
+
+The same app serves master and worker roles — "worker endpoints" are simply
+called by the other side (reference §2.6). Tensor traffic never rides these
+routes on-pod; they carry orchestration, results crossing hosts, config,
+health, and logs.
+"""
+
+from .app import create_app  # noqa: F401
+from .queue_request import QueueRequestPayload, parse_queue_request_payload  # noqa: F401

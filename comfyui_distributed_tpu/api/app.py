@@ -1,0 +1,207 @@
+"""aiohttp application wiring all /distributed/* routes.
+
+Route table parity: reference §2.6 (SURVEY). Handlers live in this module
+tree; every handler returns JSON; errors use the standardized payload
+(reference ``utils/network.py:35-44``).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from pathlib import Path
+
+from aiohttp import web
+
+from ..cluster.controller import Controller
+from ..cluster.job_timeout import check_and_requeue_timed_out_workers
+from ..utils import constants
+from ..utils.exceptions import DistributedError, ValidationError
+from ..utils.logging import log
+from . import config_routes, info_routes, usdu_routes
+from .queue_request import parse_queue_request_payload
+
+
+def json_error(message: str, status: int = 400) -> web.Response:
+    return web.json_response({"error": message, "status": status}, status=status)
+
+
+async def _json_body(request: web.Request) -> dict:
+    try:
+        return await request.json()
+    except (json.JSONDecodeError, UnicodeDecodeError):
+        raise ValidationError("body must be valid JSON")
+
+
+def create_app(controller: Controller) -> web.Application:
+    app = web.Application(client_max_size=constants.MAX_PAYLOAD_SIZE)
+    app["controller"] = controller
+
+    async def on_startup(app):
+        await controller.startup()
+
+    async def on_cleanup(app):
+        await controller.shutdown()
+
+    app.on_startup.append(on_startup)
+    app.on_cleanup.append(on_cleanup)
+
+    @web.middleware
+    async def error_middleware(request, handler):
+        try:
+            return await handler(request)
+        except ValidationError as e:
+            return json_error(str(e), 400)
+        except DistributedError as e:
+            return json_error(str(e), 500)
+
+    app.middlewares.append(error_middleware)
+
+    r = app.router
+
+    # --- health + ComfyUI-compatible probe surface -------------------------
+    async def health(request):
+        return web.json_response(controller.health())
+
+    async def prompt_get(request):
+        # reference probes workers with GET /prompt (utils/network.py:108-136)
+        return web.json_response(
+            {"exec_info": {"queue_remaining": controller.queue.queue_remaining}}
+        )
+
+    async def prompt_post(request):
+        body = await _json_body(request)
+        prompt = body.get("prompt")
+        if not isinstance(prompt, dict) or not prompt:
+            raise ValidationError("'prompt' must be a non-empty object")
+        prompt_id, errors = controller.queue.enqueue(
+            prompt, body.get("client_id", ""), body.get("trace_id"))
+        if errors:
+            return web.json_response({"error": "validation failed",
+                                      "node_errors": errors}, status=400)
+        return web.json_response({"prompt_id": prompt_id, "node_errors": {}})
+
+    r.add_get("/distributed/health", health)
+    r.add_get("/prompt", prompt_get)
+    r.add_post("/prompt", prompt_post)
+
+    # --- public queue API (reference api/job_routes.py:206-236) ------------
+    async def distributed_queue(request):
+        payload = parse_queue_request_payload(await _json_body(request))
+        result = await controller.orchestrator.orchestrate(
+            payload.prompt,
+            client_id=payload.client_id,
+            enabled_ids=payload.enabled_worker_ids,
+            delegate_master=payload.delegate_master,
+            load_balance=payload.load_balance,
+            trace_id=payload.trace_id,
+        )
+        return web.json_response({
+            "prompt_id": result.prompt_id,
+            "number": 0,
+            "node_errors": result.node_errors,
+            "worker_count": result.worker_count,
+            "trace_id": result.trace_id,
+        })
+
+    r.add_post("/distributed/queue", distributed_queue)
+
+    # --- collector ingest (reference api/job_routes.py:273-343) ------------
+    async def job_complete(request):
+        body = await _json_body(request)
+        for field in ("job_id", "worker_id"):
+            if not isinstance(body.get(field), str) or not body[field]:
+                raise ValidationError(f"missing or invalid {field!r}", field=field)
+        if "is_last" not in body:
+            raise ValidationError("missing 'is_last'", field="is_last")
+        await controller.store.put_collector_result(body["job_id"], body)
+        return web.json_response({"status": "received"})
+
+    async def prepare_job(request):
+        body = await _json_body(request)
+        job_id = body.get("job_id")
+        if not isinstance(job_id, str) or not job_id:
+            raise ValidationError("missing 'job_id'", field="job_id")
+        await controller.store.prepare_collector_job(
+            job_id, tuple(body.get("expected_workers", ())))
+        return web.json_response({"status": "prepared"})
+
+    async def clear_memory(request):
+        return web.json_response(controller.clear_memory())
+
+    r.add_post("/distributed/job_complete", job_complete)
+    r.add_post("/distributed/prepare_job", prepare_job)
+    r.add_post("/distributed/clear_memory", clear_memory)
+
+    # --- media sync (reference api/job_routes.py:238-270 + /upload/image) --
+    def _safe_media_path(rel: str) -> Path:
+        import os
+
+        base = Path(os.environ.get("CDT_INPUT_DIR", "input")).resolve()
+        p = (base / rel).resolve()
+        if not str(p).startswith(str(base)):
+            raise ValidationError("path escapes input directory", field="path")
+        return p
+
+    async def check_file(request):
+        body = await _json_body(request)
+        rel = body.get("path")
+        if not isinstance(rel, str) or not rel:
+            raise ValidationError("missing 'path'", field="path")
+        p = _safe_media_path(rel)
+        if not p.is_file():
+            return web.json_response({"exists": False})
+        md5 = hashlib.md5(p.read_bytes()).hexdigest()
+        matches = body.get("md5") is None or body["md5"] == md5
+        return web.json_response({"exists": True, "md5": md5, "matches": matches})
+
+    async def load_image(request):
+        import base64
+
+        body = await _json_body(request)
+        rel = body.get("path")
+        if not isinstance(rel, str) or not rel:
+            raise ValidationError("missing 'path'", field="path")
+        p = _safe_media_path(rel)
+        if not p.is_file():
+            return json_error(f"file not found: {rel}", 404)
+        raw = p.read_bytes()
+        return web.json_response({
+            "image": "data:image/png;base64," + base64.b64encode(raw).decode(),
+            "md5": hashlib.md5(raw).hexdigest(),
+        })
+
+    async def upload_image(request):
+        reader = await request.multipart()
+        saved = []
+        async for part in reader:
+            if part.name != "image":
+                continue
+            rel = part.filename or "upload.png"
+            p = _safe_media_path(rel)
+            p.parent.mkdir(parents=True, exist_ok=True)
+            p.write_bytes(await part.read())
+            saved.append(rel)
+        return web.json_response({"saved": saved})
+
+    r.add_post("/distributed/check_file", check_file)
+    r.add_post("/distributed/load_image", load_image)
+    r.add_post("/upload/image", upload_image)
+
+    usdu_routes.register(r, controller)
+    config_routes.register(r, controller)
+    info_routes.register(r, controller)
+    return app
+
+
+async def run_app(controller: Controller, host: str = "0.0.0.0",
+                  port: int | None = None) -> web.AppRunner:
+    app = create_app(controller)
+    cfg = controller.load_config()
+    port = port or cfg.get("master", {}).get("port", 8288)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, host, port)
+    await site.start()
+    log(f"control plane listening on {host}:{port}")
+    return runner

@@ -1,0 +1,121 @@
+"""Config CRUD routes (parity: reference ``api/config_routes.py:33-277`` —
+schema-validated updates under the config transaction)."""
+
+from __future__ import annotations
+
+import json
+
+from aiohttp import web
+
+from ..utils.config import config_transaction, normalize_host
+from ..utils.exceptions import ValidationError
+from .schemas import require_fields, validate_worker_id
+
+# Declarative setting schema: name → (type, validator) (reference :33-46)
+SETTING_SCHEMA: dict[str, type] = {
+    "debug": bool,
+    "auto_launch_workers": bool,
+    "stop_workers_on_master_exit": bool,
+    "master_delegate_only": bool,
+    "worker_timeout_seconds": (int, float),
+    "worker_probe_concurrency": int,
+    "worker_prep_concurrency": int,
+    "media_sync_concurrency": int,
+    "media_sync_timeout_seconds": (int, float),
+}
+
+HOST_FIELDS = {"id", "name", "address", "enabled", "type", "mesh_devices",
+               "extra_args"}
+
+
+def register(router, controller) -> None:
+    async def _json(request):
+        try:
+            return await request.json()
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            raise ValidationError("body must be valid JSON")
+
+    async def get_config(request):
+        return web.json_response(controller.load_config())
+
+    async def update_worker(request):
+        body = await _json(request)
+        require_fields(body, "id")
+        wid = validate_worker_id(body["id"])
+        unknown = set(body) - HOST_FIELDS
+        if unknown:
+            raise ValidationError(f"unknown host fields {sorted(unknown)}")
+        if "type" in body and body["type"] not in ("local", "remote", "cloud"):
+            raise ValidationError(f"invalid host type {body['type']!r}", field="type")
+        async with config_transaction(controller.config_path) as cfg:
+            hosts = cfg.setdefault("hosts", [])
+            for h in hosts:
+                if h.get("id") == wid:
+                    h.update(body)
+                    break
+            else:
+                hosts.append(normalize_host(body))
+        return web.json_response({"status": "ok"})
+
+    async def delete_worker(request):
+        body = await _json(request)
+        require_fields(body, "id")
+        wid = body["id"]
+        async with config_transaction(controller.config_path) as cfg:
+            before = len(cfg.get("hosts", []))
+            cfg["hosts"] = [h for h in cfg.get("hosts", []) if h.get("id") != wid]
+            removed = before - len(cfg["hosts"])
+        if not removed:
+            return web.json_response({"error": f"no host {wid!r}"}, status=404)
+        return web.json_response({"status": "ok"})
+
+    async def update_setting(request):
+        body = await _json(request)
+        require_fields(body, "key")
+        key = body["key"]
+        if key not in SETTING_SCHEMA:
+            raise ValidationError(f"unknown setting {key!r}", field="key")
+        expected = SETTING_SCHEMA[key]
+        value = body.get("value")
+        if not isinstance(value, expected) or isinstance(value, bool) and expected is not bool:
+            raise ValidationError(
+                f"setting {key!r} expects {expected}", field="value")
+        async with config_transaction(controller.config_path) as cfg:
+            cfg.setdefault("settings", {})[key] = value
+        return web.json_response({"status": "ok"})
+
+    async def update_master(request):
+        body = await _json(request)
+        allowed = {"host", "port", "delegate_only"}
+        unknown = set(body) - allowed
+        if unknown:
+            raise ValidationError(f"unknown master fields {sorted(unknown)}")
+        async with config_transaction(controller.config_path) as cfg:
+            cfg.setdefault("master", {}).update(body)
+        return web.json_response({"status": "ok"})
+
+    async def update_mesh(request):
+        """TPU-specific: declare topology (no reference analogue — the
+        reference pins CUDA devices per worker instead)."""
+        body = await _json(request)
+        shape = body.get("shape")
+        if not isinstance(shape, dict) or not shape:
+            raise ValidationError("'shape' must be a non-empty object", field="shape")
+        from ..parallel.mesh import MeshSpec
+        from ..utils.exceptions import ShardingError
+
+        try:
+            MeshSpec.from_mapping(shape)   # validates axis sizes
+        except ShardingError as e:
+            raise ValidationError(str(e), field="shape")
+        async with config_transaction(controller.config_path) as cfg:
+            cfg.setdefault("mesh", {})["shape"] = shape
+        controller._mesh = None        # rebuild lazily with the new shape
+        return web.json_response({"status": "ok"})
+
+    router.add_get("/distributed/config", get_config)
+    router.add_post("/distributed/config/update_worker", update_worker)
+    router.add_post("/distributed/config/delete_worker", delete_worker)
+    router.add_post("/distributed/config/update_setting", update_setting)
+    router.add_post("/distributed/config/update_master", update_master)
+    router.add_post("/distributed/config/update_mesh", update_mesh)

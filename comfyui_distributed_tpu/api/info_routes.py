@@ -1,0 +1,89 @@
+"""System/network info + log routes (parity: reference
+``api/worker_routes.py:142-234,292-390,393-430``)."""
+
+from __future__ import annotations
+
+import socket
+from pathlib import Path
+
+from aiohttp import web
+
+from ..utils.exceptions import ValidationError
+
+
+def _list_interfaces() -> list[dict]:
+    """Best-effort NIC enumeration (reference enumerates NICs to recommend
+    a private IP, ``api/worker_routes.py:142-234``)."""
+    interfaces = []
+    try:
+        hostname = socket.gethostname()
+        for info in socket.getaddrinfo(hostname, None, socket.AF_INET):
+            ip = info[4][0]
+            if ip not in (i["ip"] for i in interfaces):
+                interfaces.append({"name": hostname, "ip": ip})
+    except OSError:
+        pass
+    # always include loopback + best-effort outbound IP
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        s.connect(("10.255.255.255", 1))
+        ip = s.getsockname()[0]
+        s.close()
+        if ip not in (i["ip"] for i in interfaces):
+            interfaces.append({"name": "outbound", "ip": ip})
+    except OSError:
+        pass
+    if not any(i["ip"] == "127.0.0.1" for i in interfaces):
+        interfaces.append({"name": "lo", "ip": "127.0.0.1"})
+    return interfaces
+
+
+def _recommend_ip(interfaces: list[dict]) -> str:
+    for i in interfaces:
+        ip = i["ip"]
+        if ip.startswith(("10.", "192.168.")) or ip.startswith("172."):
+            return ip
+    return interfaces[0]["ip"] if interfaces else "127.0.0.1"
+
+
+def tail_file(path: Path, max_bytes: int = 64 * 1024) -> str:
+    """Efficient reverse chunk read (reference
+    ``api/worker_routes.py:292-325``)."""
+    size = path.stat().st_size
+    with open(path, "rb") as f:
+        if size > max_bytes:
+            f.seek(size - max_bytes)
+        data = f.read()
+    text = data.decode("utf-8", errors="replace")
+    if size > max_bytes and "\n" in text:
+        text = text.split("\n", 1)[1]     # drop the partial first line
+    return text
+
+
+def register(router, controller) -> None:
+    async def system_info(request):
+        return web.json_response(controller.system_info())
+
+    async def network_info(request):
+        interfaces = _list_interfaces()
+        return web.json_response({
+            "interfaces": interfaces,
+            "recommended_ip": _recommend_ip(interfaces),
+            "devices": controller.system_info()["devices"],
+        })
+
+    async def local_log(request):
+        """Tail this controller's log file (reference serves an in-memory
+        buffer, ``api/worker_routes.py:348-390``; we tail the file the
+        launcher assigns via CDT_LOG_FILE)."""
+        import os
+
+        log_file = os.environ.get("CDT_LOG_FILE", "")
+        if not log_file or not Path(log_file).is_file():
+            return web.json_response({"log": "", "available": False})
+        return web.json_response(
+            {"log": tail_file(Path(log_file)), "available": True})
+
+    router.add_get("/distributed/system_info", system_info)
+    router.add_get("/distributed/network_info", network_info)
+    router.add_get("/distributed/local_log", local_log)

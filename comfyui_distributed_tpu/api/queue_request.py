@@ -1,0 +1,61 @@
+"""``POST /distributed/queue`` payload parsing.
+
+Parity: reference ``api/queue_request.py:16-79`` — frozen dataclass,
+``workers`` accepted as a legacy alias of ``enabled_worker_ids``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+from ..utils.exceptions import ValidationError
+
+
+@dataclasses.dataclass(frozen=True)
+class QueueRequestPayload:
+    prompt: dict
+    client_id: str = ""
+    enabled_worker_ids: Optional[tuple[str, ...]] = None
+    delegate_master: Optional[bool] = None
+    load_balance: bool = False
+    trace_id: Optional[str] = None
+
+
+def parse_queue_request_payload(payload: Any) -> QueueRequestPayload:
+    if not isinstance(payload, dict):
+        raise ValidationError("payload must be a JSON object")
+    prompt = payload.get("prompt")
+    if not isinstance(prompt, dict) or not prompt:
+        raise ValidationError("'prompt' must be a non-empty object", field="prompt")
+
+    ids = payload.get("enabled_worker_ids")
+    if ids is None:
+        ids = payload.get("workers")       # legacy alias
+    if ids is not None:
+        if not isinstance(ids, (list, tuple)) or not all(
+            isinstance(i, str) for i in ids
+        ):
+            raise ValidationError(
+                "'enabled_worker_ids' must be a list of strings",
+                field="enabled_worker_ids",
+            )
+        ids = tuple(ids)
+
+    delegate = payload.get("delegate_master")
+    if delegate is not None and not isinstance(delegate, bool):
+        raise ValidationError("'delegate_master' must be a boolean",
+                              field="delegate_master")
+
+    client_id = payload.get("client_id", "")
+    if not isinstance(client_id, str):
+        raise ValidationError("'client_id' must be a string", field="client_id")
+
+    return QueueRequestPayload(
+        prompt=prompt,
+        client_id=client_id,
+        enabled_worker_ids=ids,
+        delegate_master=delegate,
+        load_balance=bool(payload.get("load_balance", False)),
+        trace_id=payload.get("trace_id") or None,
+    )

@@ -1,0 +1,41 @@
+"""Field validation helpers (parity: reference ``api/schemas.py:1-54``)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..utils.exceptions import ValidationError
+
+
+def require_fields(payload: dict, *fields: str) -> None:
+    if not isinstance(payload, dict):
+        raise ValidationError("payload must be a JSON object")
+    for f in fields:
+        if f not in payload or payload[f] in (None, ""):
+            raise ValidationError(f"missing required field {f!r}", field=f)
+
+
+def validate_worker_id(value: Any) -> str:
+    if not isinstance(value, str) or not value or len(value) > 128:
+        raise ValidationError(f"invalid worker id {value!r}", field="worker_id")
+    return value
+
+
+def parse_positive_int(value: Any, field: str) -> int:
+    try:
+        out = int(value)
+    except (TypeError, ValueError):
+        raise ValidationError(f"{field} must be an integer", field=field)
+    if out < 0:
+        raise ValidationError(f"{field} must be non-negative", field=field)
+    return out
+
+
+def parse_positive_float(value: Any, field: str) -> float:
+    try:
+        out = float(value)
+    except (TypeError, ValueError):
+        raise ValidationError(f"{field} must be a number", field=field)
+    if out < 0:
+        raise ValidationError(f"{field} must be non-negative", field=field)
+    return out

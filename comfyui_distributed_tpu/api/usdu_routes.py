@@ -1,0 +1,111 @@
+"""Tile-engine routes (parity: reference ``api/usdu_routes.py``).
+
+Heartbeats, pull-based work assignment, tile/image result ingest. Payload
+shapes follow the reference: multipart ``tiles_metadata`` JSON +
+``tile_<i>`` PNG fields for ``submit_tiles`` (``payload_parsers.py:7-64``),
+plain JSON elsewhere.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+from aiohttp import web
+
+from ..utils import constants
+from ..utils.exceptions import ValidationError
+from ..utils.image import decode_png
+from .schemas import parse_positive_int, require_fields, validate_worker_id
+
+
+def register(router, controller) -> None:
+    store = controller.store
+
+    async def _json(request):
+        try:
+            return await request.json()
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            raise ValidationError("body must be valid JSON")
+
+    async def heartbeat(request):
+        body = await _json(request)
+        require_fields(body, "job_id", "worker_id")
+        ok = await store.heartbeat(body["job_id"], validate_worker_id(body["worker_id"]))
+        return web.json_response({"status": "ok" if ok else "unknown_job"})
+
+    async def request_image(request):
+        """Pull-based assignment for both modes
+        (reference ``api/usdu_routes.py:168-215``)."""
+        body = await _json(request)
+        require_fields(body, "job_id", "worker_id")
+        task = await store.request_work(
+            body["job_id"], validate_worker_id(body["worker_id"]))
+        return web.json_response({"task": task})
+
+    async def submit_tiles(request):
+        """Chunked multipart tile ingest with payload cap
+        (reference ``api/usdu_routes.py:40-165``, 50 MB cap)."""
+        if request.content_length and request.content_length > constants.MAX_PAYLOAD_SIZE:
+            return web.json_response(
+                {"error": "payload too large"}, status=413)
+        reader = await request.multipart()
+        metadata = None
+        tiles: dict[str, np.ndarray] = {}
+        async for part in reader:
+            if part.name == "tiles_metadata":
+                try:
+                    metadata = json.loads(await part.text())
+                except json.JSONDecodeError:
+                    raise ValidationError("tiles_metadata must be valid JSON")
+            elif part.name and part.name.startswith("tile_"):
+                tiles[part.name] = decode_png(await part.read())
+        if metadata is None:
+            raise ValidationError("missing tiles_metadata part")
+        require_fields(metadata, "job_id", "worker_id")
+        worker_id = validate_worker_id(metadata["worker_id"])
+        entries = metadata.get("tiles", [])
+        accepted = 0
+        for entry in entries:
+            task_id = parse_positive_int(entry.get("task_id"), "task_id")
+            key = entry.get("part", f"tile_{task_id}")
+            if key not in tiles:
+                raise ValidationError(f"missing PNG part {key!r}")
+            payload = {"image": tiles[key], **{
+                k: v for k, v in entry.items() if k not in ("part",)
+            }}
+            if await store.submit_result(metadata["job_id"], worker_id,
+                                         task_id, payload):
+                accepted += 1
+        return web.json_response({"status": "ok", "accepted": accepted})
+
+    async def submit_image(request):
+        """Full-image result (dynamic mode; reference
+        ``worker_comms.py:190-228``)."""
+        body = await _json(request)
+        require_fields(body, "job_id", "worker_id")
+        task_id = parse_positive_int(body.get("task_id"), "task_id")
+        from ..utils.image import decode_image_b64
+
+        payload = {"image": decode_image_b64(body.get("image", ""))}
+        ok = await store.submit_result(
+            body["job_id"], validate_worker_id(body["worker_id"]), task_id, payload)
+        return web.json_response({"status": "ok", "accepted": int(ok)})
+
+    async def job_status(request):
+        job_id = request.query.get("job_id", "")
+        if not job_id:
+            raise ValidationError("missing job_id query param", field="job_id")
+        return web.json_response(await store.job_status(job_id))
+
+    async def queue_status(request):
+        job_id = request.match_info["job_id"]
+        status = await store.job_status(job_id)
+        return web.json_response(status)
+
+    router.add_post("/distributed/heartbeat", heartbeat)
+    router.add_post("/distributed/request_image", request_image)
+    router.add_post("/distributed/submit_tiles", submit_tiles)
+    router.add_post("/distributed/submit_image", submit_image)
+    router.add_get("/distributed/job_status", job_status)
+    router.add_get("/distributed/queue_status/{job_id}", queue_status)

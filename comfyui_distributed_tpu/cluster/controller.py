@@ -1,0 +1,151 @@
+"""The host controller: one per host, owning that host's chips.
+
+Reference analogue: one ComfyUI instance (master or worker —
+``distributed.py:1-51``). Role is determined by ``is_worker`` (env
+``CDT_IS_WORKER``, parity with ``COMFYUI_IS_WORKER``, ``distributed.py:48``):
+masters orchestrate and collect; workers execute dispatched prompts and
+push results back. Both run the same code and the same HTTP app.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import platform
+import uuid
+from pathlib import Path
+from typing import Any, Optional
+
+from ..utils import constants
+from ..utils.config import ensure_config_exists, load_config
+from ..utils.logging import log
+from .collector_bridge import CollectorBridge
+from .job_store import JobStore
+from .orchestration import Orchestrator
+from .runtime import PromptQueue
+
+IS_WORKER_ENV = "CDT_IS_WORKER"
+
+
+def machine_id() -> str:
+    """Stable machine identity for local/remote classification (reference
+    ``workers/detection.py:49-62`` uses MAC/hostname the same way)."""
+    return f"{platform.node()}-{uuid.getnode():012x}"
+
+
+class Controller:
+    def __init__(self, config_path: Optional[Path] = None,
+                 mesh_devices: Optional[int] = None):
+        ensure_config_exists(config_path)
+        self.config_path = config_path
+        self.is_worker = os.environ.get(IS_WORKER_ENV, "") not in ("", "0")
+        self.store = JobStore()
+        self.queue = PromptQueue(context_factory=self._execution_context)
+        self.orchestrator = Orchestrator(self.store, self.queue,
+                                         config_loader=self.load_config)
+        self.loop: Optional[asyncio.AbstractEventLoop] = None
+        self.bridge: Optional[CollectorBridge] = None
+        self._mesh = None
+        self._mesh_devices = mesh_devices
+        self._registry = None
+        self.worker_id = os.environ.get("CDT_WORKER_ID", "")
+        self.worker_index = int(os.environ.get("CDT_WORKER_INDEX", "0") or 0)
+
+    def load_config(self) -> dict:
+        return load_config(self.config_path)
+
+    # --- lazily-built heavyweight state ------------------------------------
+
+    @property
+    def mesh(self):
+        if self._mesh is None:
+            import jax
+
+            from ..parallel.mesh import mesh_from_config, build_mesh
+
+            if self._mesh_devices:
+                self._mesh = build_mesh(
+                    {"dp": self._mesh_devices}, jax.devices()[: self._mesh_devices])
+            else:
+                self._mesh = mesh_from_config(self.load_config())
+        return self._mesh
+
+    @property
+    def model_registry(self):
+        if self._registry is None:
+            from ..models.registry import ModelRegistry
+
+            root = os.environ.get("CDT_CHECKPOINT_ROOT")
+            self._registry = ModelRegistry(Path(root) if root else None)
+        return self._registry
+
+    def _execution_context(self) -> dict[str, Any]:
+        ctx: dict[str, Any] = {
+            "mesh": self.mesh,
+            "model_registry": self.model_registry,
+            "output_dir": os.environ.get("CDT_OUTPUT_DIR", "output"),
+            "input_dir": os.environ.get("CDT_INPUT_DIR", "input"),
+            "job_store": self.store,
+            "is_worker": self.is_worker,
+            "worker_id": self.worker_id,
+            "worker_index": self.worker_index,
+        }
+        if self.bridge is not None:
+            ctx["collector_bridge"] = self.bridge
+        return ctx
+
+    # --- lifecycle ----------------------------------------------------------
+
+    async def startup(self) -> None:
+        self.loop = asyncio.get_running_loop()
+        self.bridge = CollectorBridge(self.store, self.loop)
+        self.queue.start()
+        role = "worker" if self.is_worker else "master"
+        log(f"controller up as {role} (machine {machine_id()})")
+
+    async def shutdown(self) -> None:
+        from ..utils.network import close_client_session
+
+        await self.queue.stop()
+        await close_client_session()
+
+    # --- health -------------------------------------------------------------
+
+    def health(self) -> dict:
+        return {
+            "status": "ok",
+            "role": "worker" if self.is_worker else "master",
+            "queue_remaining": self.queue.queue_remaining,
+            "executing": self.queue.executing,
+            "machine_id": machine_id(),
+        }
+
+    def system_info(self) -> dict:
+        """Parity: ``/distributed/system_info``
+        (``api/worker_routes.py:393-430``) with TPU topology instead of a
+        CUDA census."""
+        from ..parallel.mesh import device_census
+
+        return {
+            "machine_id": machine_id(),
+            "platform": platform.system().lower(),
+            "path_separator": os.sep,
+            "python": platform.python_version(),
+            "is_docker": Path("/.dockerenv").exists(),
+            "devices": device_census(),
+        }
+
+    def clear_memory(self) -> dict:
+        """Parity: ``/distributed/clear_memory`` (``api/job_routes.py:160-203``)
+        — unload models + drop compiled programs. TPU equivalent: clear the
+        model registry cache, JAX compilation caches, and live device
+        buffers owned by caches."""
+        import gc
+
+        import jax
+
+        self._registry = None
+        self._mesh = None
+        jax.clear_caches()
+        gc.collect()
+        return {"status": "cleared"}
