@@ -1,0 +1,84 @@
+"""CLI: ``python -m comfyui_distributed_tpu serve|info|bench``.
+
+The reference's entry is ComfyUI's ``main.py`` with plugin loading
+(``__init__.py:1-29``); standalone, the controller boots directly.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import sys
+
+
+def cmd_serve(args: argparse.Namespace) -> None:
+    from .api.app import run_app
+    from .cluster.controller import Controller
+    from .utils.logging import log
+    from .workers.process_manager import delayed_auto_launch, get_worker_manager
+
+    controller = Controller()
+
+    async def main() -> None:
+        runner = await run_app(controller, host=args.host, port=args.port)
+        if not controller.is_worker:
+            manager = get_worker_manager()
+            asyncio.ensure_future(delayed_auto_launch(manager))
+
+            import atexit
+
+            atexit.register(manager.cleanup_all)
+        stop = asyncio.Event()
+
+        import signal
+
+        loop = asyncio.get_running_loop()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            try:
+                loop.add_signal_handler(sig, stop.set)
+            except NotImplementedError:  # pragma: no cover - windows
+                pass
+        await stop.wait()
+        log("shutting down")
+        await runner.cleanup()
+
+    asyncio.run(main())
+
+
+def cmd_info(args: argparse.Namespace) -> None:
+    from .cluster.controller import Controller
+
+    controller = Controller()
+    print(json.dumps(controller.system_info(), indent=2, default=str))
+
+
+def cmd_bench(args: argparse.Namespace) -> None:
+    import runpy
+    from pathlib import Path
+
+    bench = Path(__file__).resolve().parent.parent / "bench.py"
+    runpy.run_path(str(bench), run_name="__main__")
+
+
+def main(argv: list[str] | None = None) -> None:
+    p = argparse.ArgumentParser(prog="comfyui_distributed_tpu")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    serve = sub.add_parser("serve", help="run a host controller")
+    serve.add_argument("--host", default="0.0.0.0")
+    serve.add_argument("--port", type=int, default=None)
+    serve.set_defaults(fn=cmd_serve)
+
+    info = sub.add_parser("info", help="print system/device info")
+    info.set_defaults(fn=cmd_info)
+
+    bench = sub.add_parser("bench", help="run the throughput benchmark")
+    bench.set_defaults(fn=cmd_bench)
+
+    args = p.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -18,7 +18,7 @@ from ..cluster.job_timeout import check_and_requeue_timed_out_workers
 from ..utils import constants
 from ..utils.exceptions import DistributedError, ValidationError
 from ..utils.logging import log
-from . import config_routes, info_routes, usdu_routes
+from . import config_routes, info_routes, usdu_routes, worker_routes
 from .queue_request import parse_queue_request_payload
 
 
@@ -191,6 +191,7 @@ def create_app(controller: Controller) -> web.Application:
     usdu_routes.register(r, controller)
     config_routes.register(r, controller)
     info_routes.register(r, controller)
+    worker_routes.register(r, controller)
     return app
 
 

@@ -1,0 +1,69 @@
+"""Worker process-management routes (parity: reference
+``api/worker_routes.py:432-695`` — launch/stop/list + log tailing)."""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from pathlib import Path
+
+from aiohttp import web
+
+from ..utils.exceptions import ProcessError, ValidationError
+from ..workers.process_manager import get_worker_manager
+from .info_routes import tail_file
+from .schemas import require_fields, validate_worker_id
+
+
+def register(router, controller) -> None:
+    async def _json(request):
+        try:
+            return await request.json()
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            raise ValidationError("body must be valid JSON")
+
+    def manager():
+        return get_worker_manager(controller.config_path)
+
+    async def launch_worker(request):
+        body = await _json(request)
+        require_fields(body, "worker_id")
+        wid = validate_worker_id(body["worker_id"])
+        loop = asyncio.get_running_loop()
+        try:
+            mp = await loop.run_in_executor(None, manager().launch_worker, wid)
+        except ProcessError as e:
+            status = 404 if "no configured host" in str(e) else 409
+            return web.json_response({"error": str(e)}, status=status)
+        return web.json_response({"status": "launched", "pid": mp.pid,
+                                  "log": str(mp.log_path)})
+
+    async def stop_worker(request):
+        body = await _json(request)
+        require_fields(body, "worker_id")
+        wid = validate_worker_id(body["worker_id"])
+        loop = asyncio.get_running_loop()
+        stopped = await loop.run_in_executor(None, manager().stop_worker, wid)
+        if not stopped:
+            return web.json_response(
+                {"error": f"no managed worker {wid!r}"}, status=404)
+        return web.json_response({"status": "stopped"})
+
+    async def managed_workers(request):
+        return web.json_response({"workers": manager().get_managed_workers()})
+
+    async def worker_log(request):
+        wid = request.match_info["worker_id"]
+        info = manager().get_managed_workers().get(wid)
+        if info is None or not info.get("log"):
+            return web.json_response(
+                {"error": f"no log for worker {wid!r}"}, status=404)
+        path = Path(info["log"])
+        if not path.is_file():
+            return web.json_response({"log": "", "available": False})
+        return web.json_response({"log": tail_file(path), "available": True})
+
+    router.add_post("/distributed/launch_worker", launch_worker)
+    router.add_post("/distributed/stop_worker", stop_worker)
+    router.add_get("/distributed/managed_workers", managed_workers)
+    router.add_get("/distributed/worker_log/{worker_id}", worker_log)

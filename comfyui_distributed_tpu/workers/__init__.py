@@ -1,0 +1,11 @@
+"""Host-controller process management (reference L1: ``workers/``).
+
+The reference spawns one ComfyUI process per GPU pinned via
+``CUDA_VISIBLE_DEVICES`` (``workers/process/lifecycle.py:32-36``). Here a
+managed process is a *host controller* serving the control plane on a port,
+optionally restricted to a subset of local chips (``CDT_MESH_DEVICES``) —
+on-pod chips don't need processes, but local multi-controller setups (one
+controller per pod slice) and dev/test clusters do.
+"""
+
+from .process_manager import WorkerProcessManager, get_worker_manager  # noqa: F401

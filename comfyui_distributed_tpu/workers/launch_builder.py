@@ -1,0 +1,73 @@
+"""Build the argv + env for a managed host controller.
+
+Parity: reference ``workers/process/launch_builder.py`` — inherit the
+master's relevant CLI flags, force required flags, shlex-split
+``extra_args`` with a shell-metacharacter denylist (``:133-142``).
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+import sys
+from pathlib import Path
+
+from ..utils.exceptions import ProcessError
+
+_SHELL_META = set(";&|<>`$(){}[]!*?~#\n")
+
+
+def split_extra_args(extra: str) -> list[str]:
+    if not extra:
+        return []
+    bad = _SHELL_META & set(extra)
+    if bad:
+        raise ProcessError(
+            f"extra_args contains shell metacharacters {sorted(bad)}")
+    return shlex.split(extra)
+
+
+def build_launch_command(
+    worker: dict,
+    master_port: int,
+    config_path: str | None = None,
+) -> tuple[list[str], dict[str, str]]:
+    """Returns (argv, env_overrides) for the controller subprocess."""
+    port = worker.get("port") or _port_from_address(worker.get("address", ""))
+    if not port:
+        raise ProcessError(f"worker {worker.get('id')!r} has no port")
+    argv = [
+        sys.executable, "-m", "comfyui_distributed_tpu",
+        "serve", "--port", str(port),
+    ]
+    argv += split_extra_args(worker.get("extra_args", ""))
+
+    env = {
+        "CDT_IS_WORKER": "1",                       # COMFYUI_IS_WORKER parity
+        "CDT_WORKER_ID": str(worker.get("id", "")),
+        "CDT_MASTER_PID": str(os.getpid()),         # COMFYUI_MASTER_PID parity
+        "CDT_MASTER_PORT": str(master_port),
+    }
+    if config_path:
+        env["CDT_CONFIG_PATH"] = str(config_path)
+    mesh_devices = worker.get("mesh_devices", -1)
+    if mesh_devices and mesh_devices > 0:
+        env["CDT_MESH_DEVICES"] = str(mesh_devices)
+    return argv, env
+
+
+def _port_from_address(address: str) -> int | None:
+    tail = address.rsplit(":", 1)
+    if len(tail) == 2 and tail[1].split("/")[0].isdigit():
+        return int(tail[1].split("/")[0])
+    return None
+
+
+def log_file_for(worker_id: str, log_dir: Path | None = None) -> Path:
+    """Per-worker dated log file (reference ``lifecycle.py:41-65``)."""
+    import datetime
+
+    base = log_dir or Path(os.environ.get("CDT_LOG_DIR", "logs"))
+    base.mkdir(parents=True, exist_ok=True)
+    stamp = datetime.date.today().isoformat()
+    return base / f"worker_{worker_id}_{stamp}.log"

@@ -1,0 +1,134 @@
+"""Worker process manager facade + persistence.
+
+Parity: reference ``workers/process_manager.py`` (facade + lazy singleton),
+``workers/process/persistence.py`` (PIDs persisted into config
+``managed_processes``, restored + verified on restart), startup/cleanup
+hooks from ``workers/startup.py``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+from pathlib import Path
+from typing import Optional
+
+from ..utils.config import load_config, update_config
+from ..utils.exceptions import ProcessError
+from ..utils.logging import log
+from ..utils.process import is_process_alive
+from .lifecycle import ManagedProcess, kill_process_tree, launch_worker_process
+
+
+class WorkerProcessManager:
+    def __init__(self, config_path: Optional[Path] = None):
+        self.config_path = config_path
+        self._managed: dict[str, ManagedProcess] = {}
+        self._restore_persisted()
+
+    # --- persistence (reference persistence.py:11-48) ----------------------
+
+    def _restore_persisted(self) -> None:
+        cfg = load_config(self.config_path)
+        for wid, info in (cfg.get("managed_processes") or {}).items():
+            pid = int(info.get("pid", 0) or 0)
+            if pid and is_process_alive(pid):
+                self._managed[wid] = ManagedProcess(
+                    wid, pid=pid,
+                    log_path=Path(info["log"]) if info.get("log") else None)
+                log(f"restored managed worker {wid} pid={pid}")
+        self._persist()
+
+    def _persist(self) -> None:
+        snapshot = {
+            wid: {"pid": mp.pid, "log": str(mp.log_path) if mp.log_path else ""}
+            for wid, mp in self._managed.items()
+        }
+        update_config(lambda c: c.update(managed_processes=snapshot),
+                      self.config_path)
+
+    # --- lifecycle ----------------------------------------------------------
+
+    def launch_worker(self, worker_id: str) -> ManagedProcess:
+        self.reap_dead()
+        if worker_id in self._managed:
+            raise ProcessError(f"worker {worker_id!r} already running "
+                               f"(pid {self._managed[worker_id].pid})")
+        cfg = load_config(self.config_path)
+        worker = next(
+            (h for h in cfg.get("hosts", []) if h.get("id") == worker_id), None)
+        if worker is None:
+            raise ProcessError(f"no configured host {worker_id!r}")
+        stop_on_exit = cfg.get("settings", {}).get(
+            "stop_workers_on_master_exit", True)
+        mp = launch_worker_process(
+            worker,
+            master_port=cfg.get("master", {}).get("port", 8288),
+            config_path=str(self.config_path) if self.config_path else
+            os.environ.get("CDT_CONFIG_PATH"),
+            use_watchdog=stop_on_exit,
+        )
+        self._managed[worker_id] = mp
+        self._persist()
+        return mp
+
+    def stop_worker(self, worker_id: str) -> bool:
+        mp = self._managed.pop(worker_id, None)
+        if mp is None:
+            return False
+        ok = kill_process_tree(mp.pid) if mp.pid else True
+        self._persist()
+        log(f"stopped worker {worker_id} (pid {mp.pid}, clean={ok})")
+        return True
+
+    def get_managed_workers(self) -> dict[str, dict]:
+        self.reap_dead()
+        return {
+            wid: {"pid": mp.pid, "alive": True,
+                  "log": str(mp.log_path) if mp.log_path else "",
+                  "started_at": mp.started_at}
+            for wid, mp in self._managed.items()
+        }
+
+    def reap_dead(self) -> list[str]:
+        """Drop entries whose process died (reference
+        ``get_managed_workers`` liveness reaping, ``lifecycle.py:165-180``)."""
+        dead = [wid for wid, mp in self._managed.items() if not mp.is_alive()]
+        for wid in dead:
+            del self._managed[wid]
+        if dead:
+            self._persist()
+        return dead
+
+    def cleanup_all(self) -> None:
+        for wid in list(self._managed):
+            self.stop_worker(wid)
+
+
+_manager: Optional[WorkerProcessManager] = None
+
+
+def get_worker_manager(config_path: Optional[Path] = None) -> WorkerProcessManager:
+    global _manager
+    if _manager is None:
+        _manager = WorkerProcessManager(config_path)
+    return _manager
+
+
+async def delayed_auto_launch(manager: WorkerProcessManager, delay: float = 2.0
+                              ) -> list[str]:
+    """Auto-launch enabled local workers after a settle delay (reference
+    ``workers/startup.py:19-84``: clears stale managed PIDs first)."""
+    await asyncio.sleep(delay)
+    cfg = load_config(manager.config_path)
+    if not cfg.get("settings", {}).get("auto_launch_workers"):
+        return []
+    launched = []
+    for host in cfg.get("hosts", []):
+        if host.get("enabled") and host.get("type") == "local":
+            try:
+                manager.launch_worker(host["id"])
+                launched.append(host["id"])
+            except ProcessError as e:
+                log(f"auto-launch {host.get('id')} failed: {e}")
+    return launched

@@ -1,0 +1,88 @@
+"""Out-of-process watchdog.
+
+Parity: reference ``workers/worker_monitor.py:41-132`` — runs as its own
+process wrapping the real worker: spawns it, writes
+``monitor_pid,worker_pid`` to ``CDT_PID_FILE``, polls the master PID every
+2 s, and kills the worker when the master dies or on signal. Keeps orphaned
+controllers from outliving a crashed master.
+
+Standalone: importable with no package deps (it may run from a bare file
+path), so liveness helpers are inlined.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+POLL_INTERVAL = float(os.environ.get("CDT_MONITOR_POLL", "2.0"))
+
+
+def _alive(pid: int) -> bool:
+    if pid <= 0:
+        return False
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+
+
+def _kill_worker(proc: subprocess.Popen) -> None:
+    if proc.poll() is not None:
+        return
+    try:
+        pgid = os.getpgid(proc.pid)
+        os.killpg(pgid, signal.SIGTERM)
+    except (ProcessLookupError, PermissionError, OSError):
+        proc.terminate()
+    try:
+        proc.wait(timeout=5)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
+        except (ProcessLookupError, PermissionError, OSError):
+            proc.kill()
+
+
+def monitor_and_run(argv: list[str]) -> int:
+    master_pid = int(os.environ.get("CDT_MASTER_PID", "0") or 0)
+    kwargs: dict = {}
+    if os.name == "posix":
+        kwargs["start_new_session"] = True
+    proc = subprocess.Popen(argv, **kwargs)
+
+    pid_file = os.environ.get("CDT_PID_FILE", "")
+    if pid_file:
+        try:
+            with open(pid_file, "w", encoding="utf-8") as f:
+                f.write(f"{os.getpid()},{proc.pid}")
+        except OSError:
+            pass
+
+    def on_signal(signum, frame):
+        _kill_worker(proc)
+        sys.exit(128 + signum)
+
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, on_signal)
+
+    while True:
+        code = proc.poll()
+        if code is not None:
+            return code
+        if master_pid and not _alive(master_pid):
+            print(f"[worker_monitor] master {master_pid} died; stopping worker",
+                  file=sys.stderr)
+            _kill_worker(proc)
+            return 0
+        time.sleep(POLL_INTERVAL)
+
+
+if __name__ == "__main__":
+    sys.exit(monitor_and_run(sys.argv[1:]))
