@@ -1,0 +1,284 @@
+"""FLUX-class rectified-flow MMDiT.
+
+Covers the BASELINE "FLUX.1-dev txt2img" config family: double-stream
+(image/text) transformer blocks followed by single-stream blocks, adaLN-Zero
+modulation from (timestep, pooled text, guidance), patchified latents,
+velocity prediction for flow matching. The reference runs FLUX through
+ComfyUI; here the architecture is native and **sequence-parallel capable**:
+``attn_backend="ring"`` runs joint attention with image tokens sharded over
+the ``sp`` mesh axis (``ops/attention.joint_ring_attention``) — the
+capability the reference entirely lacks (SURVEY §2.10: SP/CP absent).
+
+Positional encoding: 2-D sinusoidal (axial) added to patch embeddings —
+functionally equivalent coverage to FLUX's RoPE for from-scratch training;
+weight-porting real FLUX checkpoints would swap in RoPE (noted for later
+rounds).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from ..ops.attention import full_attention, joint_ring_attention
+from ..utils import constants
+from .layers import timestep_embedding
+
+
+@dataclasses.dataclass(frozen=True)
+class DiTConfig:
+    patch_size: int = 2
+    in_channels: int = 16            # FLUX VAE: 16 latent channels
+    hidden: int = 3072
+    depth_double: int = 19
+    depth_single: int = 38
+    heads: int = 24
+    context_dim: int = 4096          # T5 features
+    pooled_dim: int = 768            # CLIP pooled
+    guidance_embed: bool = True      # FLUX-dev distilled guidance input
+    dtype: str = "bfloat16"
+    attn_backend: str = "dense"      # "dense" | "ring"
+
+    @classmethod
+    def flux(cls) -> "DiTConfig":
+        return cls()
+
+    @classmethod
+    def tiny(cls, attn_backend: str = "dense") -> "DiTConfig":
+        return cls(patch_size=2, in_channels=4, hidden=64, depth_double=2,
+                   depth_single=2, heads=4, context_dim=32, pooled_dim=16,
+                   attn_backend=attn_backend)
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden // self.heads
+
+    @property
+    def jnp_dtype(self):
+        return jnp.dtype(self.dtype)
+
+
+def patchify(x: jax.Array, p: int) -> jax.Array:
+    """[B,H,W,C] → [B, (H/p)(W/p), p·p·C]."""
+    B, H, W, C = x.shape
+    x = x.reshape(B, H // p, p, W // p, p, C)
+    return x.transpose(0, 1, 3, 2, 4, 5).reshape(B, (H // p) * (W // p), p * p * C)
+
+
+def unpatchify(tokens: jax.Array, hw: tuple[int, int], p: int, c: int) -> jax.Array:
+    B = tokens.shape[0]
+    h, w = hw[0] // p, hw[1] // p
+    x = tokens.reshape(B, h, w, p, p, c)
+    return x.transpose(0, 1, 3, 2, 4, 5).reshape(B, hw[0], hw[1], c)
+
+
+def sincos_2d(h: int, w: int, dim: int) -> jax.Array:
+    """Axial 2-D sinusoidal position table [h·w, dim]."""
+    def axis_table(n, d):
+        pos = jnp.arange(n, dtype=jnp.float32)
+        freqs = jnp.exp(-math.log(10000.0) * jnp.arange(d // 2, dtype=jnp.float32)
+                        / (d // 2))
+        args = pos[:, None] * freqs[None]
+        return jnp.concatenate([jnp.sin(args), jnp.cos(args)], axis=-1)
+
+    dh = dim // 2
+    th = axis_table(h, dh)                      # [h, dh]
+    tw = axis_table(w, dim - dh)                # [w, dim-dh]
+    grid = jnp.concatenate([
+        jnp.repeat(th, w, axis=0),
+        jnp.tile(tw, (h, 1)),
+    ], axis=-1)
+    return grid
+
+
+class Modulation(nn.Module):
+    """adaLN-Zero: conditioning vector → (shift, scale, gate) × n."""
+
+    n_outputs: int
+    hidden: int
+    dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, vec: jax.Array) -> tuple[jax.Array, ...]:
+        out = nn.Dense(self.hidden * 3 * self.n_outputs, dtype=self.dtype,
+                       kernel_init=nn.initializers.zeros, name="mod")(nn.silu(vec))
+        return tuple(jnp.split(out[:, None, :], 3 * self.n_outputs, axis=-1))
+
+
+def _modulate(x, shift, scale):
+    return x * (1 + scale) + shift
+
+
+class _QKV(nn.Module):
+    hidden: int
+    heads: int
+    dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, x):
+        B, N, _ = x.shape
+        qkv = nn.Dense(self.hidden * 3, dtype=self.dtype, name="qkv")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        hd = self.hidden // self.heads
+        shape = (B, N, self.heads, hd)
+        # qk-norm (RMS) as in FLUX for stability
+        q = _rms(q.reshape(shape))
+        k = _rms(k.reshape(shape))
+        return q, k, v.reshape(shape)
+
+
+def _rms(x, eps=1e-6):
+    return x * jax.lax.rsqrt(jnp.mean(x.astype(jnp.float32) ** 2, -1,
+                                      keepdims=True) + eps).astype(x.dtype)
+
+
+class DoubleBlock(nn.Module):
+    """Separate image/text streams with joint attention (MMDiT)."""
+
+    config: DiTConfig
+
+    @nn.compact
+    def __call__(self, img, txt, vec, sp_axis: Optional[str]):
+        cfg = self.config
+        dt = cfg.jnp_dtype
+        i_sh1, i_sc1, i_g1, i_sh2, i_sc2, i_g2 = Modulation(2, cfg.hidden, dt,
+                                                            name="img_mod")(vec)
+        t_sh1, t_sc1, t_g1, t_sh2, t_sc2, t_g2 = Modulation(2, cfg.hidden, dt,
+                                                            name="txt_mod")(vec)
+
+        img_n = _modulate(nn.LayerNorm(use_scale=False, use_bias=False,
+                                       dtype=dt)(img), i_sh1, i_sc1)
+        txt_n = _modulate(nn.LayerNorm(use_scale=False, use_bias=False,
+                                       dtype=dt)(txt), t_sh1, t_sc1)
+        iq, ik, iv = _QKV(cfg.hidden, cfg.heads, dt, name="img_qkv")(img_n)
+        tq, tk, tv = _QKV(cfg.hidden, cfg.heads, dt, name="txt_qkv")(txt_n)
+
+        if sp_axis is None:
+            q = jnp.concatenate([tq, iq], axis=1)
+            k = jnp.concatenate([tk, ik], axis=1)
+            v = jnp.concatenate([tv, iv], axis=1)
+            out = full_attention(q, k, v)
+        else:
+            q = jnp.concatenate([tq, iq], axis=1)
+            out = joint_ring_attention(q, tk, tv, ik, iv, sp_axis)
+        T = txt.shape[1]
+        t_out, i_out = out[:, :T], out[:, T:]
+        B = img.shape[0]
+        i_out = i_out.reshape(B, -1, cfg.hidden)
+        t_out = t_out.reshape(B, T, cfg.hidden)
+        img = img + i_g1 * nn.Dense(cfg.hidden, dtype=dt, name="img_proj")(i_out)
+        txt = txt + t_g1 * nn.Dense(cfg.hidden, dtype=dt, name="txt_proj")(t_out)
+
+        img_m = _modulate(nn.LayerNorm(use_scale=False, use_bias=False,
+                                       dtype=dt)(img), i_sh2, i_sc2)
+        txt_m = _modulate(nn.LayerNorm(use_scale=False, use_bias=False,
+                                       dtype=dt)(txt), t_sh2, t_sc2)
+        img_h = nn.Dense(cfg.hidden * 4, dtype=dt, name="img_mlp_up")(img_m)
+        img = img + i_g2 * nn.Dense(cfg.hidden, dtype=dt,
+                                    name="img_mlp_down")(nn.gelu(img_h))
+        txt_h = nn.Dense(cfg.hidden * 4, dtype=dt, name="txt_mlp_up")(txt_m)
+        txt = txt + t_g2 * nn.Dense(cfg.hidden, dtype=dt,
+                                    name="txt_mlp_down")(nn.gelu(txt_h))
+        return img, txt
+
+
+class SingleBlock(nn.Module):
+    """Merged-stream block (FLUX single blocks)."""
+
+    config: DiTConfig
+
+    @nn.compact
+    def __call__(self, x, vec, txt_len: int, sp_axis: Optional[str]):
+        cfg = self.config
+        dt = cfg.jnp_dtype
+        sh, sc, g = Modulation(1, cfg.hidden, dt, name="mod")(vec)
+        xn = _modulate(nn.LayerNorm(use_scale=False, use_bias=False, dtype=dt)(x),
+                       sh, sc)
+        q, k, v = _QKV(cfg.hidden, cfg.heads, dt, name="qkv")(xn)
+        if sp_axis is None:
+            out = full_attention(q, k, v)
+        else:
+            # txt tokens lead the sequence on every shard
+            tk, ik = k[:, :txt_len], k[:, txt_len:]
+            tv, iv = v[:, :txt_len], v[:, txt_len:]
+            out = joint_ring_attention(q, tk, tv, ik, iv, sp_axis)
+        B, N, _, _ = out.shape
+        out = out.reshape(B, N, cfg.hidden)
+        mlp_in = nn.Dense(cfg.hidden * 4, dtype=dt, name="mlp_up")(xn)
+        fused = jnp.concatenate([out, nn.gelu(mlp_in)], axis=-1)
+        return x + g * nn.Dense(cfg.hidden, dtype=dt, name="out")(fused)
+
+
+class DiT(nn.Module):
+    """x[B,h,w,C], t[B] (flow time in [0,1]), context[B,T,ctx],
+    pooled[B,P], guidance[B] → velocity [B,h,w,C]."""
+
+    config: DiTConfig
+
+    @nn.compact
+    def __call__(self, x, t, context, pooled, guidance=None,
+                 sp_axis: Optional[str] = None):
+        cfg = self.config
+        dt = cfg.jnp_dtype
+        B, H, W, C = x.shape
+        p = cfg.patch_size
+
+        tokens = patchify(x.astype(dt), p)
+        img = nn.Dense(cfg.hidden, dtype=dt, name="img_in")(tokens)
+        if sp_axis is None:
+            pos = sincos_2d(H // p, W // p, cfg.hidden)
+        else:
+            # x is this shard's row block of the global image: build the
+            # global position table and slice this shard's rows
+            n_sh = jax.lax.axis_size(sp_axis)
+            idx = jax.lax.axis_index(sp_axis)
+            pos_full = sincos_2d((H * n_sh) // p, W // p, cfg.hidden)
+            per = pos_full.shape[0] // n_sh
+            pos = jax.lax.dynamic_slice_in_dim(pos_full, idx * per, per, axis=0)
+        img = img + pos[None].astype(dt)
+
+        txt = nn.Dense(cfg.hidden, dtype=dt, name="txt_in")(context.astype(dt))
+
+        vec = nn.Dense(cfg.hidden, dtype=dt, name="t_in")(
+            timestep_embedding(t * 1000.0, 256).astype(dt))
+        vec = vec + nn.Dense(cfg.hidden, dtype=dt, name="pool_in")(pooled.astype(dt))
+        if cfg.guidance_embed:
+            gvec = guidance if guidance is not None else jnp.full((B,), 3.5)
+            vec = vec + nn.Dense(cfg.hidden, dtype=dt, name="guid_in")(
+                timestep_embedding(gvec * 1000.0, 256).astype(dt))
+        vec = nn.Dense(cfg.hidden, dtype=dt, name="vec_mlp")(nn.silu(vec))
+
+        for i in range(cfg.depth_double):
+            img, txt = DoubleBlock(cfg, name=f"double_{i}")(img, txt, vec, sp_axis)
+        xcat = jnp.concatenate([txt, img], axis=1)
+        T = txt.shape[1]
+        for i in range(cfg.depth_single):
+            xcat = SingleBlock(cfg, name=f"single_{i}")(xcat, vec, T, sp_axis)
+        img = xcat[:, T:]
+
+        sh, sc, _ = Modulation(1, cfg.hidden, dt, name="final_mod")(vec)
+        img = _modulate(nn.LayerNorm(use_scale=False, use_bias=False, dtype=dt)(img),
+                        sh, sc)
+        out = nn.Dense(p * p * C, dtype=jnp.float32,
+                       kernel_init=nn.initializers.zeros, name="img_out")(
+            img.astype(jnp.float32))
+        # in sp mode (H, W) is the local row block — output stays local,
+        # so the sampler update is shard-local too
+        return unpatchify(out, (H, W), p, C)
+
+
+def init_dit(config: DiTConfig, rng: jax.Array,
+             sample_hw: tuple[int, int] = (32, 32), context_len: int = 16):
+    model = DiT(config)
+    h, w = sample_hw
+    x = jnp.zeros((1, h, w, config.in_channels))
+    t = jnp.zeros((1,))
+    ctx = jnp.zeros((1, context_len, config.context_dim))
+    pooled = jnp.zeros((1, config.pooled_dim))
+    params = model.init(rng, x, t, ctx, pooled)
+    return model, params
