@@ -382,6 +382,53 @@ class TPUTxt2Img(NodeDef):
         return (images,)
 
 
+@register_node("TPUFlowTxt2Img")
+class TPUFlowTxt2Img(NodeDef):
+    """Sharded rectified-flow sampler (FLUX-class DiT bundles).
+
+    ``mode="dp"`` fans seeds over chips; ``mode="sp"`` shards ONE image's
+    tokens over chips with ring attention (single-image latency scaling —
+    beyond the reference's capability census, SURVEY §2.10)."""
+
+    INPUTS = {
+        "model": "MODEL", "positive": "CONDITIONING",
+        "seed": "INT", "steps": "INT", "width": "INT", "height": "INT",
+    }
+    OPTIONAL = {
+        "guidance": "FLOAT", "shift": "FLOAT", "mode": "STRING",
+        "batch_per_device": "INT",
+    }
+    HIDDEN = {"mesh": "*"}
+    RETURNS = ("IMAGE",)
+
+    def execute(self, model, positive, seed: int, steps: int, width: int,
+                height: int, guidance: float = 3.5, shift: float = 3.0,
+                mode: str = "dp", batch_per_device: int = 1, mesh=None, **_):
+        from ..diffusion.pipeline_flow import FlowSpec
+        from ..parallel.mesh import build_mesh
+
+        if mesh is None:
+            mesh = build_mesh({"dp": len(jax.devices())})
+        spec = FlowSpec(height=int(height), width=int(width), steps=int(steps),
+                        shift=float(shift), guidance=float(guidance),
+                        per_device_batch=int(batch_per_device))
+        ctx = positive["context"]
+        pooled = positive.get("pooled")
+        if pooled is None:
+            pooled = jnp.zeros((1, model.pipeline.dit.config.pooled_dim))
+        if mode == "sp":
+            from jax.sharding import Mesh
+
+            axes = dict(mesh.shape)
+            if "sp" not in axes:   # re-lay the same devices as an sp mesh
+                mesh = build_mesh({"sp": mesh.devices.size},
+                                  list(mesh.devices.flat))
+            images = model.pipeline.generate_sp(mesh, spec, int(seed), ctx, pooled)
+        else:
+            images = model.pipeline.generate(mesh, spec, int(seed), ctx, pooled)
+        return (images,)
+
+
 @register_node("VAEEncode")
 class VAEEncode(NodeDef):
     INPUTS = {"pixels": "IMAGE", "vae": "VAE"}

@@ -26,10 +26,34 @@ from .vae import AutoencoderKL, VAEConfig
 @dataclasses.dataclass(frozen=True)
 class ModelPreset:
     name: str
-    unet: UNetConfig
+    unet: "UNetConfig | None"
     vae: VAEConfig
     text: TextEncoderConfig
     sample_hw: tuple[int, int] = (128, 128)   # init-time latent H,W
+    dit: "object | None" = None               # DiTConfig for flow models
+
+    @property
+    def kind(self) -> str:
+        return "dit" if self.dit is not None else "unet"
+
+
+def _flux_preset():
+    from .dit import DiTConfig
+
+    return ModelPreset(
+        "flux", unet=None,
+        vae=VAEConfig(latent_channels=16, scaling_factor=0.3611),
+        text=TextEncoderConfig(output_dim=4096, pooled_dim=768),
+        sample_hw=(32, 32), dit=DiTConfig.flux())
+
+
+def _flux_tiny_preset():
+    from .dit import DiTConfig
+
+    return ModelPreset(
+        "flux-tiny", unet=None, vae=VAEConfig.tiny(),
+        text=TextEncoderConfig.tiny(),
+        sample_hw=(8, 8), dit=DiTConfig.tiny())
 
 
 PRESETS: dict[str, ModelPreset] = {
@@ -40,6 +64,8 @@ PRESETS: dict[str, ModelPreset] = {
                         TextEncoderConfig(output_dim=768, pooled_dim=768)),
     "tiny": ModelPreset("tiny", UNetConfig.tiny(), VAEConfig.tiny(),
                         TextEncoderConfig.tiny(), sample_hw=(8, 8)),
+    "flux": _flux_preset(),
+    "flux-tiny": _flux_tiny_preset(),
 }
 
 
@@ -48,40 +74,78 @@ class ModelBundle:
 
     def __init__(self, preset: ModelPreset, checkpoint_dir: Optional[Path] = None,
                  seed: int = 0):
-        from ..diffusion.pipeline import Txt2ImgPipeline
-
         self.preset = preset
         k1, k2, k3 = jax.random.split(jax.random.key(seed), 3)
-        lat_c = preset.unet.in_channels
-        model, params = init_unet(
-            preset.unet, k1,
-            sample_shape=(*preset.sample_hw, lat_c),
-            context_len=preset.text.max_len,
-        )
         img_hw = (preset.sample_hw[0] * preset.vae.downscale,
                   preset.sample_hw[1] * preset.vae.downscale)
         vae = AutoencoderKL(preset.vae).init(k2, image_hw=img_hw)
         self.text_encoder = TextEncoder(preset.text).init(k3)
-        self.pipeline = Txt2ImgPipeline(model, params, vae)
+        if preset.kind == "dit":
+            from ..diffusion.pipeline_flow import FlowPipeline
+            from .dit import init_dit
+
+            model, params = init_dit(preset.dit, k1,
+                                     sample_hw=preset.sample_hw,
+                                     context_len=preset.text.max_len)
+            self.pipeline = FlowPipeline(model, params, vae)
+        else:
+            from ..diffusion.pipeline import Txt2ImgPipeline
+
+            model, params = init_unet(
+                preset.unet, k1,
+                sample_shape=(*preset.sample_hw, preset.unet.in_channels),
+                context_len=preset.text.max_len,
+            )
+            self.pipeline = Txt2ImgPipeline(model, params, vae)
         if checkpoint_dir is not None and Path(checkpoint_dir).exists():
             self._load_checkpoint(Path(checkpoint_dir))
+
+    @property
+    def kind(self) -> str:
+        return self.preset.kind
+
+    def _core_params(self):
+        if self.kind == "dit":
+            return self.pipeline.dit_params
+        return self.pipeline.unet_params
+
+    def _set_core_params(self, params) -> None:
+        if self.kind == "dit":
+            self.pipeline.dit_params = params
+        else:
+            self.pipeline.unet_params = params
 
     def _load_checkpoint(self, ckpt: Path) -> None:
         import orbax.checkpoint as ocp
 
         targets = {
-            "unet": self.pipeline.unet_params,
+            "core": self._core_params(),
             "vae_enc": self.pipeline.vae.enc_params,
             "vae_dec": self.pipeline.vae.dec_params,
             "text": self.text_encoder.params,
         }
         with ocp.StandardCheckpointer() as ckptr:
             restored = ckptr.restore(ckpt.resolve(), targets)
-        self.pipeline.unet_params = restored["unet"]
+        self._set_core_params(restored["core"])
         self.pipeline.vae.enc_params = restored["vae_enc"]
         self.pipeline.vae.dec_params = restored["vae_dec"]
         self.text_encoder.params = restored["text"]
         log(f"loaded checkpoint {ckpt}")
+
+    def save_checkpoint(self, ckpt: Path) -> None:
+        """Persist the stack with orbax (enables real-weight workflows:
+        convert → save once → every controller restores)."""
+        import orbax.checkpoint as ocp
+
+        state = {
+            "core": self._core_params(),
+            "vae_enc": self.pipeline.vae.enc_params,
+            "vae_dec": self.pipeline.vae.dec_params,
+            "text": self.text_encoder.params,
+        }
+        with ocp.StandardCheckpointer() as ckptr:
+            ckptr.save(Path(ckpt).resolve(), state)
+        log(f"saved checkpoint {ckpt}")
 
 
 class ModelRegistry:
