@@ -106,3 +106,16 @@ def test_flow_sp_rejects_indivisible():
     with pytest.raises(ValueError, match="divide"):
         pipe.generate_sp_fn(build_mesh({"sp": 8}),
                             FlowSpec(height=16, width=16, steps=1))
+
+
+def test_flow_dp_tp_gspmd(flow_stack):
+    """dp×tp 2-D mesh: 4 seed-parallel images with weights sharded over 2
+    chips each."""
+    mesh = build_mesh({"dp": 4, "tp": 2})
+    spec = FlowSpec(height=16, width=16, steps=2, shift=1.0)
+    ctx, pooled = _cond(flow_stack.dit.config)
+    fn = flow_stack.generate_tp_fn(mesh, spec)
+    imgs = np.asarray(fn(jax.random.key(0), ctx, pooled))
+    assert imgs.shape == (4, 16, 16, 3)
+    assert np.isfinite(imgs).all()
+    assert len({imgs[i].tobytes() for i in range(4)}) == 4

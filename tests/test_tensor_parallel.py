@@ -1,0 +1,77 @@
+"""Tensor-parallel weight sharding: placements land where the rules say,
+and a tp-sharded forward equals the unsharded forward."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from comfyui_distributed_tpu.models.dit import DiTConfig, init_dit
+from comfyui_distributed_tpu.parallel import build_mesh
+from comfyui_distributed_tpu.parallel.tensor import (
+    DIT_TP_RULES,
+    shard_params,
+    spec_for_param,
+    tp_sharding_summary,
+)
+
+
+class TestRules:
+    def test_qkv_column_sharded(self):
+        spec = spec_for_param("double_0/img_qkv/qkv/kernel", (64, 192),
+                              DIT_TP_RULES, "tp", 2)
+        assert spec == P(None, "tp")
+
+    def test_proj_row_sharded(self):
+        spec = spec_for_param("double_0/img_proj/kernel", (64, 64),
+                              DIT_TP_RULES, "tp", 2)
+        assert spec == P("tp", None)
+
+    def test_norm_replicated(self):
+        assert spec_for_param("double_0/img_mod/mod/kernel", (64, 384),
+                              DIT_TP_RULES, "tp", 2) == P()
+
+    def test_indivisible_falls_back_to_replication(self):
+        spec = spec_for_param("double_0/img_qkv/qkv/kernel", (64, 193),
+                              DIT_TP_RULES, "tp", 2)
+        assert spec == P()
+
+
+def test_tp_forward_matches_unsharded():
+    """jit with tp-sharded params must produce the same velocity field as
+    the single-device forward (GSPMD inserts the collectives)."""
+    cfg = DiTConfig(patch_size=2, in_channels=4, hidden=64, depth_double=2,
+                    depth_single=2, heads=4, context_dim=32, pooled_dim=16,
+                    dtype="float32")
+    model, params = init_dit(cfg, jax.random.key(0), sample_hw=(8, 8),
+                             context_len=6)
+    x = jax.random.normal(jax.random.key(1), (2, 8, 8, 4))
+    t = jnp.array([0.3, 0.8])
+    ctx = jax.random.normal(jax.random.key(2), (2, 6, cfg.context_dim))
+    pooled = jax.random.normal(jax.random.key(3), (2, cfg.pooled_dim))
+
+    want = np.asarray(model.apply(params, x, t, ctx, pooled))
+
+    mesh = build_mesh({"tp": 2})
+    sharded = shard_params(params, mesh)
+    summary = tp_sharding_summary(params, mesh)
+    assert summary["sharded"] > 0, "no parameters matched the TP rules"
+
+    fwd = jax.jit(lambda p, *a: model.apply(p, *a))
+    got = np.asarray(fwd(sharded, x, t, ctx, pooled))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_tp_actually_shards_bytes():
+    cfg = DiTConfig.tiny()
+    model, params = init_dit(cfg, jax.random.key(0), sample_hw=(8, 8),
+                             context_len=6)
+    mesh = build_mesh({"tp": 4})
+    sharded = shard_params(params, mesh)
+    # verify a known leaf is physically sharded over 4 devices
+    leaf = sharded["params"]["double_0"]["img_qkv"]["qkv"]["kernel"]
+    assert leaf.sharding.spec == P(None, "tp")
+    shard_shapes = {tuple(s.data.shape) for s in leaf.addressable_shards}
+    assert shard_shapes == {(cfg.hidden, cfg.hidden * 3 // 4)}
+    summary = tp_sharding_summary(params, mesh)
+    assert summary["sharded_bytes"] > summary["replicated_bytes"] * 0.3
