@@ -15,10 +15,18 @@ import sys
 def cmd_serve(args: argparse.Namespace) -> None:
     from .api.app import run_app
     from .cluster.controller import Controller
+    from .utils.config import update_config
     from .utils.logging import log
+    from .workers.detection import auto_populate_hosts
     from .workers.process_manager import delayed_auto_launch, get_worker_manager
 
     controller = Controller()
+    if not controller.is_worker and not controller.load_config().get(
+            "settings", {}).get("has_auto_populated_workers"):
+        # first-launch auto-configuration (reference auto-populates one
+        # worker per CUDA device, web/masterDetection.js:36-100; here: one
+        # controller per TPU slice host advertised by the runtime)
+        update_config(auto_populate_hosts, controller.config_path)
 
     async def main() -> None:
         runner = await run_app(controller, host=args.host, port=args.port)

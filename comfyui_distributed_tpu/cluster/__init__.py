@@ -15,5 +15,12 @@ from .job_store import JobStore  # noqa: F401
 from .job_timeout import check_and_requeue_timed_out_workers  # noqa: F401
 from .dispatch import probe_host, select_active_hosts, select_least_busy_host  # noqa: F401
 from .collector_bridge import CollectorBridge  # noqa: F401
+from .media_sync import (  # noqa: F401
+    MediaRef,
+    SyncReport,
+    convert_paths_for_platform,
+    find_media_refs,
+    sync_host_media,
+)
 from .runtime import PromptQueue  # noqa: F401
 from .orchestration import Orchestrator, OrchestrationResult  # noqa: F401

@@ -12,25 +12,19 @@ from __future__ import annotations
 import asyncio
 import os
 import platform
-import uuid
 from pathlib import Path
 from typing import Any, Optional
 
 from ..utils import constants
 from ..utils.config import ensure_config_exists, load_config
 from ..utils.logging import log
+from ..workers.detection import detect_environment, get_machine_id as machine_id
 from .collector_bridge import CollectorBridge
 from .job_store import JobStore
 from .orchestration import Orchestrator
 from .runtime import PromptQueue
 
 IS_WORKER_ENV = "CDT_IS_WORKER"
-
-
-def machine_id() -> str:
-    """Stable machine identity for local/remote classification (reference
-    ``workers/detection.py:49-62`` uses MAC/hostname the same way)."""
-    return f"{platform.node()}-{uuid.getnode():012x}"
 
 
 class Controller:
@@ -132,6 +126,7 @@ class Controller:
             "path_separator": os.sep,
             "python": platform.python_version(),
             "is_docker": Path("/.dockerenv").exists(),
+            "environment": detect_environment(),
             "devices": device_census(),
         }
 

@@ -1,0 +1,209 @@
+"""Content-addressed media sync: master → remote host controllers.
+
+Parity: reference ``api/orchestration/media_sync.py`` — find media file
+references in prompt inputs (``:70-81``), md5-check each against the remote
+host via ``/distributed/check_file`` and upload through ``/upload/image``
+only on miss or mismatch (``:146-193``), and convert path separators for
+cross-platform workers keyed off the remote ``/distributed/system_info``
+(``:36-67,127-143``).
+
+TPU note: this only runs for *remote* host controllers reached over DCN/WAN.
+On-pod participants share the master's filesystem view (or object store) and
+never enter this module — the reference pays this cost per worker because
+every GPU is a separate process with its own input directory.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import hashlib
+import os
+from pathlib import Path
+from typing import Any, Optional
+
+import aiohttp
+
+from ..utils import constants
+from ..utils.logging import debug_log, trace_info
+from ..utils.network import build_host_url, fetch_system_info, get_client_session
+
+# Input field names that carry a media filename (reference ``:70-81`` scans
+# image/video/audio/file inputs).
+MEDIA_INPUT_KEYS = frozenset({"image", "video", "audio", "file", "filename"})
+
+MEDIA_EXTENSIONS = (
+    ".png", ".jpg", ".jpeg", ".webp", ".gif", ".bmp",
+    ".mp4", ".webm", ".mov", ".avi",
+    ".wav", ".mp3", ".flac", ".ogg",
+    ".npy", ".npz",
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class MediaRef:
+    """One media-file reference inside a prompt graph."""
+    node_id: str
+    input_key: str
+    value: str
+
+
+def looks_like_media(value: Any) -> bool:
+    return (
+        isinstance(value, str)
+        and value.lower().endswith(MEDIA_EXTENSIONS)
+        and "\n" not in value
+    )
+
+
+def find_media_refs(prompt: dict) -> list[MediaRef]:
+    """Scan node inputs for media filenames (reference ``:70-81``).
+
+    Only media-typed input keys are considered, so a STRING prompt that
+    merely *mentions* ``foo.png`` is never synced.
+    """
+    refs: list[MediaRef] = []
+    for node_id, node in prompt.items():
+        inputs = node.get("inputs", {}) if isinstance(node, dict) else {}
+        for key, value in inputs.items():
+            if key.lower() in MEDIA_INPUT_KEYS and looks_like_media(value):
+                refs.append(MediaRef(node_id, key, value))
+    return refs
+
+
+def convert_paths_for_platform(prompt: dict, remote_sep: str) -> dict:
+    """Rewrite media-path separators to the remote host's convention
+    (reference ``:36-67`` — Windows workers need ``\\``, Unix ``/``)."""
+    if remote_sep not in ("/", "\\"):
+        return prompt
+    local_sep = "\\" if remote_sep == "/" else "/"
+    out = {k: (dict(v) if isinstance(v, dict) else v) for k, v in prompt.items()}
+    for ref in find_media_refs(out):
+        if local_sep in ref.value:
+            node = dict(out[ref.node_id])
+            inputs = dict(node.get("inputs", {}))
+            inputs[ref.input_key] = ref.value.replace(local_sep, remote_sep)
+            node["inputs"] = inputs
+            out[ref.node_id] = node
+    return out
+
+
+async def fetch_host_path_separator(host: dict, timeout: float = 10.0) -> str:
+    """Remote ``/distributed/system_info`` → path separator
+    (reference ``:127-143``); defaults to ``/`` when unreachable."""
+    info = await fetch_system_info(host, timeout)
+    sep = (info or {}).get("path_separator", "/")
+    return sep if sep in ("/", "\\") else "/"
+
+
+def local_input_dir() -> Path:
+    return Path(os.environ.get("CDT_INPUT_DIR", "input"))
+
+
+def _md5_file(path: Path) -> str:
+    h = hashlib.md5()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+async def _check_remote_file(host: dict, rel: str, md5: str,
+                             timeout: float) -> bool:
+    """True iff the remote already has ``rel`` with matching content
+    (reference ``:146-166`` fast path)."""
+    url = build_host_url(host, "/distributed/check_file")
+    try:
+        session = get_client_session()
+        async with session.post(
+            url, json={"path": rel, "md5": md5},
+            timeout=aiohttp.ClientTimeout(total=timeout),
+        ) as resp:
+            if resp.status != 200:
+                return False
+            body = await resp.json()
+            return bool(body.get("exists")) and bool(body.get("matches", True))
+    except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
+        debug_log(f"check_file {rel} on {host.get('id')} failed: {e}")
+        return False
+
+
+async def _upload_file(host: dict, rel: str, path: Path,
+                       timeout: float) -> bool:
+    """Upload one file via the ComfyUI-compatible ``/upload/image`` route
+    (reference ``:168-193``). The file object is handed to aiohttp so the
+    body streams from disk — video inputs are multi-GB and must not be
+    buffered in the controller's RAM."""
+    url = build_host_url(host, "/upload/image")
+    try:
+        with open(path, "rb") as f:
+            form = aiohttp.FormData()
+            form.add_field("image", f, filename=rel,
+                           content_type="application/octet-stream")
+            session = get_client_session()
+            async with session.post(
+                url, data=form, timeout=aiohttp.ClientTimeout(total=timeout)
+            ) as resp:
+                return resp.status == 200
+    except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
+        debug_log(f"upload {rel} to {host.get('id')} failed: {e}")
+        return False
+
+
+@dataclasses.dataclass
+class SyncReport:
+    checked: int = 0
+    uploaded: int = 0
+    skipped: int = 0       # already present with matching md5
+    missing: int = 0       # absent locally — left untouched
+    failed: list = dataclasses.field(default_factory=list)
+
+
+async def sync_host_media(
+    host: dict,
+    prompt: dict,
+    input_dir: Optional[Path] = None,
+    concurrency: int = constants.MEDIA_SYNC_CONCURRENCY,
+    timeout: float = constants.MEDIA_SYNC_TIMEOUT,
+    trace_id: str = "",
+) -> tuple[dict, SyncReport]:
+    """Ensure every media file the prompt references exists (content-
+    identical) on the remote host; returns the prompt with path separators
+    converted for the remote platform plus a sync report
+    (reference ``sync_worker_media``, ``:196-256``).
+    """
+    base = input_dir or local_input_dir()
+    report = SyncReport()
+    refs = find_media_refs(prompt)
+    if not refs:
+        return prompt, report
+
+    sep = await fetch_host_path_separator(host, timeout)
+    sem = asyncio.Semaphore(max(1, concurrency))
+
+    async def sync_one(ref: MediaRef) -> None:
+        async with sem:
+            report.checked += 1
+            local = base / ref.value.replace("\\", "/")
+            if not local.is_file():
+                report.missing += 1
+                debug_log(f"media sync: {local} absent locally; skipping")
+                return
+            md5 = await asyncio.get_running_loop().run_in_executor(
+                None, _md5_file, local)
+            rel = ref.value.replace("\\", "/")
+            if await _check_remote_file(host, rel, md5, timeout):
+                report.skipped += 1
+                return
+            if await _upload_file(host, rel, local, timeout):
+                report.uploaded += 1
+            else:
+                report.failed.append(rel)
+
+    await asyncio.gather(*(sync_one(r) for r in refs))
+    if trace_id:
+        trace_info(trace_id,
+                   f"media sync → {host.get('id')}: {report.checked} checked, "
+                   f"{report.uploaded} uploaded, {report.skipped} up-to-date, "
+                   f"{report.missing} missing, {len(report.failed)} failed")
+    return convert_paths_for_platform(prompt, sep), report

@@ -30,6 +30,7 @@ from ..utils.logging import new_trace_id, trace_info
 from ..utils.network import build_master_callback_url
 from .dispatch import dispatch_prompt, select_active_hosts, select_least_busy_host
 from .job_store import JobStore
+from .media_sync import sync_host_media
 from .runtime import PromptQueue
 
 
@@ -120,9 +121,15 @@ class Orchestrator:
         async def prep_and_dispatch(index: int, host: dict) -> tuple[str, Optional[str]]:
             async with sem:
                 wid = host.get("id", f"host{index}")
+                host_type = host.get("type")
+                if host_type not in ("local", "remote"):
+                    # config didn't pin a type: machine-id comparison
+                    # (reference workers/detection.py:11-47)
+                    from ..workers.detection import classify_host
+                    host_type = await classify_host(host)
                 callback = build_master_callback_url(
                     config.get("master", {}),
-                    for_local=host.get("type") == "local",
+                    for_local=host_type == "local",
                 )
                 wprompt = prune_prompt_for_worker(prompt)
                 if not wprompt:
@@ -131,6 +138,26 @@ class Orchestrator:
                     wprompt, wid, job_ids, master_url=callback,
                     enabled_worker_ids=worker_ids, worker_index=index,
                 )
+                if host_type == "remote":
+                    # remote hosts don't share the master's filesystem:
+                    # content-addressed sync before dispatch (reference
+                    # api/queue_orchestration.py:141-197)
+                    settings = config.get("settings", {})
+                    wprompt, sync_report = await sync_host_media(
+                        host, wprompt,
+                        concurrency=settings.get(
+                            "media_sync_concurrency",
+                            constants.MEDIA_SYNC_CONCURRENCY),
+                        timeout=settings.get(
+                            "media_sync_timeout_seconds",
+                            constants.MEDIA_SYNC_TIMEOUT),
+                        trace_id=trace_id,
+                    )
+                    if sync_report.failed:
+                        # dispatching anyway would leave the collector
+                        # waiting on a host that provably lacks its inputs
+                        return wid, (f"media sync failed for "
+                                     f"{sync_report.failed}")
                 try:
                     await dispatch_prompt(host, wprompt, client_id,
                                           extra={"trace_id": trace_id},

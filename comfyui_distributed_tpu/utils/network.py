@@ -96,5 +96,24 @@ async def probe_host(address_or_host: Any, timeout: float | None = None
         return None
 
 
+async def fetch_system_info(host: dict[str, Any], timeout: float = 10.0
+                            ) -> Optional[dict]:
+    """GET a host's ``/distributed/system_info`` → dict, or None when
+    unreachable (shared by media sync's path-separator lookup and
+    detection's machine-id comparison)."""
+    url = build_host_url(host, "/distributed/system_info")
+    try:
+        session = get_client_session()
+        async with session.get(
+            url, timeout=aiohttp.ClientTimeout(total=timeout)
+        ) as resp:
+            if resp.status != 200:
+                return None
+            return await resp.json()
+    except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
+        debug_log(f"system_info fetch from {url} failed: {e}")
+        return None
+
+
 def error_payload(message: str, status: int = 400) -> dict:
     return {"error": message, "status": status}

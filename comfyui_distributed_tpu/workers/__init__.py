@@ -8,4 +8,11 @@ on-pod chips don't need processes, but local multi-controller setups (one
 controller per pod slice) and dev/test clusters do.
 """
 
+from .detection import (  # noqa: F401
+    auto_populate_hosts,
+    classify_host,
+    detect_environment,
+    get_machine_id,
+    is_local_host,
+)
 from .process_manager import WorkerProcessManager, get_worker_manager  # noqa: F401

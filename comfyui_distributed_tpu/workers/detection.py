@@ -1,0 +1,133 @@
+"""Host environment detection + local/remote classification.
+
+Parity: reference ``workers/detection.py`` — machine identity from
+MAC/hostname (``:49-62``), local-vs-remote worker classification by
+comparing machine IDs over ``/distributed/system_info`` (``:11-47``),
+container/cloud environment detection (``:64-73``).
+
+TPU additions: the "cloud" environments that matter here are TPU VMs and
+GKE pods rather than Runpod; topology env vars published by the TPU runtime
+are surfaced so the UI/auto-config can tell a single host from a pod slice.
+"""
+
+from __future__ import annotations
+
+import os
+import platform
+import uuid
+from pathlib import Path
+from typing import Any, Optional
+
+
+def get_machine_id() -> str:
+    """Stable machine identity (reference ``:49-62`` — MAC + hostname)."""
+    return f"{platform.node()}-{uuid.getnode():012x}"
+
+
+def is_docker() -> bool:
+    """Reference ``:64-68`` checks /.dockerenv and cgroup hints."""
+    if Path("/.dockerenv").exists():
+        return True
+    try:
+        return "docker" in Path("/proc/1/cgroup").read_text()
+    except OSError:
+        return False
+
+
+def is_kubernetes() -> bool:
+    return bool(os.environ.get("KUBERNETES_SERVICE_HOST"))
+
+
+def tpu_environment() -> dict[str, Any]:
+    """Topology hints published by the TPU runtime (the analogue of the
+    reference's Runpod env probe, ``:69-73``)."""
+    env = {}
+    for var in ("TPU_ACCELERATOR_TYPE", "TPU_WORKER_ID",
+                "TPU_WORKER_HOSTNAMES", "TPU_CHIPS_PER_HOST_BOUNDS",
+                "MEGASCALE_COORDINATOR_ADDRESS", "JAX_COORDINATOR_ADDRESS"):
+        if os.environ.get(var):
+            env[var.lower()] = os.environ[var]
+    return env
+
+
+def detect_environment() -> dict[str, Any]:
+    return {
+        "machine_id": get_machine_id(),
+        "platform": platform.system().lower(),
+        "docker": is_docker(),
+        "kubernetes": is_kubernetes(),
+        "tpu": tpu_environment(),
+    }
+
+
+async def fetch_remote_machine_id(host: dict) -> Optional[str]:
+    """The host's ``/distributed/system_info`` → machine_id, or None
+    when unreachable (reference ``:23-40``)."""
+    from ..utils.network import fetch_system_info
+
+    info = await fetch_system_info(host)
+    return info.get("machine_id") if info else None
+
+
+async def is_local_host(host: dict) -> bool:
+    """A host is local iff it reports this machine's identity (reference
+    ``is_local_worker``, ``:11-47``). Loopback addresses short-circuit."""
+    address = str(host.get("address", ""))
+    if any(lb in address for lb in ("127.0.0.1", "localhost", "[::1]")):
+        return True
+    remote = await fetch_remote_machine_id(host)
+    return remote is not None and remote == get_machine_id()
+
+
+async def classify_host(host: dict) -> str:
+    """'local' | 'remote' — used to decide media sync + callback URLs when
+    config doesn't pin a type (reference auto-classifies the same way)."""
+    declared = host.get("type")
+    if declared in ("local", "remote"):
+        return declared
+    return "local" if await is_local_host(host) else "remote"
+
+
+def auto_populate_hosts(config: dict, base_port: Optional[int] = None) -> bool:
+    """First-launch auto-configuration (reference auto-creates one worker
+    per non-master CUDA device at ports 8189+, ``web/masterDetection.js:36-100``
+    guarded by ``has_auto_populated_workers``).
+
+    TPU translation (SURVEY §5.6): chips on one host are mesh slots inside a
+    single controller, so nothing is populated for a single multi-chip host.
+    Only when the TPU runtime advertises *other hosts* in the slice
+    (``TPU_WORKER_HOSTNAMES``) does each get a controller entry. Returns
+    True when the config was modified.
+    """
+    settings = config.setdefault("settings", {})
+    if settings.get("has_auto_populated_workers"):
+        return False
+    settings["has_auto_populated_workers"] = True
+
+    if base_port is None:
+        # slice hosts all run `serve` with defaults, i.e. on master.port
+        base_port = config.get("master", {}).get("port", 8288)
+    hostnames = [h for h in os.environ.get("TPU_WORKER_HOSTNAMES", "").split(",")
+                 if h.strip()]
+    me = int(os.environ.get("TPU_WORKER_ID", "0") or 0)
+    hosts = config.setdefault("hosts", [])
+    existing_addrs = {h.get("address") for h in hosts}
+    existing_ids = {h.get("id") for h in hosts}
+    for i, name in enumerate(h.strip() for h in hostnames):
+        if i == me:
+            continue        # this controller is the master
+        address = f"{name}:{base_port}"
+        if address in existing_addrs:
+            continue
+        hid = f"host{i}"
+        while hid in existing_ids:
+            hid += "_auto"
+        existing_ids.add(hid)
+        hosts.append({
+            "id": hid,
+            "name": f"TPU host {i}",
+            "address": address,
+            "enabled": True,
+            "type": "remote",
+        })
+    return True             # the guard flag itself was set

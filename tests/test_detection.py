@@ -1,0 +1,114 @@
+"""Detection tests (parity model: reference tests/test_detection.py —
+machine-id, docker/cloud env, local-vs-remote classification)."""
+
+import asyncio
+
+from comfyui_distributed_tpu.workers import detection as det
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+class TestMachineId:
+    def test_stable(self):
+        assert det.get_machine_id() == det.get_machine_id()
+
+    def test_has_hostname_and_mac(self):
+        mid = det.get_machine_id()
+        assert "-" in mid
+        mac = mid.rsplit("-", 1)[1]
+        assert len(mac) == 12 and int(mac, 16) >= 0
+
+
+class TestEnvironment:
+    def test_detect_environment_keys(self):
+        env = det.detect_environment()
+        assert set(env) == {"machine_id", "platform", "docker",
+                            "kubernetes", "tpu"}
+
+    def test_tpu_environment_from_env(self, monkeypatch):
+        monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v5e-8")
+        monkeypatch.setenv("TPU_WORKER_ID", "0")
+        env = det.tpu_environment()
+        assert env["tpu_accelerator_type"] == "v5e-8"
+        assert env["tpu_worker_id"] == "0"
+
+    def test_kubernetes_flag(self, monkeypatch):
+        monkeypatch.setenv("KUBERNETES_SERVICE_HOST", "10.0.0.1")
+        assert det.is_kubernetes()
+        monkeypatch.delenv("KUBERNETES_SERVICE_HOST")
+        assert not det.is_kubernetes()
+
+
+class TestClassification:
+    def test_loopback_is_local(self):
+        assert run(det.is_local_host({"address": "http://127.0.0.1:8289"}))
+        assert run(det.is_local_host({"address": "localhost:8289"}))
+
+    def test_same_machine_id_is_local(self, monkeypatch):
+        async def fake_fetch(host):
+            return det.get_machine_id()
+        monkeypatch.setattr(det, "fetch_remote_machine_id", fake_fetch)
+        assert run(det.is_local_host({"address": "http://10.0.0.2:8289"}))
+
+    def test_different_machine_id_is_remote(self, monkeypatch):
+        async def fake_fetch(host):
+            return "other-machine-000000000000"
+        monkeypatch.setattr(det, "fetch_remote_machine_id", fake_fetch)
+        assert not run(det.is_local_host({"address": "http://10.0.0.2:8289"}))
+
+    def test_unreachable_is_remote(self, monkeypatch):
+        async def fake_fetch(host):
+            return None
+        monkeypatch.setattr(det, "fetch_remote_machine_id", fake_fetch)
+        assert not run(det.is_local_host({"address": "http://10.0.0.2:8289"}))
+
+    def test_declared_type_wins(self):
+        assert run(det.classify_host({"type": "remote",
+                                      "address": "127.0.0.1"})) == "remote"
+        assert run(det.classify_host({"type": "local",
+                                      "address": "10.9.9.9"})) == "local"
+
+
+class TestAutoPopulate:
+    def test_populates_other_slice_hosts(self, monkeypatch):
+        monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "t1k-0,t1k-1,t1k-2")
+        monkeypatch.setenv("TPU_WORKER_ID", "0")
+        cfg = {"hosts": [], "settings": {}, "master": {"port": 8288}}
+        assert det.auto_populate_hosts(cfg)
+        addrs = [h["address"] for h in cfg["hosts"]]
+        # slice hosts serve on the same default port as the master
+        assert addrs == ["t1k-1:8288", "t1k-2:8288"]
+        assert all(h["type"] == "remote" and h["enabled"]
+                   for h in cfg["hosts"])
+        assert cfg["settings"]["has_auto_populated_workers"]
+
+    def test_guard_flag_prevents_repopulation(self, monkeypatch):
+        monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "a,b")
+        monkeypatch.setenv("TPU_WORKER_ID", "0")
+        cfg = {"hosts": [], "settings": {"has_auto_populated_workers": True}}
+        assert not det.auto_populate_hosts(cfg)
+        assert cfg["hosts"] == []
+
+    def test_single_host_populates_nothing(self, monkeypatch):
+        monkeypatch.delenv("TPU_WORKER_HOSTNAMES", raising=False)
+        cfg = {"hosts": [], "settings": {}}
+        det.auto_populate_hosts(cfg)
+        assert cfg["hosts"] == []
+
+    def test_existing_address_not_duplicated(self, monkeypatch):
+        monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "a,b")
+        monkeypatch.setenv("TPU_WORKER_ID", "0")
+        cfg = {"hosts": [{"id": "x", "address": "b:8288"}], "settings": {}}
+        det.auto_populate_hosts(cfg)
+        assert [h["address"] for h in cfg["hosts"]] == ["b:8288"]
+
+    def test_id_collision_avoided(self, monkeypatch):
+        monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "a,b")
+        monkeypatch.setenv("TPU_WORKER_ID", "0")
+        cfg = {"hosts": [{"id": "host1", "address": "elsewhere:9999"}],
+               "settings": {}}
+        det.auto_populate_hosts(cfg)
+        ids = [h["id"] for h in cfg["hosts"]]
+        assert len(ids) == len(set(ids)) == 2

@@ -1,0 +1,210 @@
+"""Media sync tests (parity model: reference tests/api/test_media_sync.py —
+path-conversion matrix + sync logic against mocked transports)."""
+
+import asyncio
+from pathlib import Path
+
+import pytest
+
+from comfyui_distributed_tpu.cluster import media_sync as ms
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def prompt_with(image="photo.png", extra_inputs=None):
+    inputs = {"image": image}
+    inputs.update(extra_inputs or {})
+    return {
+        "1": {"class_type": "LoadImage", "inputs": inputs},
+        "2": {"class_type": "SaveImage", "inputs": {"images": ["1", 0]}},
+    }
+
+
+class TestFindMediaRefs:
+    def test_finds_image_input(self):
+        refs = ms.find_media_refs(prompt_with("cat.png"))
+        assert refs == [ms.MediaRef("1", "image", "cat.png")]
+
+    def test_all_media_extensions(self):
+        for ext in (".png", ".jpg", ".webp", ".mp4", ".wav", ".npz"):
+            assert ms.looks_like_media(f"x{ext}")
+            assert ms.looks_like_media(f"x{ext.upper()}")
+
+    def test_non_media_value_ignored(self):
+        refs = ms.find_media_refs(prompt_with("not a file"))
+        assert refs == []
+
+    def test_non_media_key_ignored(self):
+        # a STRING prompt mentioning foo.png must not be synced
+        p = {"1": {"class_type": "CLIPTextEncode",
+                   "inputs": {"text": "a poster of foo.png"}}}
+        assert ms.find_media_refs(p) == []
+
+    def test_link_values_ignored(self):
+        p = {"1": {"class_type": "X", "inputs": {"image": ["0", 0]}}}
+        assert ms.find_media_refs(p) == []
+
+    def test_video_and_audio_keys(self):
+        p = {
+            "1": {"class_type": "A", "inputs": {"video": "clip.mp4"}},
+            "2": {"class_type": "B", "inputs": {"audio": "song.wav"}},
+            "3": {"class_type": "C", "inputs": {"file": "arr.npz"}},
+        }
+        keys = {(r.node_id, r.input_key) for r in ms.find_media_refs(p)}
+        assert keys == {("1", "video"), ("2", "audio"), ("3", "file")}
+
+
+class TestConvertPaths:
+    def test_unix_to_windows(self):
+        p = prompt_with("subdir/cat.png")
+        out = ms.convert_paths_for_platform(p, "\\")
+        assert out["1"]["inputs"]["image"] == "subdir\\cat.png"
+
+    def test_windows_to_unix(self):
+        p = prompt_with("subdir\\cat.png")
+        out = ms.convert_paths_for_platform(p, "/")
+        assert out["1"]["inputs"]["image"] == "subdir/cat.png"
+
+    def test_no_separator_untouched(self):
+        p = prompt_with("cat.png")
+        out = ms.convert_paths_for_platform(p, "\\")
+        assert out["1"]["inputs"]["image"] == "cat.png"
+
+    def test_original_not_mutated(self):
+        p = prompt_with("a/b.png")
+        ms.convert_paths_for_platform(p, "\\")
+        assert p["1"]["inputs"]["image"] == "a/b.png"
+
+    def test_bogus_separator_noop(self):
+        p = prompt_with("a/b.png")
+        assert ms.convert_paths_for_platform(p, "|") is p
+
+
+class TestSyncHostMedia:
+    @pytest.fixture
+    def input_dir(self, tmp_path):
+        (tmp_path / "photo.png").write_bytes(b"PNGDATA")
+        return tmp_path
+
+    def patch_transport(self, monkeypatch, *, exists=False, matches=False,
+                        upload_ok=True, sep="/"):
+        calls = {"check": [], "upload": []}
+
+        async def fake_sep(host, timeout=10.0):
+            return sep
+
+        async def fake_check(host, rel, md5, timeout):
+            calls["check"].append(rel)
+            return exists and matches
+
+        async def fake_upload(host, rel, path, timeout):
+            calls["upload"].append((rel, path.read_bytes()))
+            return upload_ok
+
+        monkeypatch.setattr(ms, "fetch_host_path_separator", fake_sep)
+        monkeypatch.setattr(ms, "_check_remote_file", fake_check)
+        monkeypatch.setattr(ms, "_upload_file", fake_upload)
+        return calls
+
+    def test_uploads_on_miss(self, monkeypatch, input_dir):
+        calls = self.patch_transport(monkeypatch, exists=False)
+        out, report = run(ms.sync_host_media(
+            {"id": "w0"}, prompt_with(), input_dir=input_dir))
+        assert report.uploaded == 1 and report.skipped == 0
+        assert calls["upload"] == [("photo.png", b"PNGDATA")]
+
+    def test_skips_when_content_matches(self, monkeypatch, input_dir):
+        calls = self.patch_transport(monkeypatch, exists=True, matches=True)
+        out, report = run(ms.sync_host_media(
+            {"id": "w0"}, prompt_with(), input_dir=input_dir))
+        assert report.skipped == 1 and report.uploaded == 0
+        assert calls["upload"] == []
+
+    def test_missing_local_file_skipped(self, monkeypatch, input_dir):
+        calls = self.patch_transport(monkeypatch)
+        out, report = run(ms.sync_host_media(
+            {"id": "w0"}, prompt_with("absent.png"), input_dir=input_dir))
+        assert report.missing == 1
+        assert calls["upload"] == [] and calls["check"] == []
+
+    def test_upload_failure_reported(self, monkeypatch, input_dir):
+        self.patch_transport(monkeypatch, upload_ok=False)
+        out, report = run(ms.sync_host_media(
+            {"id": "w0"}, prompt_with(), input_dir=input_dir))
+        assert report.failed == ["photo.png"]
+
+    def test_no_refs_short_circuits(self, monkeypatch):
+        # transport must never be touched for a media-free prompt
+        async def boom(*a, **k):
+            raise AssertionError("transport touched")
+        monkeypatch.setattr(ms, "fetch_host_path_separator", boom)
+        p = {"1": {"class_type": "X", "inputs": {"seed": 1}}}
+        out, report = run(ms.sync_host_media({"id": "w0"}, p))
+        assert out is p and report.checked == 0
+
+    def test_path_conversion_applied_to_result(self, monkeypatch, tmp_path):
+        sub = tmp_path / "dir"
+        sub.mkdir()
+        (sub / "cat.png").write_bytes(b"X")
+        self.patch_transport(monkeypatch, sep="\\")
+        out, _ = run(ms.sync_host_media(
+            {"id": "w0"}, prompt_with("dir/cat.png"), input_dir=tmp_path))
+        assert out["1"]["inputs"]["image"] == "dir\\cat.png"
+
+    def test_concurrency_bounded(self, monkeypatch, tmp_path):
+        n = 8
+        for i in range(n):
+            (tmp_path / f"f{i}.png").write_bytes(b"D")
+        p = {str(i): {"class_type": "LoadImage",
+                      "inputs": {"image": f"f{i}.png"}} for i in range(n)}
+        active = peak = 0
+
+        async def fake_sep(host, timeout=10.0):
+            return "/"
+
+        async def fake_check(host, rel, md5, timeout):
+            nonlocal active, peak
+            active += 1
+            peak = max(peak, active)
+            await asyncio.sleep(0.01)
+            active -= 1
+            return True
+
+        monkeypatch.setattr(ms, "fetch_host_path_separator", fake_sep)
+        monkeypatch.setattr(ms, "_check_remote_file", fake_check)
+        out, report = run(ms.sync_host_media(
+            {"id": "w0"}, p, input_dir=tmp_path, concurrency=2))
+        assert report.skipped == n
+        assert peak <= 2
+
+
+class TestServerRoutes:
+    """check_file round-trip against the real aiohttp app."""
+
+    def test_check_file_roundtrip(self, tmp_config, tmp_path, monkeypatch):
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from comfyui_distributed_tpu.api.app import create_app
+        from comfyui_distributed_tpu.cluster.controller import Controller
+
+        monkeypatch.setenv("CDT_INPUT_DIR", str(tmp_path))
+        (tmp_path / "a.png").write_bytes(b"HELLO")
+
+        async def body():
+            app = create_app(Controller())
+            async with TestClient(TestServer(app)) as client:
+                import hashlib
+                md5 = hashlib.md5(b"HELLO").hexdigest()
+                r = await client.post("/distributed/check_file",
+                                      json={"path": "a.png", "md5": md5})
+                body1 = await r.json()
+                assert body1 == {"exists": True, "md5": md5, "matches": True}
+                r = await client.post("/distributed/check_file",
+                                      json={"path": "a.png", "md5": "0" * 32})
+                assert (await r.json())["matches"] is False
+                r = await client.post("/distributed/check_file",
+                                      json={"path": "missing.png"})
+                assert (await r.json()) == {"exists": False}
+        run(body())
