@@ -104,7 +104,9 @@ class Attention(nn.Module):
         q = q.reshape(B, N, self.num_heads, self.head_dim)
         k = k.reshape(B, M, self.num_heads, self.head_dim)
         v = v.reshape(B, M, self.num_heads, self.head_dim)
-        out = jax.nn.dot_product_attention(q, k, v)
+        from ..ops.attention import full_attention
+
+        out = full_attention(q, k, v)
         out = out.reshape(B, N, inner)
         return nn.Dense(x.shape[-1], dtype=self.dtype, name="to_out")(out)
 

@@ -26,8 +26,36 @@ import jax.numpy as jnp
 from ..utils import constants
 
 
+def _pvary(x, axis):
+    """Mark ``x`` axis-varying (jax>=0.9 renamed pvary → pcast)."""
+    if hasattr(jax.lax, "pcast"):
+        return jax.lax.pcast(x, axis, to="varying")
+    return jax.lax.pvary(x, axis)
+
+
+def _flash_enabled() -> bool:
+    """Pallas flash attention: env-forceable, default on for TPU only
+    (the interpreter path is for tests, not production CPU use)."""
+    import os
+
+    flag = os.environ.get("CDT_FLASH_ATTENTION", "").lower()
+    if flag in ("1", "true", "on"):
+        return True
+    if flag in ("0", "false", "off"):
+        return False
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except RuntimeError:
+        return False
+
+
 def full_attention(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
-    """Dense [B,N,H,D] attention (XLA picks the fused lowering)."""
+    """Dense [B,N,H,D] attention: pallas flash kernel on TPU, XLA's fused
+    lowering elsewhere."""
+    if _flash_enabled():
+        from .flash_attention import flash_attention
+
+        return flash_attention(q, k, v)
     return jax.nn.dot_product_attention(q, k, v)
 
 
@@ -74,9 +102,9 @@ def ring_attention(
 
     # initial carries must be marked axis-varying for the fori_loop carry
     # types to match (they mix with shard-varying q/k/v on step one)
-    m0 = jax.lax.pvary(jnp.full((B, H, Nq), -jnp.inf, jnp.float32), axis)
-    l0 = jax.lax.pvary(jnp.zeros((B, H, Nq), jnp.float32), axis)
-    acc0 = jax.lax.pvary(jnp.zeros((B, Nq, H, D), jnp.float32), axis)
+    m0 = _pvary(jnp.full((B, H, Nq), -jnp.inf, jnp.float32), axis)
+    l0 = _pvary(jnp.zeros((B, H, Nq), jnp.float32), axis)
+    acc0 = _pvary(jnp.zeros((B, Nq, H, D), jnp.float32), axis)
     m, l, acc, _, _ = jax.lax.fori_loop(
         0, n_shards, body, (m0, l0, acc0, k, v))
     out = acc / l.transpose(0, 2, 1)[..., None]
@@ -109,9 +137,9 @@ def joint_ring_attention(
     m0, l0, acc0 = _flash_block(
         qf, txt_k.astype(jnp.float32), txt_v.astype(jnp.float32),
         m0, l0, acc0, scale)
-    m0 = jax.lax.pvary(m0, axis)
-    l0 = jax.lax.pvary(l0, axis)
-    acc0 = jax.lax.pvary(acc0, axis)
+    m0 = _pvary(m0, axis)
+    l0 = _pvary(l0, axis)
+    acc0 = _pvary(acc0, axis)
 
     def body(i, carry):
         m, l, acc, k_cur, v_cur = carry

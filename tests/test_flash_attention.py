@@ -1,0 +1,100 @@
+"""Pallas flash-attention kernel tests (interpret mode on the CPU mesh;
+numerics checked against dense attention)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from comfyui_distributed_tpu.ops.flash_attention import flash_attention
+
+
+def dense_reference(q, k, v):
+    scale = 1.0 / (q.shape[-1] ** 0.5)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32))
+
+
+def rand_qkv(key, B=1, Nq=128, Nk=128, H=2, D=64, dtype=jnp.float32):
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (B, Nq, H, D), dtype)
+    k = jax.random.normal(kk, (B, Nk, H, D), dtype)
+    v = jax.random.normal(kv, (B, Nk, H, D), dtype)
+    return q, k, v
+
+
+class TestNumerics:
+    def test_block_aligned(self):
+        q, k, v = rand_qkv(jax.random.key(0), Nq=256, Nk=256)
+        out = flash_attention(q, k, v, interpret=True)
+        ref = dense_reference(q, k, v)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+    def test_ragged_lengths_masked(self):
+        """Nq/Nk not multiples of the block sizes → padding is masked out."""
+        q, k, v = rand_qkv(jax.random.key(1), Nq=100, Nk=77)
+        out = flash_attention(q, k, v, interpret=True)
+        ref = dense_reference(q, k, v)
+        assert out.shape == (1, 100, 2, 64)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+    def test_multi_kv_blocks_accumulate(self):
+        """Nk spanning several K blocks exercises the streaming-softmax
+        carry (running max / denominator / accumulator rescale)."""
+        q, k, v = rand_qkv(jax.random.key(2), Nq=128, Nk=512)
+        out = flash_attention(q, k, v, block_k=128, interpret=True)
+        ref = dense_reference(q, k, v)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+    def test_bf16_inputs(self):
+        q, k, v = rand_qkv(jax.random.key(3), Nq=128, Nk=256,
+                           dtype=jnp.bfloat16)
+        out = flash_attention(q, k, v, interpret=True)
+        ref = dense_reference(q, k, v)
+        assert out.dtype == jnp.bfloat16
+        np.testing.assert_allclose(out.astype(np.float32), ref,
+                                   atol=2e-2, rtol=2e-2)
+
+    def test_extreme_logits_stable(self):
+        """Large-magnitude logits must not overflow exp (running-max
+        subtraction)."""
+        q, k, v = rand_qkv(jax.random.key(4), Nq=128, Nk=256)
+        q = q * 30.0
+        out = flash_attention(q, k, v, interpret=True)
+        ref = dense_reference(q, k, v)
+        assert np.isfinite(np.asarray(out)).all()
+        np.testing.assert_allclose(out, ref, atol=2e-4, rtol=2e-4)
+
+    def test_batch_and_heads(self):
+        q, k, v = rand_qkv(jax.random.key(5), B=2, Nq=64, Nk=64, H=4, D=32)
+        out = flash_attention(q, k, v, interpret=True)
+        ref = dense_reference(q, k, v)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+    def test_cross_attention_shape(self):
+        """Cross attention: 77-token text context vs image queries."""
+        q, k, v = rand_qkv(jax.random.key(6), Nq=256, Nk=77)
+        out = flash_attention(q, k, v, interpret=True)
+        ref = dense_reference(q, k, v)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+class TestDispatch:
+    def test_full_attention_env_toggle(self, monkeypatch):
+        from comfyui_distributed_tpu.ops import attention as attn
+
+        monkeypatch.setenv("CDT_FLASH_ATTENTION", "0")
+        assert not attn._flash_enabled()
+        monkeypatch.setenv("CDT_FLASH_ATTENTION", "1")
+        assert attn._flash_enabled()
+
+    def test_full_attention_uses_flash_when_forced(self, monkeypatch):
+        from comfyui_distributed_tpu.ops import attention as attn
+
+        monkeypatch.setenv("CDT_FLASH_ATTENTION", "1")
+        q, k, v = rand_qkv(jax.random.key(7), Nq=64, Nk=64)
+        out = attn.full_attention(q, k, v)
+        ref = dense_reference(q, k, v)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
