@@ -18,7 +18,7 @@ from ..cluster.job_timeout import check_and_requeue_timed_out_workers
 from ..utils import constants
 from ..utils.exceptions import DistributedError, ValidationError
 from ..utils.logging import log
-from . import config_routes, info_routes, usdu_routes, worker_routes
+from . import config_routes, info_routes, tunnel_routes, usdu_routes, worker_routes
 from .queue_request import parse_queue_request_payload
 
 
@@ -188,6 +188,7 @@ def create_app(controller: Controller) -> web.Application:
     r.add_post("/distributed/load_image", load_image)
     r.add_post("/upload/image", upload_image)
 
+    tunnel_routes.register(r, controller)
     usdu_routes.register(r, controller)
     config_routes.register(r, controller)
     info_routes.register(r, controller)

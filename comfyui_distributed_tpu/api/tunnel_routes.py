@@ -1,0 +1,34 @@
+"""Tunnel control routes (parity: reference ``api/tunnel_routes.py:10-51``
+— GET status, POST start/stop)."""
+
+from __future__ import annotations
+
+from aiohttp import web
+
+from ..utils.exceptions import TunnelError
+from ..utils.tunnel import get_tunnel_manager
+
+
+def register(router, controller) -> None:
+    def manager():
+        return get_tunnel_manager(controller.config_path)
+
+    async def tunnel_status(request):
+        return web.json_response(manager().status())
+
+    async def tunnel_start(request):
+        port = controller.load_config().get("master", {}).get("port", 8288)
+        try:
+            url = await manager().start_tunnel(port)
+        except TunnelError as e:
+            return web.json_response({"error": str(e)}, status=503)
+        return web.json_response({"status": "started", "url": url})
+
+    async def tunnel_stop(request):
+        stopped = await manager().stop_tunnel()
+        return web.json_response(
+            {"status": "stopped" if stopped else "not_running"})
+
+    router.add_get("/distributed/tunnel/status", tunnel_status)
+    router.add_post("/distributed/tunnel/start", tunnel_start)
+    router.add_post("/distributed/tunnel/stop", tunnel_stop)

@@ -1,0 +1,192 @@
+"""Cloudflare quick-tunnel manager for NAT traversal to remote hosts.
+
+Parity: reference ``utils/cloudflare/`` — tunnel lifecycle under an async
+lock with state restore from config (``tunnel.py:19-207``), binary
+discovery (``binary.py:69-83``), a stdout reader thread capturing the
+``*.trycloudflare.com`` URL plus errors into a rolling buffer
+(``process_reader.py:14-97``), and state persistence that swaps the
+config's master host to the public URL so remote workers call back through
+the tunnel, restoring the previous host on stop (``state.py:28-81``).
+
+Difference: the reference downloads ``cloudflared`` from GitHub at runtime
+(``binary.py:47-66``); this build only *discovers* an installed binary
+(env ``CLOUDFLARED_PATH`` → package-local ``bin/`` → ``$PATH``) and reports
+a clear error otherwise — the controller may run with zero egress, and a
+framework should not fetch executables behind the operator's back.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import re
+import shutil
+import subprocess
+import threading
+import time
+from collections import deque
+from pathlib import Path
+from typing import Optional
+
+from .config import load_config, update_config
+from .exceptions import TunnelError
+from .logging import debug_log, log
+
+URL_RE = re.compile(r"https://[a-z0-9-]+\.trycloudflare\.com")
+START_TIMEOUT = float(os.environ.get("CDT_TUNNEL_START_TIMEOUT", "30"))
+LOG_BUFFER_LINES = 200
+
+
+def find_cloudflared() -> Optional[str]:
+    """Binary discovery (reference ``binary.py:69-83``), no download."""
+    env = os.environ.get("CLOUDFLARED_PATH")
+    if env and Path(env).is_file():
+        return env
+    local = Path(__file__).resolve().parent.parent / "bin" / "cloudflared"
+    if local.is_file():
+        return str(local)
+    return shutil.which("cloudflared")
+
+
+class _ProcessReader(threading.Thread):
+    """Scan tunnel stdout for the public URL + keep a rolling log buffer
+    (reference ``process_reader.py:14-97``)."""
+
+    def __init__(self, proc: subprocess.Popen):
+        super().__init__(daemon=True)
+        self.proc = proc
+        self.url: Optional[str] = None
+        self.error: Optional[str] = None
+        self.lines: deque[str] = deque(maxlen=LOG_BUFFER_LINES)
+        self._url_event = threading.Event()
+
+    def run(self) -> None:
+        stream = self.proc.stdout
+        if stream is None:
+            return
+        for raw in stream:
+            line = raw.decode("utf-8", "replace").rstrip() \
+                if isinstance(raw, bytes) else raw.rstrip()
+            self.lines.append(line)
+            if self.url is None:
+                m = URL_RE.search(line)
+                if m:
+                    self.url = m.group(0)
+                    self._url_event.set()
+            low = line.lower()
+            if "error" in low and self.error is None:
+                self.error = line
+
+    def wait_for_url(self, timeout: float) -> Optional[str]:
+        self._url_event.wait(timeout)
+        return self.url
+
+
+class TunnelManager:
+    """Lifecycle of one quick tunnel exposing this controller's port."""
+
+    def __init__(self, config_path: Optional[Path] = None):
+        self.config_path = config_path
+        self._lock = asyncio.Lock()
+        self._proc: Optional[subprocess.Popen] = None
+        self._reader: Optional[_ProcessReader] = None
+        self.url: Optional[str] = None
+
+    # --- status -------------------------------------------------------------
+
+    @property
+    def running(self) -> bool:
+        return self._proc is not None and self._proc.poll() is None
+
+    def status(self) -> dict:
+        cfg_tunnel = load_config(self.config_path).get("tunnel", {})
+        return {
+            "running": self.running,
+            "url": self.url or cfg_tunnel.get("url"),
+            "enabled": bool(cfg_tunnel.get("enabled")),
+            "binary": find_cloudflared(),
+            "log": list(self._reader.lines) if self._reader else [],
+            "error": self._reader.error if self._reader else None,
+        }
+
+    # --- lifecycle ----------------------------------------------------------
+
+    async def start_tunnel(self, port: int) -> str:
+        async with self._lock:
+            if self.running and self.url:
+                return self.url
+            binary = find_cloudflared()
+            if not binary:
+                raise TunnelError(
+                    "cloudflared binary not found — install it or set "
+                    "CLOUDFLARED_PATH (this framework does not auto-download "
+                    "executables)")
+            cmd = [binary, "tunnel", "--url", f"http://127.0.0.1:{port}"]
+            debug_log(f"starting tunnel: {' '.join(cmd)}")
+            self._proc = subprocess.Popen(
+                cmd, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+            self._reader = _ProcessReader(self._proc)
+            self._reader.start()
+            url = await asyncio.get_running_loop().run_in_executor(
+                None, self._reader.wait_for_url, START_TIMEOUT)
+            if not url:
+                err = self._reader.error or "no URL within timeout"
+                await self._stop_locked()
+                raise TunnelError(f"tunnel failed to start: {err}")
+            self.url = url
+            self._persist_started(url, port)
+            log(f"tunnel up: {url}")
+            return url
+
+    async def stop_tunnel(self) -> bool:
+        async with self._lock:
+            return await self._stop_locked()
+
+    async def _stop_locked(self) -> bool:
+        was_running = self.running
+        if self._proc is not None:
+            self._proc.terminate()
+            try:
+                await asyncio.get_running_loop().run_in_executor(
+                    None, self._proc.wait, 5)
+            except subprocess.TimeoutExpired:
+                self._proc.kill()
+            self._proc = None
+        self.url = None
+        self._persist_stopped()
+        return was_running
+
+    # --- state persistence (reference state.py:28-81) -----------------------
+
+    def _persist_started(self, url: str, port: int) -> None:
+        def mutate(cfg: dict) -> None:
+            tunnel = cfg.setdefault("tunnel", {})
+            master = cfg.setdefault("master", {})
+            # remote workers must call back through the tunnel: swap the
+            # advertised master host, remembering the previous value
+            if master.get("host") != url:
+                tunnel["previous_master_host"] = master.get("host", "")
+            tunnel.update(enabled=True, url=url, port=port,
+                          started_at=time.time())
+            master["host"] = url
+        update_config(mutate, self.config_path)
+
+    def _persist_stopped(self) -> None:
+        def mutate(cfg: dict) -> None:
+            tunnel = cfg.setdefault("tunnel", {})
+            master = cfg.setdefault("master", {})
+            if tunnel.get("url") and master.get("host") == tunnel["url"]:
+                master["host"] = tunnel.get("previous_master_host", "")
+            tunnel.update(enabled=False, url=None)
+        update_config(mutate, self.config_path)
+
+
+_manager: Optional[TunnelManager] = None
+
+
+def get_tunnel_manager(config_path: Optional[Path] = None) -> TunnelManager:
+    global _manager
+    if _manager is None or (config_path is not None
+                            and _manager.config_path != config_path):
+        _manager = TunnelManager(config_path)
+    return _manager

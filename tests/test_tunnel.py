@@ -1,0 +1,150 @@
+"""Tunnel manager tests — fake cloudflared binary (a shell script that
+prints a trycloudflare URL then sleeps), URL capture, config master-host
+swap/restore, missing-binary gating.
+
+The reference ships no tunnel tests (SURVEY §4 gap); these cover its
+state machine: ``utils/cloudflare/tunnel.py:56-207``, ``state.py:28-81``.
+"""
+
+import asyncio
+import os
+import stat
+import textwrap
+
+import pytest
+
+from comfyui_distributed_tpu.utils import tunnel as tunnel_mod
+from comfyui_distributed_tpu.utils.config import load_config, update_config
+from comfyui_distributed_tpu.utils.exceptions import TunnelError
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+@pytest.fixture
+def fake_cloudflared(tmp_path, monkeypatch):
+    """A stand-in binary emitting the startup banner + quick-tunnel URL."""
+    script = tmp_path / "cloudflared"
+    script.write_text(textwrap.dedent("""\
+        #!/bin/sh
+        echo "2026-07-29 INF Thank you for trying Cloudflare Tunnel."
+        echo "2026-07-29 INF +--------------------------------------+"
+        echo "2026-07-29 INF |  https://random-words-here.trycloudflare.com  |"
+        echo "2026-07-29 INF +--------------------------------------+"
+        sleep 30
+    """))
+    script.chmod(script.stat().st_mode | stat.S_IEXEC)
+    monkeypatch.setenv("CLOUDFLARED_PATH", str(script))
+    return script
+
+
+@pytest.fixture
+def failing_cloudflared(tmp_path, monkeypatch):
+    script = tmp_path / "cloudflared"
+    script.write_text("#!/bin/sh\necho 'ERR error=failed to request quick tunnel'\nexit 1\n")
+    script.chmod(script.stat().st_mode | stat.S_IEXEC)
+    monkeypatch.setenv("CLOUDFLARED_PATH", str(script))
+    monkeypatch.setattr(tunnel_mod, "START_TIMEOUT", 2.0)
+    return script
+
+
+class TestDiscovery:
+    def test_env_path_wins(self, fake_cloudflared):
+        assert tunnel_mod.find_cloudflared() == str(fake_cloudflared)
+
+    def test_missing_binary(self, monkeypatch):
+        monkeypatch.delenv("CLOUDFLARED_PATH", raising=False)
+        monkeypatch.setattr(tunnel_mod.shutil, "which", lambda _: None)
+        assert tunnel_mod.find_cloudflared() is None
+
+    def test_start_without_binary_raises(self, tmp_config, monkeypatch):
+        monkeypatch.delenv("CLOUDFLARED_PATH", raising=False)
+        monkeypatch.setattr(tunnel_mod.shutil, "which", lambda _: None)
+        mgr = tunnel_mod.TunnelManager(tmp_config)
+        with pytest.raises(TunnelError, match="not found"):
+            run(mgr.start_tunnel(8288))
+
+
+class TestLifecycle:
+    def test_start_captures_url_and_swaps_master_host(
+            self, tmp_config, fake_cloudflared):
+        update_config(lambda c: c["master"].update(host="10.0.0.5"),
+                      tmp_config)
+        mgr = tunnel_mod.TunnelManager(tmp_config)
+        url = run(mgr.start_tunnel(8288))
+        assert url == "https://random-words-here.trycloudflare.com"
+        assert mgr.running
+        cfg = load_config(tmp_config)
+        assert cfg["master"]["host"] == url
+        assert cfg["tunnel"]["enabled"] is True
+        assert cfg["tunnel"]["previous_master_host"] == "10.0.0.5"
+        run(mgr.stop_tunnel())
+
+    def test_stop_restores_master_host(self, tmp_config, fake_cloudflared):
+        update_config(lambda c: c["master"].update(host="10.0.0.5"),
+                      tmp_config)
+        mgr = tunnel_mod.TunnelManager(tmp_config)
+        run(mgr.start_tunnel(8288))
+        assert run(mgr.stop_tunnel()) is True
+        cfg = load_config(tmp_config)
+        assert cfg["master"]["host"] == "10.0.0.5"
+        assert cfg["tunnel"]["enabled"] is False
+        assert not mgr.running
+
+    def test_start_idempotent(self, tmp_config, fake_cloudflared):
+        mgr = tunnel_mod.TunnelManager(tmp_config)
+
+        async def body():
+            u1 = await mgr.start_tunnel(8288)
+            u2 = await mgr.start_tunnel(8288)   # second call: same tunnel
+            return u1, u2
+        u1, u2 = run(body())
+        assert u1 == u2
+        run(mgr.stop_tunnel())
+
+    def test_stop_when_not_running(self, tmp_config):
+        mgr = tunnel_mod.TunnelManager(tmp_config)
+        assert run(mgr.stop_tunnel()) is False
+
+    def test_failed_start_raises_with_error_line(
+            self, tmp_config, failing_cloudflared):
+        mgr = tunnel_mod.TunnelManager(tmp_config)
+        with pytest.raises(TunnelError, match="failed"):
+            run(mgr.start_tunnel(8288))
+        assert not mgr.running
+
+    def test_status_reports_log_buffer(self, tmp_config, fake_cloudflared):
+        mgr = tunnel_mod.TunnelManager(tmp_config)
+        run(mgr.start_tunnel(8288))
+        st = mgr.status()
+        assert st["running"] and st["url"].startswith("https://")
+        assert any("trycloudflare.com" in ln for ln in st["log"])
+        run(mgr.stop_tunnel())
+
+
+class TestRoutes:
+    def test_status_route(self, tmp_config, monkeypatch):
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from comfyui_distributed_tpu.api.app import create_app
+        from comfyui_distributed_tpu.cluster.controller import Controller
+
+        monkeypatch.delenv("CLOUDFLARED_PATH", raising=False)
+        tunnel_mod._manager = None
+
+        async def body():
+            app = create_app(Controller())
+            async with TestClient(TestServer(app)) as client:
+                r = await client.get("/distributed/tunnel/status")
+                st = await r.json()
+                assert st["running"] is False
+                # start without a binary → clean 503, not a 500
+                monkeypatch.setattr(tunnel_mod.shutil, "which", lambda _: None)
+                r = await client.post("/distributed/tunnel/start")
+                assert r.status == 503
+                assert "not found" in (await r.json())["error"]
+                r = await client.post("/distributed/tunnel/stop")
+                assert (await r.json())["status"] == "not_running"
+        run(body())
+        tunnel_mod._manager = None
