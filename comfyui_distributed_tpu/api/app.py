@@ -117,6 +117,60 @@ def create_app(controller: Controller) -> web.Application:
         await controller.store.put_collector_result(body["job_id"], body)
         return web.json_response({"status": "received"})
 
+    async def job_complete_frames(request):
+        """Binary-frame collector ingest (native codec multipart) — the
+        preferred cross-host transport; the base64 JSON route above stays
+        for parity/fallback."""
+        from .. import native
+
+        if request.content_length and request.content_length > constants.MAX_PAYLOAD_SIZE:
+            return json_error("payload too large", 413)
+        reader = await request.multipart()
+        meta = None
+        frames: dict[int, "np.ndarray"] = {}
+        async for part in reader:
+            if part.name == "metadata":
+                try:
+                    meta = json.loads(await part.text())
+                except json.JSONDecodeError:
+                    raise ValidationError("metadata must be valid JSON")
+            elif part.name and part.name.startswith("frame_"):
+                try:
+                    idx = int(part.name[len("frame_"):])
+                except ValueError:
+                    raise ValidationError(f"bad frame part name {part.name!r}")
+                try:
+                    frames[idx] = native.unpack_frame(await part.read())
+                except ValueError as e:
+                    raise ValidationError(f"frame {idx}: {e}")
+        if meta is None:
+            raise ValidationError("missing metadata part")
+        for field in ("job_id", "worker_id"):
+            if not isinstance(meta.get(field), str) or not meta[field]:
+                raise ValidationError(f"missing or invalid {field!r}", field=field)
+        count = int(meta.get("count", len(frames)))
+        if count and sorted(frames) != list(range(count)):
+            raise ValidationError(
+                f"expected frames 0..{count - 1}, got {sorted(frames)}")
+        for i in range(count):
+            envelope = {
+                "job_id": meta["job_id"], "worker_id": meta["worker_id"],
+                "batch_idx": i, "image_arr": frames[i],
+                "is_last": i == count - 1,
+            }
+            if i == count - 1 and meta.get("audio"):
+                envelope["audio"] = meta["audio"]
+            await controller.store.put_collector_result(meta["job_id"], envelope)
+        if count == 0:
+            await controller.store.put_collector_result(meta["job_id"], {
+                "job_id": meta["job_id"], "worker_id": meta["worker_id"],
+                "batch_idx": -1, "is_last": True,
+                **({"audio": meta["audio"]} if meta.get("audio") else {}),
+            })
+        return web.json_response({"status": "received", "frames": count})
+
+    r.add_post("/distributed/job_complete_frames", job_complete_frames)
+
     async def prepare_job(request):
         body = await _json_body(request)
         job_id = body.get("job_id")

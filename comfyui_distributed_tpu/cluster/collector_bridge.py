@@ -17,6 +17,7 @@ a serialized envelope is genuinely required.
 from __future__ import annotations
 
 import asyncio
+import json
 import time
 from typing import Any, Optional, Sequence
 
@@ -53,10 +54,13 @@ class CollectorBridge:
 
     async def send_async(self, job_id: str, worker_id: str, images, audio,
                          master_url: str) -> None:
-        url = normalize_host_url(master_url) + "/distributed/job_complete"
         arr = to_uint8(images) if images is not None else np.zeros((0, 1, 1, 3), np.uint8)
         n = arr.shape[0]
         session = get_client_session()
+        if n and await self._send_frames(session, normalize_host_url(master_url),
+                                         job_id, worker_id, arr, audio):
+            return
+        url = normalize_host_url(master_url) + "/distributed/job_complete"
         for i in range(n):
             envelope: dict[str, Any] = {
                 "job_id": job_id,
@@ -74,6 +78,41 @@ class CollectorBridge:
                 "image": "", "is_last": True,
             })
         debug_log(f"collector[{job_id}] worker {worker_id} sent {n} images")
+
+    async def _send_frames(self, session, base_url: str, job_id: str,
+                           worker_id: str, arr: np.ndarray, audio) -> bool:
+        """Preferred transport: ONE multipart POST of crc-checked binary
+        frames (native codec) instead of per-image base64-PNG JSON — the
+        reference pays PNG+base64+HTTP per image (``collector.py:152-174``).
+        Returns False if the master doesn't accept frames (legacy peer);
+        caller falls back to the envelope protocol."""
+        from .. import native
+
+        url = base_url + "/distributed/job_complete_frames"
+        form = aiohttp.FormData()
+        meta: dict[str, Any] = {"job_id": job_id, "worker_id": worker_id,
+                                "count": int(arr.shape[0])}
+        if audio is not None:
+            meta["audio"] = encode_audio(audio)
+        form.add_field("metadata", json.dumps(meta),
+                       content_type="application/json")
+        for i in range(arr.shape[0]):
+            form.add_field(f"frame_{i}", native.pack_frame(arr[i], level=1),
+                           filename=f"frame_{i}.cdtf",
+                           content_type="application/x-cdt-frame")
+        try:
+            async with session.post(url, data=form) as resp:
+                if resp.status in (404, 405):
+                    return False          # legacy master: use envelopes
+                if resp.status < 400:
+                    debug_log(f"collector[{job_id}] worker {worker_id} sent "
+                              f"{arr.shape[0]} frames")
+                    return True
+                body = await resp.text()
+                raise WorkerError(f"frame send {resp.status}: {body[:200]}")
+        except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
+            debug_log(f"frame send failed ({e}); using envelope fallback")
+            return False
 
     async def _post_with_retry(self, session, url: str, payload: dict) -> None:
         """Exponential backoff ×SEND_MAX_RETRIES (reference
@@ -134,7 +173,11 @@ class CollectorBridge:
             except asyncio.TimeoutError:
                 continue
             w = envelope.get("worker_id", "")
-            if envelope.get("image"):
+            if envelope.get("image_arr") is not None:
+                per_worker.setdefault(w, {})[int(envelope.get("batch_idx", 0))] = (
+                    from_uint8(envelope["image_arr"])
+                )
+            elif envelope.get("image"):
                 per_worker.setdefault(w, {})[int(envelope.get("batch_idx", 0))] = (
                     decode_image_b64(envelope["image"])
                 )

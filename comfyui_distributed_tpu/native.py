@@ -1,0 +1,268 @@
+"""ctypes bindings for the native C++ data-plane library (``native/``).
+
+Everything here has a numpy fallback: the framework is fully functional
+without a C++ toolchain, and `is_native()` reports which path is active.
+The native build is attempted once per process (make in ``native/``) when
+the shared library is missing and ``g++`` is available.
+
+Surface:
+- ``pack_frame``/``unpack_frame`` — crc32-checked, optionally
+  zlib-compressed tensor frames (the cross-host wire format; replaces the
+  reference's base64-PNG JSON envelopes, ``nodes/collector.py:152-174``)
+- ``blend_tile``/``accumulate_tile`` — master-side feathered compositing
+  (reference ``upscale/tile_ops.py:289-349`` runs this per tile in
+  PIL/torch)
+- ``hash64`` — media-sync content hash (cheaper than md5 on video files)
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+import zlib
+from pathlib import Path
+from typing import Optional, Sequence
+
+import numpy as np
+
+from .utils.logging import debug_log
+
+_NATIVE_DIR = Path(__file__).resolve().parent.parent / "native"
+_LIB_NAME = "libcdt_native.so"
+
+# frame dtype codes (wire format)
+_DTYPES: dict[int, np.dtype] = {
+    0: np.dtype(np.uint8),
+    1: np.dtype(np.float32),
+    2: np.dtype(np.float16),
+    3: np.dtype(np.int32),
+    4: np.dtype(np.uint16),   # bfloat16 travels as raw uint16 bits
+}
+_DTYPE_CODES = {v: k for k, v in _DTYPES.items()}
+
+_MAGIC = b"CDTF"
+_VERSION = 1
+
+_lib: Optional[ctypes.CDLL] = None
+_lib_lock = threading.Lock()
+_load_attempted = False
+
+
+def _try_build() -> bool:
+    import shutil
+
+    if not shutil.which("make") or not shutil.which(
+            os.environ.get("CXX", "g++")):
+        return False
+    try:
+        subprocess.run(["make", "-s"], cwd=_NATIVE_DIR, check=True,
+                       capture_output=True, timeout=120)
+        return True
+    except (subprocess.SubprocessError, OSError) as e:
+        debug_log(f"native build failed: {e}")
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _load_attempted
+    with _lib_lock:
+        if _lib is not None or _load_attempted:
+            return _lib
+        _load_attempted = True
+        if os.environ.get("CDT_NO_NATIVE", "").lower() in ("1", "true"):
+            return None
+        so = _NATIVE_DIR / _LIB_NAME
+        if not so.is_file() and _NATIVE_DIR.is_dir():
+            _try_build()
+        if not so.is_file():
+            return None
+        try:
+            lib = ctypes.CDLL(str(so))
+        except OSError as e:
+            debug_log(f"native load failed: {e}")
+            return None
+        lib.cdt_hash64.restype = ctypes.c_uint64
+        lib.cdt_hash64.argtypes = [ctypes.c_char_p, ctypes.c_int64]
+        lib.cdt_frame_bound.restype = ctypes.c_int64
+        lib.cdt_frame_bound.argtypes = [ctypes.c_int64, ctypes.c_int32]
+        lib.cdt_pack_frame.restype = ctypes.c_int64
+        lib.cdt_pack_frame.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_int32,
+            ctypes.POINTER(ctypes.c_int64), ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_void_p, ctypes.c_int64]
+        lib.cdt_unpack_frame.restype = ctypes.c_int64
+        lib.cdt_unpack_frame.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p, ctypes.c_int64]
+        lib.cdt_frame_info.restype = ctypes.c_int64
+        lib.cdt_frame_info.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64)]
+        for name in ("cdt_blend_tile", "cdt_accumulate_tile"):
+            fn = getattr(lib, name)
+            fn.restype = None
+            fn.argtypes = [ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+                           ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p,
+                           ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+                           ctypes.c_int64]
+            if name == "cdt_accumulate_tile":
+                fn.argtypes = [ctypes.c_void_p, ctypes.c_void_p] + fn.argtypes[1:]
+        _lib = lib
+        debug_log(f"native data-plane library loaded: {so}")
+        return _lib
+
+
+def is_native() -> bool:
+    return _load() is not None
+
+
+# ---------------------------------------------------------------------------
+# content hash
+# ---------------------------------------------------------------------------
+
+def hash64(data: bytes) -> int:
+    lib = _load()
+    if lib is not None:
+        return int(lib.cdt_hash64(data, len(data)))
+    # numpy-free fallback (FNV-1a 64)
+    h = 14695981039346656037
+    for b in data:
+        h = ((h ^ b) * 1099511628211) & 0xFFFFFFFFFFFFFFFF
+    return h
+
+
+# ---------------------------------------------------------------------------
+# frame codec
+# ---------------------------------------------------------------------------
+
+def _np_view(arr: np.ndarray) -> tuple[np.ndarray, int]:
+    """Contiguous byte view + wire dtype code (bfloat16 → uint16 bits)."""
+    a = np.ascontiguousarray(arr)
+    dt = a.dtype
+    if dt not in _DTYPE_CODES:
+        if dt.itemsize == 2:            # ml_dtypes.bfloat16 etc.
+            a = a.view(np.uint16)
+            dt = a.dtype
+        else:
+            a = a.astype(np.float32)
+            dt = a.dtype
+    return a, _DTYPE_CODES[np.dtype(dt)]
+
+
+def pack_frame(arr: np.ndarray, level: int = 1) -> bytes:
+    """Array → framed bytes. ``level`` 0 = raw, 1-9 = zlib (kept only when
+    it actually shrinks the payload)."""
+    a, code = _np_view(arr)
+    raw = a.tobytes()
+    lib = _load()
+    if lib is not None:
+        dims = (ctypes.c_int64 * max(1, a.ndim))(*(a.shape or (1,)))
+        cap = lib.cdt_frame_bound(len(raw), a.ndim)
+        out = ctypes.create_string_buffer(cap)
+        n = lib.cdt_pack_frame(raw, len(raw), code, dims, a.ndim,
+                               level, out, cap)
+        if n > 0:
+            return out.raw[:n]
+        debug_log(f"native pack failed ({n}); falling back")
+    # pure-python fallback, identical wire format
+    payload = raw
+    flags = 0
+    if level > 0:
+        z = zlib.compress(raw, level)
+        if len(z) < len(raw):
+            payload, flags = z, 1
+    head = _MAGIC + bytes([_VERSION, code, a.ndim, flags])
+    head += b"".join(int(d).to_bytes(8, "little") for d in a.shape)
+    head += zlib.crc32(raw).to_bytes(4, "little")
+    head += len(payload).to_bytes(8, "little")
+    head += len(raw).to_bytes(8, "little")
+    return head + payload
+
+
+def unpack_frame(data: bytes) -> np.ndarray:
+    """Framed bytes → array (crc-verified)."""
+    data = bytes(data)          # bytearray/memoryview → bytes for ctypes
+    if len(data) < 8 or data[:4] != _MAGIC or data[4] != _VERSION:
+        raise ValueError("not a CDTF frame")
+    code, ndim, flags = data[5], data[6], data[7]
+    if ndim > 8 or code not in _DTYPES:
+        raise ValueError(f"bad frame header (dtype={code} ndim={ndim})")
+    off = 8
+    shape = tuple(int.from_bytes(data[off + 8 * i: off + 8 * i + 8], "little")
+                  for i in range(ndim))
+    off += 8 * ndim
+    crc = int.from_bytes(data[off:off + 4], "little"); off += 4
+    stored = int.from_bytes(data[off:off + 8], "little"); off += 8
+    raw_len = int.from_bytes(data[off:off + 8], "little"); off += 8
+
+    lib = _load()
+    if lib is not None:
+        out = ctypes.create_string_buffer(raw_len)
+        n = lib.cdt_unpack_frame(data, len(data), out, raw_len)
+        if n < 0:
+            raise ValueError(f"frame unpack failed (code {n})")
+        raw = out.raw[:n]
+    else:
+        payload = data[off:off + stored]
+        raw = zlib.decompress(payload) if flags & 1 else payload
+        if len(raw) != raw_len or zlib.crc32(raw) != crc:
+            raise ValueError("frame crc mismatch")
+    return np.frombuffer(raw, dtype=_DTYPES[code]).reshape(shape)
+
+
+# ---------------------------------------------------------------------------
+# compositing
+# ---------------------------------------------------------------------------
+
+def blend_tile(canvas: np.ndarray, tile: np.ndarray, mask: np.ndarray,
+               y: int, x: int) -> None:
+    """In-place: ``canvas[y:y+th, x:x+tw] = canvas*(1-m) + tile*m`` with
+    bounds clipping. canvas [H,W,C] f32, tile [th,tw,C] f32, mask [th,tw]."""
+    if canvas.dtype != np.float32 or not canvas.flags["C_CONTIGUOUS"]:
+        # in-place semantics require the caller's own buffer
+        raise ValueError("canvas must be contiguous float32")
+    tile = np.ascontiguousarray(tile, np.float32)
+    mask = np.ascontiguousarray(mask, np.float32)
+    H, W, C = canvas.shape
+    th, tw = mask.shape
+    lib = _load()
+    if lib is not None:
+        lib.cdt_blend_tile(
+            canvas.ctypes.data, H, W, C, tile.ctypes.data, mask.ctypes.data,
+            th, tw, y, x)
+        return
+    y0, x0 = max(y, 0), max(x, 0)
+    y1, x1 = min(y + th, H), min(x + tw, W)
+    if y0 >= y1 or x0 >= x1:
+        return
+    m = mask[y0 - y:y1 - y, x0 - x:x1 - x, None]
+    canvas[y0:y1, x0:x1] = (canvas[y0:y1, x0:x1] * (1.0 - m)
+                            + tile[y0 - y:y1 - y, x0 - x:x1 - x] * m)
+
+
+def accumulate_tile(acc: np.ndarray, wsum: np.ndarray, tile: np.ndarray,
+                    mask: np.ndarray, y: int, x: int) -> None:
+    """In-place order-independent compositing: ``acc += tile*mask``;
+    ``wsum += mask`` (divide at the end)."""
+    for buf, name in ((acc, "acc"), (wsum, "wsum")):
+        if buf.dtype != np.float32 or not buf.flags["C_CONTIGUOUS"]:
+            raise ValueError(f"{name} must be contiguous float32")
+    tile = np.ascontiguousarray(tile, np.float32)
+    mask = np.ascontiguousarray(mask, np.float32)
+    H, W, C = acc.shape
+    th, tw = mask.shape
+    lib = _load()
+    if lib is not None:
+        lib.cdt_accumulate_tile(
+            acc.ctypes.data, wsum.ctypes.data, H, W, C,
+            tile.ctypes.data, mask.ctypes.data, th, tw, y, x)
+        return
+    y0, x0 = max(y, 0), max(x, 0)
+    y1, x1 = min(y + th, H), min(x + tw, W)
+    if y0 >= y1 or x0 >= x1:
+        return
+    m = mask[y0 - y:y1 - y, x0 - x:x1 - x]
+    acc[y0:y1, x0:x1] += tile[y0 - y:y1 - y, x0 - x:x1 - x] * m[..., None]
+    wsum[y0:y1, x0:x1] += m

@@ -1,0 +1,219 @@
+// Native data-plane library for the TPU-distributed framework.
+//
+// The reference's data plane is pure Python: every tensor hop pays
+// PIL PNG encode/decode + base64 (+33%) + JSON (SURVEY §6 — its "single
+// biggest overhead"). On-pod this framework moves tensors as device
+// arrays over ICI; this library serves the remaining *cross-host* hops
+// (DCN/WAN collector envelopes, tile submissions, media hashing) and the
+// master's host-side compositing:
+//
+//   - frame codec: length-prefixed tensor framing with crc32 integrity
+//     and optional zlib compression — binary multipart replaces
+//     base64-PNG JSON envelopes
+//   - feathered tile blend: the master-side compositing hot loop when
+//     combining tiles returned by remote hosts
+//   - fnv1a64 content hash: media-sync dedup cheaper than md5 for
+//     multi-GB video files
+//
+// C ABI only (consumed via ctypes); no exceptions across the boundary.
+// Build: `make` (g++ -O3 -shared -fPIC, links zlib).
+
+#include <cstdint>
+#include <cstring>
+#include <zlib.h>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// content hashing
+// ---------------------------------------------------------------------------
+
+uint64_t cdt_hash64(const uint8_t* data, int64_t n) {
+    // FNV-1a 64-bit
+    uint64_t h = 14695981039346656037ULL;
+    for (int64_t i = 0; i < n; ++i) {
+        h ^= data[i];
+        h *= 1099511628211ULL;
+    }
+    return h;
+}
+
+uint32_t cdt_crc32(const uint8_t* data, int64_t n) {
+    return (uint32_t)crc32(0L, data, (uInt)n);
+}
+
+// ---------------------------------------------------------------------------
+// tensor frame codec
+//
+// layout (little-endian):
+//   u32 magic 'CDTF'   u8 version   u8 dtype   u8 ndim   u8 flags(bit0=zlib)
+//   i64 dims[ndim]
+//   u32 crc32(raw payload)   u64 payload_bytes(stored)   u64 raw_bytes
+//   payload
+// ---------------------------------------------------------------------------
+
+static const uint32_t kMagic = 0x46544443u;  // "CDTF"
+static const uint8_t kVersion = 1;
+
+static int64_t header_size(int32_t ndim) {
+    return 8 + 8 * (int64_t)ndim + 4 + 8 + 8;
+}
+
+int64_t cdt_frame_bound(int64_t nbytes, int32_t ndim) {
+    // worst case: zlib expansion bound + header
+    return header_size(ndim) + (int64_t)compressBound((uLong)nbytes);
+}
+
+// returns bytes written, or <0 on error (-1 args, -2 capacity, -3 zlib)
+int64_t cdt_pack_frame(const uint8_t* src, int64_t nbytes,
+                       int32_t dtype, const int64_t* dims, int32_t ndim,
+                       int32_t level, uint8_t* dst, int64_t dst_cap) {
+    if (!src || !dst || ndim < 0 || ndim > 8 || nbytes < 0) return -1;
+    const int64_t hsize = header_size(ndim);
+    if (dst_cap < hsize) return -2;
+
+    uint8_t flags = 0;
+    uint64_t stored = (uint64_t)nbytes;
+    if (level > 0) {
+        uLongf cap = (uLongf)(dst_cap - hsize);
+        int rc = compress2(dst + hsize, &cap, src, (uLong)nbytes, level);
+        if (rc != Z_OK) return -3;
+        if ((int64_t)cap < nbytes) {        // only keep if it actually shrank
+            flags = 1;
+            stored = (uint64_t)cap;
+        }
+    }
+    if (!flags) {
+        if (dst_cap < hsize + nbytes) return -2;
+        std::memcpy(dst + hsize, src, (size_t)nbytes);
+        stored = (uint64_t)nbytes;
+    }
+
+    uint8_t* p = dst;
+    std::memcpy(p, &kMagic, 4); p += 4;
+    *p++ = kVersion;
+    *p++ = (uint8_t)dtype;
+    *p++ = (uint8_t)ndim;
+    *p++ = flags;
+    std::memcpy(p, dims, 8 * (size_t)ndim); p += 8 * ndim;
+    uint32_t crc = (uint32_t)crc32(0L, src, (uInt)nbytes);
+    std::memcpy(p, &crc, 4); p += 4;
+    std::memcpy(p, &stored, 8); p += 8;
+    uint64_t raw = (uint64_t)nbytes;
+    std::memcpy(p, &raw, 8); p += 8;
+    return hsize + (int64_t)stored;
+}
+
+// peek: fills dtype/ndim/dims/raw_bytes; returns 0 or <0 on error
+int64_t cdt_frame_info(const uint8_t* src, int64_t nbytes,
+                       int32_t* dtype, int32_t* ndim, int64_t* dims /*>=8*/,
+                       int64_t* raw_bytes) {
+    if (!src || nbytes < 8) return -1;
+    uint32_t magic;
+    std::memcpy(&magic, src, 4);
+    if (magic != kMagic || src[4] != kVersion) return -4;
+    int32_t nd = src[6];
+    if (nd < 0 || nd > 8) return -4;
+    const int64_t hsize = header_size(nd);
+    if (nbytes < hsize) return -1;
+    *dtype = src[5];
+    *ndim = nd;
+    std::memcpy(dims, src + 8, 8 * (size_t)nd);
+    uint64_t raw;
+    std::memcpy(&raw, src + 8 + 8 * nd + 4 + 8, 8);
+    *raw_bytes = (int64_t)raw;
+    return 0;
+}
+
+// returns raw payload bytes written, or <0 (-1 args, -2 cap, -3 zlib,
+// -4 bad magic/version, -5 crc mismatch)
+int64_t cdt_unpack_frame(const uint8_t* src, int64_t nbytes,
+                         uint8_t* dst, int64_t dst_cap) {
+    int32_t dtype, ndim;
+    int64_t dims[8], raw;
+    int64_t rc = cdt_frame_info(src, nbytes, &dtype, &ndim, dims, &raw);
+    if (rc < 0) return rc;
+    const int64_t hsize = header_size(ndim);
+    uint8_t flags = src[7];
+    uint32_t crc_expected;
+    std::memcpy(&crc_expected, src + 8 + 8 * ndim, 4);
+    uint64_t stored;
+    std::memcpy(&stored, src + 8 + 8 * ndim + 4, 8);
+    if (nbytes < hsize + (int64_t)stored) return -1;
+    if (dst_cap < raw) return -2;
+
+    if (flags & 1) {
+        uLongf out = (uLongf)dst_cap;
+        int zrc = uncompress(dst, &out, src + hsize, (uLong)stored);
+        if (zrc != Z_OK || (int64_t)out != raw) return -3;
+    } else {
+        std::memcpy(dst, src + hsize, (size_t)raw);
+    }
+    if ((uint32_t)crc32(0L, dst, (uInt)raw) != crc_expected) return -5;
+    return raw;
+}
+
+// ---------------------------------------------------------------------------
+// feathered tile compositing (master-side, float32 HWC)
+// ---------------------------------------------------------------------------
+
+// canvas[y:y+th, x:x+tw] = canvas*(1-mask) + tile*mask, clipped to bounds.
+void cdt_blend_tile(float* canvas, int64_t H, int64_t W, int64_t C,
+                    const float* tile, const float* mask,
+                    int64_t th, int64_t tw, int64_t y, int64_t x) {
+    for (int64_t r = 0; r < th; ++r) {
+        const int64_t cy = y + r;
+        if (cy < 0 || cy >= H) continue;
+        for (int64_t c = 0; c < tw; ++c) {
+            const int64_t cx = x + c;
+            if (cx < 0 || cx >= W) continue;
+            const float m = mask[r * tw + c];
+            const float inv = 1.0f - m;
+            float* dst = canvas + (cy * W + cx) * C;
+            const float* srcp = tile + (r * tw + c) * C;
+            for (int64_t ch = 0; ch < C; ++ch)
+                dst[ch] = dst[ch] * inv + srcp[ch] * m;
+        }
+    }
+}
+
+// weighted accumulation variant: acc += tile*mask; wsum += mask
+// (normalized compositing across overlapping tiles, order-independent)
+void cdt_accumulate_tile(float* acc, float* wsum,
+                         int64_t H, int64_t W, int64_t C,
+                         const float* tile, const float* mask,
+                         int64_t th, int64_t tw, int64_t y, int64_t x) {
+    for (int64_t r = 0; r < th; ++r) {
+        const int64_t cy = y + r;
+        if (cy < 0 || cy >= H) continue;
+        for (int64_t c = 0; c < tw; ++c) {
+            const int64_t cx = x + c;
+            if (cx < 0 || cx >= W) continue;
+            const float m = mask[r * tw + c];
+            float* dst = acc + (cy * W + cx) * C;
+            const float* srcp = tile + (r * tw + c) * C;
+            for (int64_t ch = 0; ch < C; ++ch)
+                dst[ch] += srcp[ch] * m;
+            wsum[cy * W + cx] += m;
+        }
+    }
+}
+
+// ---------------------------------------------------------------------------
+// uint8 <-> float32 image conversion (codec hot path)
+// ---------------------------------------------------------------------------
+
+void cdt_f32_to_u8(const float* src, uint8_t* dst, int64_t n) {
+    for (int64_t i = 0; i < n; ++i) {
+        float v = src[i];
+        v = v < 0.0f ? 0.0f : (v > 1.0f ? 1.0f : v);
+        dst[i] = (uint8_t)(v * 255.0f + 0.5f);
+    }
+}
+
+void cdt_u8_to_f32(const uint8_t* src, float* dst, int64_t n) {
+    const float k = 1.0f / 255.0f;
+    for (int64_t i = 0; i < n; ++i) dst[i] = src[i] * k;
+}
+
+}  // extern "C"

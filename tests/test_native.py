@@ -1,0 +1,229 @@
+"""Native data-plane library tests: frame codec (native + pure-python
+paths, cross-interop), compositing, hashing, and the binary-frame
+collector route."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from comfyui_distributed_tpu import native
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+@pytest.fixture
+def no_native(monkeypatch):
+    monkeypatch.setattr(native, "_lib", None)
+    monkeypatch.setattr(native, "_load_attempted", True)
+
+
+toolchain = pytest.mark.skipif(not native.is_native(),
+                               reason="native library unavailable")
+
+
+class TestFrameCodec:
+    @pytest.mark.parametrize("dtype", [np.uint8, np.float32, np.int32])
+    def test_roundtrip_python(self, no_native, dtype):
+        a = (np.random.RandomState(0).rand(5, 7, 3) * 100).astype(dtype)
+        assert np.array_equal(native.unpack_frame(native.pack_frame(a)), a)
+
+    def test_raw_level0(self, no_native):
+        a = np.arange(100, dtype=np.float32)
+        f = native.pack_frame(a, level=0)
+        assert np.array_equal(native.unpack_frame(f), a)
+
+    def test_compression_shrinks_constant_data(self, no_native):
+        a = np.zeros((256, 256, 3), np.uint8)
+        f = native.pack_frame(a, level=1)
+        assert len(f) < a.nbytes // 10
+
+    def test_bfloat16_travels_as_bits(self, no_native):
+        import jax.numpy as jnp
+
+        a = np.asarray(jnp.arange(8, dtype=jnp.bfloat16))
+        out = native.unpack_frame(native.pack_frame(a))
+        assert out.dtype == np.uint16
+        assert np.array_equal(out, a.view(np.uint16))
+
+    def test_corrupt_payload_detected(self, no_native):
+        a = np.arange(64, dtype=np.float32)
+        f = bytearray(native.pack_frame(a, level=0))
+        f[-2] ^= 0xFF
+        with pytest.raises(ValueError):
+            native.unpack_frame(bytes(f))
+
+    def test_not_a_frame(self, no_native):
+        with pytest.raises(ValueError):
+            native.unpack_frame(b"PNG....definitely not a frame")
+
+    @toolchain
+    def test_native_roundtrip(self):
+        a = (np.random.RandomState(1).rand(33, 65, 3) * 255).astype(np.uint8)
+        f = native.pack_frame(a, level=1)
+        assert np.array_equal(native.unpack_frame(f), a)
+
+    @toolchain
+    def test_cross_interop(self, monkeypatch):
+        """Native-packed frames unpack in pure python and vice versa —
+        mixed clusters (a host without a toolchain) stay wire-compatible."""
+        a = (np.random.RandomState(2).rand(16, 16, 3) * 255).astype(np.uint8)
+        f_native = native.pack_frame(a, level=1)
+        monkeypatch.setattr(native, "_lib", None)
+        monkeypatch.setattr(native, "_load_attempted", True)
+        assert np.array_equal(native.unpack_frame(f_native), a)
+        f_py = native.pack_frame(a, level=1)
+        monkeypatch.undo()
+        assert np.array_equal(native.unpack_frame(f_py), a)
+
+    @toolchain
+    def test_corrupt_detected_native(self):
+        a = np.arange(64, dtype=np.float32)
+        f = bytearray(native.pack_frame(a, level=0))
+        f[-2] ^= 0xFF
+        with pytest.raises(ValueError, match="-5"):
+            native.unpack_frame(bytes(f))
+
+
+class TestHash:
+    def test_known_value(self, no_native):
+        # FNV-1a 64 of empty input is the offset basis
+        assert native.hash64(b"") == 14695981039346656037
+
+    @toolchain
+    def test_native_matches_python(self):
+        data = b"the quick brown fox"
+        native_h = native.hash64(data)
+        h = 14695981039346656037
+        for b in data:
+            h = ((h ^ b) * 1099511628211) & 0xFFFFFFFFFFFFFFFF
+        assert native_h == h
+
+
+class TestCompositing:
+    def _numpy_blend(self, canvas, tile, mask, y, x):
+        out = canvas.copy()
+        th, tw = mask.shape
+        m = mask[..., None]
+        out[y:y + th, x:x + tw] = (out[y:y + th, x:x + tw] * (1 - m)
+                                   + tile * m)
+        return out
+
+    @pytest.mark.parametrize("use_native", [False, True])
+    def test_blend_matches_numpy(self, use_native, monkeypatch):
+        if use_native and not native.is_native():
+            pytest.skip("native library unavailable")
+        if not use_native:
+            monkeypatch.setattr(native, "_lib", None)
+            monkeypatch.setattr(native, "_load_attempted", True)
+        rs = np.random.RandomState(3)
+        canvas = np.ascontiguousarray(rs.rand(32, 32, 3), np.float32)
+        tile = rs.rand(8, 8, 3).astype(np.float32)
+        mask = rs.rand(8, 8).astype(np.float32)
+        expect = self._numpy_blend(canvas, tile, mask, 4, 6)
+        native.blend_tile(canvas, tile, mask, 4, 6)
+        np.testing.assert_allclose(canvas, expect, atol=1e-6)
+
+    def test_blend_clips_out_of_bounds(self):
+        canvas = np.zeros((16, 16, 3), np.float32)
+        tile = np.ones((8, 8, 3), np.float32)
+        mask = np.ones((8, 8), np.float32)
+        native.blend_tile(canvas, tile, mask, 12, 12)   # extends past edge
+        assert canvas[12:, 12:].min() == 1.0
+        assert canvas[:12].max() == 0.0
+
+    def test_accumulate_normalizes(self):
+        canvas_acc = np.zeros((16, 16, 3), np.float32)
+        wsum = np.zeros((16, 16), np.float32)
+        tile = np.full((8, 8, 3), 2.0, np.float32)
+        mask = np.full((8, 8), 0.5, np.float32)
+        native.accumulate_tile(canvas_acc, wsum, tile, mask, 0, 0)
+        native.accumulate_tile(canvas_acc, wsum, tile, mask, 0, 4)  # overlap
+        out = canvas_acc / np.maximum(wsum, 1e-8)[..., None]
+        np.testing.assert_allclose(out[:8, :8], 2.0, atol=1e-5)
+
+    def test_dtype_guard(self):
+        with pytest.raises(ValueError, match="contiguous float32"):
+            native.blend_tile(np.zeros((4, 4, 3)), np.zeros((2, 2, 3), np.float32),
+                              np.zeros((2, 2), np.float32), 0, 0)
+
+
+class TestFramesRoute:
+    def test_frames_transport_end_to_end(self, tmp_config):
+        """Worker bridge sends binary frames → master route ingests →
+        collector drain combines (the full cross-host data plane)."""
+        import aiohttp
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from comfyui_distributed_tpu.api.app import create_app
+        from comfyui_distributed_tpu.cluster.controller import Controller
+        from comfyui_distributed_tpu.cluster.collector_bridge import CollectorBridge
+
+        async def body():
+            controller = Controller()
+            app = create_app(controller)
+            async with TestClient(TestServer(app)) as client:
+                images = np.stack([
+                    np.full((8, 8, 3), 0.25, np.float32),
+                    np.full((8, 8, 3), 0.75, np.float32),
+                ])
+                await controller.store.prepare_collector_job("jobF", ("w0",))
+
+                bridge = CollectorBridge(controller.store,
+                                         asyncio.get_running_loop())
+                master_url = f"http://127.0.0.1:{client.port}"
+                # patch session getter to the test client's session
+                import comfyui_distributed_tpu.cluster.collector_bridge as cb
+
+                class S:
+                    def post(self, url, **kw):
+                        path = url.split(str(client.port))[1]
+                        return client.session.post(client.make_url(path),
+                                                   **kw)
+                orig = cb.get_client_session
+                cb.get_client_session = lambda: S()
+
+                async def no_legacy(*a, **k):
+                    raise AssertionError(
+                        "legacy envelope path used — frames transport "
+                        "should have handled the send")
+                bridge._post_with_retry = no_legacy
+                try:
+                    await bridge.send_async("jobF", "w0", images, None,
+                                            master_url)
+                    combined, audio = await bridge.collect_async(
+                        "jobF", np.full((1, 8, 8, 3), 0.5, np.float32),
+                        None, enabled_worker_ids=("w0",))
+                finally:
+                    cb.get_client_session = orig
+                assert combined.shape == (3, 8, 8, 3)
+                # master first, then worker frames in batch order
+                np.testing.assert_allclose(combined[0], 0.5, atol=1e-6)
+                np.testing.assert_allclose(combined[1], 0.25, atol=2e-2)
+                np.testing.assert_allclose(combined[2], 0.75, atol=2e-2)
+        run(body())
+
+    def test_bad_frame_rejected(self, tmp_config):
+        import aiohttp
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from comfyui_distributed_tpu.api.app import create_app
+        from comfyui_distributed_tpu.cluster.controller import Controller
+
+        async def body():
+            app = create_app(Controller())
+            async with TestClient(TestServer(app)) as client:
+                form = aiohttp.FormData()
+                form.add_field("metadata",
+                               '{"job_id": "j", "worker_id": "w", "count": 1}',
+                               content_type="application/json")
+                form.add_field("frame_0", b"garbage-not-a-frame",
+                               filename="frame_0.cdtf",
+                               content_type="application/x-cdt-frame")
+                r = await client.post("/distributed/job_complete_frames",
+                                      data=form)
+                assert r.status == 400
+                assert "frame 0" in (await r.json())["error"]
+        run(body())
