@@ -55,9 +55,34 @@ def create_app(controller: Controller) -> web.Application:
         except DistributedError as e:
             return json_error(str(e), 500)
 
+    @web.middleware
+    async def cors_middleware(request, handler):
+        # the dashboard probes/controls worker hosts cross-origin — the
+        # reference forces --enable-cors-header on workers
+        # (workers/process/launch_builder.py:100-109)
+        if request.method == "OPTIONS":
+            resp = web.Response()
+        else:
+            resp = await handler(request)
+        resp.headers["Access-Control-Allow-Origin"] = "*"
+        resp.headers["Access-Control-Allow-Methods"] = "GET, POST, OPTIONS"
+        resp.headers["Access-Control-Allow-Headers"] = "Content-Type"
+        return resp
+
     app.middlewares.append(error_middleware)
+    app.middlewares.append(cors_middleware)
 
     r = app.router
+
+    # --- dashboard (web/) --------------------------------------------------
+    web_dir = Path(__file__).resolve().parent.parent / "web"
+
+    async def index(request):
+        return web.FileResponse(web_dir / "index.html")
+
+    if web_dir.is_dir():
+        r.add_get("/", index)
+        r.add_static("/web/", web_dir)
 
     # --- health + ComfyUI-compatible probe surface -------------------------
     async def health(request):
@@ -182,6 +207,12 @@ def create_app(controller: Controller) -> web.Application:
 
     async def clear_memory(request):
         return web.json_response(controller.clear_memory())
+
+    async def interrupt(request):
+        dropped = controller.queue.interrupt()
+        return web.json_response({"status": "interrupted", "dropped": dropped})
+
+    r.add_post("/distributed/interrupt", interrupt)
 
     r.add_post("/distributed/job_complete", job_complete)
     r.add_post("/distributed/prepare_job", prepare_job)

@@ -40,11 +40,14 @@ class PromptQueue:
     """
 
     def __init__(self, context_factory: Callable[[], dict] | None = None):
+        import threading
+
         self._queue: asyncio.Queue[PromptJob] = asyncio.Queue()
         self._context_factory = context_factory or (lambda: {})
         self._pool = ThreadPoolExecutor(max_workers=1, thread_name_prefix="graph-exec")
         self._task: Optional[asyncio.Task] = None
         self._executing: Optional[str] = None
+        self._interrupt = threading.Event()
         self.history: dict[str, dict] = {}
 
     # --- lifecycle ---------------------------------------------------------
@@ -83,6 +86,23 @@ class PromptQueue:
     def queue_remaining(self) -> int:
         return self._queue.qsize() + (1 if self._executing else 0)
 
+    def interrupt(self) -> int:
+        """Drop pending prompts and flag the running one (checked between
+        nodes — parity with the reference's interrupt fan-out,
+        ``web/workerUtils.js:73-95``). Returns number of dropped jobs."""
+        dropped = 0
+        while True:
+            try:
+                job = self._queue.get_nowait()
+            except asyncio.QueueEmpty:
+                break
+            self.history[job.prompt_id] = {"status": "interrupted",
+                                           "duration": 0.0}
+            dropped += 1
+        if self._executing:
+            self._interrupt.set()
+        return dropped
+
     @property
     def executing(self) -> Optional[str]:
         return self._executing
@@ -95,8 +115,10 @@ class PromptQueue:
             job = await self._queue.get()
             self._executing = job.prompt_id
             started = time.monotonic()
+            self._interrupt.clear()
             try:
                 context = dict(self._context_factory())
+                context["interrupt_event"] = self._interrupt
                 executor = GraphExecutor(context)
                 outputs = await loop.run_in_executor(
                     self._pool, executor.execute, job.prompt
@@ -112,6 +134,12 @@ class PromptQueue:
                 trace_info(job.trace_id,
                            f"prompt {job.prompt_id} done in "
                            f"{self.history[job.prompt_id]['duration']:.2f}s")
+            except InterruptedError:
+                self.history[job.prompt_id] = {
+                    "status": "interrupted",
+                    "duration": time.monotonic() - started,
+                }
+                log(f"prompt {job.prompt_id} interrupted")
             except Exception as e:  # noqa: BLE001 — job isolation barrier
                 self.history[job.prompt_id] = {
                     "status": "error", "error": str(e),

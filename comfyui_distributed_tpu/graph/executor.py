@@ -111,7 +111,13 @@ class GraphExecutor:
                 "; ".join(f"{e.node_id}: {e.message}" for e in errs)
             )
         cache: dict[str, tuple] = {}
+        interrupt = self.context.get("interrupt_event")
         for nid in topo_order(prompt):
+            if interrupt is not None and interrupt.is_set():
+                # checked between nodes (the reference checks ComfyUI's
+                # interrupt flag inside its drain/tile loops; an in-flight
+                # XLA dispatch itself is not preemptible)
+                raise InterruptedError(f"execution interrupted before {nid}")
             node = prompt[nid]
             cls = get_node(node["class_type"])
             kwargs: dict[str, Any] = {}

@@ -1,0 +1,384 @@
+// Dashboard controller (parity: reference web/main.js DistributedExtension
+// + workerLifecycle.js status polling + workerSettings.js CRUD +
+// tunnelManager.js — SURVEY §2.7), dependency-free.
+
+import { api, probeHost, normalizeAddress } from "/web/apiClient.js";
+
+const POLL_MS = 3000;
+const LOG_REFRESH_MS = 2000;
+
+const state = {
+  config: null,
+  status: new Map(),       // worker_id → {online, queue_remaining, launching}
+  managed: {},             // worker_id → {pid, log}
+  logTimer: null,
+  editingId: null,
+};
+
+const $ = (id) => document.getElementById(id);
+
+// ---------------------------------------------------------------------------
+// worker cards
+// ---------------------------------------------------------------------------
+
+function workerCard(worker) {
+  const st = state.status.get(worker.id) || {};
+  const managed = state.managed[worker.id];
+  const card = document.createElement("div");
+  card.className = "worker-card" + (worker.enabled ? "" : " disabled");
+
+  const dot = document.createElement("span");
+  dot.className = "dot " + (st.launching ? "launching"
+    : st.online ? (st.queue_remaining > 0 ? "busy" : "online") : "offline");
+  dot.title = st.online ? `queue: ${st.queue_remaining ?? 0}` : "offline";
+
+  const info = document.createElement("div");
+  info.className = "info";
+  const qr = st.online && st.queue_remaining > 0 ? ` — queue ${st.queue_remaining}` : "";
+  info.innerHTML = `
+    <div class="name"></div>
+    <div class="addr"></div>
+    <div class="meta"></div>`;
+  info.querySelector(".name").textContent = worker.name || worker.id;
+  info.querySelector(".addr").textContent = worker.address;
+  info.querySelector(".meta").textContent =
+    `${worker.type || "auto"}${managed ? ` · pid ${managed.pid}` : ""}` +
+    `${st.online ? " · online" + qr : " · offline"}`;
+
+  const toggle = document.createElement("input");
+  toggle.type = "checkbox";
+  toggle.checked = !!worker.enabled;
+  toggle.title = "enabled";
+  toggle.onchange = async () => {
+    await api.updateWorker({ ...worker, enabled: toggle.checked });
+    await refreshConfig();
+  };
+
+  const buttons = document.createElement("div");
+  buttons.className = "row";
+  const mkBtn = (label, cls, fn, title = "") => {
+    const b = document.createElement("button");
+    b.textContent = label;
+    b.className = cls;
+    b.title = title;
+    b.onclick = fn;
+    buttons.appendChild(b);
+    return b;
+  };
+  if (managed) {
+    mkBtn("Stop", "small ghost danger", async () => {
+      await api.stopWorker(worker.id).catch(alertError);
+      await refreshManaged();
+      renderWorkers();
+    });
+    mkBtn("Log", "small ghost", () => openLog(worker.id));
+  } else if ((worker.type || "local") !== "remote") {
+    mkBtn("Launch", "small ghost", async (ev) => {
+      ev.target.disabled = true;
+      state.status.set(worker.id, { ...st, launching: true });
+      renderWorkers();
+      try { await api.launchWorker(worker.id); } catch (e) { alertError(e); }
+      await refreshManaged();
+      renderWorkers();
+    });
+  }
+  mkBtn("Edit", "small ghost", () => openEditor(worker));
+  mkBtn("✕", "small ghost danger", async () => {
+    if (!confirm(`Delete host ${worker.id}?`)) return;
+    await api.deleteWorker(worker.id).catch(alertError);
+    await refreshConfig();
+  }, "delete");
+
+  card.append(dot, info, toggle, buttons);
+  return card;
+}
+
+function renderWorkers() {
+  const root = $("worker-cards");
+  root.replaceChildren();
+  const hosts = (state.config && state.config.hosts) || [];
+  if (!hosts.length) {
+    const p = document.createElement("p");
+    p.className = "meta";
+    p.textContent = "No worker hosts configured — add one, or launch " +
+      "additional controllers on other TPU hosts.";
+    root.appendChild(p);
+    return;
+  }
+  for (const w of hosts) root.appendChild(workerCard(w));
+}
+
+// ---------------------------------------------------------------------------
+// polling (parity: workerLifecycle.js status loop)
+// ---------------------------------------------------------------------------
+
+async function pollStatus() {
+  const hosts = (state.config && state.config.hosts) || [];
+  await Promise.all(hosts.map(async (w) => {
+    const health = await probeHost(w.address);
+    const prev = state.status.get(w.id) || {};
+    state.status.set(w.id, {
+      online: !!health,
+      queue_remaining: health ? health.queue_remaining : null,
+      launching: prev.launching && !health,
+    });
+  }));
+  try {
+    const h = await api.health();
+    $("master-dot").className = "dot " + (h.queue_remaining > 0 ? "busy" : "online");
+    $("master-label").textContent = `master · ${h.machine_id}` +
+      (h.queue_remaining ? ` · queue ${h.queue_remaining}` : "");
+  } catch {
+    $("master-dot").className = "dot offline";
+  }
+  renderWorkers();
+}
+
+async function refreshConfig() {
+  state.config = await api.getConfig();
+  renderWorkers();
+  renderSettings();
+  renderMesh();
+}
+
+async function refreshManaged() {
+  try {
+    const res = await api.managedWorkers();
+    state.managed = res.workers || {};
+  } catch { state.managed = {}; }
+}
+
+// ---------------------------------------------------------------------------
+// mesh / device info
+// ---------------------------------------------------------------------------
+
+async function renderMesh() {
+  const root = $("mesh-info");
+  root.replaceChildren();
+  try {
+    const info = await api.systemInfo();
+    const rows = [
+      ["Platform", `${info.platform} (${info.environment?.tpu?.tpu_accelerator_type || "no TPU env"})`],
+      ["Devices", String((info.devices || []).length) + " — " +
+        [...new Set((info.devices || []).map((d) => d.kind))].join(", ")],
+      ["Mesh shape", JSON.stringify((state.config || {}).mesh?.shape || {})],
+      ["Machine", info.machine_id],
+    ];
+    for (const [k, v] of rows) {
+      const kd = document.createElement("div"); kd.className = "k"; kd.textContent = k;
+      const vd = document.createElement("div"); vd.textContent = v;
+      root.append(kd, vd);
+    }
+  } catch (e) {
+    root.textContent = "system info unavailable: " + e.message;
+  }
+}
+
+// ---------------------------------------------------------------------------
+// settings (parity: sidebar settings section)
+// ---------------------------------------------------------------------------
+
+const SETTING_FIELDS = [
+  ["debug", "checkbox", "Debug logging"],
+  ["auto_launch_workers", "checkbox", "Auto-launch local workers on start"],
+  ["stop_workers_on_master_exit", "checkbox", "Stop workers on master exit"],
+  ["master_delegate_only", "checkbox", "Master delegates only (no compute)"],
+  ["worker_timeout_seconds", "number", "Worker timeout (s)"],
+  ["worker_probe_concurrency", "number", "Probe concurrency"],
+  ["media_sync_concurrency", "number", "Media sync concurrency"],
+];
+
+function renderSettings() {
+  const root = $("settings-form");
+  root.replaceChildren();
+  const settings = (state.config && state.config.settings) || {};
+  for (const [key, kind, label] of SETTING_FIELDS) {
+    const kd = document.createElement("div");
+    kd.className = "k";
+    kd.textContent = label;
+    const input = document.createElement("input");
+    input.type = kind;
+    if (kind === "checkbox") input.checked = !!settings[key];
+    else input.value = settings[key] ?? "";
+    input.onchange = async () => {
+      const value = kind === "checkbox" ? input.checked : Number(input.value);
+      try { await api.updateSetting(key, value); } catch (e) { alertError(e); }
+    };
+    root.append(kd, input);
+  }
+}
+
+// ---------------------------------------------------------------------------
+// queue form (parity: executionUtils.js preflight + POST /distributed/queue)
+// ---------------------------------------------------------------------------
+
+async function submitQueue(ev) {
+  ev.preventDefault();
+  const result = $("queue-result");
+  result.hidden = false;
+  let prompt;
+  try {
+    prompt = JSON.parse($("queue-prompt").value);
+  } catch (e) {
+    result.textContent = "Invalid JSON: " + e.message;
+    return;
+  }
+  result.textContent = "Pre-flight probing workers…";
+  const hosts = ((state.config || {}).hosts || []).filter((w) => w.enabled);
+  const probes = await Promise.all(hosts.map((w) => probeHost(w.address)));
+  const online = hosts.filter((_, i) => probes[i]);
+  result.textContent = `Dispatching (${online.length}/${hosts.length} workers online)…`;
+  try {
+    const res = await api.queue(prompt, {
+      load_balance: $("queue-loadbalance").checked,
+      delegate_master: $("queue-delegate").checked,
+    });
+    result.textContent = JSON.stringify(res, null, 2);
+  } catch (e) {
+    result.textContent = "Error: " + e.message +
+      (e.data ? "\n" + JSON.stringify(e.data, null, 2) : "");
+  }
+}
+
+// ---------------------------------------------------------------------------
+// log modal (parity: workerLifecycle.js log modal, 2s auto-refresh)
+// ---------------------------------------------------------------------------
+
+async function fetchLog(workerId) {
+  const res = workerId === "__local__" ? await api.localLog()
+    : await api.workerLog(workerId);
+  return res.log || res.raw || "";
+}
+
+function openLog(workerId) {
+  $("log-title").textContent = workerId === "__local__"
+    ? "Controller log" : `Worker ${workerId} log`;
+  $("modal-backdrop").hidden = false;
+  const body = $("log-body");
+  const refresh = async () => {
+    try {
+      body.textContent = await fetchLog(workerId);
+      if ($("log-follow").checked) body.scrollTop = body.scrollHeight;
+    } catch (e) {
+      body.textContent = "log unavailable: " + e.message;
+    }
+  };
+  refresh();
+  state.logTimer = setInterval(refresh, LOG_REFRESH_MS);
+}
+
+function closeLog() {
+  $("modal-backdrop").hidden = true;
+  clearInterval(state.logTimer);
+}
+
+// ---------------------------------------------------------------------------
+// worker editor (parity: workerSettings.js forms)
+// ---------------------------------------------------------------------------
+
+function openEditor(worker) {
+  state.editingId = worker ? worker.id : null;
+  $("editor-title").textContent = worker ? `Edit ${worker.id}` : "Add host";
+  $("ed-id").value = worker?.id || "";
+  $("ed-id").disabled = !!worker;
+  $("ed-name").value = worker?.name || "";
+  $("ed-address").value = worker?.address || "";
+  $("ed-type").value = worker?.type || "";
+  $("ed-mesh").value = worker?.mesh_devices ?? -1;
+  $("ed-extra").value = worker?.extra_args || "";
+  $("ed-enabled").checked = worker ? !!worker.enabled : true;
+  $("editor-backdrop").hidden = false;
+}
+
+async function saveEditor(ev) {
+  ev.preventDefault();
+  const worker = {
+    id: $("ed-id").value.trim(),
+    name: $("ed-name").value.trim(),
+    address: normalizeAddress($("ed-address").value),
+    enabled: $("ed-enabled").checked,
+    mesh_devices: Number($("ed-mesh").value),
+    extra_args: $("ed-extra").value,
+  };
+  const type = $("ed-type").value;
+  if (type) worker.type = type;
+  try {
+    await api.updateWorker(worker);
+    $("editor-backdrop").hidden = true;
+    await refreshConfig();
+  } catch (e) { alertError(e); }
+}
+
+// ---------------------------------------------------------------------------
+// tunnel (parity: tunnelManager.js)
+// ---------------------------------------------------------------------------
+
+async function refreshTunnel() {
+  try {
+    const st = await api.tunnelStatus();
+    $("tunnel-dot").className = "dot " + (st.running ? "online" : "");
+    $("tunnel-url").textContent = st.running ? st.url : "stopped";
+    $("btn-tunnel").textContent = st.running ? "Stop tunnel" : "Start tunnel";
+    $("btn-tunnel").dataset.running = st.running ? "1" : "";
+    $("tunnel-error").hidden = true;
+  } catch { /* section stays as-is */ }
+}
+
+async function toggleTunnel() {
+  const btn = $("btn-tunnel");
+  btn.disabled = true;
+  try {
+    if (btn.dataset.running) await api.tunnelStop();
+    else await api.tunnelStart();
+  } catch (e) {
+    $("tunnel-error").textContent = e.message;
+    $("tunnel-error").hidden = false;
+  }
+  btn.disabled = false;
+  await refreshTunnel();
+}
+
+// ---------------------------------------------------------------------------
+
+function alertError(e) {
+  console.error(e);
+  alert(e.message || String(e));
+}
+
+async function init() {
+  $("queue-form").onsubmit = submitQueue;
+  $("btn-add-worker").onclick = () => openEditor(null);
+  $("editor-cancel").onclick = () => { $("editor-backdrop").hidden = true; };
+  $("editor-form").onsubmit = saveEditor;
+  $("log-close").onclick = closeLog;
+  $("modal-backdrop").onclick = (ev) => {
+    if (ev.target === $("modal-backdrop")) closeLog();
+  };
+  $("btn-tunnel").onclick = toggleTunnel;
+  $("btn-interrupt").onclick = async () => {
+    // fan out to all enabled hosts, then the master (reference
+    // workerUtils.js:73-95)
+    const hosts = ((state.config || {}).hosts || []).filter((w) => w.enabled);
+    await Promise.all(hosts.map((w) =>
+      fetch(`${normalizeAddress(w.address)}/distributed/interrupt`,
+            { method: "POST" }).catch(() => null)));
+    await api.interrupt().catch(alertError);
+  };
+  $("btn-clear-memory").onclick = async () => {
+    const hosts = ((state.config || {}).hosts || []).filter((w) => w.enabled);
+    await Promise.all(hosts.map((w) =>
+      fetch(`${normalizeAddress(w.address)}/distributed/clear_memory`,
+            { method: "POST" }).catch(() => null)));
+    await api.clearMemory().catch(alertError);
+  };
+  $("master-dot").ondblclick = () => openLog("__local__");
+
+  await refreshConfig();
+  await refreshManaged();
+  await refreshTunnel();
+  await pollStatus();
+  setInterval(pollStatus, POLL_MS);
+  setInterval(refreshTunnel, POLL_MS * 4);
+}
+
+init();

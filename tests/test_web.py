@@ -1,0 +1,92 @@
+"""Dashboard serving tests: the control plane serves the UI and all
+endpoints the UI's apiClient calls exist with matching contracts."""
+
+import asyncio
+import re
+from pathlib import Path
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from comfyui_distributed_tpu.api.app import create_app
+from comfyui_distributed_tpu.cluster.controller import Controller
+
+WEB_DIR = Path("comfyui_distributed_tpu/web")
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+class TestDashboard:
+    def test_index_and_statics(self, tmp_config):
+        async def body():
+            app = create_app(Controller())
+            async with TestClient(TestServer(app)) as client:
+                r = await client.get("/")
+                assert r.status == 200
+                html = await r.text()
+                assert "TPU Distributed" in html
+                for asset in ("/web/style.css", "/web/main.js",
+                              "/web/apiClient.js"):
+                    r = await client.get(asset)
+                    assert r.status == 200, asset
+        run(body())
+
+    def test_cors_headers_on_distributed_routes(self, tmp_config):
+        async def body():
+            app = create_app(Controller())
+            async with TestClient(TestServer(app)) as client:
+                r = await client.get("/distributed/health")
+                assert r.headers["Access-Control-Allow-Origin"] == "*"
+                r = await client.options("/distributed/clear_memory")
+                assert r.status == 200
+                assert "POST" in r.headers["Access-Control-Allow-Methods"]
+        run(body())
+
+    def test_interrupt_route(self, tmp_config):
+        async def body():
+            app = create_app(Controller())
+            async with TestClient(TestServer(app)) as client:
+                r = await client.post("/distributed/interrupt")
+                assert (await r.json())["status"] == "interrupted"
+        run(body())
+
+    def test_apiclient_routes_exist(self, tmp_config):
+        """Every literal /distributed|/upload path in apiClient.js resolves
+        to a registered route (contract drift guard)."""
+        src = (WEB_DIR / "apiClient.js").read_text()
+        paths = set(re.findall(r'"(/(?:distributed|upload)/[^"$]*?)"', src))
+        assert paths, "no routes parsed from apiClient.js"
+
+        async def body():
+            app = create_app(Controller())
+            registered = set()
+            for route in app.router.routes():
+                info = route.resource.get_info() if route.resource else {}
+                registered.add(info.get("path") or info.get("formatter", ""))
+            for p in paths:
+                p = p.split("${")[0]
+                matches = [rp for rp in registered
+                           if rp.startswith(p) or p.startswith(rp.split("{")[0])]
+                assert matches, f"apiClient path {p!r} has no registered route"
+        run(body())
+
+
+class TestInterruptExecution:
+    def test_interrupt_drops_pending(self, tmp_config):
+        from comfyui_distributed_tpu.cluster.runtime import PromptQueue
+
+        async def body():
+            q = PromptQueue()
+            # valid single-node prompts
+            p = {"1": {"class_type": "PrimitiveInt", "inputs": {"value": 1}}}
+            ids = [q.enqueue(p)[0] for _ in range(3)]
+            assert all(ids)
+            dropped = q.interrupt()
+            # consumer may have grabbed the first before interrupt
+            assert dropped >= 2
+            for pid in ids[3 - dropped:]:
+                assert q.history[pid]["status"] == "interrupted"
+            await q.stop()
+        run(body())
