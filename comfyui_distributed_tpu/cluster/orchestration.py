@@ -74,6 +74,9 @@ class Orchestrator:
         load_balance: bool = False,
         trace_id: str | None = None,
     ) -> OrchestrationResult:
+        from ..graph.executor import strip_meta
+
+        prompt = strip_meta(prompt)
         trace_id = trace_id or new_trace_id()
         config = self.load_config()
         candidates = self._resolve_enabled_hosts(config, enabled_ids)

@@ -17,7 +17,7 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Optional
 
-from ..graph.executor import GraphExecutor, validate_prompt
+from ..graph.executor import GraphExecutor, strip_meta, validate_prompt
 from ..utils.exceptions import ValidationError
 from ..utils.logging import log, trace_info
 
@@ -73,6 +73,7 @@ class PromptQueue:
         """Validate + enqueue; returns (prompt_id, node_errors). Mirrors
         ``queue_prompt_payload``: validation errors reject the prompt
         before it reaches the queue (``utils/async_helpers.py:108-149``)."""
+        prompt = strip_meta(prompt)
         errors = validate_prompt(prompt)
         if errors:
             return "", [e.as_dict() for e in errors]

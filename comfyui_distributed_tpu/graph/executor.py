@@ -26,6 +26,14 @@ class NodeError:
         return {"node_id": self.node_id, "message": self.message}
 
 
+def strip_meta(prompt: Prompt) -> Prompt:
+    """Drop underscore-prefixed keys (``_meta`` workflow headers etc.) —
+    shipped workflow files carry documentation alongside the nodes."""
+    if isinstance(prompt, dict) and any(k.startswith("_") for k in prompt):
+        return {k: v for k, v in prompt.items() if not k.startswith("_")}
+    return prompt
+
+
 def validate_prompt(prompt: Prompt) -> list[NodeError]:
     """Structural validation; returns per-node errors (empty = valid).
 

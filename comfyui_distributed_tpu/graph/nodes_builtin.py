@@ -429,6 +429,58 @@ class TPUFlowTxt2Img(NodeDef):
         return (images,)
 
 
+@register_node("TPUTxt2Video")
+class TPUTxt2Video(NodeDef):
+    """Sharded WAN-class t2v sampler (reference parity: the WAN t2v/i2v
+    workflows, SURVEY §2.9, which the reference runs job-per-worker).
+
+    ``mode="dp"``: each chip samples a full seed-varied video (the
+    reference's whole dispatch/collect cycle as one SPMD program).
+    ``mode="sp"``: ONE video's frame blocks shard over chips with joint
+    ring attention spanning the full spatio-temporal sequence — single-
+    video latency scaling the reference cannot express (SURVEY §5.7).
+    Frame count pads to 4n+1 (``nodes/distributed_upscale.py:131-142``'s
+    rule, applied as padding not a constraint)."""
+
+    INPUTS = {
+        "model": "MODEL", "positive": "CONDITIONING",
+        "seed": "INT", "frames": "INT", "steps": "INT",
+        "width": "INT", "height": "INT",
+    }
+    OPTIONAL = {"cfg": "FLOAT", "shift": "FLOAT", "mode": "STRING"}
+    HIDDEN = {"mesh": "*"}
+    RETURNS = ("IMAGE",)
+
+    def execute(self, model, positive, seed: int, frames: int, steps: int,
+                width: int, height: int, cfg: float = 1.0,
+                shift: float = 3.0, mode: str = "dp", mesh=None, **_):
+        from ..diffusion.pipeline_video import VideoSpec
+        from ..parallel.mesh import build_mesh
+
+        if mesh is None:
+            mesh = build_mesh({"dp": len(jax.devices())})
+        spec = VideoSpec(frames=int(frames), height=int(height),
+                         width=int(width), steps=int(steps),
+                         shift=float(shift), guidance_scale=float(cfg))
+        ctx = positive["context"]
+        pooled = positive.get("pooled")
+        if pooled is None:
+            pooled = jnp.zeros((1, model.pipeline.dit.config.pooled_dim))
+        key = jax.random.key(int(seed))
+        if mode == "sp":
+            if "sp" not in mesh.shape:
+                mesh = build_mesh({"sp": mesh.devices.size},
+                                  list(mesh.devices.flat))
+            videos = model.pipeline.generate_frames_fn(mesh, spec)(
+                key, ctx, pooled)
+        else:
+            videos = model.pipeline.generate(mesh, spec, int(seed), ctx, pooled)
+        # [B,F,H,W,3] → IMAGE batch [B·F,H,W,3] (ImageBatchDivider splits
+        # it back per video/chunk, reference workflow parity)
+        B, F = videos.shape[:2]
+        return (videos.reshape((B * F,) + videos.shape[2:]),)
+
+
 @register_node("VAEEncode")
 class VAEEncode(NodeDef):
     INPUTS = {"pixels": "IMAGE", "vae": "VAE"}

@@ -31,9 +31,12 @@ class ModelPreset:
     text: TextEncoderConfig
     sample_hw: tuple[int, int] = (128, 128)   # init-time latent H,W
     dit: "object | None" = None               # DiTConfig for flow models
+    video: "object | None" = None             # VideoDiTConfig for t2v models
 
     @property
     def kind(self) -> str:
+        if self.video is not None:
+            return "video"
         return "dit" if self.dit is not None else "unet"
 
 
@@ -56,6 +59,27 @@ def _flux_tiny_preset():
         sample_hw=(8, 8), dit=DiTConfig.tiny())
 
 
+def _wan_preset():
+    from .video_dit import VideoDiTConfig
+
+    # WAN-class t2v: 16-ch video latents, T5-width context
+    return ModelPreset(
+        "wan", unet=None,
+        vae=VAEConfig(latent_channels=16, scaling_factor=0.3611),
+        text=TextEncoderConfig(output_dim=4096, pooled_dim=768),
+        sample_hw=(60, 104),             # 480×832 / 8
+        video=VideoDiTConfig.wan())
+
+
+def _wan_tiny_preset():
+    from .video_dit import VideoDiTConfig
+
+    return ModelPreset(
+        "wan-tiny", unet=None, vae=VAEConfig.tiny(),
+        text=TextEncoderConfig.tiny(),
+        sample_hw=(8, 8), video=VideoDiTConfig.tiny())
+
+
 PRESETS: dict[str, ModelPreset] = {
     "sdxl": ModelPreset("sdxl", UNetConfig.sdxl(), VAEConfig.sdxl(),
                         TextEncoderConfig()),
@@ -66,6 +90,8 @@ PRESETS: dict[str, ModelPreset] = {
                         TextEncoderConfig.tiny(), sample_hw=(8, 8)),
     "flux": _flux_preset(),
     "flux-tiny": _flux_tiny_preset(),
+    "wan": _wan_preset(),
+    "wan-tiny": _wan_tiny_preset(),
 }
 
 
@@ -80,7 +106,16 @@ class ModelBundle:
                   preset.sample_hw[1] * preset.vae.downscale)
         vae = AutoencoderKL(preset.vae).init(k2, image_hw=img_hw)
         self.text_encoder = TextEncoder(preset.text).init(k3)
-        if preset.kind == "dit":
+        if preset.kind == "video":
+            from ..diffusion.pipeline_video import VideoPipeline
+            from .video_dit import init_video_dit
+
+            model, params = init_video_dit(
+                preset.video, k1,
+                sample_fhw=(5, *preset.sample_hw),
+                context_len=preset.text.max_len)
+            self.pipeline = VideoPipeline(model, params, vae)
+        elif preset.kind == "dit":
             from ..diffusion.pipeline_flow import FlowPipeline
             from .dit import init_dit
 
@@ -105,12 +140,12 @@ class ModelBundle:
         return self.preset.kind
 
     def _core_params(self):
-        if self.kind == "dit":
+        if self.kind in ("dit", "video"):
             return self.pipeline.dit_params
         return self.pipeline.unet_params
 
     def _set_core_params(self, params) -> None:
-        if self.kind == "dit":
+        if self.kind in ("dit", "video"):
             self.pipeline.dit_params = params
         else:
             self.pipeline.unet_params = params

@@ -127,6 +127,15 @@ def _flash_mha(q, k, v, block_q: int, block_k: int, interpret: bool):
         _flash_kernel, kv_len=Nk, block_k=block_k, num_k_blocks=nkb,
         scale=scale, precision=precision)
 
+    # inside shard_map the output must declare which mesh axes it varies
+    # over (check_vma) — it varies exactly like q does
+    try:
+        vma = getattr(jax.typeof(qp), "vma", None)
+    except Exception:  # noqa: BLE001 — typeof unavailable outside tracing
+        vma = None
+    out_sds = (jax.ShapeDtypeStruct(qp.shape, q.dtype, vma=vma)
+               if vma else jax.ShapeDtypeStruct(qp.shape, q.dtype))
+
     out = pl.pallas_call(
         kernel,
         grid=(BH, nqb, nkb),
@@ -140,7 +149,7 @@ def _flash_mha(q, k, v, block_q: int, block_k: int, interpret: bool):
         ],
         out_specs=pl.BlockSpec((1, block_q, D), lambda b, i, j: (b, i, 0),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct(qp.shape, q.dtype),
+        out_shape=out_sds,
         scratch_shapes=[
             pltpu.VMEM((block_q, _LANES), jnp.float32),   # running max
             pltpu.VMEM((block_q, _LANES), jnp.float32),   # running sum

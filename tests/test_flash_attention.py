@@ -81,6 +81,29 @@ class TestNumerics:
         np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
 
 
+class TestShardMap:
+    def test_inside_shard_map_dp(self):
+        """The production path: attention running inside the dp-sharded
+        generation program (vma must propagate to the pallas out_shape)."""
+        from jax.sharding import PartitionSpec as P
+
+        from comfyui_distributed_tpu.parallel.mesh import build_mesh
+
+        mesh = build_mesh({"dp": 8})
+        q, k, v = rand_qkv(jax.random.key(8), B=8, Nq=64, Nk=64, H=2, D=32)
+
+        def per_shard(q, k, v):
+            return flash_attention(q, k, v, interpret=True)
+
+        f = jax.jit(jax.shard_map(
+            per_shard, mesh=mesh,
+            in_specs=(P("dp"), P("dp"), P("dp")),
+            out_specs=P("dp")))
+        out = f(q, k, v)
+        ref = dense_reference(q, k, v)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
 class TestDispatch:
     def test_full_attention_env_toggle(self, monkeypatch):
         from comfyui_distributed_tpu.ops import attention as attn

@@ -78,23 +78,24 @@ class TestWorkerMonitor:
         long-lived worker; when the master exits, the monitor must kill
         the worker (reference workers/worker_monitor.py:94-106)."""
         monitor = Path("comfyui_distributed_tpu/workers/worker_monitor.py").resolve()
-        master = subprocess.Popen([sys.executable, "-c", "import time; time.sleep(1.5)"])
+        master = subprocess.Popen([sys.executable, "-c", "import time; time.sleep(4)"])
         pid_file = tmp_path / "pids"
         env = {**os.environ, "CDT_MASTER_PID": str(master.pid),
                "CDT_PID_FILE": str(pid_file), "CDT_MONITOR_POLL": "0.2"}
         mon = subprocess.Popen(
             [sys.executable, str(monitor), sys.executable, "-c",
-             "import time; time.sleep(60)"],
+             "import time; time.sleep(120)"],
             env=env)
-        # wait for pid file
-        for _ in range(50):
+        # wait for pid file (generous: interpreter start can starve under
+        # concurrent suite load)
+        for _ in range(300):
             if pid_file.exists() and "," in pid_file.read_text():
                 break
             time.sleep(0.1)
         _, worker_pid = map(int, pid_file.read_text().split(","))
         assert is_process_alive(worker_pid)
-        master.wait(timeout=10)
-        mon.wait(timeout=15)          # monitor exits after killing worker
+        master.wait(timeout=30)
+        mon.wait(timeout=30)          # monitor exits after killing worker
         time.sleep(0.3)
         assert not is_process_alive(worker_pid)
 
@@ -104,7 +105,7 @@ class TestWorkerMonitor:
                "CDT_MONITOR_POLL": "0.1"}
         mon = subprocess.Popen(
             [sys.executable, str(monitor), sys.executable, "-c", "exit(3)"], env=env)
-        assert mon.wait(timeout=15) == 3
+        assert mon.wait(timeout=60) == 3
 
 
 class TestProcessManager:
