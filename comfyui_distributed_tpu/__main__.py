@@ -69,6 +69,24 @@ def cmd_bench(args: argparse.Namespace) -> None:
     runpy.run_path(str(bench), run_name="__main__")
 
 
+def cmd_convert(args: argparse.Namespace) -> None:
+    """Published single-file .safetensors → orbax checkpoint dir usable via
+    CDT_CHECKPOINT_ROOT (the reference ships model *names* and assumes
+    ComfyUI loads them; here conversion is an explicit, verified step)."""
+    from pathlib import Path
+
+    from .models.registry import PRESETS, ModelBundle
+
+    preset = PRESETS.get(args.preset)
+    if preset is None:
+        sys.exit(f"unknown preset {args.preset!r}; have {sorted(PRESETS)}")
+    bundle = ModelBundle(preset)
+    bundle.load_safetensors_checkpoint(Path(args.checkpoint))
+    bundle.save_checkpoint(Path(args.out))
+    print(json.dumps({"preset": args.preset, "out": str(args.out),
+                      "entries": sorted(bundle._state_entries())}))
+
+
 def main(argv: list[str] | None = None) -> None:
     p = argparse.ArgumentParser(prog="comfyui_distributed_tpu")
     sub = p.add_subparsers(dest="command", required=True)
@@ -83,6 +101,13 @@ def main(argv: list[str] | None = None) -> None:
 
     bench = sub.add_parser("bench", help="run the throughput benchmark")
     bench.set_defaults(fn=cmd_bench)
+
+    conv = sub.add_parser(
+        "convert", help="convert a single-file .safetensors checkpoint")
+    conv.add_argument("--checkpoint", required=True)
+    conv.add_argument("--preset", default="sdxl")
+    conv.add_argument("--out", required=True)
+    conv.set_defaults(fn=cmd_convert)
 
     args = p.parse_args(argv)
     args.fn(args)

@@ -14,7 +14,6 @@ from pathlib import Path
 from aiohttp import web
 
 from ..cluster.controller import Controller
-from ..cluster.job_timeout import check_and_requeue_timed_out_workers
 from ..utils import constants
 from ..utils.exceptions import DistributedError, ValidationError
 from ..utils.logging import log
@@ -31,6 +30,34 @@ async def _json_body(request: web.Request) -> dict:
         return await request.json()
     except (json.JSONDecodeError, UnicodeDecodeError):
         raise ValidationError("body must be valid JSON")
+
+
+# Read-only probe surface the dashboard hits on *other* hosts (the
+# reference forces --enable-cors-header on workers for the same reason,
+# workers/process/launch_builder.py:100-109). Mutating routes are NOT
+# CORS-exposed: with a public quick-tunnel up, a permissive `*` on config
+# mutation / worker launch / upload would let any web page reconfigure the
+# cluster. `settings.permissive_cors` restores the old behavior.
+_CORS_SAFE_PATHS = frozenset({
+    "/distributed/health",
+    "/distributed/system_info",
+    "/distributed/network_info",
+    "/prompt",
+})
+
+# header cluster peers send on multipart POSTs; a cross-origin browser page
+# cannot attach it without triggering a CORS preflight (which mutating
+# routes never grant)
+CLIENT_HEADER = "X-CDT-Client"
+
+
+def _post_content_type_ok(request: web.Request) -> bool:
+    ctype = (request.headers.get("Content-Type") or "").lower()
+    if ctype.startswith("application/json"):
+        return True
+    if ctype.startswith("multipart/form-data"):
+        return CLIENT_HEADER in request.headers
+    return False
 
 
 def create_app(controller: Controller) -> web.Application:
@@ -57,16 +84,27 @@ def create_app(controller: Controller) -> web.Application:
 
     @web.middleware
     async def cors_middleware(request, handler):
-        # the dashboard probes/controls worker hosts cross-origin — the
-        # reference forces --enable-cors-header on workers
-        # (workers/process/launch_builder.py:100-109)
         if request.method == "OPTIONS":
             resp = web.Response()
+        elif request.method == "POST" and not _post_content_type_ok(request):
+            # scoping the ACAO header alone doesn't stop cross-origin
+            # "simple requests" (text/plain POSTs execute without any
+            # preflight): mutating routes additionally require a JSON
+            # content type, and multipart routes the X-CDT-Client header
+            # (cluster peers set it; browser form posts can't without a
+            # preflight)
+            resp = json_error("unsupported media type", 415)
         else:
             resp = await handler(request)
-        resp.headers["Access-Control-Allow-Origin"] = "*"
-        resp.headers["Access-Control-Allow-Methods"] = "GET, POST, OPTIONS"
-        resp.headers["Access-Control-Allow-Headers"] = "Content-Type"
+        permissive = bool(controller.load_config().get("settings", {})
+                          .get("permissive_cors", False))
+        safe = (request.method in ("GET", "OPTIONS")
+                and (request.path in _CORS_SAFE_PATHS
+                     or request.path.startswith("/distributed/queue_status")))
+        if permissive or safe:
+            resp.headers["Access-Control-Allow-Origin"] = "*"
+            resp.headers["Access-Control-Allow-Methods"] = "GET, POST, OPTIONS"
+            resp.headers["Access-Control-Allow-Headers"] = "Content-Type"
         return resp
 
     app.middlewares.append(error_middleware)

@@ -59,7 +59,18 @@ def register(router, controller) -> None:
                 except json.JSONDecodeError:
                     raise ValidationError("tiles_metadata must be valid JSON")
             elif part.name and part.name.startswith("tile_"):
-                tiles[part.name] = decode_png(await part.read())
+                raw = await part.read()
+                if part.headers.get("Content-Type") == "application/x-cdt-frame":
+                    # CDTF float32 frames: the native transport (lossless,
+                    # crc-checked); PNG stays accepted for parity
+                    from .. import native
+
+                    try:
+                        tiles[part.name] = native.unpack_frame(raw)
+                    except ValueError as e:
+                        raise ValidationError(f"{part.name}: {e}")
+                else:
+                    tiles[part.name] = decode_png(raw)
         if metadata is None:
             raise ValidationError("missing tiles_metadata part")
         require_fields(metadata, "job_id", "worker_id")

@@ -101,15 +101,22 @@ class CollectorBridge:
                            filename=f"frame_{i}.cdtf",
                            content_type="application/x-cdt-frame")
         try:
-            async with session.post(url, data=form) as resp:
+            async with session.post(url, data=form,
+                                    headers={"X-CDT-Client": "1"}) as resp:
                 if resp.status in (404, 405):
                     return False          # legacy master: use envelopes
                 if resp.status < 400:
                     debug_log(f"collector[{job_id}] worker {worker_id} sent "
                               f"{arr.shape[0]} frames")
                     return True
+                # any error (transient 5xx included) falls back to the
+                # envelope path, which retries with exponential backoff —
+                # a fire-and-forget send must never drop a finished job's
+                # results on a single failed POST
                 body = await resp.text()
-                raise WorkerError(f"frame send {resp.status}: {body[:200]}")
+                log(f"frame send {resp.status} ({body[:200]}); "
+                    "using envelope fallback")
+                return False
         except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
             debug_log(f"frame send failed ({e}); using envelope fallback")
             return False

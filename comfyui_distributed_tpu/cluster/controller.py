@@ -39,6 +39,7 @@ class Controller:
                                          config_loader=self.load_config)
         self.loop: Optional[asyncio.AbstractEventLoop] = None
         self.bridge: Optional[CollectorBridge] = None
+        self.tile_farm = None
         self._mesh = None
         self._mesh_devices = mesh_devices
         self._registry = None
@@ -86,13 +87,18 @@ class Controller:
         }
         if self.bridge is not None:
             ctx["collector_bridge"] = self.bridge
+        if self.tile_farm is not None:
+            ctx["tile_farm"] = self.tile_farm
         return ctx
 
     # --- lifecycle ----------------------------------------------------------
 
     async def startup(self) -> None:
+        from .tile_farm import TileFarm
+
         self.loop = asyncio.get_running_loop()
         self.bridge = CollectorBridge(self.store, self.loop)
+        self.tile_farm = TileFarm(self.store, self.loop)
         self.queue.start()
         role = "worker" if self.is_worker else "master"
         log(f"controller up as {role} (machine {machine_id()})")

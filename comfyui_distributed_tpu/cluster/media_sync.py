@@ -142,7 +142,8 @@ async def _upload_file(host: dict, rel: str, path: Path,
                            content_type="application/octet-stream")
             session = get_client_session()
             async with session.post(
-                url, data=form, timeout=aiohttp.ClientTimeout(total=timeout)
+                url, data=form, timeout=aiohttp.ClientTimeout(total=timeout),
+                headers={"X-CDT-Client": "1"},
             ) as resp:
                 return resp.status == 200
     except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:

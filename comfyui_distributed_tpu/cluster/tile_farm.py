@@ -1,0 +1,275 @@
+"""Cross-host tile farm: pull-queue scatter of tile work between hosts.
+
+On-pod, tile parallelism is one SPMD program (``tiles/engine.py``). Across
+hosts — where chips don't share ICI — this module reproduces the
+reference's distributed-upscale machinery over the HTTP control plane:
+
+- master (``master_run``): seeds the pull queue
+  (``upscale/modes/static.py:371-395``), processes tasks itself while
+  draining worker results (``:406-448``), runs the heartbeat-timeout
+  requeue monitor every HEARTBEAT_INTERVAL (``:337-343``,
+  ``upscale/job_timeout.py:17-150``), and reprocesses every leftover
+  locally so a job always completes (``:469-513``);
+- worker (``worker_run``): polls job-ready (``:33-47``), pulls task
+  ranges (``worker_comms.py:124-188``), runs them through the local SPMD
+  chunk program, heartbeats per task, and flushes results in size-capped
+  multipart batches with retries (``worker_comms.py:16-108``).
+
+Transport: CDTF binary frames (float32, crc-checked — zero precision loss)
+instead of the reference's PNG parts; the route also accepts PNG for
+compatibility. Tile task ranges are defined on *global* tile indices, and
+per-tile noise keys fold the global index, so any host can process any
+range and requeue is numerically invisible.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import time
+from typing import Awaitable, Callable, Optional, Sequence
+
+import aiohttp
+import numpy as np
+
+from ..utils import constants
+from ..utils.async_helpers import run_in_loop
+from ..utils.exceptions import TileCollectionError, WorkerError
+from ..utils.logging import debug_log, log
+from ..utils.network import get_client_session, normalize_host_url
+from .job_store import JobStore
+from .job_timeout import check_and_requeue_timed_out_workers
+
+ProcessFn = Callable[[int, int], np.ndarray]      # (start, end) -> [n,...]
+ProbeFn = Callable[[str], Awaitable[Optional[dict]]]
+
+
+class TileFarm:
+    """Bound to the controller's store + event loop; graph nodes call the
+    sync wrappers from the executor thread (same bridging discipline as
+    ``CollectorBridge``)."""
+
+    def __init__(self, store: JobStore, loop: asyncio.AbstractEventLoop):
+        self.store = store
+        self.loop = loop
+
+    # --- sync wrappers (node-facing) ---------------------------------------
+
+    def master_run(self, job_id: str, total: int, process_fn: ProcessFn,
+                   chunk: int = 1, **kw) -> dict[int, np.ndarray]:
+        return run_in_loop(
+            self.master_run_async(job_id, total, process_fn, chunk, **kw),
+            self.loop, timeout=None)
+
+    def worker_run(self, job_id: str, worker_id: str, master_url: str,
+                   process_fn: ProcessFn, **kw) -> int:
+        return run_in_loop(
+            self.worker_run_async(job_id, worker_id, master_url,
+                                  process_fn, **kw),
+            self.loop, timeout=None)
+
+    # --- master role --------------------------------------------------------
+
+    async def master_run_async(
+        self, job_id: str, total: int, process_fn: ProcessFn, chunk: int = 1,
+        heartbeat_interval: float | None = None,
+        worker_timeout: float | None = None,
+        probe_fn: ProbeFn | None = None,
+        overall_timeout: float | None = None,
+    ) -> dict[int, np.ndarray]:
+        """Drive a tile job to completion; returns {task_id: array}.
+
+        The loop interleaves what the reference splits into three phases
+        (master work loop → collect-and-monitor → local fallback): the
+        master pulls from the same queue as workers, so it naturally takes
+        over everything requeued from dead workers, and the job completes
+        whenever at least the master survives.
+        """
+        heartbeat_interval = (constants.HEARTBEAT_INTERVAL
+                              if heartbeat_interval is None else heartbeat_interval)
+        job = await self.store.init_tile_job(job_id, total, chunk=chunk)
+        deadline = (time.monotonic() + overall_timeout) if overall_timeout else None
+        last_check = time.monotonic()
+        log(f"tile-farm[{job_id}] master: {job.total_tasks} tasks "
+            f"(chunk {chunk}, {total} tiles)")
+
+        while True:
+            async with self.store.lock:
+                done = len(job.completed) >= job.total_tasks
+            if done:
+                break
+            if deadline and time.monotonic() > deadline:
+                raise TileCollectionError(
+                    f"tile job {job_id} timed out", job_id=job_id)
+
+            task = await self.store.request_work(job_id, "master")
+            if task is not None:
+                arr = await asyncio.to_thread(
+                    process_fn, task["start"], task["end"])
+                await self.store.submit_result(
+                    job_id, "master", task["task_id"], {"image": arr})
+            else:
+                # queue momentarily empty: wait for worker results
+                try:
+                    await asyncio.wait_for(
+                        job.results.get(),
+                        timeout=min(constants.COLLECT_POLL_TIMEOUT,
+                                    heartbeat_interval),
+                    )
+                except asyncio.TimeoutError:
+                    pass
+
+            if time.monotonic() - last_check >= heartbeat_interval:
+                evicted = await check_and_requeue_timed_out_workers(
+                    self.store, job_id, timeout=worker_timeout,
+                    probe_fn=probe_fn)
+                for w, tasks in evicted.items():
+                    log(f"tile-farm[{job_id}] requeued {len(tasks)} tasks "
+                        f"from silent worker {w}")
+                last_check = time.monotonic()
+
+        async with self.store.lock:
+            results = {tid: payload["image"]
+                       for tid, payload in job.completed.items()}
+        await self.store.cleanup_job(job_id)
+        log(f"tile-farm[{job_id}] complete ({len(results)} tasks)")
+        return results
+
+    # --- worker role --------------------------------------------------------
+
+    async def worker_run_async(
+        self, job_id: str, worker_id: str, master_url: str,
+        process_fn: ProcessFn, max_batch: int | None = None,
+        ready_polls: int = 20, ready_interval: float = 1.0,
+    ) -> int:
+        """Pull-process-submit loop; returns number of tasks completed."""
+        max_batch = constants.MAX_BATCH if max_batch is None else max_batch
+        base = normalize_host_url(master_url)
+        session = get_client_session()
+
+        if not await self._poll_job_ready(session, base, job_id,
+                                          ready_polls, ready_interval):
+            log(f"tile-farm[{job_id}] worker {worker_id}: job never appeared")
+            return 0
+
+        pending_flush: list[tuple[int, dict, np.ndarray]] = []
+        completed = 0
+        while True:
+            task = await self._request_work(session, base, job_id, worker_id)
+            if task is None:
+                break
+            arr = await asyncio.to_thread(process_fn, task["start"], task["end"])
+            meta = {"task_id": task["task_id"], "start": task["start"],
+                    "end": task["end"]}
+            pending_flush.append((task["task_id"], meta, arr))
+            completed += 1
+            await self._heartbeat(session, base, job_id, worker_id)
+            if len(pending_flush) >= max_batch:
+                await self._flush(session, base, job_id, worker_id, pending_flush)
+                pending_flush = []
+        if pending_flush:
+            await self._flush(session, base, job_id, worker_id, pending_flush)
+        debug_log(f"tile-farm[{job_id}] worker {worker_id}: "
+                  f"{completed} tasks done")
+        return completed
+
+    # --- wire helpers -------------------------------------------------------
+
+    async def _poll_job_ready(self, session, base, job_id, polls, interval) -> bool:
+        for _ in range(polls):
+            try:
+                async with session.get(
+                        f"{base}/distributed/job_status",
+                        params={"job_id": job_id}) as resp:
+                    if resp.status < 400:
+                        body = await resp.json()
+                        if body.get("exists"):
+                            return True
+            except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
+                pass
+            await asyncio.sleep(interval)
+        return False
+
+    async def _request_work(self, session, base, job_id, worker_id) -> Optional[dict]:
+        """30 s total budget with 404-tolerant retries (reference
+        ``worker_comms.py:124-169``)."""
+        deadline = time.monotonic() + constants.WORK_REQUEST_BUDGET
+        attempt = 0
+        while time.monotonic() < deadline:
+            try:
+                async with session.post(
+                        f"{base}/distributed/request_image",
+                        json={"job_id": job_id, "worker_id": worker_id}) as resp:
+                    if resp.status < 400:
+                        return (await resp.json()).get("task")
+            except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
+                debug_log(f"work request failed ({e}); retrying")
+            attempt += 1
+            await asyncio.sleep(min(constants.SEND_BACKOFF_BASE * (2 ** attempt), 5.0))
+        return None
+
+    async def _heartbeat(self, session, base, job_id, worker_id) -> None:
+        try:
+            async with session.post(
+                    f"{base}/distributed/heartbeat",
+                    json={"job_id": job_id, "worker_id": worker_id}) as resp:
+                await resp.release()
+        except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
+            pass   # heartbeat loss is what the timeout monitor detects
+
+    async def _flush(self, session, base, job_id, worker_id, batch) -> None:
+        """Size-capped chunked multipart submit with retries (reference
+        ``worker_comms.py:16-108``: ≤ MAX_PAYLOAD−1MB per POST, ≥1 tile)."""
+        from .. import native
+
+        cap = constants.MAX_PAYLOAD_SIZE - (1 << 20)
+        group: list[tuple[int, dict, bytes]] = []
+        size = 0
+        for task_id, meta, arr in batch:
+            frame = native.pack_frame(np.asarray(arr, np.float32), level=1)
+            if group and size + len(frame) > cap:
+                await self._post_tiles(session, base, job_id, worker_id, group)
+                group, size = [], 0
+            group.append((task_id, meta, frame))
+            size += len(frame)
+        if group:
+            await self._post_tiles(session, base, job_id, worker_id, group)
+
+    async def _post_tiles(self, session, base, job_id, worker_id, group) -> None:
+        url = f"{base}/distributed/submit_tiles"
+        last: Exception | None = None
+        for attempt in range(constants.SEND_MAX_RETRIES):
+            form = aiohttp.FormData()
+            form.add_field("tiles_metadata", json.dumps({
+                "job_id": job_id, "worker_id": worker_id,
+                "tiles": [{**meta, "part": f"tile_{tid}"}
+                          for tid, meta, _ in group],
+            }), content_type="application/json")
+            for tid, _, frame in group:
+                form.add_field(f"tile_{tid}", frame,
+                               filename=f"tile_{tid}.cdtf",
+                               content_type="application/x-cdt-frame")
+            try:
+                async with session.post(url, data=form,
+                                        headers={"X-CDT-Client": "1"}) as resp:
+                    if resp.status < 400:
+                        return
+                    body = await resp.text()
+                    last = WorkerError(f"{resp.status}: {body[:200]}")
+            except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
+                last = e
+            await asyncio.sleep(constants.SEND_BACKOFF_BASE * (2 ** attempt))
+        raise WorkerError(f"tile submit to {url} failed after retries: {last}")
+
+
+def assemble_tiles(results: dict[int, np.ndarray], total: int,
+                   chunk: int) -> np.ndarray:
+    """{task_id: [n, ch, cw, C]} → ordered [total, ch, cw, C]."""
+    parts: list[np.ndarray] = []
+    for tid in sorted(results):
+        parts.append(np.asarray(results[tid], np.float32))
+    out = np.concatenate(parts, axis=0)
+    if out.shape[0] < total:
+        raise TileCollectionError(
+            f"assembled {out.shape[0]} tiles, expected {total}")
+    return out[:total]

@@ -181,13 +181,27 @@ class Txt2ImgPipeline:
         key = jax.random.key(seed)
         return fn(key, context, uncond_context, y, uncond_y)
 
-    @functools.lru_cache(maxsize=8)
-    def _cached_fn_impl(self, mesh_key, spec):
-        return self.generate_fn(self._meshes[mesh_key], spec)
+    _CACHE_MAX = 8
+
+    @staticmethod
+    def _mesh_cache_key(mesh: Mesh) -> tuple:
+        """Value key for a mesh: axis names + shape + device ids.
+
+        ``id(mesh)`` is wrong here — ids are recycled after GC, so a
+        long-lived controller could be handed a stale compiled fn for a
+        *different* mesh with a coincident id.
+        """
+        return (tuple(mesh.axis_names), tuple(mesh.shape.values()),
+                tuple(d.id for d in mesh.devices.flat))
 
     def _cached_fn(self, mesh: Mesh, spec: GenerationSpec):
-        if not hasattr(self, "_meshes"):
-            self._meshes: dict[int, Mesh] = {}
-        mesh_key = id(mesh)
-        self._meshes[mesh_key] = mesh
-        return self._cached_fn_impl(mesh_key, spec)
+        if not hasattr(self, "_fn_cache"):
+            self._fn_cache: "dict[tuple, Any]" = {}
+        key = (self._mesh_cache_key(mesh), spec)
+        fn = self._fn_cache.get(key)
+        if fn is None:
+            if len(self._fn_cache) >= self._CACHE_MAX:
+                self._fn_cache.pop(next(iter(self._fn_cache)))
+            fn = self.generate_fn(mesh, spec)
+            self._fn_cache[key] = fn
+        return fn

@@ -253,6 +253,7 @@ class UltimateSDUpscaleDistributed(NodeDef):
         "mesh": "*", "multi_job_id": "STRING", "is_worker": "BOOLEAN",
         "worker_id": "STRING", "master_url": "STRING",
         "enabled_worker_ids": "*", "delegate_only": "BOOLEAN",
+        "tile_farm": "*",
     }
     RETURNS = ("IMAGE",)
 
@@ -260,7 +261,10 @@ class UltimateSDUpscaleDistributed(NodeDef):
                 denoise: float, upscale_by: float, tile_width: int = 512,
                 tile_height: int = 512, tile_padding: int = 32,
                 cfg: float = 5.0, sampler_name: str = "euler",
-                scheduler: str = "karras", mesh=None, **_):
+                scheduler: str = "karras", mesh=None, multi_job_id: str = "",
+                is_worker: bool = False, worker_id: str = "",
+                master_url: str = "", enabled_worker_ids=(), tile_farm=None,
+                **_):
         from ..parallel.mesh import build_mesh
         from ..tiles.engine import TileUpscaler, UpscaleSpec
 
@@ -277,11 +281,47 @@ class UltimateSDUpscaleDistributed(NodeDef):
         if adm:
             y = _adm_from_cond(positive, adm)
             uy = _adm_from_cond(negative, adm)
-        out = upscaler.upscale(
-            mesh, jnp.asarray(image), spec, int(seed),
-            positive["context"], negative["context"], y, uy,
-        )
-        return (out,)
+
+        # cross-host farm engages when orchestration assigned a job id and
+        # remote worker hosts participate (reference mode selection,
+        # nodes/distributed_upscale.py:230-267; on-pod SPMD otherwise)
+        farm_active = (tile_farm is not None and multi_job_id
+                       and (is_worker or enabled_worker_ids))
+        if not farm_active:
+            out = upscaler.upscale(
+                mesh, jnp.asarray(image), spec, int(seed),
+                positive["context"], negative["context"], y, uy,
+            )
+            return (out,)
+
+        images = jnp.asarray(image)
+        outs = []
+        for b in range(images.shape[0]):
+            plan = upscaler.range_plan(
+                mesh, images[b], spec, int(seed),
+                positive["context"], negative["context"], y, uy,
+            )
+            job_id = (f"{multi_job_id}_b{b}" if images.shape[0] > 1
+                      else multi_job_id)
+            if is_worker:
+                from ..ops.resize import upscale_image
+
+                tile_farm.worker_run(job_id, worker_id, master_url,
+                                     plan.run_range)
+                # master owns the composite; the worker returns a size-
+                # correct plain resize so its downstream graph stays
+                # shape-consistent (reference worker role,
+                # nodes/distributed_upscale.py:164)
+                outs.append(upscale_image(images[b][None], spec.scale,
+                                          spec.resize_method)[0])
+                continue
+            from ..cluster.tile_farm import assemble_tiles
+
+            results = tile_farm.master_run(
+                job_id, plan.num_tiles, plan.run_range, chunk=plan.chunk)
+            tiles = assemble_tiles(results, plan.num_tiles, plan.chunk)
+            outs.append(upscaler.composite(tiles, plan))
+        return (jnp.stack([jnp.asarray(o) for o in outs], axis=0),)
 
 
 def _adm_from_cond(cond: dict, adm_channels: int) -> jax.Array:

@@ -1,0 +1,248 @@
+"""Weight-faithful CLIP text encoders (SDXL/SD1.5 conditioning).
+
+The reference free-rides on ComfyUI's CLIP loaders for conditioning
+(SURVEY "external substrate"); this module owns it. Unlike
+``models/text.py`` (a generic encoder for random-init benchmarks), these
+modules reproduce the *exact* CLIP text-transformer computation so
+published checkpoints load and match:
+
+- pre-LN residual blocks with a **causal** attention mask,
+- ``quick_gelu`` (CLIP-L) or ``gelu`` (CLIP-G) MLP activation,
+- EOT pooling at ``argmax(tokens == eot_token_id)``,
+- optional ``text_projection`` (CLIP-G pooled output),
+- penultimate-layer hidden states (what SDXL/SD conditioning consumes:
+  sgm's FrozenCLIPEmbedder uses ``hidden_states[-2]`` with no final LN).
+
+Numerics are validated against ``transformers.CLIPTextModel`` in
+``tests/test_clip.py``.
+
+SDXL's conditioning contract (matching sgm/ComfyUI):
+``context = concat(L.penultimate[768], G.penultimate[1280]) = 2048``,
+``pooled = G.final EOT @ text_projection = 1280``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+NEG_INF = -1e9
+
+
+@dataclasses.dataclass(frozen=True)
+class CLIPTextConfig:
+    vocab_size: int = 49408
+    max_len: int = 77
+    width: int = 768
+    layers: int = 12
+    heads: int = 12
+    intermediate: int = 3072
+    act: str = "quick_gelu"            # CLIP-L; CLIP-G uses "gelu"
+    eot_token_id: int = 49407
+    projection_dim: int = 0            # 0 = no text_projection head
+    layer_norm_eps: float = 1e-5
+    dtype: str = "float32"             # conditioning runs once; keep f32
+
+    @classmethod
+    def clip_l(cls) -> "CLIPTextConfig":
+        """openai/clip-vit-large-patch14 text tower (SD1.5 + SDXL ctx)."""
+        return cls()
+
+    @classmethod
+    def clip_g(cls) -> "CLIPTextConfig":
+        """OpenCLIP bigG-14 text tower (SDXL's second encoder)."""
+        return cls(width=1280, layers=32, heads=20, intermediate=5120,
+                   act="gelu", projection_dim=1280)
+
+    @classmethod
+    def tiny(cls, **kw) -> "CLIPTextConfig":
+        base = dict(vocab_size=128, max_len=16, width=32, layers=2, heads=2,
+                    intermediate=64, eot_token_id=127)
+        base.update(kw)
+        return cls(**base)
+
+
+def quick_gelu(x: jax.Array) -> jax.Array:
+    return x * jax.nn.sigmoid(1.702 * x)
+
+
+class _CLIPAttention(nn.Module):
+    config: CLIPTextConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array, mask: jax.Array) -> jax.Array:
+        cfg = self.config
+        head_dim = cfg.width // cfg.heads
+        B, N, _ = x.shape
+        q = nn.Dense(cfg.width, name="q_proj")(x)
+        k = nn.Dense(cfg.width, name="k_proj")(x)
+        v = nn.Dense(cfg.width, name="v_proj")(x)
+        q = q.reshape(B, N, cfg.heads, head_dim)
+        k = k.reshape(B, N, cfg.heads, head_dim)
+        v = v.reshape(B, N, cfg.heads, head_dim)
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / (head_dim ** 0.5)
+        s = s + mask[None, None]
+        p = jax.nn.softmax(s, axis=-1)
+        out = jnp.einsum("bhqk,bkhd->bqhd", p, v).reshape(B, N, cfg.width)
+        return nn.Dense(cfg.width, name="out_proj")(out)
+
+
+class _CLIPLayer(nn.Module):
+    config: CLIPTextConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array, mask: jax.Array) -> jax.Array:
+        cfg = self.config
+        # HF/OpenCLIP "gelu" is the exact erf form (flax defaults to tanh)
+        act = quick_gelu if cfg.act == "quick_gelu" else (
+            lambda x: nn.gelu(x, approximate=False))
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="ln1")(x)
+        x = x + _CLIPAttention(cfg, name="attn")(h, mask)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="ln2")(x)
+        h = nn.Dense(cfg.intermediate, name="fc1")(h)
+        h = nn.Dense(cfg.width, name="fc2")(act(h))
+        return x + h
+
+
+class CLIPTextTransformer(nn.Module):
+    """Returns every view SD-family conditioning needs in one pass."""
+
+    config: CLIPTextConfig
+
+    @nn.compact
+    def __call__(self, tokens: jax.Array) -> dict[str, jax.Array]:
+        cfg = self.config
+        B, N = tokens.shape
+        x = nn.Embed(cfg.vocab_size, cfg.width, name="tok_emb")(tokens)
+        pos = self.param("pos_emb", nn.initializers.normal(0.01),
+                         (cfg.max_len, cfg.width))
+        x = x + pos[None, :N]
+        mask = jnp.triu(jnp.full((N, N), NEG_INF, x.dtype), k=1)
+
+        penultimate = x
+        for i in range(cfg.layers):
+            if i == cfg.layers - 1:
+                penultimate = x            # input of the last layer = output
+            x = _CLIPLayer(cfg, name=f"layer_{i}")(x, mask)
+
+        last = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="final_ln")(x)
+        eot = jnp.argmax((tokens == cfg.eot_token_id).astype(jnp.int32), axis=1)
+        pooled = last[jnp.arange(B), eot]
+        out = {"last_hidden": last, "penultimate": penultimate,
+               "pooled": pooled}
+        if cfg.projection_dim:
+            out["projected"] = nn.Dense(cfg.projection_dim, use_bias=False,
+                                        name="text_projection")(pooled)
+        return out
+
+
+@dataclasses.dataclass
+class CLIPTextModel:
+    """Host wrapper: module + params."""
+
+    config: CLIPTextConfig
+    params: Optional[dict] = None
+
+    def __post_init__(self):
+        self.module = CLIPTextTransformer(self.config)
+
+    def init(self, rng: jax.Array) -> "CLIPTextModel":
+        toks = jnp.zeros((1, self.config.max_len), jnp.int32)
+        self.params = self.module.init(rng, toks)
+        return self
+
+    def __call__(self, tokens: jax.Array) -> dict[str, jax.Array]:
+        return self.module.apply(self.params, tokens)
+
+
+class SDXLTextStack:
+    """The dual-encoder conditioning stack SDXL checkpoints ship.
+
+    ``encode(tokens_l, tokens_g)`` →
+    ``context [B,77,2048]`` (concat of both penultimates) and
+    ``pooled [B,1280]`` (G's projected EOT) — matching sgm's
+    ``GeneralConditioner`` wiring that the reference inherits via ComfyUI.
+    """
+
+    def __init__(self, clip_l: CLIPTextModel, clip_g: CLIPTextModel):
+        assert clip_g.config.projection_dim, "CLIP-G needs text_projection"
+        self.clip_l = clip_l
+        self.clip_g = clip_g
+
+    @classmethod
+    def init_random(cls, rng: jax.Array, tiny: bool = False) -> "SDXLTextStack":
+        k1, k2 = jax.random.split(rng)
+        if tiny:
+            cfg_l = CLIPTextConfig.tiny()
+            cfg_g = CLIPTextConfig.tiny(width=48, heads=2, act="gelu",
+                                        projection_dim=48)
+        else:
+            cfg_l, cfg_g = CLIPTextConfig.clip_l(), CLIPTextConfig.clip_g()
+        return cls(CLIPTextModel(cfg_l).init(k1), CLIPTextModel(cfg_g).init(k2))
+
+    def encode_tokens(self, tokens_l: jax.Array,
+                      tokens_g: jax.Array) -> tuple[jax.Array, jax.Array]:
+        out_l = self.clip_l(tokens_l)
+        out_g = self.clip_g(tokens_g)
+        context = jnp.concatenate(
+            [out_l["penultimate"], out_g["penultimate"]], axis=-1)
+        return context, out_g["projected"]
+
+
+class CLIPConditioner:
+    """``TextEncoder``-compatible adapter (strings → context, pooled) over
+    the weight-faithful CLIP stack, so graph nodes (``CLIPTextEncode``)
+    work unchanged whichever encoder a bundle carries.
+
+    Tokenizers come from ``CDT_TOKENIZER_DIR`` (standard vocab.json +
+    merges.txt). Without one, a deterministic hash fallback keeps the
+    stack runnable (correct SOT/EOT framing so pooling works) — outputs
+    are then *not* meaningful text conditioning, and a warning says so.
+    """
+
+    def __init__(self, stack, kind: str = "sdxl", tok_l=None, tok_g=None):
+        from ..utils.logging import log
+        from .tokenizer import load_sd_tokenizers
+
+        self.stack = stack
+        self.kind = kind
+        if tok_l is None and tok_g is None:
+            tok_l, tok_g = load_sd_tokenizers()
+        self.tok_l, self.tok_g = tok_l, tok_g
+        if self.tok_l is None:
+            log("WARNING: no CLIP vocab at CDT_TOKENIZER_DIR — text is "
+                "hash-tokenized; conditioning will not reflect the prompt")
+
+    def _ids(self, texts, tok, cfg, pad_id: int):
+        if tok is not None:
+            return jnp.asarray([tok.encode(t) for t in texts], jnp.int32)
+        import hashlib
+
+        def fallback(text: str) -> list[int]:
+            ids = []
+            for w in text.lower().split():
+                h = hashlib.blake2s(w.encode(), digest_size=4).digest()
+                ids.append(int.from_bytes(h, "little")
+                           % (cfg.vocab_size - 2) + 1)
+            ids = ids[: cfg.max_len - 2]
+            out = [0] + ids + [cfg.eot_token_id]
+            return out + [pad_id] * (cfg.max_len - len(out))
+        return jnp.asarray([fallback(t) for t in texts], jnp.int32)
+
+    def encode(self, texts) -> tuple[jax.Array, jax.Array]:
+        texts = [str(t) for t in texts]
+        if self.kind == "sdxl":
+            l_cfg = self.stack.clip_l.config
+            g_cfg = self.stack.clip_g.config
+            toks_l = self._ids(texts, self.tok_l, l_cfg, l_cfg.eot_token_id)
+            toks_g = self._ids(texts, self.tok_g, g_cfg, 0)
+            return self.stack.encode_tokens(toks_l, toks_g)
+        cfg = self.stack.config
+        toks = self._ids(texts, self.tok_l, cfg, cfg.eot_token_id)
+        out = self.stack(toks)
+        # SD1.5 convention: final hidden states + EOT pooled
+        return out["last_hidden"], out["pooled"]

@@ -1,0 +1,492 @@
+"""safetensors → flax weight converters (SD-family checkpoints).
+
+The reference never converts weights — it ships model *names* to workers
+and lets ComfyUI load the checkpoints (``nodes/utilities.py:164-224``,
+SURVEY "external substrate"). A standalone framework must own this step:
+these converters map the published single-file checkpoint layouts onto
+this repo's flax module trees.
+
+Supported source layouts (key prefixes of the standard single-file
+``.safetensors``):
+
+- UNet: ``model.diffusion_model.*`` (LDM/SGM ``UNetModel`` numbering)
+- VAE: ``first_stage_model.*`` (LDM ``AutoencoderKL``)
+- CLIP-L: ``conditioner.embedders.0.transformer.text_model.*`` (SDXL) or
+  ``cond_stage_model.transformer.text_model.*`` (SD1.5) — HF layout
+- CLIP-G: ``conditioner.embedders.1.model.*`` (SDXL) — OpenCLIP layout
+  with fused ``in_proj_weight`` attention weights
+
+Every converter is **template-driven**: it fills a pytree shaped exactly
+like ``module.init(...)``'s params, asserting per-tensor shape equality
+and that every source key under the prefix is consumed — a silent partial
+load is impossible.
+
+Conventions: torch ``Linear.weight`` is ``[out, in]`` → transposed to
+flax ``kernel [in, out]``; conv ``OIHW`` → ``HWIO``; 1×1 convs squeeze to
+Dense kernels where the flax module uses Dense.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Callable, Mapping
+
+import numpy as np
+
+from ..utils.logging import log
+
+
+class ConversionError(ValueError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# plumbing
+# ---------------------------------------------------------------------------
+
+def _lin(w):   # torch Linear weight -> flax Dense kernel
+    return np.asarray(w, np.float32).T
+
+
+def _conv(w):  # torch Conv2d OIHW -> flax HWIO
+    return np.asarray(w, np.float32).transpose(2, 3, 1, 0)
+
+
+def _conv1x1_to_dense(w):  # [O,I,1,1] -> [I,O]
+    return np.asarray(w, np.float32)[:, :, 0, 0].T
+
+
+def _id(w):
+    return np.asarray(w, np.float32)
+
+
+class _Filler:
+    """Writes converted tensors into a template-shaped tree with shape
+    checks; tracks which source keys and which template leaves were hit."""
+
+    def __init__(self, sd: Mapping[str, np.ndarray], template):
+        self.sd = sd
+        self.tree = _map_leaves(template, lambda x: None)
+        self.template = template
+        self.used: set[str] = set()
+
+    def put(self, src_key: str, dst_path: str,
+            transform: Callable = _id) -> None:
+        if src_key not in self.sd:
+            raise ConversionError(f"missing source key {src_key!r}")
+        value = transform(self.sd[src_key])
+        tmpl = _get_path(self.template, dst_path)
+        if tmpl is None:
+            raise ConversionError(f"no template leaf at {dst_path!r}")
+        if tuple(tmpl.shape) != tuple(value.shape):
+            raise ConversionError(
+                f"{src_key} -> {dst_path}: shape {value.shape} != "
+                f"template {tuple(tmpl.shape)}")
+        _set_path(self.tree, dst_path, value.astype(np.float32))
+        self.used.add(src_key)
+
+    def put_raw(self, value: np.ndarray, dst_path: str) -> None:
+        tmpl = _get_path(self.template, dst_path)
+        if tmpl is None:
+            raise ConversionError(f"no template leaf at {dst_path!r}")
+        if tuple(tmpl.shape) != tuple(value.shape):
+            raise ConversionError(
+                f"-> {dst_path}: shape {value.shape} != "
+                f"template {tuple(tmpl.shape)}")
+        _set_path(self.tree, dst_path, np.asarray(value, np.float32))
+
+    def linear(self, src: str, dst: str, bias: bool = True) -> None:
+        self.put(f"{src}.weight", f"{dst}/kernel", _lin)
+        if bias:
+            self.put(f"{src}.bias", f"{dst}/bias")
+
+    def conv(self, src: str, dst: str) -> None:
+        self.put(f"{src}.weight", f"{dst}/kernel", _conv)
+        self.put(f"{src}.bias", f"{dst}/bias")
+
+    def norm(self, src: str, dst: str) -> None:
+        self.put(f"{src}.weight", f"{dst}/scale")
+        self.put(f"{src}.bias", f"{dst}/bias")
+
+    def finish(self, *, expect_prefix: str = "") -> dict:
+        missing = [p for p, v in _walk(self.tree) if v is None]
+        if missing:
+            raise ConversionError(
+                f"unfilled template leaves: {missing[:8]}"
+                f"{'…' if len(missing) > 8 else ''}")
+        if expect_prefix:
+            leftover = [k for k in self.sd
+                        if k.startswith(expect_prefix) and k not in self.used]
+            if leftover:
+                raise ConversionError(
+                    f"unconsumed source keys under {expect_prefix!r}: "
+                    f"{leftover[:8]}{'…' if len(leftover) > 8 else ''}")
+        return self.tree
+
+
+def _map_leaves(tree, fn):
+    if isinstance(tree, dict):
+        return {k: _map_leaves(v, fn) for k, v in tree.items()}
+    return fn(tree)
+
+
+def _walk(tree, prefix=""):
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            yield from _walk(v, f"{prefix}/{k}" if prefix else k)
+    else:
+        yield prefix, tree
+
+
+def _get_path(tree, path: str):
+    node = tree
+    for part in path.split("/"):
+        if not isinstance(node, dict) or part not in node:
+            return None
+        node = node[part]
+    return node
+
+
+def _set_path(tree, path: str, value) -> None:
+    parts = path.split("/")
+    node = tree
+    for part in parts[:-1]:
+        node = node[part]
+    node[parts[-1]] = value
+
+
+def load_safetensors(path: Path) -> dict[str, np.ndarray]:
+    from safetensors.numpy import load_file
+
+    try:
+        return load_file(str(path))
+    except Exception:
+        # f16/bf16 payloads: torch loader handles every dtype
+        from safetensors.torch import load_file as load_torch
+
+        return {k: v.float().numpy() for k, v in load_torch(str(path)).items()}
+
+
+# ---------------------------------------------------------------------------
+# CLIP (HF layout — SD1.5's encoder and SDXL's embedders.0)
+# ---------------------------------------------------------------------------
+
+def convert_clip_hf(sd: Mapping[str, np.ndarray], template, config,
+                    prefix: str = "text_model.") -> dict:
+    """HF ``CLIPTextModel`` state dict → ``models.clip.CLIPTextTransformer``
+    params. ``text_projection.weight`` (when the template wants one) lives
+    *outside* ``text_model.`` in HF checkpoints."""
+    f = _Filler(sd, template["params"])
+    p = prefix
+    f.put(f"{p}embeddings.token_embedding.weight", "tok_emb/embedding")
+    f.put(f"{p}embeddings.position_embedding.weight", "pos_emb")
+    for i in range(config.layers):
+        src = f"{p}encoder.layers.{i}"
+        dst = f"layer_{i}"
+        f.norm(f"{src}.layer_norm1", f"{dst}/ln1")
+        f.norm(f"{src}.layer_norm2", f"{dst}/ln2")
+        for proj in ("q_proj", "k_proj", "v_proj", "out_proj"):
+            f.linear(f"{src}.self_attn.{proj}", f"{dst}/attn/{proj}")
+        f.linear(f"{src}.mlp.fc1", f"{dst}/fc1")
+        f.linear(f"{src}.mlp.fc2", f"{dst}/fc2")
+    f.norm(f"{p}final_layer_norm", "final_ln")
+    if config.projection_dim:
+        f.linear("text_projection", "text_projection", bias=False)
+    # position_ids buffers appear in older HF dumps — ignore them
+    f.used.update(k for k in sd if k.endswith("position_ids"))
+    return {"params": f.finish(expect_prefix=p)}
+
+
+# ---------------------------------------------------------------------------
+# CLIP (OpenCLIP layout — SDXL's embedders.1, fused qkv)
+# ---------------------------------------------------------------------------
+
+def convert_clip_openclip(sd: Mapping[str, np.ndarray], template, config,
+                          prefix: str = "model.") -> dict:
+    f = _Filler(sd, template["params"])
+    p = prefix
+    f.put(f"{p}token_embedding.weight", "tok_emb/embedding")
+    f.put(f"{p}positional_embedding", "pos_emb")
+    width = config.width
+    for i in range(config.layers):
+        src = f"{p}transformer.resblocks.{i}"
+        dst = f"layer_{i}"
+        f.norm(f"{src}.ln_1", f"{dst}/ln1")
+        f.norm(f"{src}.ln_2", f"{dst}/ln2")
+        in_w = np.asarray(sd[f"{src}.attn.in_proj_weight"], np.float32)
+        in_b = np.asarray(sd[f"{src}.attn.in_proj_bias"], np.float32)
+        if in_w.shape != (3 * width, width):
+            raise ConversionError(
+                f"{src}.attn.in_proj_weight: shape {in_w.shape} != "
+                f"{(3 * width, width)}")
+        for j, proj in enumerate(("q_proj", "k_proj", "v_proj")):
+            f.put_raw(in_w[j * width:(j + 1) * width].T,
+                      f"{dst}/attn/{proj}/kernel")
+            f.put_raw(in_b[j * width:(j + 1) * width],
+                      f"{dst}/attn/{proj}/bias")
+        f.used.update({f"{src}.attn.in_proj_weight",
+                       f"{src}.attn.in_proj_bias"})
+        f.linear(f"{src}.attn.out_proj", f"{dst}/attn/out_proj")
+        f.linear(f"{src}.mlp.c_fc", f"{dst}/fc1")
+        f.linear(f"{src}.mlp.c_proj", f"{dst}/fc2")
+    f.norm(f"{p}ln_final", "final_ln")
+    # openclip applies `pooled @ text_projection` directly → already [in,out]
+    f.put(f"{p}text_projection", "text_projection/kernel")
+    f.used.update(k for k in sd
+                  if k.startswith(p) and k.endswith(("attn_mask", "logit_scale")))
+    return {"params": f.finish(expect_prefix=p)}
+
+
+# ---------------------------------------------------------------------------
+# UNet (LDM/SGM UNetModel numbering)
+# ---------------------------------------------------------------------------
+
+def _res_block(f: _Filler, src: str, dst: str, has_skip: bool) -> None:
+    """LDM ResBlock: in_layers=[GN,SiLU,conv], emb_layers=[SiLU,Linear],
+    out_layers=[GN,SiLU,dropout,conv], optional 1×1 skip_connection."""
+    f.norm(f"{src}.in_layers.0", f"{dst}/GroupNorm32_0/GroupNorm_0")
+    f.conv(f"{src}.in_layers.2", f"{dst}/conv1")
+    f.linear(f"{src}.emb_layers.1", f"{dst}/time_proj")
+    f.norm(f"{src}.out_layers.0", f"{dst}/GroupNorm32_1/GroupNorm_0")
+    f.conv(f"{src}.out_layers.3", f"{dst}/conv2")
+    if has_skip:
+        f.put(f"{src}.skip_connection.weight", f"{dst}/skip/kernel", _conv)
+        f.put(f"{src}.skip_connection.bias", f"{dst}/skip/bias")
+
+
+def _spatial_transformer(f: _Filler, src: str, dst: str, depth: int,
+                         linear_proj: bool) -> None:
+    f.norm(f"{src}.norm", f"{dst}/GroupNorm32_0/GroupNorm_0")
+    proj_tx = _lin if linear_proj else _conv1x1_to_dense
+    f.put(f"{src}.proj_in.weight", f"{dst}/proj_in/kernel", proj_tx)
+    f.put(f"{src}.proj_in.bias", f"{dst}/proj_in/bias")
+    for d in range(depth):
+        b_src = f"{src}.transformer_blocks.{d}"
+        b_dst = f"{dst}/block_{d}"
+        f.norm(f"{b_src}.norm1", f"{b_dst}/LayerNorm_0")
+        f.norm(f"{b_src}.norm2", f"{b_dst}/LayerNorm_1")
+        f.norm(f"{b_src}.norm3", f"{b_dst}/LayerNorm_2")
+        for attn in ("attn1", "attn2"):
+            for proj in ("to_q", "to_k", "to_v"):
+                f.put(f"{b_src}.{attn}.{proj}.weight",
+                      f"{b_dst}/{attn}/{proj}/kernel", _lin)
+            f.linear(f"{b_src}.{attn}.to_out.0", f"{b_dst}/{attn}/to_out")
+        f.linear(f"{b_src}.ff.net.0.proj", f"{b_dst}/ff/proj_in")
+        f.linear(f"{b_src}.ff.net.2", f"{b_dst}/ff/proj_out")
+    f.put(f"{src}.proj_out.weight", f"{dst}/proj_out/kernel", proj_tx)
+    f.put(f"{src}.proj_out.bias", f"{dst}/proj_out/bias")
+
+
+def convert_unet(sd: Mapping[str, np.ndarray], template, config,
+                 prefix: str = "model.diffusion_model.") -> dict:
+    """LDM ``UNetModel`` → ``models.unet.UNet2D`` params.
+
+    Walks the same block-numbering scheme the LDM constructor uses so the
+    index math is config-derived, not hard-coded per model.
+    """
+    cfg = config
+    f = _Filler(sd, template["params"])
+    p = prefix
+    # SDXL uses linear proj_in/out in transformers; SD1.5 uses 1×1 convs.
+    # Detect from the checkpoint itself.
+    linear_proj = True
+    for k in sd:
+        if k.startswith(p) and k.endswith("proj_in.weight"):
+            linear_proj = len(sd[k].shape) == 2
+            break
+
+    f.linear(f"{p}time_embed.0", "time_1")
+    f.linear(f"{p}time_embed.2", "time_2")
+    if cfg.adm_in_channels:
+        f.linear(f"{p}label_emb.0.0", "label_1")
+        f.linear(f"{p}label_emb.0.2", "label_2")
+
+    f.conv(f"{p}input_blocks.0.0", "conv_in")
+    idx = 1
+    prev_ch = cfg.model_channels
+    for level, mult in enumerate(cfg.channel_mult):
+        ch = cfg.model_channels * mult
+        for i in range(cfg.num_res_blocks):
+            src = f"{p}input_blocks.{idx}"
+            _res_block(f, f"{src}.0", f"down_{level}_res_{i}",
+                       has_skip=prev_ch != ch)
+            if cfg.transformer_depth[level]:
+                _spatial_transformer(f, f"{src}.1", f"down_{level}_attn_{i}",
+                                     cfg.transformer_depth[level], linear_proj)
+            prev_ch = ch
+            idx += 1
+        if level < len(cfg.channel_mult) - 1:
+            # Downsample/Upsample wrap an unnamed nn.Conv → auto "Conv_0"
+            f.conv(f"{p}input_blocks.{idx}.0.op", f"down_{level}_ds/Conv_0")
+            idx += 1
+
+    _res_block(f, f"{p}middle_block.0", "mid_res_1", has_skip=False)
+    if cfg.transformer_depth[-1]:
+        _spatial_transformer(f, f"{p}middle_block.1", "mid_attn",
+                             cfg.transformer_depth[-1], linear_proj)
+        _res_block(f, f"{p}middle_block.2", "mid_res_2", has_skip=False)
+    else:
+        _res_block(f, f"{p}middle_block.1", "mid_res_2", has_skip=False)
+
+    # up path: skip-concat changes input channels, so every ResBlock has a
+    # skip 1×1. Mirror UNet2D's skip-pop order to know nothing more is
+    # needed than has_skip=True throughout.
+    idx = 0
+    for level in reversed(range(len(cfg.channel_mult))):
+        for i in range(cfg.num_res_blocks + 1):
+            src = f"{p}output_blocks.{idx}"
+            _res_block(f, f"{src}.0", f"up_{level}_res_{i}", has_skip=True)
+            sub = 1
+            if cfg.transformer_depth[level]:
+                _spatial_transformer(f, f"{src}.{sub}", f"up_{level}_attn_{i}",
+                                     cfg.transformer_depth[level], linear_proj)
+                sub += 1
+            if level > 0 and i == cfg.num_res_blocks:
+                f.conv(f"{p}output_blocks.{idx}.{sub}.conv",
+                       f"up_{level}_us/Conv_0")
+            idx += 1
+
+    f.norm(f"{p}out.0", "norm_out/GroupNorm_0")
+    f.conv(f"{p}out.2", "conv_out")
+    return {"params": f.finish(expect_prefix=p)}
+
+
+# ---------------------------------------------------------------------------
+# VAE (LDM AutoencoderKL)
+# ---------------------------------------------------------------------------
+
+def _vae_res(f: _Filler, src: str, dst: str, has_skip: bool) -> None:
+    f.norm(f"{src}.norm1", f"{dst}/GroupNorm32_0/GroupNorm_0")
+    f.conv(f"{src}.conv1", f"{dst}/conv1")
+    f.norm(f"{src}.norm2", f"{dst}/GroupNorm32_1/GroupNorm_0")
+    f.conv(f"{src}.conv2", f"{dst}/conv2")
+    if has_skip:
+        f.put(f"{src}.nin_shortcut.weight", f"{dst}/skip/kernel", _conv)
+        f.put(f"{src}.nin_shortcut.bias", f"{dst}/skip/bias")
+
+
+def _vae_mid(f: _Filler, src: str, dst: str) -> None:
+    _vae_res(f, f"{src}.block_1", f"{dst}/res1", has_skip=False)
+    f.norm(f"{src}.attn_1.norm", f"{dst}/GroupNorm32_0/GroupNorm_0")
+    for t_proj, o_proj in (("q", "to_q"), ("k", "to_k"), ("v", "to_v"),
+                           ("proj_out", "to_out")):
+        f.put(f"{src}.attn_1.{t_proj}.weight",
+              f"{dst}/attn/{o_proj}/kernel", _conv1x1_to_dense)
+        f.put(f"{src}.attn_1.{t_proj}.bias", f"{dst}/attn/{o_proj}/bias")
+    _vae_res(f, f"{src}.block_2", f"{dst}/res2", has_skip=False)
+
+
+def convert_vae(sd: Mapping[str, np.ndarray], enc_template, dec_template,
+                config, prefix: str = "first_stage_model.") -> tuple[dict, dict]:
+    cfg = config
+    p = prefix
+
+    fe = _Filler(sd, enc_template["params"])
+    fe.conv(f"{p}encoder.conv_in", "conv_in")
+    prev_ch = cfg.base_channels
+    for level, mult in enumerate(cfg.channel_mult):
+        ch = cfg.base_channels * mult
+        for i in range(cfg.num_res_blocks):
+            _vae_res(fe, f"{p}encoder.down.{level}.block.{i}",
+                     f"down_{level}_res_{i}", has_skip=prev_ch != ch)
+            prev_ch = ch
+        if level < len(cfg.channel_mult) - 1:
+            fe.conv(f"{p}encoder.down.{level}.downsample.conv",
+                    f"down_{level}_ds")
+    _vae_mid(fe, f"{p}encoder.mid", "mid")
+    fe.norm(f"{p}encoder.norm_out", "norm_out/GroupNorm_0")
+    fe.conv(f"{p}encoder.conv_out", "conv_out")
+    fe.conv(f"{p}quant_conv", "quant_conv")
+    enc = {"params": fe.finish()}
+
+    fd = _Filler(sd, dec_template["params"])
+    fd.conv(f"{p}post_quant_conv", "post_quant_conv")
+    fd.conv(f"{p}decoder.conv_in", "conv_in")
+    _vae_mid(fd, f"{p}decoder.mid", "mid")
+    top_ch = cfg.base_channels * cfg.channel_mult[-1]
+    prev_ch = top_ch
+    for level in reversed(range(len(cfg.channel_mult))):
+        ch = cfg.base_channels * cfg.channel_mult[level]
+        for i in range(cfg.num_res_blocks + 1):
+            _vae_res(fd, f"{p}decoder.up.{level}.block.{i}",
+                     f"up_{level}_res_{i}", has_skip=prev_ch != ch)
+            prev_ch = ch
+        if level > 0:
+            fd.conv(f"{p}decoder.up.{level}.upsample.conv", f"up_{level}_us")
+    fd.norm(f"{p}decoder.norm_out", "norm_out/GroupNorm_0")
+    fd.conv(f"{p}decoder.conv_out", "conv_out")
+    dec = {"params": fd.finish()}
+
+    leftover = [k for k in sd if k.startswith(p)
+                and k not in fe.used and k not in fd.used
+                and "loss" not in k and "model_ema" not in k]
+    if leftover:
+        raise ConversionError(
+            f"unconsumed VAE keys: {leftover[:8]}"
+            f"{'…' if len(leftover) > 8 else ''}")
+    return enc, dec
+
+
+# ---------------------------------------------------------------------------
+# single-file checkpoint assembly
+# ---------------------------------------------------------------------------
+
+SDXL_CLIP_L_PREFIX = "conditioner.embedders.0.transformer.text_model."
+SDXL_CLIP_G_PREFIX = "conditioner.embedders.1.model."
+SD15_CLIP_PREFIX = "cond_stage_model.transformer.text_model."
+
+
+def detect_layout(sd: Mapping[str, np.ndarray]) -> str:
+    if any(k.startswith(SDXL_CLIP_G_PREFIX) for k in sd):
+        return "sdxl"
+    if any(k.startswith(SD15_CLIP_PREFIX) for k in sd):
+        return "sd15"
+    if any(k.startswith("model.diffusion_model.") for k in sd):
+        return "unet-only"
+    raise ConversionError("unrecognized checkpoint layout")
+
+
+def convert_checkpoint(path: Path, bundle) -> None:
+    """Load a single-file checkpoint into a ``ModelBundle`` in place.
+
+    ``bundle`` must be built from the matching preset (``sdxl``/``sd15``);
+    template trees come from its random-init params, so every converted
+    tensor is shape-checked against the live architecture.
+    """
+    sd = load_safetensors(Path(path))
+    layout = detect_layout(sd)
+    log(f"converting {path} (layout: {layout})")
+
+    unet_tmpl = bundle.pipeline.unet_params
+    bundle.pipeline.unet_params = convert_unet(
+        sd, unet_tmpl, bundle.preset.unet)
+
+    if layout == "unet-only":
+        log("unet-only checkpoint: VAE and CLIP keep their current weights")
+        return
+
+    enc, dec = convert_vae(sd, bundle.pipeline.vae.enc_params,
+                           bundle.pipeline.vae.dec_params, bundle.preset.vae)
+    bundle.pipeline.vae.enc_params = enc
+    bundle.pipeline.vae.dec_params = dec
+
+    if layout == "sdxl":
+        stack = bundle.clip_stack
+        stack.clip_l.params = convert_clip_hf(
+            {k[len("conditioner.embedders.0.transformer."):]: v
+             for k, v in sd.items()
+             if k.startswith("conditioner.embedders.0.transformer.")},
+            stack.clip_l.params, stack.clip_l.config)
+        stack.clip_g.params = convert_clip_openclip(
+            {k[len("conditioner.embedders.1."):]: v for k, v in sd.items()
+             if k.startswith("conditioner.embedders.1.")},
+            stack.clip_g.params, stack.clip_g.config)
+    elif layout == "sd15":
+        # sd15 presets carry a single CLIPTextModel (no dual stack)
+        clip = bundle.clip_stack
+        clip.params = convert_clip_hf(
+            {k[len("cond_stage_model.transformer."):]: v
+             for k, v in sd.items()
+             if k.startswith("cond_stage_model.transformer.")},
+            clip.params, clip.config)
+    log(f"converted {path} into {bundle.preset.name} bundle")

@@ -120,7 +120,8 @@ class GEGLU(nn.Module):
         dim = x.shape[-1]
         h = nn.Dense(dim * self.mult * 2, dtype=self.dtype, name="proj_in")(x)
         h, gate = jnp.split(h, 2, axis=-1)
-        h = h * nn.gelu(gate)
+        # LDM's GEGLU uses exact (erf) gelu; flax defaults to tanh approx
+        h = h * nn.gelu(gate, approximate=False)
         return nn.Dense(dim, dtype=self.dtype, name="proj_out")(h)
 
 

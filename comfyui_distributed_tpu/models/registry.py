@@ -32,6 +32,7 @@ class ModelPreset:
     sample_hw: tuple[int, int] = (128, 128)   # init-time latent H,W
     dit: "object | None" = None               # DiTConfig for flow models
     video: "object | None" = None             # VideoDiTConfig for t2v models
+    clip: "str | None" = None                 # "sdxl" | "clip-l" real-CLIP stack
 
     @property
     def kind(self) -> str:
@@ -82,10 +83,11 @@ def _wan_tiny_preset():
 
 PRESETS: dict[str, ModelPreset] = {
     "sdxl": ModelPreset("sdxl", UNetConfig.sdxl(), VAEConfig.sdxl(),
-                        TextEncoderConfig()),
+                        TextEncoderConfig(), clip="sdxl"),
     "sd15": ModelPreset("sd15", UNetConfig.sd15(),
                         VAEConfig(scaling_factor=0.18215),
-                        TextEncoderConfig(output_dim=768, pooled_dim=768)),
+                        TextEncoderConfig(output_dim=768, pooled_dim=768),
+                        clip="clip-l"),
     "tiny": ModelPreset("tiny", UNetConfig.tiny(), VAEConfig.tiny(),
                         TextEncoderConfig.tiny(), sample_hw=(8, 8)),
     "flux": _flux_preset(),
@@ -101,6 +103,7 @@ class ModelBundle:
     def __init__(self, preset: ModelPreset, checkpoint_dir: Optional[Path] = None,
                  seed: int = 0):
         self.preset = preset
+        self.clip_stack = None      # built lazily (real-weight path only)
         k1, k2, k3 = jax.random.split(jax.random.key(seed), 3)
         img_hw = (preset.sample_hw[0] * preset.vae.downscale,
                   preset.sample_hw[1] * preset.vae.downscale)
@@ -132,8 +135,14 @@ class ModelBundle:
                 context_len=preset.text.max_len,
             )
             self.pipeline = Txt2ImgPipeline(model, params, vae)
-        if checkpoint_dir is not None and Path(checkpoint_dir).exists():
-            self._load_checkpoint(Path(checkpoint_dir))
+        if checkpoint_dir is not None:
+            p = Path(checkpoint_dir)
+            if p.is_dir():
+                self._load_checkpoint(p)
+            elif p.with_suffix(".safetensors").is_file():
+                # drop `<name>.safetensors` next to the orbax dirs and the
+                # published checkpoint converts on first load
+                self.load_safetensors_checkpoint(p.with_suffix(".safetensors"))
 
     @property
     def kind(self) -> str:
@@ -150,37 +159,114 @@ class ModelBundle:
         else:
             self.pipeline.unet_params = params
 
-    def _load_checkpoint(self, ckpt: Path) -> None:
-        import orbax.checkpoint as ocp
+    def build_clip_stack(self, tiny: bool = False):
+        """Instantiate the weight-faithful CLIP stack for this preset and
+        swap the bundle's text encoder to it (``models/clip.py``)."""
+        from .clip import (CLIPConditioner, CLIPTextConfig, CLIPTextModel,
+                           SDXLTextStack)
 
-        targets = {
-            "core": self._core_params(),
-            "vae_enc": self.pipeline.vae.enc_params,
-            "vae_dec": self.pipeline.vae.dec_params,
-            "text": self.text_encoder.params,
-        }
-        with ocp.StandardCheckpointer() as ckptr:
-            restored = ckptr.restore(ckpt.resolve(), targets)
-        self._set_core_params(restored["core"])
-        self.pipeline.vae.enc_params = restored["vae_enc"]
-        self.pipeline.vae.dec_params = restored["vae_dec"]
-        self.text_encoder.params = restored["text"]
-        log(f"loaded checkpoint {ckpt}")
+        if self.clip_stack is not None:
+            return self.clip_stack
+        kind = self.preset.clip
+        if kind is None:
+            raise ValidationError(
+                f"preset {self.preset.name!r} has no real-CLIP stack")
+        key = jax.random.key(0)
+        if kind == "sdxl":
+            self.clip_stack = SDXLTextStack.init_random(key, tiny=tiny)
+        else:
+            cfg = CLIPTextConfig.tiny() if tiny else CLIPTextConfig.clip_l()
+            self.clip_stack = CLIPTextModel(cfg).init(key)
+        self.text_encoder = CLIPConditioner(self.clip_stack, kind=kind)
+        return self.clip_stack
 
-    def save_checkpoint(self, ckpt: Path) -> None:
-        """Persist the stack with orbax (enables real-weight workflows:
-        convert → save once → every controller restores)."""
-        import orbax.checkpoint as ocp
-
+    def _state_entries(self) -> dict:
         state = {
             "core": self._core_params(),
             "vae_enc": self.pipeline.vae.enc_params,
             "vae_dec": self.pipeline.vae.dec_params,
-            "text": self.text_encoder.params,
         }
+        if self.clip_stack is not None:
+            if self.preset.clip == "sdxl":
+                state["clip_l"] = self.clip_stack.clip_l.params
+                state["clip_g"] = self.clip_stack.clip_g.params
+            else:
+                state["clip_l"] = self.clip_stack.params
+        else:
+            state["text"] = self.text_encoder.params
+        return state
+
+    def _apply_entries(self, restored: dict) -> None:
+        self._set_core_params(restored["core"])
+        self.pipeline.vae.enc_params = restored["vae_enc"]
+        self.pipeline.vae.dec_params = restored["vae_dec"]
+        if "clip_l" in restored:
+            if self.preset.clip == "sdxl":
+                self.clip_stack.clip_l.params = restored["clip_l"]
+                self.clip_stack.clip_g.params = restored["clip_g"]
+            else:
+                self.clip_stack.params = restored["clip_l"]
+        if "text" in restored:
+            self.text_encoder.params = restored["text"]
+
+    def _load_checkpoint(self, ckpt: Path) -> None:
+        import json
+
+        import orbax.checkpoint as ocp
+
+        ckpt = Path(ckpt)
+        state_dir = ckpt / "state"
+        if not state_dir.exists():
+            raise ValidationError(
+                f"{ckpt} is not a converted checkpoint (no state/ dir); "
+                "re-run `python -m comfyui_distributed_tpu convert`")
+        manifest = {}
+        mf = ckpt / "cdt_manifest.json"
+        if mf.is_file():
+            manifest = json.loads(mf.read_text())
+        if "clip_l" in manifest.get("entries", []):
+            self.build_clip_stack(tiny=bool(manifest.get("tiny_clip")))
+        targets = self._state_entries()
+        if manifest.get("entries"):
+            targets = {k: v for k, v in targets.items()
+                       if k in manifest["entries"]}
         with ocp.StandardCheckpointer() as ckptr:
-            ckptr.save(Path(ckpt).resolve(), state)
+            restored = ckptr.restore(state_dir.resolve(), targets)
+        self._apply_entries(restored)
+        log(f"loaded checkpoint {ckpt}")
+
+    def save_checkpoint(self, ckpt: Path) -> None:
+        """Persist the stack with orbax (enables real-weight workflows:
+        convert → save once → every controller restores). A small manifest
+        records which entries exist so restore can rebuild the right
+        text-encoder stack."""
+        import json
+
+        import orbax.checkpoint as ocp
+
+        ckpt = Path(ckpt)
+        state = self._state_entries()
+        with ocp.StandardCheckpointer() as ckptr:
+            ckptr.save((ckpt / "state").resolve(), state)
+        tiny_clip = False
+        if self.clip_stack is not None:
+            cl = (self.clip_stack.clip_l if self.preset.clip == "sdxl"
+                  else self.clip_stack)
+            tiny_clip = cl.config.width < 256
+        ckpt.mkdir(parents=True, exist_ok=True)
+        (ckpt / "cdt_manifest.json").write_text(json.dumps(
+            {"preset": self.preset.name, "entries": sorted(state),
+             "tiny_clip": tiny_clip}))
         log(f"saved checkpoint {ckpt}")
+
+    def load_safetensors_checkpoint(self, path: Path) -> None:
+        """Convert a published single-file ``.safetensors`` checkpoint
+        (SDXL/SD1.5 layout) into this bundle in place."""
+        from .convert import convert_checkpoint
+
+        if self.preset.clip is not None:
+            self.build_clip_stack()
+        convert_checkpoint(path, self)
 
 
 class ModelRegistry:

@@ -1,0 +1,157 @@
+"""CLIP byte-pair-encoding tokenizer (exact algorithm, file-loaded vocab).
+
+The reference gets tokenization for free from ComfyUI's CLIP stack; a
+standalone framework owns it. This is a faithful implementation of the
+OpenAI CLIP tokenizer (the one SD/SDXL checkpoints were trained with):
+
+- byte→unicode table, lowercased input, whitespace collapse,
+- the CLIP word-splitting regex (letters / numbers / punctuation runs,
+  contraction suffixes),
+- greedy lowest-rank BPE merges with the ``</w>`` end-of-word marker,
+- ``<|startoftext|>`` / ``<|endoftext|>`` specials, truncate-then-pad to
+  ``max_len``.
+
+Vocab files are the standard ``vocab.json`` + ``merges.txt`` pair every
+SD checkpoint distribution carries (this environment is zero-egress so no
+vocab is vendored here; point ``CDT_TOKENIZER_DIR`` at one). Differential
+tests validate the algorithm against ``transformers.CLIPTokenizer`` on
+synthetic vocabularies (``tests/test_tokenizer.py``).
+
+Padding: CLIP-L convention pads with EOT (SD1.5/SDXL first encoder);
+CLIP-G pads with 0 — pass ``pad_token_id`` accordingly.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import os
+from pathlib import Path
+from typing import Optional, Sequence
+
+try:
+    import regex as _re
+except ImportError:  # pragma: no cover - regex ships with transformers
+    import re as _re
+
+_PATTERN = _re.compile(
+    r"""<\|startoftext\|>|<\|endoftext\|>|'s|'t|'re|'ve|'m|'ll|'d|[\p{L}]+|[\p{N}]|[^\s\p{L}\p{N}]+""",
+    _re.IGNORECASE,
+)
+
+SOT = "<|startoftext|>"
+EOT = "<|endoftext|>"
+
+
+@functools.lru_cache(maxsize=1)
+def bytes_to_unicode() -> dict[int, str]:
+    """The GPT-2/CLIP reversible byte→printable-unicode table."""
+    bs = (list(range(ord("!"), ord("~") + 1))
+          + list(range(ord("¡"), ord("¬") + 1))
+          + list(range(ord("®"), ord("ÿ") + 1)))
+    cs = bs[:]
+    n = 0
+    for b in range(256):
+        if b not in bs:
+            bs.append(b)
+            cs.append(256 + n)
+            n += 1
+    return dict(zip(bs, map(chr, cs)))
+
+
+def _get_pairs(word: tuple[str, ...]) -> set[tuple[str, str]]:
+    return set(zip(word[:-1], word[1:]))
+
+
+class CLIPBPETokenizer:
+    def __init__(self, vocab: dict[str, int],
+                 merges: Sequence[tuple[str, str]], max_len: int = 77,
+                 pad_token_id: Optional[int] = None):
+        self.vocab = dict(vocab)
+        self.ranks = {tuple(m): i for i, m in enumerate(merges)}
+        self.max_len = max_len
+        self.byte_encoder = bytes_to_unicode()
+        self.sot_id = self.vocab[SOT]
+        self.eot_id = self.vocab[EOT]
+        self.pad_token_id = self.eot_id if pad_token_id is None else pad_token_id
+        self._cache: dict[str, list[str]] = {}
+
+    # -- construction -------------------------------------------------------
+
+    @classmethod
+    def from_dir(cls, path: Path, **kw) -> "CLIPBPETokenizer":
+        """Load the standard HF-format ``vocab.json`` + ``merges.txt``."""
+        path = Path(path)
+        vocab = json.loads((path / "vocab.json").read_text(encoding="utf-8"))
+        merges = []
+        for line in (path / "merges.txt").read_text(encoding="utf-8").splitlines():
+            if line.startswith("#version") or not line.strip():
+                continue
+            a, b = line.split()
+            merges.append((a, b))
+        return cls(vocab, merges, **kw)
+
+    @classmethod
+    def from_env(cls, subdir: str = "", **kw) -> Optional["CLIPBPETokenizer"]:
+        root = os.environ.get("CDT_TOKENIZER_DIR")
+        if not root:
+            return None
+        path = Path(root) / subdir if subdir else Path(root)
+        if not (path / "vocab.json").is_file():
+            return None
+        return cls.from_dir(path, **kw)
+
+    # -- BPE ----------------------------------------------------------------
+
+    def _bpe(self, token: str) -> list[str]:
+        if token in self._cache:
+            return self._cache[token]
+        word = tuple(token[:-1]) + (token[-1] + "</w>",)
+        if len(word) == 1:
+            self._cache[token] = list(word)
+            return list(word)
+        while len(word) > 1:
+            pairs = _get_pairs(word)
+            best = min(pairs, key=lambda p: self.ranks.get(p, float("inf")))
+            if best not in self.ranks:
+                break
+            a, b = best
+            merged: list[str] = []
+            i = 0
+            while i < len(word):
+                if i < len(word) - 1 and word[i] == a and word[i + 1] == b:
+                    merged.append(a + b)
+                    i += 2
+                else:
+                    merged.append(word[i])
+                    i += 1
+            word = tuple(merged)
+        self._cache[token] = list(word)
+        return list(word)
+
+    def tokenize_text(self, text: str) -> list[int]:
+        """Text → BPE ids (no specials, no padding)."""
+        text = " ".join(text.split()).strip().lower()
+        ids: list[int] = []
+        for tok in _PATTERN.findall(text):
+            encoded = "".join(self.byte_encoder[b] for b in tok.encode("utf-8"))
+            for unit in self._bpe(encoded):
+                ids.append(self.vocab[unit])
+        return ids
+
+    def encode(self, text: str) -> list[int]:
+        """Text → fixed-length [SOT, …, EOT, pad…] id sequence."""
+        ids = self.tokenize_text(text)[: self.max_len - 2]
+        out = [self.sot_id] + ids + [self.eot_id]
+        return out + [self.pad_token_id] * (self.max_len - len(out))
+
+
+def load_sd_tokenizers(max_len: int = 77):
+    """(CLIP-L tokenizer, CLIP-G tokenizer) from ``CDT_TOKENIZER_DIR``,
+    or ``(None, None)`` when no vocab is available (hash fallback path).
+    Both towers share one vocab; they differ only in padding id."""
+    tok_l = CLIPBPETokenizer.from_env(max_len=max_len)
+    if tok_l is None:
+        return None, None
+    tok_g = CLIPBPETokenizer.from_env(max_len=max_len, pad_token_id=0)
+    return tok_l, tok_g

@@ -15,7 +15,7 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
-from .layers import Attention, GroupNorm32
+from .layers import GroupNorm32
 
 
 @dataclasses.dataclass(frozen=True)
@@ -47,21 +47,45 @@ class VAEConfig:
         return 2 ** (len(self.channel_mult) - 1)
 
 
+# LDM's AutoencoderKL normalizes with eps=1e-6 (vs the UNet's 1e-5) —
+# weight parity requires matching it
+_VAE_EPS = 1e-6
+
+
 class _VAEResBlock(nn.Module):
     out_channels: int
     dtype: jnp.dtype
 
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
-        h = GroupNorm32()(x)
+        h = GroupNorm32(epsilon=_VAE_EPS)(x)
         h = nn.silu(h)
         h = nn.Conv(self.out_channels, (3, 3), padding=1, dtype=self.dtype, name="conv1")(h)
-        h = GroupNorm32()(h)
+        h = GroupNorm32(epsilon=_VAE_EPS)(h)
         h = nn.silu(h)
         h = nn.Conv(self.out_channels, (3, 3), padding=1, dtype=self.dtype, name="conv2")(h)
         if x.shape[-1] != self.out_channels:
             x = nn.Conv(self.out_channels, (1, 1), dtype=self.dtype, name="skip")(x)
         return x + h
+
+
+class _VAEAttention(nn.Module):
+    """LDM AttnBlock: single-head attention with biased q/k/v/proj (the
+    checkpoint stores them as 1×1 convs; Dense is the same linear map)."""
+
+    dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, h: jax.Array) -> jax.Array:
+        B, N, C = h.shape
+        q = nn.Dense(C, dtype=self.dtype, name="to_q")(h)
+        k = nn.Dense(C, dtype=self.dtype, name="to_k")(h)
+        v = nn.Dense(C, dtype=self.dtype, name="to_v")(h)
+        s = jnp.einsum("bqc,bkc->bqk", q.astype(jnp.float32),
+                       k.astype(jnp.float32)) / (C ** 0.5)
+        p = jax.nn.softmax(s, axis=-1).astype(v.dtype)
+        out = jnp.einsum("bqk,bkc->bqc", p, v)
+        return nn.Dense(C, dtype=self.dtype, name="to_out")(out)
 
 
 class _MidBlock(nn.Module):
@@ -72,8 +96,8 @@ class _MidBlock(nn.Module):
     def __call__(self, x: jax.Array) -> jax.Array:
         x = _VAEResBlock(self.channels, self.dtype, name="res1")(x)
         B, H, W, C = x.shape
-        h = GroupNorm32()(x).reshape(B, H * W, C)
-        h = Attention(num_heads=1, head_dim=C, dtype=self.dtype, name="attn")(h)
+        h = GroupNorm32(epsilon=_VAE_EPS)(x).reshape(B, H * W, C)
+        h = _VAEAttention(self.dtype, name="attn")(h)
         x = x + h.reshape(B, H, W, C)
         return _VAEResBlock(self.channels, self.dtype, name="res2")(x)
 
@@ -93,14 +117,18 @@ class Encoder(nn.Module):
             for i in range(cfg.num_res_blocks):
                 h = _VAEResBlock(ch, dt, name=f"down_{level}_res_{i}")(h)
             if level < len(cfg.channel_mult) - 1:
-                h = nn.Conv(ch, (3, 3), strides=2, padding=1, dtype=dt,
-                            name=f"down_{level}_ds")(h)
+                # LDM downsamples with asymmetric (0,1) padding — weight
+                # parity requires the exact same spatial alignment
+                h = nn.Conv(ch, (3, 3), strides=2, padding=((0, 1), (0, 1)),
+                            dtype=dt, name=f"down_{level}_ds")(h)
         h = _MidBlock(h.shape[-1], dt, name="mid")(h)
-        h = GroupNorm32(name="norm_out")(h)
+        h = GroupNorm32(epsilon=_VAE_EPS, name="norm_out")(h)
         h = nn.silu(h)
         # 2×latent: mean and logvar
-        return nn.Conv(cfg.latent_channels * 2, (3, 3), padding=1, dtype=jnp.float32,
-                       name="conv_out")(h.astype(jnp.float32))
+        h = nn.Conv(cfg.latent_channels * 2, (3, 3), padding=1, dtype=jnp.float32,
+                    name="conv_out")(h.astype(jnp.float32))
+        return nn.Conv(cfg.latent_channels * 2, (1, 1), dtype=jnp.float32,
+                       name="quant_conv")(h)
 
 
 class Decoder(nn.Module):
@@ -110,6 +138,8 @@ class Decoder(nn.Module):
     def __call__(self, z: jax.Array) -> jax.Array:
         cfg = self.config
         dt = cfg.jnp_dtype
+        z = nn.Conv(cfg.latent_channels, (1, 1), dtype=jnp.float32,
+                    name="post_quant_conv")(z.astype(jnp.float32))
         ch = cfg.base_channels * cfg.channel_mult[-1]
         h = nn.Conv(ch, (3, 3), padding=1, dtype=dt, name="conv_in")(z.astype(dt))
         h = _MidBlock(ch, dt, name="mid")(h)
@@ -121,7 +151,7 @@ class Decoder(nn.Module):
                 B, H, W, C = h.shape
                 h = jax.image.resize(h, (B, H * 2, W * 2, C), method="nearest")
                 h = nn.Conv(C, (3, 3), padding=1, dtype=dt, name=f"up_{level}_us")(h)
-        h = GroupNorm32(name="norm_out")(h)
+        h = GroupNorm32(epsilon=_VAE_EPS, name="norm_out")(h)
         h = nn.silu(h)
         return nn.Conv(cfg.in_channels, (3, 3), padding=1, dtype=jnp.float32,
                        name="conv_out")(h.astype(jnp.float32))

@@ -32,18 +32,33 @@ from .utils.logging import debug_log
 _NATIVE_DIR = Path(__file__).resolve().parent.parent / "native"
 _LIB_NAME = "libcdt_native.so"
 
-# frame dtype codes (wire format)
+# frame dtype codes (wire format; the C++ codec treats the code as opaque)
 _DTYPES: dict[int, np.dtype] = {
     0: np.dtype(np.uint8),
     1: np.dtype(np.float32),
     2: np.dtype(np.float16),
     3: np.dtype(np.int32),
-    4: np.dtype(np.uint16),   # bfloat16 travels as raw uint16 bits
+    4: np.dtype(np.uint16),
+    5: np.dtype(np.int64),
+    6: np.dtype(np.float64),
+    7: np.dtype(np.bool_),
 }
+try:  # jax always ships ml_dtypes; frames then round-trip bf16 losslessly
+    import ml_dtypes
+
+    _DTYPES[8] = np.dtype(ml_dtypes.bfloat16)
+except ImportError:  # pragma: no cover
+    pass
 _DTYPE_CODES = {v: k for k, v in _DTYPES.items()}
 
 _MAGIC = b"CDTF"
 _VERSION = 1
+
+# decompression ceiling: frames claiming a larger raw size are rejected
+# before any allocation (the wire size itself is already capped per-route
+# by MAX_PAYLOAD_SIZE — this bounds the zlib expansion of what got past)
+MAX_FRAME_RAW_BYTES = int(os.environ.get("CDT_MAX_FRAME_RAW_BYTES",
+                                         str(1 << 30)))
 
 _lib: Optional[ctypes.CDLL] = None
 _lib_lock = threading.Lock()
@@ -138,17 +153,15 @@ def hash64(data: bytes) -> int:
 # ---------------------------------------------------------------------------
 
 def _np_view(arr: np.ndarray) -> tuple[np.ndarray, int]:
-    """Contiguous byte view + wire dtype code (bfloat16 → uint16 bits)."""
+    """Contiguous view + wire dtype code. Unsupported dtypes raise rather
+    than silently cast — the codec must round-trip losslessly."""
     a = np.ascontiguousarray(arr)
-    dt = a.dtype
+    dt = np.dtype(a.dtype)
     if dt not in _DTYPE_CODES:
-        if dt.itemsize == 2:            # ml_dtypes.bfloat16 etc.
-            a = a.view(np.uint16)
-            dt = a.dtype
-        else:
-            a = a.astype(np.float32)
-            dt = a.dtype
-    return a, _DTYPE_CODES[np.dtype(dt)]
+        raise ValueError(
+            f"unsupported frame dtype {dt}; supported: "
+            f"{sorted(str(d) for d in _DTYPE_CODES)}")
+    return a, _DTYPE_CODES[dt]
 
 
 def pack_frame(arr: np.ndarray, level: int = 1) -> bytes:
@@ -197,16 +210,42 @@ def unpack_frame(data: bytes) -> np.ndarray:
     stored = int.from_bytes(data[off:off + 8], "little"); off += 8
     raw_len = int.from_bytes(data[off:off + 8], "little"); off += 8
 
+    # header fields are attacker-controlled (frames arrive on unauthenticated
+    # routes): bound every size before any allocation
+    if any(d < 0 for d in shape):
+        raise ValueError("bad frame header (negative dim)")
+    expected = _DTYPES[code].itemsize
+    for d in shape:
+        expected *= d
+    if raw_len != expected:
+        raise ValueError(
+            f"frame raw size {raw_len} != shape/dtype size {expected}")
+    if raw_len > MAX_FRAME_RAW_BYTES:
+        raise ValueError(
+            f"frame raw size {raw_len} exceeds cap {MAX_FRAME_RAW_BYTES}")
+    if stored > len(data) - off:
+        raise ValueError("frame payload truncated")
+
     lib = _load()
     if lib is not None:
-        out = ctypes.create_string_buffer(raw_len)
+        out = ctypes.create_string_buffer(raw_len if raw_len > 0 else 1)
         n = lib.cdt_unpack_frame(data, len(data), out, raw_len)
         if n < 0:
             raise ValueError(f"frame unpack failed (code {n})")
         raw = out.raw[:n]
     else:
         payload = data[off:off + stored]
-        raw = zlib.decompress(payload) if flags & 1 else payload
+        if flags & 1:
+            # bounded inflate: never produce more than raw_len+1 bytes no
+            # matter what the stream claims (zlib-bomb guard for the pure-
+            # python path; the native path bounds by the output buffer)
+            try:
+                d = zlib.decompressobj()
+                raw = d.decompress(payload, raw_len + 1)
+            except zlib.error as e:
+                raise ValueError(f"frame decompress failed: {e}")
+        else:
+            raw = payload
         if len(raw) != raw_len or zlib.crc32(raw) != crc:
             raise ValueError("frame crc mismatch")
     return np.frombuffer(raw, dtype=_DTYPES[code]).reshape(shape)

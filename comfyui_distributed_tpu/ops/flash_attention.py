@@ -107,6 +107,61 @@ def _on_tpu() -> bool:
         return False
 
 
+def _in_manual_trace(x) -> bool:
+    """True when tracing inside ``shard_map`` (the aval carries varying
+    manual axes)."""
+    try:
+        return bool(getattr(jax.typeof(x), "vma", None))
+    except Exception:  # noqa: BLE001 — typeof unavailable on some inputs
+        return False
+
+
+def _flash_emulated(q, k, v, block_q: int, block_k: int):
+    """The kernel's streaming-softmax algorithm in plain JAX ops.
+
+    Used only where the pallas *interpreter* cannot run: inside a
+    ``shard_map`` trace in interpret mode, JAX's HLO interpreter issues
+    ``dynamic_slice`` calls whose index operands lack the varying manual
+    axes of the data operand and trips ``check_vma`` (jax-ml/jax — the
+    error itself suggests ``check_vma=False`` as the workaround, which we
+    cannot impose on callers). This emulation runs the same block
+    schedule, padding, NEG_INF tail masking and fp32 accumulation as
+    ``_flash_kernel``, so CPU shard_map tests exercise the same math;
+    compiled TPU runs still take the pallas path.
+    """
+    BH, Nq, D = q.shape
+    _, Nk, _ = k.shape
+    scale = 1.0 / (D ** 0.5)
+    qp = _pad_to(q, 1, block_q)
+    kp = _pad_to(k, 1, block_k)
+    vp = _pad_to(v, 1, block_k)
+    nkb = kp.shape[1] // block_k
+
+    m = jnp.full((BH, qp.shape[1], 1), NEG_INF, jnp.float32)
+    l = jnp.zeros((BH, qp.shape[1], 1), jnp.float32)
+    acc = jnp.zeros((BH, qp.shape[1], D), jnp.float32)
+    for j in range(nkb):  # static unroll — nkb is a Python int
+        kb = jax.lax.dynamic_slice_in_dim(kp, j * block_k, block_k, 1)
+        vb = jax.lax.dynamic_slice_in_dim(vp, j * block_k, block_k, 1)
+        s = jax.lax.dot_general(
+            qp, kb, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32) * scale
+        if Nk % block_k != 0:
+            col = jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+            s = jnp.where(j * block_k + col < Nk, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m - m_new)
+        l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(vb.dtype), vb, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)
+        acc = acc * corr + pv
+        m = m_new
+    l = jnp.where(l == 0.0, 1.0, l)
+    return (acc / l).astype(q.dtype)[:, :Nq]
+
+
 @functools.partial(jax.jit, static_argnames=("block_q", "block_k", "interpret"))
 def _flash_mha(q, k, v, block_q: int, block_k: int, interpret: bool):
     BH, Nq, D = q.shape
@@ -178,6 +233,11 @@ def flash_attention(
     # [B,N,H,D] → [B·H, N, D]
     def to_bh(x, n):
         return x.transpose(0, 2, 1, 3).reshape(B * H, n, D)
-    out = _flash_mha(to_bh(q, Nq), to_bh(k, Nk), to_bh(v, Nk),
-                     block_q=block_q, block_k=block_k, interpret=interpret)
+    if interpret and _in_manual_trace(q):
+        out = _flash_emulated(to_bh(q, Nq), to_bh(k, Nk), to_bh(v, Nk),
+                              block_q=block_q, block_k=block_k)
+    else:
+        out = _flash_mha(to_bh(q, Nq), to_bh(k, Nk), to_bh(v, Nk),
+                         block_q=block_q, block_k=block_k,
+                         interpret=interpret)
     return out.reshape(B, H, Nq, D).transpose(0, 2, 1, 3)

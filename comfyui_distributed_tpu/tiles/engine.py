@@ -178,3 +178,102 @@ class TileUpscaler:
         if uncond_y is None:
             uncond_y = jnp.zeros_like(y)
         return fn(images, jax.random.key(seed), context, uncond_context, y, uncond_y)
+
+    # --- cross-host farm support -------------------------------------------
+
+    def range_plan(
+        self,
+        mesh: Mesh,
+        image: jax.Array,
+        spec: UpscaleSpec,
+        seed: int,
+        context: jax.Array,
+        uncond_context: jax.Array,
+        y: Optional[jax.Array] = None,
+        uncond_y: Optional[jax.Array] = None,
+        axis: str = constants.AXIS_DATA,
+    ) -> "TileRangePlan":
+        """Prepare arbitrary-range tile processing for the cross-host farm
+        (``cluster/tile_farm.py``): resize + extract all crops once, and
+        compile ONE fixed-chunk SPMD program reused for every pulled task.
+
+        Per-tile noise keys fold the *global* tile index exactly as
+        ``upscale_fn`` does, so any host processing any range produces the
+        same tiles the single-program path would — the shard-count /
+        host-assignment invariance that makes requeue safe (the reference
+        gets this from tile IDs travelling through its HTTP queue,
+        ``upscale/job_store.py:34-80``).
+        """
+        H, W, _ = image.shape
+        grid = self.grid_for(H, W, spec)
+        n_shards = mesh.shape[axis]
+        chunk = n_shards        # one tile per chip per pulled task
+        per_shard = chunk // n_shards
+        sigmas = make_sigma_ladder(spec.generation_spec(), self.pipeline.schedule)
+        has_y = self.pipeline.unet.config.adm_in_channels > 0
+        if y is None:
+            adm = self.pipeline.unet.config.adm_in_channels
+            y = jnp.zeros((1, max(adm, 1)), jnp.float32)
+        if uncond_y is None:
+            uncond_y = jnp.zeros_like(y)
+
+        @jax.jit
+        def prepare(img):
+            up = upscale_image(img[None], spec.scale, spec.resize_method)[0]
+            return extract_tiles(up, grid)
+
+        all_tiles = prepare(image)              # [T, ch, cw, C]
+
+        def process_shard(tiles, start, key, ctx, unc, yy, uyy):
+            shard_i = jax.lax.axis_index(axis)
+            global_idx = start + shard_i * per_shard + jnp.arange(per_shard)
+            return self._img2img_tiles(
+                tiles, key, ctx, unc,
+                yy if has_y else None, uyy if has_y else None,
+                spec, sigmas, global_idx,
+            )
+
+        sharded = jax.jit(jax.shard_map(
+            process_shard,
+            mesh=mesh,
+            in_specs=(P(axis, None, None, None), P(), P(), P(None, None, None),
+                      P(None, None, None), P(None, None), P(None, None)),
+            out_specs=P(axis, None, None, None),
+        ))
+        key = jax.random.key(seed)
+
+        def run_range(start: int, end: int):
+            import numpy as np
+
+            seg = all_tiles[start:end]
+            if seg.shape[0] < chunk:
+                pad = jnp.zeros((chunk - seg.shape[0],) + seg.shape[1:],
+                                seg.dtype)
+                seg = jnp.concatenate([seg, pad], axis=0)
+            out = sharded(seg, jnp.int32(start), key, context, uncond_context,
+                          y, uncond_y)
+            return np.asarray(out[: end - start])
+
+        return TileRangePlan(grid=grid, chunk=chunk, run_range=run_range,
+                             feather=spec.feather)
+
+    def composite(self, tiles, plan: "TileRangePlan"):
+        """Blend a complete [T, ch, cw, C] tile set into the output image
+        (same normalized feather composite the single-program path uses)."""
+        masks = feather_mask(plan.grid, plan.feather)
+        return composite_tiles(jnp.asarray(tiles), masks, plan.grid)
+
+
+@dataclasses.dataclass
+class TileRangePlan:
+    """Host-side handle the farm drivers use: tile geometry + the compiled
+    fixed-chunk range processor."""
+
+    grid: TileGrid
+    chunk: int
+    run_range: "callable"
+    feather: Optional[int]
+
+    @property
+    def num_tiles(self) -> int:
+        return self.grid.num_tiles

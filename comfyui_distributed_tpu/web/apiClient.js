@@ -13,7 +13,9 @@ async function request(path, { method = "GET", body, retries = RETRIES, timeoutM
     try {
       const resp = await fetch(path, {
         method,
-        headers: body !== undefined ? { "Content-Type": "application/json" } : undefined,
+        // POSTs always declare JSON: the control plane rejects POSTs
+        // without a JSON content type (cross-origin simple-request guard)
+        headers: method === "POST" ? { "Content-Type": "application/json" } : undefined,
         body: body !== undefined ? JSON.stringify(body) : undefined,
         signal: ctrl.signal,
       });

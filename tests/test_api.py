@@ -161,7 +161,8 @@ class TestRoutes:
                     form.add_field(f"tile_{i}",
                                    encode_png(np.full((4, 4, 3), 0.5, np.float32)),
                                    content_type="image/png")
-                resp = await client.post("/distributed/submit_tiles", data=form)
+                resp = await client.post("/distributed/submit_tiles", data=form,
+                                          headers={"X-CDT-Client": "1"})
                 assert resp.status == 200
                 assert (await resp.json())["accepted"] == 2
                 assert controller.store.tile_jobs["t1"].is_complete()
@@ -218,7 +219,8 @@ class TestRoutes:
                 png = encode_png(np.zeros((2, 2, 3), np.float32))
                 form.add_field("image", png, filename="a.png",
                                content_type="image/png")
-                resp = await client.post("/upload/image", data=form)
+                resp = await client.post("/upload/image", data=form,
+                                          headers={"X-CDT-Client": "1"})
                 assert (await resp.json())["saved"] == ["a.png"]
                 resp = await client.post("/distributed/check_file",
                                          json={"path": "a.png"})

@@ -1,0 +1,656 @@
+"""safetensors-converter numerics: torch replicas of the published LDM
+``UNetModel``/``AutoencoderKL`` layouts (the exact key names and forward
+semantics real checkpoints assume) are built with random weights, their
+state dicts converted, and the flax modules must reproduce the torch
+outputs. This is the proof that a real SDXL/SD1.5 checkpoint maps onto
+this framework correctly — every transpose, norm-eps, padding and
+activation choice is covered."""
+
+import math
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from comfyui_distributed_tpu.models.convert import (
+    convert_unet, convert_vae, detect_layout, ConversionError)
+from comfyui_distributed_tpu.models.unet import UNetConfig, init_unet
+from comfyui_distributed_tpu.models.vae import AutoencoderKL, VAEConfig
+
+torch = pytest.importorskip("torch")
+nn = torch.nn
+F = torch.nn.functional
+
+
+def gn(ch):
+    return nn.GroupNorm(min(32, ch), ch)
+
+
+def gn6(ch):
+    return nn.GroupNorm(min(32, ch), ch, eps=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# torch replica: LDM UNetModel (SGM numbering, linear transformer proj)
+# ---------------------------------------------------------------------------
+
+class TResBlock(nn.Module):
+    def __init__(self, in_ch, out_ch, emb_dim):
+        super().__init__()
+        self.in_layers = nn.Sequential(
+            gn(in_ch), nn.SiLU(), nn.Conv2d(in_ch, out_ch, 3, padding=1))
+        self.emb_layers = nn.Sequential(nn.SiLU(), nn.Linear(emb_dim, out_ch))
+        self.out_layers = nn.Sequential(
+            gn(out_ch), nn.SiLU(), nn.Dropout(0.0),
+            nn.Conv2d(out_ch, out_ch, 3, padding=1))
+        self.skip_connection = (nn.Conv2d(in_ch, out_ch, 1)
+                                if in_ch != out_ch else nn.Identity())
+
+    def forward(self, x, emb):
+        h = self.in_layers(x)
+        h = h + self.emb_layers(emb)[:, :, None, None]
+        h = self.out_layers(h)
+        return self.skip_connection(x) + h
+
+
+class TCrossAttention(nn.Module):
+    def __init__(self, dim, ctx_dim, heads, head_dim):
+        super().__init__()
+        inner = heads * head_dim
+        self.heads, self.head_dim = heads, head_dim
+        self.to_q = nn.Linear(dim, inner, bias=False)
+        self.to_k = nn.Linear(ctx_dim, inner, bias=False)
+        self.to_v = nn.Linear(ctx_dim, inner, bias=False)
+        self.to_out = nn.Sequential(nn.Linear(inner, dim), nn.Dropout(0.0))
+
+    def forward(self, x, ctx=None):
+        ctx = x if ctx is None else ctx
+        B, N, _ = x.shape
+        M = ctx.shape[1]
+        q = self.to_q(x).view(B, N, self.heads, self.head_dim)
+        k = self.to_k(ctx).view(B, M, self.heads, self.head_dim)
+        v = self.to_v(ctx).view(B, M, self.heads, self.head_dim)
+        s = torch.einsum("bnhd,bmhd->bhnm", q, k) / math.sqrt(self.head_dim)
+        p = s.softmax(-1)
+        out = torch.einsum("bhnm,bmhd->bnhd", p, v).reshape(B, N, -1)
+        return self.to_out(out)
+
+
+class TGEGLU(nn.Module):
+    def __init__(self, dim, inner):
+        super().__init__()
+        self.proj = nn.Linear(dim, inner * 2)
+
+    def forward(self, x):
+        x, gate = self.proj(x).chunk(2, dim=-1)
+        return x * F.gelu(gate)
+
+
+class TFeedForward(nn.Module):
+    def __init__(self, dim):
+        super().__init__()
+        self.net = nn.Sequential(TGEGLU(dim, dim * 4), nn.Dropout(0.0),
+                                 nn.Linear(dim * 4, dim))
+
+    def forward(self, x):
+        return self.net(x)
+
+
+class TBasicTransformer(nn.Module):
+    def __init__(self, dim, ctx_dim, heads, head_dim):
+        super().__init__()
+        self.norm1 = nn.LayerNorm(dim)
+        self.attn1 = TCrossAttention(dim, dim, heads, head_dim)
+        self.norm2 = nn.LayerNorm(dim)
+        self.attn2 = TCrossAttention(dim, ctx_dim, heads, head_dim)
+        self.norm3 = nn.LayerNorm(dim)
+        self.ff = TFeedForward(dim)
+
+    def forward(self, x, ctx):
+        x = x + self.attn1(self.norm1(x))
+        x = x + self.attn2(self.norm2(x), ctx)
+        x = x + self.ff(self.norm3(x))
+        return x
+
+
+class TSpatialTransformer(nn.Module):
+    def __init__(self, ch, ctx_dim, heads, depth):
+        super().__init__()
+        self.norm = gn6(ch)
+        self.proj_in = nn.Linear(ch, ch)
+        self.transformer_blocks = nn.ModuleList(
+            [TBasicTransformer(ch, ctx_dim, heads, ch // heads)
+             for _ in range(depth)])
+        self.proj_out = nn.Linear(ch, ch)
+
+    def forward(self, x, ctx):
+        B, C, H, W = x.shape
+        x_in = x
+        h = self.norm(x).permute(0, 2, 3, 1).reshape(B, H * W, C)
+        h = self.proj_in(h)
+        for block in self.transformer_blocks:
+            h = block(h, ctx)
+        h = self.proj_out(h)
+        return x_in + h.reshape(B, H, W, C).permute(0, 3, 1, 2)
+
+
+class TDownsample(nn.Module):
+    def __init__(self, ch):
+        super().__init__()
+        self.op = nn.Conv2d(ch, ch, 3, stride=2, padding=1)
+
+    def forward(self, x):
+        return self.op(x)
+
+
+class TUpsample(nn.Module):
+    def __init__(self, ch):
+        super().__init__()
+        self.conv = nn.Conv2d(ch, ch, 3, padding=1)
+
+    def forward(self, x):
+        return self.conv(F.interpolate(x, scale_factor=2, mode="nearest"))
+
+
+def t_timestep_embedding(t, dim):
+    half = dim // 2
+    freqs = torch.exp(-math.log(10000.0) * torch.arange(half) / half)
+    args = t[:, None].float() * freqs[None]
+    return torch.cat([torch.cos(args), torch.sin(args)], dim=-1)
+
+
+class TUNet(nn.Module):
+    """LDM UNetModel replica driven by our UNetConfig (tiny shapes)."""
+
+    def __init__(self, cfg: UNetConfig, ctx_dim: int):
+        super().__init__()
+        self.cfg = cfg
+        time_dim = cfg.model_channels * 4
+        self.time_embed = nn.Sequential(
+            nn.Linear(cfg.model_channels, time_dim), nn.SiLU(),
+            nn.Linear(time_dim, time_dim))
+        if cfg.adm_in_channels:
+            self.label_emb = nn.Sequential(nn.Sequential(
+                nn.Linear(cfg.adm_in_channels, time_dim), nn.SiLU(),
+                nn.Linear(time_dim, time_dim)))
+
+        def st(ch, depth):
+            return TSpatialTransformer(ch, ctx_dim, cfg.heads_for(ch), depth)
+
+        blocks = [nn.ModuleList([nn.Conv2d(cfg.in_channels,
+                                           cfg.model_channels, 3, padding=1)])]
+        ch = cfg.model_channels
+        for level, mult in enumerate(cfg.channel_mult):
+            out_ch = cfg.model_channels * mult
+            for _ in range(cfg.num_res_blocks):
+                mods = [TResBlock(ch, out_ch, time_dim)]
+                if cfg.transformer_depth[level]:
+                    mods.append(st(out_ch, cfg.transformer_depth[level]))
+                blocks.append(nn.ModuleList(mods))
+                ch = out_ch
+            if level < len(cfg.channel_mult) - 1:
+                blocks.append(nn.ModuleList([TDownsample(ch)]))
+        self.input_blocks = nn.ModuleList(blocks)
+
+        mid = [TResBlock(ch, ch, time_dim)]
+        if cfg.transformer_depth[-1]:
+            mid.append(st(ch, cfg.transformer_depth[-1]))
+        mid.append(TResBlock(ch, ch, time_dim))
+        self.middle_block = nn.ModuleList(mid)
+
+        # skip-channel bookkeeping mirrors the push order above
+        skip_chs = [cfg.model_channels]
+        c = cfg.model_channels
+        for level, mult in enumerate(cfg.channel_mult):
+            for _ in range(cfg.num_res_blocks):
+                c = cfg.model_channels * mult
+                skip_chs.append(c)
+            if level < len(cfg.channel_mult) - 1:
+                skip_chs.append(c)
+
+        out_blocks = []
+        for level in reversed(range(len(cfg.channel_mult))):
+            out_ch = cfg.model_channels * cfg.channel_mult[level]
+            for i in range(cfg.num_res_blocks + 1):
+                mods = [TResBlock(ch + skip_chs.pop(), out_ch, time_dim)]
+                if cfg.transformer_depth[level]:
+                    mods.append(st(out_ch, cfg.transformer_depth[level]))
+                if level > 0 and i == cfg.num_res_blocks:
+                    mods.append(TUpsample(out_ch))
+                out_blocks.append(nn.ModuleList(mods))
+                ch = out_ch
+        self.output_blocks = nn.ModuleList(out_blocks)
+        self.out = nn.Sequential(gn(ch), nn.SiLU(),
+                                 nn.Conv2d(ch, cfg.out_channels, 3, padding=1))
+
+    def forward(self, x, t, ctx, y=None):
+        emb = self.time_embed(t_timestep_embedding(t, self.cfg.model_channels))
+        if self.cfg.adm_in_channels:
+            emb = emb + self.label_emb(y)
+        h = x
+        hs = []
+        for mods in self.input_blocks:
+            for m in mods:
+                if isinstance(m, TResBlock):
+                    h = m(h, emb)
+                elif isinstance(m, TSpatialTransformer):
+                    h = m(h, ctx)
+                else:
+                    h = m(h)
+            hs.append(h)
+        for m in self.middle_block:
+            h = m(h, emb) if isinstance(m, TResBlock) else m(h, ctx)
+        for mods in self.output_blocks:
+            h = torch.cat([h, hs.pop()], dim=1)
+            for m in mods:
+                if isinstance(m, TResBlock):
+                    h = m(h, emb)
+                elif isinstance(m, TSpatialTransformer):
+                    h = m(h, ctx)
+                else:
+                    h = m(h)
+        return self.out(h)
+
+
+# ---------------------------------------------------------------------------
+# torch replica: LDM AutoencoderKL
+# ---------------------------------------------------------------------------
+
+class TVAEResnet(nn.Module):
+    def __init__(self, in_ch, out_ch):
+        super().__init__()
+        self.norm1 = gn6(in_ch)
+        self.conv1 = nn.Conv2d(in_ch, out_ch, 3, padding=1)
+        self.norm2 = gn6(out_ch)
+        self.conv2 = nn.Conv2d(out_ch, out_ch, 3, padding=1)
+        if in_ch != out_ch:
+            self.nin_shortcut = nn.Conv2d(in_ch, out_ch, 1)
+
+    def forward(self, x):
+        h = self.conv1(F.silu(self.norm1(x)))
+        h = self.conv2(F.silu(self.norm2(h)))
+        if hasattr(self, "nin_shortcut"):
+            x = self.nin_shortcut(x)
+        return x + h
+
+
+class TVAEAttn(nn.Module):
+    def __init__(self, ch):
+        super().__init__()
+        self.norm = gn6(ch)
+        self.q = nn.Conv2d(ch, ch, 1)
+        self.k = nn.Conv2d(ch, ch, 1)
+        self.v = nn.Conv2d(ch, ch, 1)
+        self.proj_out = nn.Conv2d(ch, ch, 1)
+
+    def forward(self, x):
+        B, C, H, W = x.shape
+        h = self.norm(x)
+        q = self.q(h).reshape(B, C, H * W)
+        k = self.k(h).reshape(B, C, H * W)
+        v = self.v(h).reshape(B, C, H * W)
+        w = torch.bmm(q.permute(0, 2, 1), k) / math.sqrt(C)
+        w = w.softmax(dim=2)
+        h = torch.bmm(v, w.permute(0, 2, 1)).reshape(B, C, H, W)
+        return x + self.proj_out(h)
+
+
+class TVAEMid(nn.Module):
+    def __init__(self, ch):
+        super().__init__()
+        self.block_1 = TVAEResnet(ch, ch)
+        self.attn_1 = TVAEAttn(ch)
+        self.block_2 = TVAEResnet(ch, ch)
+
+    def forward(self, x):
+        return self.block_2(self.attn_1(self.block_1(x)))
+
+
+class TVAEDown(nn.Module):
+    def __init__(self, ch):
+        super().__init__()
+        self.conv = nn.Conv2d(ch, ch, 3, stride=2, padding=0)
+
+    def forward(self, x):
+        return self.conv(F.pad(x, (0, 1, 0, 1)))
+
+
+class TVAEUp(nn.Module):
+    def __init__(self, ch):
+        super().__init__()
+        self.conv = nn.Conv2d(ch, ch, 3, padding=1)
+
+    def forward(self, x):
+        return self.conv(F.interpolate(x, scale_factor=2, mode="nearest"))
+
+
+class TVAEEncoder(nn.Module):
+    def __init__(self, cfg: VAEConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.conv_in = nn.Conv2d(cfg.in_channels, cfg.base_channels, 3, padding=1)
+        downs = []
+        ch = cfg.base_channels
+        for level, mult in enumerate(cfg.channel_mult):
+            out_ch = cfg.base_channels * mult
+            stage = nn.Module()
+            stage.block = nn.ModuleList()
+            for _ in range(cfg.num_res_blocks):
+                stage.block.append(TVAEResnet(ch, out_ch))
+                ch = out_ch
+            if level < len(cfg.channel_mult) - 1:
+                stage.downsample = TVAEDown(ch)
+            downs.append(stage)
+        self.down = nn.ModuleList(downs)
+        self.mid = TVAEMid(ch)
+        self.norm_out = gn6(ch)
+        self.conv_out = nn.Conv2d(ch, cfg.latent_channels * 2, 3, padding=1)
+
+    def forward(self, x):
+        h = self.conv_in(x)
+        for level, stage in enumerate(self.down):
+            for block in stage.block:
+                h = block(h)
+            if level < len(self.down) - 1:
+                h = stage.downsample(h)
+        h = self.mid(h)
+        return self.conv_out(F.silu(self.norm_out(h)))
+
+
+class TVAEDecoder(nn.Module):
+    def __init__(self, cfg: VAEConfig):
+        super().__init__()
+        self.cfg = cfg
+        ch = cfg.base_channels * cfg.channel_mult[-1]
+        self.conv_in = nn.Conv2d(cfg.latent_channels, ch, 3, padding=1)
+        self.mid = TVAEMid(ch)
+        ups = [None] * len(cfg.channel_mult)
+        for level in reversed(range(len(cfg.channel_mult))):
+            out_ch = cfg.base_channels * cfg.channel_mult[level]
+            stage = nn.Module()
+            stage.block = nn.ModuleList()
+            for _ in range(cfg.num_res_blocks + 1):
+                stage.block.append(TVAEResnet(ch, out_ch))
+                ch = out_ch
+            if level > 0:
+                stage.upsample = TVAEUp(ch)
+            ups[level] = stage
+        self.up = nn.ModuleList(ups)
+        self.norm_out = gn6(ch)
+        self.conv_out = nn.Conv2d(ch, cfg.in_channels, 3, padding=1)
+
+    def forward(self, z):
+        h = self.mid(self.conv_in(z))
+        for level in reversed(range(len(self.up))):
+            for block in self.up[level].block:
+                h = block(h)
+            if level > 0:
+                h = self.up[level].upsample(h)
+        return self.conv_out(F.silu(self.norm_out(h)))
+
+
+class TAutoencoderKL(nn.Module):
+    def __init__(self, cfg: VAEConfig):
+        super().__init__()
+        self.encoder = TVAEEncoder(cfg)
+        self.decoder = TVAEDecoder(cfg)
+        self.quant_conv = nn.Conv2d(cfg.latent_channels * 2,
+                                    cfg.latent_channels * 2, 1)
+        self.post_quant_conv = nn.Conv2d(cfg.latent_channels,
+                                         cfg.latent_channels, 1)
+
+
+# ---------------------------------------------------------------------------
+# the tests
+# ---------------------------------------------------------------------------
+
+def _nchw(x):
+    return torch.from_numpy(np.asarray(x, np.float32).transpose(0, 3, 1, 2))
+
+
+@pytest.fixture(scope="module")
+def unet_pair():
+    cfg = UNetConfig.tiny(dtype="float32")
+    torch.manual_seed(0)
+    tmodel = TUNet(cfg, ctx_dim=cfg.context_dim).eval()
+    sd = {f"model.diffusion_model.{k}": v.numpy()
+          for k, v in tmodel.state_dict().items()}
+    model, params = init_unet(cfg, jax.random.key(0),
+                              sample_shape=(16, 16, 4), context_len=8)
+    params = convert_unet(sd, params, cfg)
+    return cfg, tmodel, model, params
+
+
+class TestUNetConversion:
+    def test_forward_matches_torch(self, unet_pair):
+        cfg, tmodel, model, params = unet_pair
+        rng = np.random.RandomState(1)
+        x = rng.randn(2, 16, 16, 4).astype(np.float32)
+        t = np.array([3.0, 700.0], np.float32)
+        ctx = rng.randn(2, 8, cfg.context_dim).astype(np.float32)
+        y = rng.randn(2, cfg.adm_in_channels).astype(np.float32)
+
+        with torch.no_grad():
+            ref = tmodel(_nchw(x), torch.from_numpy(t),
+                         torch.from_numpy(ctx), torch.from_numpy(y))
+        out = model.apply(params, jnp.asarray(x), jnp.asarray(t),
+                          jnp.asarray(ctx), jnp.asarray(y))
+        np.testing.assert_allclose(
+            np.asarray(out), ref.numpy().transpose(0, 2, 3, 1),
+            atol=2e-4, rtol=2e-4)
+
+    def test_missing_key_fails_loudly(self, unet_pair):
+        cfg, tmodel, model, params = unet_pair
+        sd = {f"model.diffusion_model.{k}": v.numpy()
+              for k, v in tmodel.state_dict().items()}
+        del sd["model.diffusion_model.middle_block.0.in_layers.2.weight"]
+        with pytest.raises(ConversionError, match="middle_block"):
+            convert_unet(sd, params, cfg)
+
+
+@pytest.fixture(scope="module")
+def vae_pair():
+    cfg = VAEConfig.tiny(dtype="float32")
+    torch.manual_seed(0)
+    tmodel = TAutoencoderKL(cfg).eval()
+    sd = {f"first_stage_model.{k}": v.numpy()
+          for k, v in tmodel.state_dict().items()}
+    vae = AutoencoderKL(cfg).init(jax.random.key(0), image_hw=(16, 16))
+    enc, dec = convert_vae(sd, vae.enc_params, vae.dec_params, cfg)
+    vae.enc_params, vae.dec_params = enc, dec
+    return cfg, tmodel, vae
+
+
+class TestVAEConversion:
+    def test_encoder_matches_torch(self, vae_pair):
+        cfg, tmodel, vae = vae_pair
+        rng = np.random.RandomState(2)
+        img = rng.randn(1, 16, 16, 3).astype(np.float32)
+        with torch.no_grad():
+            ref = tmodel.quant_conv(tmodel.encoder(_nchw(img)))
+        moments = vae.encoder.apply(vae.enc_params, jnp.asarray(img))
+        np.testing.assert_allclose(
+            np.asarray(moments), ref.numpy().transpose(0, 2, 3, 1),
+            atol=2e-4, rtol=2e-4)
+
+    def test_decoder_matches_torch(self, vae_pair):
+        cfg, tmodel, vae = vae_pair
+        rng = np.random.RandomState(3)
+        z = rng.randn(1, 8, 8, cfg.latent_channels).astype(np.float32)
+        with torch.no_grad():
+            ref = tmodel.decoder(tmodel.post_quant_conv(_nchw(z)))
+        out = vae.decoder.apply(vae.dec_params, jnp.asarray(z))
+        np.testing.assert_allclose(
+            np.asarray(out), ref.numpy().transpose(0, 2, 3, 1),
+            atol=2e-4, rtol=2e-4)
+
+    def test_roundtrip_shapes(self, vae_pair):
+        cfg, _, vae = vae_pair
+        img = np.zeros((1, 16, 16, 3), np.float32)
+        lat = vae.encode(jnp.asarray(img))
+        assert lat.shape == (1, 8, 8, cfg.latent_channels)
+        assert vae.decode(lat).shape == (1, 16, 16, 3)
+
+
+class TestLayoutDetection:
+    def test_detect(self):
+        assert detect_layout(
+            {"conditioner.embedders.1.model.ln_final.weight": 0}) == "sdxl"
+        assert detect_layout(
+            {"cond_stage_model.transformer.text_model.x": 0}) == "sd15"
+        assert detect_layout({"model.diffusion_model.out.0.weight": 0}) == "unet-only"
+        with pytest.raises(ConversionError):
+            detect_layout({"bogus": 0})
+
+
+class TestSD15SingleFile:
+    def test_sd15_layout_converts(self, tmp_path):
+        """SD1.5 single-file layout: single CLIPTextModel stack (the
+        clip_stack is NOT a dual SDXLTextStack — regression guard)."""
+        transformers = pytest.importorskip("transformers")
+        from safetensors.numpy import save_file
+
+        from comfyui_distributed_tpu.models.clip import CLIPTextConfig
+        from comfyui_distributed_tpu.models.convert import convert_checkpoint
+        from comfyui_distributed_tpu.models.registry import ModelBundle, ModelPreset
+        from comfyui_distributed_tpu.models.text import TextEncoderConfig
+
+        unet_cfg = UNetConfig.tiny(dtype="float32")
+        vae_cfg = VAEConfig.tiny(dtype="float32")
+        preset = ModelPreset("tiny-sd15", unet_cfg, vae_cfg,
+                             TextEncoderConfig.tiny(), sample_hw=(8, 8),
+                             clip="clip-l")
+        torch.manual_seed(0)
+        sd = {}
+        sd.update({f"model.diffusion_model.{k}": v.numpy() for k, v in
+                   TUNet(unet_cfg, ctx_dim=unet_cfg.context_dim).state_dict().items()})
+        sd.update({f"first_stage_model.{k}": v.numpy() for k, v in
+                   TAutoencoderKL(vae_cfg).state_dict().items()})
+        l_cfg = CLIPTextConfig.tiny()
+        hf_l = transformers.CLIPTextModel(transformers.CLIPTextConfig(
+            vocab_size=l_cfg.vocab_size, hidden_size=l_cfg.width,
+            num_hidden_layers=l_cfg.layers, num_attention_heads=l_cfg.heads,
+            intermediate_size=l_cfg.intermediate,
+            max_position_embeddings=l_cfg.max_len, hidden_act="quick_gelu",
+            eos_token_id=l_cfg.eot_token_id, bos_token_id=0)).eval()
+        sd.update({f"cond_stage_model.transformer.{k}": v.numpy()
+                   for k, v in hf_l.state_dict().items()})
+        path = tmp_path / "tiny_sd15.safetensors"
+        save_file(sd, str(path))
+
+        bundle = ModelBundle(preset)
+        bundle.build_clip_stack(tiny=True)
+        convert_checkpoint(path, bundle)
+        ctx, pooled = bundle.text_encoder.encode(["a photo"])
+        assert ctx.shape == (1, 16, 32)       # last hidden, CLIP-L width
+        assert pooled.shape == (1, 32)
+
+
+class TestSingleFileEndToEnd:
+    """Full weights pipeline on a synthetic tiny SDXL-layout single file:
+    assemble → convert into a bundle → orbax save → fresh bundle restores
+    from the manifest → conditioning outputs identical."""
+
+    @pytest.fixture(scope="class")
+    def tiny_sdxl_file(self, tmp_path_factory):
+        transformers = pytest.importorskip("transformers")
+        from safetensors.numpy import save_file
+
+        from comfyui_distributed_tpu.models.clip import CLIPTextConfig
+        from comfyui_distributed_tpu.models.registry import ModelPreset
+        from comfyui_distributed_tpu.models.text import TextEncoderConfig
+
+        unet_cfg = UNetConfig.tiny(dtype="float32")
+        unet_cfg = UNetConfig(**{**unet_cfg.__dict__, "context_dim": 80})
+        vae_cfg = VAEConfig.tiny(dtype="float32")
+        preset = ModelPreset("tiny-sdxl", unet_cfg, vae_cfg,
+                             TextEncoderConfig.tiny(), sample_hw=(8, 8),
+                             clip="sdxl")
+
+        torch.manual_seed(0)
+        sd = {}
+        tunet = TUNet(unet_cfg, ctx_dim=80).eval()
+        sd.update({f"model.diffusion_model.{k}": v.numpy()
+                   for k, v in tunet.state_dict().items()})
+        tvae = TAutoencoderKL(vae_cfg).eval()
+        sd.update({f"first_stage_model.{k}": v.numpy()
+                   for k, v in tvae.state_dict().items()})
+
+        # clip-L: HF layout under embedders.0 (matches tiny() config)
+        l_cfg = CLIPTextConfig.tiny()
+        hf_l = transformers.CLIPTextModel(transformers.CLIPTextConfig(
+            vocab_size=l_cfg.vocab_size, hidden_size=l_cfg.width,
+            num_hidden_layers=l_cfg.layers, num_attention_heads=l_cfg.heads,
+            intermediate_size=l_cfg.intermediate,
+            max_position_embeddings=l_cfg.max_len, hidden_act="quick_gelu",
+            eos_token_id=l_cfg.eot_token_id, bos_token_id=0)).eval()
+        sd.update({f"conditioner.embedders.0.transformer.{k}": v.numpy()
+                   for k, v in hf_l.state_dict().items()})
+
+        # clip-G: OpenCLIP layout under embedders.1 (tiny G config from
+        # SDXLTextStack.init_random)
+        g_cfg = CLIPTextConfig.tiny(width=48, heads=2, act="gelu",
+                                    projection_dim=48)
+        torch.manual_seed(1)
+        g = {}
+        W = g_cfg.width
+        rng = np.random.RandomState(7)
+        g["model.token_embedding.weight"] = rng.randn(
+            g_cfg.vocab_size, W).astype(np.float32) * 0.02
+        g["model.positional_embedding"] = rng.randn(
+            g_cfg.max_len, W).astype(np.float32) * 0.01
+        for i in range(g_cfg.layers):
+            b = f"model.transformer.resblocks.{i}"
+            g[f"{b}.ln_1.weight"] = np.ones(W, np.float32)
+            g[f"{b}.ln_1.bias"] = np.zeros(W, np.float32)
+            g[f"{b}.ln_2.weight"] = np.ones(W, np.float32)
+            g[f"{b}.ln_2.bias"] = np.zeros(W, np.float32)
+            g[f"{b}.attn.in_proj_weight"] = rng.randn(3 * W, W).astype(np.float32) * 0.05
+            g[f"{b}.attn.in_proj_bias"] = np.zeros(3 * W, np.float32)
+            g[f"{b}.attn.out_proj.weight"] = rng.randn(W, W).astype(np.float32) * 0.05
+            g[f"{b}.attn.out_proj.bias"] = np.zeros(W, np.float32)
+            g[f"{b}.mlp.c_fc.weight"] = rng.randn(
+                g_cfg.intermediate, W).astype(np.float32) * 0.05
+            g[f"{b}.mlp.c_fc.bias"] = np.zeros(g_cfg.intermediate, np.float32)
+            g[f"{b}.mlp.c_proj.weight"] = rng.randn(
+                W, g_cfg.intermediate).astype(np.float32) * 0.05
+            g[f"{b}.mlp.c_proj.bias"] = np.zeros(W, np.float32)
+        g["model.ln_final.weight"] = np.ones(W, np.float32)
+        g["model.ln_final.bias"] = np.zeros(W, np.float32)
+        g["model.text_projection"] = rng.randn(W, W).astype(np.float32) * 0.05
+        g["model.logit_scale"] = np.zeros((), np.float32)
+        sd.update({f"conditioner.embedders.1.{k}": v for k, v in g.items()})
+
+        path = tmp_path_factory.mktemp("ckpt") / "tiny_sdxl.safetensors"
+        save_file(sd, str(path))
+        return preset, path
+
+    def test_convert_save_restore_roundtrip(self, tiny_sdxl_file, tmp_path):
+        from comfyui_distributed_tpu.models.convert import convert_checkpoint
+        from comfyui_distributed_tpu.models.registry import ModelBundle
+
+        preset, path = tiny_sdxl_file
+        bundle = ModelBundle(preset)
+        bundle.build_clip_stack(tiny=True)
+        convert_checkpoint(path, bundle)
+
+        ctx, pooled = bundle.text_encoder.encode(["hello tpu"])
+        assert ctx.shape == (1, 16, 80)
+        assert pooled.shape == (1, 48)
+
+        out_dir = tmp_path / "orbax" / "tiny-sdxl"
+        bundle.save_checkpoint(out_dir)
+
+        fresh = ModelBundle(preset, checkpoint_dir=out_dir)
+        assert fresh.clip_stack is not None
+        ctx2, pooled2 = fresh.text_encoder.encode(["hello tpu"])
+        np.testing.assert_allclose(np.asarray(ctx), np.asarray(ctx2),
+                                   atol=1e-6)
+        np.testing.assert_allclose(np.asarray(pooled), np.asarray(pooled2),
+                                   atol=1e-6)
+        # UNet weights survived the roundtrip too
+        a = bundle.pipeline.unet_params["params"]["conv_in"]["kernel"]
+        b = fresh.pipeline.unet_params["params"]["conv_in"]["kernel"]
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)

@@ -40,13 +40,59 @@ class TestFrameCodec:
         f = native.pack_frame(a, level=1)
         assert len(f) < a.nbytes // 10
 
-    def test_bfloat16_travels_as_bits(self, no_native):
+    def test_bfloat16_roundtrips_losslessly(self, no_native):
         import jax.numpy as jnp
 
         a = np.asarray(jnp.arange(8, dtype=jnp.bfloat16))
         out = native.unpack_frame(native.pack_frame(a))
-        assert out.dtype == np.uint16
-        assert np.array_equal(out, a.view(np.uint16))
+        assert out.dtype == a.dtype
+        assert np.array_equal(out.view(np.uint16), a.view(np.uint16))
+
+    @pytest.mark.parametrize("dtype", [np.int64, np.float64, np.bool_])
+    def test_wide_dtypes_roundtrip(self, no_native, dtype):
+        a = np.arange(16).reshape(4, 4).astype(dtype)
+        out = native.unpack_frame(native.pack_frame(a))
+        assert out.dtype == a.dtype
+        assert np.array_equal(out, a)
+
+    def test_unsupported_dtype_raises(self, no_native):
+        with pytest.raises(ValueError, match="unsupported frame dtype"):
+            native.pack_frame(np.zeros(4, np.complex64))
+
+    def test_rawlen_bomb_rejected(self, no_native, monkeypatch):
+        """A frame header claiming a huge raw size must be rejected before
+        allocation (zlib-bomb / memory-exhaustion guard)."""
+        a = np.zeros((4, 4), np.float32)
+        f = bytearray(native.pack_frame(a, level=0))
+        # raw_len lives in the last 8 header bytes before the payload
+        off = 8 + 8 * 2 + 4 + 8
+        f[off:off + 8] = (1 << 60).to_bytes(8, "little")
+        with pytest.raises(ValueError, match="raw size"):
+            native.unpack_frame(bytes(f))
+
+    def test_shape_size_mismatch_rejected(self, no_native):
+        a = np.zeros((4, 4), np.float32)
+        f = bytearray(native.pack_frame(a, level=0))
+        f[8:16] = (1 << 50).to_bytes(8, "little")   # dim0 → absurd
+        with pytest.raises(ValueError, match="raw size"):
+            native.unpack_frame(bytes(f))
+
+    def test_inflation_bomb_bounded(self, no_native):
+        """A payload that INFLATES beyond its declared raw_len must fail
+        without materializing the expansion (decompress is bounded by the
+        header's raw_len, which the shape check already pinned)."""
+        big = native.pack_frame(np.zeros((512, 512, 3), np.float32), level=1)
+        small_hdr = native.pack_frame(np.zeros((4, 4), np.float32), level=1)
+        # graft the big compressed payload onto the small header: header
+        # claims 64 raw bytes, payload inflates to 3 MB
+        hdr_len = 8 + 8 * 2 + 4 + 8 + 8
+        big_payload = big[8 + 8 * 3 + 4 + 8 + 8:]
+        f = bytearray(small_hdr[:hdr_len])
+        f[7] |= 1                                     # flags: compressed
+        stored_off = 8 + 8 * 2 + 4
+        f[stored_off:stored_off + 8] = len(big_payload).to_bytes(8, "little")
+        with pytest.raises(ValueError, match="crc mismatch|decompress"):
+            native.unpack_frame(bytes(f) + big_payload)
 
     def test_corrupt_payload_detected(self, no_native):
         a = np.arange(64, dtype=np.float32)
@@ -223,7 +269,7 @@ class TestFramesRoute:
                                filename="frame_0.cdtf",
                                content_type="application/x-cdt-frame")
                 r = await client.post("/distributed/job_complete_frames",
-                                      data=form)
+                                      data=form, headers={"X-CDT-Client": "1"})
                 assert r.status == 400
                 assert "frame 0" in (await r.json())["error"]
         run(body())

@@ -90,3 +90,34 @@ def test_sdxl_adm_shape():
     pooled = jnp.zeros((2, 1280))
     y = sdxl_adm(pooled, (1024, 1024))
     assert y.shape == (2, 1280 + 6 * 256)  # 2816, matches UNetConfig.sdxl adm
+
+
+class TestCompileCache:
+    def test_key_is_mesh_value_not_identity(self, tiny_pipeline):
+        """Two mesh objects with identical topology share one compiled fn;
+        id() recycling can never alias distinct meshes."""
+        from comfyui_distributed_tpu.diffusion.pipeline import GenerationSpec
+
+        spec = GenerationSpec(height=16, width=16, steps=1)
+        m1, m2 = build_mesh({"dp": 4}), build_mesh({"dp": 4})
+        assert tiny_pipeline._mesh_cache_key(m1) == tiny_pipeline._mesh_cache_key(m2)
+        f1 = tiny_pipeline._cached_fn(m1, spec)
+        f2 = tiny_pipeline._cached_fn(m2, spec)
+        assert f1 is f2
+
+        m8 = build_mesh({"dp": 8})
+        assert tiny_pipeline._cached_fn(m8, spec) is not f1
+        # distinct-id meshes with different topology can't collide even if
+        # an id were recycled — the key carries axis names/shape/devices
+        k4 = tiny_pipeline._mesh_cache_key(m1)
+        k8 = tiny_pipeline._mesh_cache_key(m8)
+        assert k4 != k8
+
+    def test_cache_is_bounded(self, tiny_pipeline):
+        from comfyui_distributed_tpu.diffusion.pipeline import GenerationSpec
+
+        mesh = build_mesh({"dp": 4})
+        for i in range(tiny_pipeline._CACHE_MAX + 3):
+            tiny_pipeline._cached_fn(
+                mesh, GenerationSpec(height=16, width=16, steps=1 + i))
+        assert len(tiny_pipeline._fn_cache) <= tiny_pipeline._CACHE_MAX

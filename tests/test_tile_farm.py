@@ -1,0 +1,202 @@
+"""Cross-host tile farm: two-controller HTTP tests (real aiohttp server,
+real pull/submit wire traffic), fault injection (worker killed mid-job →
+heartbeat requeue → master fallback completes), and numerical equivalence
+of the farm path with the single-program SPMD path.
+
+Closes the reference's own test gap (SURVEY §4: "no end-to-end
+multi-process test"; §5.3 "fault injection: none")."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+import jax
+
+from comfyui_distributed_tpu.cluster.controller import Controller
+from comfyui_distributed_tpu.cluster.job_store import JobStore
+from comfyui_distributed_tpu.cluster.tile_farm import TileFarm, assemble_tiles
+from comfyui_distributed_tpu.utils.exceptions import TileCollectionError
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def make_proc(marker=0.0, delay=0.0):
+    """process_fn whose output encodes the global tile index — whoever
+    processes tile i must produce the same pixels."""
+    import time as _t
+
+    def proc(start, end):
+        if delay:
+            _t.sleep(delay)
+        return np.stack([np.full((4, 4, 3), float(i) + marker, np.float32)
+                         for i in range(start, end)])
+    return proc
+
+
+class TestAssemble:
+    def test_orders_by_task_id(self):
+        results = {1: np.full((2, 4, 4, 3), 9.0), 0: np.zeros((2, 4, 4, 3))}
+        out = assemble_tiles(results, total=3, chunk=2)
+        assert out.shape == (3, 4, 4, 3)
+        assert out[0].max() == 0.0 and out[2].max() == 9.0
+
+    def test_shortage_raises(self):
+        with pytest.raises(TileCollectionError, match="expected 4"):
+            assemble_tiles({0: np.zeros((2, 4, 4, 3))}, total=4, chunk=2)
+
+
+class TestMasterOnly:
+    def test_master_completes_alone(self, tmp_config):
+        """No workers ever show up — the master's own pull loop finishes
+        the whole queue (reference single-host degradation)."""
+        async def body():
+            store = JobStore()
+            farm = TileFarm(store, asyncio.get_running_loop())
+            results = await farm.master_run_async(
+                "solo", total=5, process_fn=make_proc(), chunk=2,
+                heartbeat_interval=0.2)
+            tiles = assemble_tiles(results, 5, 2)
+            np.testing.assert_allclose(tiles[:, 0, 0, 0], np.arange(5.0))
+        run(body())
+
+
+class TestTwoControllersHTTP:
+    """Master controller serves the real route surface; the worker farm
+    talks to it over a real localhost socket."""
+
+    def _serve_master(self):
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from comfyui_distributed_tpu.api.app import create_app
+
+        controller = Controller()
+        app = create_app(controller)
+        return controller, TestClient(TestServer(app))
+
+    def test_worker_processes_share_of_tiles(self, tmp_config):
+        async def body():
+            controller, client = self._serve_master()
+            async with client:
+                base = f"http://127.0.0.1:{client.port}"
+                farm_m = controller.tile_farm
+                farm_w = TileFarm(JobStore(), asyncio.get_running_loop())
+
+                master_task = asyncio.create_task(farm_m.master_run_async(
+                    "j2c", total=8, process_fn=make_proc(delay=0.05),
+                    chunk=2, heartbeat_interval=0.5))
+                await asyncio.sleep(0.05)   # let the job initialize
+                worker_done = await farm_w.worker_run_async(
+                    "j2c", "w0", base, make_proc(), max_batch=2)
+                results = await master_task
+
+                assert worker_done > 0, "worker never got work"
+                tiles = assemble_tiles(results, 8, 2)
+                np.testing.assert_allclose(tiles[:, 0, 0, 0], np.arange(8.0))
+        run(body())
+
+    def test_worker_killed_mid_job_requeue_and_fallback(self, tmp_config):
+        """Fault injection: a worker pulls tasks and dies silently. The
+        heartbeat monitor requeues its tasks; the master completes them
+        (reference upscale/job_timeout.py:17-150 + modes/static.py:469-513)."""
+        async def body():
+            import aiohttp
+
+            controller, client = self._serve_master()
+            async with client:
+                base = f"http://127.0.0.1:{client.port}"
+                farm_m = controller.tile_farm
+
+                async def dead_worker():
+                    # pulls two tasks over the real wire, then vanishes
+                    async with aiohttp.ClientSession() as s:
+                        for _ in range(2):
+                            async with s.post(
+                                    f"{base}/distributed/request_image",
+                                    json={"job_id": "jkill",
+                                          "worker_id": "wdead"}) as r:
+                                body = await r.json()
+                                assert body["task"] is not None
+
+                master_task = asyncio.create_task(farm_m.master_run_async(
+                    "jkill", total=8, process_fn=make_proc(delay=0.05),
+                    chunk=2, heartbeat_interval=0.2, worker_timeout=0.4))
+                await asyncio.sleep(0.05)
+                await dead_worker()
+                results = await master_task
+
+                tiles = assemble_tiles(results, 8, 2)
+                # every tile present and correct despite the dead worker
+                np.testing.assert_allclose(tiles[:, 0, 0, 0], np.arange(8.0))
+        run(body())
+
+    def test_busy_worker_spared_by_probe_grace(self, tmp_config):
+        """A silent-but-busy worker is NOT evicted when the probe shows a
+        non-empty queue (reference busy-probe grace)."""
+        async def body():
+            store = JobStore()
+            farm = TileFarm(store, asyncio.get_running_loop())
+            await store.init_tile_job("jgrace", 4, chunk=2)
+            task = await store.request_work("jgrace", "wslow")
+            assert task is not None
+
+            from comfyui_distributed_tpu.cluster.job_timeout import (
+                check_and_requeue_timed_out_workers)
+
+            async def busy_probe(worker_id):
+                return {"queue_remaining": 3}
+
+            import time
+
+            evicted = await check_and_requeue_timed_out_workers(
+                store, "jgrace", timeout=0.0, probe_fn=busy_probe,
+                now=time.monotonic() + 10)
+            assert evicted == {}
+            job = store.tile_jobs["jgrace"]
+            assert task["task_id"] in job.assigned   # still theirs
+        run(body())
+
+
+class TestFarmMatchesSPMD:
+    def test_farm_equals_single_program(self, tmp_config):
+        """Chunked range processing through the farm produces the same
+        pixels as the one-shot SPMD upscale — host assignment and requeue
+        are numerically invisible (float32)."""
+        from comfyui_distributed_tpu.diffusion.pipeline import Txt2ImgPipeline
+        from comfyui_distributed_tpu.models.text import TextEncoder, TextEncoderConfig
+        from comfyui_distributed_tpu.models.unet import UNetConfig, init_unet
+        from comfyui_distributed_tpu.models.vae import AutoencoderKL, VAEConfig
+        from comfyui_distributed_tpu.parallel import build_mesh
+        from comfyui_distributed_tpu.tiles.engine import TileUpscaler, UpscaleSpec
+
+        model, params = init_unet(UNetConfig.tiny(dtype="float32"),
+                                  jax.random.key(0), sample_shape=(8, 8, 4),
+                                  context_len=16)
+        vae = AutoencoderKL(VAEConfig.tiny(dtype="float32")).init(
+            jax.random.key(1), image_hw=(16, 16))
+        pipe = Txt2ImgPipeline(model, params, vae)
+        enc = TextEncoder(TextEncoderConfig.tiny()).init(jax.random.key(2))
+        ctx, _ = enc.encode(["tile prompt"])
+        unc, _ = enc.encode([""])
+        spec = UpscaleSpec(scale=2.0, tile_w=16, tile_h=16, padding=4,
+                           steps=2, denoise=0.4, guidance_scale=1.0)
+        ups = TileUpscaler(pipe)
+        img = jax.random.uniform(jax.random.key(3), (1, 16, 16, 3))
+        mesh = build_mesh({"dp": 2})
+
+        ref = np.asarray(ups.upscale(mesh, img, spec, seed=11, context=ctx,
+                                     uncond_context=unc))
+
+        plan = ups.range_plan(mesh, img[0], spec, seed=11, context=ctx,
+                              uncond_context=unc)
+        results = {}
+        tid = 0
+        for start in range(0, plan.num_tiles, plan.chunk):
+            end = min(start + plan.chunk, plan.num_tiles)
+            results[tid] = plan.run_range(start, end)
+            tid += 1
+        tiles = assemble_tiles(results, plan.num_tiles, plan.chunk)
+        out = np.asarray(ups.composite(tiles, plan))
+        np.testing.assert_allclose(out, ref[0], rtol=1e-5, atol=1e-5)

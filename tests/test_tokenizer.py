@@ -1,0 +1,107 @@
+"""CLIP BPE tokenizer: differential-tested against
+``transformers.CLIPTokenizer`` on a synthetic vocabulary (no network, no
+vendored vocab — the algorithm is what's under test)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from comfyui_distributed_tpu.models.tokenizer import (
+    CLIPBPETokenizer, SOT, EOT, bytes_to_unicode, load_sd_tokenizers)
+
+transformers = pytest.importorskip("transformers")
+
+
+MERGES = [
+    ("h", "e"), ("l", "l"), ("o", "</w>"), ("he", "ll"), ("hell", "o</w>"),
+    ("w", "o"), ("r", "l"), ("d", "</w>"), ("wo", "rl"), ("worl", "d</w>"),
+    ("t", "p"), ("u", "</w>"), ("tp", "u</w>"),
+    ("1", "</w>"), ("a", "</w>"),
+]
+
+
+def _build_vocab():
+    units = list(bytes_to_unicode().values())
+    vocab = {}
+    for u in units:
+        vocab[u] = len(vocab)
+    for u in units:
+        vocab[u + "</w>"] = len(vocab)
+    for a, b in MERGES:
+        merged = a + b
+        if merged not in vocab:
+            vocab[merged] = len(vocab)
+    vocab[SOT] = len(vocab)
+    vocab[EOT] = len(vocab)
+    return vocab
+
+
+@pytest.fixture(scope="module")
+def vocab_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("clip_vocab")
+    (d / "vocab.json").write_text(json.dumps(_build_vocab()))
+    (d / "merges.txt").write_text(
+        "#version: 0.2\n" + "\n".join(f"{a} {b}" for a, b in MERGES) + "\n")
+    return d
+
+
+@pytest.fixture(scope="module")
+def ours(vocab_dir):
+    return CLIPBPETokenizer.from_dir(vocab_dir, max_len=77)
+
+
+@pytest.fixture(scope="module")
+def theirs(vocab_dir):
+    return transformers.CLIPTokenizer(
+        str(vocab_dir / "vocab.json"), str(vocab_dir / "merges.txt"))
+
+
+TEXTS = [
+    "hello world",
+    "Hello, WORLD!",
+    "a hello  on   tpu",
+    "hello's world'll 1 2 3",
+    "x" * 300,                       # overflow → truncation
+    "",
+    "punctuation!!! ... (grouping)",
+]
+
+
+class TestDifferential:
+    @pytest.mark.parametrize("text", TEXTS)
+    def test_matches_transformers(self, ours, theirs, text):
+        ref = theirs(text, padding="max_length", truncation=True,
+                     max_length=77)["input_ids"]
+        assert ours.encode(text) == ref
+
+    def test_bpe_merging_applies(self, ours):
+        ids = ours.tokenize_text("hello")
+        # fully merged into a single unit
+        assert ids == [ours.vocab["hello</w>"]]
+
+    def test_padding_and_specials(self, ours):
+        out = ours.encode("hello")
+        assert out[0] == ours.sot_id
+        assert out[2] == ours.eot_id
+        assert len(out) == 77
+        assert set(out[3:]) == {ours.eot_id}
+
+    def test_clip_g_zero_padding(self, vocab_dir):
+        tok = CLIPBPETokenizer.from_dir(vocab_dir, max_len=77, pad_token_id=0)
+        out = tok.encode("hello")
+        assert out[2] == tok.eot_id and set(out[3:]) == {0}
+
+
+class TestEnvLoading:
+    def test_from_env_absent(self, monkeypatch):
+        monkeypatch.delenv("CDT_TOKENIZER_DIR", raising=False)
+        assert CLIPBPETokenizer.from_env() is None
+        assert load_sd_tokenizers() == (None, None)
+
+    def test_from_env_present(self, monkeypatch, vocab_dir):
+        monkeypatch.setenv("CDT_TOKENIZER_DIR", str(vocab_dir))
+        tok_l, tok_g = load_sd_tokenizers(max_len=77)
+        assert tok_l is not None
+        assert tok_l.pad_token_id == tok_l.eot_id
+        assert tok_g.pad_token_id == 0

@@ -141,10 +141,10 @@ class TestRoutes:
                 assert st["running"] is False
                 # start without a binary → clean 503, not a 500
                 monkeypatch.setattr(tunnel_mod.shutil, "which", lambda _: None)
-                r = await client.post("/distributed/tunnel/start")
+                r = await client.post("/distributed/tunnel/start", json={})
                 assert r.status == 503
                 assert "not found" in (await r.json())["error"]
-                r = await client.post("/distributed/tunnel/stop")
+                r = await client.post("/distributed/tunnel/stop", json={})
                 assert (await r.json())["status"] == "not_running"
         run(body())
         tunnel_mod._manager = None

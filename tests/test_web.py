@@ -33,22 +33,67 @@ class TestDashboard:
                     assert r.status == 200, asset
         run(body())
 
-    def test_cors_headers_on_distributed_routes(self, tmp_config):
+    def test_cors_scoped_to_readonly_probe_routes(self, tmp_config):
+        """Cross-origin is allowed only on the read-only probe surface the
+        dashboard needs on other hosts; mutating routes expose no CORS (a
+        public tunnel must not let arbitrary pages reconfigure the
+        cluster)."""
         async def body():
             app = create_app(Controller())
             async with TestClient(TestServer(app)) as client:
                 r = await client.get("/distributed/health")
                 assert r.headers["Access-Control-Allow-Origin"] == "*"
+                r = await client.get("/prompt")
+                assert r.headers["Access-Control-Allow-Origin"] == "*"
                 r = await client.options("/distributed/clear_memory")
                 assert r.status == 200
-                assert "POST" in r.headers["Access-Control-Allow-Methods"]
+                assert "Access-Control-Allow-Origin" not in r.headers
+                r = await client.post("/distributed/interrupt", json={})
+                assert "Access-Control-Allow-Origin" not in r.headers
+        run(body())
+
+    def test_cors_permissive_setting_restores_wildcard(self, tmp_config):
+        from comfyui_distributed_tpu.utils import config as config_mod
+
+        async def body():
+            controller = Controller()
+            cfg = controller.load_config()
+            cfg.setdefault("settings", {})["permissive_cors"] = True
+            config_mod.save_config(cfg)
+            app = create_app(controller)
+            async with TestClient(TestServer(app)) as client:
+                r = await client.options("/distributed/clear_memory")
+                assert r.headers["Access-Control-Allow-Origin"] == "*"
+        run(body())
+
+    def test_post_content_type_enforced(self, tmp_config):
+        """Cross-origin 'simple requests' (text/plain or bare POSTs, which
+        browsers send without preflight) must be rejected on mutating
+        routes; JSON and header-carrying multipart pass."""
+        async def body():
+            app = create_app(Controller())
+            async with TestClient(TestServer(app)) as client:
+                r = await client.post("/distributed/interrupt",
+                                      data=b"x", headers={
+                                          "Content-Type": "text/plain"})
+                assert r.status == 415
+                r = await client.post("/distributed/interrupt")  # no ctype
+                assert r.status == 415
+                import aiohttp
+
+                form = aiohttp.FormData()
+                form.add_field("image", b"png", filename="x.png")
+                r = await client.post("/upload/image", data=form)
+                assert r.status == 415        # multipart without header
+                r = await client.post("/distributed/interrupt", json={})
+                assert r.status == 200
         run(body())
 
     def test_interrupt_route(self, tmp_config):
         async def body():
             app = create_app(Controller())
             async with TestClient(TestServer(app)) as client:
-                r = await client.post("/distributed/interrupt")
+                r = await client.post("/distributed/interrupt", json={})
                 assert (await r.json())["status"] == "interrupted"
         run(body())
 
