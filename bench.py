@@ -2,74 +2,100 @@
 """Benchmark driver: SDXL-class txt2img throughput on the available device.
 
 Prints ONE JSON line:
-    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
 
 Metric matches BASELINE.md: images/sec for SDXL 1024², 30 steps (per chip;
 pod scaling multiplies by data-parallel width). The reference publishes no
-numbers (BASELINE.json "published": {}), so ``vs_baseline`` is the ratio
-against the implied reference performance model: one denoise step per UNet
-call, plus the reference's per-result PNG/base64/HTTP overhead which this
-framework eliminates on-pod — baselined as 1.0 at parity.
+numbers (BASELINE.json "published": {}), so ``vs_baseline`` falls back to
+1.0 with an explicit ``vs_baseline_note`` when nothing is published.
 
-Robustness: if the TPU backend is unreachable (tunnel down), falls back to
-CPU with a scaled-down config so the driver always gets a result line;
-the JSON then carries "platform": "cpu" for honest bookkeeping.
+Hardened against the flaky accelerator tunnel (it can refuse connections,
+die mid-compile, or hang ``jax.devices()`` outright):
+
+- the accelerator attempt runs in a WATCHDOG SUBPROCESS with a wall-clock
+  timeout, retried within ``CDT_BENCH_BUDGET_S`` (default 2400 s);
+- a CPU downgrade is loud (stderr) and explicit in the JSON —
+  ``tpu_attempted`` / ``tpu_error`` make a toy CPU line impossible to
+  mistake for the real result;
+- MFU comes from XLA's compiled cost analysis of the whole generation
+  program divided by measured step time and chip peak (bf16).
 """
 
 from __future__ import annotations
 
+import argparse
 import json
 import os
+import subprocess
 import sys
+import tempfile
 import time
 
+# bf16 peak FLOP/s per chip, by device_kind substring (lowercase match).
+_PEAK_BF16 = [
+    ("v5 lite", 197e12),   # v5e reports "TPU v5 lite"
+    ("v5e", 197e12),
+    ("v5p", 459e12),
+    ("v6", 918e12),        # Trillium
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+]
 
-def _try_tpu() -> str:
-    """Pick the best available platform; returns its name."""
-    import jax
 
-    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
-        # a pre-registered accelerator platform may have overridden the env
-        # var programmatically; honor the explicit request
-        jax.config.update("jax_platforms", "cpu")
-        return "cpu"
+def _peak_flops(device_kind: str) -> float | None:
+    kind = device_kind.lower()
+    for sub, peak in _PEAK_BF16:
+        if sub in kind:
+            return peak
+    return None
+
+
+def _cost_analysis_flops(compiled) -> float | None:
+    """Total FLOPs of the compiled program per XLA's cost model."""
     try:
-        devs = jax.devices()
-        return devs[0].platform
-    except RuntimeError:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else None
+        if ca:
+            f = ca.get("flops")
+            if f and f > 0:
+                return float(f)
+    except Exception:
         pass
-    jax.config.update("jax_platforms", "cpu")
-    return "cpu"
+    return None
 
 
-def main() -> None:
-    os.environ.setdefault("XLA_FLAGS", "")
+def run_benchmark(steps: int, runs: int | None, force_cpu: bool) -> dict:
+    """The actual measurement (single process, current JAX backend)."""
     import jax
     import jax.numpy as jnp
 
-    platform = _try_tpu()
+    if force_cpu:
+        jax.config.update("jax_platforms", "cpu")
+    platform = jax.devices()[0].platform
     on_accel = platform not in ("cpu",)
 
     from comfyui_distributed_tpu.diffusion.pipeline import (
-        GenerationSpec, Txt2ImgPipeline)
+        GenerationSpec, Txt2ImgPipeline, sdxl_adm)
     from comfyui_distributed_tpu.models.text import TextEncoder, TextEncoderConfig
     from comfyui_distributed_tpu.models.unet import UNetConfig, init_unet
     from comfyui_distributed_tpu.models.vae import AutoencoderKL, VAEConfig
     from comfyui_distributed_tpu.parallel import build_mesh
 
     if on_accel:
-        # SDXL-base architecture, 1024² (latent 128²), 30 steps
+        # SDXL-base architecture, 1024² (latent 128²)
         unet_cfg = UNetConfig.sdxl()
         vae_cfg = VAEConfig.sdxl()
         text_cfg = TextEncoderConfig()
-        spec = GenerationSpec(height=1024, width=1024, steps=30,
+        spec = GenerationSpec(height=1024, width=1024, steps=steps,
                               guidance_scale=5.0, per_device_batch=1)
         lat_hw = (128, 128)
     else:
         unet_cfg = UNetConfig.tiny()
         vae_cfg = VAEConfig.tiny()
         text_cfg = TextEncoderConfig.tiny()
-        spec = GenerationSpec(height=32, width=32, steps=30,
+        spec = GenerationSpec(height=32, width=32, steps=steps,
                               guidance_scale=5.0, per_device_batch=1)
         lat_hw = (16, 16)
 
@@ -88,10 +114,6 @@ def main() -> None:
     n_dev = len(jax.devices())
     mesh = build_mesh({"dp": n_dev})
 
-    import numpy as np
-
-    from comfyui_distributed_tpu.diffusion.pipeline import sdxl_adm
-
     y = uy = None
     if unet_cfg.adm_in_channels:
         if unet_cfg.adm_in_channels == 2816:
@@ -106,42 +128,202 @@ def main() -> None:
             y if y is not None else jnp.zeros((1, 1)),
             uy if uy is not None else jnp.zeros((1, 1)))
 
-    # compile + warmup
+    # compile (timed separately) + cost analysis for the MFU estimate
     t0 = time.perf_counter()
-    out = jax.block_until_ready(fn(*args))
+    compiled = fn.lower(*args).compile()
     compile_s = time.perf_counter() - t0
+    total_flops = _cost_analysis_flops(compiled)
+
+    # warmup run (first execution pays allocator/init overhead)
+    jax.block_until_ready(compiled(*args))
 
     # timed runs (median of 5 per protocol in BASELINE.md; 3 on cpu)
-    runs = 5 if on_accel else 3
+    runs = runs or (5 if on_accel else 3)
     times = []
     for i in range(runs):
         t0 = time.perf_counter()
-        out = jax.block_until_ready(fn(jax.random.key(i), *args[1:]))
+        jax.block_until_ready(compiled(jax.random.key(i), *args[1:]))
         times.append(time.perf_counter() - t0)
     times.sort()
     median = times[len(times) // 2]
     images = n_dev * spec.per_device_batch
     ips = images / median
 
+    mfu = None
+    flops_per_image = None
+    peak = _peak_flops(jax.devices()[0].device_kind) if on_accel else None
+    if total_flops:
+        flops_per_image = total_flops / images
+        if peak:
+            mfu = total_flops / median / (peak * n_dev)
+
     baseline = None
+    note = None
     try:
         with open(os.path.join(os.path.dirname(__file__), "BASELINE.json")) as f:
             baseline = json.load(f).get("published", {}).get("images_per_sec")
     except (OSError, json.JSONDecodeError):
         pass
-    vs = (ips / baseline) if baseline else 1.0
+    if baseline:
+        vs = ips / baseline
+    else:
+        vs = 1.0
+        note = "reference publishes no numbers (BASELINE.json published={})"
 
-    print(json.dumps({
-        "metric": "sdxl_1024_30step_images_per_sec" if on_accel
-                  else "tiny_32_30step_images_per_sec_cpu",
+    result = {
+        "metric": (f"sdxl_1024_{spec.steps}step_images_per_sec" if on_accel
+                   else f"tiny_32_{spec.steps}step_images_per_sec_cpu"),
         "value": round(ips, 4),
         "unit": "images/sec",
         "vs_baseline": round(vs, 4),
         "platform": platform,
+        "device_kind": jax.devices()[0].device_kind,
         "devices": n_dev,
-        "median_step_time_s": round(median, 3),
+        "steps": spec.steps,
+        "median_image_latency_s": round(median, 3),
+        "median_step_time_s": round(median / spec.steps, 4),
         "compile_s": round(compile_s, 1),
-    }))
+        "run_times_s": [round(t, 3) for t in times],
+    }
+    if note:
+        result["vs_baseline_note"] = note
+    if flops_per_image:
+        result["model_flops_per_image"] = round(flops_per_image)
+    if mfu is not None:
+        result["mfu"] = round(mfu, 4)
+        result["peak_flops_per_chip_bf16"] = peak
+    return result
+
+
+def _inner_main(cli) -> None:
+    force_cpu = os.environ.get("JAX_PLATFORMS", "") == "cpu"
+    result = run_benchmark(cli.steps, cli.runs, force_cpu)
+    line = json.dumps(result)
+    if cli.out:
+        with open(cli.out, "w") as f:
+            f.write(line + "\n")
+    print(line)
+
+
+def _watchdog_main(cli) -> None:
+    """Run the accelerator attempt in a subprocess so a hung tunnel (even
+    inside ``jax.devices()``) can never prevent a result line; retry
+    within the budget, then fall back to CPU — loudly and explicitly."""
+    budget = float(os.environ.get("CDT_BENCH_BUDGET_S", "2400"))
+    attempt_timeout = float(os.environ.get("CDT_BENCH_ATTEMPT_TIMEOUT_S", "1800"))
+    start = time.monotonic()
+    attempt = 0
+    last_err = None
+
+    def launch(extra_env: dict, timeout: float) -> tuple[int, str]:
+        tmp = tempfile.NamedTemporaryFile(
+            mode="r", suffix=".json", delete=False)
+        env = dict(os.environ, **extra_env)
+        cmd = [sys.executable, os.path.abspath(__file__), "--inner",
+               "--out", tmp.name, "--steps", str(cli.steps)]
+        if cli.runs:
+            cmd += ["--runs", str(cli.runs)]
+        try:
+            proc = subprocess.run(cmd, timeout=timeout,
+                                  capture_output=True, text=True)
+            err = (proc.stderr or "").strip().splitlines()
+            return proc.returncode, "\n".join(err[-5:])
+        except subprocess.TimeoutExpired:
+            return -1, f"attempt timed out after {timeout:.0f}s"
+        finally:
+            tmp_path = tmp.name
+            tmp.close()
+            # stash for the reader below
+            launch.last_tmp = tmp_path  # type: ignore[attr-defined]
+
+    def read_result() -> dict | None:
+        path = launch.last_tmp  # type: ignore[attr-defined]
+        try:
+            with open(path) as f:
+                return json.loads(f.read())
+        except (OSError, json.JSONDecodeError):
+            return None
+        finally:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+
+    while time.monotonic() - start < budget:
+        attempt += 1
+        remaining = budget - (time.monotonic() - start)
+        rc, err_tail = launch({}, min(attempt_timeout, max(60.0, remaining)))
+        result = read_result()          # also unlinks the temp file
+        if rc != 0:
+            result = None
+        if result and result.get("platform") not in (None, "cpu"):
+            result["tpu_attempted"] = True
+            result["tpu_error"] = None
+            _emit(result, cli.out)
+            return
+        if result:
+            # a machine with no accelerator at all resolves CPU instantly
+            # and deterministically — emit the CPU result we already hold
+            # instead of burning the budget re-running identical attempts
+            last_err = ("inner process silently fell back to CPU "
+                        f"(platform={result.get('platform')})")
+            print(f"[bench] WARNING: no accelerator available — "
+                  f"CPU toy result. {last_err}", file=sys.stderr)
+            result["tpu_attempted"] = True
+            result["tpu_error"] = last_err
+            _emit(result, cli.out)
+            return
+        last_err = err_tail or f"exit code {rc}"
+        print(f"[bench] accelerator attempt {attempt} failed: {last_err}",
+              file=sys.stderr)
+        time.sleep(15)
+
+    print(f"[bench] WARNING: no accelerator result after {attempt} attempts "
+          f"over {budget:.0f}s — CPU toy fallback. Last error: {last_err}",
+          file=sys.stderr)
+    rc, err_tail = launch({"JAX_PLATFORMS": "cpu"}, attempt_timeout)
+    result = read_result()
+    if rc != 0:
+        result = None
+    if result is None:
+        _emit({"metric": "benchmark_failed", "value": 0.0, "unit": "n/a",
+               "vs_baseline": 0.0, "tpu_attempted": True,
+               "tpu_error": last_err, "cpu_error": err_tail}, cli.out)
+        return
+    result["tpu_attempted"] = True
+    result["tpu_error"] = last_err
+    _emit(result, cli.out)
+
+
+def _emit(result: dict, out: str | None) -> None:
+    line = json.dumps(result)
+    if out:
+        with open(out, "w") as f:
+            f.write(line + "\n")
+    print(line)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--out", default=None,
+                        help="also write the JSON result to this path")
+    parser.add_argument("--steps", type=int, default=30)
+    parser.add_argument("--runs", type=int, default=None)
+    parser.add_argument("--inner", action="store_true",
+                        help="(internal) run the measurement in-process")
+    cli = parser.parse_args()
+
+    if cli.inner or os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        # explicit CPU (test harness) skips the watchdog
+        if os.environ.get("JAX_PLATFORMS", "") == "cpu" and not cli.inner:
+            result = run_benchmark(cli.steps, cli.runs, force_cpu=True)
+            result["tpu_attempted"] = False
+            result["tpu_error"] = "JAX_PLATFORMS=cpu requested explicitly"
+            _emit(result, cli.out)
+            return
+        _inner_main(cli)
+        return
+    _watchdog_main(cli)
 
 
 if __name__ == "__main__":

@@ -13,6 +13,16 @@ import sys
 
 
 def cmd_serve(args: argparse.Namespace) -> None:
+    from .parallel.bootstrap import init_multihost
+
+    # must precede any jax device query (backend freezes on first touch);
+    # no-op without a coordinator (single host)
+    init_multihost(
+        coordinator_address=getattr(args, "coordinator", None),
+        num_processes=getattr(args, "num_hosts", None),
+        process_id=getattr(args, "host_index", None),
+    )
+
     from .api.app import run_app
     from .cluster.controller import Controller
     from .utils.config import update_config
@@ -88,12 +98,32 @@ def cmd_convert(args: argparse.Namespace) -> None:
 
 
 def main(argv: list[str] | None = None) -> None:
+    import os
+
+    if os.environ.get("JAX_PLATFORMS"):
+        # the environment may pre-register an accelerator plugin and set
+        # jax_platforms programmatically, which overrides the env var —
+        # honor the operator's explicit request (e.g. CPU integration
+        # tests, or pinning "tpu" on a pod)
+        import jax
+
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
     p = argparse.ArgumentParser(prog="comfyui_distributed_tpu")
     sub = p.add_subparsers(dest="command", required=True)
 
     serve = sub.add_parser("serve", help="run a host controller")
     serve.add_argument("--host", default="0.0.0.0")
     serve.add_argument("--port", type=int, default=None)
+    serve.add_argument("--coordinator", default=None, metavar="HOST:PORT",
+                       help="multi-host: JAX coordinator address "
+                            "(env CDT_COORDINATOR)")
+    serve.add_argument("--num-hosts", type=int, default=None,
+                       help="multi-host: total host processes "
+                            "(env CDT_NUM_HOSTS)")
+    serve.add_argument("--host-index", type=int, default=None,
+                       help="multi-host: this host's process id "
+                            "(env CDT_HOST_INDEX)")
     serve.set_defaults(fn=cmd_serve)
 
     info = sub.add_parser("info", help="print system/device info")

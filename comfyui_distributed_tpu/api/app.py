@@ -144,9 +144,37 @@ def create_app(controller: Controller) -> web.Application:
                                       "node_errors": errors}, status=400)
         return web.json_response({"prompt_id": prompt_id, "node_errors": {}})
 
+    async def history(request):
+        """Status/outputs of a finished prompt (ComfyUI's /history is the
+        substrate surface the reference free-rides on; tensors are
+        summarized as shapes — images travel the collector/frames paths)."""
+        pid = request.match_info["prompt_id"]
+        entry = controller.queue.history.get(pid)
+        if entry is None:
+            return web.json_response({}, status=404)
+
+        def summarize(v):
+            arr = getattr(v, "shape", None)
+            if arr is not None and not isinstance(v, (int, float, bool)):
+                return {"shape": list(v.shape), "dtype": str(getattr(v, "dtype", ""))}
+            if isinstance(v, (dict, list, tuple)):
+                return str(type(v).__name__)
+            return v if isinstance(v, (int, float, str, bool, type(None))) else str(v)
+
+        return web.json_response({
+            "prompt_id": pid,
+            "status": entry.get("status"),
+            "error": entry.get("error"),
+            "outputs": {
+                node: [summarize(v) for v in (outs if isinstance(outs, (list, tuple)) else [outs])]
+                for node, outs in (entry.get("outputs") or {}).items()
+            },
+        })
+
     r.add_get("/distributed/health", health)
     r.add_get("/prompt", prompt_get)
     r.add_post("/prompt", prompt_post)
+    r.add_get("/distributed/history/{prompt_id}", history)
 
     # --- public queue API (reference api/job_routes.py:206-236) ------------
     async def distributed_queue(request):

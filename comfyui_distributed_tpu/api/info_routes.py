@@ -73,16 +73,20 @@ def register(router, controller) -> None:
         })
 
     async def local_log(request):
-        """Tail this controller's log file (reference serves an in-memory
-        buffer, ``api/worker_routes.py:348-390``; we tail the file the
-        launcher assigns via CDT_LOG_FILE)."""
+        """Tail this controller's log: the launcher-assigned file
+        (CDT_LOG_FILE) when present, else the in-memory rolling buffer
+        (reference serves the same buffer, ``api/worker_routes.py:348-390``)."""
         import os
 
+        from ..utils.logging import get_log_buffer
+
         log_file = os.environ.get("CDT_LOG_FILE", "")
-        if not log_file or not Path(log_file).is_file():
-            return web.json_response({"log": "", "available": False})
+        if log_file and Path(log_file).is_file():
+            return web.json_response(
+                {"log": tail_file(Path(log_file)), "available": True})
+        lines = get_log_buffer()
         return web.json_response(
-            {"log": tail_file(Path(log_file)), "available": True})
+            {"log": "\n".join(lines), "available": bool(lines)})
 
     router.add_get("/distributed/system_info", system_info)
     router.add_get("/distributed/network_info", network_info)

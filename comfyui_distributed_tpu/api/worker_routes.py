@@ -1,5 +1,7 @@
 """Worker process-management routes (parity: reference
-``api/worker_routes.py:432-695`` — launch/stop/list + log tailing)."""
+``api/worker_routes.py`` — launch/stop/list + log tailing ``:432-695``,
+launching-flag handshake ``:115-139``, local-worker status ``:523-603``,
+remote log proxy ``:649-695``, WebSocket dispatch channel ``:43-112``)."""
 
 from __future__ import annotations
 
@@ -7,9 +9,13 @@ import asyncio
 import json
 from pathlib import Path
 
+import aiohttp
 from aiohttp import web
 
+from ..utils import constants
 from ..utils.exceptions import ProcessError, ValidationError
+from ..utils.logging import debug_log
+from ..utils.network import build_host_url, get_client_session, probe_host
 from ..workers.process_manager import get_worker_manager
 from .info_routes import tail_file
 from .schemas import require_fields, validate_worker_id
@@ -63,7 +69,105 @@ def register(router, controller) -> None:
             return web.json_response({"log": "", "available": False})
         return web.json_response({"log": tail_file(path), "available": True})
 
+    async def clear_launching(request):
+        """Worker self-reports ready (reference ``:115-139``)."""
+        body = await _json(request)
+        require_fields(body, "worker_id")
+        wid = validate_worker_id(body["worker_id"])
+        cleared = manager().clear_launching(wid)
+        debug_log(f"worker {wid} reported ready (flag was "
+                  f"{'set' if cleared else 'not set'})")
+        return web.json_response({"status": "ok", "cleared": cleared})
+
+    async def local_worker_status(request):
+        """Per-worker online/queue/launching status for the dashboard
+        (reference ``:523-603``)."""
+        managed = manager().get_managed_workers()
+        hosts = {str(h.get("id")): h
+                 for h in controller.load_config().get("hosts", [])}
+        ids = sorted(set(managed) | {i for i, h in hosts.items()
+                                     if h.get("type") == "local"})
+        # bounded fan-out, same cap as the dispatch probe
+        # (cluster/dispatch.py select_active_hosts)
+        sem = asyncio.Semaphore(constants.WORKER_PROBE_CONCURRENCY)
+
+        async def status_one(wid: str) -> tuple[str, dict]:
+            entry: dict = {
+                "managed": wid in managed,
+                "launching": bool(managed.get(wid, {}).get("launching")),
+                "pid": managed.get(wid, {}).get("pid"),
+                "online": False,
+                "queue_remaining": None,
+            }
+            host = hosts.get(wid)
+            if host:
+                async with sem:
+                    health = await probe_host(host)
+                if health is not None:
+                    entry["online"] = True
+                    entry["queue_remaining"] = health.get("queue_remaining")
+            return wid, entry
+
+        results = await asyncio.gather(*(status_one(w) for w in ids))
+        return web.json_response({"workers": dict(results)})
+
+    async def remote_worker_log(request):
+        """Proxy a remote controller's in-memory/file log so the dashboard
+        can show it without direct reachability (reference ``:649-695``)."""
+        wid = request.match_info["worker_id"]
+        host = controller.host_by_id(wid)
+        if host is None:
+            return web.json_response(
+                {"error": f"no configured host {wid!r}"}, status=404)
+        url = build_host_url(host, "/distributed/local_log")
+        try:
+            session = get_client_session()
+            async with session.get(
+                url,
+                timeout=aiohttp.ClientTimeout(total=constants.PROBE_TIMEOUT * 2),
+            ) as resp:
+                body = await resp.json(content_type=None)
+                return web.json_response(body, status=resp.status)
+        except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
+            return web.json_response(
+                {"error": f"host {wid!r} unreachable: {e}"}, status=502)
+
+    async def worker_ws(request):
+        """WebSocket dispatch channel: the master connects here and sends
+        ``dispatch_prompt``; this controller queues the prompt locally and
+        replies ``dispatch_ack`` carrying the prompt id + validation errors
+        (reference ``api/worker_routes.py:43-112``)."""
+        ws = web.WebSocketResponse(heartbeat=constants.HEARTBEAT_INTERVAL)
+        await ws.prepare(request)
+        async for msg in ws:
+            if msg.type != aiohttp.WSMsgType.TEXT:
+                continue
+            try:
+                data = json.loads(msg.data)
+            except json.JSONDecodeError:
+                await ws.send_json({"type": "error", "error": "invalid JSON"})
+                continue
+            if data.get("type") != "dispatch_prompt":
+                await ws.send_json({"type": "error",
+                                    "error": f"unknown type {data.get('type')!r}"})
+                continue
+            prompt = data.get("prompt") or {}
+            prompt_id, node_errors = controller.queue.enqueue(
+                prompt, data.get("client_id", ""), data.get("trace_id"))
+            await ws.send_json({
+                "type": "dispatch_ack",
+                "request_id": data.get("request_id"),
+                "prompt_id": prompt_id,
+                "node_errors": node_errors,
+                "ok": not node_errors,
+            })
+        return ws
+
     router.add_post("/distributed/launch_worker", launch_worker)
     router.add_post("/distributed/stop_worker", stop_worker)
     router.add_get("/distributed/managed_workers", managed_workers)
     router.add_get("/distributed/worker_log/{worker_id}", worker_log)
+    router.add_post("/distributed/worker/clear_launching", clear_launching)
+    router.add_get("/distributed/local-worker-status", local_worker_status)
+    router.add_get("/distributed/remote_worker_log/{worker_id}", remote_worker_log)
+    router.add_get("/distributed/worker_ws", worker_ws)

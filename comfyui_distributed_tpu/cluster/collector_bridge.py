@@ -30,17 +30,24 @@ from ..utils.audio_payload import decode_audio, encode_audio
 from ..utils.exceptions import TileCollectionError, WorkerError
 from ..utils.image import decode_image_b64, encode_image_b64, to_uint8, from_uint8
 from ..utils.logging import debug_log, log
-from ..utils.network import get_client_session, normalize_host_url
+from ..utils.network import get_client_session, normalize_host_url, probe_host
 from .job_store import JobStore
 
 
 class CollectorBridge:
     """Bound to a controller's job store + event loop; node code calls the
-    sync methods from the executor thread."""
+    sync methods from the executor thread.
 
-    def __init__(self, store: JobStore, loop: asyncio.AbstractEventLoop):
+    ``host_resolver`` maps a worker id to its config host dict (or None);
+    when provided, the master-side drain loop probes silent workers on
+    timeout and extends the deadline while they are verifiably busy
+    (reference busy-probe grace, ``nodes/collector.py:414-470``)."""
+
+    def __init__(self, store: JobStore, loop: asyncio.AbstractEventLoop,
+                 host_resolver=None):
         self.store = store
         self.loop = loop
+        self.host_resolver = host_resolver
 
     # --- worker role -------------------------------------------------------
 
@@ -165,11 +172,19 @@ class CollectorBridge:
         # with envelopes still queued (same discipline as the reference's
         # drain loop, ``nodes/collector.py:381-499``).
         drained_done: set[str] = set()
+        grace_rounds = 0
 
         while not drained_done >= set(job.expected_workers):
             remaining = deadline - time.monotonic()
             if remaining <= 0:
                 missing = [w for w in job.expected_workers if w not in drained_done]
+                busy = await self._probe_busy(missing)
+                if busy and grace_rounds < constants.COLLECT_MAX_GRACE_ROUNDS:
+                    grace_rounds += 1
+                    deadline = time.monotonic() + constants.COLLECT_GRACE_S
+                    log(f"collector[{job_id}] workers {busy} still busy; "
+                        f"extending deadline (grace {grace_rounds})")
+                    continue
                 log(f"collector[{job_id}] timed out waiting for {missing}")
                 break
             try:
@@ -198,6 +213,21 @@ class CollectorBridge:
         audio = self._combine_audio(local_audio, audio_parts, job.expected_workers)
         await self.store.cleanup_job(job_id)
         return images, audio
+
+    async def _probe_busy(self, missing: Sequence[str]) -> list[str]:
+        """Probe silent workers' health; return those with work still
+        queued/executing. A dead host (probe None) or an idle one gets no
+        grace — only a verifiably busy worker extends the drain deadline."""
+        if self.host_resolver is None or not missing:
+            return []
+        resolvable = [(w, self.host_resolver(w)) for w in missing]
+        resolvable = [(w, h) for w, h in resolvable if h]
+        statuses = await asyncio.gather(
+            *(probe_host(h) for _, h in resolvable))
+        return [
+            w for (w, _), status in zip(resolvable, statuses)
+            if status and int(status.get("queue_remaining", 0) or 0) > 0
+        ]
 
     @staticmethod
     def _combine_images(local_images, per_worker, expected: Sequence[str],

@@ -49,6 +49,13 @@ class Controller:
     def load_config(self) -> dict:
         return load_config(self.config_path)
 
+    def host_by_id(self, host_id: str) -> Optional[dict]:
+        """Config host entry for a worker/host id (busy-probe resolver)."""
+        for h in self.load_config().get("hosts", []):
+            if str(h.get("id")) == str(host_id):
+                return h
+        return None
+
     # --- lazily-built heavyweight state ------------------------------------
 
     @property
@@ -97,11 +104,37 @@ class Controller:
         from .tile_farm import TileFarm
 
         self.loop = asyncio.get_running_loop()
-        self.bridge = CollectorBridge(self.store, self.loop)
+        self.bridge = CollectorBridge(self.store, self.loop,
+                                      host_resolver=self.host_by_id)
         self.tile_farm = TileFarm(self.store, self.loop)
         self.queue.start()
         role = "worker" if self.is_worker else "master"
         log(f"controller up as {role} (machine {machine_id()})")
+        if self.is_worker and self.worker_id:
+            # self-report ready → master clears this worker's launching
+            # flag (reference handshake, api/worker_routes.py:115-139);
+            # reference kept so the task can't be GC'd before running
+            self._ready_task = asyncio.ensure_future(self._report_ready())
+
+    async def _report_ready(self) -> None:
+        import aiohttp
+
+        from ..utils.network import get_client_session
+
+        master_port = os.environ.get("CDT_MASTER_PORT", "")
+        if not master_port:
+            return
+        url = (f"http://127.0.0.1:{master_port}"
+               "/distributed/worker/clear_launching")
+        try:
+            session = get_client_session()
+            async with session.post(
+                url, json={"worker_id": self.worker_id},
+                timeout=aiohttp.ClientTimeout(total=constants.PROBE_TIMEOUT),
+            ) as resp:
+                await resp.read()
+        except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
+            pass                       # master gone or standalone worker
 
     async def shutdown(self) -> None:
         from ..utils.network import close_client_session

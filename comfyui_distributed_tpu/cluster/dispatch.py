@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import asyncio
 import itertools
+import json
 from typing import Any, Optional, Sequence
 
 import aiohttp
@@ -65,19 +66,92 @@ def select_least_busy_host(online_hosts: Sequence[dict]) -> Optional[dict]:
     return min(online_hosts, key=queue_depth)
 
 
-async def dispatch_prompt(
+async def dispatch_prompt_ws(
     host: dict[str, Any],
     prompt: dict,
     client_id: str = "",
     extra: dict | None = None,
     trace_id: str | None = None,
 ) -> dict:
+    """Dispatch over the WebSocket channel: connect to the host's
+    ``/distributed/worker_ws``, send ``dispatch_prompt``, await the
+    ``dispatch_ack`` (reference ``_dispatch_via_websocket``,
+    ``dispatch.py:62-95``). Validation errors in the ack raise
+    ``WorkerError`` exactly like the HTTP path."""
+    from ..utils.exceptions import WorkerError
+
+    url = build_host_url(host, "/distributed/worker_ws")
+    session = get_client_session()
+    try:
+        ws_ctx = session.ws_connect(url)
+        ws = await ws_ctx.__aenter__()
+    except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
+        # connection never opened — the prompt cannot have been delivered,
+        # so the caller may safely retry over HTTP
+        err = WorkerError(
+            f"ws dispatch to {host.get('id')} unreachable: {e}",
+            worker_id=host.get("id"))
+        err.ws_undelivered = True
+        raise err from e
+    try:
+        await ws.send_json({
+            "type": "dispatch_prompt",
+            "prompt": prompt,
+            "client_id": client_id,
+            **(extra or {}),
+        })
+        msg = await ws.receive(timeout=constants.DISPATCH_TIMEOUT)
+        if msg.type != aiohttp.WSMsgType.TEXT:
+            # the send may have been delivered even though the ack never
+            # arrived — retrying over HTTP could double-enqueue; fail hard
+            raise WorkerError(
+                f"ws dispatch to {host.get('id')}: connection closed "
+                f"before ack ({msg.type})", worker_id=host.get("id"))
+        ack = json.loads(msg.data)
+        if ack.get("type") != "dispatch_ack" or not ack.get("ok", False):
+            raise WorkerError(
+                f"ws dispatch to {host.get('id')} rejected: "
+                f"{ack.get('node_errors') or ack.get('error')}",
+                worker_id=host.get("id"))
+        trace_info(trace_id, f"dispatched to {host.get('id')} (ws)")
+        return ack
+    except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
+        raise WorkerError(
+            f"ws dispatch to {host.get('id')} failed after connect: {e}",
+            worker_id=host.get("id"),
+        ) from e
+    finally:
+        await ws_ctx.__aexit__(None, None, None)
+
+
+async def dispatch_prompt(
+    host: dict[str, Any],
+    prompt: dict,
+    client_id: str = "",
+    extra: dict | None = None,
+    trace_id: str | None = None,
+    via_ws: bool = False,
+) -> dict:
     """POST the prompt to a host's queue endpoint; returns its response.
 
     Raises ``WorkerError`` with the remote validation errors on 4xx
     (reference propagates node_errors the same way, ``dispatch.py:98-141``).
+    With ``via_ws`` (settings.websocket_orchestration) the WebSocket channel
+    is tried first; transport errors fall back to HTTP so enabling the
+    setting can't strand a cluster whose peers lack the WS route.
     """
     from ..utils.exceptions import WorkerError
+
+    if via_ws:
+        try:
+            return await dispatch_prompt_ws(host, prompt, client_id, extra,
+                                            trace_id)
+        except WorkerError as e:
+            if not getattr(e, "ws_undelivered", False):
+                # the prompt may already sit in the worker's queue (lost
+                # ack ≠ lost dispatch) — an HTTP retry would double-run it
+                raise
+            debug_log(f"ws connect failed ({e}); falling back to HTTP")
 
     url = build_host_url(host, "/prompt")
     payload = {"prompt": prompt, "client_id": client_id, **(extra or {})}

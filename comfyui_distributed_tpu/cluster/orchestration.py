@@ -162,9 +162,11 @@ class Orchestrator:
                         return wid, (f"media sync failed for "
                                      f"{sync_report.failed}")
                 try:
-                    await dispatch_prompt(host, wprompt, client_id,
-                                          extra={"trace_id": trace_id},
-                                          trace_id=trace_id)
+                    await dispatch_prompt(
+                        host, wprompt, client_id,
+                        extra={"trace_id": trace_id}, trace_id=trace_id,
+                        via_ws=bool(config.get("settings", {}).get(
+                            "websocket_orchestration")))
                     return wid, None
                 except WorkerError as e:
                     return wid, str(e)

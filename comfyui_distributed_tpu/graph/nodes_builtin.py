@@ -248,6 +248,7 @@ class UltimateSDUpscaleDistributed(NodeDef):
     OPTIONAL = {
         "tile_width": "INT", "tile_height": "INT", "tile_padding": "INT",
         "cfg": "FLOAT", "sampler_name": "STRING", "scheduler": "STRING",
+        "spatial_cond": "MASK",
     }
     HIDDEN = {
         "mesh": "*", "multi_job_id": "STRING", "is_worker": "BOOLEAN",
@@ -261,10 +262,10 @@ class UltimateSDUpscaleDistributed(NodeDef):
                 denoise: float, upscale_by: float, tile_width: int = 512,
                 tile_height: int = 512, tile_padding: int = 32,
                 cfg: float = 5.0, sampler_name: str = "euler",
-                scheduler: str = "karras", mesh=None, multi_job_id: str = "",
-                is_worker: bool = False, worker_id: str = "",
-                master_url: str = "", enabled_worker_ids=(), tile_farm=None,
-                **_):
+                scheduler: str = "karras", spatial_cond=None, mesh=None,
+                multi_job_id: str = "", is_worker: bool = False,
+                worker_id: str = "", master_url: str = "",
+                enabled_worker_ids=(), tile_farm=None, **_):
         from ..parallel.mesh import build_mesh
         from ..tiles.engine import TileUpscaler, UpscaleSpec
 
@@ -287,10 +288,18 @@ class UltimateSDUpscaleDistributed(NodeDef):
         # nodes/distributed_upscale.py:230-267; on-pod SPMD otherwise)
         farm_active = (tile_farm is not None and multi_job_id
                        and (is_worker or enabled_worker_ids))
+        smap = None
+        if spatial_cond is not None:
+            # MASK convention [B,H,W] → [B,H,W,1]; cropped per tile inside
+            # the engine (reference crop_cond, usdu_utils.py:506)
+            smap = jnp.asarray(spatial_cond, jnp.float32)
+            if smap.ndim == 3:
+                smap = smap[..., None]
         if not farm_active:
             out = upscaler.upscale(
                 mesh, jnp.asarray(image), spec, int(seed),
                 positive["context"], negative["context"], y, uy,
+                spatial_cond=smap,
             )
             return (out,)
 
@@ -300,6 +309,7 @@ class UltimateSDUpscaleDistributed(NodeDef):
             plan = upscaler.range_plan(
                 mesh, images[b], spec, int(seed),
                 positive["context"], negative["context"], y, uy,
+                spatial_cond=None if smap is None else smap[b],
             )
             job_id = (f"{multi_job_id}_b{b}" if images.shape[0] > 1
                       else multi_job_id)
@@ -370,14 +380,25 @@ class CLIPTextEncode(NodeDef):
 @register_node("EmptyLatentImage")
 class EmptyLatentImage(NodeDef):
     INPUTS = {"width": "INT", "height": "INT"}
-    OPTIONAL = {"batch_size": "INT"}
+    OPTIONAL = {"batch_size": "INT", "ckpt_name": "STRING"}
     RETURNS = ("LATENT",)
 
-    def execute(self, width: int, height: int, batch_size: int = 1, **_):
-        # latent downscale fixed at 8 for SD-family; tiny VAE uses 2 but
-        # TPUTxt2Img derives sizes from the model, not from this node
-        return ({"samples": jnp.zeros((int(batch_size), int(height) // 8,
-                                       int(width) // 8, 4), jnp.float32),
+    def execute(self, width: int, height: int, batch_size: int = 1,
+                ckpt_name: str = "", **_):
+        # latent geometry follows the model preset (flux/wan latents are
+        # 16-channel; the tiny test VAE downscales 2×, not 8×); SD-family
+        # 8×/4ch is the default for preset-less graphs
+        downscale, channels = 8, 4
+        if ckpt_name:
+            from ..models.registry import PRESETS
+
+            preset = PRESETS.get(str(ckpt_name))
+            if preset is not None:
+                downscale = preset.vae.downscale
+                channels = preset.vae.latent_channels
+        return ({"samples": jnp.zeros(
+                    (int(batch_size), int(height) // downscale,
+                     int(width) // downscale, channels), jnp.float32),
                  "height": int(height), "width": int(width)},)
 
 

@@ -152,7 +152,7 @@ class CLIPTextModel:
 
     def init(self, rng: jax.Array) -> "CLIPTextModel":
         toks = jnp.zeros((1, self.config.max_len), jnp.int32)
-        self.params = self.module.init(rng, toks)
+        self.params = jax.jit(self.module.init)(rng, toks)
         return self
 
     def __call__(self, tokens: jax.Array) -> dict[str, jax.Array]:

@@ -280,5 +280,5 @@ def init_dit(config: DiTConfig, rng: jax.Array,
     t = jnp.zeros((1,))
     ctx = jnp.zeros((1, context_len, config.context_dim))
     pooled = jnp.zeros((1, config.pooled_dim))
-    params = model.init(rng, x, t, ctx, pooled)
+    params = jax.jit(model.init)(rng, x, t, ctx, pooled)
     return model, params

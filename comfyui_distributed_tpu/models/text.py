@@ -102,7 +102,7 @@ class TextEncoder:
 
     def init(self, rng: jax.Array) -> "TextEncoder":
         tokens = jnp.zeros((1, self.config.max_len), jnp.int32)
-        self.params = self.module.init(rng, tokens)
+        self.params = jax.jit(self.module.init)(rng, tokens)
         return self
 
     def tokenize(self, texts: Sequence[str]) -> jax.Array:

@@ -178,8 +178,9 @@ class AutoencoderKL:
         k1, k2 = jax.random.split(rng)
         img = jnp.zeros((1, H, W, cfg.in_channels))
         lat = jnp.zeros((1, H // cfg.downscale, W // cfg.downscale, cfg.latent_channels))
-        self.enc_params = self.encoder.init(k1, img)
-        self.dec_params = self.decoder.init(k2, lat)
+        # jitted: one compiled init program instead of per-op eager dispatch
+        self.enc_params = jax.jit(self.encoder.init)(k1, img)
+        self.dec_params = jax.jit(self.decoder.init)(k2, lat)
         return self
 
     def encode(self, images: jax.Array) -> jax.Array:

@@ -169,7 +169,7 @@ def init_video_dit(config: VideoDiTConfig, rng: jax.Array,
     model = VideoDiT(config)
     f, h, w = sample_fhw
     x = jnp.zeros((1, f, h, w, config.in_channels))
-    params = model.init(rng, x, jnp.zeros((1,)),
-                        jnp.zeros((1, context_len, config.context_dim)),
-                        jnp.zeros((1, config.pooled_dim)))
+    params = jax.jit(model.init)(rng, x, jnp.zeros((1,)),
+                                 jnp.zeros((1, context_len, config.context_dim)),
+                                 jnp.zeros((1, config.pooled_dim)))
     return model, params

@@ -1,0 +1,92 @@
+"""Multi-host JAX runtime bootstrap.
+
+The reference scales across machines by HTTP port registration: every
+worker is a separately-launched ComfyUI process that the master reaches
+over the network (``workers/process/lifecycle.py:78-96``, config hosts).
+On TPU the runtime-level membership is JAX's distributed runtime instead:
+one coordinator, N host processes, after which ``jax.devices()`` returns
+the GLOBAL device list and a single ``Mesh`` spans hosts — collectives
+ride ICI within a slice and DCN across slices (SURVEY §5.8). The HTTP
+control plane stays for orchestration/UI exactly like the reference's.
+
+Deployment flow (see ``docs/deployment.md``):
+
+    # host 0 (coordinator)
+    python -m comfyui_distributed_tpu serve \
+        --coordinator host0:9911 --num-hosts 4 --host-index 0
+    # hosts 1..3
+    python -m comfyui_distributed_tpu serve \
+        --coordinator host0:9911 --num-hosts 4 --host-index i
+
+Env-var equivalents (for k8s/pod launchers that template manifests):
+``CDT_COORDINATOR``, ``CDT_NUM_HOSTS``, ``CDT_HOST_INDEX``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Optional
+
+from ..utils.logging import log
+
+_initialized = False
+
+
+def multihost_env() -> dict:
+    """The multi-host settings resolved from env (CLI flags override)."""
+    return {
+        "coordinator_address": os.environ.get("CDT_COORDINATOR") or None,
+        "num_processes": int(os.environ["CDT_NUM_HOSTS"])
+        if os.environ.get("CDT_NUM_HOSTS") else None,
+        "process_id": int(os.environ["CDT_HOST_INDEX"])
+        if os.environ.get("CDT_HOST_INDEX") else None,
+    }
+
+
+def init_multihost(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+    initialize_fn: Optional[Callable] = None,
+) -> bool:
+    """Initialize JAX's distributed runtime when a coordinator is given.
+
+    Arguments fall back to ``CDT_COORDINATOR`` / ``CDT_NUM_HOSTS`` /
+    ``CDT_HOST_INDEX``. Returns True when the runtime was initialized,
+    False for the single-host no-op. Must run before the first device
+    query — JAX's backend is frozen once touched.
+
+    ``initialize_fn`` exists for tests (the real
+    ``jax.distributed.initialize`` wants a live coordinator).
+    """
+    global _initialized
+    env = multihost_env()
+    coordinator_address = coordinator_address or env["coordinator_address"]
+    if not coordinator_address:
+        return False
+    if _initialized:
+        log("multi-host runtime already initialized; skipping")
+        return True
+    num_processes = num_processes if num_processes is not None else env["num_processes"]
+    process_id = process_id if process_id is not None else env["process_id"]
+    if num_processes is None or process_id is None:
+        raise ValueError(
+            "multi-host bootstrap needs --num-hosts and --host-index "
+            "(or CDT_NUM_HOSTS / CDT_HOST_INDEX) alongside the coordinator")
+    if not (0 <= process_id < num_processes):
+        raise ValueError(
+            f"host index {process_id} out of range for {num_processes} hosts")
+
+    if initialize_fn is None:                      # pragma: no cover - needs pod
+        import jax
+
+        initialize_fn = jax.distributed.initialize
+    log(f"initializing multi-host runtime: coordinator={coordinator_address} "
+        f"hosts={num_processes} index={process_id}")
+    initialize_fn(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    _initialized = True
+    return True

@@ -70,13 +70,20 @@ class TileUpscaler:
         return compute_tile_grid(out_w, out_h, spec.tile_w, spec.tile_h, spec.padding)
 
     def _img2img_tiles(self, tiles, key, context, uncond_context, y, uncond_y,
-                       spec: UpscaleSpec, sigmas, global_idx):
+                       spec: UpscaleSpec, sigmas, global_idx,
+                       tile_masks=None):
         """img2img a [n, ch, cw, C] tile batch on one shard.
 
         Per-tile noise keys fold in the *global* tile index, so the output
         for tile i never depends on which shard processed it — the property
         that lets host-level requeue re-shard freely (reference analogue:
         tiles carry global IDs through the queue, ``upscale/job_store.py``).
+
+        ``tile_masks`` ([n, ch, cw, 1], optional) is this shard's slice of
+        the spatial conditioning map, already cropped per tile with the
+        same grid as the image — the engine's analogue of the reference's
+        per-tile conditioning crop (``utils/usdu_utils.py`` ``crop_cond``
+        at ``:506``): mask 1 = denoise, 0 = keep the source pixels.
         """
         pipe = self.pipeline
         vae = pipe.vae
@@ -104,12 +111,23 @@ class TileUpscaler:
         x0 = sample(gspec.sampler, denoise_fn, noised, sigmas,
                     key=jax.random.fold_in(key, jnp.uint32(0xFFFFFFFF)))
         out = vae.decode(x0)
-        return jnp.clip(out / 2.0 + 0.5, 0.0, 1.0)
+        out = jnp.clip(out / 2.0 + 0.5, 0.0, 1.0)
+        if tile_masks is not None:
+            out = tiles * (1.0 - tile_masks) + out * tile_masks
+        return out
 
     def upscale_fn(self, mesh: Mesh, image_hw: tuple[int, int], spec: UpscaleSpec,
-                   batch: int = 1, axis: str = constants.AXIS_DATA):
-        """Compile the full upscale: (images, key, ctx, unc, y, unc_y) →
-        upscaled images [B, H·s, W·s, C]."""
+                   batch: int = 1, axis: str = constants.AXIS_DATA,
+                   with_spatial: bool = False):
+        """Compile the full upscale: (images, key, ctx, unc, y, unc_y
+        [, spatial]) → upscaled images [B, H·s, W·s, C].
+
+        With ``with_spatial`` the last argument is a spatial conditioning
+        map [B, H·s, W·s, 1] (denoise mask: 1 = regenerate, 0 = keep). It
+        is cropped per tile with the image's own grid — seam-free region
+        control matching the reference's conditioning-crop semantics
+        (``utils/usdu_utils.py:506``, ``utils/crop_model_patch.py:9-114``).
+        """
         H, W = image_hw
         grid = self.grid_for(H, W, spec)
         n_shards = mesh.shape[axis]
@@ -120,7 +138,7 @@ class TileUpscaler:
         masks = feather_mask(grid, spec.feather)
         has_y = self.pipeline.unet.config.adm_in_channels > 0
 
-        def process_shard(tiles, key, context, uncond_context, y, uncond_y):
+        def process_shard(tiles, stiles, key, context, uncond_context, y, uncond_y):
             # tiles: [per_shard, ch, cw, C] block of this shard
             shard_i = jax.lax.axis_index(axis)
             global_idx = shard_i * per_shard + jnp.arange(per_shard)
@@ -128,25 +146,39 @@ class TileUpscaler:
                 tiles, key, context, uncond_context,
                 y if has_y else None, uncond_y if has_y else None,
                 spec, sigmas, global_idx,
+                tile_masks=stiles if with_spatial else None,
             )
 
         sharded = jax.shard_map(
             process_shard,
             mesh=mesh,
-            in_specs=(P(axis, None, None, None), P(), P(None, None, None),
+            in_specs=(P(axis, None, None, None), P(axis, None, None, None),
+                      P(), P(None, None, None),
                       P(None, None, None), P(None, None), P(None, None)),
             out_specs=P(axis, None, None, None),
         )
 
-        def run(images, key, context, uncond_context, y, uncond_y):
-            up = upscale_image(images, spec.scale, spec.resize_method)
-            all_tiles = jnp.concatenate(
-                [extract_tiles(up[b], grid) for b in range(batch)], axis=0
-            )
+        def tile_and_pad(per_image_fn, arrs):
+            stacked = jnp.concatenate(
+                [per_image_fn(a) for a in arrs], axis=0)
             if padded > total:
-                pad = jnp.zeros((padded - total,) + all_tiles.shape[1:], all_tiles.dtype)
-                all_tiles = jnp.concatenate([all_tiles, pad], axis=0)
-            done = sharded(all_tiles, key, context, uncond_context, y, uncond_y)
+                pad = jnp.zeros((padded - total,) + stacked.shape[1:],
+                                stacked.dtype)
+                stacked = jnp.concatenate([stacked, pad], axis=0)
+            return stacked
+
+        def run(images, key, context, uncond_context, y, uncond_y,
+                spatial=None):
+            up = upscale_image(images, spec.scale, spec.resize_method)
+            all_tiles = tile_and_pad(lambda im: extract_tiles(im, grid),
+                                     [up[b] for b in range(batch)])
+            if with_spatial:
+                stiles = tile_and_pad(lambda m: extract_tiles(m, grid),
+                                      [spatial[b] for b in range(batch)])
+            else:
+                stiles = jnp.ones(all_tiles.shape[:3] + (1,), all_tiles.dtype)
+            done = sharded(all_tiles, stiles, key, context, uncond_context,
+                           y, uncond_y)
             done = done[:total]
             outs = [
                 composite_tiles(
@@ -169,15 +201,28 @@ class TileUpscaler:
         y: Optional[jax.Array] = None,
         uncond_y: Optional[jax.Array] = None,
         axis: str = constants.AXIS_DATA,
+        spatial_cond: Optional[jax.Array] = None,
     ) -> jax.Array:
+        """``spatial_cond``: [B, H, W, 1] (input res) or [B, H·s, W·s, 1]
+        (output res) region mask, cropped per tile inside the program."""
         B, H, W, _ = images.shape
-        fn = self.upscale_fn(mesh, (H, W), spec, batch=B, axis=axis)
+        fn = self.upscale_fn(mesh, (H, W), spec, batch=B, axis=axis,
+                             with_spatial=spatial_cond is not None)
         adm = self.pipeline.unet.config.adm_in_channels
         if y is None:
             y = jnp.zeros((1, max(adm, 1)), jnp.float32)
         if uncond_y is None:
             uncond_y = jnp.zeros_like(y)
-        return fn(images, jax.random.key(seed), context, uncond_context, y, uncond_y)
+        args = (images, jax.random.key(seed), context, uncond_context, y, uncond_y)
+        if spatial_cond is None:
+            return fn(*args)
+        grid = self.grid_for(H, W, spec)
+        if spatial_cond.shape[1:3] != (grid.image_h, grid.image_w):
+            spatial_cond = jax.image.resize(
+                spatial_cond.astype(jnp.float32),
+                (B, grid.image_h, grid.image_w, spatial_cond.shape[-1]),
+                method="bilinear")
+        return fn(*args, spatial_cond)
 
     # --- cross-host farm support -------------------------------------------
 
@@ -192,6 +237,7 @@ class TileUpscaler:
         y: Optional[jax.Array] = None,
         uncond_y: Optional[jax.Array] = None,
         axis: str = constants.AXIS_DATA,
+        spatial_cond: Optional[jax.Array] = None,
     ) -> "TileRangePlan":
         """Prepare arbitrary-range tile processing for the cross-host farm
         (``cluster/tile_farm.py``): resize + extract all crops once, and
@@ -223,20 +269,36 @@ class TileUpscaler:
             return extract_tiles(up, grid)
 
         all_tiles = prepare(image)              # [T, ch, cw, C]
+        use_spatial = spatial_cond is not None
+        if use_spatial:
+            # same per-tile crop as the image (reference crop_cond
+            # semantics, usdu_utils.py:506), resized to the output grid
+            smap = jnp.asarray(spatial_cond, jnp.float32)
+            if smap.ndim == 2:
+                smap = smap[..., None]
+            if smap.shape[:2] != (grid.image_h, grid.image_w):
+                smap = jax.image.resize(
+                    smap, (grid.image_h, grid.image_w, smap.shape[-1]),
+                    method="bilinear")
+            all_stiles = extract_tiles(smap, grid)
+        else:
+            all_stiles = jnp.ones(all_tiles.shape[:3] + (1,), all_tiles.dtype)
 
-        def process_shard(tiles, start, key, ctx, unc, yy, uyy):
+        def process_shard(tiles, stiles, start, key, ctx, unc, yy, uyy):
             shard_i = jax.lax.axis_index(axis)
             global_idx = start + shard_i * per_shard + jnp.arange(per_shard)
             return self._img2img_tiles(
                 tiles, key, ctx, unc,
                 yy if has_y else None, uyy if has_y else None,
                 spec, sigmas, global_idx,
+                tile_masks=stiles if use_spatial else None,
             )
 
         sharded = jax.jit(jax.shard_map(
             process_shard,
             mesh=mesh,
-            in_specs=(P(axis, None, None, None), P(), P(), P(None, None, None),
+            in_specs=(P(axis, None, None, None), P(axis, None, None, None),
+                      P(), P(), P(None, None, None),
                       P(None, None, None), P(None, None), P(None, None)),
             out_specs=P(axis, None, None, None),
         ))
@@ -246,12 +308,16 @@ class TileUpscaler:
             import numpy as np
 
             seg = all_tiles[start:end]
+            sseg = all_stiles[start:end]
             if seg.shape[0] < chunk:
                 pad = jnp.zeros((chunk - seg.shape[0],) + seg.shape[1:],
                                 seg.dtype)
                 seg = jnp.concatenate([seg, pad], axis=0)
-            out = sharded(seg, jnp.int32(start), key, context, uncond_context,
-                          y, uncond_y)
+                spad = jnp.ones((chunk - sseg.shape[0],) + sseg.shape[1:],
+                                sseg.dtype)
+                sseg = jnp.concatenate([sseg, spad], axis=0)
+            out = sharded(seg, sseg, jnp.int32(start), key, context,
+                          uncond_context, y, uncond_y)
             return np.asarray(out[: end - start])
 
         return TileRangePlan(grid=grid, chunk=chunk, run_range=run_range,

@@ -50,6 +50,12 @@ PROBE_TIMEOUT = _env_float("CDT_PROBE_TIMEOUT", 5.0)
 DISPATCH_TIMEOUT = _env_float("CDT_DISPATCH_TIMEOUT", 30.0)
 MEDIA_SYNC_TIMEOUT = _env_float("CDT_MEDIA_SYNC_TIMEOUT", 120.0)
 COLLECT_POLL_TIMEOUT = _env_float("CDT_COLLECT_POLL_TIMEOUT", 5.0)
+# On collector drain timeout, silent-but-busy workers are granted grace
+# extensions of COLLECT_GRACE_S each, at most COLLECT_MAX_GRACE_ROUNDS times
+# (reference probes /prompt and extends while queue_remaining>0,
+# nodes/collector.py:414-470).
+COLLECT_GRACE_S = _env_float("CDT_COLLECT_GRACE_S", 30.0)
+COLLECT_MAX_GRACE_ROUNDS = _env_int("CDT_COLLECT_MAX_GRACE_ROUNDS", 20)
 JOB_INIT_GRACE = _env_float("CDT_JOB_INIT_GRACE", 10.0)
 WORK_REQUEST_BUDGET = _env_float("CDT_WORK_REQUEST_BUDGET", 30.0)
 

@@ -8,6 +8,7 @@ config-gated ``debug_log`` whose gate is cached with a short TTL) and
 
 from __future__ import annotations
 
+import collections
 import os
 import secrets
 import sys
@@ -15,6 +16,17 @@ import time
 from typing import Callable
 
 _PREFIX = "[Distributed-TPU]"
+
+# Rolling in-memory buffer of recent log lines, served by
+# /distributed/local_log and proxied cross-host by
+# /distributed/remote_worker_log (reference keeps the same rolling buffer
+# on app.logger, api/worker_routes.py:348-390).
+_BUFFER_LINES = 400
+_log_buffer: collections.deque[str] = collections.deque(maxlen=_BUFFER_LINES)
+
+
+def get_log_buffer() -> list[str]:
+    return list(_log_buffer)
 
 # TTL cache of the debug gate so hot loops don't re-read config every call
 # (reference utils/logging.py:15-39 uses a 5 s TTL for the same reason).
@@ -48,7 +60,9 @@ def _debug_enabled() -> bool:
 
 
 def log(msg: str) -> None:
-    print(f"{_PREFIX} {msg}", file=sys.stderr, flush=True)
+    line = f"{_PREFIX} {msg}"
+    _log_buffer.append(f"{time.strftime('%H:%M:%S')} {line}")
+    print(line, file=sys.stderr, flush=True)
 
 
 def debug_log(msg: str) -> None:

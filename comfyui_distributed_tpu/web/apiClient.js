@@ -73,7 +73,10 @@ export const api = {
   stopWorker: (workerId) => request("/distributed/stop_worker", { method: "POST", body: { worker_id: workerId }, retries: 0 }),
   managedWorkers: () => request("/distributed/managed_workers"),
   workerLog: (workerId) => request(`/distributed/worker_log/${encodeURIComponent(workerId)}`),
+  remoteWorkerLog: (workerId) => request(`/distributed/remote_worker_log/${encodeURIComponent(workerId)}`),
   localLog: () => request("/distributed/local_log"),
+  localWorkerStatus: () => request("/distributed/local-worker-status"),
+  clearLaunching: (workerId) => request("/distributed/worker/clear_launching", { method: "POST", body: { worker_id: workerId } }),
 
   // tunnel
   tunnelStatus: () => request("/distributed/tunnel/status"),

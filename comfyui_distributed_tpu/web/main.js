@@ -72,7 +72,11 @@ function workerCard(worker) {
       renderWorkers();
     });
     mkBtn("Log", "small ghost", () => openLog(worker.id));
-  } else if ((worker.type || "local") !== "remote") {
+  } else if ((worker.type || "local") === "remote") {
+    // remote controller: proxy its in-memory log through the master
+    // (reference remote_worker_log, api/worker_routes.py:649-695)
+    mkBtn("Log", "small ghost", () => openLog(worker.id, true));
+  } else {
     mkBtn("Launch", "small ghost", async (ev) => {
       ev.target.disabled = true;
       state.status.set(worker.id, { ...st, launching: true });
@@ -114,9 +118,26 @@ function renderWorkers() {
 
 async function pollStatus() {
   const hosts = (state.config && state.config.hosts) || [];
+  // server-side launching-state machine: flags set at launch, cleared by
+  // the worker's clear_launching self-report (reference workerLifecycle.js
+  // launching-flag tracking)
+  let serverStatus = {};
+  try {
+    serverStatus = (await api.localWorkerStatus()).workers || {};
+  } catch { /* older controller: browser probes only */ }
   await Promise.all(hosts.map(async (w) => {
-    const health = await probeHost(w.address);
     const prev = state.status.get(w.id) || {};
+    const srv = serverStatus[w.id];
+    if (srv && srv.online !== undefined && w.id in serverStatus) {
+      // server already probed this (local/managed) host — don't probe twice
+      state.status.set(w.id, {
+        online: !!srv.online,
+        queue_remaining: srv.queue_remaining,
+        launching: srv.launching || (prev.launching && !srv.online),
+      });
+      return;
+    }
+    const health = await probeHost(w.address);
     state.status.set(w.id, {
       online: !!health,
       queue_remaining: health ? health.queue_remaining : null,
@@ -244,20 +265,22 @@ async function submitQueue(ev) {
 // log modal (parity: workerLifecycle.js log modal, 2s auto-refresh)
 // ---------------------------------------------------------------------------
 
-async function fetchLog(workerId) {
+async function fetchLog(workerId, remote) {
   const res = workerId === "__local__" ? await api.localLog()
+    : remote ? await api.remoteWorkerLog(workerId)
     : await api.workerLog(workerId);
   return res.log || res.raw || "";
 }
 
-function openLog(workerId) {
+function openLog(workerId, remote = false) {
   $("log-title").textContent = workerId === "__local__"
-    ? "Controller log" : `Worker ${workerId} log`;
+    ? "Controller log"
+    : `Worker ${workerId} log${remote ? " (remote)" : ""}`;
   $("modal-backdrop").hidden = false;
   const body = $("log-body");
   const refresh = async () => {
     try {
-      body.textContent = await fetchLog(workerId);
+      body.textContent = await fetchLog(workerId, remote);
       if ($("log-follow").checked) body.scrollTop = body.scrollHeight;
     } catch (e) {
       body.textContent = "log unavailable: " + e.message;

@@ -20,10 +20,19 @@ from ..utils.process import is_process_alive
 from .lifecycle import ManagedProcess, kill_process_tree, launch_worker_process
 
 
+# A worker that never self-reports ready (crash during boot) must not pin
+# the launching flag forever; the dashboard falls back to the probe result.
+LAUNCHING_FLAG_TTL = 180.0
+
+
 class WorkerProcessManager:
     def __init__(self, config_path: Optional[Path] = None):
         self.config_path = config_path
         self._managed: dict[str, ManagedProcess] = {}
+        # launching-state machine (reference: flag set at launch,
+        # lifecycle.py:106; cleared by the worker's self-report through
+        # POST /distributed/worker/clear_launching, api/worker_routes.py:115-139)
+        self._launching: dict[str, float] = {}
         self._restore_persisted()
 
     # --- persistence (reference persistence.py:11-48) ----------------------
@@ -69,11 +78,15 @@ class WorkerProcessManager:
             use_watchdog=stop_on_exit,
         )
         self._managed[worker_id] = mp
+        import time
+
+        self._launching[worker_id] = time.monotonic()
         self._persist()
         return mp
 
     def stop_worker(self, worker_id: str) -> bool:
         mp = self._managed.pop(worker_id, None)
+        self._launching.pop(worker_id, None)
         if mp is None:
             return False
         ok = kill_process_tree(mp.pid) if mp.pid else True
@@ -81,11 +94,27 @@ class WorkerProcessManager:
         log(f"stopped worker {worker_id} (pid {mp.pid}, clean={ok})")
         return True
 
+    def clear_launching(self, worker_id: str) -> bool:
+        """Worker self-reported ready; returns whether the flag was set."""
+        return self._launching.pop(worker_id, None) is not None
+
+    def is_launching(self, worker_id: str) -> bool:
+        import time
+
+        ts = self._launching.get(worker_id)
+        if ts is None:
+            return False
+        if time.monotonic() - ts > LAUNCHING_FLAG_TTL:
+            del self._launching[worker_id]
+            return False
+        return True
+
     def get_managed_workers(self) -> dict[str, dict]:
         self.reap_dead()
         return {
             wid: {"pid": mp.pid, "alive": True,
                   "log": str(mp.log_path) if mp.log_path else "",
+                  "launching": self.is_launching(wid),
                   "started_at": mp.started_at}
             for wid, mp in self._managed.items()
         }
@@ -96,6 +125,7 @@ class WorkerProcessManager:
         dead = [wid for wid, mp in self._managed.items() if not mp.is_alive()]
         for wid in dead:
             del self._managed[wid]
+            self._launching.pop(wid, None)
         if dead:
             self._persist()
         return dead

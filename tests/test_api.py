@@ -245,6 +245,117 @@ class TestRoutes:
                 assert net["recommended_ip"]
         run(body())
 
+    def test_clear_launching_route(self, tmp_config):
+        async def body():
+            controller, client = make_client()
+            async with client:
+                resp = await client.post(
+                    "/distributed/worker/clear_launching",
+                    json={"worker_id": "w0"})
+                data = await resp.json()
+                assert resp.status == 200
+                assert data["cleared"] is False   # flag was never set
+                resp = await client.post(
+                    "/distributed/worker/clear_launching", json={})
+                assert resp.status == 400
+        run(body())
+
+    def test_local_worker_status_route(self, tmp_config):
+        from comfyui_distributed_tpu.utils import config as config_mod
+
+        async def body():
+            # one configured local host that is offline
+            config_mod.update_config(lambda c: c["hosts"].append(
+                {"id": "w0", "address": "http://127.0.0.1:1",
+                 "enabled": True, "type": "local"}))
+            controller, client = make_client()
+            async with client:
+                resp = await client.get("/distributed/local-worker-status")
+                data = await resp.json()
+                assert resp.status == 200
+                assert data["workers"]["w0"]["online"] is False
+                assert data["workers"]["w0"]["managed"] is False
+        run(body())
+
+    def test_remote_worker_log_route(self, tmp_config):
+        from comfyui_distributed_tpu.utils import config as config_mod
+        from comfyui_distributed_tpu.utils.logging import log
+
+        async def body():
+            controller, client = make_client()
+            async with client:
+                # unknown host → 404
+                resp = await client.get("/distributed/remote_worker_log/nope")
+                assert resp.status == 404
+
+            # a second controller acts as the remote peer; proxy its log
+            peer = Controller()
+            peer_server = TestServer(create_app(peer))
+            await peer_server.start_server()
+            log("remote-log-marker")
+            config_mod.update_config(lambda c: c["hosts"].append(
+                {"id": "peer",
+                 "address": f"http://127.0.0.1:{peer_server.port}",
+                 "enabled": True, "type": "remote"}))
+            controller2, client2 = make_client()
+            async with client2:
+                resp = await client2.get("/distributed/remote_worker_log/peer")
+                data = await resp.json()
+                assert resp.status == 200
+                assert "remote-log-marker" in data["log"]
+                # unreachable peer → 502
+                config_mod.update_config(lambda c: c["hosts"].append(
+                    {"id": "gone", "address": "http://127.0.0.1:1",
+                     "enabled": True, "type": "remote"}))
+                resp = await client2.get("/distributed/remote_worker_log/gone")
+                assert resp.status == 502
+            await peer_server.close()
+        run(body())
+
+    def test_worker_ws_dispatch_channel(self, tmp_config):
+        async def body():
+            controller, client = make_client()
+            async with client:
+                ws = await client.ws_connect("/distributed/worker_ws")
+                await ws.send_json({
+                    "type": "dispatch_prompt",
+                    "prompt": {"1": {"class_type": "PrimitiveInt",
+                                     "inputs": {"value": 3}}},
+                    "client_id": "t", "request_id": "r1",
+                })
+                ack = await ws.receive_json()
+                assert ack["type"] == "dispatch_ack"
+                assert ack["ok"] is True and ack["prompt_id"]
+                assert ack["request_id"] == "r1"
+                # invalid prompt → ack with node_errors, not a dropped socket
+                await ws.send_json({"type": "dispatch_prompt",
+                                    "prompt": {"1": {"class_type": "Nope",
+                                                     "inputs": {}}}})
+                ack = await ws.receive_json()
+                assert ack["ok"] is False and ack["node_errors"]
+                await ws.close()
+        run(body())
+
+    def test_dispatch_prompt_ws_master_side(self, tmp_config):
+        """Master-side WS dispatch against a real worker_ws endpoint."""
+        from comfyui_distributed_tpu.cluster.dispatch import dispatch_prompt_ws
+        from comfyui_distributed_tpu.utils.exceptions import WorkerError
+
+        async def body():
+            worker = Controller()
+            server = TestServer(create_app(worker))
+            await server.start_server()
+            host = {"id": "w0", "address": f"http://127.0.0.1:{server.port}"}
+            ack = await dispatch_prompt_ws(
+                host, {"1": {"class_type": "PrimitiveInt",
+                             "inputs": {"value": 1}}})
+            assert ack["ok"] is True
+            with pytest.raises(WorkerError):
+                await dispatch_prompt_ws(
+                    host, {"1": {"class_type": "Nope", "inputs": {}}})
+            await server.close()
+        run(body())
+
 
 class TestTwoControllerE2E:
     """Master + worker controllers over real HTTP: orchestrate fans out,

@@ -114,6 +114,65 @@ class TestCollectDrain:
             assert images.shape == (2, 4, 4, 3)   # master + w1; dead skipped
         run(body())
 
+    def test_busy_probe_grace_extends_for_slow_worker(self, monkeypatch):
+        """A slow-but-alive worker whose health probe reports queued work
+        gets a deadline extension (reference busy-probe grace,
+        nodes/collector.py:414-470) — its results are NOT dropped."""
+        from comfyui_distributed_tpu.cluster import collector_bridge as cb
+        from comfyui_distributed_tpu.utils import constants
+
+        monkeypatch.setattr(constants, "COLLECT_GRACE_S", 0.5)
+        probes = []
+
+        async def fake_probe(host):
+            probes.append(host)
+            return {"queue_remaining": 1}
+
+        monkeypatch.setattr(cb, "probe_host", fake_probe)
+
+        async def body():
+            store = JobStore()
+            bridge = CollectorBridge(
+                store, asyncio.get_running_loop(),
+                host_resolver=lambda w: {"id": w, "address": "h:1"})
+            await store.prepare_collector_job("j1", ("slow",))
+
+            async def late_send():
+                await asyncio.sleep(0.25)   # past the 0.1s base timeout
+                await store.put_collector_result("j1", {
+                    "worker_id": "slow", "batch_idx": 0,
+                    "image": encode_image_b64(img(0.6)), "is_last": True,
+                })
+
+            task = asyncio.ensure_future(late_send())
+            images, _ = await bridge.collect_async(
+                "j1", img(0.2)[None], None, ("slow",), timeout=0.1)
+            await task
+            assert probes, "drain timeout should have probed the silent worker"
+            assert images.shape == (2, 4, 4, 3)   # grace kept the results
+        run(body())
+
+    def test_dead_worker_gets_no_grace(self, monkeypatch):
+        from comfyui_distributed_tpu.cluster import collector_bridge as cb
+
+        async def fake_probe(host):
+            return None                      # unreachable host
+
+        monkeypatch.setattr(cb, "probe_host", fake_probe)
+
+        async def body():
+            store = JobStore()
+            bridge = CollectorBridge(
+                store, asyncio.get_running_loop(),
+                host_resolver=lambda w: {"id": w, "address": "h:1"})
+            await store.prepare_collector_job("j1", ("dead",))
+            t0 = asyncio.get_running_loop().time()
+            images, _ = await bridge.collect_async(
+                "j1", img(0.2)[None], None, ("dead",), timeout=0.2)
+            assert asyncio.get_running_loop().time() - t0 < 2.0
+            assert images.shape == (1, 4, 4, 3)   # master only
+        run(body())
+
     def test_empty_batch_worker_contributes_nothing(self):
         async def body():
             store = JobStore()

@@ -119,7 +119,8 @@ class TestOrchestrator:
         async def fake_probe(host, timeout=None):
             return {"queue_remaining": 0} if host["id"] in probe_ok else None
 
-        async def fake_dispatch(host, prompt, client_id="", extra=None, trace_id=None):
+        async def fake_dispatch(host, prompt, client_id="", extra=None, trace_id=None,
+                                via_ws=False):
             if dispatch_log is not None:
                 dispatch_log.append((host["id"], prompt))
             return {"prompt_id": f"remote_{host['id']}"}
@@ -193,7 +194,7 @@ class TestOrchestrator:
         orch, store, queue = self._make(monkeypatch, hosts(2))
 
         async def failing_dispatch(host, prompt, client_id="", extra=None,
-                                   trace_id=None):
+                                   trace_id=None, via_ws=False):
             from comfyui_distributed_tpu.utils.exceptions import WorkerError
             if host["id"] == "w1":
                 raise WorkerError("boom", worker_id="w1")

@@ -1,0 +1,174 @@
+"""Two-PROCESS integration + fault injection.
+
+SURVEY §4 lists "no end-to-end multi-process test" as a reference gap and
+§5.3 "fault injection: none"; this closes both: real
+``python -m comfyui_distributed_tpu serve`` master+worker subprocesses,
+a tiny-preset txt2img driven through ``POST /distributed/queue``, and a
+kill-the-worker run asserting the master degrades gracefully (partial
+results, no hang) — the behavior the reference implements via collector
+timeouts (``nodes/collector.py:381-499``) but never tests.
+
+Marked ``slow``: two fresh JAX-on-CPU processes pay import+compile (~40 s
+total); wall time scales with core count — ~90 s on a multi-core
+box, a few minutes on a 1-core CI VM (compiles contend for the core).
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+import pytest
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def http_json(url, payload=None, timeout=10):
+    data = json.dumps(payload).encode() if payload is not None else None
+    req = urllib.request.Request(
+        url, data=data,
+        headers={"Content-Type": "application/json"} if data else {})
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return json.loads(resp.read().decode())
+
+
+def wait_health(port, deadline_s=60.0):
+    end = time.monotonic() + deadline_s
+    last = None
+    while time.monotonic() < end:
+        try:
+            return http_json(f"http://127.0.0.1:{port}/distributed/health",
+                            timeout=3)
+        except (urllib.error.URLError, OSError, json.JSONDecodeError) as e:
+            last = e
+            time.sleep(0.3)
+    raise TimeoutError(f"controller on :{port} never became healthy: {last}")
+
+
+def spawn_controller(port, config_path, *, worker_id=None, master_port=None,
+                     extra_env=None):
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+        "CDT_CONFIG_PATH": str(config_path),
+        # short failure-detection clocks so the kill test finishes fast
+        "CDT_HEARTBEAT_TIMEOUT": "2",
+        "CDT_COLLECT_POLL_TIMEOUT": "0.5",
+        "CDT_COLLECT_GRACE_S": "2",
+        "CDT_PROBE_TIMEOUT": "2",
+    })
+    if worker_id:
+        env["CDT_IS_WORKER"] = "1"
+        env["CDT_WORKER_ID"] = worker_id
+    if master_port:
+        env["CDT_MASTER_PORT"] = str(master_port)
+    env.update(extra_env or {})
+    return subprocess.Popen(
+        [sys.executable, "-m", "comfyui_distributed_tpu", "serve",
+         "--host", "127.0.0.1", "--port", str(port)],
+        cwd=REPO, env=env,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+
+
+TXT2IMG_TINY = {
+    "1": {"class_type": "CheckpointLoader", "inputs": {"ckpt_name": "tiny"}},
+    "2": {"class_type": "CLIPTextEncode",
+          "inputs": {"text": "integration", "clip": ["1", 1]}},
+    "3": {"class_type": "CLIPTextEncode",
+          "inputs": {"text": "", "clip": ["1", 1]}},
+    "4": {"class_type": "DistributedSeed", "inputs": {"seed": 3}},
+    "5": {"class_type": "TPUTxt2Img", "inputs": {
+        "model": ["1", 0], "positive": ["2", 0], "negative": ["3", 0],
+        "seed": ["4", 0], "steps": 2, "cfg": 1.0,
+        "width": 16, "height": 16}},
+    "6": {"class_type": "DistributedCollector", "inputs": {"images": ["5", 0]}},
+}
+
+
+def wait_history(mport, prompt_id, deadline_s=300.0):
+    end = time.monotonic() + deadline_s
+    while time.monotonic() < end:
+        try:
+            hist = http_json(
+                f"http://127.0.0.1:{mport}/distributed/history/{prompt_id}",
+                timeout=5)
+            if hist.get("status") in ("success", "error"):
+                return hist
+        except urllib.error.HTTPError as e:
+            if e.code != 404:
+                raise
+        except (urllib.error.URLError, OSError):
+            pass        # controller busy compiling; poll again
+        time.sleep(0.5)
+    raise TimeoutError(f"prompt {prompt_id} never finished")
+
+
+@pytest.mark.slow
+class TestTwoProcessIntegration:
+    def test_fanout_then_worker_kill(self, tmp_path):
+        wport, mport = free_port(), free_port()
+
+        wconfig = tmp_path / "worker.json"
+        wconfig.write_text(json.dumps({"master": {"port": mport}}))
+        mconfig = tmp_path / "master.json"
+        mconfig.write_text(json.dumps({
+            "master": {"host": "127.0.0.1", "port": mport},
+            "hosts": [{"id": "w0", "address": f"http://127.0.0.1:{wport}",
+                       "enabled": True, "type": "local"}],
+        }))
+
+        worker = spawn_controller(wport, wconfig, worker_id="w0",
+                                  master_port=mport)
+        master = spawn_controller(mport, mconfig)
+        try:
+            wait_health(wport)
+            wait_health(mport)
+
+            # --- happy path: master + 1 worker, tiny txt2img -------------
+            res = http_json(
+                f"http://127.0.0.1:{mport}/distributed/queue",
+                {"prompt": TXT2IMG_TINY, "client_id": "it"}, timeout=30)
+            assert res["worker_count"] == 1, res
+            hist = wait_history(mport, res["prompt_id"])
+            assert hist["status"] == "success", hist
+            # collector output: master's 4 (dp=4 virtual devices) + the
+            # worker's 4 seed-varied images
+            imgs = hist["outputs"]["6"][0]
+            assert imgs["shape"][0] == 8, imgs
+
+            # --- fault injection: kill the worker mid-job ----------------
+            res = http_json(
+                f"http://127.0.0.1:{mport}/distributed/queue",
+                {"prompt": TXT2IMG_TINY, "client_id": "it2"}, timeout=30)
+            assert res["worker_count"] == 1
+            worker.send_signal(signal.SIGKILL)
+            worker.wait(timeout=10)
+            hist = wait_history(mport, res["prompt_id"])
+            # graceful degradation: master's own images survive, no hang
+            assert hist["status"] == "success", hist
+            imgs = hist["outputs"]["6"][0]
+            assert imgs["shape"][0] == 4, imgs
+        finally:
+            for proc in (worker, master):
+                if proc.poll() is None:
+                    proc.terminate()
+                    try:
+                        proc.wait(timeout=10)
+                    except subprocess.TimeoutExpired:
+                        proc.kill()

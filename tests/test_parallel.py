@@ -129,3 +129,51 @@ def test_ring_shift():
     # shard i holds value of shard i-1 (ring)
     expected = (np.arange(8) - 1) % 8
     np.testing.assert_array_equal(out[:, 0], expected)
+
+
+class TestMultihostBootstrap:
+    """Bootstrap logic with a faked jax.distributed.initialize (the real
+    one needs a live coordinator; the code path is identical)."""
+
+    def _reset(self):
+        from comfyui_distributed_tpu.parallel import bootstrap
+        bootstrap._initialized = False
+        return bootstrap
+
+    def test_noop_without_coordinator(self, monkeypatch):
+        b = self._reset()
+        monkeypatch.delenv("CDT_COORDINATOR", raising=False)
+        calls = []
+        assert b.init_multihost(initialize_fn=lambda **kw: calls.append(kw)) is False
+        assert calls == []
+
+    def test_explicit_args_forwarded(self):
+        b = self._reset()
+        calls = []
+        ok = b.init_multihost("10.0.0.1:9911", 4, 2,
+                              initialize_fn=lambda **kw: calls.append(kw))
+        assert ok is True
+        assert calls == [{"coordinator_address": "10.0.0.1:9911",
+                          "num_processes": 4, "process_id": 2}]
+        # idempotent: second call doesn't re-initialize
+        assert b.init_multihost("10.0.0.1:9911", 4, 2,
+                                initialize_fn=lambda **kw: calls.append(kw))
+        assert len(calls) == 1
+
+    def test_env_fallbacks(self, monkeypatch):
+        b = self._reset()
+        monkeypatch.setenv("CDT_COORDINATOR", "c:1")
+        monkeypatch.setenv("CDT_NUM_HOSTS", "2")
+        monkeypatch.setenv("CDT_HOST_INDEX", "1")
+        calls = []
+        assert b.init_multihost(initialize_fn=lambda **kw: calls.append(kw))
+        assert calls[0]["num_processes"] == 2 and calls[0]["process_id"] == 1
+
+    def test_incomplete_config_raises(self, monkeypatch):
+        b = self._reset()
+        monkeypatch.delenv("CDT_NUM_HOSTS", raising=False)
+        monkeypatch.delenv("CDT_HOST_INDEX", raising=False)
+        with pytest.raises(ValueError):
+            b.init_multihost("c:1", initialize_fn=lambda **kw: None)
+        with pytest.raises(ValueError):
+            b.init_multihost("c:1", 4, 7, initialize_fn=lambda **kw: None)

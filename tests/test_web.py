@@ -118,6 +118,34 @@ class TestDashboard:
         run(body())
 
 
+class TestDashboardDomContract:
+    """UI drift guards runnable without node (the reference ships a vitest
+    suite; this environment has no JS runtime, so the contracts the UI
+    depends on — DOM ids and api-client methods — are checked statically)."""
+
+    WEB = Path(__file__).resolve().parent.parent / "comfyui_distributed_tpu" / "web"
+
+    def test_mainjs_dom_ids_exist_in_index(self):
+        import re
+
+        main = (self.WEB / "main.js").read_text()
+        html = (self.WEB / "index.html").read_text()
+        ids_used = set(re.findall(r'\$\("([\w-]+)"\)', main))
+        ids_defined = set(re.findall(r'id="([\w-]+)"', html))
+        missing = ids_used - ids_defined
+        assert not missing, f"main.js references missing DOM ids: {sorted(missing)}"
+
+    def test_mainjs_api_methods_exist_in_client(self):
+        import re
+
+        main = (self.WEB / "main.js").read_text()
+        client = (self.WEB / "apiClient.js").read_text()
+        used = set(re.findall(r"\bapi\.(\w+)\(", main))
+        defined = set(re.findall(r"^\s{2}(\w+):", client, re.M))
+        missing = used - defined
+        assert not missing, f"main.js calls undefined api methods: {sorted(missing)}"
+
+
 class TestInterruptExecution:
     def test_interrupt_drops_pending(self, tmp_config):
         from comfyui_distributed_tpu.cluster.runtime import PromptQueue
