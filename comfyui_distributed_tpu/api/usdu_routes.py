@@ -19,8 +19,24 @@ from ..utils.image import decode_png
 from .schemas import parse_positive_int, require_fields, validate_worker_id
 
 
+MAX_FRAME_PARTS = 64
+
+
 def register(router, controller) -> None:
     store = controller.store
+    # reassembly buffers for byte-split oversized frames (dynamic-mode
+    # whole images): (job_id, worker_id, task_id) → {part_index: bytes};
+    # stale entries are pruned on every submit
+    partial_frames: dict[tuple, dict] = {}
+    partial_seen: dict[tuple, float] = {}
+
+    def _prune_partials() -> None:
+        import time
+
+        horizon = time.monotonic() - constants.HEARTBEAT_TIMEOUT * 4
+        for key in [k for k, ts in partial_seen.items() if ts < horizon]:
+            partial_frames.pop(key, None)
+            partial_seen.pop(key, None)
 
     async def _json(request):
         try:
@@ -51,7 +67,7 @@ def register(router, controller) -> None:
                 {"error": "payload too large"}, status=413)
         reader = await request.multipart()
         metadata = None
-        tiles: dict[str, np.ndarray] = {}
+        raw_parts: dict[str, tuple[bytes, str]] = {}
         async for part in reader:
             if part.name == "tiles_metadata":
                 try:
@@ -59,22 +75,62 @@ def register(router, controller) -> None:
                 except json.JSONDecodeError:
                     raise ValidationError("tiles_metadata must be valid JSON")
             elif part.name and part.name.startswith("tile_"):
-                raw = await part.read()
-                if part.headers.get("Content-Type") == "application/x-cdt-frame":
-                    # CDTF float32 frames: the native transport (lossless,
-                    # crc-checked); PNG stays accepted for parity
-                    from .. import native
-
-                    try:
-                        tiles[part.name] = native.unpack_frame(raw)
-                    except ValueError as e:
-                        raise ValidationError(f"{part.name}: {e}")
-                else:
-                    tiles[part.name] = decode_png(raw)
+                raw_parts[part.name] = (
+                    await part.read(),
+                    part.headers.get("Content-Type", ""))
         if metadata is None:
             raise ValidationError("missing tiles_metadata part")
         require_fields(metadata, "job_id", "worker_id")
         worker_id = validate_worker_id(metadata["worker_id"])
+
+        fp = metadata.get("frame_parts")
+        if fp:
+            # byte-range piece of one oversized frame: buffer until whole
+            import time
+
+            from .. import native
+
+            task_id = parse_positive_int(fp.get("task_id"), "task_id")
+            idx = parse_positive_int(fp.get("part_index"), "part_index")
+            count = parse_positive_int(fp.get("part_count"), "part_count")
+            if count < 1 or count > MAX_FRAME_PARTS or idx >= count:
+                raise ValidationError(
+                    f"invalid frame_parts {idx}/{count} "
+                    f"(max {MAX_FRAME_PARTS})")
+            if len(raw_parts) != 1:
+                raise ValidationError(
+                    "frame_parts submit must carry exactly one body part")
+            _prune_partials()
+            key = (metadata["job_id"], worker_id, task_id)
+            buf = partial_frames.setdefault(key, {})
+            buf[idx] = next(iter(raw_parts.values()))[0]
+            partial_seen[key] = time.monotonic()
+            if len(buf) < count:
+                return web.json_response({"status": "ok", "buffered": idx})
+            data = b"".join(buf[i] for i in range(count))
+            partial_frames.pop(key, None)
+            partial_seen.pop(key, None)
+            try:
+                arr = native.unpack_frame(data)
+            except ValueError as e:
+                raise ValidationError(f"reassembled frame: {e}")
+            ok = await store.submit_result(
+                metadata["job_id"], worker_id, task_id, {"image": arr})
+            return web.json_response({"status": "ok", "accepted": int(ok)})
+
+        tiles: dict[str, np.ndarray] = {}
+        for name, (raw, ctype) in raw_parts.items():
+            if ctype == "application/x-cdt-frame":
+                # CDTF float32 frames: the native transport (lossless,
+                # crc-checked); PNG stays accepted for parity
+                from .. import native
+
+                try:
+                    tiles[name] = native.unpack_frame(raw)
+                except ValueError as e:
+                    raise ValidationError(f"{name}: {e}")
+            else:
+                tiles[name] = decode_png(raw)
         entries = metadata.get("tiles", [])
         accepted = 0
         for entry in entries:

@@ -219,14 +219,29 @@ class TileFarm:
 
     async def _flush(self, session, base, job_id, worker_id, batch) -> None:
         """Size-capped chunked multipart submit with retries (reference
-        ``worker_comms.py:16-108``: ≤ MAX_PAYLOAD−1MB per POST, ≥1 tile)."""
+        ``worker_comms.py:16-108``: ≤ MAX_PAYLOAD−1MB per POST, ≥1 tile).
+
+        A single frame larger than the cap (dynamic mode ships whole
+        upscaled images, which a 4× upscale easily pushes past 50 MB) is
+        byte-split across sequential POSTs; the master reassembles before
+        unpacking."""
         from .. import native
 
-        cap = constants.MAX_PAYLOAD_SIZE - (1 << 20)
+        # 1 MB headroom for multipart framing; the floor keeps the math
+        # sane when tests shrink MAX_PAYLOAD_SIZE
+        cap = max(constants.MAX_PAYLOAD_SIZE - (1 << 20),
+                  constants.MAX_PAYLOAD_SIZE // 2, 1)
         group: list[tuple[int, dict, bytes]] = []
         size = 0
         for task_id, meta, arr in batch:
             frame = native.pack_frame(np.asarray(arr, np.float32), level=1)
+            if len(frame) > cap:
+                if group:
+                    await self._post_tiles(session, base, job_id, worker_id, group)
+                    group, size = [], 0
+                await self._post_frame_parts(session, base, job_id, worker_id,
+                                             task_id, frame, cap)
+                continue
             if group and size + len(frame) > cap:
                 await self._post_tiles(session, base, job_id, worker_id, group)
                 group, size = [], 0
@@ -235,16 +250,33 @@ class TileFarm:
         if group:
             await self._post_tiles(session, base, job_id, worker_id, group)
 
-    async def _post_tiles(self, session, base, job_id, worker_id, group) -> None:
+    async def _post_frame_parts(self, session, base, job_id, worker_id,
+                                task_id, frame: bytes, cap: int) -> None:
+        """Split one oversized frame into byte-range parts ≤ cap each."""
+        n = -(-len(frame) // cap)
+        for j in range(n):
+            chunk = frame[j * cap:(j + 1) * cap]
+            await self._post_tiles(
+                session, base, job_id, worker_id,
+                [(task_id, {"task_id": task_id}, chunk)],
+                frame_parts={"task_id": task_id, "part_index": j,
+                             "part_count": n})
+
+    async def _post_tiles(self, session, base, job_id, worker_id, group,
+                          frame_parts: dict | None = None) -> None:
         url = f"{base}/distributed/submit_tiles"
         last: Exception | None = None
         for attempt in range(constants.SEND_MAX_RETRIES):
             form = aiohttp.FormData()
-            form.add_field("tiles_metadata", json.dumps({
+            meta_doc = {
                 "job_id": job_id, "worker_id": worker_id,
                 "tiles": [{**meta, "part": f"tile_{tid}"}
                           for tid, meta, _ in group],
-            }), content_type="application/json")
+            }
+            if frame_parts:
+                meta_doc["frame_parts"] = frame_parts
+            form.add_field("tiles_metadata", json.dumps(meta_doc),
+                           content_type="application/json")
             for tid, _, frame in group:
                 form.add_field(f"tile_{tid}", frame,
                                filename=f"tile_{tid}.cdtf",

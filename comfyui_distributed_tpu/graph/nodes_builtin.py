@@ -248,7 +248,7 @@ class UltimateSDUpscaleDistributed(NodeDef):
     OPTIONAL = {
         "tile_width": "INT", "tile_height": "INT", "tile_padding": "INT",
         "cfg": "FLOAT", "sampler_name": "STRING", "scheduler": "STRING",
-        "spatial_cond": "MASK",
+        "spatial_cond": "MASK", "dynamic_threshold": "INT",
     }
     HIDDEN = {
         "mesh": "*", "multi_job_id": "STRING", "is_worker": "BOOLEAN",
@@ -262,7 +262,8 @@ class UltimateSDUpscaleDistributed(NodeDef):
                 denoise: float, upscale_by: float, tile_width: int = 512,
                 tile_height: int = 512, tile_padding: int = 32,
                 cfg: float = 5.0, sampler_name: str = "euler",
-                scheduler: str = "karras", spatial_cond=None, mesh=None,
+                scheduler: str = "karras", spatial_cond=None,
+                dynamic_threshold: int = 8, mesh=None,
                 multi_job_id: str = "", is_worker: bool = False,
                 worker_id: str = "", master_url: str = "",
                 enabled_worker_ids=(), tile_farm=None, **_):
@@ -304,6 +305,37 @@ class UltimateSDUpscaleDistributed(NodeDef):
             return (out,)
 
         images = jnp.asarray(image)
+
+        # dynamic (per-image) mode for large batches — reference
+        # upscale/modes/dynamic.py: the pull queue holds IMAGE indices and
+        # full processed images travel back, not tiles. Here each task is
+        # one image run through the on-pod SPMD tile program; global image
+        # index seeds the noise so assignment/requeue stays invisible.
+        if images.shape[0] >= max(2, int(dynamic_threshold)):
+            def process_images(start: int, end: int) -> np.ndarray:
+                done = []
+                for i in range(start, end):
+                    done.append(np.asarray(upscaler.upscale(
+                        mesh, images[i:i + 1], spec, int(seed) + i,
+                        positive["context"], negative["context"], y, uy,
+                        spatial_cond=None if smap is None else smap[i:i + 1],
+                    )))
+                return np.concatenate(done, axis=0)
+
+            from ..cluster.tile_farm import assemble_tiles
+
+            if is_worker:
+                from ..ops.resize import upscale_image
+
+                tile_farm.worker_run(multi_job_id, worker_id, master_url,
+                                     process_images)
+                return (upscale_image(images, spec.scale,
+                                      spec.resize_method),)
+            results = tile_farm.master_run(
+                multi_job_id, images.shape[0], process_images, chunk=1)
+            full = assemble_tiles(results, images.shape[0], 1)
+            return (jnp.asarray(full),)
+
         outs = []
         for b in range(images.shape[0]):
             plan = upscaler.range_plan(

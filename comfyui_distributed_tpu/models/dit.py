@@ -9,10 +9,15 @@ ComfyUI; here the architecture is native and **sequence-parallel capable**:
 the ``sp`` mesh axis (``ops/attention.joint_ring_attention``) — the
 capability the reference entirely lacks (SURVEY §2.10: SP/CP absent).
 
-Positional encoding: 2-D sinusoidal (axial) added to patch embeddings —
-functionally equivalent coverage to FLUX's RoPE for from-scratch training;
-weight-porting real FLUX checkpoints would swap in RoPE (noted for later
-rounds).
+Positional encoding: selectable per config —
+
+- ``pos_embed="sincos"``: axial 2-D sinusoidal added to patch embeddings
+  (simple, fine for from-scratch training);
+- ``pos_embed="rope"`` (the FLUX preset's default): 3-axis rotary
+  embeddings applied to q/k per head exactly in FLUX's layout (axis 0 =
+  text/time slot, axes 1-2 = patch row/col; ``rope_axes_dim`` must sum
+  to ``head_dim``) — the form real FLUX checkpoints require, so weight
+  porting needs no architectural surgery.
 """
 
 from __future__ import annotations
@@ -43,20 +48,35 @@ class DiTConfig:
     guidance_embed: bool = True      # FLUX-dev distilled guidance input
     dtype: str = "bfloat16"
     attn_backend: str = "dense"      # "dense" | "ring"
+    pos_embed: str = "sincos"        # "sincos" | "rope"
+    rope_theta: float = 10000.0
+    rope_axes_dim: Optional[tuple[int, int, int]] = None   # None → derived
 
     @classmethod
     def flux(cls) -> "DiTConfig":
-        return cls()
+        # FLUX.1: head_dim 128 = 16 (txt/time axis) + 56 (row) + 56 (col)
+        return cls(pos_embed="rope", rope_axes_dim=(16, 56, 56))
 
     @classmethod
-    def tiny(cls, attn_backend: str = "dense") -> "DiTConfig":
+    def tiny(cls, attn_backend: str = "dense",
+             pos_embed: str = "sincos") -> "DiTConfig":
         return cls(patch_size=2, in_channels=4, hidden=64, depth_double=2,
                    depth_single=2, heads=4, context_dim=32, pooled_dim=16,
-                   attn_backend=attn_backend)
+                   attn_backend=attn_backend, pos_embed=pos_embed)
 
     @property
     def head_dim(self) -> int:
         return self.hidden // self.heads
+
+    @property
+    def axes_dim(self) -> tuple[int, int, int]:
+        """Per-axis RoPE widths (must sum to head_dim, all even)."""
+        if self.rope_axes_dim is not None:
+            return self.rope_axes_dim
+        d0 = max(2, (self.head_dim // 8) // 2 * 2)
+        rest = self.head_dim - d0
+        dh = (rest // 2) // 2 * 2
+        return (d0, dh, rest - dh)
 
     @property
     def jnp_dtype(self):
@@ -94,6 +114,44 @@ def sincos_2d(h: int, w: int, dim: int) -> jax.Array:
         jnp.tile(tw, (h, 1)),
     ], axis=-1)
     return grid
+
+
+def rope_freqs(ids: jax.Array, axes_dim: tuple[int, ...],
+               theta: float) -> tuple[jax.Array, jax.Array]:
+    """FLUX multi-axis RoPE table.
+
+    ``ids``: [N, n_axes] integer positions per token (txt tokens all-zero,
+    img tokens (0, row, col)). Returns (cos, sin), each [N, head_dim/2]:
+    axis a contributes ``axes_dim[a]/2`` rotation frequencies, concatenated
+    in axis order — FLUX's EmbedND layout.
+    """
+    parts_cos, parts_sin = [], []
+    for a, d in enumerate(axes_dim):
+        half = d // 2
+        freqs = 1.0 / (theta ** (jnp.arange(half, dtype=jnp.float32) * 2.0 / d))
+        args = ids[:, a].astype(jnp.float32)[:, None] * freqs[None]
+        parts_cos.append(jnp.cos(args))
+        parts_sin.append(jnp.sin(args))
+    return (jnp.concatenate(parts_cos, axis=-1),
+            jnp.concatenate(parts_sin, axis=-1))
+
+
+def apply_rope(x: jax.Array, pe: tuple[jax.Array, jax.Array]) -> jax.Array:
+    """Rotate q/k pairs: x [B, N, heads, head_dim], pe ([N, hd/2], [N, hd/2])."""
+    cos, sin = pe
+    cos = cos[None, :, None, :].astype(jnp.float32)
+    sin = sin[None, :, None, :].astype(jnp.float32)
+    xf = x.astype(jnp.float32)
+    x1, x2 = xf[..., 0::2], xf[..., 1::2]
+    out = jnp.stack([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return out.reshape(x.shape).astype(x.dtype)
+
+
+def image_ids(h: int, w: int, row_offset: int = 0) -> jax.Array:
+    """[h·w, 3] FLUX image token ids: (0, row, col)."""
+    rows = jnp.repeat(jnp.arange(h) + row_offset, w)
+    cols = jnp.tile(jnp.arange(w), (h,))
+    return jnp.stack([jnp.zeros_like(rows), rows, cols], axis=-1)
 
 
 class Modulation(nn.Module):
@@ -143,7 +201,8 @@ class DoubleBlock(nn.Module):
     config: DiTConfig
 
     @nn.compact
-    def __call__(self, img, txt, vec, sp_axis: Optional[str]):
+    def __call__(self, img, txt, vec, sp_axis: Optional[str],
+                 pe_img=None, pe_txt=None):
         cfg = self.config
         dt = cfg.jnp_dtype
         i_sh1, i_sc1, i_g1, i_sh2, i_sc2, i_g2 = Modulation(2, cfg.hidden, dt,
@@ -157,6 +216,9 @@ class DoubleBlock(nn.Module):
                                        dtype=dt)(txt), t_sh1, t_sc1)
         iq, ik, iv = _QKV(cfg.hidden, cfg.heads, dt, name="img_qkv")(img_n)
         tq, tk, tv = _QKV(cfg.hidden, cfg.heads, dt, name="txt_qkv")(txt_n)
+        if pe_img is not None:
+            iq, ik = apply_rope(iq, pe_img), apply_rope(ik, pe_img)
+            tq, tk = apply_rope(tq, pe_txt), apply_rope(tk, pe_txt)
 
         if sp_axis is None:
             q = jnp.concatenate([tq, iq], axis=1)
@@ -193,13 +255,16 @@ class SingleBlock(nn.Module):
     config: DiTConfig
 
     @nn.compact
-    def __call__(self, x, vec, txt_len: int, sp_axis: Optional[str]):
+    def __call__(self, x, vec, txt_len: int, sp_axis: Optional[str],
+                 pe_full=None):
         cfg = self.config
         dt = cfg.jnp_dtype
         sh, sc, g = Modulation(1, cfg.hidden, dt, name="mod")(vec)
         xn = _modulate(nn.LayerNorm(use_scale=False, use_bias=False, dtype=dt)(x),
                        sh, sc)
         q, k, v = _QKV(cfg.hidden, cfg.heads, dt, name="qkv")(xn)
+        if pe_full is not None:
+            q, k = apply_rope(q, pe_full), apply_rope(k, pe_full)
         if sp_axis is None:
             out = full_attention(q, k, v)
         else:
@@ -230,8 +295,25 @@ class DiT(nn.Module):
 
         tokens = patchify(x.astype(dt), p)
         img = nn.Dense(cfg.hidden, dtype=dt, name="img_in")(tokens)
-        if sp_axis is None:
+        pe_img = pe_txt = pe_full = None
+        if cfg.pos_embed == "rope":
+            # per-head rotary positions (FLUX layout); in sp mode the row
+            # ids are offset by this shard's global row-block start so a
+            # sharded run rotates identically to the unsharded one
+            if sp_axis is None:
+                ids_img = image_ids(H // p, W // p)
+            else:
+                idx = jax.lax.axis_index(sp_axis)
+                ids_img = image_ids(H // p, W // p,
+                                    row_offset=idx * (H // p))
+            ids_txt = jnp.zeros((context.shape[1], 3), jnp.int32)
+            pe_img = rope_freqs(ids_img, cfg.axes_dim, cfg.rope_theta)
+            pe_txt = rope_freqs(ids_txt, cfg.axes_dim, cfg.rope_theta)
+            pe_full = (jnp.concatenate([pe_txt[0], pe_img[0]], axis=0),
+                       jnp.concatenate([pe_txt[1], pe_img[1]], axis=0))
+        elif sp_axis is None:
             pos = sincos_2d(H // p, W // p, cfg.hidden)
+            img = img + pos[None].astype(dt)
         else:
             # x is this shard's row block of the global image: build the
             # global position table and slice this shard's rows
@@ -240,7 +322,7 @@ class DiT(nn.Module):
             pos_full = sincos_2d((H * n_sh) // p, W // p, cfg.hidden)
             per = pos_full.shape[0] // n_sh
             pos = jax.lax.dynamic_slice_in_dim(pos_full, idx * per, per, axis=0)
-        img = img + pos[None].astype(dt)
+            img = img + pos[None].astype(dt)
 
         txt = nn.Dense(cfg.hidden, dtype=dt, name="txt_in")(context.astype(dt))
 
@@ -254,11 +336,13 @@ class DiT(nn.Module):
         vec = nn.Dense(cfg.hidden, dtype=dt, name="vec_mlp")(nn.silu(vec))
 
         for i in range(cfg.depth_double):
-            img, txt = DoubleBlock(cfg, name=f"double_{i}")(img, txt, vec, sp_axis)
+            img, txt = DoubleBlock(cfg, name=f"double_{i}")(
+                img, txt, vec, sp_axis, pe_img, pe_txt)
         xcat = jnp.concatenate([txt, img], axis=1)
         T = txt.shape[1]
         for i in range(cfg.depth_single):
-            xcat = SingleBlock(cfg, name=f"single_{i}")(xcat, vec, T, sp_axis)
+            xcat = SingleBlock(cfg, name=f"single_{i}")(xcat, vec, T, sp_axis,
+                                                        pe_full)
         img = xcat[:, T:]
 
         sh, sc, _ = Modulation(1, cfg.hidden, dt, name="final_mod")(vec)

@@ -224,6 +224,14 @@ class ModelBundle:
         mf = ckpt / "cdt_manifest.json"
         if mf.is_file():
             manifest = json.loads(mf.read_text())
+        saved_arch = manifest.get("arch")
+        if saved_arch and saved_arch != self._arch_fingerprint():
+            raise ValidationError(
+                f"checkpoint {ckpt} was saved with architecture "
+                f"{saved_arch} but the current preset resolves to "
+                f"{self._arch_fingerprint()}; a mismatched positional "
+                "encoding restores byte-compatibly yet generates garbage — "
+                "re-convert the checkpoint for this preset")
         if "clip_l" in manifest.get("entries", []):
             self.build_clip_stack(tiny=bool(manifest.get("tiny_clip")))
         targets = self._state_entries()
@@ -256,8 +264,21 @@ class ModelBundle:
         ckpt.mkdir(parents=True, exist_ok=True)
         (ckpt / "cdt_manifest.json").write_text(json.dumps(
             {"preset": self.preset.name, "entries": sorted(state),
-             "tiny_clip": tiny_clip}))
+             "tiny_clip": tiny_clip,
+             "arch": self._arch_fingerprint()}))
         log(f"saved checkpoint {ckpt}")
+
+    def _arch_fingerprint(self) -> dict:
+        """Architecture facts that change SEMANTICS without changing the
+        param tree (a rope↔sincos flip restores byte-compatibly but
+        generates garbage); recorded at save, validated at load."""
+        core = (self.preset.dit or self.preset.video or self.preset.unet)
+        fp: dict = {"kind": self.kind}
+        for field in ("pos_embed", "rope_theta", "rope_axes_dim"):
+            if hasattr(core, field):
+                v = getattr(core, field)
+                fp[field] = list(v) if isinstance(v, tuple) else v
+        return fp
 
     def load_safetensors_checkpoint(self, path: Path) -> None:
         """Convert a published single-file ``.safetensors`` checkpoint

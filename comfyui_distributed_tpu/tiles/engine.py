@@ -61,8 +61,28 @@ class UpscaleSpec:
 class TileUpscaler:
     """Drives a ``Txt2ImgPipeline``'s model stack over a sharded tile axis."""
 
+    _CACHE_MAX = 8
+
     def __init__(self, pipeline: Txt2ImgPipeline):
         self.pipeline = pipeline
+        self._fn_cache: dict = {}
+
+    def _cached_upscale_fn(self, mesh: Mesh, image_hw, spec: UpscaleSpec,
+                          batch: int, axis: str, with_spatial: bool):
+        """Compiled-program cache (same value-keyed discipline as
+        ``Txt2ImgPipeline._cached_fn``): dynamic per-image farming calls
+        upscale() once per image — without this it would re-trace and
+        re-compile the identical program every time."""
+        key = (Txt2ImgPipeline._mesh_cache_key(mesh), tuple(image_hw), spec,
+               batch, axis, with_spatial)
+        fn = self._fn_cache.get(key)
+        if fn is None:
+            if len(self._fn_cache) >= self._CACHE_MAX:
+                self._fn_cache.pop(next(iter(self._fn_cache)))
+            fn = self.upscale_fn(mesh, tuple(image_hw), spec, batch=batch,
+                                 axis=axis, with_spatial=with_spatial)
+            self._fn_cache[key] = fn
+        return fn
 
     def grid_for(self, image_h: int, image_w: int, spec: UpscaleSpec) -> TileGrid:
         out_h = int(round(image_h * spec.scale))
@@ -206,8 +226,8 @@ class TileUpscaler:
         """``spatial_cond``: [B, H, W, 1] (input res) or [B, H·s, W·s, 1]
         (output res) region mask, cropped per tile inside the program."""
         B, H, W, _ = images.shape
-        fn = self.upscale_fn(mesh, (H, W), spec, batch=B, axis=axis,
-                             with_spatial=spatial_cond is not None)
+        fn = self._cached_upscale_fn(mesh, (H, W), spec, batch=B, axis=axis,
+                                     with_spatial=spatial_cond is not None)
         adm = self.pipeline.unet.config.adm_in_channels
         if y is None:
             y = jnp.zeros((1, max(adm, 1)), jnp.float32)

@@ -119,3 +119,56 @@ def test_flow_dp_tp_gspmd(flow_stack):
     assert imgs.shape == (4, 16, 16, 3)
     assert np.isfinite(imgs).all()
     assert len({imgs[i].tobytes() for i in range(4)}) == 4
+
+
+class TestRope:
+    """FLUX-style 3-axis rotary positions (pos_embed='rope')."""
+
+    def test_apply_rope_preserves_norm_and_moves_positions(self):
+        from comfyui_distributed_tpu.models.dit import (
+            apply_rope, image_ids, rope_freqs)
+
+        ids = image_ids(4, 4)
+        pe = rope_freqs(ids, (4, 6, 6), 10000.0)
+        x = jax.random.normal(jax.random.key(0), (1, 16, 2, 16))
+        out = np.asarray(apply_rope(x, pe))
+        # rotation preserves per-pair norms
+        np.testing.assert_allclose(
+            np.linalg.norm(out, axis=-1), np.linalg.norm(np.asarray(x), axis=-1),
+            rtol=1e-5)
+        # token at (0,0) has zero angles → unrotated
+        np.testing.assert_allclose(out[:, 0], np.asarray(x[:, 0]), rtol=1e-6)
+        # distinct positions rotate differently
+        assert not np.allclose(out[:, 5], np.asarray(x[:, 5]))
+
+    def test_rope_forward_and_flux_axes(self):
+        cfg = DiTConfig.tiny(pos_embed="rope")
+        assert sum(cfg.axes_dim) == cfg.head_dim
+        assert DiTConfig.flux().axes_dim == (16, 56, 56)
+        assert sum(DiTConfig.flux().axes_dim) == DiTConfig.flux().head_dim
+        model, params = init_dit(cfg, jax.random.key(0), sample_hw=(8, 8),
+                                 context_len=6)
+        out = model.apply(params, jnp.ones((1, 8, 8, 4)), jnp.ones((1,)) * 0.5,
+                          jnp.ones((1, 6, 32)), jnp.ones((1, 16)))
+        assert out.shape == (1, 8, 8, 4)
+        assert np.isfinite(np.asarray(out)).all()
+
+    def test_rope_sp_matches_single_chip(self):
+        """Sharded rows with offset RoPE ids must reproduce the unsharded
+        rotation exactly — the sp decomposition holds under rope too."""
+        cfg = DiTConfig(patch_size=2, in_channels=4, hidden=64,
+                        depth_double=2, depth_single=2, heads=4,
+                        context_dim=32, pooled_dim=16, dtype="float32",
+                        pos_embed="rope")
+        model, params = init_dit(cfg, jax.random.key(0), sample_hw=(16, 16),
+                                 context_len=6)
+        vae = AutoencoderKL(VAEConfig.tiny(dtype="float32")).init(
+            jax.random.key(1), image_hw=(32, 32))
+        pipe = FlowPipeline(model, params, vae)
+        ctx, pooled = _cond(cfg)
+        spec = FlowSpec(height=32, width=32, steps=2, shift=1.0)
+        sp_out = np.asarray(pipe.generate_sp(build_mesh({"sp": 4}), spec,
+                                             seed=7, context=ctx, pooled=pooled))
+        single = np.asarray(pipe.generate_sp(build_mesh({"sp": 1}), spec,
+                                             seed=7, context=ctx, pooled=pooled))
+        np.testing.assert_allclose(sp_out, single, rtol=2e-4, atol=2e-4)

@@ -200,3 +200,140 @@ class TestFarmMatchesSPMD:
         tiles = assemble_tiles(results, plan.num_tiles, plan.chunk)
         out = np.asarray(ups.composite(tiles, plan))
         np.testing.assert_allclose(out, ref[0], rtol=1e-5, atol=1e-5)
+
+
+class TestDynamicMode:
+    """Per-image (dynamic) mode — reference upscale/modes/dynamic.py: the
+    pull queue holds image indices and full images travel back. Here a
+    task IS one image (total=#images, chunk=1), driven through the same
+    farm machinery over a real localhost socket."""
+
+    def test_images_farmed_per_index(self, tmp_config):
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from comfyui_distributed_tpu.api.app import create_app
+
+        def per_image(start, end, _delay=0.0):
+            # stand-in for "run the SPMD tile program on image i"
+            import time as _t
+
+            if _delay:
+                _t.sleep(_delay)
+            return np.stack([np.full((8, 8, 3), float(i), np.float32)
+                             for i in range(start, end)])
+
+        async def body():
+            controller, client = TestClient(TestServer(create_app(Controller()))), None
+            controller, client = controller.server.app["controller"], controller
+            async with client:
+                base = f"http://127.0.0.1:{client.port}"
+                farm_w = TileFarm(JobStore(), asyncio.get_running_loop())
+                master_task = asyncio.create_task(
+                    controller.tile_farm.master_run_async(
+                        "dyn", total=6,
+                        process_fn=lambda s, e: per_image(s, e, _delay=0.05),
+                        chunk=1, heartbeat_interval=0.5))
+                await asyncio.sleep(0.05)
+                done = await farm_w.worker_run_async(
+                    "dyn", "w0", base, per_image, max_batch=1)
+                results = await master_task
+                assert done > 0
+                images = assemble_tiles(results, 6, 1)
+                np.testing.assert_allclose(images[:, 0, 0, 0], np.arange(6.0))
+        run(body())
+
+    def test_usdu_node_dynamic_branch(self, tmp_config):
+        """The node picks per-image farming for batches >= dynamic_threshold
+        and reassembles images in index order (master completes alone)."""
+        import threading
+
+        from comfyui_distributed_tpu.diffusion.pipeline import Txt2ImgPipeline
+        from comfyui_distributed_tpu.graph.node import NODE_REGISTRY
+        from comfyui_distributed_tpu.models.text import TextEncoder, TextEncoderConfig
+        from comfyui_distributed_tpu.models.unet import UNetConfig, init_unet
+        from comfyui_distributed_tpu.models.vae import AutoencoderKL, VAEConfig
+        from comfyui_distributed_tpu.parallel import build_mesh
+
+        model, params = init_unet(UNetConfig.tiny(), jax.random.key(0),
+                                  sample_shape=(8, 8, 4), context_len=16)
+        vae = AutoencoderKL(VAEConfig.tiny()).init(jax.random.key(1),
+                                                   image_hw=(16, 16))
+        pipe = Txt2ImgPipeline(model, params, vae)
+        enc = TextEncoder(TextEncoderConfig.tiny()).init(jax.random.key(2))
+        ctx, _ = enc.encode(["p"])
+        unc, _ = enc.encode([""])
+
+        class Bundle:
+            pipeline = pipe
+
+        cond = {"context": ctx, "pooled": None}
+        uncond = {"context": unc, "pooled": None}
+        node = NODE_REGISTRY["UltimateSDUpscaleDistributed"]()
+        imgs = np.random.rand(3, 16, 16, 3).astype(np.float32)
+
+        async def body():
+            store = JobStore()
+            loop = asyncio.get_running_loop()
+            farm = TileFarm(store, loop)
+            out = {}
+
+            def call():
+                out["images"] = node.execute(
+                    imgs, Bundle(), cond, uncond, seed=5, steps=2,
+                    denoise=0.4, upscale_by=2.0, tile_width=16,
+                    tile_height=16, tile_padding=4, cfg=1.0,
+                    dynamic_threshold=2, mesh=build_mesh({"dp": 2}),
+                    multi_job_id="usdu-dyn", is_worker=False,
+                    enabled_worker_ids=("w1",), tile_farm=farm)[0]
+
+            t = threading.Thread(target=call)
+            t.start()
+            while t.is_alive():
+                await asyncio.sleep(0.1)
+            t.join()
+            assert np.asarray(out["images"]).shape == (3, 32, 32, 3)
+        run(body())
+
+
+class TestOversizedFrames:
+    def test_frame_larger_than_cap_is_split_and_reassembled(self, tmp_config,
+                                                            monkeypatch):
+        """Dynamic mode ships whole upscaled images; a frame bigger than
+        MAX_PAYLOAD_SIZE must byte-split across POSTs and reassemble on
+        the master losslessly."""
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from comfyui_distributed_tpu.api.app import create_app
+        from comfyui_distributed_tpu.utils import constants
+
+        monkeypatch.setattr(constants, "MAX_PAYLOAD_SIZE", 64 * 1024)
+
+        rng = np.random.default_rng(0)
+        big = rng.random((1, 80, 80, 3)).astype(np.float32)   # ~75KB raw
+
+        def per_image(start, end):
+            import time as _t
+
+            _t.sleep(0.05)
+            return big + float(start)
+
+        async def body():
+            client = TestClient(TestServer(create_app(Controller())))
+            controller = client.server.app["controller"]
+            async with client:
+                base = f"http://127.0.0.1:{client.port}"
+                farm_w = TileFarm(JobStore(), asyncio.get_running_loop())
+                master_task = asyncio.create_task(
+                    controller.tile_farm.master_run_async(
+                        "big", total=3, process_fn=per_image, chunk=1,
+                        heartbeat_interval=0.5))
+                await asyncio.sleep(0.05)
+                done = await farm_w.worker_run_async(
+                    "big", "w0", base, per_image, max_batch=1)
+                results = await master_task
+                assert done > 0, "worker never got work"
+                images = assemble_tiles(results, 3, 1)
+                for i in range(3):
+                    np.testing.assert_array_equal(
+                        images[i], (big + float(i))[0])
+        run(body())
