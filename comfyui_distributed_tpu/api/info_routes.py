@@ -88,6 +88,84 @@ def register(router, controller) -> None:
         return web.json_response(
             {"log": "\n".join(lines), "available": bool(lines)})
 
+    # --- profiling / device observability ----------------------------------
+    # The reference has no profiler (SURVEY §5.1: "no timing histograms,
+    # no flamegraphs"); on TPU the right tool is jax.profiler — these
+    # routes capture an XLA trace viewable in TensorBoard/Perfetto.
+    profile_state = {"dir": None}
+
+    async def profile_start(request):
+        import jax
+
+        if profile_state["dir"]:
+            return web.json_response(
+                {"error": f"trace already running → {profile_state['dir']}"},
+                status=409)
+        body = {}
+        try:
+            body = await request.json()
+        except Exception:
+            pass
+        import os
+        import time as _t
+
+        # "out" is a NAME under the profile root, never a client path —
+        # same sandbox discipline as the media routes (an unauthenticated
+        # peer must not direct filesystem writes)
+        root = os.environ.get("CDT_PROFILE_DIR", "/tmp/cdt_profile")
+        name = str(body.get("out") or _t.strftime("%Y%m%d-%H%M%S"))
+        name = "".join(c if (c.isalnum() or c in "-_.") else "_"
+                       for c in os.path.basename(name))[:80] or "trace"
+        out = os.path.join(root, name)
+        try:
+            jax.profiler.start_trace(out)
+        except RuntimeError as e:
+            return web.json_response({"error": str(e)}, status=409)
+        profile_state["dir"] = out
+        return web.json_response({"status": "tracing", "out": out})
+
+    async def profile_stop(request):
+        import jax
+
+        if not profile_state["dir"]:
+            return web.json_response({"error": "no trace running"}, status=409)
+        out, profile_state["dir"] = profile_state["dir"], None
+        try:
+            jax.profiler.stop_trace()
+        except RuntimeError as e:
+            return web.json_response({"error": str(e)}, status=409)
+        return web.json_response({"status": "stopped", "out": out})
+
+    async def memory_stats(request):
+        """Per-device HBM/host memory stats (None on backends that don't
+        report them, e.g. CPU)."""
+        import jax
+
+        out = []
+        for d in jax.local_devices():
+            try:
+                stats = d.memory_stats()
+            except Exception:
+                stats = None
+            out.append({"id": d.id, "kind": getattr(d, "device_kind", "?"),
+                        "stats": stats})
+        return web.json_response({"devices": out})
+
+    async def step_times(request):
+        """Recent prompt durations — the step-time observability the
+        reference's progress logs approximate."""
+        hist = controller.queue.history
+        recent = list(hist.items())[-50:]
+        return web.json_response({"prompts": [
+            {"prompt_id": pid, "status": h.get("status"),
+             "duration_s": round(h.get("duration", 0.0), 3)}
+            for pid, h in recent
+        ]})
+
     router.add_get("/distributed/system_info", system_info)
     router.add_get("/distributed/network_info", network_info)
     router.add_get("/distributed/local_log", local_log)
+    router.add_post("/distributed/profile/start", profile_start)
+    router.add_post("/distributed/profile/stop", profile_stop)
+    router.add_get("/distributed/memory_stats", memory_stats)
+    router.add_get("/distributed/step_times", step_times)

@@ -125,6 +125,22 @@ class JobStore:
         await job.results.put((task_id, payload))
         return True
 
+    async def restore_completed(self, job_id: str, task_id: int,
+                                payload: Any) -> bool:
+        """Pre-mark a task complete from a journal (crash resume): unlike
+        ``submit_result`` this also removes it from the pending queue so
+        nobody reprocesses it, and skips the results queue."""
+        async with self.lock:
+            job = self.tile_jobs.get(job_id)
+            if job is None:
+                raise JobQueueError(f"unknown tile job {job_id!r}", job_id=job_id)
+            if task_id not in job.tasks or task_id in job.completed:
+                return False
+            job.completed[task_id] = payload
+            job.pending = [t for t in job.pending if t.task_id != task_id]
+            job.assigned.pop(task_id, None)
+            return True
+
     async def heartbeat(self, job_id: str, worker_id: str) -> bool:
         async with self.lock:
             job = self.tile_jobs.get(job_id)

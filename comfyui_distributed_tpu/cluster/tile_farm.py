@@ -44,6 +44,82 @@ ProcessFn = Callable[[int, int], np.ndarray]      # (start, end) -> [n,...]
 ProbeFn = Callable[[str], Awaitable[Optional[dict]]]
 
 
+class TileJournal:
+    """Disk journal of completed tile tasks (crash resume for long jobs —
+    SURVEY §5.4: the reference restarts minutes-long jobs from scratch;
+    multi-hour video upscales warrant result journaling).
+
+    One CDTF frame file per completed task, written atomically
+    (tmp + rename, same discipline as the config saver); a restarted
+    master preloads them and only the remainder is recomputed.
+
+    The key must be STABLE ACROSS RESTARTS (a content hash of the job's
+    inputs, not the per-execution job id — a crashed workflow re-submits
+    under a fresh exec id). Stale sibling dirs are pruned by TTL on open
+    so crashed-and-abandoned jobs can't leak disk forever.
+    """
+
+    TTL_S = 7 * 24 * 3600.0
+
+    def __init__(self, root, key: str):
+        import time
+        from pathlib import Path
+
+        safe = "".join(c if (c.isalnum() or c in "-_.") else "_"
+                       for c in key)[:120]
+        self.dir = Path(root) / safe
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self.disabled = False
+        # TTL sweep of abandoned sibling journals
+        horizon = time.time() - self.TTL_S
+        for sib in Path(root).iterdir():
+            try:
+                if sib.is_dir() and sib != self.dir and sib.stat().st_mtime < horizon:
+                    import shutil
+
+                    shutil.rmtree(sib, ignore_errors=True)
+            except OSError:
+                pass
+
+    def write(self, task_id: int, arr: np.ndarray) -> None:
+        """Best-effort: journaling must never kill the job it protects —
+        on any write failure the journal disables itself and the run
+        continues un-journaled."""
+        if self.disabled:
+            return
+        out = self.dir / f"task_{task_id}.cdtf"
+        if out.exists():
+            return   # master-processed tasks also flow through the results
+                     # queue; don't pack+write the same frame twice
+        from .. import native
+
+        try:
+            tmp = self.dir / f".task_{task_id}.tmp"
+            tmp.write_bytes(
+                native.pack_frame(np.asarray(arr, np.float32), level=1))
+            tmp.rename(out)
+        except OSError as e:
+            log(f"journal: write failed ({e}); disabling journal for this run")
+            self.disabled = True
+
+    def load(self) -> dict[int, np.ndarray]:
+        from .. import native
+
+        out: dict[int, np.ndarray] = {}
+        for f in sorted(self.dir.glob("task_*.cdtf")):
+            try:
+                tid = int(f.stem.split("_", 1)[1])
+                out[tid] = native.unpack_frame(f.read_bytes())
+            except (ValueError, OSError) as e:
+                log(f"journal: skipping corrupt entry {f.name} ({e})")
+        return out
+
+    def clear(self) -> None:
+        import shutil
+
+        shutil.rmtree(self.dir, ignore_errors=True)
+
+
 class TileFarm:
     """Bound to the controller's store + event loop; graph nodes call the
     sync wrappers from the executor thread (same bridging discipline as
@@ -76,6 +152,8 @@ class TileFarm:
         worker_timeout: float | None = None,
         probe_fn: ProbeFn | None = None,
         overall_timeout: float | None = None,
+        journal_dir=None,
+        journal_key: str | None = None,
     ) -> dict[int, np.ndarray]:
         """Drive a tile job to completion; returns {task_id: array}.
 
@@ -88,6 +166,17 @@ class TileFarm:
         heartbeat_interval = (constants.HEARTBEAT_INTERVAL
                               if heartbeat_interval is None else heartbeat_interval)
         job = await self.store.init_tile_job(job_id, total, chunk=chunk)
+        journal = (TileJournal(journal_dir, journal_key or job_id)
+                   if journal_dir else None)
+        if journal:
+            restored = 0
+            for tid, arr in journal.load().items():
+                if await self.store.restore_completed(job_id, tid,
+                                                      {"image": arr}):
+                    restored += 1
+            if restored:
+                log(f"tile-farm[{job_id}] resumed {restored} tasks "
+                    "from journal")
         deadline = (time.monotonic() + overall_timeout) if overall_timeout else None
         last_check = time.monotonic()
         log(f"tile-farm[{job_id}] master: {job.total_tasks} tasks "
@@ -108,14 +197,19 @@ class TileFarm:
                     process_fn, task["start"], task["end"])
                 await self.store.submit_result(
                     job_id, "master", task["task_id"], {"image": arr})
+                if journal:
+                    await asyncio.to_thread(journal.write, task["task_id"], arr)
             else:
                 # queue momentarily empty: wait for worker results
                 try:
-                    await asyncio.wait_for(
+                    tid, payload = await asyncio.wait_for(
                         job.results.get(),
                         timeout=min(constants.COLLECT_POLL_TIMEOUT,
                                     heartbeat_interval),
                     )
+                    if journal:
+                        await asyncio.to_thread(
+                            journal.write, tid, payload["image"])
                 except asyncio.TimeoutError:
                     pass
 
@@ -132,6 +226,8 @@ class TileFarm:
             results = {tid: payload["image"]
                        for tid, payload in job.completed.items()}
         await self.store.cleanup_job(job_id)
+        if journal:
+            journal.clear()
         log(f"tile-farm[{job_id}] complete ({len(results)} tasks)")
         return results
 

@@ -331,8 +331,12 @@ class UltimateSDUpscaleDistributed(NodeDef):
                                      process_images)
                 return (upscale_image(images, spec.scale,
                                       spec.resize_method),)
+            from ..utils import constants as _c
+
             results = tile_farm.master_run(
-                multi_job_id, images.shape[0], process_images, chunk=1)
+                multi_job_id, images.shape[0], process_images, chunk=1,
+                journal_dir=_c.TILE_JOURNAL_DIR or None,
+                journal_key=_journal_key(images, spec, seed))
             full = assemble_tiles(results, images.shape[0], 1)
             return (jnp.asarray(full),)
 
@@ -359,11 +363,27 @@ class UltimateSDUpscaleDistributed(NodeDef):
                 continue
             from ..cluster.tile_farm import assemble_tiles
 
+            from ..utils import constants as _c
+
             results = tile_farm.master_run(
-                job_id, plan.num_tiles, plan.run_range, chunk=plan.chunk)
+                job_id, plan.num_tiles, plan.run_range, chunk=plan.chunk,
+                journal_dir=_c.TILE_JOURNAL_DIR or None,
+                journal_key=_journal_key(images[b], spec, seed, b))
             tiles = assemble_tiles(results, plan.num_tiles, plan.chunk)
             outs.append(upscaler.composite(tiles, plan))
         return (jnp.stack([jnp.asarray(o) for o in outs], axis=0),)
+
+
+def _journal_key(images, spec, seed: int, index: int = 0) -> str:
+    """Stable crash-resume key: a re-submitted workflow gets a fresh
+    execution job id, so the journal is keyed by job CONTENT (input
+    pixels + spec + seed) instead."""
+    import hashlib
+
+    h = hashlib.sha1()
+    h.update(np.ascontiguousarray(np.asarray(images, np.float32)).tobytes())
+    h.update(repr((spec, int(seed), int(index))).encode())
+    return f"usdu_{h.hexdigest()[:20]}"
 
 
 def _adm_from_cond(cond: dict, adm_channels: int) -> jax.Array:

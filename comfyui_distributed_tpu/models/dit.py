@@ -49,13 +49,17 @@ class DiTConfig:
     dtype: str = "bfloat16"
     attn_backend: str = "dense"      # "dense" | "ring"
     pos_embed: str = "sincos"        # "sincos" | "rope"
+    remat: bool = False              # recompute block activations (HBM relief)
     rope_theta: float = 10000.0
     rope_axes_dim: Optional[tuple[int, int, int]] = None   # None → derived
 
     @classmethod
     def flux(cls) -> "DiTConfig":
+        from ..utils import constants
+
         # FLUX.1: head_dim 128 = 16 (txt/time axis) + 56 (row) + 56 (col)
-        return cls(pos_embed="rope", rope_axes_dim=(16, 56, 56))
+        return cls(pos_embed="rope", rope_axes_dim=(16, 56, 56),
+                   remat=constants.REMAT)
 
     @classmethod
     def tiny(cls, attn_backend: str = "dense",
@@ -335,14 +339,18 @@ class DiT(nn.Module):
                 timestep_embedding(gvec * 1000.0, 256).astype(dt))
         vec = nn.Dense(cfg.hidden, dtype=dt, name="vec_mlp")(nn.silu(vec))
 
+        DBlock = (nn.remat(DoubleBlock, static_argnums=(4,))
+                  if cfg.remat else DoubleBlock)
+        SBlock = (nn.remat(SingleBlock, static_argnums=(3, 4))
+                  if cfg.remat else SingleBlock)
         for i in range(cfg.depth_double):
-            img, txt = DoubleBlock(cfg, name=f"double_{i}")(
+            img, txt = DBlock(cfg, name=f"double_{i}")(
                 img, txt, vec, sp_axis, pe_img, pe_txt)
         xcat = jnp.concatenate([txt, img], axis=1)
         T = txt.shape[1]
         for i in range(cfg.depth_single):
-            xcat = SingleBlock(cfg, name=f"single_{i}")(xcat, vec, T, sp_axis,
-                                                        pe_full)
+            xcat = SBlock(cfg, name=f"single_{i}")(xcat, vec, T, sp_axis,
+                                                   pe_full)
         img = xcat[:, T:]
 
         sh, sc, _ = Modulation(1, cfg.hidden, dt, name="final_mod")(vec)

@@ -44,14 +44,23 @@ class UNetConfig:
     context_dim: int = 2048
     adm_in_channels: int = 0       # SDXL: 2816 (pooled text + size conds)
     dtype: str = "bfloat16"
+    # activation rematerialization: recompute block activations in the
+    # backward/later passes instead of keeping them in HBM — trades FLOPs
+    # for memory headroom on big latents (CDT_REMAT=1 flips the presets)
+    remat: bool = False
 
     @classmethod
     def sdxl(cls) -> "UNetConfig":
-        return cls()
+        from ..utils import constants
+
+        return cls(remat=constants.REMAT)
 
     @classmethod
     def sd15(cls) -> "UNetConfig":
+        from ..utils import constants
+
         return cls(
+            remat=constants.REMAT,
             channel_mult=(1, 2, 4, 4),
             transformer_depth=(1, 1, 1, 0),
             context_dim=768,
@@ -114,6 +123,9 @@ class UNet2D(nn.Module):
         if context is not None:
             context = context.astype(dt)
 
+        Res = nn.remat(ResBlock) if cfg.remat else ResBlock
+        Attn = nn.remat(SpatialTransformer) if cfg.remat else SpatialTransformer
+
         h = nn.Conv(cfg.model_channels, (3, 3), padding=1, dtype=dt, name="conv_in")(x)
         skips = [h]
 
@@ -121,9 +133,9 @@ class UNet2D(nn.Module):
         for level, mult in enumerate(cfg.channel_mult):
             ch = cfg.model_channels * mult
             for i in range(cfg.num_res_blocks):
-                h = ResBlock(ch, dt, name=f"down_{level}_res_{i}")(h, emb)
+                h = Res(ch, dt, name=f"down_{level}_res_{i}")(h, emb)
                 if cfg.transformer_depth[level]:
-                    h = SpatialTransformer(
+                    h = Attn(
                         cfg.heads_for(ch),
                         cfg.transformer_depth[level],
                         dt,
@@ -136,21 +148,21 @@ class UNet2D(nn.Module):
 
         # --- middle ---
         mid_ch = cfg.model_channels * cfg.channel_mult[-1]
-        h = ResBlock(mid_ch, dt, name="mid_res_1")(h, emb)
+        h = Res(mid_ch, dt, name="mid_res_1")(h, emb)
         if cfg.transformer_depth[-1]:
-            h = SpatialTransformer(
+            h = Attn(
                 cfg.heads_for(mid_ch), cfg.transformer_depth[-1], dt, name="mid_attn"
             )(h, context)
-        h = ResBlock(mid_ch, dt, name="mid_res_2")(h, emb)
+        h = Res(mid_ch, dt, name="mid_res_2")(h, emb)
 
         # --- up path ---
         for level in reversed(range(len(cfg.channel_mult))):
             ch = cfg.model_channels * cfg.channel_mult[level]
             for i in range(cfg.num_res_blocks + 1):
                 h = jnp.concatenate([h, skips.pop()], axis=-1)
-                h = ResBlock(ch, dt, name=f"up_{level}_res_{i}")(h, emb)
+                h = Res(ch, dt, name=f"up_{level}_res_{i}")(h, emb)
                 if cfg.transformer_depth[level]:
-                    h = SpatialTransformer(
+                    h = Attn(
                         cfg.heads_for(ch),
                         cfg.transformer_depth[level],
                         dt,

@@ -27,6 +27,14 @@ def _env_float(name: str, default: float) -> float:
 # --- cluster liveness (reference utils/constants.py:43-68) -----------------
 # Workers heartbeat per processed shard; master requeues work of hosts silent
 # longer than HEARTBEAT_TIMEOUT (reference upscale/job_timeout.py:17-150).
+# Optional crash-resume journal for long tile jobs (empty = disabled);
+# completed tasks persist as CDTF frames and a restarted master resumes.
+TILE_JOURNAL_DIR = os.environ.get("CDT_TILE_JOURNAL_DIR", "")
+
+# Activation rematerialization for the big-model presets (trade FLOPs for
+# HBM headroom on large latents/frames); tiny test configs ignore it.
+REMAT = os.environ.get("CDT_REMAT", "") not in ("", "0", "false")
+
 HEARTBEAT_INTERVAL = _env_float("CDT_HEARTBEAT_INTERVAL", 10.0)
 HEARTBEAT_TIMEOUT = _env_float("CDT_HEARTBEAT_TIMEOUT", 60.0)
 

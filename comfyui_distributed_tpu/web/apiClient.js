@@ -78,6 +78,12 @@ export const api = {
   localWorkerStatus: () => request("/distributed/local-worker-status"),
   clearLaunching: (workerId) => request("/distributed/worker/clear_launching", { method: "POST", body: { worker_id: workerId } }),
 
+  // observability
+  memoryStats: () => request("/distributed/memory_stats"),
+  stepTimes: () => request("/distributed/step_times"),
+  profileStart: (out) => request("/distributed/profile/start", { method: "POST", body: out ? { out } : {}, retries: 0 }),
+  profileStop: () => request("/distributed/profile/stop", { method: "POST", body: {}, retries: 0 }),
+
   // tunnel
   tunnelStatus: () => request("/distributed/tunnel/status"),
   tunnelStart: () => request("/distributed/tunnel/start", { method: "POST", body: {}, retries: 0, timeoutMs: 45000 }),

@@ -245,6 +245,34 @@ class TestRoutes:
                 assert net["recommended_ip"]
         run(body())
 
+    def test_profiler_and_observability_routes(self, tmp_config):
+        async def body():
+            controller, client = make_client()
+            async with client:
+                # memory stats: shape only (CPU backends report None)
+                res = await (await client.get("/distributed/memory_stats")).json()
+                assert len(res["devices"]) == 8
+                # step times: empty history → empty list
+                res = await (await client.get("/distributed/step_times")).json()
+                assert res["prompts"] == []
+                # profile start/stop round trip (CPU tracing works);
+                # client "out" is a sandboxed NAME under CDT_PROFILE_DIR
+                resp = await client.post("/distributed/profile/start",
+                                         json={"out": "../../../etc/x"})
+                data = await resp.json()
+                assert resp.status == 200
+                assert "/etc/" not in data["out"]
+                assert data["out"].startswith("/tmp/cdt_profile")
+                # double-start rejected
+                resp = await client.post("/distributed/profile/start", json={})
+                assert resp.status == 409
+                resp = await client.post("/distributed/profile/stop", json={})
+                assert resp.status == 200
+                # double-stop rejected
+                resp = await client.post("/distributed/profile/stop", json={})
+                assert resp.status == 409
+        run(body())
+
     def test_clear_launching_route(self, tmp_config):
         async def body():
             controller, client = make_client()

@@ -53,3 +53,51 @@ def test_text_encoder_tiny():
     # different prompts → different conditioning
     ctx3, _ = enc.encode(["something else entirely", "a dog"])
     assert not np.allclose(np.asarray(ctx[0]), np.asarray(ctx3[0]))
+
+
+def test_unet_remat_matches_plain():
+    """remat=True recomputes activations but must be numerically identical."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from comfyui_distributed_tpu.models.unet import UNetConfig, init_unet
+
+    cfg = UNetConfig.tiny(dtype="float32")
+    model, params = init_unet(cfg, jax.random.key(0), sample_shape=(8, 8, 4),
+                              context_len=8)
+    cfg_r = dataclasses.replace(cfg, remat=True)
+    from comfyui_distributed_tpu.models.unet import UNet2D
+
+    model_r = UNet2D(cfg_r)
+    x = jax.random.normal(jax.random.key(1), (1, 8, 8, 4))
+    t = jnp.ones((1,)) * 0.3
+    ctx = jax.random.normal(jax.random.key(2), (1, 8, cfg.context_dim))
+    y = jnp.ones((1, cfg.adm_in_channels))
+    a = np.asarray(model.apply(params, x, t, ctx, y))
+    b = np.asarray(model_r.apply(params, x, t, ctx, y))
+    np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-6)
+
+
+def test_dit_remat_matches_plain():
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from comfyui_distributed_tpu.models.dit import DiT, DiTConfig, init_dit
+
+    cfg = dataclasses.replace(DiTConfig.tiny(pos_embed="rope"), dtype="float32")
+    model, params = init_dit(cfg, jax.random.key(0), sample_hw=(8, 8),
+                             context_len=6)
+    model_r = DiT(dataclasses.replace(cfg, remat=True))
+    x = jax.random.normal(jax.random.key(1), (1, 8, 8, 4))
+    args = (x, jnp.ones((1,)) * 0.4,
+            jax.random.normal(jax.random.key(2), (1, 6, 32)),
+            jnp.ones((1, 16)))
+    a = np.asarray(model.apply(params, *args))
+    b = np.asarray(model_r.apply(params, *args))
+    np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-6)

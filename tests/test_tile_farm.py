@@ -337,3 +337,50 @@ class TestOversizedFrames:
                     np.testing.assert_array_equal(
                         images[i], (big + float(i))[0])
         run(body())
+
+
+class TestJournalResume:
+    def test_crash_resume_skips_journaled_tasks(self, tmp_config, tmp_path):
+        """Master run 1 journals its completions and 'crashes' (cancelled);
+        run 2 with the same journal restores them, recomputes only the
+        remainder, and clears the journal on success (SURVEY §5.4)."""
+        calls = []
+
+        def proc(start, end):
+            import time as _t
+
+            calls.append(start)
+            _t.sleep(0.05)
+            return np.stack([np.full((4, 4, 3), float(i), np.float32)
+                             for i in range(start, end)])
+
+        async def body():
+            store = JobStore()
+            farm = TileFarm(store, asyncio.get_running_loop())
+            task = asyncio.create_task(farm.master_run_async(
+                "jres", total=6, process_fn=proc, chunk=1,
+                heartbeat_interval=0.2, journal_dir=tmp_path))
+            while len(list((tmp_path / "jres").glob("task_*.cdtf"))) < 2:
+                await asyncio.sleep(0.02)   # let two tasks journal
+            task.cancel()
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):
+                pass
+            await store.cleanup_job("jres")
+            done_before = len(list((tmp_path / "jres").glob("task_*.cdtf")))
+            assert done_before >= 2
+
+            calls.clear()
+            store2 = JobStore()
+            farm2 = TileFarm(store2, asyncio.get_running_loop())
+            results = await farm2.master_run_async(
+                "jres", total=6, process_fn=proc, chunk=1,
+                heartbeat_interval=0.2, journal_dir=tmp_path)
+            tiles = assemble_tiles(results, 6, 1)
+            np.testing.assert_allclose(tiles[:, 0, 0, 0], np.arange(6.0))
+            # resumed tasks were NOT recomputed
+            assert len(calls) == 6 - done_before
+            # journal cleared after success
+            assert not (tmp_path / "jres").exists()
+        run(body())
