@@ -112,10 +112,12 @@ def register(router, controller) -> None:
         # "out" is a NAME under the profile root, never a client path —
         # same sandbox discipline as the media routes (an unauthenticated
         # peer must not direct filesystem writes)
+        from ..utils.names import sanitize_name
+
         root = os.environ.get("CDT_PROFILE_DIR", "/tmp/cdt_profile")
-        name = str(body.get("out") or _t.strftime("%Y%m%d-%H%M%S"))
-        name = "".join(c if (c.isalnum() or c in "-_.") else "_"
-                       for c in os.path.basename(name))[:80] or "trace"
+        name = sanitize_name(
+            os.path.basename(str(body.get("out") or _t.strftime("%Y%m%d-%H%M%S"))),
+            max_len=80, fallback="trace")
         out = os.path.join(root, name)
         try:
             jax.profiler.start_trace(out)
@@ -162,6 +164,40 @@ def register(router, controller) -> None:
             for pid, h in recent
         ]})
 
+    # --- shipped workflows --------------------------------------------------
+    def _workflows_dir() -> Path:
+        import os
+
+        env = os.environ.get("CDT_WORKFLOWS_DIR")
+        if env:
+            return Path(env)
+        # repo layout: workflows/ beside the package
+        return Path(__file__).resolve().parents[2] / "workflows"
+
+    async def list_workflows(request):
+        d = _workflows_dir()
+        names = sorted(p.stem for p in d.glob("*.json")) if d.is_dir() else []
+        return web.json_response({"workflows": names})
+
+    async def get_workflow(request):
+        import json
+
+        from ..utils.names import validate_name
+
+        name = validate_name(request.match_info["name"], max_len=80)
+        path = _workflows_dir() / f"{name}.json"
+        if not path.is_file():
+            return web.json_response(
+                {"error": f"no workflow {name!r}"}, status=404)
+        try:
+            return web.json_response(json.loads(path.read_text()))
+        except json.JSONDecodeError as e:
+            return web.json_response(
+                {"error": f"workflow {name!r} is invalid JSON: {e}"},
+                status=500)
+
+    router.add_get("/distributed/workflows", list_workflows)
+    router.add_get("/distributed/workflows/{name}", get_workflow)
     router.add_get("/distributed/system_info", system_info)
     router.add_get("/distributed/network_info", network_info)
     router.add_get("/distributed/local_log", local_log)

@@ -65,9 +65,10 @@ class TileJournal:
         import time
         from pathlib import Path
 
-        safe = "".join(c if (c.isalnum() or c in "-_.") else "_"
-                       for c in key)[:120]
-        self.dir = Path(root) / safe
+        from ..utils.names import sanitize_name
+
+        self.dir = Path(root) / sanitize_name(key, max_len=120,
+                                              fallback="job")
         self.dir.mkdir(parents=True, exist_ok=True)
         self.disabled = False
         # TTL sweep of abandoned sibling journals
@@ -166,11 +167,16 @@ class TileFarm:
         heartbeat_interval = (constants.HEARTBEAT_INTERVAL
                               if heartbeat_interval is None else heartbeat_interval)
         job = await self.store.init_tile_job(job_id, total, chunk=chunk)
-        journal = (TileJournal(journal_dir, journal_key or job_id)
-                   if journal_dir else None)
+        journal = None
+        if journal_dir:
+            # ctor (mkdir + TTL sweep) and load (read+unpack of possibly
+            # hundreds of MB) must not block the serving event loop
+            journal = await asyncio.to_thread(
+                TileJournal, journal_dir, journal_key or job_id)
         if journal:
             restored = 0
-            for tid, arr in journal.load().items():
+            loaded = await asyncio.to_thread(journal.load)
+            for tid, arr in loaded.items():
                 if await self.store.restore_completed(job_id, tid,
                                                       {"image": arr}):
                     restored += 1

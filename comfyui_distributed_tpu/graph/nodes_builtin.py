@@ -336,7 +336,9 @@ class UltimateSDUpscaleDistributed(NodeDef):
             results = tile_farm.master_run(
                 multi_job_id, images.shape[0], process_images, chunk=1,
                 journal_dir=_c.TILE_JOURNAL_DIR or None,
-                journal_key=_journal_key(images, spec, seed))
+                journal_key=_journal_key(images, spec, seed, 0, 1,
+                                         images.shape[0])
+                if _c.TILE_JOURNAL_DIR else None)
             full = assemble_tiles(results, images.shape[0], 1)
             return (jnp.asarray(full),)
 
@@ -368,21 +370,27 @@ class UltimateSDUpscaleDistributed(NodeDef):
             results = tile_farm.master_run(
                 job_id, plan.num_tiles, plan.run_range, chunk=plan.chunk,
                 journal_dir=_c.TILE_JOURNAL_DIR or None,
-                journal_key=_journal_key(images[b], spec, seed, b))
+                journal_key=_journal_key(images[b], spec, seed, b,
+                                         plan.chunk, plan.num_tiles)
+                if _c.TILE_JOURNAL_DIR else None)
             tiles = assemble_tiles(results, plan.num_tiles, plan.chunk)
             outs.append(upscaler.composite(tiles, plan))
         return (jnp.stack([jnp.asarray(o) for o in outs], axis=0),)
 
 
-def _journal_key(images, spec, seed: int, index: int = 0) -> str:
+def _journal_key(images, spec, seed: int, index: int = 0,
+                 chunk: int = 1, total: int = 0) -> str:
     """Stable crash-resume key: a re-submitted workflow gets a fresh
     execution job id, so the journal is keyed by job CONTENT (input
-    pixels + spec + seed) instead."""
+    pixels + spec + seed) — plus the task topology (chunk/total): a
+    restart on a different chip count must NOT restore payloads whose
+    arrays cover different tile ranges."""
     import hashlib
 
     h = hashlib.sha1()
     h.update(np.ascontiguousarray(np.asarray(images, np.float32)).tobytes())
-    h.update(repr((spec, int(seed), int(index))).encode())
+    h.update(repr((spec, int(seed), int(index), int(chunk),
+                   int(total))).encode())
     return f"usdu_{h.hexdigest()[:20]}"
 
 

@@ -51,10 +51,13 @@ class VideoDiTConfig:
     context_dim: int = 4096
     pooled_dim: int = 768
     dtype: str = "bfloat16"
+    remat: bool = False              # recompute block activations (HBM relief)
 
     @classmethod
     def wan(cls) -> "VideoDiTConfig":
-        return cls()
+        from ..utils import constants
+
+        return cls(remat=constants.REMAT)
 
     @classmethod
     def tiny(cls) -> "VideoDiTConfig":
@@ -67,7 +70,8 @@ class VideoDiTConfig:
             hidden=self.hidden, depth_double=self.depth_double,
             depth_single=self.depth_single, heads=self.heads,
             context_dim=self.context_dim, pooled_dim=self.pooled_dim,
-            guidance_embed=False, dtype=dtype or self.dtype)
+            guidance_embed=False, dtype=dtype or self.dtype,
+            remat=self.remat)
 
     @property
     def jnp_dtype(self):
@@ -146,12 +150,16 @@ class VideoDiT(nn.Module):
             pooled.astype(dt))
         vec = nn.Dense(cfg.hidden, dtype=dt, name="vec_mlp")(nn.silu(vec))
 
+        DBlock = (nn.remat(DoubleBlock, static_argnums=(4,))
+                  if dcfg.remat else DoubleBlock)
+        SBlock = (nn.remat(SingleBlock, static_argnums=(3, 4))
+                  if dcfg.remat else SingleBlock)
         for i in range(cfg.depth_double):
-            img, txt = DoubleBlock(dcfg, name=f"double_{i}")(img, txt, vec, sp_axis)
+            img, txt = DBlock(dcfg, name=f"double_{i}")(img, txt, vec, sp_axis)
         xcat = jnp.concatenate([txt, img], axis=1)
         T = txt.shape[1]
         for i in range(cfg.depth_single):
-            xcat = SingleBlock(dcfg, name=f"single_{i}")(xcat, vec, T, sp_axis)
+            xcat = SBlock(dcfg, name=f"single_{i}")(xcat, vec, T, sp_axis)
         img = xcat[:, T:]
 
         sh, sc, _ = Modulation(1, cfg.hidden, dt, name="final_mod")(vec)

@@ -78,6 +78,10 @@ export const api = {
   localWorkerStatus: () => request("/distributed/local-worker-status"),
   clearLaunching: (workerId) => request("/distributed/worker/clear_launching", { method: "POST", body: { worker_id: workerId } }),
 
+  // shipped workflows
+  listWorkflows: () => request("/distributed/workflows"),
+  getWorkflow: (name) => request(`/distributed/workflows/${encodeURIComponent(name)}`),
+
   // observability
   memoryStats: () => request("/distributed/memory_stats"),
   stepTimes: () => request("/distributed/step_times"),

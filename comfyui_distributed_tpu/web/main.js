@@ -368,8 +368,30 @@ function alertError(e) {
   alert(e.message || String(e));
 }
 
+async function loadWorkflowList() {
+  try {
+    const res = await api.listWorkflows();
+    const sel = $("workflow-select");
+    for (const name of res.workflows || []) {
+      const opt = document.createElement("option");
+      opt.value = name;
+      opt.textContent = name;
+      sel.appendChild(opt);
+    }
+  } catch { /* route absent on older controllers */ }
+}
+
 async function init() {
   $("queue-form").onsubmit = submitQueue;
+  $("btn-load-workflow").onclick = async () => {
+    const name = $("workflow-select").value;
+    if (!name) return;
+    try {
+      const wf = await api.getWorkflow(name);
+      delete wf._meta;
+      $("queue-prompt").value = JSON.stringify(wf, null, 2);
+    } catch (e) { alertError(e); }
+  };
   $("btn-add-worker").onclick = () => openEditor(null);
   $("editor-cancel").onclick = () => { $("editor-backdrop").hidden = true; };
   $("editor-form").onsubmit = saveEditor;
@@ -397,6 +419,7 @@ async function init() {
   $("master-dot").ondblclick = () => openLog("__local__");
 
   await refreshConfig();
+  await loadWorkflowList();
   await refreshManaged();
   await refreshTunnel();
   await pollStatus();
