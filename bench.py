@@ -66,6 +66,23 @@ def _cost_analysis_flops(compiled) -> float | None:
     return None
 
 
+def _enable_compile_cache() -> None:
+    """Persistent XLA compilation cache: on the flaky tunneled accelerator,
+    a successful compile from ANY earlier attempt (even one whose run died
+    later) is reused, so watcher retries make monotonic progress."""
+    import jax
+
+    cache_dir = os.environ.get(
+        "CDT_COMPILE_CACHE_DIR",
+        os.path.join(tempfile.gettempdir(), "cdt_xla_cache"))
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    except Exception as e:  # cache is an optimization, never a requirement
+        print(f"[bench] compile cache unavailable: {e}", file=sys.stderr)
+
+
 def run_benchmark(steps: int, runs: int | None, force_cpu: bool) -> dict:
     """The actual measurement (single process, current JAX backend)."""
     import jax
@@ -73,6 +90,7 @@ def run_benchmark(steps: int, runs: int | None, force_cpu: bool) -> dict:
 
     if force_cpu:
         jax.config.update("jax_platforms", "cpu")
+    _enable_compile_cache()
     platform = jax.devices()[0].platform
     on_accel = platform not in ("cpu",)
 
@@ -203,6 +221,7 @@ def run_usdu_benchmark(steps: int, runs: int | None, force_cpu: bool) -> dict:
 
     if force_cpu:
         jax.config.update("jax_platforms", "cpu")
+    _enable_compile_cache()
     platform = jax.devices()[0].platform
     on_accel = platform not in ("cpu",)
 
@@ -277,17 +296,14 @@ def run_usdu_benchmark(steps: int, runs: int | None, force_cpu: bool) -> dict:
     }
 
 
+def _workload_fn(workload: str):
+    return run_usdu_benchmark if workload == "usdu" else run_benchmark
+
+
 def _inner_main(cli) -> None:
     force_cpu = os.environ.get("JAX_PLATFORMS", "") == "cpu"
-    if cli.workload == "usdu":
-        result = run_usdu_benchmark(cli.steps, cli.runs, force_cpu)
-    else:
-        result = run_benchmark(cli.steps, cli.runs, force_cpu)
-    line = json.dumps(result)
-    if cli.out:
-        with open(cli.out, "w") as f:
-            f.write(line + "\n")
-    print(line)
+    result = _workload_fn(cli.workload)(cli.steps, cli.runs, force_cpu)
+    _emit(result, cli.out)
 
 
 def _watchdog_main(cli) -> None:
@@ -405,10 +421,8 @@ def main() -> None:
     if cli.inner or os.environ.get("JAX_PLATFORMS", "") == "cpu":
         # explicit CPU (test harness) skips the watchdog
         if os.environ.get("JAX_PLATFORMS", "") == "cpu" and not cli.inner:
-            if cli.workload == "usdu":
-                result = run_usdu_benchmark(cli.steps, cli.runs, force_cpu=True)
-            else:
-                result = run_benchmark(cli.steps, cli.runs, force_cpu=True)
+            result = _workload_fn(cli.workload)(cli.steps, cli.runs,
+                                                force_cpu=True)
             result["tpu_attempted"] = False
             result["tpu_error"] = "JAX_PLATFORMS=cpu requested explicitly"
             _emit(result, cli.out)

@@ -103,6 +103,25 @@ class Orchestrator:
 
         job_ids = generate_job_id_map(prompt, trace_id)
         worker_ids = tuple(h.get("id", f"host{i}") for i, h in enumerate(online))
+        # worker_index is the host's position among the hosts ENABLED IN
+        # CONFIG (the exact list the dashboard's widget layer keys its
+        # 1-indexed worker_values by) — never the online survivors or a
+        # caller-supplied enabled_ids subset: DistributedSeed offsets and
+        # per-worker overrides stay pinned to the same host across
+        # outages, load-balance picks, and partial dispatches (reference
+        # parity: worker_N's offset comes from its config number,
+        # nodes/utilities.py:52-75). A host selected by id while disabled
+        # in config falls back to its position in the full host list.
+        stable_index = {
+            h.get("id", f"host{i}"): i
+            for i, h in enumerate(config.get("hosts", []))
+            if not h.get("enabled")
+        }
+        stable_index.update({
+            h.get("id", f"host{i}"): i
+            for i, h in enumerate(
+                [h for h in config.get("hosts", []) if h.get("enabled")])
+        })
         for jid in job_ids.values():
             await self.store.prepare_collector_job(jid, worker_ids)
 
@@ -139,7 +158,8 @@ class Orchestrator:
                     return wid, "nothing to dispatch (no distributed nodes)"
                 wprompt = apply_participant_overrides(
                     wprompt, wid, job_ids, master_url=callback,
-                    enabled_worker_ids=worker_ids, worker_index=index,
+                    enabled_worker_ids=worker_ids,
+                    worker_index=stable_index.get(wid, index),
                 )
                 if host_type == "remote":
                     # remote hosts don't share the master's filesystem:

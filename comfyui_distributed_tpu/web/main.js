@@ -160,6 +160,7 @@ async function refreshConfig() {
   renderWorkers();
   renderSettings();
   renderMesh();
+  renderNodeWidgets();
 }
 
 async function refreshManaged() {
@@ -258,6 +259,81 @@ async function submitQueue(ev) {
   } catch (e) {
     result.textContent = "Error: " + e.message +
       (e.data ? "\n" + JSON.stringify(e.data, null, 2) : "");
+  }
+}
+
+// ---------------------------------------------------------------------------
+// per-node widget layer (parity: reference web/distributedValue.js — per-
+// worker value widgets for DistributedValue nodes, two-way synced with the
+// prompt JSON's `worker_values` map; keys are 1-indexed worker numbers,
+// nodes/utilities.py:86-162)
+// ---------------------------------------------------------------------------
+
+function parsePrompt() {
+  try { return JSON.parse($("queue-prompt").value); } catch { return null; }
+}
+
+function writePromptInput(nodeId, field, value) {
+  const prompt = parsePrompt();
+  if (!prompt || !prompt[nodeId]) return;
+  prompt[nodeId].inputs = prompt[nodeId].inputs || {};
+  prompt[nodeId].inputs[field] = value;
+  $("queue-prompt").value = JSON.stringify(prompt, null, 2);
+}
+
+function renderNodeWidgets() {
+  const root = $("node-widgets");
+  root.replaceChildren();
+  const prompt = parsePrompt();
+  const hosts = ((state.config || {}).hosts || []).filter((w) => w.enabled);
+  const dvNodes = prompt
+    ? Object.entries(prompt).filter(
+        ([, n]) => n && n.class_type === "DistributedValue")
+    : [];
+  if (!dvNodes.length || !hosts.length) { root.hidden = true; return; }
+  root.hidden = false;
+  for (const [nodeId, node] of dvNodes) {
+    const inputs = node.inputs || {};
+    let mapping = {};
+    try { mapping = JSON.parse(inputs.worker_values || "{}") || {}; }
+    catch { mapping = {}; }
+    const vtype = String(inputs.value_type || mapping._type || "").toUpperCase();
+
+    const box = document.createElement("div");
+    box.className = "dv-node";
+    const title = document.createElement("div");
+    title.className = "meta";
+    const dflt = Array.isArray(inputs.default_value)
+      ? `link ${JSON.stringify(inputs.default_value)}`
+      : JSON.stringify(inputs.default_value ?? null);
+    title.textContent =
+      `DistributedValue #${nodeId}${vtype ? ` (${vtype})` : ""} — default ${dflt}`;
+    box.appendChild(title);
+
+    const grid = document.createElement("div");
+    grid.className = "kv";
+    hosts.forEach((w, i) => {
+      const key = String(i + 1);              // 1-indexed per reference
+      const kd = document.createElement("div");
+      kd.className = "k";
+      kd.textContent = `${w.name || w.id} (#${key})`;
+      const input = document.createElement("input");
+      if (vtype === "INT" || vtype === "FLOAT") input.type = "number";
+      input.value = mapping[key] ?? "";
+      input.placeholder = "(default)";
+      input.onchange = () => {
+        if (input.value === "") delete mapping[key];
+        else mapping[key] = (vtype === "INT" || vtype === "FLOAT")
+          ? Number(input.value) : input.value;
+        const hasValues = Object.keys(mapping).some((k) => k !== "_type");
+        if (vtype && hasValues) mapping._type = vtype;
+        else delete mapping._type;
+        writePromptInput(nodeId, "worker_values", JSON.stringify(mapping));
+      };
+      grid.append(kd, input);
+    });
+    box.appendChild(grid);
+    root.appendChild(box);
   }
 }
 
@@ -390,8 +466,14 @@ async function init() {
       const wf = await api.getWorkflow(name);
       delete wf._meta;
       $("queue-prompt").value = JSON.stringify(wf, null, 2);
+      renderNodeWidgets();
     } catch (e) { alertError(e); }
   };
+  let widgetDebounce = null;
+  $("queue-prompt").addEventListener("input", () => {
+    clearTimeout(widgetDebounce);
+    widgetDebounce = setTimeout(renderNodeWidgets, 400);
+  });
   $("btn-add-worker").onclick = () => openEditor(null);
   $("editor-cancel").onclick = () => { $("editor-backdrop").hidden = true; };
   $("editor-form").onsubmit = saveEditor;

@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""Persistent TPU benchmark watcher.
+
+The accelerator tunnel in this environment is flaky: it can refuse
+connections, hang ``jax.devices()``, or die mid-compile. This watcher
+loops forever (until both artifacts are captured or ``--budget-s`` runs
+out): cheap probe first, then the real benchmark runs, each in watchdog
+subprocesses so a hung tunnel never wedges the loop.
+
+Artifacts (committed so the numbers survive tunnel outages):
+- ``benchmarks/r{N}_tpu.json``        — txt2img images/sec + MFU
+- ``benchmarks/r{N}_tpu_usdu.json``   — 4K USDU wall-clock
+
+Usage: ``nohup python scripts/tpu_bench_watcher.py --round 2 &``
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PROBE_SRC = "import jax; print(jax.devices()[0].platform)"
+
+
+def probe(timeout_s: float) -> bool:
+    """True iff jax.devices() resolves to a non-CPU backend in time."""
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", PROBE_SRC],
+            timeout=timeout_s, capture_output=True, text=True, cwd=ROOT,
+        )
+    except subprocess.TimeoutExpired:
+        return False
+    last = (out.stdout or "").strip().splitlines()
+    return out.returncode == 0 and bool(last) and last[-1] != "cpu"
+
+
+def captured(path: str) -> bool:
+    """True iff the artifact holds a real accelerator result."""
+    try:
+        with open(path) as f:
+            data = json.loads(f.read())
+        return data.get("platform") not in (None, "cpu") and data.get("value", 0) > 0
+    except (OSError, json.JSONDecodeError):
+        return False
+
+
+def run_bench(workload: str, out_path: str, timeout_s: float) -> bool:
+    cmd = [sys.executable, os.path.join(ROOT, "bench.py"), "--inner",
+           "--workload", workload, "--out", out_path]
+    print(f"[watcher] running {workload} bench (timeout {timeout_s:.0f}s)",
+          flush=True)
+    try:
+        proc = subprocess.run(cmd, timeout=timeout_s, capture_output=True,
+                              text=True, cwd=ROOT)
+    except subprocess.TimeoutExpired:
+        print(f"[watcher] {workload} timed out", flush=True)
+        return False
+    if proc.returncode != 0:
+        tail = "\n".join((proc.stderr or "").strip().splitlines()[-4:])
+        print(f"[watcher] {workload} failed:\n{tail}", flush=True)
+        return False
+    ok = captured(out_path)
+    print(f"[watcher] {workload} -> {'CAPTURED' if ok else 'cpu/invalid'}",
+          flush=True)
+    return ok
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--round", type=int, default=2)
+    ap.add_argument("--budget-s", type=float, default=10 * 3600)
+    ap.add_argument("--probe-timeout-s", type=float, default=180)
+    ap.add_argument("--bench-timeout-s", type=float, default=3600)
+    ap.add_argument("--poll-s", type=float, default=120)
+    cli = ap.parse_args()
+
+    bdir = os.path.join(ROOT, "benchmarks")
+    os.makedirs(bdir, exist_ok=True)
+    targets = [
+        ("txt2img", os.path.join(bdir, f"r{cli.round:02d}_tpu.json")),
+        ("usdu", os.path.join(bdir, f"r{cli.round:02d}_tpu_usdu.json")),
+    ]
+    start = time.monotonic()
+    while time.monotonic() - start < cli.budget_s:
+        todo = [(w, p) for w, p in targets if not captured(p)]
+        if not todo:
+            print("[watcher] all artifacts captured — done", flush=True)
+            return
+        if probe(cli.probe_timeout_s):
+            print("[watcher] TPU reachable", flush=True)
+            for workload, path in todo:
+                run_bench(workload, path, cli.bench_timeout_s)
+        else:
+            print(f"[watcher] TPU unreachable "
+                  f"({(time.monotonic() - start) / 60:.0f}m elapsed)",
+                  flush=True)
+        time.sleep(cli.poll_s)
+    print("[watcher] budget exhausted", flush=True)
+
+
+if __name__ == "__main__":
+    main()

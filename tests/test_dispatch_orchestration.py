@@ -158,6 +158,43 @@ class TestOrchestrator:
         res = run(body())
         assert res.dispatched_to == ["w1"]
 
+    def test_worker_index_stable_under_outage(self, monkeypatch):
+        """worker_index pins to the host's position among ENABLED hosts, not
+        the online survivors: seeds and 1-indexed worker_values keys stay
+        with the same host when a peer is offline (reference parity —
+        worker_N's seed offset is its config number, utilities.py:52-75)."""
+        sent = []
+        orch, store, queue = self._make(
+            monkeypatch, hosts(3), probe_ok={"w0", "w2"}, dispatch_log=sent)
+        prompt = distributed_prompt()
+        # wire the seed into the retained subgraph so pruning keeps it
+        prompt["3"]["inputs"]["height"] = ["2", 0]
+
+        async def body():
+            return await orch.orchestrate(prompt)
+        run(body())
+        indices = {wid: wprompt["2"]["inputs"]["worker_index"]
+                   for wid, wprompt in sent}
+        assert indices == {"w0": 0, "w2": 2}   # w2 keeps index 2, not 1
+
+    def test_worker_index_stable_under_enabled_ids_subset(self, monkeypatch):
+        """A /distributed/queue call that names a subset via
+        enabled_worker_ids must not renumber the chosen host: its
+        worker_index is its position among the config-enabled hosts."""
+        sent = []
+        orch, store, queue = self._make(monkeypatch, hosts(3),
+                                        dispatch_log=sent)
+        prompt = distributed_prompt()
+        prompt["3"]["inputs"]["height"] = ["2", 0]
+
+        async def body():
+            return await orch.orchestrate(prompt, enabled_ids=["w2"])
+        run(body())
+        assert len(sent) == 1
+        wid, wprompt = sent[0]
+        assert wid == "w2"
+        assert wprompt["2"]["inputs"]["worker_index"] == 2
+
     def test_delegate_disabled_when_all_offline(self, monkeypatch):
         orch, store, queue = self._make(monkeypatch, hosts(2), probe_ok=set())
 

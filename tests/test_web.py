@@ -145,6 +145,17 @@ class TestDashboardDomContract:
         missing = used - defined
         assert not missing, f"main.js calls undefined api methods: {sorted(missing)}"
 
+    def test_widget_layer_covers_distributed_value(self):
+        """The per-node widget layer (reference web/distributedValue.js)
+        edits `worker_values` maps keyed by 1-indexed worker number — the
+        exact contract DistributedValue.execute reads
+        (graph/nodes_builtin.py)."""
+        main = (self.WEB / "main.js").read_text()
+        assert "renderNodeWidgets" in main
+        assert '"DistributedValue"' in main
+        assert '"worker_values"' in main
+        assert "String(i + 1)" in main   # 1-indexed keys per reference
+
 
 class TestInterruptExecution:
     def test_interrupt_drops_pending(self, tmp_config):
