@@ -102,26 +102,28 @@ class Orchestrator:
             delegate_master = False
 
         job_ids = generate_job_id_map(prompt, trace_id)
-        worker_ids = tuple(h.get("id", f"host{i}") for i, h in enumerate(online))
-        # worker_index is the host's position among the hosts ENABLED IN
-        # CONFIG (the exact list the dashboard's widget layer keys its
-        # 1-indexed worker_values by) — never the online survivors or a
+        # worker_index is the host's position in the FULL config host list
+        # (one numbering scheme for every host, unique by construction, and
+        # the exact list the dashboard's widget layer keys its 1-indexed
+        # worker_values by) — never the online survivors or a
         # caller-supplied enabled_ids subset: DistributedSeed offsets and
-        # per-worker overrides stay pinned to the same host across
-        # outages, load-balance picks, and partial dispatches (reference
-        # parity: worker_N's offset comes from its config number,
-        # nodes/utilities.py:52-75). A host selected by id while disabled
-        # in config falls back to its position in the full host list.
-        stable_index = {
-            h.get("id", f"host{i}"): i
-            for i, h in enumerate(config.get("hosts", []))
-            if not h.get("enabled")
+        # per-worker overrides stay pinned to the same host across outages,
+        # load-balance picks, partial dispatches, and enable-flag flips
+        # (reference parity: worker_N's offset comes from its config
+        # number, nodes/utilities.py:52-75). Id-less hosts get the same
+        # host{config_position} name at every site via _host_name.
+        all_hosts = config.get("hosts", [])
+        host_names = {
+            id(h): (h.get("id") or f"host{i}")
+            for i, h in enumerate(all_hosts)
         }
-        stable_index.update({
-            h.get("id", f"host{i}"): i
-            for i, h in enumerate(
-                [h for h in config.get("hosts", []) if h.get("enabled")])
-        })
+
+        def _host_name(h: dict, fallback_i: int) -> str:
+            return host_names.get(id(h)) or h.get("id") or f"host{fallback_i}"
+
+        stable_index = {host_names[id(h)]: i
+                        for i, h in enumerate(all_hosts)}
+        worker_ids = tuple(_host_name(h, i) for i, h in enumerate(online))
         for jid in job_ids.values():
             await self.store.prepare_collector_job(jid, worker_ids)
 
@@ -142,7 +144,7 @@ class Orchestrator:
 
         async def prep_and_dispatch(index: int, host: dict) -> tuple[str, Optional[str]]:
             async with sem:
-                wid = host.get("id", f"host{index}")
+                wid = _host_name(host, index)
                 host_type = host.get("type")
                 if host_type not in ("local", "remote"):
                     # config didn't pin a type: machine-id comparison

@@ -413,6 +413,96 @@ def _adm_from_cond(cond: dict, adm_channels: int) -> jax.Array:
 # --------------------------------------------------------------------------
 
 
+_UPSCALER_PRESETS = {
+    "tiny-x2": lambda cfg_mod: cfg_mod.UpscalerConfig.tiny(scale=2),
+    "tiny-x4": lambda cfg_mod: cfg_mod.UpscalerConfig.tiny(scale=4),
+    "esrgan-x4": lambda cfg_mod: cfg_mod.UpscalerConfig.esrgan_x4(),
+    "realesrgan-x2": lambda cfg_mod: cfg_mod.UpscalerConfig.realesrgan_x2(),
+}
+_upscaler_cache: dict[str, Any] = {}
+
+
+@register_node("UpscaleModelLoader")
+class UpscaleModelLoader(NodeDef):
+    """ESRGAN-family model loader (ComfyUI-core surface the reference's
+    upscale workflows assume: ``UpscaleModelLoader`` →
+    ``ImageUpscaleWithModel`` feeding USDU's input,
+    ``workflows/distributed-upscale.json``). ``model_name`` is either a
+    published RRDBNet ``.safetensors`` under ``CDT_UPSCALE_MODEL_DIR``
+    (falling back to ``CDT_CHECKPOINT_ROOT/upscalers``) — converted on
+    load — or an architecture preset name (random-init, for tests and
+    architecture work)."""
+
+    INPUTS = {"model_name": "STRING"}
+    RETURNS = ("UPSCALE_MODEL",)
+
+    def execute(self, model_name: str, **_):
+        import os
+
+        name = str(model_name)
+        root = os.environ.get("CDT_UPSCALE_MODEL_DIR") or (
+            os.path.join(os.environ["CDT_CHECKPOINT_ROOT"], "upscalers")
+            if os.environ.get("CDT_CHECKPOINT_ROOT") else "")
+        candidate = Path(root) / f"{name}.safetensors" if root else None
+        if name.endswith(".safetensors") and root:
+            candidate = Path(root) / name
+        # cache entries are keyed by their weight SOURCE: a checkpoint
+        # dropped in after a random-init fallback (or replaced on disk)
+        # must win on the next load, not be shadowed until restart
+        if candidate is not None and candidate.is_file():
+            source = ("file", str(candidate), candidate.stat().st_mtime_ns)
+        elif name in _UPSCALER_PRESETS:
+            source = ("preset", name)
+        else:
+            raise ValidationError(
+                f"unknown upscale model {name!r}: no checkpoint under "
+                f"{root or '$CDT_UPSCALE_MODEL_DIR'} and not one of "
+                f"{sorted(_UPSCALER_PRESETS)}", field="model_name")
+        cached = _upscaler_cache.get(name)
+        if cached is not None and cached[0] == source:
+            return (cached[1],)
+        if source[0] == "file":
+            from ..models.convert import load_upscaler_checkpoint
+
+            bundle = load_upscaler_checkpoint(candidate)
+        else:
+            from ..models import upscaler as upscaler_mod
+
+            cfg = _UPSCALER_PRESETS[name](upscaler_mod)
+            bundle = upscaler_mod.init_upscaler(cfg, jax.random.key(0))
+            bundle.name = name
+            log(f"upscaler {name!r}: no checkpoint found — random init")
+        _upscaler_cache[name] = (source, bundle)
+        return (bundle,)
+
+
+@register_node("ImageUpscaleWithModel")
+class ImageUpscaleWithModel(NodeDef):
+    """Tile-sharded learned upscale: the tile batch shards over the mesh's
+    dp axis in one SPMD program (TPU redesign of ComfyUI's single-GPU
+    tiled torch loop the reference free-rides on)."""
+
+    INPUTS = {"upscale_model": "UPSCALE_MODEL", "image": "IMAGE"}
+    OPTIONAL = {"tile": "INT", "tile_padding": "INT"}
+    HIDDEN = {"mesh": "*"}
+    RETURNS = ("IMAGE",)
+
+    def execute(self, upscale_model, image, tile: int = 256,
+                tile_padding: int = 16, mesh=None, **_):
+        from ..parallel.mesh import build_mesh
+        from ..tiles.model_upscale import tiled_model_upscale
+
+        if mesh is None:
+            mesh = build_mesh({"dp": len(jax.devices())})
+        images = jnp.asarray(image, jnp.float32)
+        if images.ndim == 3:
+            images = images[None]
+        tile = min(int(tile), images.shape[1], images.shape[2])
+        out = tiled_model_upscale(mesh, upscale_model, images,
+                                  tile=tile, padding=int(tile_padding))
+        return (np.asarray(out),)
+
+
 @register_node("CheckpointLoader")
 class CheckpointLoader(NodeDef):
     INPUTS = {"ckpt_name": "STRING"}

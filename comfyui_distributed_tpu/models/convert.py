@@ -490,3 +490,92 @@ def convert_checkpoint(path: Path, bundle) -> None:
              if k.startswith("cond_stage_model.transformer.")},
             clip.params, clip.config)
     log(f"converted {path} into {bundle.preset.name} bundle")
+
+
+# ---------------------------------------------------------------------------
+# ESRGAN-family upscalers (RRDBNet)
+# ---------------------------------------------------------------------------
+
+def _upscaler_config_from_sd(sd: Mapping[str, np.ndarray]):
+    """Infer the RRDBNet geometry from checkpoint shapes.
+
+    Supports both published layouts: BasicSR/Real-ESRGAN "new arch"
+    (``conv_first``/``body.N...``) and original-ESRGAN "old arch"
+    (``model.0``/``model.1.sub.N...``) — the layout every community
+    checkpoint (4x-UltraSharp, RealESRGAN_x4plus, …) uses.
+    """
+    from .upscaler import UpscalerConfig
+
+    if "conv_first.weight" in sd:
+        arch = "new"
+        first = sd["conv_first.weight"]
+        blocks = {int(k.split(".")[1]) for k in sd if k.startswith("body.")}
+        grow = sd["body.0.rdb1.conv1.weight"].shape[0]
+    elif "model.0.weight" in sd:
+        arch = "old"
+        first = sd["model.0.weight"]
+        blocks = {int(k.split(".")[3]) for k in sd
+                  if k.startswith("model.1.sub.") and ".RDB" in k}
+        grow = sd["model.1.sub.0.RDB1.conv1.0.weight"].shape[0]
+    else:
+        raise ConversionError("unrecognized upscaler layout "
+                              "(no conv_first.* / model.0.*)")
+    num_feat, in_total = first.shape[0], first.shape[1]
+    # pixel-unshuffle stem encodes the scale in the stem's input width
+    scale = {1: 4, 4: 2, 16: 1}.get(in_total // 3)
+    if scale is None or in_total % 3:
+        raise ConversionError(f"cannot infer scale from stem width {in_total}")
+    cfg = UpscalerConfig(scale=scale, num_feat=num_feat,
+                         num_block=max(blocks) + 1, grow_ch=grow)
+    return cfg, arch
+
+
+def convert_upscaler(sd: Mapping[str, np.ndarray]):
+    """torch RRDBNet state dict → (config, flax params)."""
+    from .upscaler import init_upscaler
+
+    cfg, arch = _upscaler_config_from_sd(sd)
+    import jax
+
+    template = init_upscaler(cfg, jax.random.key(0), sample_hw=(16, 16)).params
+    f = _Filler(sd, template)
+
+    if arch == "new":
+        def body_key(i, j, k):
+            return f"body.{i}.rdb{j}.conv{k}"
+        heads = {"conv_first": "conv_first", "conv_body": "conv_body",
+                 "conv_up1": "conv_up1", "conv_up2": "conv_up2",
+                 "conv_hr": "conv_hr", "conv_last": "conv_last"}
+    else:
+        def body_key(i, j, k):
+            return f"model.1.sub.{i}.RDB{j}.conv{k}.0"
+        heads = {"model.0": "conv_first",
+                 f"model.1.sub.{cfg.num_block}": "conv_body",
+                 "model.3": "conv_up1", "model.6": "conv_up2",
+                 "model.8": "conv_hr", "model.10": "conv_last"}
+
+    for src, dst in heads.items():
+        f.conv(src, f"params/{dst}")
+    for i in range(cfg.num_block):
+        for j in (1, 2, 3):
+            for k in (1, 2, 3, 4, 5):
+                f.conv(body_key(i, j, k),
+                       f"params/body_{i}/rdb{j}/conv{k}")
+    params = f.finish()
+    leftover = sorted(set(sd) - f.used)
+    if leftover:
+        raise ConversionError(
+            f"unconsumed upscaler keys: {leftover[:8]}"
+            f"{'…' if len(leftover) > 8 else ''}")
+    return cfg, params
+
+
+def load_upscaler_checkpoint(path: Path):
+    """Published ``.safetensors`` RRDBNet → ``UpscalerBundle``."""
+    from .upscaler import RRDBNet, UpscalerBundle
+
+    sd = load_safetensors(Path(path))
+    cfg, params = convert_upscaler(sd)
+    log(f"converted upscaler {path} "
+        f"(x{cfg.scale}, {cfg.num_block} blocks, {cfg.num_feat} feat)")
+    return UpscalerBundle(RRDBNet(cfg), params, name=Path(path).stem)

@@ -285,7 +285,11 @@ function renderNodeWidgets() {
   const root = $("node-widgets");
   root.replaceChildren();
   const prompt = parsePrompt();
-  const hosts = ((state.config || {}).hosts || []).filter((w) => w.enabled);
+  // worker_values keys are 1-indexed positions in the FULL config host
+  // list (the orchestrator's stable worker_index contract) — enabled
+  // hosts are shown, but each keeps its config-position number
+  const hosts = (((state.config || {}).hosts || [])
+    .map((w, i) => [w, i])).filter(([w]) => w.enabled);
   const dvNodes = prompt
     ? Object.entries(prompt).filter(
         ([, n]) => n && n.class_type === "DistributedValue")
@@ -312,8 +316,8 @@ function renderNodeWidgets() {
 
     const grid = document.createElement("div");
     grid.className = "kv";
-    hosts.forEach((w, i) => {
-      const key = String(i + 1);              // 1-indexed per reference
+    hosts.forEach(([w, configIdx]) => {
+      const key = String(configIdx + 1);      // 1-indexed per reference
       const kd = document.createElement("div");
       kd.className = "k";
       kd.textContent = `${w.name || w.id} (#${key})`;

@@ -195,6 +195,26 @@ class TestOrchestrator:
         assert wid == "w2"
         assert wprompt["2"]["inputs"]["worker_index"] == 2
 
+    def test_worker_index_unique_with_disabled_host(self, monkeypatch):
+        """One numbering scheme (full config-list position) for every
+        host: a config-disabled host explicitly selected via enabled_ids
+        cannot collide with an enabled host's index."""
+        sent = []
+        cfg_hosts = hosts(2)
+        cfg_hosts[0]["enabled"] = False          # w0 disabled in config
+        orch, store, queue = self._make(monkeypatch, cfg_hosts,
+                                        dispatch_log=sent)
+        prompt = distributed_prompt()
+        prompt["3"]["inputs"]["height"] = ["2", 0]
+
+        async def body():
+            return await orch.orchestrate(prompt,
+                                          enabled_ids=["w0", "w1"])
+        run(body())
+        indices = {wid: wprompt["2"]["inputs"]["worker_index"]
+                   for wid, wprompt in sent}
+        assert indices == {"w0": 0, "w1": 1}
+
     def test_delegate_disabled_when_all_offline(self, monkeypatch):
         orch, store, queue = self._make(monkeypatch, hosts(2), probe_ok=set())
 

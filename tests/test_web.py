@@ -154,7 +154,9 @@ class TestDashboardDomContract:
         assert "renderNodeWidgets" in main
         assert '"DistributedValue"' in main
         assert '"worker_values"' in main
-        assert "String(i + 1)" in main   # 1-indexed keys per reference
+        # 1-indexed keys pinned to FULL config-list position (the
+        # orchestrator's stable worker_index contract)
+        assert "String(configIdx + 1)" in main
 
 
 class TestInterruptExecution:

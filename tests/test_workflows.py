@@ -80,6 +80,22 @@ class TestSmokeExecution:
         outputs = GraphExecutor().execute(prompt)
         assert np.asarray(outputs["5"][0]).shape[0] == len(jax.devices())
 
+    def test_upscale_workflow_executes(self, tmp_path):
+        """Model upscale (tiny-x2) + tile-diffusion refine end-to-end."""
+        from PIL import Image
+
+        Image.new("RGB", (16, 16), (120, 60, 30)).save(tmp_path / "input.png")
+        prompt = strip_meta(load(Path("workflows/distributed-upscale.json")))
+        prompt = _swap_model(prompt, "tiny")
+        prompt["8"]["inputs"]["model_name"] = "tiny-x2"
+        prompt["9"]["inputs"].update(tile=16, tile_padding=4)
+        prompt["5"]["inputs"].update(steps=2, tile_width=16, tile_height=16,
+                                     tile_padding=4)
+        prompt["7"]["inputs"]["output_dir"] = str(tmp_path / "out")
+        outputs = GraphExecutor({"input_dir": str(tmp_path)}).execute(prompt)
+        img = np.asarray(outputs["6"][0])
+        assert img.shape[1:3] == (32, 32)       # 16² × tiny-x2
+
     def test_wan_workflow_executes(self, tmp_path):
         prompt = strip_meta(load(Path("workflows/wan-t2v.json")))
         prompt = _swap_model(prompt, "wan-tiny")
