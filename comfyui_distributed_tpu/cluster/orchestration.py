@@ -53,17 +53,25 @@ class Orchestrator:
         self.queue = queue
         self.load_config = config_loader
 
+    @staticmethod
+    def _normalized_hosts(config: dict) -> list[dict]:
+        """Full config host list as copies with a guaranteed ``id``
+        (synthetic ``host{config_position}`` when absent). Copies survive
+        the probe layer's dict rebuilding, so the same name reaches every
+        site — stable indexing must never depend on object identity."""
+        return [h if h.get("id") else {**h, "id": f"host{i}"}
+                for i, h in enumerate(config.get("hosts", []))]
+
     def _resolve_enabled_hosts(
-        self, config: dict, enabled_ids: Optional[Sequence[str]]
+        self, all_hosts: list[dict], enabled_ids: Optional[Sequence[str]]
     ) -> list[dict]:
         """Explicit ids win; else config-enabled hosts
         (reference ``:63-93`` incl. the legacy ``workers`` alias handled in
         the API layer)."""
-        hosts = config.get("hosts", [])
         if enabled_ids is not None:
-            by_id = {h.get("id"): h for h in hosts}
+            by_id = {h["id"]: h for h in all_hosts}
             return [by_id[i] for i in enabled_ids if i in by_id]
-        return [h for h in hosts if h.get("enabled")]
+        return [h for h in all_hosts if h.get("enabled")]
 
     async def orchestrate(
         self,
@@ -79,7 +87,8 @@ class Orchestrator:
         prompt = strip_meta(prompt)
         trace_id = trace_id or new_trace_id()
         config = self.load_config()
-        candidates = self._resolve_enabled_hosts(config, enabled_ids)
+        all_hosts = self._normalized_hosts(config)
+        candidates = self._resolve_enabled_hosts(all_hosts, enabled_ids)
         if delegate_master is None:
             delegate_master = bool(
                 config.get("settings", {}).get("master_delegate_only")
@@ -110,20 +119,11 @@ class Orchestrator:
         # per-worker overrides stay pinned to the same host across outages,
         # load-balance picks, partial dispatches, and enable-flag flips
         # (reference parity: worker_N's offset comes from its config
-        # number, nodes/utilities.py:52-75). Id-less hosts get the same
-        # host{config_position} name at every site via _host_name.
-        all_hosts = config.get("hosts", [])
-        host_names = {
-            id(h): (h.get("id") or f"host{i}")
-            for i, h in enumerate(all_hosts)
-        }
-
-        def _host_name(h: dict, fallback_i: int) -> str:
-            return host_names.get(id(h)) or h.get("id") or f"host{fallback_i}"
-
-        stable_index = {host_names[id(h)]: i
-                        for i, h in enumerate(all_hosts)}
-        worker_ids = tuple(_host_name(h, i) for i, h in enumerate(online))
+        # number, nodes/utilities.py:52-75). Every host carries a
+        # guaranteed id from _normalized_hosts, so names match across the
+        # probe layer's dict copies.
+        stable_index = {h["id"]: i for i, h in enumerate(all_hosts)}
+        worker_ids = tuple(h["id"] for h in online)
         for jid in job_ids.values():
             await self.store.prepare_collector_job(jid, worker_ids)
 
@@ -144,7 +144,7 @@ class Orchestrator:
 
         async def prep_and_dispatch(index: int, host: dict) -> tuple[str, Optional[str]]:
             async with sem:
-                wid = _host_name(host, index)
+                wid = host["id"]
                 host_type = host.get("type")
                 if host_type not in ("local", "remote"):
                     # config didn't pin a type: machine-id comparison
@@ -161,7 +161,7 @@ class Orchestrator:
                 wprompt = apply_participant_overrides(
                     wprompt, wid, job_ids, master_url=callback,
                     enabled_worker_ids=worker_ids,
-                    worker_index=stable_index.get(wid, index),
+                    worker_index=stable_index[wid],
                 )
                 if host_type == "remote":
                     # remote hosts don't share the master's filesystem:

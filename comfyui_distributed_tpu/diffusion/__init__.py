@@ -13,6 +13,8 @@ from .schedules import (  # noqa: F401
     sigmas_karras,
     sigmas_normal,
     sigmas_flow,
+    sigmas_exponential,
+    sigmas_sgm_uniform,
 )
 from .samplers import SAMPLERS, sample  # noqa: F401
 from .guidance import cfg_denoiser  # noqa: F401

@@ -27,7 +27,8 @@ from ..parallel.rng import participant_key
 from ..utils import constants
 from .guidance import cfg_denoiser, eps_denoiser
 from .samplers import sample
-from .schedules import NoiseSchedule, sigmas_karras, sigmas_normal, vp_schedule
+from .schedules import (NoiseSchedule, sigmas_exponential, sigmas_karras,
+                        sigmas_normal, sigmas_sgm_uniform, vp_schedule)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -36,7 +37,7 @@ class GenerationSpec:
     width: int = 1024
     steps: int = 30
     sampler: str = "euler"
-    scheduler: str = "karras"      # "karras" | "normal"
+    scheduler: str = "karras"  # karras | normal | exponential | sgm_uniform
     guidance_scale: float = 5.0
     per_device_batch: int = 1
     denoise: float = 1.0           # <1.0: img2img partial ladder (tile engine)
@@ -50,6 +51,11 @@ def make_sigma_ladder(spec: GenerationSpec, schedule: NoiseSchedule) -> jax.Arra
         full = sigmas_karras(spec.steps, smin, smax)
     elif spec.scheduler == "normal":
         full = sigmas_normal(spec.steps, schedule)
+    elif spec.scheduler == "exponential":
+        full = sigmas_exponential(spec.steps, float(schedule.sigmas[0]),
+                                  float(schedule.sigmas[-1]))
+    elif spec.scheduler == "sgm_uniform":
+        full = sigmas_sgm_uniform(spec.steps, schedule)
     else:
         raise ValueError(f"unknown scheduler {spec.scheduler!r}")
     # partial denoise keeps the *tail* of the ladder (img2img convention)

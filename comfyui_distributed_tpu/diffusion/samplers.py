@@ -42,14 +42,22 @@ def sample_euler(denoise: Denoiser, x: jax.Array, sigmas: jax.Array,
     return x
 
 
+def _ancestral_sigmas(sigma_from, sigma_to, eta):
+    """Split a σ_from→σ_to transition into a deterministic step plus an
+    ancestral noise injection (k-diffusion ``get_ancestral_step``)."""
+    var_ratio = jnp.maximum(
+        1.0 - (sigma_to / jnp.maximum(sigma_from, 1e-10)) ** 2, 0.0)
+    sigma_up = jnp.minimum(sigma_to, eta * sigma_to * jnp.sqrt(var_ratio))
+    sigma_down = jnp.sqrt(jnp.maximum(sigma_to ** 2 - sigma_up ** 2, 0.0))
+    return sigma_down, sigma_up
+
+
 def sample_euler_ancestral(denoise: Denoiser, x: jax.Array, sigmas: jax.Array,
                            key: jax.Array, eta: float = 1.0) -> jax.Array:
     def step(x, i):
         sigma, sigma_next = sigmas[i], sigmas[i + 1]
         denoised = denoise(x, sigma)
-        var_ratio = jnp.maximum(1.0 - (sigma_next / jnp.maximum(sigma, 1e-10)) ** 2, 0.0)
-        sigma_up = jnp.minimum(sigma_next, eta * sigma_next * jnp.sqrt(var_ratio))
-        sigma_down = jnp.sqrt(jnp.maximum(sigma_next ** 2 - sigma_up ** 2, 0.0))
+        sigma_down, sigma_up = _ancestral_sigmas(sigma, sigma_next, eta)
         d = _to_d(x, sigma, denoised)
         x = x + d * (sigma_down - sigma)
         noise = jax.random.normal(jax.random.fold_in(key, i), x.shape, x.dtype)
@@ -122,11 +130,149 @@ def sample_dpmpp_2m(denoise: Denoiser, x: jax.Array, sigmas: jax.Array,
     return x
 
 
+def sample_ddim(denoise: Denoiser, x: jax.Array, sigmas: jax.Array,
+                key: jax.Array | None = None, eta: float = 0.0) -> jax.Array:
+    """DDIM in sigma space. ``eta=0`` is the deterministic solver (the
+    x0-form of Euler); ``eta>0`` interpolates toward ancestral sampling."""
+
+    def step(x, i):
+        sigma, sigma_next = sigmas[i], sigmas[i + 1]
+        denoised = denoise(x, sigma)
+        if eta and key is not None:
+            sigma_down, sigma_up = _ancestral_sigmas(sigma, sigma_next, eta)
+        else:
+            sigma_down, sigma_up = sigma_next, jnp.zeros(())
+        x = denoised + (x - denoised) * (sigma_down / jnp.maximum(sigma, 1e-10))
+        if eta and key is not None:
+            noise = jax.random.normal(jax.random.fold_in(key, i),
+                                      x.shape, x.dtype)
+            x = x + noise * sigma_up
+        return x, None
+
+    n = sigmas.shape[0] - 1
+    x, _ = jax.lax.scan(step, x, jnp.arange(n))
+    return x
+
+
+def sample_lcm(denoise: Denoiser, x: jax.Array, sigmas: jax.Array,
+               key: jax.Array) -> jax.Array:
+    """Latent-consistency sampling: jump to x0, re-noise to the next
+    sigma (k-diffusion ``sample_lcm``)."""
+
+    def step(x, i):
+        denoised = denoise(x, sigmas[i])
+        sigma_next = sigmas[i + 1]
+        noise = jax.random.normal(jax.random.fold_in(key, i),
+                                  x.shape, x.dtype)
+        return denoised + jnp.where(sigma_next > 0, sigma_next, 0.0) * noise, None
+
+    n = sigmas.shape[0] - 1
+    x, _ = jax.lax.scan(step, x, jnp.arange(n))
+    return x
+
+
+def sample_dpmpp_sde(denoise: Denoiser, x: jax.Array, sigmas: jax.Array,
+                     key: jax.Array, eta: float = 1.0, s_noise: float = 1.0,
+                     r: float = 0.5) -> jax.Array:
+    """DPM-Solver++ (SDE): single-step second-order with an ancestral
+    noise injection at the midpoint and endpoint (k-diffusion
+    ``sample_dpmpp_sde``)."""
+
+    def t_of(sigma):
+        return -jnp.log(jnp.maximum(sigma, 1e-10))
+
+    def sigma_of(t):
+        return jnp.exp(-t)
+
+    def step(x, i):
+        sigma, sigma_next = sigmas[i], sigmas[i + 1]
+        denoised = denoise(x, sigma)
+
+        def last(_):
+            return denoised
+
+        def stage(_):
+            t, t_next = t_of(sigma), t_of(sigma_next)
+            h = t_next - t
+            s = t + h * r
+            fac = 1.0 / (2.0 * r)
+            # midpoint stage with its own ancestral split
+            sd1, su1 = _ancestral_sigmas(sigma_of(t), sigma_of(s), eta)
+            s_down = t_of(sd1)
+            x2 = (sigma_of(s_down) / sigma_of(t)) * x \
+                - jnp.expm1(t - s_down) * denoised
+            noise1 = jax.random.normal(jax.random.fold_in(key, 2 * i),
+                                       x.shape, x.dtype)
+            x2 = x2 + noise1 * su1 * s_noise
+            denoised2 = denoise(x2, sigma_of(s))
+            # full step
+            sd2, su2 = _ancestral_sigmas(sigma_of(t), sigma_of(t_next), eta)
+            t_down = t_of(sd2)
+            denoised_d = (1 - fac) * denoised + fac * denoised2
+            x_new = (sigma_of(t_down) / sigma_of(t)) * x \
+                - jnp.expm1(t - t_down) * denoised_d
+            noise2 = jax.random.normal(jax.random.fold_in(key, 2 * i + 1),
+                                       x.shape, x.dtype)
+            return x_new + noise2 * su2 * s_noise
+
+        return jax.lax.cond(sigma_next > 0, stage, last, None), None
+
+    n = sigmas.shape[0] - 1
+    x, _ = jax.lax.scan(step, x, jnp.arange(n))
+    return x
+
+
+def sample_dpmpp_2m_sde(denoise: Denoiser, x: jax.Array, sigmas: jax.Array,
+                        key: jax.Array, eta: float = 1.0,
+                        s_noise: float = 1.0) -> jax.Array:
+    """DPM-Solver++(2M) SDE, midpoint solver (k-diffusion
+    ``sample_dpmpp_2m_sde``)."""
+
+    def t_of(sigma):
+        return -jnp.log(jnp.maximum(sigma, 1e-10))
+
+    def step(carry, i):
+        x, old_denoised, h_last, have_old = carry
+        sigma, sigma_next = sigmas[i], sigmas[i + 1]
+        denoised = denoise(x, sigma)
+
+        def last(_):
+            return denoised, jnp.zeros(())
+
+        def stage(_):
+            h = t_of(sigma_next) - t_of(sigma)
+            eta_h = eta * h
+            x_new = (sigma_next / jnp.maximum(sigma, 1e-10)) \
+                * jnp.exp(-eta_h) * x \
+                - jnp.expm1(-h - eta_h) * denoised
+            r = h_last / jnp.maximum(h, 1e-10)
+            second = -jnp.expm1(-h - eta_h) * (0.5 / jnp.maximum(r, 1e-10)) \
+                * (denoised - old_denoised)
+            x_new = x_new + jnp.where(have_old, second, 0.0)
+            noise = jax.random.normal(jax.random.fold_in(key, i),
+                                      x.shape, x.dtype)
+            x_new = x_new + noise * sigma_next * s_noise \
+                * jnp.sqrt(jnp.maximum(-jnp.expm1(-2.0 * eta_h), 0.0))
+            return x_new, h
+
+        x_new, h = jax.lax.cond(sigma_next > 0, stage, last, None)
+        return (x_new, denoised, h, jnp.array(True)), None
+
+    n = sigmas.shape[0] - 1
+    init = (x, jnp.zeros_like(x), jnp.zeros(()), jnp.array(False))
+    (x, _, _, _), _ = jax.lax.scan(step, init, jnp.arange(n))
+    return x
+
+
 SAMPLERS: dict[str, Callable] = {
     "euler": sample_euler,
     "euler_ancestral": sample_euler_ancestral,
     "heun": sample_heun,
     "dpmpp_2m": sample_dpmpp_2m,
+    "ddim": sample_ddim,
+    "lcm": sample_lcm,
+    "dpmpp_sde": sample_dpmpp_sde,
+    "dpmpp_2m_sde": sample_dpmpp_2m_sde,
 }
 
 

@@ -79,6 +79,25 @@ def sigmas_normal(n: int, schedule: NoiseSchedule) -> jax.Array:
     return jnp.concatenate([sigmas, jnp.zeros((1,))])
 
 
+def sigmas_exponential(n: int, sigma_min: float, sigma_max: float) -> jax.Array:
+    """Log-uniform ladder (k-diffusion ``get_sigmas_exponential``)."""
+    sigmas = jnp.exp(jnp.linspace(
+        jnp.log(sigma_max), jnp.log(sigma_min), n))
+    return jnp.concatenate([sigmas, jnp.zeros((1,))])
+
+
+def sigmas_sgm_uniform(n: int, schedule: NoiseSchedule) -> jax.Array:
+    """SGM-style uniform timesteps: like "normal" but the ladder ends at
+    the table's sigma_min instead of duplicating the final step at the
+    interpolated zero-point (ComfyUI "sgm_uniform" — the convention
+    SDXL-refiner/turbo models were trained with)."""
+    table = schedule.sigmas
+    T = table.shape[0]
+    t = jnp.linspace(T - 1, 0, n + 1)[:-1]
+    sigmas = jnp.interp(t, jnp.arange(T, dtype=jnp.float32), table)
+    return jnp.concatenate([sigmas, jnp.zeros((1,))])
+
+
 def sigmas_flow(n: int, shift: float = 1.0) -> jax.Array:
     """Rectified-flow ladder: t from 1→0 with resolution shift
     (sigma' = shift·sigma / (1 + (shift−1)·sigma)); FLUX/SD3 convention."""

@@ -108,3 +108,111 @@ def test_cfg_denoiser_interpolates():
     den = cfg_denoiser(make, cond_ctx, uncond_ctx, guidance_scale=3.0)
     out = den(jnp.zeros((1, 2, 2, 1)), jnp.array(1.0))
     np.testing.assert_allclose(np.asarray(out), 3.0)  # 0 + 3·(1−0)
+
+
+# ---------------------------------------------------------------------------
+# round-2 sampler additions (ddim / lcm / dpmpp_sde / dpmpp_2m_sde)
+# ---------------------------------------------------------------------------
+
+from comfyui_distributed_tpu.diffusion import (  # noqa: E402
+    sigmas_exponential, sigmas_sgm_uniform)
+
+
+def test_exponential_ladder():
+    s = np.asarray(sigmas_exponential(8, 0.03, 150.0))
+    assert s.shape == (9,)
+    assert np.isclose(s[0], 150.0) and np.isclose(s[-2], 0.03)
+    assert s[-1] == 0.0
+    # log-uniform: ratios between consecutive sigmas are constant
+    ratios = s[1:-1] / s[:-2]
+    np.testing.assert_allclose(ratios, ratios[0], rtol=1e-5)
+
+
+def test_sgm_uniform_ladder():
+    sched = vp_schedule()
+    s = np.asarray(sigmas_sgm_uniform(8, sched))
+    n = np.asarray(sigmas_normal(8, sched))
+    assert s.shape == n.shape == (9,)
+    assert s[-1] == 0.0
+    # sgm variant must NOT end at the table's sigma_min before the zero —
+    # its last real sigma sits one uniform step above it
+    assert s[-2] > n[-2]
+
+
+def test_ddim_eta0_equals_euler():
+    """Deterministic DDIM is the x0-form of the Euler step — bit-equal."""
+    sigmas = sigmas_karras(8, 0.03, 20.0)
+    x = jax.random.normal(jax.random.key(0), (2, 4, 4, 1)) * sigmas[0]
+    denoise = lambda xx, s: xx * 0.4
+    e = sample("euler", denoise, x, sigmas)
+    d = sample("ddim", denoise, x, sigmas)
+    np.testing.assert_allclose(np.asarray(e), np.asarray(d), atol=1e-5)
+
+
+def test_dpmpp_2m_sde_eta0_equals_dpmpp_2m():
+    """With eta=0 the SDE collapses to the deterministic 2M solver."""
+    sigmas = sigmas_karras(8, 0.03, 20.0)
+    x = jax.random.normal(jax.random.key(1), (2, 4, 4, 1)) * sigmas[0]
+    denoise = lambda xx, s: xx * 0.4
+    a = sample("dpmpp_2m", denoise, x, sigmas)
+    b = sample("dpmpp_2m_sde", denoise, x, sigmas, key=jax.random.key(2),
+               eta=0.0)
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                               rtol=1e-4, atol=1e-5)
+
+
+def _kdiffusion_dpmpp_sde_loop(denoise, x, sigmas, key, eta=1.0, r=0.5):
+    """Literal (non-scan) transcription of k-diffusion sample_dpmpp_sde
+    with this repo's fold_in noise convention — structure-independence
+    check for the scan implementation."""
+    sigma_fn = lambda t: jnp.exp(-t)
+    t_fn = lambda s: -jnp.log(jnp.maximum(s, 1e-10))
+
+    def anc(sf, st):
+        vr = jnp.maximum(1.0 - (st / jnp.maximum(sf, 1e-10)) ** 2, 0.0)
+        su = jnp.minimum(st, eta * st * jnp.sqrt(vr))
+        return jnp.sqrt(jnp.maximum(st ** 2 - su ** 2, 0.0)), su
+
+    for i in range(int(sigmas.shape[0]) - 1):
+        denoised = denoise(x, sigmas[i])
+        if float(sigmas[i + 1]) == 0.0:
+            x = denoised
+            continue
+        t, t_next = t_fn(sigmas[i]), t_fn(sigmas[i + 1])
+        h = t_next - t
+        s = t + h * r
+        fac = 1.0 / (2.0 * r)
+        sd, su = anc(sigma_fn(t), sigma_fn(s))
+        s_ = t_fn(sd)
+        x2 = (sigma_fn(s_) / sigma_fn(t)) * x - jnp.expm1(t - s_) * denoised
+        x2 = x2 + jax.random.normal(jax.random.fold_in(key, 2 * i),
+                                    x.shape, x.dtype) * su
+        denoised2 = denoise(x2, sigma_fn(s))
+        sd, su = anc(sigma_fn(t), sigma_fn(t_next))
+        t_ = t_fn(sd)
+        dd = (1 - fac) * denoised + fac * denoised2
+        x = (sigma_fn(t_) / sigma_fn(t)) * x - jnp.expm1(t - t_) * dd
+        x = x + jax.random.normal(jax.random.fold_in(key, 2 * i + 1),
+                                  x.shape, x.dtype) * su
+    return x
+
+
+def test_dpmpp_sde_matches_reference_loop():
+    sigmas = sigmas_karras(6, 0.05, 15.0)
+    x = jax.random.normal(jax.random.key(3), (1, 4, 4, 2)) * sigmas[0]
+    denoise = lambda xx, s: xx * 0.3
+    key = jax.random.key(7)
+    ours = sample("dpmpp_sde", denoise, x, sigmas, key=key)
+    ref = _kdiffusion_dpmpp_sde_loop(denoise, x, sigmas, key)
+    np.testing.assert_allclose(np.asarray(ours), np.asarray(ref),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_stochastic_samplers_vary_with_key():
+    sigmas = sigmas_karras(6, 0.03, 10.0)
+    x = jax.random.normal(jax.random.key(0), (1, 4, 4, 1)) * sigmas[0]
+    denoise = lambda xx, s: xx * 0.5
+    for name in ("lcm", "dpmpp_sde", "dpmpp_2m_sde"):
+        a = sample(name, denoise, x, sigmas, key=jax.random.key(1))
+        b = sample(name, denoise, x, sigmas, key=jax.random.key(2))
+        assert not np.allclose(np.asarray(a), np.asarray(b)), name

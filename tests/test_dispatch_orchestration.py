@@ -215,6 +215,29 @@ class TestOrchestrator:
                    for wid, wprompt in sent}
         assert indices == {"w0": 0, "w1": 1}
 
+    def test_idless_host_named_by_config_position(self, monkeypatch):
+        """Hosts without an id get a synthetic host{config_position} name
+        that survives the probe layer's dict copies — index stays the
+        config position, not the online position."""
+        sent = []
+        cfg_hosts = [
+            {"id": "w0", "address": "http://10.0.0.0:8288", "enabled": False},
+            {"address": "http://10.0.0.1:8288", "enabled": True},  # no id
+        ]
+        orch, store, queue = self._make(monkeypatch, cfg_hosts,
+                                        probe_ok={"host1"},
+                                        dispatch_log=sent)
+        prompt = distributed_prompt()
+        prompt["3"]["inputs"]["height"] = ["2", 0]
+
+        async def body():
+            return await orch.orchestrate(prompt)
+        run(body())
+        assert len(sent) == 1
+        wid, wprompt = sent[0]
+        assert wid == "host1"
+        assert wprompt["2"]["inputs"]["worker_index"] == 1
+
     def test_delegate_disabled_when_all_offline(self, monkeypatch):
         orch, store, queue = self._make(monkeypatch, hosts(2), probe_ok=set())
 
