@@ -55,12 +55,24 @@ class Orchestrator:
 
     @staticmethod
     def _normalized_hosts(config: dict) -> list[dict]:
-        """Full config host list as copies with a guaranteed ``id``
-        (synthetic ``host{config_position}`` when absent). Copies survive
-        the probe layer's dict rebuilding, so the same name reaches every
-        site — stable indexing must never depend on object identity."""
-        return [h if h.get("id") else {**h, "id": f"host{i}"}
-                for i, h in enumerate(config.get("hosts", []))]
+        """Full config host list as copies with a guaranteed UNIQUE ``id``
+        (synthetic ``host{config_position}`` when absent, skipping names an
+        explicit id already claims). Copies survive the probe layer's dict
+        rebuilding, so the same name reaches every site — stable indexing
+        must never depend on object identity."""
+        hosts = config.get("hosts", [])
+        taken = {h.get("id") for h in hosts if h.get("id")}
+        out = []
+        for i, h in enumerate(hosts):
+            if h.get("id"):
+                out.append(h)
+                continue
+            name = f"host{i}"
+            while name in taken:
+                name += "_"
+            taken.add(name)
+            out.append({**h, "id": name})
+        return out
 
     def _resolve_enabled_hosts(
         self, all_hosts: list[dict], enabled_ids: Optional[Sequence[str]]

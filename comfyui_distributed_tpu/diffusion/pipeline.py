@@ -110,14 +110,24 @@ class Txt2ImgPipeline:
         return eps_denoiser(model_fn, self.schedule, context, y)
 
     def _sample_and_decode(self, key, context, uncond_context, y, uncond_y,
-                           spec: GenerationSpec, batch: int, sigmas: jax.Array):
-        """Single-shard work: noise → sampler scan → VAE decode."""
-        lat_h = spec.height // self.vae.config.downscale
-        lat_w = spec.width // self.vae.config.downscale
+                           spec: GenerationSpec, batch: int, sigmas: jax.Array,
+                           init_latent: Optional[jax.Array] = None):
+        """Single-shard work: noise → sampler scan → VAE decode.
+
+        ``init_latent`` switches to img2img: the source latent is noised
+        to the (partial) ladder's head instead of starting from pure
+        noise (k-diffusion img2img convention)."""
         k_noise, k_samp = jax.random.split(key)
-        x = jax.random.normal(
-            k_noise, (batch, lat_h, lat_w, self.latent_channels), jnp.float32
-        ) * sigmas[0]
+        if init_latent is None:
+            lat_h = spec.height // self.vae.config.downscale
+            lat_w = spec.width // self.vae.config.downscale
+            x = jax.random.normal(
+                k_noise, (batch, lat_h, lat_w, self.latent_channels),
+                jnp.float32,
+            ) * sigmas[0]
+        else:
+            x = init_latent + jax.random.normal(
+                k_noise, init_latent.shape, jnp.float32) * sigmas[0]
 
         if spec.guidance_scale != 1.0:
             denoise = cfg_denoiser(
@@ -166,6 +176,64 @@ class Txt2ImgPipeline:
             out_specs=P(axis, None, None, None),
         )
         return jax.jit(f)
+
+    def img2img_fn(self, mesh: Mesh, spec: GenerationSpec,
+                   axis: str = constants.AXIS_DATA):
+        """Compile the SPMD img2img program over ``mesh[axis]``.
+
+        The source batch is replicated; every shard encodes it, noises it
+        at the partial ladder's head (``spec.denoise`` sets the fraction)
+        with its participant-folded key, samples the tail, and decodes —
+        N seed-varied edits of the same source in one step-time (the
+        img2img analogue of the reference's seed-offset fan-out)."""
+        has_y = self.unet.config.adm_in_channels > 0
+        sigmas = make_sigma_ladder(spec, self.schedule)
+
+        def per_shard(images, key, context, uncond_context, y, uncond_y):
+            k = participant_key(key, axis)
+            lat = self.vae.encode(images.astype(jnp.float32) * 2.0 - 1.0)
+            return self._sample_and_decode(
+                k, context, uncond_context,
+                y if has_y else None, uncond_y if has_y else None,
+                spec, images.shape[0], sigmas, init_latent=lat,
+            )
+
+        in_specs = (P(None, None, None, None), P(), P(None, None, None),
+                    P(None, None, None), P(None, None), P(None, None))
+        f = jax.shard_map(
+            per_shard, mesh=mesh, in_specs=in_specs,
+            out_specs=P(axis, None, None, None),
+        )
+        return jax.jit(f)
+
+    def img2img(
+        self,
+        mesh: Mesh,
+        spec: GenerationSpec,
+        seed: int,
+        images: jax.Array,
+        context: jax.Array,
+        uncond_context: jax.Array,
+        y: Optional[jax.Array] = None,
+        uncond_y: Optional[jax.Array] = None,
+    ) -> jax.Array:
+        """One-shot img2img (value-keyed compile cache)."""
+        if not hasattr(self, "_i2i_cache"):
+            self._i2i_cache: "dict[tuple, Any]" = {}
+        key = (self._mesh_cache_key(mesh), spec, tuple(images.shape))
+        fn = self._i2i_cache.get(key)
+        if fn is None:
+            if len(self._i2i_cache) >= self._CACHE_MAX:
+                self._i2i_cache.pop(next(iter(self._i2i_cache)))
+            fn = self.img2img_fn(mesh, spec)
+            self._i2i_cache[key] = fn
+        if y is None:
+            adm = self.unet.config.adm_in_channels
+            y = jnp.zeros((1, max(adm, 1)), jnp.float32)
+        if uncond_y is None:
+            uncond_y = jnp.zeros_like(y)
+        return fn(jnp.asarray(images, jnp.float32), jax.random.key(seed),
+                  context, uncond_context, y, uncond_y)
 
     def generate(
         self,

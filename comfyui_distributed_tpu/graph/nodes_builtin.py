@@ -503,6 +503,44 @@ class ImageUpscaleWithModel(NodeDef):
         return (np.asarray(out),)
 
 
+@register_node("LoraLoader")
+class LoraLoader(NodeDef):
+    """Merge a kohya-format LoRA into copies of the model/clip (ComfyUI
+    core ``LoraLoader`` surface; the reference free-rides on it). The
+    registry's shared bundle is never mutated — patched params live in a
+    shallow pipeline clone with a fresh compile cache. ``lora_name``
+    resolves under ``CDT_LORA_DIR`` (or ``CDT_CHECKPOINT_ROOT/loras``)."""
+
+    INPUTS = {"model": "MODEL", "clip": "CLIP", "lora_name": "STRING"}
+    OPTIONAL = {"strength_model": "FLOAT", "strength_clip": "FLOAT"}
+    RETURNS = ("MODEL", "CLIP")
+
+    def execute(self, model, clip, lora_name: str,
+                strength_model: float = 1.0, strength_clip: float = 1.0,
+                **_):
+        import os
+
+        from ..models.lora import apply_lora, load_lora_file
+
+        if not strength_model and not strength_clip:
+            return (model, clip)
+        name = str(lora_name)
+        root = os.environ.get("CDT_LORA_DIR") or (
+            os.path.join(os.environ["CDT_CHECKPOINT_ROOT"], "loras")
+            if os.environ.get("CDT_CHECKPOINT_ROOT") else "")
+        path = Path(root) / (name if name.endswith(".safetensors")
+                             else f"{name}.safetensors") if root else None
+        if path is None or not path.is_file():
+            raise ValidationError(
+                f"LoRA {name!r} not found under "
+                f"{root or '$CDT_LORA_DIR'}", field="lora_name")
+        patched, conditioner = apply_lora(
+            model, load_lora_file(path),
+            strength_model=float(strength_model),
+            strength_clip=float(strength_clip), name=name)
+        return (patched, conditioner if conditioner is not None else clip)
+
+
 @register_node("CheckpointLoader")
 class CheckpointLoader(NodeDef):
     INPUTS = {"ckpt_name": "STRING"}
@@ -591,6 +629,52 @@ class TPUTxt2Img(NodeDef):
             mesh, spec, int(seed), positive["context"], negative["context"], y, uy,
         )
         return (images,)
+
+
+@register_node("TPUImg2Img")
+class TPUImg2Img(NodeDef):
+    """Distributed img2img: every chip produces its own seed-varied edit
+    of the (replicated) source batch in one SPMD program — the img2img
+    analogue of the reference's seed-offset fan-out. ``denoise`` sets the
+    partial sigma-ladder fraction (k-diffusion convention, like the
+    reference's KSampler denoise)."""
+
+    INPUTS = {
+        "model": "MODEL", "image": "IMAGE",
+        "positive": "CONDITIONING", "negative": "CONDITIONING",
+        "seed": "INT", "steps": "INT", "cfg": "FLOAT", "denoise": "FLOAT",
+    }
+    OPTIONAL = {"sampler_name": "STRING", "scheduler": "STRING"}
+    HIDDEN = {"mesh": "*"}
+    RETURNS = ("IMAGE",)
+
+    def execute(self, model, image, positive, negative, seed: int,
+                steps: int, cfg: float, denoise: float,
+                sampler_name: str = "euler", scheduler: str = "karras",
+                mesh=None, **_):
+        from ..diffusion.pipeline import GenerationSpec
+        from ..parallel.mesh import build_mesh
+
+        if mesh is None:
+            mesh = build_mesh({"dp": len(jax.devices())})
+        images = jnp.asarray(image, jnp.float32)
+        if images.ndim == 3:
+            images = images[None]
+        B, H, W, _ = images.shape
+        spec = GenerationSpec(
+            height=int(H), width=int(W), steps=int(steps),
+            sampler=sampler_name, scheduler=scheduler,
+            guidance_scale=float(cfg), per_device_batch=B,
+            denoise=float(denoise),
+        )
+        adm = model.pipeline.unet.config.adm_in_channels
+        y = _adm_from_cond(positive, adm) if adm else None
+        uy = _adm_from_cond(negative, adm) if adm else None
+        out = model.pipeline.img2img(
+            mesh, spec, int(seed), images,
+            positive["context"], negative["context"], y, uy,
+        )
+        return (out,)
 
 
 @register_node("TPUFlowTxt2Img")

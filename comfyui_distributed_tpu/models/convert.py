@@ -60,7 +60,30 @@ def _id(w):
     return np.asarray(w, np.float32)
 
 
-class _Filler:
+class _PutHelpers:
+    """Shared src→dst naming rules over an abstract ``put`` — the single
+    definition both the real filler and the LoRA-key recorder use, so the
+    two can never drift."""
+
+    def put(self, src_key: str, dst_path: str,
+            transform: Callable = _id) -> None:
+        raise NotImplementedError
+
+    def linear(self, src: str, dst: str, bias: bool = True) -> None:
+        self.put(f"{src}.weight", f"{dst}/kernel", _lin)
+        if bias:
+            self.put(f"{src}.bias", f"{dst}/bias")
+
+    def conv(self, src: str, dst: str) -> None:
+        self.put(f"{src}.weight", f"{dst}/kernel", _conv)
+        self.put(f"{src}.bias", f"{dst}/bias")
+
+    def norm(self, src: str, dst: str) -> None:
+        self.put(f"{src}.weight", f"{dst}/scale")
+        self.put(f"{src}.bias", f"{dst}/bias")
+
+
+class _Filler(_PutHelpers):
     """Writes converted tensors into a template-shaped tree with shape
     checks; tracks which source keys and which template leaves were hit."""
 
@@ -94,19 +117,6 @@ class _Filler:
                 f"-> {dst_path}: shape {value.shape} != "
                 f"template {tuple(tmpl.shape)}")
         _set_path(self.tree, dst_path, np.asarray(value, np.float32))
-
-    def linear(self, src: str, dst: str, bias: bool = True) -> None:
-        self.put(f"{src}.weight", f"{dst}/kernel", _lin)
-        if bias:
-            self.put(f"{src}.bias", f"{dst}/bias")
-
-    def conv(self, src: str, dst: str) -> None:
-        self.put(f"{src}.weight", f"{dst}/kernel", _conv)
-        self.put(f"{src}.bias", f"{dst}/bias")
-
-    def norm(self, src: str, dst: str) -> None:
-        self.put(f"{src}.weight", f"{dst}/scale")
-        self.put(f"{src}.bias", f"{dst}/bias")
 
     def finish(self, *, expect_prefix: str = "") -> dict:
         missing = [p for p, v in _walk(self.tree) if v is None]
@@ -171,13 +181,23 @@ def load_safetensors(path: Path) -> dict[str, np.ndarray]:
 # CLIP (HF layout — SD1.5's encoder and SDXL's embedders.0)
 # ---------------------------------------------------------------------------
 
-def convert_clip_hf(sd: Mapping[str, np.ndarray], template, config,
-                    prefix: str = "text_model.") -> dict:
-    """HF ``CLIPTextModel`` state dict → ``models.clip.CLIPTextTransformer``
-    params. ``text_projection.weight`` (when the template wants one) lives
-    *outside* ``text_model.`` in HF checkpoints."""
-    f = _Filler(sd, template["params"])
-    p = prefix
+class _Recorder(_PutHelpers):
+    """A ``_Filler`` stand-in that records (src_key, dst_path, transform)
+    triples instead of filling — the converter layout walks double as the
+    source of truth for LoRA key maps (``models/lora.py``)."""
+
+    def __init__(self):
+        self.records: list[tuple[str, str, Callable]] = []
+        self.used: set[str] = set()
+
+    def put(self, src_key: str, dst_path: str, transform: Callable = _id):
+        self.records.append((src_key, dst_path, transform))
+
+    def put_raw(self, value, dst_path: str) -> None:
+        pass
+
+
+def _clip_hf_layout(f, config, p: str) -> None:
     f.put(f"{p}embeddings.token_embedding.weight", "tok_emb/embedding")
     f.put(f"{p}embeddings.position_embedding.weight", "pos_emb")
     for i in range(config.layers):
@@ -192,9 +212,18 @@ def convert_clip_hf(sd: Mapping[str, np.ndarray], template, config,
     f.norm(f"{p}final_layer_norm", "final_ln")
     if config.projection_dim:
         f.linear("text_projection", "text_projection", bias=False)
+
+
+def convert_clip_hf(sd: Mapping[str, np.ndarray], template, config,
+                    prefix: str = "text_model.") -> dict:
+    """HF ``CLIPTextModel`` state dict → ``models.clip.CLIPTextTransformer``
+    params. ``text_projection.weight`` (when the template wants one) lives
+    *outside* ``text_model.`` in HF checkpoints."""
+    f = _Filler(sd, template["params"])
+    _clip_hf_layout(f, config, prefix)
     # position_ids buffers appear in older HF dumps — ignore them
     f.used.update(k for k in sd if k.endswith("position_ids"))
-    return {"params": f.finish(expect_prefix=p)}
+    return {"params": f.finish(expect_prefix=prefix)}
 
 
 # ---------------------------------------------------------------------------
@@ -277,24 +306,10 @@ def _spatial_transformer(f: _Filler, src: str, dst: str, depth: int,
     f.put(f"{src}.proj_out.bias", f"{dst}/proj_out/bias")
 
 
-def convert_unet(sd: Mapping[str, np.ndarray], template, config,
-                 prefix: str = "model.diffusion_model.") -> dict:
-    """LDM ``UNetModel`` → ``models.unet.UNet2D`` params.
-
-    Walks the same block-numbering scheme the LDM constructor uses so the
-    index math is config-derived, not hard-coded per model.
-    """
-    cfg = config
-    f = _Filler(sd, template["params"])
-    p = prefix
-    # SDXL uses linear proj_in/out in transformers; SD1.5 uses 1×1 convs.
-    # Detect from the checkpoint itself.
-    linear_proj = True
-    for k in sd:
-        if k.startswith(p) and k.endswith("proj_in.weight"):
-            linear_proj = len(sd[k].shape) == 2
-            break
-
+def _unet_layout(f, cfg, p: str, linear_proj: bool) -> None:
+    """The full LDM→flax key walk (same block numbering the LDM
+    constructor uses, so index math is config-derived). Drives both the
+    real converter and the LoRA-key recorder."""
     f.linear(f"{p}time_embed.0", "time_1")
     f.linear(f"{p}time_embed.2", "time_2")
     if cfg.adm_in_channels:
@@ -348,7 +363,21 @@ def convert_unet(sd: Mapping[str, np.ndarray], template, config,
 
     f.norm(f"{p}out.0", "norm_out/GroupNorm_0")
     f.conv(f"{p}out.2", "conv_out")
-    return {"params": f.finish(expect_prefix=p)}
+
+
+def convert_unet(sd: Mapping[str, np.ndarray], template, config,
+                 prefix: str = "model.diffusion_model.") -> dict:
+    """LDM ``UNetModel`` → ``models.unet.UNet2D`` params."""
+    f = _Filler(sd, template["params"])
+    # SDXL uses linear proj_in/out in transformers; SD1.5 uses 1×1 convs.
+    # Detect from the checkpoint itself.
+    linear_proj = True
+    for k in sd:
+        if k.startswith(prefix) and k.endswith("proj_in.weight"):
+            linear_proj = len(sd[k].shape) == 2
+            break
+    _unet_layout(f, config, prefix, linear_proj)
+    return {"params": f.finish(expect_prefix=prefix)}
 
 
 # ---------------------------------------------------------------------------

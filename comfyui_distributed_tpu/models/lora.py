@@ -1,0 +1,198 @@
+"""LoRA loading and merging (kohya ``.safetensors`` format).
+
+The reference gets LoRA for free from ComfyUI core (``LoraLoader`` node);
+a standalone framework owns it. This implements the dominant published
+format — kohya sd-scripts keys, as shipped by civitai for SD1.5/SDXL:
+
+- ``lora_unet_{ldm_module_path_with_underscores}.lora_down.weight`` /
+  ``.lora_up.weight`` / ``.alpha`` for the UNet,
+- ``lora_te_…`` (SD1.5) / ``lora_te1_…``+``lora_te2_…`` (SDXL) with HF
+  ``CLIPTextModel`` module paths for the text encoders.
+
+Key-map derivation is the part every implementation gets subtly wrong;
+here it cannot drift: the map is RECORDED from the weight converter's own
+layout walks (``convert._unet_layout`` / ``convert._clip_hf_layout`` via
+``convert._Recorder``), so a LoRA key matches exactly where the
+corresponding base weight would land, and the converter's torch→flax
+transforms are reused verbatim on the delta (``W' = W + s·(α/r)·B·A``,
+merged — TPU-first: merging keeps the hot path one fused matmul; runtime
+adapter branches would add per-layer matmuls XLA cannot fold away).
+"""
+
+from __future__ import annotations
+
+import copy
+from pathlib import Path
+from typing import Mapping
+
+import numpy as np
+
+from ..utils.exceptions import ValidationError
+from ..utils.logging import debug_log, log
+from .convert import (_Recorder, _clip_hf_layout, _unet_layout,
+                      load_safetensors)
+
+
+def unet_records(config, linear_proj: bool = True,
+                 prefix: str = "model.diffusion_model."):
+    rec = _Recorder()
+    _unet_layout(rec, config, prefix, linear_proj)
+    return rec.records
+
+
+def clip_hf_records(config, prefix: str = "text_model."):
+    rec = _Recorder()
+    _clip_hf_layout(rec, config, prefix)
+    return rec.records
+
+
+def _delta(down: np.ndarray, up: np.ndarray, alpha, transform) -> np.ndarray:
+    """torch-layout ΔW = (α/r)·up·down, then the converter's torch→flax
+    transform (valid because every transform is a pure layout map)."""
+    r = down.shape[0]
+    scale = (float(alpha) / r) if alpha is not None else 1.0
+    down = np.asarray(down, np.float32)
+    up = np.asarray(up, np.float32)
+    if down.ndim == 2:                       # Linear: [r,in] / [out,r]
+        d = up @ down
+    else:                                    # Conv: [r,in,k,k] / [out,r,1,1]
+        d = (up.reshape(up.shape[0], -1) @ down.reshape(r, -1)).reshape(
+            up.shape[0], *down.shape[1:])
+    return transform(d * scale)
+
+
+def collect_deltas(
+    lora_sd: Mapping[str, np.ndarray],
+    records,
+    lora_prefix: str,
+    converter_prefix: str,
+    strength: float,
+) -> tuple[dict[str, np.ndarray], set[str]]:
+    """Match LoRA keys against recorded converter entries.
+
+    Returns (dst_path → flax-layout delta, consumed source keys).
+    """
+    deltas: dict[str, np.ndarray] = {}
+    used: set[str] = set()
+    for src_key, dst_path, transform in records:
+        if (not src_key.endswith(".weight")
+                or not src_key.startswith(converter_prefix)):
+            continue
+        base = src_key[len(converter_prefix):-len(".weight")]
+        lkey = lora_prefix + base.replace(".", "_")
+        dk, uk, ak = (f"{lkey}.lora_down.weight", f"{lkey}.lora_up.weight",
+                      f"{lkey}.alpha")
+        if dk not in lora_sd or uk not in lora_sd:
+            continue
+        alpha = lora_sd.get(ak)
+        deltas[dst_path] = strength * _delta(
+            lora_sd[dk], lora_sd[uk], alpha, transform)
+        used.update({dk, uk})
+        if ak in lora_sd:
+            used.add(ak)
+    return deltas, used
+
+
+def apply_deltas(params: dict, deltas: Mapping[str, np.ndarray]) -> dict:
+    """Return a tree sharing every untouched leaf with ``params``, with
+    deltas added along patched paths (shape-checked against the live tree
+    — a geometry-mismatched LoRA fails loudly). Path-copy, not deep copy:
+    a real SDXL UNet is ~GBs, and only the LoRA'd leaves change."""
+    tree = dict(params["params"])
+    out = {**params, "params": tree}
+    for dst, d in deltas.items():
+        parts = dst.split("/")
+        node = tree
+        for part in parts[:-1]:          # copy-on-write down the path
+            child = node.get(part)
+            if not isinstance(child, dict):
+                raise ValidationError(f"LoRA target {dst!r} not in params tree")
+            child = dict(child)
+            node[part] = child
+            node = child
+        leaf = node.get(parts[-1])
+        if leaf is None:
+            raise ValidationError(f"LoRA target {dst!r} not in params tree")
+        if tuple(leaf.shape) != tuple(d.shape):
+            raise ValidationError(
+                f"LoRA delta for {dst!r}: shape {d.shape} != {tuple(leaf.shape)}")
+        node[parts[-1]] = np.asarray(leaf, np.float32) + d
+    return out
+
+
+def load_lora_file(path: Path) -> dict[str, np.ndarray]:
+    return load_safetensors(Path(path))
+
+
+def apply_lora(bundle, lora_sd: Mapping[str, np.ndarray], *,
+               strength_model: float = 1.0, strength_clip: float = 1.0,
+               name: str = "lora"):
+    """Merge a kohya LoRA into copies of a unet-kind ``ModelBundle``'s
+    params. Returns ``(patched_bundle, patched_conditioner_or_None)``.
+
+    The input bundle is never mutated (registry bundles are shared);
+    pipelines are shallow-cloned with fresh compile caches.
+    """
+    if bundle.kind != "unet":
+        raise ValidationError(
+            f"LoRA merging supports unet-kind presets; {bundle.preset.name!r} "
+            f"is {bundle.kind!r} (FLUX/video LoRA formats differ)")
+
+    used: set[str] = set()
+    unet_cfg = bundle.preset.unet
+    linear_proj = not (unet_cfg.context_dim == 768 and
+                      unet_cfg.adm_in_channels == 0)
+    recs = unet_records(unet_cfg, linear_proj=linear_proj)
+    deltas, u = collect_deltas(lora_sd, recs, "lora_unet_",
+                               "model.diffusion_model.", strength_model)
+    used |= u
+
+    patched = copy.copy(bundle)
+    patched.pipeline = copy.copy(bundle.pipeline)
+    patched.pipeline._fn_cache = {}
+    patched.pipeline._i2i_cache = {}
+    if deltas and strength_model:
+        patched.pipeline.unet_params = apply_deltas(
+            bundle.pipeline.unet_params, deltas)
+
+    # text encoders: only the weight-faithful CLIP stack is patchable
+    conditioner = None
+    stack = getattr(bundle, "clip_stack", None)
+    if stack is not None and strength_clip:
+        from .clip import CLIPConditioner
+
+        te_parts = []
+        if hasattr(stack, "clip_l"):          # SDXL dual stack
+            te_parts = [("lora_te1_", stack.clip_l), ("lora_te2_", stack.clip_g)]
+        else:                                  # SD1.5 single encoder
+            te_parts = [("lora_te_", stack)]
+        new_stack = copy.copy(stack)
+        for prefix, enc in te_parts:
+            d, u = collect_deltas(
+                lora_sd, clip_hf_records(enc.config),
+                prefix + "text_model_", "text_model.", strength_clip)
+            used |= u
+            if d:
+                new_enc = copy.copy(enc)
+                new_enc.params = apply_deltas(enc.params, d)
+                if enc is getattr(stack, "clip_l", None):
+                    new_stack.clip_l = new_enc
+                elif enc is getattr(stack, "clip_g", None):
+                    new_stack.clip_g = new_enc
+                else:
+                    new_stack = new_enc
+        patched.clip_stack = new_stack
+        conditioner = CLIPConditioner(
+            new_stack, kind=bundle.preset.clip or "clip-l")
+        # keep the bundle self-consistent: its own encoder must produce
+        # LoRA'd conditioning too, not just the returned CLIP output
+        patched.text_encoder = conditioner
+
+    unmatched = len([k for k in lora_sd if k not in used])
+    log(f"LoRA {name!r}: merged {len(deltas)} unet tensors"
+        f"{' + text encoders' if conditioner else ''}"
+        f"{f' ({unmatched} keys unmatched)' if unmatched else ''}")
+    if unmatched:
+        sample = [k for k in lora_sd if k not in used][:4]
+        debug_log(f"LoRA {name!r} unmatched keys (first 4): {sample}")
+    return patched, conditioner

@@ -53,7 +53,10 @@ class UNetConfig:
     def sdxl(cls) -> "UNetConfig":
         from ..utils import constants
 
-        return cls(remat=constants.REMAT)
+        # 2816 = 1280 pooled CLIP-G + 6×256 Fourier size/crop conds —
+        # without label_emb a real SDXL checkpoint cannot convert
+        # (label_emb.* keys would be unconsumed) and micro-conds are lost
+        return cls(remat=constants.REMAT, adm_in_channels=2816)
 
     @classmethod
     def sd15(cls) -> "UNetConfig":

@@ -1,0 +1,194 @@
+"""LoRA merging (kohya format): analytic delta checks against the flax
+trees, strength scaling, bundle isolation, text-encoder patching, and the
+LoraLoader node (ComfyUI-core surface the reference free-rides on)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from comfyui_distributed_tpu.models.lora import (
+    apply_lora, clip_hf_records, collect_deltas, unet_records)
+from comfyui_distributed_tpu.models.registry import ModelRegistry
+from comfyui_distributed_tpu.models.unet import UNetConfig
+from comfyui_distributed_tpu.utils.exceptions import ValidationError
+
+
+def _leaf(tree, path):
+    node = tree["params"]
+    for part in path.split("/"):
+        node = node[part]
+    return np.asarray(node)
+
+
+def _bundle():
+    # fresh registry → bundles are not shared with other tests
+    return ModelRegistry().get("tiny")
+
+
+def _attn_lora(rng, in_dim, out_dim, r=4, alpha=None, conv=None):
+    """Random kohya pair for one module. ``conv``: (k, k) kernel dims."""
+    if conv:
+        down = rng.randn(r, in_dim, *conv).astype(np.float32) * 0.1
+        up = rng.randn(out_dim, r, 1, 1).astype(np.float32) * 0.1
+    else:
+        down = rng.randn(r, in_dim).astype(np.float32) * 0.1
+        up = rng.randn(out_dim, r).astype(np.float32) * 0.1
+    sd = {"lora_down.weight": down, "lora_up.weight": up}
+    if alpha is not None:
+        sd["alpha"] = np.array(alpha, np.float32)
+    return sd
+
+
+class TestDeltas:
+    def test_linear_delta_matches_analytic(self):
+        cfg = UNetConfig.tiny(dtype="float32")
+        recs = unet_records(cfg)
+        # tiny level 1 has the only transformer: down_1_attn_0 block_0 attn1
+        target = "model.diffusion_model.input_blocks.3.1.transformer_blocks.0.attn1.to_q"
+        assert any(s == f"{target}.weight" for s, _, _ in recs)
+        rng = np.random.RandomState(0)
+        inner = 64   # tiny level-1: model_channels*2 = 64
+        parts = _attn_lora(rng, inner, inner, r=4, alpha=2.0)
+        sd = {f"lora_unet_input_blocks_3_1_transformer_blocks_0_attn1_to_q.{k}": v
+              for k, v in parts.items()}
+        deltas, used = collect_deltas(sd, recs, "lora_unet_",
+                                      "model.diffusion_model.", 0.7)
+        assert len(deltas) == 1 and len(used) == 3
+        (dst, d), = deltas.items()
+        expected = 0.7 * (2.0 / 4) * (parts["lora_up.weight"]
+                                      @ parts["lora_down.weight"]).T
+        np.testing.assert_allclose(d, expected, rtol=1e-6)
+
+    def test_conv_delta_shape(self):
+        cfg = UNetConfig.tiny(dtype="float32")
+        recs = unet_records(cfg)
+        rng = np.random.RandomState(1)
+        # conv_in: 4 -> 32 channels, 3x3
+        parts = _attn_lora(rng, 4, 32, r=2, conv=(3, 3))
+        sd = {f"lora_unet_input_blocks_0_0.{k}": v for k, v in parts.items()}
+        deltas, _ = collect_deltas(sd, recs, "lora_unet_",
+                                   "model.diffusion_model.", 1.0)
+        (dst, d), = deltas.items()
+        assert dst == "conv_in/kernel"
+        assert d.shape == (3, 3, 4, 32)        # HWIO
+        up = parts["lora_up.weight"].reshape(32, 2)
+        down = parts["lora_down.weight"].reshape(2, -1)
+        expected = (up @ down).reshape(32, 4, 3, 3).transpose(2, 3, 1, 0)
+        np.testing.assert_allclose(d, expected, rtol=1e-6)
+
+
+class TestApply:
+    def _unet_lora_sd(self, rng, scale=0.1):
+        parts = _attn_lora(rng, 64, 64, r=4, alpha=4.0)
+        return {f"lora_unet_input_blocks_3_1_transformer_blocks_0_attn1_to_q.{k}": v
+                for k, v in parts.items()}, parts
+
+    def test_merge_changes_output_and_preserves_original(self):
+        bundle = _bundle()
+        sd, parts = self._unet_lora_sd(np.random.RandomState(2))
+        before = _leaf(bundle.pipeline.unet_params,
+                       "down_1_attn_0/block_0/attn1/to_q/kernel").copy()
+        patched, conditioner = apply_lora(bundle, sd, strength_model=1.0)
+        after = _leaf(patched.pipeline.unet_params,
+                      "down_1_attn_0/block_0/attn1/to_q/kernel")
+        np.testing.assert_allclose(
+            after - before,
+            (parts["lora_up.weight"] @ parts["lora_down.weight"]).T,
+            rtol=1e-4, atol=1e-6)
+        # shared registry bundle untouched
+        np.testing.assert_array_equal(
+            _leaf(bundle.pipeline.unet_params,
+                  "down_1_attn_0/block_0/attn1/to_q/kernel"), before)
+        assert conditioner is None             # tiny has no clip stack
+
+    def test_strength_zero_is_identity(self):
+        bundle = _bundle()
+        sd, _ = self._unet_lora_sd(np.random.RandomState(3))
+        patched, _ = apply_lora(bundle, sd, strength_model=0.0)
+        np.testing.assert_array_equal(
+            _leaf(patched.pipeline.unet_params,
+                  "down_1_attn_0/block_0/attn1/to_q/kernel"),
+            _leaf(bundle.pipeline.unet_params,
+                  "down_1_attn_0/block_0/attn1/to_q/kernel"))
+
+    def test_geometry_mismatch_fails_loudly(self):
+        bundle = _bundle()
+        rng = np.random.RandomState(4)
+        parts = _attn_lora(rng, 77, 99, r=4)   # wrong dims for this model
+        sd = {f"lora_unet_input_blocks_3_1_transformer_blocks_0_attn1_to_q.{k}": v
+              for k, v in parts.items()}
+        with pytest.raises(ValidationError, match="shape"):
+            apply_lora(bundle, sd, strength_model=1.0)
+
+    def test_video_kind_rejected(self):
+        bundle = ModelRegistry().get("wan-tiny")
+        with pytest.raises(ValidationError, match="unet-kind"):
+            apply_lora(bundle, {}, strength_model=1.0)
+
+    def test_te_patching_with_clip_stack(self):
+        bundle = _bundle()
+        bundle.preset = bundle.preset.__class__(
+            **{**bundle.preset.__dict__, "clip": "sdxl"})
+        bundle.build_clip_stack(tiny=True)
+        cfg = bundle.clip_stack.clip_l.config
+        recs = clip_hf_records(cfg)
+        assert any("q_proj" in s for s, _, _ in recs)
+        rng = np.random.RandomState(5)
+        parts = _attn_lora(rng, cfg.width, cfg.width, r=2, alpha=2.0)
+        sd = {f"lora_te1_text_model_encoder_layers_0_self_attn_q_proj.{k}": v
+              for k, v in parts.items()}
+        before = np.asarray(
+            bundle.clip_stack.clip_l.params["params"]["layer_0"]["attn"]
+            ["q_proj"]["kernel"]).copy()
+        patched, conditioner = apply_lora(bundle, sd, strength_clip=1.0)
+        assert conditioner is not None
+        after = np.asarray(
+            patched.clip_stack.clip_l.params["params"]["layer_0"]["attn"]
+            ["q_proj"]["kernel"])
+        assert not np.array_equal(before, after)
+        # original stack untouched
+        np.testing.assert_array_equal(
+            np.asarray(bundle.clip_stack.clip_l.params["params"]["layer_0"]
+                       ["attn"]["q_proj"]["kernel"]), before)
+
+
+class TestNode:
+    def test_loader_node(self, tmp_path, monkeypatch, tmp_config):
+        from safetensors.numpy import save_file
+        from comfyui_distributed_tpu.graph.node import get_node
+
+        rng = np.random.RandomState(6)
+        parts = _attn_lora(rng, 64, 64, r=4, alpha=4.0)
+        sd = {f"lora_unet_input_blocks_3_1_transformer_blocks_0_attn1_to_q.{k}": v
+              for k, v in parts.items()}
+        save_file(sd, str(tmp_path / "style.safetensors"))
+        monkeypatch.setenv("CDT_LORA_DIR", str(tmp_path))
+
+        bundle = _bundle()
+        clip = bundle.text_encoder
+        node = get_node("LoraLoader")()
+        (patched, clip_out) = node.execute(bundle, clip, "style",
+                                           strength_model=0.5)
+        assert patched is not bundle
+        assert clip_out is clip                # no clip stack → passthrough
+        a = _leaf(patched.pipeline.unet_params,
+                  "down_1_attn_0/block_0/attn1/to_q/kernel")
+        b = _leaf(bundle.pipeline.unet_params,
+                  "down_1_attn_0/block_0/attn1/to_q/kernel")
+        assert not np.array_equal(a, b)
+
+    def test_loader_missing_file(self, tmp_path, monkeypatch, tmp_config):
+        from comfyui_distributed_tpu.graph.node import get_node
+
+        monkeypatch.setenv("CDT_LORA_DIR", str(tmp_path))
+        with pytest.raises(ValidationError, match="not found"):
+            get_node("LoraLoader")().execute(_bundle(), None, "absent")
+
+
+def test_sdxl_preset_has_adm():
+    """Real SDXL checkpoints carry label_emb (2816 = 1280 pooled +
+    6×256 size conds); a preset without it cannot convert them."""
+    assert UNetConfig.sdxl().adm_in_channels == 2816
+    assert UNetConfig.sd15().adm_in_channels == 0
