@@ -103,20 +103,62 @@ class Txt2ImgPipeline:
     def latent_channels(self) -> int:
         return self.unet.config.in_channels
 
-    def _denoiser(self, context, y):
+    def _denoiser(self, context, y, hint=None):
+        """``hint``: control map [B,H,W,C] when this pipeline carries a
+        ControlNet (``with_control``); residuals are scaled and fed into
+        the UNet's control hook every step. Under CFG's batch-dim concat
+        the hint tiles to the doubled batch, so control conditions the
+        cond AND uncond passes (A1111 convention)."""
+        control_cfg = getattr(self, "_control", None)
+
         def model_fn(x, t, ctx, y_):
-            return self.unet.apply(self.unet_params, x, t, ctx, y_)
+            control = None
+            if control_cfg is not None and hint is not None:
+                cn, strength = control_cfg
+                hf = hint.astype(jnp.float32)
+                if hf.shape[0] != x.shape[0]:
+                    hf = jnp.concatenate(
+                        [hf] * (x.shape[0] // hf.shape[0]), axis=0)
+                down, mid = cn.model.apply(cn.params, x, t, ctx, y_, hf)
+                control = ([d * strength for d in down], mid * strength)
+            return self.unet.apply(self.unet_params, x, t, ctx, y_,
+                                   control=control)
 
         return eps_denoiser(model_fn, self.schedule, context, y)
 
+    def with_control(self, cn_bundle, strength: float = 1.0):
+        """Clone carrying a ControlNet (fresh compile caches; the base
+        pipeline is untouched — same discipline as LoRA patching).
+        Clones are memoized per (cn uid, strength) so repeated node
+        executions reuse their compiled programs."""
+        import copy as _copy
+
+        cache = getattr(self, "_control_clones", None)
+        if cache is None:
+            cache = self._control_clones = {}
+        key = (getattr(cn_bundle, "uid", id(cn_bundle)), float(strength))
+        clone = cache.get(key)
+        if clone is None:
+            if len(cache) >= 4:
+                cache.pop(next(iter(cache)))
+            clone = _copy.copy(self)
+            clone._control = (cn_bundle, float(strength))
+            clone._fn_cache = {}
+            clone._i2i_cache = {}
+            clone._control_clones = {}
+            cache[key] = clone
+        return clone
+
     def _sample_and_decode(self, key, context, uncond_context, y, uncond_y,
                            spec: GenerationSpec, batch: int, sigmas: jax.Array,
-                           init_latent: Optional[jax.Array] = None):
+                           init_latent: Optional[jax.Array] = None,
+                           hint: Optional[jax.Array] = None):
         """Single-shard work: noise → sampler scan → VAE decode.
 
         ``init_latent`` switches to img2img: the source latent is noised
         to the (partial) ladder's head instead of starting from pure
-        noise (k-diffusion img2img convention)."""
+        noise (k-diffusion img2img convention). ``hint`` feeds the
+        pipeline's ControlNet (``with_control``)."""
         k_noise, k_samp = jax.random.split(key)
         if init_latent is None:
             lat_h = spec.height // self.vae.config.downscale
@@ -131,7 +173,7 @@ class Txt2ImgPipeline:
 
         if spec.guidance_scale != 1.0:
             denoise = cfg_denoiser(
-                lambda ctx, yy: self._denoiser(ctx, yy),
+                lambda ctx, yy: self._denoiser(ctx, yy, hint=hint),
                 jnp.broadcast_to(context, (batch,) + context.shape[1:]),
                 jnp.broadcast_to(uncond_context, (batch,) + uncond_context.shape[1:]),
                 spec.guidance_scale,
@@ -142,6 +184,7 @@ class Txt2ImgPipeline:
             denoise = self._denoiser(
                 jnp.broadcast_to(context, (batch,) + context.shape[1:]),
                 None if y is None else jnp.broadcast_to(y, (batch,) + y.shape[1:]),
+                hint=hint,
             )
         x0 = sample(spec.sampler, denoise, x, sigmas, key=k_samp)
         images = self.vae.decode(x0)
@@ -159,18 +202,34 @@ class Txt2ImgPipeline:
         ``nodes/collector.py:252-295``).
         """
         has_y = self.unet.config.adm_in_channels > 0
+        has_control = getattr(self, "_control", None) is not None
         # ladder is built eagerly (host-side) so it's a compile-time constant
         sigmas = make_sigma_ladder(spec, self.schedule)
 
-        def per_shard(key, context, uncond_context, y, uncond_y):
-            k = participant_key(key, axis)
-            return self._sample_and_decode(
-                k, context, uncond_context,
-                y if has_y else None, uncond_y if has_y else None,
-                spec, spec.per_device_batch, sigmas,
-            )
+        if has_control:
+            # control hint rides as a replicated trailing argument
+            def per_shard(key, context, uncond_context, y, uncond_y, hint):
+                k = participant_key(key, axis)
+                return self._sample_and_decode(
+                    k, context, uncond_context,
+                    y if has_y else None, uncond_y if has_y else None,
+                    spec, spec.per_device_batch, sigmas, hint=hint,
+                )
 
-        in_specs = (P(), P(None, None, None), P(None, None, None), P(None, None), P(None, None))
+            in_specs = (P(), P(None, None, None), P(None, None, None),
+                        P(None, None), P(None, None),
+                        P(None, None, None, None))
+        else:
+            def per_shard(key, context, uncond_context, y, uncond_y):
+                k = participant_key(key, axis)
+                return self._sample_and_decode(
+                    k, context, uncond_context,
+                    y if has_y else None, uncond_y if has_y else None,
+                    spec, spec.per_device_batch, sigmas,
+                )
+
+            in_specs = (P(), P(None, None, None), P(None, None, None),
+                        P(None, None), P(None, None))
         f = jax.shard_map(
             per_shard, mesh=mesh, in_specs=in_specs,
             out_specs=P(axis, None, None, None),
@@ -187,19 +246,35 @@ class Txt2ImgPipeline:
         N seed-varied edits of the same source in one step-time (the
         img2img analogue of the reference's seed-offset fan-out)."""
         has_y = self.unet.config.adm_in_channels > 0
+        has_control = getattr(self, "_control", None) is not None
         sigmas = make_sigma_ladder(spec, self.schedule)
 
-        def per_shard(images, key, context, uncond_context, y, uncond_y):
-            k = participant_key(key, axis)
-            lat = self.vae.encode(images.astype(jnp.float32) * 2.0 - 1.0)
-            return self._sample_and_decode(
-                k, context, uncond_context,
-                y if has_y else None, uncond_y if has_y else None,
-                spec, images.shape[0], sigmas, init_latent=lat,
-            )
+        base_specs = (P(None, None, None, None), P(), P(None, None, None),
+                      P(None, None, None), P(None, None), P(None, None))
+        if has_control:
+            def per_shard(images, key, context, uncond_context, y, uncond_y,
+                          hint):
+                k = participant_key(key, axis)
+                lat = self.vae.encode(images.astype(jnp.float32) * 2.0 - 1.0)
+                return self._sample_and_decode(
+                    k, context, uncond_context,
+                    y if has_y else None, uncond_y if has_y else None,
+                    spec, images.shape[0], sigmas, init_latent=lat,
+                    hint=hint,
+                )
 
-        in_specs = (P(None, None, None, None), P(), P(None, None, None),
-                    P(None, None, None), P(None, None), P(None, None))
+            in_specs = base_specs + (P(None, None, None, None),)
+        else:
+            def per_shard(images, key, context, uncond_context, y, uncond_y):
+                k = participant_key(key, axis)
+                lat = self.vae.encode(images.astype(jnp.float32) * 2.0 - 1.0)
+                return self._sample_and_decode(
+                    k, context, uncond_context,
+                    y if has_y else None, uncond_y if has_y else None,
+                    spec, images.shape[0], sigmas, init_latent=lat,
+                )
+
+            in_specs = base_specs
         f = jax.shard_map(
             per_shard, mesh=mesh, in_specs=in_specs,
             out_specs=P(axis, None, None, None),
@@ -216,11 +291,13 @@ class Txt2ImgPipeline:
         uncond_context: jax.Array,
         y: Optional[jax.Array] = None,
         uncond_y: Optional[jax.Array] = None,
+        hint: Optional[jax.Array] = None,
     ) -> jax.Array:
         """One-shot img2img (value-keyed compile cache)."""
         if not hasattr(self, "_i2i_cache"):
             self._i2i_cache: "dict[tuple, Any]" = {}
-        key = (self._mesh_cache_key(mesh), spec, tuple(images.shape))
+        key = (self._mesh_cache_key(mesh), spec, tuple(images.shape),
+               None if hint is None else tuple(hint.shape))
         fn = self._i2i_cache.get(key)
         if fn is None:
             if len(self._i2i_cache) >= self._CACHE_MAX:
@@ -232,8 +309,14 @@ class Txt2ImgPipeline:
             y = jnp.zeros((1, max(adm, 1)), jnp.float32)
         if uncond_y is None:
             uncond_y = jnp.zeros_like(y)
-        return fn(jnp.asarray(images, jnp.float32), jax.random.key(seed),
-                  context, uncond_context, y, uncond_y)
+        args = (jnp.asarray(images, jnp.float32), jax.random.key(seed),
+                context, uncond_context, y, uncond_y)
+        if getattr(self, "_control", None) is not None:
+            if hint is None:
+                raise ValueError("pipeline carries a ControlNet but no "
+                                 "hint was given")
+            return fn(*args, jnp.asarray(hint, jnp.float32))
+        return fn(*args)
 
     def generate(
         self,
@@ -244,15 +327,22 @@ class Txt2ImgPipeline:
         uncond_context: jax.Array,
         y: Optional[jax.Array] = None,
         uncond_y: Optional[jax.Array] = None,
+        hint: Optional[jax.Array] = None,
     ) -> jax.Array:
         """Convenience one-shot generate (compiles on first distinct spec)."""
-        fn = self._cached_fn(mesh, spec)
+        fn = self._cached_fn(mesh, spec, hint=hint)
         if y is None:
             adm = self.unet.config.adm_in_channels
             y = jnp.zeros((1, max(adm, 1)), jnp.float32)
         if uncond_y is None:
             uncond_y = jnp.zeros_like(y)
         key = jax.random.key(seed)
+        if getattr(self, "_control", None) is not None:
+            if hint is None:
+                raise ValueError("pipeline carries a ControlNet but no "
+                                 "hint was given")
+            return fn(key, context, uncond_context, y, uncond_y,
+                      jnp.asarray(hint, jnp.float32))
         return fn(key, context, uncond_context, y, uncond_y)
 
     _CACHE_MAX = 8
@@ -268,10 +358,11 @@ class Txt2ImgPipeline:
         return (tuple(mesh.axis_names), tuple(mesh.shape.values()),
                 tuple(d.id for d in mesh.devices.flat))
 
-    def _cached_fn(self, mesh: Mesh, spec: GenerationSpec):
+    def _cached_fn(self, mesh: Mesh, spec: GenerationSpec, hint=None):
         if not hasattr(self, "_fn_cache"):
             self._fn_cache: "dict[tuple, Any]" = {}
-        key = (self._mesh_cache_key(mesh), spec)
+        key = (self._mesh_cache_key(mesh), spec,
+               None if hint is None else tuple(hint.shape))
         fn = self._fn_cache.get(key)
         if fn is None:
             if len(self._fn_cache) >= self._CACHE_MAX:

@@ -277,7 +277,19 @@ class UltimateSDUpscaleDistributed(NodeDef):
             padding=int(tile_padding), steps=int(steps), denoise=float(denoise),
             sampler=sampler_name, scheduler=scheduler, guidance_scale=float(cfg),
         )
-        upscaler = TileUpscaler(model.pipeline)
+        # ControlNet rides the positive conditioning; hints are cropped
+        # per tile inside the SPMD program (reference crop_cond +
+        # crop_model_patch semantics, SURVEY §7 hard-part #3)
+        control = positive.get("control") if isinstance(positive, dict) else None
+        pipeline = model.pipeline
+        control_hint = None
+        if control:
+            pipeline = pipeline.with_control(control["model"],
+                                             control.get("strength", 1.0))
+            control_hint = jnp.asarray(control["hint"], jnp.float32)
+            if control_hint.ndim == 3:
+                control_hint = control_hint[None]
+        upscaler = TileUpscaler(pipeline)
         adm = model.pipeline.unet.config.adm_in_channels
         y = uy = None
         if adm:
@@ -300,9 +312,13 @@ class UltimateSDUpscaleDistributed(NodeDef):
             out = upscaler.upscale(
                 mesh, jnp.asarray(image), spec, int(seed),
                 positive["context"], negative["context"], y, uy,
-                spatial_cond=smap,
+                spatial_cond=smap, control_hint=control_hint,
             )
             return (out,)
+        if control_hint is not None:
+            log("USDU farm mode: ControlNet hints apply to locally "
+                "processed work only; cross-host STATIC tile tasks run "
+                "without control this round")
 
         images = jnp.asarray(image)
 
@@ -315,10 +331,14 @@ class UltimateSDUpscaleDistributed(NodeDef):
             def process_images(start: int, end: int) -> np.ndarray:
                 done = []
                 for i in range(start, end):
+                    ch = control_hint
+                    if ch is not None and ch.shape[0] == images.shape[0]:
+                        ch = ch[i:i + 1]
                     done.append(np.asarray(upscaler.upscale(
                         mesh, images[i:i + 1], spec, int(seed) + i,
                         positive["context"], negative["context"], y, uy,
                         spatial_cond=None if smap is None else smap[i:i + 1],
+                        control_hint=ch,
                     )))
                 return np.concatenate(done, axis=0)
 
@@ -413,6 +433,27 @@ def _adm_from_cond(cond: dict, adm_channels: int) -> jax.Array:
 # --------------------------------------------------------------------------
 
 
+def _resolve_model_file(env_var: str, subdir: str, name: str):
+    """Shared weight-file resolution for the model-loader nodes:
+    ``$<env_var>`` (or ``$CDT_CHECKPOINT_ROOT/<subdir>``) + ``name`` with
+    ``.safetensors`` appended unless present. Returns (path_or_None,
+    root, source_key) where ``source_key`` identifies the weight SOURCE
+    (path + mtime for files) so loader caches invalidate when the file
+    appears or changes."""
+    import os
+
+    root = os.environ.get(env_var) or (
+        os.path.join(os.environ["CDT_CHECKPOINT_ROOT"], subdir)
+        if os.environ.get("CDT_CHECKPOINT_ROOT") else "")
+    if not root:
+        return None, "", None
+    fname = name if name.endswith(".safetensors") else f"{name}.safetensors"
+    path = Path(root) / fname
+    if path.is_file():
+        return path, root, ("file", str(path), path.stat().st_mtime_ns)
+    return None, root, None
+
+
 _UPSCALER_PRESETS = {
     "tiny-x2": lambda cfg_mod: cfg_mod.UpscalerConfig.tiny(scale=2),
     "tiny-x4": lambda cfg_mod: cfg_mod.UpscalerConfig.tiny(scale=4),
@@ -437,23 +478,15 @@ class UpscaleModelLoader(NodeDef):
     RETURNS = ("UPSCALE_MODEL",)
 
     def execute(self, model_name: str, **_):
-        import os
-
         name = str(model_name)
-        root = os.environ.get("CDT_UPSCALE_MODEL_DIR") or (
-            os.path.join(os.environ["CDT_CHECKPOINT_ROOT"], "upscalers")
-            if os.environ.get("CDT_CHECKPOINT_ROOT") else "")
-        candidate = Path(root) / f"{name}.safetensors" if root else None
-        if name.endswith(".safetensors") and root:
-            candidate = Path(root) / name
+        candidate, root, source = _resolve_model_file(
+            "CDT_UPSCALE_MODEL_DIR", "upscalers", name)
         # cache entries are keyed by their weight SOURCE: a checkpoint
         # dropped in after a random-init fallback (or replaced on disk)
         # must win on the next load, not be shadowed until restart
-        if candidate is not None and candidate.is_file():
-            source = ("file", str(candidate), candidate.stat().st_mtime_ns)
-        elif name in _UPSCALER_PRESETS:
+        if source is None and name in _UPSCALER_PRESETS:
             source = ("preset", name)
-        else:
+        if source is None:
             raise ValidationError(
                 f"unknown upscale model {name!r}: no checkpoint under "
                 f"{root or '$CDT_UPSCALE_MODEL_DIR'} and not one of "
@@ -503,6 +536,119 @@ class ImageUpscaleWithModel(NodeDef):
         return (np.asarray(out),)
 
 
+_controlnet_cache: dict[str, Any] = {}
+
+
+@register_node("ControlNetLoader")
+class ControlNetLoader(NodeDef):
+    """ControlNet loader (ComfyUI-core surface; the reference's USDU
+    crops control hints per tile, ``utils/usdu_utils.py:506``).
+    ``control_net_name`` is a published ``.safetensors`` under
+    ``CDT_CONTROLNET_DIR`` (or ``CDT_CHECKPOINT_ROOT/controlnet``) — the
+    base architecture (sd15/sdxl) is detected from the checkpoint — or a
+    preset name (``tiny``/``sd15``/``sdxl``, random init)."""
+
+    INPUTS = {"control_net_name": "STRING"}
+    RETURNS = ("CONTROL_NET",)
+
+    _PRESETS = ("tiny", "sd15", "sdxl")
+
+    def execute(self, control_net_name: str, **_):
+        from ..models.unet import UNetConfig
+
+        name = str(control_net_name)
+        candidate, root, source = _resolve_model_file(
+            "CDT_CONTROLNET_DIR", "controlnet", name)
+        if source is None and name in self._PRESETS:
+            source = ("preset", name)
+        if source is None:
+            raise ValidationError(
+                f"unknown control net {name!r}: no checkpoint under "
+                f"{root or '$CDT_CONTROLNET_DIR'} and not one of "
+                f"{self._PRESETS}", field="control_net_name")
+        cached = _controlnet_cache.get(name)
+        if cached is not None and cached[0] == source:
+            return (cached[1],)
+
+        from ..models.controlnet import ControlNet, ControlNetBundle, \
+            init_controlnet
+
+        if source[0] == "file":
+            from ..models.convert import convert_controlnet, load_safetensors
+
+            sd = load_safetensors(candidate)
+            # base architecture from the checkpoint itself
+            if "control_model.label_emb.0.0.weight" in sd:
+                cfg = UNetConfig.sdxl()
+            else:
+                cfg = UNetConfig.sd15()
+            params = convert_controlnet(sd, self._template(cfg), cfg)
+            bundle = ControlNetBundle(ControlNet(cfg), params,
+                                      name=candidate.stem)
+            log(f"converted controlnet {candidate} ({cfg.context_dim}-ctx)")
+        else:
+            cfg = {"tiny": UNetConfig.tiny, "sd15": UNetConfig.sd15,
+                   "sdxl": UNetConfig.sdxl}[name]()
+            hw = (8, 8) if name == "tiny" else (32, 32)
+            bundle = init_controlnet(cfg, jax.random.key(0), sample_shape=(
+                *hw, cfg.in_channels))
+            bundle.name = name
+            log(f"controlnet {name!r}: no checkpoint found — random init")
+        _controlnet_cache[name] = (source, bundle)
+        return (bundle,)
+
+    @staticmethod
+    def _template(cfg):
+        from ..models.controlnet import init_controlnet
+
+        return init_controlnet(cfg, jax.random.key(0),
+                               sample_shape=(8, 8, cfg.in_channels)).params
+
+
+@register_node("ControlNetApply")
+class ControlNetApply(NodeDef):
+    """Attach a control hint to a conditioning (ComfyUI semantics): the
+    sampler nodes read ``conditioning["control"]`` and thread the hint
+    through every denoise step. Under CFG the control conditions both
+    passes (A1111 convention)."""
+
+    INPUTS = {"conditioning": "CONDITIONING", "control_net": "CONTROL_NET",
+              "image": "IMAGE"}
+    OPTIONAL = {"strength": "FLOAT"}
+    RETURNS = ("CONDITIONING",)
+
+    def execute(self, conditioning, control_net, image,
+                strength: float = 1.0, **_):
+        hint = np.asarray(image, np.float32)
+        if hint.ndim == 3:
+            hint = hint[None]
+        return ({**conditioning,
+                 "control": {"model": control_net, "hint": hint,
+                             "strength": float(strength)}},)
+
+
+def _control_from_cond(pipeline, cond: dict, height: int, width: int):
+    """Activate the conditioning's ControlNet on a pipeline clone and
+    shape the hint for the stem: the published hint stem downscales by 8,
+    so the hint target is latent-res × 8 (equal to the image size for
+    SD-family VAEs; differs only for toy test VAEs). Returns
+    (pipeline, hint)."""
+    control = cond.get("control") if isinstance(cond, dict) else None
+    if not control:
+        return pipeline, None
+    hint = jnp.asarray(control["hint"], jnp.float32)
+    if hint.ndim == 3:
+        hint = hint[None]
+    ds = pipeline.vae.config.downscale
+    target = (height // ds * 8, width // ds * 8)
+    if hint.shape[1:3] != target:
+        hint = jax.image.resize(
+            hint, (hint.shape[0], *target, hint.shape[-1]),
+            method="bilinear")
+    return (pipeline.with_control(control["model"],
+                                  control.get("strength", 1.0)), hint)
+
+
 @register_node("LoraLoader")
 class LoraLoader(NodeDef):
     """Merge a kohya-format LoRA into copies of the model/clip (ComfyUI
@@ -515,30 +661,39 @@ class LoraLoader(NodeDef):
     OPTIONAL = {"strength_model": "FLOAT", "strength_clip": "FLOAT"}
     RETURNS = ("MODEL", "CLIP")
 
+    _cache: dict = {}
+
     def execute(self, model, clip, lora_name: str,
                 strength_model: float = 1.0, strength_clip: float = 1.0,
                 **_):
-        import os
-
         from ..models.lora import apply_lora, load_lora_file
 
         if not strength_model and not strength_clip:
             return (model, clip)
         name = str(lora_name)
-        root = os.environ.get("CDT_LORA_DIR") or (
-            os.path.join(os.environ["CDT_CHECKPOINT_ROOT"], "loras")
-            if os.environ.get("CDT_CHECKPOINT_ROOT") else "")
-        path = Path(root) / (name if name.endswith(".safetensors")
-                             else f"{name}.safetensors") if root else None
-        if path is None or not path.is_file():
+        path, root, source = _resolve_model_file("CDT_LORA_DIR", "loras",
+                                                 name)
+        if source is None:
             raise ValidationError(
                 f"LoRA {name!r} not found under "
                 f"{root or '$CDT_LORA_DIR'}", field="lora_name")
+        # merge + compile are expensive; memoize per (base model, weight
+        # source, strengths). The cached entry pins the base bundle, so
+        # identity comparison is safe (ids can't recycle while cached).
+        key = (name, source, float(strength_model), float(strength_clip))
+        cached = self._cache.get(key)
+        if (cached is not None and cached[0] is model
+                and cached[1] is clip):
+            return cached[2]
         patched, conditioner = apply_lora(
             model, load_lora_file(path),
             strength_model=float(strength_model),
             strength_clip=float(strength_clip), name=name)
-        return (patched, conditioner if conditioner is not None else clip)
+        result = (patched, conditioner if conditioner is not None else clip)
+        if len(self._cache) >= 4:
+            self._cache.pop(next(iter(self._cache)))
+        self._cache[key] = (model, clip, result)
+        return result
 
 
 @register_node("CheckpointLoader")
@@ -625,8 +780,11 @@ class TPUTxt2Img(NodeDef):
         adm = model.pipeline.unet.config.adm_in_channels
         y = _adm_from_cond(positive, adm) if adm else None
         uy = _adm_from_cond(negative, adm) if adm else None
-        images = model.pipeline.generate(
-            mesh, spec, int(seed), positive["context"], negative["context"], y, uy,
+        pipeline, hint = _control_from_cond(model.pipeline, positive,
+                                            spec.height, spec.width)
+        images = pipeline.generate(
+            mesh, spec, int(seed), positive["context"], negative["context"],
+            y, uy, hint=hint,
         )
         return (images,)
 
@@ -670,9 +828,10 @@ class TPUImg2Img(NodeDef):
         adm = model.pipeline.unet.config.adm_in_channels
         y = _adm_from_cond(positive, adm) if adm else None
         uy = _adm_from_cond(negative, adm) if adm else None
-        out = model.pipeline.img2img(
+        pipeline, hint = _control_from_cond(model.pipeline, positive, H, W)
+        out = pipeline.img2img(
             mesh, spec, int(seed), images,
-            positive["context"], negative["context"], y, uy,
+            positive["context"], negative["context"], y, uy, hint=hint,
         )
         return (out,)
 

@@ -306,18 +306,20 @@ def _spatial_transformer(f: _Filler, src: str, dst: str, depth: int,
     f.put(f"{src}.proj_out.bias", f"{dst}/proj_out/bias")
 
 
-def _unet_layout(f, cfg, p: str, linear_proj: bool) -> None:
-    """The full LDM→flax key walk (same block numbering the LDM
-    constructor uses, so index math is config-derived). Drives both the
-    real converter and the LoRA-key recorder."""
+def _unet_embed_layout(f, cfg, p: str) -> None:
     f.linear(f"{p}time_embed.0", "time_1")
     f.linear(f"{p}time_embed.2", "time_2")
     if cfg.adm_in_channels:
         f.linear(f"{p}label_emb.0.0", "label_1")
         f.linear(f"{p}label_emb.0.2", "label_2")
 
+
+def _unet_down_layout(f, cfg, p: str, linear_proj: bool) -> int:
+    """Encoder walk (shared with the ControlNet converter, whose trunk is
+    an exact copy of the UNet encoder). Returns the skip count."""
     f.conv(f"{p}input_blocks.0.0", "conv_in")
     idx = 1
+    skips = 1
     prev_ch = cfg.model_channels
     for level, mult in enumerate(cfg.channel_mult):
         ch = cfg.model_channels * mult
@@ -330,11 +332,16 @@ def _unet_layout(f, cfg, p: str, linear_proj: bool) -> None:
                                      cfg.transformer_depth[level], linear_proj)
             prev_ch = ch
             idx += 1
+            skips += 1
         if level < len(cfg.channel_mult) - 1:
             # Downsample/Upsample wrap an unnamed nn.Conv → auto "Conv_0"
             f.conv(f"{p}input_blocks.{idx}.0.op", f"down_{level}_ds/Conv_0")
             idx += 1
+            skips += 1
+    return skips
 
+
+def _unet_mid_layout(f, cfg, p: str, linear_proj: bool) -> None:
     _res_block(f, f"{p}middle_block.0", "mid_res_1", has_skip=False)
     if cfg.transformer_depth[-1]:
         _spatial_transformer(f, f"{p}middle_block.1", "mid_attn",
@@ -342,6 +349,15 @@ def _unet_layout(f, cfg, p: str, linear_proj: bool) -> None:
         _res_block(f, f"{p}middle_block.2", "mid_res_2", has_skip=False)
     else:
         _res_block(f, f"{p}middle_block.1", "mid_res_2", has_skip=False)
+
+
+def _unet_layout(f, cfg, p: str, linear_proj: bool) -> None:
+    """The full LDM→flax key walk (same block numbering the LDM
+    constructor uses, so index math is config-derived). Drives both the
+    real converter and the LoRA-key recorder."""
+    _unet_embed_layout(f, cfg, p)
+    _unet_down_layout(f, cfg, p, linear_proj)
+    _unet_mid_layout(f, cfg, p, linear_proj)
 
     # up path: skip-concat changes input channels, so every ResBlock has a
     # skip 1×1. Mirror UNet2D's skip-pop order to know nothing more is
@@ -608,3 +624,37 @@ def load_upscaler_checkpoint(path: Path):
     log(f"converted upscaler {path} "
         f"(x{cfg.scale}, {cfg.num_block} blocks, {cfg.num_feat} feat)")
     return UpscalerBundle(RRDBNet(cfg), params, name=Path(path).stem)
+
+
+# ---------------------------------------------------------------------------
+# ControlNet (LDM ``cldm`` layout — ``control_model.*``)
+# ---------------------------------------------------------------------------
+
+_HINT_SRC_INDICES = (0, 2, 4, 6, 8, 10, 12, 14)
+
+
+def _controlnet_layout(f, cfg, p: str, linear_proj: bool) -> None:
+    """``control_model.*`` walk: the trunk is an exact copy of the UNet
+    encoder (shared ``_unet_down_layout`` — drift-proof), plus the hint
+    stem, one zero-conv per skip, and the middle output zero-conv."""
+    _unet_embed_layout(f, cfg, p)
+    n_skips = _unet_down_layout(f, cfg, p, linear_proj)
+    _unet_mid_layout(f, cfg, p, linear_proj)
+    for j, src_idx in enumerate(_HINT_SRC_INDICES):
+        f.conv(f"{p}input_hint_block.{src_idx}", f"hint_{j}")
+    for i in range(n_skips):
+        f.conv(f"{p}zero_convs.{i}.0", f"zero_{i}")
+    f.conv(f"{p}middle_block_out.0", "mid_out")
+
+
+def convert_controlnet(sd: Mapping[str, np.ndarray], template, config,
+                       prefix: str = "control_model.") -> dict:
+    """LDM ControlNet state dict → ``models.controlnet.ControlNet`` params."""
+    f = _Filler(sd, template["params"])
+    linear_proj = True
+    for k in sd:
+        if k.startswith(prefix) and k.endswith("proj_in.weight"):
+            linear_proj = len(sd[k].shape) == 2
+            break
+    _controlnet_layout(f, config, prefix, linear_proj)
+    return {"params": f.finish(expect_prefix=prefix)}

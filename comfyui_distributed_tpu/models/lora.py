@@ -151,6 +151,7 @@ def apply_lora(bundle, lora_sd: Mapping[str, np.ndarray], *,
     patched.pipeline = copy.copy(bundle.pipeline)
     patched.pipeline._fn_cache = {}
     patched.pipeline._i2i_cache = {}
+    patched.pipeline._control_clones = {}   # never share pre-LoRA clones
     if deltas and strength_model:
         patched.pipeline.unet_params = apply_deltas(
             bundle.pipeline.unet_params, deltas)

@@ -108,7 +108,12 @@ class UNet2D(nn.Module):
         t: jax.Array,
         context: Optional[jax.Array] = None,
         y: Optional[jax.Array] = None,
+        control: Optional[tuple] = None,
     ) -> jax.Array:
+        """``control``: optional ``(down_residuals, mid_residual)`` from a
+        ControlNet (``models/controlnet.py``) — one residual per skip in
+        push order, added when each skip is popped, plus one added to the
+        middle state (LDM ``cldm`` semantics)."""
         cfg = self.config
         dt = cfg.jnp_dtype
         time_dim = cfg.model_channels * 4
@@ -157,6 +162,14 @@ class UNet2D(nn.Module):
                 cfg.heads_for(mid_ch), cfg.transformer_depth[-1], dt, name="mid_attn"
             )(h, context)
         h = Res(mid_ch, dt, name="mid_res_2")(h, emb)
+
+        if control is not None:
+            down_res, mid_res = control
+            assert len(down_res) == len(skips), (
+                f"control carries {len(down_res)} skip residuals, "
+                f"UNet has {len(skips)}")
+            h = h + mid_res.astype(h.dtype)
+            skips = [s + r.astype(s.dtype) for s, r in zip(skips, down_res)]
 
         # --- up path ---
         for level in reversed(range(len(cfg.channel_mult))):

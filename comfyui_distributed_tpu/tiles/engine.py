@@ -68,19 +68,21 @@ class TileUpscaler:
         self._fn_cache: dict = {}
 
     def _cached_upscale_fn(self, mesh: Mesh, image_hw, spec: UpscaleSpec,
-                          batch: int, axis: str, with_spatial: bool):
+                          batch: int, axis: str, with_spatial: bool,
+                          with_control: bool = False):
         """Compiled-program cache (same value-keyed discipline as
         ``Txt2ImgPipeline._cached_fn``): dynamic per-image farming calls
         upscale() once per image — without this it would re-trace and
         re-compile the identical program every time."""
         key = (Txt2ImgPipeline._mesh_cache_key(mesh), tuple(image_hw), spec,
-               batch, axis, with_spatial)
+               batch, axis, with_spatial, with_control)
         fn = self._fn_cache.get(key)
         if fn is None:
             if len(self._fn_cache) >= self._CACHE_MAX:
                 self._fn_cache.pop(next(iter(self._fn_cache)))
             fn = self.upscale_fn(mesh, tuple(image_hw), spec, batch=batch,
-                                 axis=axis, with_spatial=with_spatial)
+                                 axis=axis, with_spatial=with_spatial,
+                                 with_control=with_control)
             self._fn_cache[key] = fn
         return fn
 
@@ -91,7 +93,7 @@ class TileUpscaler:
 
     def _img2img_tiles(self, tiles, key, context, uncond_context, y, uncond_y,
                        spec: UpscaleSpec, sigmas, global_idx,
-                       tile_masks=None):
+                       tile_masks=None, hint_tiles=None):
         """img2img a [n, ch, cw, C] tile batch on one shard.
 
         Per-tile noise keys fold in the *global* tile index, so the output
@@ -120,13 +122,15 @@ class TileUpscaler:
         bc = lambda a: jnp.broadcast_to(a, (n,) + a.shape[1:])
         if gspec.guidance_scale != 1.0:
             denoise_fn = cfg_denoiser(
-                lambda ctx, yy: pipe._denoiser(ctx, yy),
+                lambda ctx, yy: pipe._denoiser(ctx, yy, hint=hint_tiles),
                 bc(context), bc(uncond_context), gspec.guidance_scale,
                 None if y is None else bc(y),
                 None if uncond_y is None else bc(uncond_y),
             )
         else:
-            denoise_fn = pipe._denoiser(bc(context), None if y is None else bc(y))
+            denoise_fn = pipe._denoiser(bc(context),
+                                        None if y is None else bc(y),
+                                        hint=hint_tiles)
         # sampler key uses a sentinel fold well above any global tile index
         x0 = sample(gspec.sampler, denoise_fn, noised, sigmas,
                     key=jax.random.fold_in(key, jnp.uint32(0xFFFFFFFF)))
@@ -138,7 +142,7 @@ class TileUpscaler:
 
     def upscale_fn(self, mesh: Mesh, image_hw: tuple[int, int], spec: UpscaleSpec,
                    batch: int = 1, axis: str = constants.AXIS_DATA,
-                   with_spatial: bool = False):
+                   with_spatial: bool = False, with_control: bool = False):
         """Compile the full upscale: (images, key, ctx, unc, y, unc_y
         [, spatial]) → upscaled images [B, H·s, W·s, C].
 
@@ -157,8 +161,17 @@ class TileUpscaler:
         sigmas = make_sigma_ladder(spec.generation_spec(), self.pipeline.schedule)
         masks = feather_mask(grid, spec.feather)
         has_y = self.pipeline.unet.config.adm_in_channels > 0
+        # control hints live in the hint stem's space (latent-res × 8):
+        # the hint grid is the image grid scaled by 8/vae_downscale, so
+        # every tile's hint crop aligns exactly with its image crop — the
+        # reference's per-tile ControlNet crop (usdu_utils.py:506)
+        hf = 8 // self.pipeline.vae.config.downscale if with_control else 1
+        hint_grid = grid if hf == 1 else compute_tile_grid(
+            grid.image_w * hf, grid.image_h * hf,
+            grid.tile_w * hf, grid.tile_h * hf, grid.padding * hf)
 
-        def process_shard(tiles, stiles, key, context, uncond_context, y, uncond_y):
+        def process_shard(tiles, stiles, htiles, key, context,
+                          uncond_context, y, uncond_y):
             # tiles: [per_shard, ch, cw, C] block of this shard
             shard_i = jax.lax.axis_index(axis)
             global_idx = shard_i * per_shard + jnp.arange(per_shard)
@@ -167,12 +180,14 @@ class TileUpscaler:
                 y if has_y else None, uncond_y if has_y else None,
                 spec, sigmas, global_idx,
                 tile_masks=stiles if with_spatial else None,
+                hint_tiles=htiles if with_control else None,
             )
 
         sharded = jax.shard_map(
             process_shard,
             mesh=mesh,
             in_specs=(P(axis, None, None, None), P(axis, None, None, None),
+                      P(axis, None, None, None),
                       P(), P(None, None, None),
                       P(None, None, None), P(None, None), P(None, None)),
             out_specs=P(axis, None, None, None),
@@ -188,7 +203,7 @@ class TileUpscaler:
             return stacked
 
         def run(images, key, context, uncond_context, y, uncond_y,
-                spatial=None):
+                spatial=None, hint=None):
             up = upscale_image(images, spec.scale, spec.resize_method)
             all_tiles = tile_and_pad(lambda im: extract_tiles(im, grid),
                                      [up[b] for b in range(batch)])
@@ -197,8 +212,15 @@ class TileUpscaler:
                                       [spatial[b] for b in range(batch)])
             else:
                 stiles = jnp.ones(all_tiles.shape[:3] + (1,), all_tiles.dtype)
-            done = sharded(all_tiles, stiles, key, context, uncond_context,
-                           y, uncond_y)
+            if with_control:
+                htiles = tile_and_pad(
+                    lambda m: extract_tiles(m, hint_grid),
+                    [hint[b] for b in range(batch)])
+            else:
+                htiles = jnp.zeros(
+                    (all_tiles.shape[0], 8, 8, 1), all_tiles.dtype)
+            done = sharded(all_tiles, stiles, htiles, key, context,
+                           uncond_context, y, uncond_y)
             done = done[:total]
             outs = [
                 composite_tiles(
@@ -222,27 +244,43 @@ class TileUpscaler:
         uncond_y: Optional[jax.Array] = None,
         axis: str = constants.AXIS_DATA,
         spatial_cond: Optional[jax.Array] = None,
+        control_hint: Optional[jax.Array] = None,
     ) -> jax.Array:
         """``spatial_cond``: [B, H, W, 1] (input res) or [B, H·s, W·s, 1]
-        (output res) region mask, cropped per tile inside the program."""
+        (output res) region mask, cropped per tile inside the program.
+        ``control_hint``: [B, h, w, C] control map for the pipeline's
+        ControlNet (``with_control`` clone), cropped per tile in the hint
+        stem's space — the reference's per-tile ControlNet crop."""
         B, H, W, _ = images.shape
+        with_control = (control_hint is not None
+                        and getattr(self.pipeline, "_control", None) is not None)
         fn = self._cached_upscale_fn(mesh, (H, W), spec, batch=B, axis=axis,
-                                     with_spatial=spatial_cond is not None)
+                                     with_spatial=spatial_cond is not None,
+                                     with_control=with_control)
         adm = self.pipeline.unet.config.adm_in_channels
         if y is None:
             y = jnp.zeros((1, max(adm, 1)), jnp.float32)
         if uncond_y is None:
             uncond_y = jnp.zeros_like(y)
         args = (images, jax.random.key(seed), context, uncond_context, y, uncond_y)
-        if spatial_cond is None:
-            return fn(*args)
         grid = self.grid_for(H, W, spec)
-        if spatial_cond.shape[1:3] != (grid.image_h, grid.image_w):
-            spatial_cond = jax.image.resize(
-                spatial_cond.astype(jnp.float32),
-                (B, grid.image_h, grid.image_w, spatial_cond.shape[-1]),
-                method="bilinear")
-        return fn(*args, spatial_cond)
+        if spatial_cond is not None:
+            if spatial_cond.shape[1:3] != (grid.image_h, grid.image_w):
+                spatial_cond = jax.image.resize(
+                    spatial_cond.astype(jnp.float32),
+                    (B, grid.image_h, grid.image_w, spatial_cond.shape[-1]),
+                    method="bilinear")
+        if with_control:
+            hfac = 8 // self.pipeline.vae.config.downscale
+            target = (grid.image_h * hfac, grid.image_w * hfac)
+            if control_hint.shape[1:3] != target:
+                control_hint = jax.image.resize(
+                    control_hint.astype(jnp.float32),
+                    (B, *target, control_hint.shape[-1]), method="bilinear")
+        # None is an empty pytree under jit; unused trailing inputs cost
+        # nothing when the matching with_* flag compiled them out
+        return fn(*args, spatial_cond,
+                  control_hint if with_control else None)
 
     # --- cross-host farm support -------------------------------------------
 
