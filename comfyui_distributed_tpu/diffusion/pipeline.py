@@ -117,6 +117,10 @@ class Txt2ImgPipeline:
                 cn, strength = control_cfg
                 hf = hint.astype(jnp.float32)
                 if hf.shape[0] != x.shape[0]:
+                    if x.shape[0] % hf.shape[0]:
+                        raise ValueError(
+                            f"control hint batch {hf.shape[0]} does not "
+                            f"divide model batch {x.shape[0]}")
                     hf = jnp.concatenate(
                         [hf] * (x.shape[0] // hf.shape[0]), axis=0)
                 down, mid = cn.model.apply(cn.params, x, t, ctx, y_, hf)

@@ -286,9 +286,8 @@ class UltimateSDUpscaleDistributed(NodeDef):
         if control:
             pipeline = pipeline.with_control(control["model"],
                                              control.get("strength", 1.0))
+            # hints arrive 4-D (normalized by ControlNetApply)
             control_hint = jnp.asarray(control["hint"], jnp.float32)
-            if control_hint.ndim == 3:
-                control_hint = control_hint[None]
         upscaler = TileUpscaler(pipeline)
         adm = model.pipeline.unet.config.adm_in_channels
         y = uy = None
@@ -594,15 +593,27 @@ class ControlNetLoader(NodeDef):
                 *hw, cfg.in_channels))
             bundle.name = name
             log(f"controlnet {name!r}: no checkpoint found — random init")
+        if len(_controlnet_cache) >= 4:
+            _controlnet_cache.pop(next(iter(_controlnet_cache)))
         _controlnet_cache[name] = (source, bundle)
         return (bundle,)
 
     @staticmethod
     def _template(cfg):
-        from ..models.controlnet import init_controlnet
+        """Shape-only template via eval_shape — the converter checks leaf
+        shapes, so a full (GB-scale) random init would be pure waste."""
+        from ..models.controlnet import ControlNet
 
-        return init_controlnet(cfg, jax.random.key(0),
-                               sample_shape=(8, 8, cfg.in_channels)).params
+        model = ControlNet(cfg)
+        h, w = 8, 8
+        return jax.eval_shape(
+            model.init, jax.random.key(0),
+            jnp.zeros((1, h, w, cfg.in_channels), jnp.float32),
+            jnp.zeros((1,), jnp.float32),
+            jnp.zeros((1, 8, cfg.context_dim), jnp.float32),
+            (jnp.zeros((1, cfg.adm_in_channels), jnp.float32)
+             if cfg.adm_in_channels else None),
+            jnp.zeros((1, h * 8, w * 8, 3), jnp.float32))
 
 
 @register_node("ControlNetApply")
@@ -636,9 +647,8 @@ def _control_from_cond(pipeline, cond: dict, height: int, width: int):
     control = cond.get("control") if isinstance(cond, dict) else None
     if not control:
         return pipeline, None
+    # ControlNetApply normalizes hints to 4-D at the producer side
     hint = jnp.asarray(control["hint"], jnp.float32)
-    if hint.ndim == 3:
-        hint = hint[None]
     ds = pipeline.vae.config.downscale
     target = (height // ds * 8, width // ds * 8)
     if hint.shape[1:3] != target:

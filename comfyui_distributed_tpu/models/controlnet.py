@@ -60,6 +60,9 @@ class ControlNet(nn.Module):
         cfg = self.config
         dt = cfg.jnp_dtype
         time_dim = cfg.model_channels * 4
+        assert hint.shape[-1] == self.hint_channels, (
+            f"hint has {hint.shape[-1]} channels, module expects "
+            f"{self.hint_channels}")
 
         emb = timestep_embedding(t, cfg.model_channels)
         emb = nn.Dense(time_dim, dtype=dt, name="time_1")(emb.astype(dt))

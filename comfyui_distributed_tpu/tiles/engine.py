@@ -271,12 +271,21 @@ class TileUpscaler:
                     (B, grid.image_h, grid.image_w, spatial_cond.shape[-1]),
                     method="bilinear")
         if with_control:
+            hb = control_hint.shape[0]
+            if hb not in (1, B):
+                raise ValueError(
+                    f"control hint batch {hb} incompatible with image "
+                    f"batch {B} (must be 1 or {B})")
             hfac = 8 // self.pipeline.vae.config.downscale
             target = (grid.image_h * hfac, grid.image_w * hfac)
             if control_hint.shape[1:3] != target:
+                # resize per image — never interpolate across the batch dim
                 control_hint = jax.image.resize(
                     control_hint.astype(jnp.float32),
-                    (B, *target, control_hint.shape[-1]), method="bilinear")
+                    (hb, *target, control_hint.shape[-1]), method="bilinear")
+            if hb == 1 and B > 1:
+                control_hint = jnp.broadcast_to(
+                    control_hint, (B, *control_hint.shape[1:]))
         # None is an empty pytree under jit; unused trailing inputs cost
         # nothing when the matching with_* flag compiled them out
         return fn(*args, spatial_cond,

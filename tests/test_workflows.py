@@ -26,7 +26,8 @@ class TestShippedWorkflows:
     def test_all_present(self):
         names = {p.stem for p in WORKFLOWS}
         assert {"distributed-txt2img", "distributed-upscale",
-                "flux-txt2img", "wan-t2v", "video-upscale"} <= names
+                "flux-txt2img", "wan-t2v", "video-upscale",
+                "controlnet-tile-upscale"} <= names
 
     @pytest.mark.parametrize("path", WORKFLOWS, ids=lambda p: p.stem)
     def test_validates(self, path):
@@ -79,6 +80,21 @@ class TestSmokeExecution:
         prompt["6"]["inputs"]["output_dir"] = str(tmp_path)
         outputs = GraphExecutor().execute(prompt)
         assert np.asarray(outputs["5"][0]).shape[0] == len(jax.devices())
+
+    def test_controlnet_tile_workflow_executes(self, tmp_path):
+        from PIL import Image
+
+        Image.new("RGB", (16, 16), (40, 80, 160)).save(tmp_path / "input.png")
+        prompt = strip_meta(
+            load(Path("workflows/controlnet-tile-upscale.json")))
+        prompt = _swap_model(prompt, "tiny")
+        prompt["8"]["inputs"]["control_net_name"] = "tiny"
+        prompt["5"]["inputs"].update(steps=2, tile_width=16, tile_height=16,
+                                     tile_padding=4)
+        prompt["7"]["inputs"]["output_dir"] = str(tmp_path / "out")
+        outputs = GraphExecutor({"input_dir": str(tmp_path)}).execute(prompt)
+        img = np.asarray(outputs["6"][0])
+        assert img.shape[1:3] == (32, 32)
 
     def test_upscale_workflow_executes(self, tmp_path):
         """Model upscale (tiny-x2) + tile-diffusion refine end-to-end."""
