@@ -706,6 +706,61 @@ class LoraLoader(NodeDef):
         return result
 
 
+@register_node("ImageScale")
+class ImageScale(NodeDef):
+    """Plain device-side resize (ComfyUI-core surface the reference's
+    workflows interleave between model stages). Accepts ComfyUI's
+    ``upscale_method`` input name and method vocabulary; width/height 0
+    derives that dimension keeping aspect (ComfyUI convention)."""
+
+    INPUTS = {"image": "IMAGE", "width": "INT", "height": "INT"}
+    OPTIONAL = {"method": "STRING", "upscale_method": "STRING"}
+    RETURNS = ("IMAGE",)
+
+    def execute(self, image, width: int, height: int,
+                method: str = "lanczos3", upscale_method: str = "", **_):
+        from ..ops.resize import normalize_method, resize_to
+
+        method = upscale_method or method
+        try:
+            normalize_method(method)
+        except ValueError as e:
+            raise ValidationError(str(e), field="upscale_method")
+        images = jnp.asarray(image, jnp.float32)
+        if images.ndim == 3:
+            images = images[None]
+        _, H, W, _ = images.shape
+        width, height = int(width), int(height)
+        if width <= 0 and height <= 0:
+            raise ValidationError("width and height cannot both be 0",
+                                  field="width")
+        if width <= 0:
+            width = max(1, round(W * height / H))
+        if height <= 0:
+            height = max(1, round(H * width / W))
+        return (resize_to(images, height, width, method),)
+
+
+@register_node("ImageScaleBy")
+class ImageScaleBy(NodeDef):
+    INPUTS = {"image": "IMAGE", "scale_by": "FLOAT"}
+    OPTIONAL = {"method": "STRING", "upscale_method": "STRING"}
+    RETURNS = ("IMAGE",)
+
+    def execute(self, image, scale_by: float, method: str = "lanczos3",
+                upscale_method: str = "", **_):
+        from ..ops.resize import upscale_image
+
+        method = upscale_method or method
+        images = jnp.asarray(image, jnp.float32)
+        if images.ndim == 3:
+            images = images[None]
+        try:
+            return (upscale_image(images, float(scale_by), method),)
+        except ValueError as e:
+            raise ValidationError(str(e), field="upscale_method")
+
+
 @register_node("CheckpointLoader")
 class CheckpointLoader(NodeDef):
     INPUTS = {"ckpt_name": "STRING"}

@@ -11,15 +11,42 @@ import jax
 import jax.numpy as jnp
 
 _METHODS = {"bilinear", "lanczos3", "lanczos5", "nearest", "cubic"}
+# ComfyUI workflow vocabulary → jax.image kernels (reference workflows
+# carry these names in `upscale_method` inputs)
+_ALIASES = {
+    "nearest-exact": "nearest",
+    "nearest_exact": "nearest",
+    "bicubic": "cubic",
+    "lanczos": "lanczos3",
+    "linear": "bilinear",
+    "area": "bilinear",    # closest jax kernel; area is downscale-only
+}
+
+
+def normalize_method(method: str) -> str:
+    """Accept both jax kernel names and ComfyUI workflow values."""
+    m = _ALIASES.get(method, method)
+    if m not in _METHODS:
+        raise ValueError(
+            f"unknown resize method {method!r}; have "
+            f"{sorted(_METHODS | set(_ALIASES))}")
+    return m
+
+
+def resize_to(images: jax.Array, height: int, width: int,
+              method: str = "lanczos3") -> jax.Array:
+    """Resize [B,H,W,C] to exact (height, width)."""
+    m = normalize_method(method)
+    B, _, _, C = images.shape
+    out = jax.image.resize(images.astype(jnp.float32),
+                           (B, int(height), int(width), C), method=m)
+    return jnp.clip(out, 0.0, 1.0) if m != "nearest" else out
 
 
 def upscale_image(
     images: jax.Array, scale: float, method: str = "lanczos3"
 ) -> jax.Array:
     """Resize [B,H,W,C] by ``scale`` (rounded to ints)."""
-    if method not in _METHODS:
-        raise ValueError(f"unknown resize method {method!r}; have {sorted(_METHODS)}")
     B, H, W, C = images.shape
-    out_h, out_w = int(round(H * scale)), int(round(W * scale))
-    out = jax.image.resize(images.astype(jnp.float32), (B, out_h, out_w, C), method=method)
-    return jnp.clip(out, 0.0, 1.0) if method != "nearest" else out
+    return resize_to(images, int(round(H * scale)), int(round(W * scale)),
+                     method)

@@ -220,3 +220,50 @@ def test_end_to_end_tiny_workflow():
     out = ex.execute(p)
     images = out["5"][0]
     assert images.shape == (8, 16, 16, 3)
+
+
+class TestImageScaleNodes:
+    def test_image_scale(self):
+        import numpy as np
+
+        from comfyui_distributed_tpu.graph.node import get_node
+
+        img = np.random.RandomState(0).rand(2, 8, 8, 3).astype("float32")
+        (out,) = get_node("ImageScale")().execute(img, width=16, height=12)
+        assert np.asarray(out).shape == (2, 12, 16, 3)
+        assert np.asarray(out).min() >= 0.0 and np.asarray(out).max() <= 1.0
+
+    def test_image_scale_by(self):
+        import numpy as np
+
+        from comfyui_distributed_tpu.graph.node import get_node
+
+        img = np.random.RandomState(1).rand(1, 8, 8, 3).astype("float32")
+        (out,) = get_node("ImageScaleBy")().execute(img, scale_by=2.0)
+        assert np.asarray(out).shape == (1, 16, 16, 3)
+
+    def test_image_scale_bad_method(self):
+        import numpy as np
+        import pytest as _pytest
+
+        from comfyui_distributed_tpu.graph.node import get_node
+        from comfyui_distributed_tpu.utils.exceptions import ValidationError
+
+        img = np.zeros((1, 8, 8, 3), "float32")
+        with _pytest.raises(ValidationError):
+            get_node("ImageScale")().execute(img, width=4, height=4,
+                                             method="nope")
+
+    def test_comfy_method_vocabulary_and_keep_aspect(self):
+        import numpy as np
+
+        from comfyui_distributed_tpu.graph.node import get_node
+
+        img = np.random.RandomState(2).rand(1, 8, 16, 3).astype("float32")
+        # ComfyUI input name + vocabulary
+        (out,) = get_node("ImageScale")().execute(
+            img, width=32, height=0, upscale_method="bicubic")
+        assert np.asarray(out).shape == (1, 16, 32, 3)  # aspect kept
+        (out2,) = get_node("ImageScaleBy")().execute(
+            img, scale_by=2.0, upscale_method="nearest-exact")
+        assert np.asarray(out2).shape == (1, 16, 32, 3)
