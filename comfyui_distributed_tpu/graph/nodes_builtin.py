@@ -714,30 +714,49 @@ class ImageScale(NodeDef):
     derives that dimension keeping aspect (ComfyUI convention)."""
 
     INPUTS = {"image": "IMAGE", "width": "INT", "height": "INT"}
-    OPTIONAL = {"method": "STRING", "upscale_method": "STRING"}
+    OPTIONAL = {"method": "STRING", "upscale_method": "STRING",
+                "crop": "STRING"}
     RETURNS = ("IMAGE",)
 
     def execute(self, image, width: int, height: int,
-                method: str = "lanczos3", upscale_method: str = "", **_):
+                method: str = "lanczos3", upscale_method: str = "",
+                crop: str = "disabled", **_):
         from ..ops.resize import normalize_method, resize_to
 
-        method = upscale_method or method
         try:
-            normalize_method(method)
+            method = normalize_method(upscale_method or method)
         except ValueError as e:
             raise ValidationError(str(e), field="upscale_method")
+        if crop not in ("disabled", "center"):
+            raise ValidationError(
+                f"unknown crop mode {crop!r}; have disabled|center",
+                field="crop")
         images = jnp.asarray(image, jnp.float32)
         if images.ndim == 3:
             images = images[None]
         _, H, W, _ = images.shape
         width, height = int(width), int(height)
-        if width <= 0 and height <= 0:
+        if width < 0 or height < 0:
+            raise ValidationError(
+                "width/height must be >= 0 (0 keeps aspect)", field="width")
+        if width == 0 and height == 0:
             raise ValidationError("width and height cannot both be 0",
                                   field="width")
-        if width <= 0:
+        if width == 0:
             width = max(1, round(W * height / H))
-        if height <= 0:
+        if height == 0:
             height = max(1, round(H * width / W))
+        if crop == "center" and (H * width != W * height):
+            # center-crop the source to the target aspect before resizing
+            # (ComfyUI-core ImageScale crop="center" semantics)
+            if W * height > H * width:            # too wide
+                new_w = max(1, round(H * width / height))
+                x0 = (W - new_w) // 2
+                images = images[:, :, x0:x0 + new_w, :]
+            else:                                  # too tall
+                new_h = max(1, round(W * height / width))
+                y0 = (H - new_h) // 2
+                images = images[:, y0:y0 + new_h, :, :]
         return (resize_to(images, height, width, method),)
 
 
@@ -749,16 +768,18 @@ class ImageScaleBy(NodeDef):
 
     def execute(self, image, scale_by: float, method: str = "lanczos3",
                 upscale_method: str = "", **_):
-        from ..ops.resize import upscale_image
+        from ..ops.resize import normalize_method, upscale_image
 
-        method = upscale_method or method
+        try:
+            method = normalize_method(upscale_method or method)
+        except ValueError as e:
+            raise ValidationError(str(e), field="upscale_method")
+        if float(scale_by) <= 0:
+            raise ValidationError("scale_by must be > 0", field="scale_by")
         images = jnp.asarray(image, jnp.float32)
         if images.ndim == 3:
             images = images[None]
-        try:
-            return (upscale_image(images, float(scale_by), method),)
-        except ValueError as e:
-            raise ValidationError(str(e), field="upscale_method")
+        return (upscale_image(images, float(scale_by), method),)
 
 
 @register_node("CheckpointLoader")
