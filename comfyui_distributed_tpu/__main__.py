@@ -90,8 +90,17 @@ def cmd_convert(args: argparse.Namespace) -> None:
     preset = PRESETS.get(args.preset)
     if preset is None:
         sys.exit(f"unknown preset {args.preset!r}; have {sorted(PRESETS)}")
-    bundle = ModelBundle(preset)
+    # abstract core: the converter only needs leaf shapes, and every core
+    # leaf is about to be overwritten — skip the (FLUX-size: ~48 GB)
+    # random init
+    bundle = ModelBundle(preset, abstract_core=True)
     bundle.load_safetensors_checkpoint(Path(args.checkpoint))
+    if getattr(args, "t5", None) or getattr(args, "clip_l", None):
+        bundle.load_text_encoder_files(
+            t5=Path(args.t5) if args.t5 else None,
+            clip_l=Path(args.clip_l) if args.clip_l else None)
+    if getattr(args, "vae", None):
+        bundle.load_vae_file(Path(args.vae))
     bundle.save_checkpoint(Path(args.out))
     print(json.dumps({"preset": args.preset, "out": str(args.out),
                       "entries": sorted(bundle._state_entries())}))
@@ -137,6 +146,13 @@ def main(argv: list[str] | None = None) -> None:
     conv.add_argument("--checkpoint", required=True)
     conv.add_argument("--preset", default="sdxl")
     conv.add_argument("--out", required=True)
+    conv.add_argument("--t5", default=None,
+                      help="flux: standalone t5xxl .safetensors (HF layout)")
+    conv.add_argument("--clip-l", dest="clip_l", default=None,
+                      help="flux: standalone clip_l .safetensors (HF layout)")
+    conv.add_argument("--vae", default=None,
+                      help="standalone VAE .safetensors (BFL ae / SD VAE / "
+                           "LDM-embedded layouts auto-detected)")
     conv.set_defaults(fn=cmd_convert)
 
     args = p.parse_args(argv)

@@ -193,6 +193,26 @@ class SDXLTextStack:
         return context, out_g["projected"]
 
 
+def tokenize_ids(texts, tok, cfg, pad_id: int) -> jax.Array:
+    """Strings → [B, max_len] int32 ids: real BPE when a tokenizer is
+    loaded, deterministic hash fallback (correct SOT/EOT framing so EOT
+    pooling works) otherwise."""
+    if tok is not None:
+        return jnp.asarray([tok.encode(t) for t in texts], jnp.int32)
+    import hashlib
+
+    def fallback(text: str) -> list[int]:
+        ids = []
+        for w in text.lower().split():
+            h = hashlib.blake2s(w.encode(), digest_size=4).digest()
+            ids.append(int.from_bytes(h, "little")
+                       % (cfg.vocab_size - 2) + 1)
+        ids = ids[: cfg.max_len - 2]
+        out = [0] + ids + [cfg.eot_token_id]
+        return out + [pad_id] * (cfg.max_len - len(out))
+    return jnp.asarray([fallback(t) for t in texts], jnp.int32)
+
+
 class CLIPConditioner:
     """``TextEncoder``-compatible adapter (strings → context, pooled) over
     the weight-faithful CLIP stack, so graph nodes (``CLIPTextEncode``)
@@ -218,20 +238,7 @@ class CLIPConditioner:
                 "hash-tokenized; conditioning will not reflect the prompt")
 
     def _ids(self, texts, tok, cfg, pad_id: int):
-        if tok is not None:
-            return jnp.asarray([tok.encode(t) for t in texts], jnp.int32)
-        import hashlib
-
-        def fallback(text: str) -> list[int]:
-            ids = []
-            for w in text.lower().split():
-                h = hashlib.blake2s(w.encode(), digest_size=4).digest()
-                ids.append(int.from_bytes(h, "little")
-                           % (cfg.vocab_size - 2) + 1)
-            ids = ids[: cfg.max_len - 2]
-            out = [0] + ids + [cfg.eot_token_id]
-            return out + [pad_id] * (cfg.max_len - len(out))
-        return jnp.asarray([fallback(t) for t in texts], jnp.int32)
+        return tokenize_ids(texts, tok, cfg, pad_id)
 
     def encode(self, texts) -> tuple[jax.Array, jax.Array]:
         texts = [str(t) for t in texts]

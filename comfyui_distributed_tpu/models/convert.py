@@ -422,7 +422,11 @@ def _vae_mid(f: _Filler, src: str, dst: str) -> None:
 
 
 def convert_vae(sd: Mapping[str, np.ndarray], enc_template, dec_template,
-                config, prefix: str = "first_stage_model.") -> tuple[dict, dict]:
+                config, prefix: str = "first_stage_model.",
+                quant_convs: bool = True) -> tuple[dict, dict]:
+    """``quant_convs=False`` handles the BFL ``ae.safetensors`` layout
+    (FLUX KL-VAE): same encoder/decoder walk, no quant convs in the file —
+    identity 1×1 convs are synthesized so the flax modules are unchanged."""
     cfg = config
     p = prefix
 
@@ -441,11 +445,25 @@ def convert_vae(sd: Mapping[str, np.ndarray], enc_template, dec_template,
     _vae_mid(fe, f"{p}encoder.mid", "mid")
     fe.norm(f"{p}encoder.norm_out", "norm_out/GroupNorm_0")
     fe.conv(f"{p}encoder.conv_out", "conv_out")
-    fe.conv(f"{p}quant_conv", "quant_conv")
+    if quant_convs:
+        fe.conv(f"{p}quant_conv", "quant_conv")
+    else:
+        z2 = 2 * cfg.latent_channels
+        eye = np.zeros((1, 1, z2, z2), np.float32)
+        eye[0, 0] = np.eye(z2)
+        fe.put_raw(eye, "quant_conv/kernel")
+        fe.put_raw(np.zeros((z2,), np.float32), "quant_conv/bias")
     enc = {"params": fe.finish()}
 
     fd = _Filler(sd, dec_template["params"])
-    fd.conv(f"{p}post_quant_conv", "post_quant_conv")
+    if quant_convs:
+        fd.conv(f"{p}post_quant_conv", "post_quant_conv")
+    else:
+        z = cfg.latent_channels
+        eye = np.zeros((1, 1, z, z), np.float32)
+        eye[0, 0] = np.eye(z)
+        fd.put_raw(eye, "post_quant_conv/kernel")
+        fd.put_raw(np.zeros((z,), np.float32), "post_quant_conv/bias")
     fd.conv(f"{p}decoder.conv_in", "conv_in")
     _vae_mid(fd, f"{p}decoder.mid", "mid")
     top_ch = cfg.base_channels * cfg.channel_mult[-1]
@@ -482,6 +500,15 @@ SD15_CLIP_PREFIX = "cond_stage_model.transformer.text_model."
 
 
 def detect_layout(sd: Mapping[str, np.ndarray]) -> str:
+    if any(k.endswith("double_blocks.0.img_attn.qkv.weight") for k in sd):
+        return "flux"
+    if any(k.endswith("blocks.0.self_attn.norm_q.weight") for k in sd):
+        return "wan"
+    if any(k.startswith(FLUX_DIFFUSERS_HINT) for k in sd):
+        raise ConversionError(
+            "diffusers-repacked FLUX transformer (transformer_blocks.*) is "
+            "not supported — convert from the BFL single-file layout "
+            "(double_blocks.*/single_blocks.*) instead")
     if any(k.startswith(SDXL_CLIP_G_PREFIX) for k in sd):
         return "sdxl"
     if any(k.startswith(SD15_CLIP_PREFIX) for k in sd):
@@ -501,6 +528,35 @@ def convert_checkpoint(path: Path, bundle) -> None:
     sd = load_safetensors(Path(path))
     layout = detect_layout(sd)
     log(f"converting {path} (layout: {layout})")
+
+    if layout == "flux":
+        if bundle.kind != "dit":
+            raise ConversionError(
+                f"FLUX transformer checkpoint needs a dit preset; "
+                f"{bundle.preset.name!r} is {bundle.kind!r}")
+        prefix = (FLUX_PREFIXED if any(k.startswith(FLUX_PREFIXED)
+                                       for k in sd) else "")
+        bundle.pipeline.dit_params = convert_flux(
+            sd, bundle.pipeline.dit_params, bundle.preset.dit, prefix)
+        log("FLUX transformer converted; VAE/text encoders ship separately "
+            "and keep their current weights")
+        return
+
+    if layout == "wan":
+        from .wan import WAN_PREFIXED, WanConfig, convert_wan
+
+        if bundle.kind != "video" or not isinstance(bundle.preset.video,
+                                                    WanConfig):
+            raise ConversionError(
+                f"WAN transformer checkpoint needs a wan video preset; "
+                f"{bundle.preset.name!r} is {bundle.kind!r}")
+        prefix = (WAN_PREFIXED if any(k.startswith(WAN_PREFIXED)
+                                      for k in sd) else "")
+        bundle.pipeline.dit_params = convert_wan(
+            sd, bundle.pipeline.dit_params, bundle.preset.video, prefix)
+        log("WAN transformer converted; VAE/text encoders ship separately "
+            "and keep their current weights")
+        return
 
     unet_tmpl = bundle.pipeline.unet_params
     bundle.pipeline.unet_params = convert_unet(
@@ -658,3 +714,115 @@ def convert_controlnet(sd: Mapping[str, np.ndarray], template, config,
             break
     _controlnet_layout(f, config, prefix, linear_proj)
     return {"params": f.finish(expect_prefix=prefix)}
+
+
+# ---------------------------------------------------------------------------
+# FLUX-class MMDiT (BFL transformer layout)
+# ---------------------------------------------------------------------------
+
+FLUX_DIFFUSERS_HINT = "transformer_blocks."      # diffusers repack: unsupported
+FLUX_PREFIXED = "model.diffusion_model."         # ComfyUI single-file repack
+
+
+def _flux_patch_perm(p: int, c: int) -> np.ndarray:
+    """Patch-token feature permutation BFL→ours.
+
+    BFL patchifies ``(c, ph, pw)``-major (``rearrange "b c (h ph) (w pw) ->
+    b (h w) (c ph pw)"``); ``dit.patchify`` flattens ``(ph, pw, c)``.
+    ``perm[j]`` is the BFL feature index holding our feature ``j``."""
+    idx = np.arange(c * p * p).reshape(c, p, p)
+    return idx.transpose(1, 2, 0).reshape(-1)
+
+
+def convert_flux(sd: Mapping[str, np.ndarray], template, config,
+                 prefix: str = "") -> dict:
+    """BFL FLUX transformer state dict → ``models/dit.DiT`` params.
+
+    Source layout: the published ``flux1-dev``/``flux1-schnell``
+    ``.safetensors`` transformer keys (``img_in``, ``time_in.*``,
+    ``double_blocks.N.*``, ``single_blocks.N.*``, ``final_layer.*``), bare
+    or under ``model.diffusion_model.`` (single-file repacks). The
+    reference runs FLUX through ComfyUI's loader (SURVEY "external
+    substrate"); here the mapping is explicit and shape-checked:
+
+    - ``double_blocks.i.{img,txt}_mod.lin`` → ``double_i/{img,txt}_mod/mod``
+    - ``…_attn.qkv / …_attn.proj / …_mlp.{0,2}`` →
+      ``{img,txt}_qkv/qkv, {img,txt}_proj, {img,txt}_mlp_{up,down}``
+    - ``…_attn.norm.{query,key}_norm.scale`` → ``{img,txt}_qkv/{q,k}_scale``
+    - ``single_blocks.i.linear1`` (rows ``[3h | 4h]``) row-splits into
+      ``qkv/qkv`` + ``mlp_up``; ``linear2`` → ``out`` (our concat order
+      ``[attn, gelu(mlp)]`` matches BFL's)
+    - ``final_layer.adaLN_modulation.1`` (rows ``[shift | scale]``) maps
+      into the first two thirds of ``final_mod/mod``; the gate third the
+      flax Modulation also produces (and the final layer discards) is zero
+    - ``img_in`` / ``final_layer.linear`` are column/row-permuted for the
+      patch-ordering difference (``_flux_patch_perm``)
+    """
+    p = prefix
+    f = _Filler(sd, template["params"])
+    h = config.hidden
+
+    def take(key: str) -> np.ndarray:
+        if key not in sd:
+            raise ConversionError(f"missing source key {key!r}")
+        f.used.add(key)
+        return np.asarray(sd[key], np.float32)
+
+    perm = _flux_patch_perm(config.patch_size, config.in_channels)
+    f.put_raw(take(f"{p}img_in.weight").T[perm], "img_in/kernel")
+    f.put(f"{p}img_in.bias", "img_in/bias")
+    f.linear(f"{p}txt_in", "txt_in")
+    embedders = ["time_in", "vector_in"]
+    if config.guidance_embed:
+        if f"{p}guidance_in.in_layer.weight" not in sd:
+            raise ConversionError(
+                "preset expects distilled guidance (guidance_embed=True) "
+                "but the checkpoint has no guidance_in.* keys — use a "
+                "schnell-style preset with guidance_embed=False")
+        embedders.append("guidance_in")
+    for name in embedders:
+        f.linear(f"{p}{name}.in_layer", f"{name}/in_layer")
+        f.linear(f"{p}{name}.out_layer", f"{name}/out_layer")
+
+    for i in range(config.depth_double):
+        src, dst = f"{p}double_blocks.{i}", f"double_{i}"
+        for s in ("img", "txt"):
+            f.linear(f"{src}.{s}_mod.lin", f"{dst}/{s}_mod/mod")
+            f.linear(f"{src}.{s}_attn.qkv", f"{dst}/{s}_qkv/qkv")
+            f.put(f"{src}.{s}_attn.norm.query_norm.scale",
+                  f"{dst}/{s}_qkv/q_scale")
+            f.put(f"{src}.{s}_attn.norm.key_norm.scale",
+                  f"{dst}/{s}_qkv/k_scale")
+            f.linear(f"{src}.{s}_attn.proj", f"{dst}/{s}_proj")
+            f.linear(f"{src}.{s}_mlp.0", f"{dst}/{s}_mlp_up")
+            f.linear(f"{src}.{s}_mlp.2", f"{dst}/{s}_mlp_down")
+
+    for i in range(config.depth_single):
+        src, dst = f"{p}single_blocks.{i}", f"single_{i}"
+        w1, b1 = take(f"{src}.linear1.weight"), take(f"{src}.linear1.bias")
+        f.put_raw(w1[:3 * h].T, f"{dst}/qkv/qkv/kernel")
+        f.put_raw(b1[:3 * h], f"{dst}/qkv/qkv/bias")
+        f.put_raw(w1[3 * h:].T, f"{dst}/mlp_up/kernel")
+        f.put_raw(b1[3 * h:], f"{dst}/mlp_up/bias")
+        f.put(f"{src}.norm.query_norm.scale", f"{dst}/qkv/q_scale")
+        f.put(f"{src}.norm.key_norm.scale", f"{dst}/qkv/k_scale")
+        f.linear(f"{src}.linear2", f"{dst}/out")
+        f.linear(f"{src}.modulation.lin", f"{dst}/mod/mod")
+
+    wf = take(f"{p}final_layer.adaLN_modulation.1.weight")      # [2h, h]
+    bf = take(f"{p}final_layer.adaLN_modulation.1.bias")
+    f.put_raw(np.concatenate([wf.T, np.zeros((h, h), np.float32)], axis=1),
+              "final_mod/mod/kernel")
+    f.put_raw(np.concatenate([bf, np.zeros(h, np.float32)]),
+              "final_mod/mod/bias")
+    wo = take(f"{p}final_layer.linear.weight")
+    f.put_raw(wo[perm].T, "img_out/kernel")
+    f.put_raw(take(f"{p}final_layer.linear.bias")[perm], "img_out/bias")
+    tree = f.finish(expect_prefix=p)
+    if not p:
+        leftover = [k for k in sd if k not in f.used]
+        if leftover:
+            raise ConversionError(
+                f"unconsumed FLUX keys: {leftover[:8]}"
+                f"{'…' if len(leftover) > 8 else ''}")
+    return {"params": tree}

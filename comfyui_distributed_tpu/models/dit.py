@@ -158,6 +158,22 @@ def image_ids(h: int, w: int, row_offset: int = 0) -> jax.Array:
     return jnp.stack([jnp.zeros_like(rows), rows, cols], axis=-1)
 
 
+class MLPEmbedder(nn.Module):
+    """FLUX conditioning embedder: Dense → silu → Dense (in_layer/out_layer).
+
+    Matches the checkpoint layout of FLUX's ``time_in``/``vector_in``/
+    ``guidance_in`` MLPs so published weights convert without surgery.
+    """
+
+    hidden: int
+    dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        h = nn.Dense(self.hidden, dtype=self.dtype, name="in_layer")(x)
+        return nn.Dense(self.hidden, dtype=self.dtype, name="out_layer")(nn.silu(h))
+
+
 class Modulation(nn.Module):
     """adaLN-Zero: conditioning vector → (shift, scale, gate) × n."""
 
@@ -188,9 +204,12 @@ class _QKV(nn.Module):
         q, k, v = jnp.split(qkv, 3, axis=-1)
         hd = self.hidden // self.heads
         shape = (B, N, self.heads, hd)
-        # qk-norm (RMS) as in FLUX for stability
-        q = _rms(q.reshape(shape))
-        k = _rms(k.reshape(shape))
+        # qk-norm (learned-scale RMS over head_dim) as in FLUX's QKNorm —
+        # the scales land from checkpoints' {query,key}_norm.scale entries
+        qs = self.param("q_scale", nn.initializers.ones, (hd,), jnp.float32)
+        ks = self.param("k_scale", nn.initializers.ones, (hd,), jnp.float32)
+        q = _rms(q.reshape(shape)) * qs.astype(self.dtype)
+        k = _rms(k.reshape(shape)) * ks.astype(self.dtype)
         return q, k, v.reshape(shape)
 
 
@@ -330,14 +349,16 @@ class DiT(nn.Module):
 
         txt = nn.Dense(cfg.hidden, dtype=dt, name="txt_in")(context.astype(dt))
 
-        vec = nn.Dense(cfg.hidden, dtype=dt, name="t_in")(
+        # FLUX conditioning vector: summed MLPEmbedder outputs (time_in /
+        # vector_in / guidance_in) — the exact functional form of the
+        # published checkpoints, so weights port without surgery
+        vec = MLPEmbedder(cfg.hidden, dt, name="time_in")(
             timestep_embedding(t * 1000.0, 256).astype(dt))
-        vec = vec + nn.Dense(cfg.hidden, dtype=dt, name="pool_in")(pooled.astype(dt))
+        vec = vec + MLPEmbedder(cfg.hidden, dt, name="vector_in")(pooled.astype(dt))
         if cfg.guidance_embed:
             gvec = guidance if guidance is not None else jnp.full((B,), 3.5)
-            vec = vec + nn.Dense(cfg.hidden, dtype=dt, name="guid_in")(
+            vec = vec + MLPEmbedder(cfg.hidden, dt, name="guidance_in")(
                 timestep_embedding(gvec * 1000.0, 256).astype(dt))
-        vec = nn.Dense(cfg.hidden, dtype=dt, name="vec_mlp")(nn.silu(vec))
 
         DBlock = (nn.remat(DoubleBlock, static_argnums=(4,))
                   if cfg.remat else DoubleBlock)
@@ -365,12 +386,19 @@ class DiT(nn.Module):
 
 
 def init_dit(config: DiTConfig, rng: jax.Array,
-             sample_hw: tuple[int, int] = (32, 32), context_len: int = 16):
+             sample_hw: tuple[int, int] = (32, 32), context_len: int = 16,
+             abstract: bool = False):
+    """``abstract=True`` returns a ShapeDtypeStruct tree instead of
+    materialized random params — the shape template weight conversion
+    needs without paying a 12B-param random init (FLUX-size presets)."""
     model = DiT(config)
     h, w = sample_hw
     x = jnp.zeros((1, h, w, config.in_channels))
     t = jnp.zeros((1,))
     ctx = jnp.zeros((1, context_len, config.context_dim))
     pooled = jnp.zeros((1, config.pooled_dim))
-    params = jax.jit(model.init)(rng, x, t, ctx, pooled)
+    if abstract:
+        params = jax.eval_shape(model.init, rng, x, t, ctx, pooled)
+    else:
+        params = jax.jit(model.init)(rng, x, t, ctx, pooled)
     return model, params

@@ -32,7 +32,7 @@ class ModelPreset:
     sample_hw: tuple[int, int] = (128, 128)   # init-time latent H,W
     dit: "object | None" = None               # DiTConfig for flow models
     video: "object | None" = None             # VideoDiTConfig for t2v models
-    clip: "str | None" = None                 # "sdxl" | "clip-l" real-CLIP stack
+    clip: "str | None" = None   # real text stack: "sdxl" | "clip-l" | "flux" (T5+CLIP-L)
 
     @property
     def kind(self) -> str:
@@ -46,9 +46,10 @@ def _flux_preset():
 
     return ModelPreset(
         "flux", unet=None,
-        vae=VAEConfig(latent_channels=16, scaling_factor=0.3611),
+        vae=VAEConfig(latent_channels=16, scaling_factor=0.3611,
+                      shift_factor=0.1159),
         text=TextEncoderConfig(output_dim=4096, pooled_dim=768),
-        sample_hw=(32, 32), dit=DiTConfig.flux())
+        sample_hw=(32, 32), dit=DiTConfig.flux(), clip="flux")
 
 
 def _flux_tiny_preset():
@@ -61,24 +62,37 @@ def _flux_tiny_preset():
 
 
 def _wan_preset():
-    from .video_dit import VideoDiTConfig
+    from .wan import WanConfig
 
-    # WAN-class t2v: 16-ch video latents, T5-width context
+    # WAN t2v (exact published architecture): 16-ch video latents,
+    # UMT5-width context
     return ModelPreset(
         "wan", unet=None,
         vae=VAEConfig(latent_channels=16, scaling_factor=0.3611),
         text=TextEncoderConfig(output_dim=4096, pooled_dim=768),
         sample_hw=(60, 104),             # 480×832 / 8
-        video=VideoDiTConfig.wan())
+        video=WanConfig.wan_14b(), clip="umt5")
 
 
 def _wan_tiny_preset():
-    from .video_dit import VideoDiTConfig
+    from .wan import WanConfig
 
     return ModelPreset(
         "wan-tiny", unet=None, vae=VAEConfig.tiny(),
         text=TextEncoderConfig.tiny(),
-        sample_hw=(8, 8), video=VideoDiTConfig.tiny())
+        sample_hw=(8, 8), video=WanConfig.tiny())
+
+
+def _wan_mmdit_preset():
+    from .video_dit import VideoDiTConfig
+
+    # the generic MMDiT-over-frames stack (pre-WAN-parity architecture,
+    # kept for from-scratch work and as the video-sp reference design)
+    return ModelPreset(
+        "video-mmdit", unet=None,
+        vae=VAEConfig(latent_channels=16, scaling_factor=0.3611),
+        text=TextEncoderConfig(output_dim=4096, pooled_dim=768),
+        sample_hw=(60, 104), video=VideoDiTConfig.wan())
 
 
 PRESETS: dict[str, ModelPreset] = {
@@ -94,6 +108,7 @@ PRESETS: dict[str, ModelPreset] = {
     "flux-tiny": _flux_tiny_preset(),
     "wan": _wan_preset(),
     "wan-tiny": _wan_tiny_preset(),
+    "video-mmdit": _wan_mmdit_preset(),
 }
 
 
@@ -101,7 +116,11 @@ class ModelBundle:
     """Loaded stack: pipeline + text encoder, built lazily and cached."""
 
     def __init__(self, preset: ModelPreset, checkpoint_dir: Optional[Path] = None,
-                 seed: int = 0):
+                 seed: int = 0, abstract_core: bool = False):
+        """``abstract_core=True`` builds the core model's params as a
+        ShapeDtypeStruct template instead of random weights — for
+        conversion flows where every leaf is about to be overwritten
+        (a FLUX-size random init alone is ~48 GB of wasted fp32)."""
         self.preset = preset
         self.clip_stack = None      # built lazily (real-weight path only)
         k1, k2, k3 = jax.random.split(jax.random.key(seed), 3)
@@ -111,12 +130,20 @@ class ModelBundle:
         self.text_encoder = TextEncoder(preset.text).init(k3)
         if preset.kind == "video":
             from ..diffusion.pipeline_video import VideoPipeline
-            from .video_dit import init_video_dit
+            from .wan import WanConfig, init_wan
 
-            model, params = init_video_dit(
-                preset.video, k1,
-                sample_fhw=(5, *preset.sample_hw),
-                context_len=preset.text.max_len)
+            if isinstance(preset.video, WanConfig):
+                model, params = init_wan(
+                    preset.video, k1,
+                    sample_fhw=(5, *preset.sample_hw),
+                    context_len=preset.text.max_len, abstract=abstract_core)
+            else:
+                from .video_dit import init_video_dit
+
+                model, params = init_video_dit(
+                    preset.video, k1,
+                    sample_fhw=(5, *preset.sample_hw),
+                    context_len=preset.text.max_len, abstract=abstract_core)
             self.pipeline = VideoPipeline(model, params, vae)
         elif preset.kind == "dit":
             from ..diffusion.pipeline_flow import FlowPipeline
@@ -124,7 +151,8 @@ class ModelBundle:
 
             model, params = init_dit(preset.dit, k1,
                                      sample_hw=preset.sample_hw,
-                                     context_len=preset.text.max_len)
+                                     context_len=preset.text.max_len,
+                                     abstract=abstract_core)
             self.pipeline = FlowPipeline(model, params, vae)
         else:
             from ..diffusion.pipeline import Txt2ImgPipeline
@@ -132,7 +160,7 @@ class ModelBundle:
             model, params = init_unet(
                 preset.unet, k1,
                 sample_shape=(*preset.sample_hw, preset.unet.in_channels),
-                context_len=preset.text.max_len,
+                context_len=preset.text.max_len, abstract=abstract_core,
             )
             self.pipeline = Txt2ImgPipeline(model, params, vae)
         if checkpoint_dir is not None:
@@ -159,9 +187,13 @@ class ModelBundle:
         else:
             self.pipeline.unet_params = params
 
-    def build_clip_stack(self, tiny: bool = False):
-        """Instantiate the weight-faithful CLIP stack for this preset and
-        swap the bundle's text encoder to it (``models/clip.py``)."""
+    def build_clip_stack(self, tiny: bool = False,
+                         abstract_t5: bool = False):
+        """Instantiate the weight-faithful text stack for this preset and
+        swap the bundle's text encoder to it (``models/clip.py`` /
+        ``models/t5.py``). ``abstract_t5=True`` leaves the (XXL-size) T5
+        params as a ShapeDtypeStruct template for callers about to
+        restore or convert real weights over them."""
         from .clip import (CLIPConditioner, CLIPTextConfig, CLIPTextModel,
                            SDXLTextStack)
 
@@ -174,6 +206,20 @@ class ModelBundle:
         key = jax.random.key(0)
         if kind == "sdxl":
             self.clip_stack = SDXLTextStack.init_random(key, tiny=tiny)
+        elif kind == "flux":
+            from .t5 import FluxTextStack
+
+            self.clip_stack = FluxTextStack.init_random(
+                key, tiny=tiny, abstract_t5=abstract_t5)
+            self.text_encoder = self.clip_stack    # encode()-compatible
+            return self.clip_stack
+        elif kind == "umt5":
+            from .t5 import UMT5Conditioner
+
+            self.clip_stack = UMT5Conditioner.init_random(
+                key, tiny=tiny, abstract_t5=abstract_t5)
+            self.text_encoder = self.clip_stack
+            return self.clip_stack
         else:
             cfg = CLIPTextConfig.tiny() if tiny else CLIPTextConfig.clip_l()
             self.clip_stack = CLIPTextModel(cfg).init(key)
@@ -190,6 +236,11 @@ class ModelBundle:
             if self.preset.clip == "sdxl":
                 state["clip_l"] = self.clip_stack.clip_l.params
                 state["clip_g"] = self.clip_stack.clip_g.params
+            elif self.preset.clip == "flux":
+                state["clip_l"] = self.clip_stack.clip_l.params
+                state["t5"] = self.clip_stack.t5.params
+            elif self.preset.clip == "umt5":
+                state["t5"] = self.clip_stack.t5.params
             else:
                 state["clip_l"] = self.clip_stack.params
         else:
@@ -204,8 +255,13 @@ class ModelBundle:
             if self.preset.clip == "sdxl":
                 self.clip_stack.clip_l.params = restored["clip_l"]
                 self.clip_stack.clip_g.params = restored["clip_g"]
+            elif self.preset.clip == "flux":
+                self.clip_stack.clip_l.params = restored["clip_l"]
+                self.clip_stack.t5.params = restored["t5"]
             else:
                 self.clip_stack.params = restored["clip_l"]
+        elif "t5" in restored:                     # umt5-only stack
+            self.clip_stack.t5.params = restored["t5"]
         if "text" in restored:
             self.text_encoder.params = restored["text"]
 
@@ -232,8 +288,11 @@ class ModelBundle:
                 f"{self._arch_fingerprint()}; a mismatched positional "
                 "encoding restores byte-compatibly yet generates garbage — "
                 "re-convert the checkpoint for this preset")
-        if "clip_l" in manifest.get("entries", []):
-            self.build_clip_stack(tiny=bool(manifest.get("tiny_clip")))
+        if {"clip_l", "t5"} & set(manifest.get("entries", [])):
+            # abstract T5 targets: orbax restores over ShapeDtypeStructs,
+            # so a T5-XXL restore never pays a ~19 GB random init first
+            self.build_clip_stack(tiny=bool(manifest.get("tiny_clip")),
+                                  abstract_t5="t5" in manifest["entries"])
         targets = self._state_entries()
         if manifest.get("entries"):
             targets = {k: v for k, v in targets.items()
@@ -258,9 +317,13 @@ class ModelBundle:
             ckptr.save((ckpt / "state").resolve(), state)
         tiny_clip = False
         if self.clip_stack is not None:
-            cl = (self.clip_stack.clip_l if self.preset.clip == "sdxl"
-                  else self.clip_stack)
-            tiny_clip = cl.config.width < 256
+            if self.preset.clip == "umt5":
+                tiny_clip = self.clip_stack.t5.config.d_model < 256
+            else:
+                cl = (self.clip_stack.clip_l
+                      if self.preset.clip in ("sdxl", "flux")
+                      else self.clip_stack)
+                tiny_clip = cl.config.width < 256
         ckpt.mkdir(parents=True, exist_ok=True)
         (ckpt / "cdt_manifest.json").write_text(json.dumps(
             {"preset": self.preset.name, "entries": sorted(state),
@@ -282,12 +345,78 @@ class ModelBundle:
 
     def load_safetensors_checkpoint(self, path: Path) -> None:
         """Convert a published single-file ``.safetensors`` checkpoint
-        (SDXL/SD1.5 layout) into this bundle in place."""
+        (SDXL/SD1.5/FLUX layout) into this bundle in place."""
         from .convert import convert_checkpoint
 
-        if self.preset.clip is not None:
+        if self.preset.clip not in (None, "flux", "umt5"):
+            # FLUX/WAN single files carry only the transformer; the (large)
+            # T5 stacks are built on demand by load_text_encoder_files —
+            # pre-building here would materialize ~19-23 GB of random fp32
+            # T5 weights and, worse, let save_checkpoint persist them as
+            # if they were real
             self.build_clip_stack()
         convert_checkpoint(path, self)
+
+    def load_text_encoder_files(self, t5: Optional[Path] = None,
+                                clip_l: Optional[Path] = None) -> None:
+        """Convert the standalone text-encoder ``.safetensors`` files FLUX
+        distributions ship (``t5xxl_*.safetensors`` in HF T5 layout,
+        ``clip_l.safetensors`` in HF ``text_model.*`` layout) into this
+        bundle's conditioning stack."""
+        from .convert import convert_clip_hf, load_safetensors
+        from .t5 import convert_t5
+
+        if self.preset.clip not in ("flux", "umt5"):
+            raise ValidationError(
+                "separate text-encoder files are a flux/wan-stack feature; "
+                f"preset {self.preset.name!r} bundles its encoders in the "
+                "single-file checkpoint")
+        if self.clip_stack is None:
+            from .t5 import FluxTextStack, UMT5Conditioner
+
+            # T5-XXL random init is ~19 GB; skip it when the converter is
+            # about to overwrite every leaf
+            if self.preset.clip == "flux":
+                self.clip_stack = FluxTextStack.init_random(
+                    jax.random.key(0), abstract_t5=t5 is not None)
+            else:
+                self.clip_stack = UMT5Conditioner.init_random(
+                    jax.random.key(0), abstract_t5=t5 is not None)
+            self.text_encoder = self.clip_stack
+        if t5 is not None:
+            self.clip_stack.t5.params = convert_t5(
+                load_safetensors(Path(t5)), self.clip_stack.t5.params,
+                self.clip_stack.t5.config)
+        if clip_l is not None:
+            if self.preset.clip != "flux":
+                raise ValidationError(
+                    "clip_l is part of the flux stack only")
+            self.clip_stack.clip_l.params = convert_clip_hf(
+                load_safetensors(Path(clip_l)),
+                self.clip_stack.clip_l.params, self.clip_stack.clip_l.config)
+
+    def load_vae_file(self, path: Path) -> None:
+        """Convert a standalone VAE ``.safetensors`` into this bundle.
+
+        Detects the three published layouts: LDM-embedded
+        (``first_stage_model.*``), standalone SD VAE (bare keys with
+        ``quant_conv``), and BFL ``ae.safetensors`` (bare keys, no quant
+        convs — FLUX's 16-channel KL-VAE)."""
+        from .convert import convert_vae, load_safetensors
+
+        sd = load_safetensors(Path(path))
+        if any(k.startswith("first_stage_model.") for k in sd):
+            prefix, qc = "first_stage_model.", True
+        elif "quant_conv.weight" in sd:
+            prefix, qc = "", True
+        else:
+            prefix, qc = "", False
+        enc, dec = convert_vae(sd, self.pipeline.vae.enc_params,
+                               self.pipeline.vae.dec_params,
+                               self.preset.vae, prefix=prefix,
+                               quant_convs=qc)
+        self.pipeline.vae.enc_params = enc
+        self.pipeline.vae.dec_params = dec
 
 
 class ModelRegistry:

@@ -200,8 +200,12 @@ def init_unet(
     rng: jax.Array,
     sample_shape: tuple[int, int, int] = (64, 64, 4),
     context_len: int = 77,
+    abstract: bool = False,
 ):
-    """Initialize params with a canonical dummy batch; returns (module, params)."""
+    """Initialize params with a canonical dummy batch; returns (module, params).
+
+    ``abstract=True`` returns a ShapeDtypeStruct tree (conversion template
+    — no multi-GB random init when every leaf is about to be replaced)."""
     model = UNet2D(config)
     H, W, C = sample_shape
     x = jnp.zeros((1, H, W, C), jnp.float32)
@@ -211,5 +215,8 @@ def init_unet(
     # jit the init: eager tracing dispatches each initializer op through a
     # separate tiny XLA executable (~tens of seconds for a full UNet even
     # at toy sizes); one compiled program is an order of magnitude faster
-    params = jax.jit(model.init)(rng, x, t, ctx, y)
+    if abstract:
+        params = jax.eval_shape(model.init, rng, x, t, ctx, y)
+    else:
+        params = jax.jit(model.init)(rng, x, t, ctx, y)
     return model, params

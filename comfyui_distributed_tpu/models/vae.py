@@ -26,6 +26,7 @@ class VAEConfig:
     channel_mult: tuple[int, ...] = (1, 2, 4, 4)
     num_res_blocks: int = 2
     scaling_factor: float = 0.13025      # SDXL VAE; SD1.5 uses 0.18215
+    shift_factor: float = 0.0            # FLUX ae: 0.1159
     dtype: str = "bfloat16"
 
     @classmethod
@@ -186,7 +187,9 @@ class AutoencoderKL:
     def encode(self, images: jax.Array) -> jax.Array:
         moments = self.encoder.apply(self.enc_params, images)
         mean, _logvar = jnp.split(moments, 2, axis=-1)
-        return mean * self.config.scaling_factor
+        return (mean - self.config.shift_factor) * self.config.scaling_factor
 
     def decode(self, latents: jax.Array) -> jax.Array:
-        return self.decoder.apply(self.dec_params, latents / self.config.scaling_factor)
+        return self.decoder.apply(
+            self.dec_params,
+            latents / self.config.scaling_factor + self.config.shift_factor)

@@ -6,6 +6,7 @@ outputs. This is the proof that a real SDXL/SD1.5 checkpoint maps onto
 this framework correctly — every transpose, norm-eps, padding and
 activation choice is covered."""
 
+import dataclasses
 import math
 
 import numpy as np
@@ -492,6 +493,56 @@ class TestVAEConversion:
         lat = vae.encode(jnp.asarray(img))
         assert lat.shape == (1, 8, 8, cfg.latent_channels)
         assert vae.decode(lat).shape == (1, 16, 16, 3)
+
+    def test_bfl_ae_layout(self, vae_pair):
+        """BFL ae.safetensors: bare encoder./decoder. keys, no quant convs
+        — synthesized identity quant convs must make the flax stack equal
+        the raw torch encoder/decoder outputs."""
+        cfg, tmodel, _ = vae_pair
+        sd = {k: v.numpy() for k, v in tmodel.state_dict().items()
+              if not k.startswith(("quant_conv", "post_quant_conv"))}
+        vae2 = AutoencoderKL(cfg).init(jax.random.key(1), image_hw=(16, 16))
+        enc, dec = convert_vae(sd, vae2.enc_params, vae2.dec_params, cfg,
+                               prefix="", quant_convs=False)
+        vae2.enc_params, vae2.dec_params = enc, dec
+
+        rng = np.random.RandomState(4)
+        img = rng.randn(1, 16, 16, 3).astype(np.float32)
+        with torch.no_grad():
+            ref = tmodel.encoder(_nchw(img))      # no quant conv
+        moments = vae2.encoder.apply(vae2.enc_params, jnp.asarray(img))
+        np.testing.assert_allclose(
+            np.asarray(moments), ref.numpy().transpose(0, 2, 3, 1),
+            atol=2e-4, rtol=2e-4)
+
+        z = rng.randn(1, 8, 8, cfg.latent_channels).astype(np.float32)
+        with torch.no_grad():
+            ref_d = tmodel.decoder(_nchw(z))      # no post-quant conv
+        out = vae2.decoder.apply(vae2.dec_params, jnp.asarray(z))
+        np.testing.assert_allclose(
+            np.asarray(out), ref_d.numpy().transpose(0, 2, 3, 1),
+            atol=2e-4, rtol=2e-4)
+
+    def test_shift_factor_roundtrip(self):
+        """FLUX-style shift/scale: encode∘decode must invert the affine."""
+        cfg = VAEConfig.tiny(dtype="float32")
+        cfg = dataclasses.replace(cfg, scaling_factor=0.3611,
+                                  shift_factor=0.1159)
+        vae = AutoencoderKL(cfg).init(jax.random.key(2), image_hw=(16, 16))
+        z = jnp.asarray(np.random.RandomState(5)
+                        .randn(1, 8, 8, cfg.latent_channels)
+                        .astype(np.float32))
+        moments = vae.encoder.apply(
+            vae.enc_params,
+            jnp.zeros((1, 16, 16, 3), jnp.float32))
+        mean = np.asarray(moments)[..., :cfg.latent_channels]
+        lat = vae.encode(jnp.zeros((1, 16, 16, 3), jnp.float32))
+        np.testing.assert_allclose(
+            np.asarray(lat), (mean - 0.1159) * 0.3611, atol=1e-5)
+        # decode applies the inverse affine before the decoder
+        raw = vae.decoder.apply(vae.dec_params, z / 0.3611 + 0.1159)
+        np.testing.assert_allclose(np.asarray(vae.decode(z)),
+                                   np.asarray(raw), atol=1e-6)
 
 
 class TestLayoutDetection:

@@ -276,25 +276,27 @@ def test_nodes_doc_covers_registry():
     from comfyui_distributed_tpu.graph import nodes_builtin  # noqa: F401
     from comfyui_distributed_tpu.graph.node import NODE_REGISTRY
 
-    doc = Path("docs/nodes.md").read_text()
+    doc = (Path(__file__).resolve().parent.parent
+           / "docs" / "nodes.md").read_text()
     missing = [n for n in NODE_REGISTRY if f"`{n}`" not in doc]
     assert not missing, f"docs/nodes.md missing nodes: {missing}"
 
-    def test_center_crop_and_negative_rejection(self):
-        import numpy as np
-        import pytest as _pytest
 
-        from comfyui_distributed_tpu.graph.node import get_node
-        from comfyui_distributed_tpu.utils.exceptions import ValidationError
+def test_center_crop_and_negative_rejection():
+    import numpy as np
+    import pytest as _pytest
 
-        node = get_node("ImageScale")()
-        img = np.random.RandomState(3).rand(1, 8, 16, 3).astype("float32")
-        # center crop to square: wide source loses equal margins
-        (out,) = node.execute(img, width=8, height=8, crop="center")
-        assert np.asarray(out).shape == (1, 8, 8, 3)
-        with _pytest.raises(ValidationError):
-            node.execute(img, width=-4, height=8)
-        with _pytest.raises(ValidationError):
-            node.execute(img, width=8, height=8, crop="nope")
-        with _pytest.raises(ValidationError):
-            get_node("ImageScaleBy")().execute(img, scale_by=-1.0)
+    from comfyui_distributed_tpu.graph.node import get_node
+    from comfyui_distributed_tpu.utils.exceptions import ValidationError
+
+    node = get_node("ImageScale")()
+    img = np.random.RandomState(3).rand(1, 8, 16, 3).astype("float32")
+    # center crop to square: wide source loses equal margins
+    (out,) = node.execute(img, width=8, height=8, crop="center")
+    assert np.asarray(out).shape == (1, 8, 8, 3)
+    with _pytest.raises(ValidationError):
+        node.execute(img, width=-4, height=8)
+    with _pytest.raises(ValidationError):
+        node.execute(img, width=8, height=8, crop="nope")
+    with _pytest.raises(ValidationError):
+        get_node("ImageScaleBy")().execute(img, scale_by=-1.0)
