@@ -9,10 +9,11 @@ frame batches afterwards (``ImageBatchDivider``); here:
   attention over the spatio-temporal token sequence) — single-video
   latency scaling the reference cannot express.
 
-VAE: frames are encoded/decoded per-frame with the image AutoencoderKL
-(vmapped over F). A causal temporal VAE (real WAN) slots in behind the
-same interface later; the 4n+1 frame rule helpers live in
-``models/video_dit.py``.
+VAE: either the image ``AutoencoderKL`` applied per frame, or the
+WAN-geometry 3D causal VAE (``models/wan_vae.WanVAE3D``) — with the 3D
+VAE the DiT runs on a 4×-shorter latent frame axis (the 4n+1 rule's
+origin), a direct transformer-sequence reduction. The 4n+1 frame rule
+helpers live in ``models/video_dit.py``.
 """
 
 from __future__ import annotations
@@ -52,18 +53,37 @@ class VideoPipeline:
         self.dit_params = dit_params
         self.vae = vae
 
+    @property
+    def temporal_downscale(self) -> int:
+        return getattr(self.vae.config, "temporal_downscale", 1)
+
+    def latent_frames(self, spec: "VideoSpec") -> int:
+        """DiT frame-axis length: padded pixel frames compressed by the
+        VAE's temporal factor (1 for the per-frame image VAE)."""
+        return (spec.padded_frames - 1) // self.temporal_downscale + 1
+
     def decode_frames(self, latents: jax.Array) -> jax.Array:
-        """[B,F,h,w,c] → [B,F,H,W,3] via per-frame VAE decode."""
+        """[B,f,h,w,c] → [B,F,H,W,3]: whole-clip decode through a 3D
+        causal VAE, per-frame decode through the image VAE."""
+        if self.temporal_downscale > 1:
+            frames = self.vae.decode(latents)
+            return jnp.clip(frames / 2.0 + 0.5, 0.0, 1.0)
         B, F = latents.shape[:2]
         flat = latents.reshape((B * F,) + latents.shape[2:])
         frames = self.vae.decode(flat)
         frames = jnp.clip(frames / 2.0 + 0.5, 0.0, 1.0)
         return frames.reshape((B, F) + frames.shape[1:])
 
-    def _denoiser(self, context, pooled, guidance_scale, sp_axis=None):
+    def _denoiser(self, context, pooled, guidance_scale, sp_axis=None,
+                  inp_fn=None):
+        """``inp_fn`` optionally transforms the latent before the model
+        sees it (i2v concatenates mask + conditioning channels); the CFG
+        machinery is shared so t2v/i2v guidance can never diverge."""
         def model_call(x, sigma, ctx, pl):
             t = jnp.broadcast_to(sigma, (x.shape[0],))
-            v = self.dit.apply(self.dit_params, x, t, ctx, pl, sp_axis=sp_axis)
+            inp = x if inp_fn is None else inp_fn(x)
+            v = self.dit.apply(self.dit_params, inp, t, ctx, pl,
+                               sp_axis=sp_axis)
             return x - sigma * v
 
         if guidance_scale == 1.0:
@@ -87,7 +107,7 @@ class VideoPipeline:
         """dp fan-out: each shard samples a full (seed-varied) video."""
         sigmas = sigmas_flow(spec.steps, spec.shift)
         ds = self.vae.config.downscale
-        F = spec.padded_frames
+        F = self.latent_frames(spec)
         lat = (F, spec.height // ds, spec.width // ds, self.dit.config.in_channels)
 
         def per_shard(key, context, pooled):
@@ -108,17 +128,86 @@ class VideoPipeline:
                  context: jax.Array, pooled: jax.Array) -> jax.Array:
         return self.generate_fn(mesh, spec)(jax.random.key(seed), context, pooled)
 
+    # -- image→video (WAN-2.2-style latent-concat conditioning) ----------
+
+    def i2v_condition(self, image: jax.Array,
+                      spec: VideoSpec) -> tuple[jax.Array, jax.Array]:
+        """First-frame conditioning for i2v models.
+
+        ``image`` [B,H,W,3] in [0,1] → ``y`` (the causal VAE encoding of
+        the image followed by blank frames) and ``mask`` (one channel per
+        compressed-away pixel frame, published WAN polarity: **1 where
+        content is given** — the first latent frame — 0 where the model
+        must generate). The model input per step is
+        ``concat([x_t, mask, y])``, matching the i2v in_channels
+        arithmetic (e.g. 16+4+16=36 at 4× temporal)."""
+        B, H, W, _ = image.shape
+        F = spec.padded_frames
+        vid = jnp.concatenate(
+            [image[:, None] * 2.0 - 1.0,
+             jnp.zeros((B, F - 1, H, W, image.shape[-1]))], axis=1)
+        y = self.vae.encode(vid)
+        td = max(self.temporal_downscale, 1)
+        mask = jnp.zeros(y.shape[:4] + (td,), y.dtype)
+        return y, mask.at[:, 0].set(1.0)
+
+    def _denoiser_i2v(self, context, pooled, y, mask, guidance_scale,
+                      sp_axis=None):
+        def inp_fn(x):
+            return jnp.concatenate(
+                [x, jnp.broadcast_to(mask, x.shape[:4] + (mask.shape[-1],)),
+                 jnp.broadcast_to(y, x.shape[:4] + (y.shape[-1],))], axis=-1)
+
+        return self._denoiser(context, pooled, guidance_scale,
+                              sp_axis=sp_axis, inp_fn=inp_fn)
+
+    def generate_i2v_fn(self, mesh: Mesh, spec: VideoSpec,
+                        axis: str = constants.AXIS_DATA):
+        """dp fan-out of seed-varied i2v samples from one start image
+        (the conditioning latents replicate across shards)."""
+        sigmas = sigmas_flow(spec.steps, spec.shift)
+        ds = self.vae.config.downscale
+        F = self.latent_frames(spec)
+        c = getattr(self.dit.config, "out_channels",
+                    self.dit.config.in_channels)
+        lat = (F, spec.height // ds, spec.width // ds, c)
+
+        def per_shard(key, context, pooled, y, mask):
+            k = participant_key(key, axis)
+            x = jax.random.normal(k, (1,) + lat, jnp.float32)
+            den = self._denoiser_i2v(context, pooled, y, mask,
+                                     spec.guidance_scale)
+            x0 = sample(spec.sampler, den, x, sigmas, key=k)
+            return self.decode_frames(x0)
+
+        f = jax.shard_map(
+            per_shard, mesh=mesh,
+            in_specs=(P(), P(None, None, None), P(None, None),
+                      P(None, None, None, None, None),
+                      P(None, None, None, None, None)),
+            out_specs=P(axis, None, None, None, None),
+        )
+        return jax.jit(f)
+
+    def generate_i2v(self, mesh: Mesh, spec: VideoSpec, seed: int,
+                     image: jax.Array, context: jax.Array,
+                     pooled: jax.Array) -> jax.Array:
+        y, mask = self.i2v_condition(image, spec)
+        return self.generate_i2v_fn(mesh, spec)(
+            jax.random.key(seed), context, pooled, y, mask)
+
     def generate_frames_fn(self, mesh: Mesh, spec: VideoSpec,
                            axis: str = constants.AXIS_SEQUENCE):
         """ONE video, frame blocks sharded over ``axis``; joint ring
         attention spans the full spatio-temporal sequence so motion stays
         globally coherent (this is exact attention, not windowed)."""
         n_sh = mesh.shape[axis]
-        F = spec.padded_frames
+        F = self.latent_frames(spec)
         if F % n_sh:
             raise ValueError(
-                f"padded frame count {F} must divide over {n_sh} shards "
-                f"(choose frames so that 4n+1 ≡ 0 mod shards)")
+                f"latent frame count {F} must divide over {n_sh} shards "
+                f"(choose frames so the compressed 4n+1 count ≡ 0 mod "
+                f"shards)")
         sigmas = sigmas_flow(spec.steps, spec.shift)
         ds = self.vae.config.downscale
         lat_h, lat_w = spec.height // ds, spec.width // ds

@@ -1005,7 +1005,10 @@ class TPUTxt2Video(NodeDef):
         ctx = positive["context"]
         pooled = positive.get("pooled")
         if pooled is None:
-            pooled = jnp.zeros((1, model.pipeline.dit.config.pooled_dim))
+            # real-WAN configs have no pooled-vector input (the model
+            # ignores it); any width satisfies the call signature
+            pooled = jnp.zeros(
+                (1, getattr(model.pipeline.dit.config, "pooled_dim", 768)))
         key = jax.random.key(int(seed))
         if mode == "sp":
             if "sp" not in mesh.shape:
@@ -1017,6 +1020,55 @@ class TPUTxt2Video(NodeDef):
             videos = model.pipeline.generate(mesh, spec, int(seed), ctx, pooled)
         # [B,F,H,W,3] → IMAGE batch [B·F,H,W,3] (ImageBatchDivider splits
         # it back per video/chunk, reference workflow parity)
+        B, F = videos.shape[:2]
+        return (videos.reshape((B * F,) + videos.shape[2:]),)
+
+
+@register_node("TPUImg2Video")
+class TPUImg2Video(NodeDef):
+    """Sharded WAN-class i2v sampler: the start image conditions every
+    sample via causal-VAE latent concat (WAN-2.2 style — no CLIP-vision
+    branch), seeds fan out over ``dp`` (reference parity: the WAN i2v
+    workflow, SURVEY §2.9, run job-per-worker there)."""
+
+    INPUTS = {
+        "model": "MODEL", "positive": "CONDITIONING", "image": "IMAGE",
+        "seed": "INT", "frames": "INT", "steps": "INT",
+    }
+    OPTIONAL = {"cfg": "FLOAT", "shift": "FLOAT"}
+    HIDDEN = {"mesh": "*"}
+    RETURNS = ("IMAGE",)
+
+    def execute(self, model, positive, image, seed: int, frames: int,
+                steps: int, cfg: float = 1.0, shift: float = 3.0,
+                mesh=None, **_):
+        from ..diffusion.pipeline_video import VideoSpec
+        from ..parallel.mesh import build_mesh
+        from ..utils.exceptions import ValidationError
+
+        image = jnp.asarray(image)
+        if image.ndim == 3:
+            image = image[None]
+        din = model.pipeline.dit.config.in_channels
+        dout = getattr(model.pipeline.dit.config, "out_channels", din)
+        if din == dout:
+            raise ValidationError(
+                f"model {model.preset.name!r} is a t2v architecture "
+                "(in_channels == out_channels) — i2v needs a preset with "
+                "latent-concat conditioning channels, e.g. 'wan-i2v'")
+        if mesh is None:
+            mesh = build_mesh({"dp": len(jax.devices())})
+        H, W = int(image.shape[1]), int(image.shape[2])
+        spec = VideoSpec(frames=int(frames), height=H, width=W,
+                         steps=int(steps), shift=float(shift),
+                         guidance_scale=float(cfg))
+        ctx = positive["context"]
+        pooled = positive.get("pooled")
+        if pooled is None:
+            pooled = jnp.zeros(
+                (1, getattr(model.pipeline.dit.config, "pooled_dim", 768)))
+        videos = model.pipeline.generate_i2v(mesh, spec, int(seed),
+                                             image[:1], ctx, pooled)
         B, F = videos.shape[:2]
         return (videos.reshape((B * F,) + videos.shape[2:]),)
 

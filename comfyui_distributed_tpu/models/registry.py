@@ -63,12 +63,12 @@ def _flux_tiny_preset():
 
 def _wan_preset():
     from .wan import WanConfig
+    from .wan_vae import WanVAEConfig
 
-    # WAN t2v (exact published architecture): 16-ch video latents,
-    # UMT5-width context
+    # WAN t2v (exact published architecture): 16-ch video latents from
+    # the 3D causal VAE (4× temporal compression), UMT5-width context
     return ModelPreset(
-        "wan", unet=None,
-        vae=VAEConfig(latent_channels=16, scaling_factor=0.3611),
+        "wan", unet=None, vae=WanVAEConfig.wan(),
         text=TextEncoderConfig(output_dim=4096, pooled_dim=768),
         sample_hw=(60, 104),             # 480×832 / 8
         video=WanConfig.wan_14b(), clip="umt5")
@@ -79,6 +79,44 @@ def _wan_tiny_preset():
 
     return ModelPreset(
         "wan-tiny", unet=None, vae=VAEConfig.tiny(),
+        text=TextEncoderConfig.tiny(),
+        sample_hw=(8, 8), video=WanConfig.tiny())
+
+
+def _wan_i2v_preset():
+    from .wan import WanConfig
+    from .wan_vae import WanVAEConfig
+
+    # WAN 2.2-style i2v: first frame conditions via latent concat —
+    # in_channels 36 = 16 noise + 4 mask (one per compressed pixel
+    # frame) + 16 conditioning latents; no CLIP-vision branch
+    return ModelPreset(
+        "wan-i2v", unet=None, vae=WanVAEConfig.wan(),
+        text=TextEncoderConfig(output_dim=4096, pooled_dim=768),
+        sample_hw=(60, 104),
+        video=dataclasses.replace(WanConfig.wan_14b(), in_channels=36),
+        clip="umt5")
+
+
+def _wan_i2v_tiny_preset():
+    from .wan import WanConfig
+    from .wan_vae import WanVAEConfig
+
+    # tiny arithmetic: 4 noise + 2 mask (2× temporal VAE) + 4 cond = 10
+    return ModelPreset(
+        "wan-i2v-tiny", unet=None, vae=WanVAEConfig.tiny(),
+        text=TextEncoderConfig.tiny(), sample_hw=(8, 8),
+        video=WanConfig.tiny(in_channels=10))
+
+
+def _wan_tiny_3d_preset():
+    from .wan import WanConfig
+    from .wan_vae import WanVAEConfig
+
+    # tiny real-geometry stack: 3D causal VAE (2× temporal here) + WAN
+    # transformer — the full video architecture at test scale
+    return ModelPreset(
+        "wan-tiny-3d", unet=None, vae=WanVAEConfig.tiny(),
         text=TextEncoderConfig.tiny(),
         sample_hw=(8, 8), video=WanConfig.tiny())
 
@@ -108,6 +146,9 @@ PRESETS: dict[str, ModelPreset] = {
     "flux-tiny": _flux_tiny_preset(),
     "wan": _wan_preset(),
     "wan-tiny": _wan_tiny_preset(),
+    "wan-tiny-3d": _wan_tiny_3d_preset(),
+    "wan-i2v": _wan_i2v_preset(),
+    "wan-i2v-tiny": _wan_i2v_tiny_preset(),
     "video-mmdit": _wan_mmdit_preset(),
 }
 
@@ -126,7 +167,12 @@ class ModelBundle:
         k1, k2, k3 = jax.random.split(jax.random.key(seed), 3)
         img_hw = (preset.sample_hw[0] * preset.vae.downscale,
                   preset.sample_hw[1] * preset.vae.downscale)
-        vae = AutoencoderKL(preset.vae).init(k2, image_hw=img_hw)
+        from .wan_vae import WanVAE3D, WanVAEConfig
+
+        if isinstance(preset.vae, WanVAEConfig):
+            vae = WanVAE3D(preset.vae).init(k2, frames=5, image_hw=img_hw)
+        else:
+            vae = AutoencoderKL(preset.vae).init(k2, image_hw=img_hw)
         self.text_encoder = TextEncoder(preset.text).init(k3)
         if preset.kind == "video":
             from ..diffusion.pipeline_video import VideoPipeline
@@ -402,8 +448,14 @@ class ModelBundle:
         (``first_stage_model.*``), standalone SD VAE (bare keys with
         ``quant_conv``), and BFL ``ae.safetensors`` (bare keys, no quant
         convs — FLUX's 16-channel KL-VAE)."""
-        from .convert import convert_vae, load_safetensors
+        from .convert import ConversionError, convert_vae, load_safetensors
+        from .wan_vae import WanVAEConfig
 
+        if isinstance(self.preset.vae, WanVAEConfig):
+            raise ConversionError(
+                "WAN 3D-causal-VAE weight portability is not yet wired "
+                "(models/wan_vae.py) — the preset's VAE keeps its current "
+                "weights; --vae applies to image-VAE presets only")
         sd = load_safetensors(Path(path))
         if any(k.startswith("first_stage_model.") for k in sd):
             prefix, qc = "first_stage_model.", True

@@ -1,0 +1,301 @@
+"""WAN-geometry 3D causal video VAE (flax).
+
+The reference free-rides on ComfyUI for video VAEs (SURVEY "external
+substrate"); the WAN family compresses video 4× in time and 8× in space
+through a *causal* 3D conv stack, which is what makes its 4n+1 frame
+rule work: ``T`` pixel frames ↔ ``(T-1)/4 + 1`` latent frames, with the
+first frame compressed alone (so single images are valid 1-frame
+videos). This module implements that geometry TPU-natively:
+
+- causal 3D convs (time padded front-only with edge replication — no
+  future leakage, so prefix decodes are consistent with full decodes);
+- channel-RMS norms, SiLU residual blocks, single-head spatial
+  attention in the bottleneck;
+- temporal downsample = stride-2 causal conv (``ceil(T/2)``); temporal
+  upsample = per-frame frame-pair expansion minus the leading duplicate
+  (``2T-1``) — exact inverses over the 4n+1 family.
+
+The ~4× shorter latent frame axis is a direct transformer-sequence
+reduction for ``WanModel`` — the dominant video-generation cost.
+
+Weight portability for published WAN VAE checkpoints is **not yet
+wired** (the official stack's streaming-cache forward has extra
+chunk-boundary semantics); the architecture is init-compatible with the
+geometry and ships behind the same ``encode``/``decode`` interface as
+``AutoencoderKL`` so it slots into ``VideoPipeline`` unchanged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+@dataclasses.dataclass(frozen=True)
+class WanVAEConfig:
+    in_channels: int = 3
+    latent_channels: int = 16
+    base_dim: int = 96
+    dim_mult: tuple[int, ...] = (1, 2, 4, 4)
+    num_res_blocks: int = 2
+    # one entry per downsample transition (len(dim_mult) - 1): True adds
+    # stride-2 temporal compression to that spatial downsample
+    temporal_downsample: tuple[bool, ...] = (False, True, True)
+    scaling_factor: float = 1.0
+    dtype: str = "float32"
+
+    @classmethod
+    def wan(cls) -> "WanVAEConfig":
+        return cls()
+
+    @classmethod
+    def tiny(cls, **kw) -> "WanVAEConfig":
+        base = dict(latent_channels=4, base_dim=16, dim_mult=(1, 2),
+                    num_res_blocks=1, temporal_downsample=(True,))
+        base.update(kw)
+        return cls(**base)
+
+    @property
+    def downscale(self) -> int:
+        """Spatial compression (one stride-2 per dim transition)."""
+        return 2 ** (len(self.dim_mult) - 1)
+
+    @property
+    def temporal_downscale(self) -> int:
+        return 2 ** sum(self.temporal_downsample)
+
+    @property
+    def jnp_dtype(self):
+        return jnp.dtype(self.dtype)
+
+    def latent_frames(self, frames: int) -> int:
+        """4n+1 pixel frames → n+1 latent frames (causal: first alone)."""
+        return (frames - 1) // self.temporal_downscale + 1
+
+    def pixel_frames(self, latent_frames: int) -> int:
+        return (latent_frames - 1) * self.temporal_downscale + 1
+
+
+def _pad_time_causal(x: jax.Array, n: int) -> jax.Array:
+    """Front-pad the frame axis with ``n`` copies of the first frame."""
+    if n == 0:
+        return x
+    first = jnp.repeat(x[:, :1], n, axis=1)
+    return jnp.concatenate([first, x], axis=1)
+
+
+class CausalConv3d(nn.Module):
+    """[B,T,H,W,C] conv: causal (front-padded) in time, SAME in space."""
+
+    features: int
+    kernel: tuple[int, int, int] = (3, 3, 3)
+    time_stride: int = 1
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        kt, kh, kw = self.kernel
+        x = _pad_time_causal(x, kt - 1)
+        return nn.Conv(
+            self.features, self.kernel,
+            strides=(self.time_stride, 1, 1),
+            padding=[(0, 0), (kh // 2, kh // 2), (kw // 2, kw // 2)],
+            dtype=self.dtype, name="conv")(x)
+
+
+class ChannelRMSNorm(nn.Module):
+    """L2-normalize the channel axis × √C × learned gamma (WAN's norm)."""
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        c = x.shape[-1]
+        g = self.param("gamma", nn.initializers.ones, (c,))
+        xf = x.astype(jnp.float32)
+        n = xf * jax.lax.rsqrt(jnp.sum(xf * xf, -1, keepdims=True) + 1e-12)
+        return (n * (c ** 0.5)).astype(x.dtype) * g.astype(x.dtype)
+
+
+class ResBlock3d(nn.Module):
+    features: int
+    dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        h = ChannelRMSNorm(name="norm1")(x)
+        h = CausalConv3d(self.features, dtype=self.dtype,
+                         name="conv1")(nn.silu(h))
+        h = ChannelRMSNorm(name="norm2")(h)
+        h = CausalConv3d(self.features, dtype=self.dtype,
+                         name="conv2")(nn.silu(h))
+        if x.shape[-1] != self.features:
+            x = nn.Dense(self.features, dtype=self.dtype, name="skip")(x)
+        return x + h
+
+
+class SpatialAttention(nn.Module):
+    """Single-head per-frame spatial self-attention (bottleneck only)."""
+
+    dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        B, T, H, W, C = x.shape
+        h = ChannelRMSNorm(name="norm")(x).reshape(B * T, H * W, C)
+        qkv = nn.Dense(C * 3, dtype=self.dtype, name="qkv")(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        s = jnp.einsum("bqc,bkc->bqk", q, k) / (C ** 0.5)
+        out = jnp.einsum("bqk,bkc->bqc", jax.nn.softmax(s, axis=-1), v)
+        out = nn.Dense(C, dtype=self.dtype, name="proj")(out)
+        return x + out.reshape(B, T, H, W, C)
+
+
+class _Downsample(nn.Module):
+    features: int
+    temporal: bool
+    dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        B, T, H, W, C = x.shape
+        # spatial: stride-2 conv per frame (zero-pad bottom/right, WAN style)
+        h = x.reshape(B * T, H, W, C)
+        h = jnp.pad(h, ((0, 0), (0, 1), (0, 1), (0, 0)))
+        h = nn.Conv(self.features, (3, 3), strides=(2, 2), padding="VALID",
+                    dtype=self.dtype, name="space")(h)
+        h = h.reshape(B, T, H // 2, W // 2, self.features)
+        if self.temporal:
+            # stride-2 causal conv: T → ceil(T/2), frame 0 kept alone
+            h = _pad_time_causal(h, 1)
+            h = nn.Conv(self.features, (2, 1, 1), strides=(2, 1, 1),
+                        padding="VALID", dtype=self.dtype, name="time")(h)
+        return h
+
+
+class _Upsample(nn.Module):
+    features: int
+    temporal: bool
+    dtype: jnp.dtype
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        if self.temporal:
+            # every latent frame expands to a frame pair; the leading
+            # duplicate is dropped: T → 2T-1 (inverse of ceil(T/2))
+            B, T, H, W, C = x.shape
+            h = CausalConv3d(C * 2, (3, 1, 1), dtype=self.dtype,
+                             name="time")(x)
+            h = jnp.moveaxis(h.reshape(B, T, H, W, 2, C), 4, 2)
+            x = h.reshape(B, 2 * T, H, W, C)[:, 1:]
+        B, T, H, W, C = x.shape
+        h = x.reshape(B * T, H, W, C)
+        h = jax.image.resize(h, (B * T, H * 2, W * 2, C), "nearest")
+        h = nn.Conv(self.features, (3, 3), padding="SAME", dtype=self.dtype,
+                    name="space")(h)
+        return h.reshape(B, T, H * 2, W * 2, self.features)
+
+
+class WanVAEEncoder(nn.Module):
+    config: WanVAEConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        cfg = self.config
+        dt = cfg.jnp_dtype
+        dims = [cfg.base_dim * m for m in cfg.dim_mult]
+        h = CausalConv3d(dims[0], dtype=dt, name="conv_in")(x.astype(dt))
+        for level, dim in enumerate(dims):
+            for i in range(cfg.num_res_blocks):
+                h = ResBlock3d(dim, dt, name=f"down_{level}_res_{i}")(h)
+            if level < len(dims) - 1:
+                h = _Downsample(dims[level + 1],
+                                cfg.temporal_downsample[level], dt,
+                                name=f"down_{level}_ds")(h)
+        h = ResBlock3d(dims[-1], dt, name="mid_res1")(h)
+        h = SpatialAttention(dt, name="mid_attn")(h)
+        h = ResBlock3d(dims[-1], dt, name="mid_res2")(h)
+        h = ChannelRMSNorm(name="norm_out")(h)
+        h = CausalConv3d(cfg.latent_channels * 2, dtype=dt,
+                         name="conv_out")(nn.silu(h))
+        return nn.Dense(cfg.latent_channels * 2, dtype=jnp.float32,
+                        name="quant")(h.astype(jnp.float32))
+
+
+class WanVAEDecoder(nn.Module):
+    config: WanVAEConfig
+
+    @nn.compact
+    def __call__(self, z: jax.Array) -> jax.Array:
+        cfg = self.config
+        dt = cfg.jnp_dtype
+        dims = [cfg.base_dim * m for m in cfg.dim_mult]
+        z = nn.Dense(cfg.latent_channels, dtype=jnp.float32,
+                     name="post_quant")(z.astype(jnp.float32))
+        h = CausalConv3d(dims[-1], dtype=dt, name="conv_in")(z.astype(dt))
+        h = ResBlock3d(dims[-1], dt, name="mid_res1")(h)
+        h = SpatialAttention(dt, name="mid_attn")(h)
+        h = ResBlock3d(dims[-1], dt, name="mid_res2")(h)
+        for level in reversed(range(len(dims))):
+            for i in range(cfg.num_res_blocks + 1):
+                h = ResBlock3d(dims[level], dt,
+                               name=f"up_{level}_res_{i}")(h)
+            if level > 0:
+                h = _Upsample(dims[level - 1],
+                              cfg.temporal_downsample[level - 1], dt,
+                              name=f"up_{level}_us")(h)
+        h = ChannelRMSNorm(name="norm_out")(h)
+        h = CausalConv3d(cfg.in_channels, dtype=dt,
+                         name="conv_out")(nn.silu(h))
+        return h.astype(jnp.float32)
+
+
+class WanVAE3D:
+    """Host wrapper matching ``AutoencoderKL``'s interface over video
+    tensors [B,T,H,W,C] — ``VideoPipeline`` drives either transparently."""
+
+    def __init__(self, config: WanVAEConfig, enc_params=None,
+                 dec_params=None):
+        self.config = config
+        self.encoder = WanVAEEncoder(config)
+        self.decoder = WanVAEDecoder(config)
+        self.enc_params = enc_params
+        self.dec_params = dec_params
+        # jit once (params are traced args, so weight swaps don't stale it);
+        # inside an outer jit these inline, standalone calls compile once
+        self._enc_fn = jax.jit(self.encoder.apply)
+        self._dec_fn = jax.jit(self.decoder.apply)
+
+    def init(self, rng: jax.Array, frames: int = 5,
+             image_hw: tuple[int, int] = (32, 32)) -> "WanVAE3D":
+        cfg = self.config
+        H, W = image_hw
+        k1, k2 = jax.random.split(rng)
+        vid = jnp.zeros((1, frames, H, W, cfg.in_channels))
+        lat = jnp.zeros((1, cfg.latent_frames(frames), H // cfg.downscale,
+                         W // cfg.downscale, cfg.latent_channels))
+        self.enc_params = jax.jit(self.encoder.init)(k1, vid)
+        self.dec_params = jax.jit(self.decoder.init)(k2, lat)
+        return self
+
+    def encode(self, video: jax.Array) -> jax.Array:
+        """[B,T,H,W,C] → latents; a rank-4 [B,H,W,C] image is treated as
+        a 1-frame video (the causal design's single-image case) and the
+        frame axis squeezed back out."""
+        single = video.ndim == 4
+        if single:
+            video = video[:, None]
+        moments = self._enc_fn(self.enc_params, video)
+        mean, _ = jnp.split(moments, 2, axis=-1)
+        lat = mean * self.config.scaling_factor
+        return lat[:, 0] if single else lat
+
+    def decode(self, latents: jax.Array) -> jax.Array:
+        single = latents.ndim == 4
+        if single:
+            latents = latents[:, None]
+        out = self._dec_fn(self.dec_params,
+                           latents / self.config.scaling_factor)
+        return out[:, 0] if single else out

@@ -1,0 +1,190 @@
+"""WAN-geometry 3D causal VAE: 4n+1 frame arithmetic, temporal
+causality (no future leakage), and the full video pipeline over the
+compressed latent frame axis."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from comfyui_distributed_tpu.models.wan_vae import (
+    WanVAE3D, WanVAEConfig)
+
+TINY = WanVAEConfig.tiny()
+
+
+class TestGeometry:
+    def test_frame_arithmetic(self):
+        wan = WanVAEConfig.wan()
+        assert wan.temporal_downscale == 4
+        assert wan.downscale == 8
+        assert wan.latent_frames(81) == 21
+        assert wan.pixel_frames(21) == 81
+        assert wan.latent_frames(1) == 1
+        assert TINY.temporal_downscale == 2
+        assert TINY.latent_frames(5) == 3
+
+    def test_encode_decode_shapes(self):
+        vae = WanVAE3D(TINY).init(jax.random.key(0), frames=5,
+                                  image_hw=(8, 8))
+        vid = jnp.zeros((1, 5, 8, 8, 3))
+        lat = vae.encode(vid)
+        assert lat.shape == (1, 3, 4, 4, TINY.latent_channels)
+        out = vae.decode(lat)
+        assert out.shape == (1, 5, 8, 8, 3)
+
+    def test_single_frame_is_valid_video(self):
+        """The causal design's point: 1 pixel frame ↔ 1 latent frame."""
+        vae = WanVAE3D(TINY).init(jax.random.key(0), frames=1,
+                                  image_hw=(8, 8))
+        lat = vae.encode(jnp.ones((1, 1, 8, 8, 3)) * 0.3)
+        assert lat.shape[1] == 1
+        assert vae.decode(lat).shape[1] == 1
+
+
+class TestCausality:
+    def test_encoder_first_latent_ignores_future_frames(self):
+        """All temporal ops are front-padded: latent frame 0 must be a
+        function of pixel frame 0 only."""
+        vae = WanVAE3D(TINY).init(jax.random.key(0), frames=5,
+                                  image_hw=(8, 8))
+        rng = np.random.RandomState(1)
+        a = rng.rand(1, 5, 8, 8, 3).astype(np.float32)
+        b = a.copy()
+        b[:, 1:] = rng.rand(1, 4, 8, 8, 3)     # change every later frame
+        la = vae.encode(jnp.asarray(a))
+        lb = vae.encode(jnp.asarray(b))
+        np.testing.assert_allclose(np.asarray(la[:, 0]),
+                                   np.asarray(lb[:, 0]), atol=1e-5)
+        assert not np.allclose(np.asarray(la[:, 1:]), np.asarray(lb[:, 1:]))
+
+    def test_decoder_prefix_consistency(self):
+        """Causal decode: the first pixel frame depends only on the first
+        latent frame."""
+        vae = WanVAE3D(TINY).init(jax.random.key(0), frames=5,
+                                  image_hw=(8, 8))
+        rng = np.random.RandomState(2)
+        z = rng.rand(1, 3, 4, 4, TINY.latent_channels).astype(np.float32)
+        z2 = z.copy()
+        z2[:, 1:] = rng.rand(1, 2, 4, 4, TINY.latent_channels)
+        fa = vae.decode(jnp.asarray(z))
+        fb = vae.decode(jnp.asarray(z2))
+        np.testing.assert_allclose(np.asarray(fa[:, 0]),
+                                   np.asarray(fb[:, 0]), atol=1e-5)
+
+
+class TestPipelineIntegration:
+    def test_t2v_over_compressed_latents(self):
+        """wan-tiny-3d bundle: 5 pixel frames sample as 3 latent frames
+        through the WAN transformer, decode back to 5."""
+        from comfyui_distributed_tpu.diffusion.pipeline_video import VideoSpec
+        from comfyui_distributed_tpu.models.registry import ModelRegistry
+        from comfyui_distributed_tpu.parallel import build_mesh
+
+        bundle = ModelRegistry().get("wan-tiny-3d")
+        assert bundle.pipeline.temporal_downscale == 2
+        spec = VideoSpec(frames=5, height=16, width=16, steps=1)
+        assert bundle.pipeline.latent_frames(spec) == 3
+        mesh = build_mesh({"dp": 1})
+        ctx, pooled = bundle.text_encoder.encode(["tiny clip"])
+        vids = bundle.pipeline.generate(mesh, spec, 0, ctx, pooled)
+        assert vids.shape == (1, 5, 16, 16, 3)
+
+    def test_t2v_node_through_graph(self):
+        from comfyui_distributed_tpu.graph.node import get_node
+        from comfyui_distributed_tpu.models.registry import ModelRegistry
+
+        from comfyui_distributed_tpu.parallel import build_mesh
+
+        bundle = ModelRegistry().get("wan-tiny-3d")
+        ctx, pooled = bundle.text_encoder.encode(["node clip"])
+        (images,) = get_node("TPUTxt2Video")().execute(
+            bundle, {"context": ctx, "pooled": pooled},
+            seed=3, frames=5, steps=1, width=16, height=16,
+            mesh=build_mesh({"dp": 1}))
+        # flattened to an IMAGE batch of 5 pixel frames
+        assert np.asarray(images).shape == (5, 16, 16, 3)
+
+
+class TestI2V:
+    def test_condition_shapes_and_mask(self):
+        from comfyui_distributed_tpu.diffusion.pipeline_video import (
+            VideoPipeline, VideoSpec)
+        from comfyui_distributed_tpu.models.registry import ModelRegistry
+
+        bundle = ModelRegistry().get("wan-i2v-tiny")
+        spec = VideoSpec(frames=5, height=16, width=16, steps=1)
+        img = jnp.ones((1, 16, 16, 3)) * 0.5
+        y, mask = bundle.pipeline.i2v_condition(img, spec)
+        assert y.shape == (1, 3, 8, 8, 4)        # 3 latent frames
+        assert mask.shape == (1, 3, 8, 8, 2)     # 2× temporal → 2 channels
+        # published WAN polarity: 1 marks GIVEN content (first frame),
+        # 0 marks frames to generate
+        assert float(mask[:, 0].min()) == 1.0
+        assert float(mask[:, 1:].max()) == 0.0
+
+    def test_generate_i2v_shapes_and_determinism(self):
+        from comfyui_distributed_tpu.diffusion.pipeline_video import VideoSpec
+        from comfyui_distributed_tpu.models.registry import ModelRegistry
+        from comfyui_distributed_tpu.parallel import build_mesh
+
+        bundle = ModelRegistry().get("wan-i2v-tiny")
+        spec = VideoSpec(frames=5, height=16, width=16, steps=1)
+        mesh = build_mesh({"dp": 1})
+        ctx, pooled = bundle.text_encoder.encode(["animate"])
+        img_a = jnp.ones((1, 16, 16, 3)) * 0.2
+        img_b = jnp.ones((1, 16, 16, 3)) * 0.9
+        va = bundle.pipeline.generate_i2v(mesh, spec, 0, img_a, ctx, pooled)
+        assert va.shape == (1, 5, 16, 16, 3)
+        va2 = bundle.pipeline.generate_i2v(mesh, spec, 0, img_a, ctx, pooled)
+        np.testing.assert_allclose(np.asarray(va), np.asarray(va2))
+        vb = bundle.pipeline.generate_i2v(mesh, spec, 0, img_b, ctx, pooled)
+        assert not np.allclose(np.asarray(va), np.asarray(vb))
+
+    def test_node_rejects_t2v_architecture(self):
+        import pytest
+
+        from comfyui_distributed_tpu.graph.node import get_node
+        from comfyui_distributed_tpu.models.registry import ModelRegistry
+        from comfyui_distributed_tpu.utils.exceptions import ValidationError
+
+        bundle = ModelRegistry().get("wan-tiny-3d")   # in == out: t2v
+        ctx, pooled = bundle.text_encoder.encode(["x"])
+        with pytest.raises(ValidationError, match="t2v architecture"):
+            get_node("TPUImg2Video")().execute(
+                bundle, {"context": ctx, "pooled": pooled},
+                np.zeros((1, 16, 16, 3), np.float32),
+                seed=0, frames=5, steps=1)
+
+
+class TestSingleImageAdapter:
+    def test_rank4_encode_decode(self):
+        """VAEEncode/VAEDecode nodes pass [B,H,W,C]: the 3D VAE treats it
+        as a 1-frame video and squeezes the frame axis back out."""
+        vae = WanVAE3D(TINY).init(jax.random.key(0), frames=1,
+                                  image_hw=(8, 8))
+        img = jnp.ones((2, 8, 8, 3)) * 0.4
+        lat = vae.encode(img)
+        assert lat.shape == (2, 4, 4, TINY.latent_channels)
+        out = vae.decode(lat)
+        assert out.shape == (2, 8, 8, 3)
+
+    def test_vae_nodes_on_3d_bundle(self):
+        from comfyui_distributed_tpu.graph.node import get_node
+        from comfyui_distributed_tpu.models.registry import ModelRegistry
+
+        bundle = ModelRegistry().get("wan-tiny-3d")
+        (latent,) = get_node("VAEEncode")().execute(
+            np.full((1, 16, 16, 3), 0.5, np.float32), bundle.pipeline.vae)
+        (img,) = get_node("VAEDecode")().execute(latent, bundle.pipeline.vae)
+        assert np.asarray(img).shape == (1, 16, 16, 3)
+
+    def test_vae_file_targeted_error(self):
+        import pytest
+
+        from comfyui_distributed_tpu.models.convert import ConversionError
+        from comfyui_distributed_tpu.models.registry import ModelRegistry
+
+        bundle = ModelRegistry().get("wan-tiny-3d")
+        with pytest.raises(ConversionError, match="not yet wired"):
+            bundle.load_vae_file("/nonexistent.safetensors")

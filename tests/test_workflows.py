@@ -26,7 +26,7 @@ class TestShippedWorkflows:
     def test_all_present(self):
         names = {p.stem for p in WORKFLOWS}
         assert {"distributed-txt2img", "distributed-upscale",
-                "flux-txt2img", "wan-t2v", "video-upscale",
+                "flux-txt2img", "wan-t2v", "wan-i2v", "video-upscale",
                 "controlnet-tile-upscale"} <= names
 
     @pytest.mark.parametrize("path", WORKFLOWS, ids=lambda p: p.stem)
@@ -123,3 +123,18 @@ class TestSmokeExecution:
         # dp videos × 5 padded frames each, flattened to an IMAGE batch
         assert collected.shape[0] == len(jax.devices()) * 5
         assert collected.shape[3] == 3
+
+    def test_wan_i2v_workflow_executes(self, tmp_path):
+        from PIL import Image
+
+        Image.new("RGB", (16, 16), (90, 60, 120)).save(
+            tmp_path / "start_frame.png")
+        prompt = strip_meta(load(Path("workflows/wan-i2v.json")))
+        prompt = _swap_model(prompt, "wan-i2v-tiny")
+        prompt = _shrink(prompt, frames=5, steps=2)
+        prompt["8"]["inputs"]["output_dir"] = str(tmp_path / "out")
+        prompt["9"]["inputs"]["output_dir"] = str(tmp_path / "out")
+        outputs = GraphExecutor({"input_dir": str(tmp_path)}).execute(prompt)
+        collected = np.asarray(outputs["6"][0])
+        assert collected.shape[0] == len(jax.devices()) * 5
+        assert collected.shape[1:] == (16, 16, 3)
