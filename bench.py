@@ -83,6 +83,19 @@ def _enable_compile_cache() -> None:
         print(f"[bench] compile cache unavailable: {e}", file=sys.stderr)
 
 
+
+def _timed_runs(run_once, n_runs: int) -> tuple[list, float]:
+    """Shared timing harness: run n times, return (sorted times, median)
+    — one place for the measurement methodology (BASELINE protocol)."""
+    times = []
+    for i in range(n_runs):
+        t0 = time.perf_counter()
+        run_once(i)
+        times.append(time.perf_counter() - t0)
+    times.sort()
+    return times, times[len(times) // 2]
+
+
 def run_benchmark(steps: int, runs: int | None, force_cpu: bool) -> dict:
     """The actual measurement (single process, current JAX backend)."""
     import jax
@@ -157,13 +170,9 @@ def run_benchmark(steps: int, runs: int | None, force_cpu: bool) -> dict:
 
     # timed runs (median of 5 per protocol in BASELINE.md; 3 on cpu)
     runs = runs or (5 if on_accel else 3)
-    times = []
-    for i in range(runs):
-        t0 = time.perf_counter()
-        jax.block_until_ready(compiled(jax.random.key(i), *args[1:]))
-        times.append(time.perf_counter() - t0)
-    times.sort()
-    median = times[len(times) // 2]
+    times, median = _timed_runs(
+        lambda i: jax.block_until_ready(compiled(jax.random.key(i),
+                                                 *args[1:])), runs)
     images = n_dev * spec.per_device_batch
     ips = images / median
 
@@ -269,13 +278,9 @@ def run_usdu_benchmark(steps: int, runs: int | None, force_cpu: bool) -> dict:
     compile_s = time.perf_counter() - t0
 
     runs = runs or (3 if on_accel else 2)
-    times = []
-    for i in range(runs):
-        t0 = time.perf_counter()
-        jax.block_until_ready(ups.upscale(mesh, image, spec, i, ctx, unc))
-        times.append(time.perf_counter() - t0)
-    times.sort()
-    median = times[len(times) // 2]
+    times, median = _timed_runs(
+        lambda i: jax.block_until_ready(
+            ups.upscale(mesh, image, spec, i, ctx, unc)), runs)
     grid = ups.grid_for(src_hw[0], src_hw[1], spec)
 
     return {
@@ -296,8 +301,151 @@ def run_usdu_benchmark(steps: int, runs: int | None, force_cpu: bool) -> dict:
     }
 
 
+def run_flux_benchmark(steps: int, runs: int | None, force_cpu: bool) -> dict:
+    """BASELINE row 3: FLUX-class flow txt2img 1024² (per-chip; pod
+    scaling multiplies by dp width). Tiny preset on CPU."""
+    import jax
+    import jax.numpy as jnp
+
+    if force_cpu:
+        jax.config.update("jax_platforms", "cpu")
+    _enable_compile_cache()
+    platform = jax.devices()[0].platform
+    on_accel = platform not in ("cpu",)
+
+    from comfyui_distributed_tpu.diffusion.pipeline_flow import (
+        FlowPipeline, FlowSpec)
+    from comfyui_distributed_tpu.models.dit import DiTConfig, init_dit
+    from comfyui_distributed_tpu.models.vae import AutoencoderKL, VAEConfig
+    from comfyui_distributed_tpu.parallel import build_mesh
+
+    if on_accel:
+        cfg = DiTConfig.flux()
+        vae_cfg = VAEConfig(latent_channels=16, scaling_factor=0.3611,
+                            shift_factor=0.1159)
+        hw, lat_hw, ctx_len = (1024, 1024), (128, 128), 512
+    else:
+        cfg = DiTConfig.tiny(pos_embed="rope")
+        vae_cfg = VAEConfig.tiny()
+        hw, lat_hw, ctx_len = (32, 32), (16, 16), 16
+
+    model, params = init_dit(cfg, jax.random.key(0), sample_hw=lat_hw,
+                             context_len=ctx_len)
+    vae = AutoencoderKL(vae_cfg).init(
+        jax.random.key(1),
+        image_hw=(lat_hw[0] * vae_cfg.downscale,
+                  lat_hw[1] * vae_cfg.downscale))
+    pipe = FlowPipeline(model, params, vae)
+    n_dev = len(jax.devices())
+    mesh = build_mesh({"dp": n_dev})
+    spec = FlowSpec(height=hw[0], width=hw[1], steps=steps)
+    ctx = jnp.zeros((1, ctx_len, cfg.context_dim))
+    pooled = jnp.zeros((1, cfg.pooled_dim))
+
+    fn = pipe.generate_fn(mesh, spec)
+    t0 = time.perf_counter()
+    jax.block_until_ready(fn(jax.random.key(0), ctx, pooled))
+    compile_s = time.perf_counter() - t0
+
+    runs = runs or (5 if on_accel else 3)
+    times, median = _timed_runs(
+        lambda i: jax.block_until_ready(
+            fn(jax.random.key(i + 1), ctx, pooled)), runs)
+    return {
+        "metric": (f"flux_1024_{steps}step_images_per_sec" if on_accel
+                   else f"flux_tiny_{steps}step_images_per_sec_cpu"),
+        "value": round(n_dev / median, 4),
+        "unit": "images/sec",
+        "vs_baseline": 1.0,
+        "vs_baseline_note": "reference publishes no numbers",
+        "platform": platform,
+        "device_kind": jax.devices()[0].device_kind,
+        "devices": n_dev, "steps": steps,
+        "median_image_latency_s": round(median, 3),
+        "compile_s": round(compile_s, 1),
+        "run_times_s": [round(t, 3) for t in times],
+    }
+
+
+def run_wan_benchmark(steps: int, runs: int | None, force_cpu: bool) -> dict:
+    """BASELINE row 4: WAN t2v end-to-end (exact architecture over the 3D
+    causal VAE; 33 frames 480×832 on accel, tiny shapes on CPU)."""
+    import jax
+    import jax.numpy as jnp
+
+    if force_cpu:
+        jax.config.update("jax_platforms", "cpu")
+    _enable_compile_cache()
+    platform = jax.devices()[0].platform
+    on_accel = platform not in ("cpu",)
+
+    from comfyui_distributed_tpu.diffusion.pipeline_video import (
+        VideoPipeline, VideoSpec)
+    from comfyui_distributed_tpu.models.wan import WanConfig, init_wan
+    from comfyui_distributed_tpu.models.wan_vae import (WanVAE3D,
+                                                        WanVAEConfig)
+    from comfyui_distributed_tpu.parallel import build_mesh
+
+    if on_accel:
+        # 1.3B-class config fits one v5e chip; 14B needs tp over a pod
+        cfg, vae_cfg = WanConfig.wan_1_3b(), WanVAEConfig.wan()
+        spec = VideoSpec(frames=33, height=480, width=832, steps=steps)
+        ctx_len = 512
+    else:
+        cfg, vae_cfg = WanConfig.tiny(), WanVAEConfig.tiny()
+        spec = VideoSpec(frames=5, height=16, width=16,
+                         steps=min(steps, 2))
+        ctx_len = 16
+
+    n_dev = len(jax.devices())
+    mesh = build_mesh({"dp": n_dev})
+    vae = WanVAE3D(vae_cfg).init(jax.random.key(1), frames=5,
+                                 image_hw=(vae_cfg.downscale * 4,) * 2)
+    f_lat = vae_cfg.latent_frames(spec.padded_frames)
+    model, params = init_wan(
+        cfg, jax.random.key(0),
+        sample_fhw=(f_lat, spec.height // vae_cfg.downscale,
+                    spec.width // vae_cfg.downscale),
+        context_len=ctx_len)
+    pipe = VideoPipeline(model, params, vae)
+    ctx = jnp.zeros((1, ctx_len, cfg.text_dim))
+    pooled = jnp.zeros((1, 16))
+
+    fn = pipe.generate_fn(mesh, spec)
+    t0 = time.perf_counter()
+    jax.block_until_ready(fn(jax.random.key(0), ctx, pooled))
+    compile_s = time.perf_counter() - t0
+
+    runs = runs or (3 if on_accel else 2)
+    times, median = _timed_runs(
+        lambda i: jax.block_until_ready(
+            fn(jax.random.key(i + 1), ctx, pooled)), runs)
+    return {
+        "metric": ("wan_t2v_480p_33f_wall_clock_s" if on_accel
+                   else "wan_tiny_t2v_wall_clock_s_cpu"),
+        "value": round(median, 3),
+        "unit": "seconds",
+        "vs_baseline": 1.0,
+        "vs_baseline_note": "reference publishes no numbers",
+        "platform": platform,
+        "device_kind": jax.devices()[0].device_kind,
+        "devices": n_dev, "steps": spec.steps,
+        "frames": spec.padded_frames, "latent_frames": f_lat,
+        "compile_s": round(compile_s, 1),
+        "run_times_s": [round(t, 3) for t in times],
+    }
+
+
+_WORKLOADS = {
+    "txt2img": run_benchmark,
+    "usdu": run_usdu_benchmark,
+    "flux": run_flux_benchmark,
+    "wan": run_wan_benchmark,
+}
+
+
 def _workload_fn(workload: str):
-    return run_usdu_benchmark if workload == "usdu" else run_benchmark
+    return _WORKLOADS.get(workload, run_benchmark)
 
 
 def _inner_main(cli) -> None:
@@ -411,9 +559,12 @@ def main() -> None:
                         help="also write the JSON result to this path")
     parser.add_argument("--steps", type=int, default=30)
     parser.add_argument("--runs", type=int, default=None)
-    parser.add_argument("--workload", choices=["txt2img", "usdu"],
+    parser.add_argument("--workload",
+                        choices=["txt2img", "usdu", "flux", "wan"],
                         default="txt2img",
-                        help="txt2img (images/sec) or usdu (4K upscale wall-clock)")
+                        help="txt2img (SDXL images/sec), usdu (4K upscale "
+                             "wall-clock), flux (flow images/sec), wan "
+                             "(t2v wall-clock)")
     parser.add_argument("--inner", action="store_true",
                         help="(internal) run the measurement in-process")
     cli = parser.parse_args()

@@ -196,6 +196,50 @@ class VideoPipeline:
         return self.generate_i2v_fn(mesh, spec)(
             jax.random.key(seed), context, pooled, y, mask)
 
+    def generate_i2v_frames_fn(self, mesh: Mesh, spec: VideoSpec,
+                               axis: str = constants.AXIS_SEQUENCE):
+        """ONE i2v sample with latent frame blocks sharded over ``axis``:
+        ring attention spans the full sequence; each shard sees its own
+        slice of the conditioning latents/mask (frame-aligned, so the
+        concat happens shard-locally with no collective)."""
+        n_sh = mesh.shape[axis]
+        F = self.latent_frames(spec)
+        if F % n_sh:
+            raise ValueError(
+                f"latent frame count {F} must divide over {n_sh} shards")
+        sigmas = sigmas_flow(spec.steps, spec.shift)
+        ds = self.vae.config.downscale
+        lat_h, lat_w = spec.height // ds, spec.width // ds
+        c = getattr(self.dit.config, "out_channels",
+                    self.dit.config.in_channels)
+        per = F // n_sh
+
+        def per_shard(key, context, pooled, y_sh, mask_sh):
+            idx = jax.lax.axis_index(axis)
+            full = jax.random.normal(key, (1, F, lat_h, lat_w, c),
+                                     jnp.float32)
+            x = jax.lax.dynamic_slice_in_dim(full, idx * per, per, axis=1)
+            den = self._denoiser_i2v(context, pooled, y_sh, mask_sh,
+                                     spec.guidance_scale, sp_axis=axis)
+            # per-shard sampler key: ancestral samplers must inject
+            # DIFFERENT noise into each frame block (deterministic
+            # samplers ignore the key, so sp==unsharded still holds)
+            return sample(spec.sampler, den, x, sigmas,
+                          key=jax.random.fold_in(key, idx))
+
+        f = jax.shard_map(
+            per_shard, mesh=mesh,
+            in_specs=(P(), P(None, None, None), P(None, None),
+                      P(None, axis), P(None, axis)),
+            out_specs=P(None, axis, None, None, None),
+            check_vma=False,
+        )
+
+        def run(key, context, pooled, y, mask):
+            return self.decode_frames(f(key, context, pooled, y, mask))
+
+        return jax.jit(run)
+
     def generate_frames_fn(self, mesh: Mesh, spec: VideoSpec,
                            axis: str = constants.AXIS_SEQUENCE):
         """ONE video, frame blocks sharded over ``axis``; joint ring
@@ -220,7 +264,10 @@ class VideoPipeline:
             x = jax.lax.dynamic_slice_in_dim(full, idx * per, per, axis=1)
             den = self._denoiser(context, pooled, spec.guidance_scale,
                                  sp_axis=axis)
-            return sample(spec.sampler, den, x, sigmas, key=key)
+            # fold the shard index so ancestral samplers draw distinct
+            # noise per frame block (deterministic samplers ignore it)
+            return sample(spec.sampler, den, x, sigmas,
+                          key=jax.random.fold_in(key, idx))
 
         f = jax.shard_map(
             per_shard, mesh=mesh,

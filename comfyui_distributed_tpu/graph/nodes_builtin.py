@@ -969,6 +969,23 @@ class TPUFlowTxt2Img(NodeDef):
         return (images,)
 
 
+def _video_pooled_default(model, positive):
+    """Shared video-node prologue: real-WAN configs have no pooled-vector
+    input (the model ignores it); any width satisfies the signature."""
+    pooled = positive.get("pooled")
+    if pooled is None:
+        pooled = jnp.zeros(
+            (1, getattr(model.pipeline.dit.config, "pooled_dim", 768)))
+    return pooled
+
+
+def _flatten_video_batch(videos):
+    """[B,F,H,W,3] → IMAGE batch [B·F,H,W,3] (ImageBatchDivider splits it
+    back per video/chunk — reference workflow parity)."""
+    B, F = videos.shape[:2]
+    return videos.reshape((B * F,) + videos.shape[2:])
+
+
 @register_node("TPUTxt2Video")
 class TPUTxt2Video(NodeDef):
     """Sharded WAN-class t2v sampler (reference parity: the WAN t2v/i2v
@@ -1003,12 +1020,7 @@ class TPUTxt2Video(NodeDef):
                          width=int(width), steps=int(steps),
                          shift=float(shift), guidance_scale=float(cfg))
         ctx = positive["context"]
-        pooled = positive.get("pooled")
-        if pooled is None:
-            # real-WAN configs have no pooled-vector input (the model
-            # ignores it); any width satisfies the call signature
-            pooled = jnp.zeros(
-                (1, getattr(model.pipeline.dit.config, "pooled_dim", 768)))
+        pooled = _video_pooled_default(model, positive)
         key = jax.random.key(int(seed))
         if mode == "sp":
             if "sp" not in mesh.shape:
@@ -1018,10 +1030,7 @@ class TPUTxt2Video(NodeDef):
                 key, ctx, pooled)
         else:
             videos = model.pipeline.generate(mesh, spec, int(seed), ctx, pooled)
-        # [B,F,H,W,3] → IMAGE batch [B·F,H,W,3] (ImageBatchDivider splits
-        # it back per video/chunk, reference workflow parity)
-        B, F = videos.shape[:2]
-        return (videos.reshape((B * F,) + videos.shape[2:]),)
+        return (_flatten_video_batch(videos),)
 
 
 @register_node("TPUImg2Video")
@@ -1035,13 +1044,13 @@ class TPUImg2Video(NodeDef):
         "model": "MODEL", "positive": "CONDITIONING", "image": "IMAGE",
         "seed": "INT", "frames": "INT", "steps": "INT",
     }
-    OPTIONAL = {"cfg": "FLOAT", "shift": "FLOAT"}
+    OPTIONAL = {"cfg": "FLOAT", "shift": "FLOAT", "mode": "STRING"}
     HIDDEN = {"mesh": "*"}
     RETURNS = ("IMAGE",)
 
     def execute(self, model, positive, image, seed: int, frames: int,
                 steps: int, cfg: float = 1.0, shift: float = 3.0,
-                mesh=None, **_):
+                mode: str = "dp", mesh=None, **_):
         from ..diffusion.pipeline_video import VideoSpec
         from ..parallel.mesh import build_mesh
         from ..utils.exceptions import ValidationError
@@ -1063,14 +1072,18 @@ class TPUImg2Video(NodeDef):
                          steps=int(steps), shift=float(shift),
                          guidance_scale=float(cfg))
         ctx = positive["context"]
-        pooled = positive.get("pooled")
-        if pooled is None:
-            pooled = jnp.zeros(
-                (1, getattr(model.pipeline.dit.config, "pooled_dim", 768)))
-        videos = model.pipeline.generate_i2v(mesh, spec, int(seed),
-                                             image[:1], ctx, pooled)
-        B, F = videos.shape[:2]
-        return (videos.reshape((B * F,) + videos.shape[2:]),)
+        pooled = _video_pooled_default(model, positive)
+        if mode == "sp":
+            if "sp" not in mesh.shape:
+                mesh = build_mesh({"sp": mesh.devices.size},
+                                  list(mesh.devices.flat))
+            y, m = model.pipeline.i2v_condition(image[:1], spec)
+            videos = model.pipeline.generate_i2v_frames_fn(mesh, spec)(
+                jax.random.key(int(seed)), ctx, pooled, y, m)
+        else:
+            videos = model.pipeline.generate_i2v(mesh, spec, int(seed),
+                                                 image[:1], ctx, pooled)
+        return (_flatten_video_batch(videos),)
 
 
 @register_node("VAEEncode")

@@ -85,6 +85,8 @@ def main() -> None:
     targets = [
         ("txt2img", os.path.join(bdir, f"r{cli.round:02d}_tpu.json")),
         ("usdu", os.path.join(bdir, f"r{cli.round:02d}_tpu_usdu.json")),
+        ("flux", os.path.join(bdir, f"r{cli.round:02d}_tpu_flux.json")),
+        ("wan", os.path.join(bdir, f"r{cli.round:02d}_tpu_wan.json")),
     ]
     start = time.monotonic()
     while time.monotonic() - start < cli.budget_s:

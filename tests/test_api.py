@@ -451,3 +451,20 @@ class TestTwoControllerE2E:
             await worker_server.close()
             await master_server.close()
         run(body())
+
+
+def test_api_doc_covers_routes():
+    """docs/api.md must mention every /distributed route (drift guard,
+    same pattern as the nodes-doc guard)."""
+    from pathlib import Path
+
+    controller = Controller()
+    app = create_app(controller)
+    doc = (Path(__file__).resolve().parent.parent
+           / "docs" / "api.md").read_text()
+    missing = sorted({
+        r.resource.canonical for r in app.router.routes()
+        if r.resource is not None
+        and r.resource.canonical.startswith("/distributed")
+        and r.resource.canonical not in doc})
+    assert not missing, f"docs/api.md missing routes: {missing}"

@@ -188,3 +188,28 @@ class TestSingleImageAdapter:
         bundle = ModelRegistry().get("wan-tiny-3d")
         with pytest.raises(ConversionError, match="not yet wired"):
             bundle.load_vae_file("/nonexistent.safetensors")
+
+    def test_i2v_frame_sharded_matches_unsharded(self):
+        """sp i2v over 3 frame shards reproduces the 1-shard run exactly
+        (ring attention + shard-local conditioning slices; same RNG
+        convention — the dp path uses per-participant key folding, so dp
+        and sp are intentionally different samples)."""
+        from comfyui_distributed_tpu.diffusion.pipeline_video import VideoSpec
+        from comfyui_distributed_tpu.models.registry import ModelRegistry
+        from comfyui_distributed_tpu.parallel import build_mesh
+
+        bundle = ModelRegistry().get("wan-i2v-tiny")
+        spec = VideoSpec(frames=5, height=16, width=16, steps=1)
+        ctx, pooled = bundle.text_encoder.encode(["animate"])
+        img = jnp.ones((1, 16, 16, 3)) * 0.3
+        y, m = bundle.pipeline.i2v_condition(img, spec)
+
+        ref = bundle.pipeline.generate_i2v_frames_fn(
+            build_mesh({"sp": 1}), spec)(
+            jax.random.key(0), ctx, pooled, y, m)
+        sp = bundle.pipeline.generate_i2v_frames_fn(
+            build_mesh({"sp": 3}), spec)(
+            jax.random.key(0), ctx, pooled, y, m)
+        assert sp.shape == (1, 5, 16, 16, 3)
+        np.testing.assert_allclose(np.asarray(sp), np.asarray(ref),
+                                   atol=2e-4, rtol=2e-3)
