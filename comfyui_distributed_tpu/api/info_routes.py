@@ -164,6 +164,29 @@ def register(router, controller) -> None:
             for pid, h in recent
         ]})
 
+    async def sampling_progress(request):
+        """Per-step progress of an in-flight sampling run (streamed out of
+        the compiled scan via jax.debug.callback — the standalone
+        equivalent of ComfyUI's executor progress hooks)."""
+        pid = request.match_info["prompt_id"]
+        snap = controller.progress.snapshot(pid)
+        if snap is None:
+            return web.json_response({"error": "unknown prompt"}, status=404)
+        return web.json_response(snap)
+
+    async def sampling_preview(request):
+        """Live latent preview (linear latent→RGB approximation) of an
+        in-flight run; 404 until the first step reports."""
+        pid = request.match_info["prompt_id"]
+        try:
+            shard = int(request.query.get("shard", "0"))
+        except ValueError:
+            shard = 0
+        png = controller.progress.preview_png(pid, shard)
+        if png is None:
+            return web.json_response({"error": "no preview yet"}, status=404)
+        return web.Response(body=png, content_type="image/png")
+
     # --- shipped workflows --------------------------------------------------
     def _workflows_dir() -> Path:
         import os
@@ -205,3 +228,5 @@ def register(router, controller) -> None:
     router.add_post("/distributed/profile/stop", profile_stop)
     router.add_get("/distributed/memory_stats", memory_stats)
     router.add_get("/distributed/step_times", step_times)
+    router.add_get("/distributed/progress/{prompt_id}", sampling_progress)
+    router.add_get("/distributed/preview/{prompt_id}", sampling_preview)

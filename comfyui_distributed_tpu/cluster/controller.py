@@ -45,6 +45,8 @@ class Controller:
         self._registry = None
         self.worker_id = os.environ.get("CDT_WORKER_ID", "")
         self.worker_index = int(os.environ.get("CDT_WORKER_INDEX", "0") or 0)
+        from .progress import ProgressTracker
+        self.progress = ProgressTracker()
 
     def load_config(self) -> dict:
         return load_config(self.config_path)
@@ -91,6 +93,7 @@ class Controller:
             "is_worker": self.is_worker,
             "worker_id": self.worker_id,
             "worker_index": self.worker_index,
+            "progress_tracker": self.progress,
         }
         if self.bridge is not None:
             ctx["collector_bridge"] = self.bridge

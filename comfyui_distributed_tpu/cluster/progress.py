@@ -1,0 +1,163 @@
+"""Host-side sampling-progress tracker: step counts + live latent previews.
+
+Consumes the ``jax.debug.callback`` events emitted by
+``diffusion/progress.wrap_denoiser`` and serves them to the control plane
+(``/distributed/progress/{prompt_id}``, ``/distributed/preview/{prompt_id}``)
+— the standalone equivalent of the per-step progress bar + live preview the
+reference inherits from ComfyUI's executor hooks.
+
+Events are unordered (async host effects): ``sigma`` — strictly decreasing
+over the ladder — orders previews; the step *count* is simply the number of
+events seen from shard 0 (order-independent). Previews are kept per shard
+so a dp fan-out can show every participant's image forming.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import OrderedDict
+from typing import Optional
+
+import numpy as np
+
+from ..diffusion import progress as _events
+from ..utils.image import encode_png
+
+# Approximate linear latent→RGB maps for previews (rows = latent channels,
+# cols = RGB). These are the community-standard preview approximations for
+# 4-channel VP latents; they need only be *recognizable*, not exact — the
+# real decode happens in the VAE at the end of the run.
+_RGB_4CH = np.array(
+    [[0.298, 0.207, 0.208],
+     [0.187, 0.286, 0.173],
+     [-0.158, 0.189, 0.264],
+     [-0.184, -0.271, -0.473]], dtype=np.float32)
+
+
+def latent_to_rgb(latent: np.ndarray) -> np.ndarray:
+    """[H,W,C] latent → [H,W,3] float image in [0,1] (preview quality).
+
+    4-channel latents go through the standard linear approximation;
+    anything else (16-ch FLUX/WAN, video frames) takes the first three
+    channels. Output is mean/std normalized so previews stay visible at
+    any sigma scale."""
+    lat = np.asarray(latent, dtype=np.float32)
+    if lat.ndim == 4:          # video [F,H,W,C] → middle frame
+        lat = lat[lat.shape[0] // 2]
+    if lat.shape[-1] == _RGB_4CH.shape[0]:
+        rgb = lat @ _RGB_4CH
+    else:
+        rgb = lat[..., :3]
+    std = float(rgb.std()) or 1.0
+    rgb = (rgb - float(rgb.mean())) / (3.0 * std) + 0.5
+    return np.clip(rgb, 0.0, 1.0)
+
+
+class _Job:
+    __slots__ = ("prompt_id", "total", "calls_seen", "previews",
+                 "preview_sigmas", "started", "updated", "done", "failed")
+
+    def __init__(self, prompt_id: str, total: int):
+        self.prompt_id = prompt_id
+        self.total = max(1, int(total))
+        self.calls_seen = 0
+        self.previews: dict[int, np.ndarray] = {}
+        self.preview_sigmas: dict[int, float] = {}
+        self.started = time.time()
+        self.updated = self.started
+        self.done = False
+        self.failed = False
+
+
+class ProgressTracker:
+    """Registry of in-flight sampling runs, keyed by token (traced into
+    the compiled program) and by prompt id (control-plane handle)."""
+
+    def __init__(self, keep: int = 16):
+        self._keep = keep
+        self._jobs: "OrderedDict[int, _Job]" = OrderedDict()
+        self._by_prompt: dict[str, int] = {}
+        self._next_token = 1
+        self._lock = threading.Lock()
+        _events.set_sink(self._on_event)
+
+    # --- producer side (node layer) ------------------------------------
+
+    def start(self, prompt_id: str, total_calls: int) -> int:
+        """Allocate a token for a run about to execute; returns the int32
+        scalar to thread into the compiled program."""
+        with self._lock:
+            token = self._next_token
+            self._next_token += 1
+            job = _Job(prompt_id, total_calls)
+            self._jobs[token] = job
+            self._by_prompt[prompt_id] = token
+            while len(self._jobs) > self._keep:
+                old_token, old = self._jobs.popitem(last=False)
+                # a newer token may have reused the same prompt id (one
+                # prompt, many sampler nodes) — only drop the mapping if
+                # it still points at the evicted token
+                if self._by_prompt.get(old.prompt_id) == old_token:
+                    self._by_prompt.pop(old.prompt_id, None)
+        return token
+
+    def finish(self, prompt_id: str, failed: bool = False) -> None:
+        """Mark a run finished. ``failed=True`` freezes progress where it
+        stopped instead of fabricating 100% — an OOM at step 5/30 must not
+        render as "done (30 steps)"."""
+        with self._lock:
+            token = self._by_prompt.get(prompt_id)
+            job = self._jobs.get(token) if token is not None else None
+            if job is not None:
+                job.done = True
+                job.failed = failed
+                if not failed:
+                    job.calls_seen = job.total
+                job.updated = time.time()
+
+    # --- event sink (jax.debug.callback, runtime threads) ---------------
+
+    def _on_event(self, token: int, shard: int, sigma: float,
+                  x0: np.ndarray) -> None:
+        with self._lock:
+            job = self._jobs.get(token)
+            if job is None or job.done:
+                return
+            job.updated = time.time()
+            if shard == 0:
+                job.calls_seen += 1
+            prev = job.preview_sigmas.get(shard)
+            if prev is None or sigma <= prev:
+                job.preview_sigmas[shard] = sigma
+                job.previews[shard] = x0[0] if x0.ndim >= 4 else x0
+
+    # --- consumer side (routes / dashboard) -----------------------------
+
+    def snapshot(self, prompt_id: str) -> Optional[dict]:
+        with self._lock:
+            token = self._by_prompt.get(prompt_id)
+            job = self._jobs.get(token) if token is not None else None
+            if job is None:
+                return None
+            frac = min(1.0, job.calls_seen / job.total)
+            return {
+                "prompt_id": prompt_id,
+                "step": job.calls_seen,
+                "total": job.total,
+                "fraction": round(frac, 4),
+                "done": job.done,
+                "failed": job.failed,
+                "shards_reporting": len(job.previews),
+                "updated_s_ago": round(time.time() - job.updated, 2),
+            }
+
+    def preview_png(self, prompt_id: str, shard: int = 0) -> Optional[bytes]:
+        with self._lock:
+            token = self._by_prompt.get(prompt_id)
+            job = self._jobs.get(token) if token is not None else None
+            lat = None if job is None else job.previews.get(shard)
+            if lat is None:
+                return None
+            lat = np.array(lat)
+        return encode_png(latent_to_rgb(lat))

@@ -120,6 +120,7 @@ class PromptQueue:
             try:
                 context = dict(self._context_factory())
                 context["interrupt_event"] = self._interrupt
+                context["prompt_id"] = job.prompt_id
                 executor = GraphExecutor(context)
                 outputs = await loop.run_in_executor(
                     self._pool, executor.execute, job.prompt

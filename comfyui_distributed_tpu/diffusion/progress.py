@@ -1,0 +1,80 @@
+"""In-flight sampling progress: per-step x0 streaming out of compiled code.
+
+The reference inherits per-step progress bars and live latent previews
+from ComfyUI's executor hooks (its UI polls them; SURVEY "external
+substrate"). In a jit-compiled world the sampler scan is one XLA program,
+so progress must stream out *through* the compiled boundary:
+``wrap_denoiser`` interposes on the (guided) denoiser and emits
+``jax.debug.callback`` effects carrying ``(token, shard, sigma, x0)``.
+Callbacks are asynchronous host effects — the TPU does not stall on them —
+and the payload is one latent (`x0[:1]`, ~256 KB for SDXL), so the
+overhead is negligible against a UNet step.
+
+``token`` is a *traced* int32 scalar, so one compiled program serves every
+job: the host allocates a fresh token per run and the callback routes on
+its runtime value. Callbacks are unordered; ``sigma`` (strictly decreasing
+over the ladder) is the ordering key the sink uses to keep the newest
+preview and a monotonic step count.
+
+This module is deliberately free of cluster/HTTP imports: the sink is
+injected (``set_sink``) by ``cluster/progress.ProgressTracker``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import numpy as np
+
+# sink(token:int, shard:int, sigma:float, x0:np.ndarray) — installed by the
+# cluster-side tracker; None = progress events are dropped on the floor.
+_SINK: Optional[Callable] = None
+
+
+def set_sink(fn: Optional[Callable]) -> None:
+    global _SINK
+    _SINK = fn
+
+
+def _dispatch(token, shard, sigma, x0) -> None:
+    sink = _SINK
+    if sink is not None:
+        try:
+            sink(int(token), int(shard), float(sigma), np.asarray(x0))
+        except Exception:  # a broken UI consumer must never kill a job
+            pass
+
+
+# model calls the wrapped (guided) denoiser makes per sampler step; CFG is
+# batch-concatenated into one call (guidance.cfg_denoiser) so it doesn't
+# multiply. Second-order samplers call twice (their final Euler fallback
+# step calls once — the count is an upper bound; consumers clamp to 1.0).
+_CALLS_PER_STEP = {
+    "heun": 2,
+    "dpmpp_sde": 2,
+    "dpmpp_2m_sde": 1,
+}
+
+
+def calls_per_step(sampler: str) -> int:
+    return _CALLS_PER_STEP.get(sampler, 1)
+
+
+def total_calls(sampler: str, steps: int) -> int:
+    return calls_per_step(sampler) * steps
+
+
+def wrap_denoiser(denoise, token, shard_index):
+    """Interpose on a denoiser: after every model call, stream the current
+    x0 estimate (first batch element) to the host sink. ``token`` may be a
+    traced scalar; ``shard_index`` a traced ``axis_index`` under
+    ``shard_map`` (each shard reports itself — the sink keys previews by
+    shard and counts steps on shard 0 only)."""
+
+    def wrapped(x, sigma):
+        x0 = denoise(x, sigma)
+        jax.debug.callback(_dispatch, token, shard_index, sigma, x0[:1])
+        return x0
+
+    return wrapped

@@ -85,6 +85,8 @@ export const api = {
   // observability
   memoryStats: () => request("/distributed/memory_stats"),
   stepTimes: () => request("/distributed/step_times"),
+  progress: (promptId) => request(`/distributed/progress/${encodeURIComponent(promptId)}`, { retries: 0 }),
+  previewUrl: (promptId, shard = 0) => `/distributed/preview/${encodeURIComponent(promptId)}?shard=${shard}&t=${Date.now()}`,
   profileStart: (out) => request("/distributed/profile/start", { method: "POST", body: out ? { out } : {}, retries: 0 }),
   profileStop: () => request("/distributed/profile/stop", { method: "POST", body: {}, retries: 0 }),
 

@@ -256,10 +256,50 @@ async function submitQueue(ev) {
       delegate_master: $("queue-delegate").checked,
     });
     result.textContent = JSON.stringify(res, null, 2);
+    if (res.prompt_id) trackProgress(res.prompt_id);
   } catch (e) {
     result.textContent = "Error: " + e.message +
       (e.data ? "\n" + JSON.stringify(e.data, null, 2) : "");
   }
+}
+
+// live sampling progress + latent preview (/distributed/progress|preview —
+// the step/preview UX ComfyUI's UI provides, served by our own tracker)
+let progressTimer = null;
+async function trackProgress(promptId) {
+  const box = $("job-progress"), bar = $("job-progress-bar");
+  const label = $("job-progress-label"), img = $("job-preview");
+  if (progressTimer) clearInterval(progressTimer);
+  box.hidden = false;
+  bar.style.width = "0%";
+  label.textContent = "waiting for first step…";
+  img.hidden = true;
+  let misses = 0, lastStep = -1;
+  progressTimer = setInterval(async () => {
+    let snap = null;
+    try { snap = await api.progress(promptId); } catch { misses += 1; }
+    if (!snap) {
+      // the prompt may sit behind a long-running job (the queue is
+      // serial and a cold compile alone can take minutes) — keep
+      // polling for ~10 min before giving up
+      if (misses > 800) { clearInterval(progressTimer); box.hidden = true; }
+      else label.textContent = "queued…";
+      return;
+    }
+    misses = 0;
+    bar.style.width = Math.round(snap.fraction * 100) + "%";
+    label.textContent = snap.failed
+      ? `failed at step ${snap.step}/${snap.total}`
+      : snap.done
+        ? `done (${snap.total} steps)`
+        : `step ${snap.step}/${snap.total}`;
+    if (snap.step > 0 && snap.step !== lastStep) {
+      lastStep = snap.step;      // refetch only when a new step reported
+      img.src = api.previewUrl(promptId);
+      img.hidden = false;
+    }
+    if (snap.done) clearInterval(progressTimer);
+  }, 750);
 }
 
 // ---------------------------------------------------------------------------

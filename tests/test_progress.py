@@ -1,0 +1,190 @@
+"""Sampling progress + live previews: events stream out of the compiled
+sampler scan (jax.debug.callback), the tracker aggregates them, and the
+control plane serves them — the standalone equivalent of the per-step
+progress/preview UX the reference inherits from ComfyUI."""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from comfyui_distributed_tpu.cluster.progress import (ProgressTracker,
+                                                      latent_to_rgb)
+from comfyui_distributed_tpu.diffusion import progress as events
+from comfyui_distributed_tpu.diffusion.progress import (calls_per_step,
+                                                        total_calls,
+                                                        wrap_denoiser)
+
+
+@pytest.fixture
+def tracker():
+    t = ProgressTracker()
+    yield t
+    events.set_sink(None)
+
+
+class TestLatentToRgb:
+    def test_4ch_linear_map(self):
+        rgb = latent_to_rgb(np.random.randn(8, 8, 4).astype(np.float32))
+        assert rgb.shape == (8, 8, 3)
+        assert rgb.min() >= 0.0 and rgb.max() <= 1.0
+
+    def test_16ch_fallback(self):
+        rgb = latent_to_rgb(np.random.randn(8, 8, 16).astype(np.float32))
+        assert rgb.shape == (8, 8, 3)
+
+    def test_video_latent_takes_middle_frame(self):
+        rgb = latent_to_rgb(np.random.randn(5, 8, 8, 4).astype(np.float32))
+        assert rgb.shape == (8, 8, 3)
+
+
+class TestTracker:
+    def test_counts_and_preview_ordering(self, tracker):
+        token = tracker.start("p1", total_calls("euler", 4))
+        lat_hi = np.full((1, 4, 4, 4), 7.0, np.float32)
+        lat_lo = np.full((1, 4, 4, 4), 1.0, np.float32)
+        # unordered arrival: the low-sigma (later) event first
+        tracker._on_event(token, 0, 2.0, lat_lo)
+        tracker._on_event(token, 0, 14.0, lat_hi)
+        snap = tracker.snapshot("p1")
+        assert snap["step"] == 2 and snap["total"] == 4
+        assert snap["fraction"] == 0.5
+        # preview kept the LOWEST sigma seen (newest step), not the last
+        assert tracker._jobs[token].previews[0][0, 0, 0] == 1.0
+
+    def test_shard_previews_kept_separately(self, tracker):
+        token = tracker.start("p2", 4)
+        tracker._on_event(token, 0, 5.0, np.zeros((1, 4, 4, 4), np.float32))
+        tracker._on_event(token, 1, 5.0, np.ones((1, 4, 4, 4), np.float32))
+        snap = tracker.snapshot("p2")
+        assert snap["shards_reporting"] == 2
+        assert snap["step"] == 1            # shard 0 only drives the count
+
+    def test_finish_clamps_and_blocks_late_events(self, tracker):
+        token = tracker.start("p3", 10)
+        tracker._on_event(token, 0, 5.0, np.zeros((1, 2, 2, 4), np.float32))
+        tracker.finish("p3")
+        snap = tracker.snapshot("p3")
+        assert snap["done"] and snap["fraction"] == 1.0
+        tracker._on_event(token, 0, 1.0, np.ones((1, 2, 2, 4), np.float32))
+        assert tracker.snapshot("p3")["step"] == 10
+
+    def test_preview_png_roundtrip(self, tracker):
+        from comfyui_distributed_tpu.utils.image import decode_png
+
+        token = tracker.start("p4", 2)
+        tracker._on_event(token, 0, 3.0,
+                          np.random.randn(1, 8, 8, 4).astype(np.float32))
+        png = tracker.preview_png("p4")
+        assert png is not None
+        assert decode_png(png).shape == (8, 8, 3)
+
+    def test_unknown_prompt(self, tracker):
+        assert tracker.snapshot("nope") is None
+        assert tracker.preview_png("nope") is None
+
+    def test_eviction_keeps_newest(self):
+        t = ProgressTracker(keep=2)
+        try:
+            t.start("a", 1)
+            t.start("b", 1)
+            t.start("c", 1)
+            assert t.snapshot("a") is None
+            assert t.snapshot("c") is not None
+        finally:
+            events.set_sink(None)
+
+
+class TestCallsPerStep:
+    def test_table(self):
+        assert calls_per_step("euler") == 1
+        assert calls_per_step("heun") == 2
+        assert calls_per_step("dpmpp_sde") == 2
+        assert total_calls("euler", 30) == 30
+
+
+def test_wrapped_denoiser_streams_through_jit(tracker):
+    """The wrapper emits one event per model call from inside a jitted
+    scan, with the traced token routed at runtime."""
+    token = tracker.start("jit1", 3)
+    den = wrap_denoiser(lambda x, s: x * 0.5, jnp.int32(token), 0)
+
+    def scan_fn(x, sigma):
+        return den(x, sigma), None
+
+    xs = jnp.array([3.0, 2.0, 1.0])
+    jax.block_until_ready(
+        jax.jit(lambda x0: jax.lax.scan(scan_fn, x0, xs))(
+            jnp.ones((1, 4, 4, 4))))
+    # callbacks are async host effects — drain them before asserting
+    jax.effects_barrier()
+    snap = tracker.snapshot("jit1")
+    assert snap["step"] == 3
+    assert snap["fraction"] == 1.0
+
+
+def test_pipeline_generate_with_progress(tracker, tmp_config):
+    """End-to-end: dp-sharded tiny generation with a progress token — the
+    tracker sees every step and a preview from each shard."""
+    from comfyui_distributed_tpu.diffusion.pipeline import (GenerationSpec,
+                                                            Txt2ImgPipeline)
+    from comfyui_distributed_tpu.models.text import (TextEncoder,
+                                                     TextEncoderConfig)
+    from comfyui_distributed_tpu.models.unet import UNetConfig, init_unet
+    from comfyui_distributed_tpu.models.vae import AutoencoderKL, VAEConfig
+    from comfyui_distributed_tpu.parallel import build_mesh
+
+    model, params = init_unet(UNetConfig.tiny(), jax.random.key(0),
+                              sample_shape=(8, 8, 4), context_len=16)
+    vae = AutoencoderKL(VAEConfig.tiny()).init(jax.random.key(1),
+                                               image_hw=(16, 16))
+    enc = TextEncoder(TextEncoderConfig.tiny()).init(jax.random.key(2))
+    pipe = Txt2ImgPipeline(model, params, vae)
+    ctx, _ = enc.encode(["progress"])
+    unc, _ = enc.encode([""])
+    mesh = build_mesh({"dp": 4})
+    spec = GenerationSpec(height=16, width=16, steps=3, guidance_scale=2.0)
+
+    token = tracker.start("run1", total_calls(spec.sampler, spec.steps))
+    out = pipe.generate(mesh, spec, 0, ctx, unc, progress_token=token)
+    jax.block_until_ready(out)
+    jax.effects_barrier()       # block_until_ready does not flush callbacks
+    snap = tracker.snapshot("run1")
+    assert snap["step"] == 3, snap
+    assert snap["shards_reporting"] == 4
+    assert tracker.preview_png("run1", shard=3) is not None
+    # progress-off compiles separately and still works (cache keyed)
+    out2 = pipe.generate(mesh, spec, 0, ctx, unc)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(out2),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_progress_routes(tmp_config):
+    """Route surface: /distributed/progress + /preview."""
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from comfyui_distributed_tpu.api.app import create_app
+    from comfyui_distributed_tpu.cluster.controller import Controller
+
+    async def body():
+        controller = Controller()
+        app = create_app(controller)
+        token = controller.progress.start("pr1", 4)
+        controller.progress._on_event(
+            token, 0, 3.0, np.random.randn(1, 8, 8, 4).astype(np.float32))
+        async with TestClient(TestServer(app)) as client:
+            r = await client.get("/distributed/progress/pr1")
+            assert r.status == 200
+            data = await r.json()
+            assert data["step"] == 1 and data["total"] == 4
+            r = await client.get("/distributed/preview/pr1")
+            assert r.status == 200
+            assert r.content_type == "image/png"
+            r = await client.get("/distributed/progress/none")
+            assert r.status == 404
+        events.set_sink(None)
+
+    asyncio.run(body())
