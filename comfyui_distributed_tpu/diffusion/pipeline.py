@@ -43,6 +43,20 @@ class GenerationSpec:
     denoise: float = 1.0           # <1.0: img2img partial ladder (tile engine)
 
 
+def bind_weights(jitted, weights):
+    """Wrap a jitted function whose LEADING argument is the weight pytree:
+    the returned callable supplies it automatically, while ``.jitted`` /
+    ``.weights`` expose the raw jit object for AOT use
+    (``bench.py``: ``fn.jitted.lower(fn.weights, *args)``). One shared
+    definition — every pipeline factory returns this shape."""
+    def call(*args, **kw):
+        return jitted(weights, *args, **kw)
+
+    call.jitted = jitted
+    call.weights = weights
+    return call
+
+
 def make_sigma_ladder(spec: GenerationSpec, schedule: NoiseSchedule) -> jax.Array:
     n = max(1, round(spec.steps * spec.denoise))
     if spec.scheduler == "karras":
@@ -103,18 +117,35 @@ class Txt2ImgPipeline:
     def latent_channels(self) -> int:
         return self.unet.config.in_channels
 
-    def _denoiser(self, context, y, hint=None):
+    def _weights(self, img2img: bool = False) -> dict:
+        """Weight pytree passed as a jit ARGUMENT. Closing over params
+        instead would embed them as lowering constants — for SDXL that is
+        >5 GB serialized into the MLIR module (each leaf fetched to host
+        first), which makes compilation effectively unbounded on a
+        tunneled accelerator and bloats every executable."""
+        w = {"unet": self.unet_params, "vae_dec": self.vae.dec_params}
+        if img2img:
+            w["vae_enc"] = self.vae.enc_params
+        control_cfg = getattr(self, "_control", None)
+        if control_cfg is not None:
+            w["control"] = control_cfg[0].params
+        return w
+
+    def _denoiser(self, context, y, hint=None, weights=None):
         """``hint``: control map [B,H,W,C] when this pipeline carries a
         ControlNet (``with_control``); residuals are scaled and fed into
         the UNet's control hook every step. Under CFG's batch-dim concat
         the hint tiles to the doubled batch, so control conditions the
-        cond AND uncond passes (A1111 convention)."""
+        cond AND uncond passes (A1111 convention). ``weights``: explicit
+        param pytree (``_weights``) when called under jit."""
         control_cfg = getattr(self, "_control", None)
 
         def model_fn(x, t, ctx, y_):
             control = None
             if control_cfg is not None and hint is not None:
                 cn, strength = control_cfg
+                cn_params = (cn.params if weights is None
+                             else weights["control"])
                 hf = hint.astype(jnp.float32)
                 if hf.shape[0] != x.shape[0]:
                     if x.shape[0] % hf.shape[0]:
@@ -123,9 +154,11 @@ class Txt2ImgPipeline:
                             f"divide model batch {x.shape[0]}")
                     hf = jnp.concatenate(
                         [hf] * (x.shape[0] // hf.shape[0]), axis=0)
-                down, mid = cn.model.apply(cn.params, x, t, ctx, y_, hf)
+                down, mid = cn.model.apply(cn_params, x, t, ctx, y_, hf)
                 control = ([d * strength for d in down], mid * strength)
-            return self.unet.apply(self.unet_params, x, t, ctx, y_,
+            unet_params = (self.unet_params if weights is None
+                           else weights["unet"])
+            return self.unet.apply(unet_params, x, t, ctx, y_,
                                    control=control)
 
         return eps_denoiser(model_fn, self.schedule, context, y)
@@ -156,13 +189,16 @@ class Txt2ImgPipeline:
     def _sample_and_decode(self, key, context, uncond_context, y, uncond_y,
                            spec: GenerationSpec, batch: int, sigmas: jax.Array,
                            init_latent: Optional[jax.Array] = None,
-                           hint: Optional[jax.Array] = None):
+                           hint: Optional[jax.Array] = None,
+                           progress=None, weights=None):
         """Single-shard work: noise → sampler scan → VAE decode.
 
         ``init_latent`` switches to img2img: the source latent is noised
         to the (partial) ladder's head instead of starting from pure
         noise (k-diffusion img2img convention). ``hint`` feeds the
-        pipeline's ControlNet (``with_control``)."""
+        pipeline's ControlNet (``with_control``). ``progress`` is an
+        optional ``(token, shard_index)`` pair that streams per-step x0
+        previews to the host (``diffusion/progress.wrap_denoiser``)."""
         k_noise, k_samp = jax.random.split(key)
         if init_latent is None:
             lat_h = spec.height // self.vae.config.downscale
@@ -177,7 +213,8 @@ class Txt2ImgPipeline:
 
         if spec.guidance_scale != 1.0:
             denoise = cfg_denoiser(
-                lambda ctx, yy: self._denoiser(ctx, yy, hint=hint),
+                lambda ctx, yy: self._denoiser(ctx, yy, hint=hint,
+                                               weights=weights),
                 jnp.broadcast_to(context, (batch,) + context.shape[1:]),
                 jnp.broadcast_to(uncond_context, (batch,) + uncond_context.shape[1:]),
                 spec.guidance_scale,
@@ -188,14 +225,20 @@ class Txt2ImgPipeline:
             denoise = self._denoiser(
                 jnp.broadcast_to(context, (batch,) + context.shape[1:]),
                 None if y is None else jnp.broadcast_to(y, (batch,) + y.shape[1:]),
-                hint=hint,
+                hint=hint, weights=weights,
             )
+        if progress is not None:
+            from .progress import wrap_denoiser
+
+            denoise = wrap_denoiser(denoise, progress[0], progress[1])
         x0 = sample(spec.sampler, denoise, x, sigmas, key=k_samp)
-        images = self.vae.decode(x0)
+        images = self.vae.decode(
+            x0, params=None if weights is None else weights["vae_dec"])
         return jnp.clip(images / 2.0 + 0.5, 0.0, 1.0)
 
     def generate_fn(self, mesh: Mesh, spec: GenerationSpec,
-                    axis: str = constants.AXIS_DATA):
+                    axis: str = constants.AXIS_DATA,
+                    progress: bool = False):
         """Compile the SPMD generator over ``mesh[axis]``.
 
         Every shard derives its own key via ``fold_in(key, axis_index)`` —
@@ -210,35 +253,49 @@ class Txt2ImgPipeline:
         # ladder is built eagerly (host-side) so it's a compile-time constant
         sigmas = make_sigma_ladder(spec, self.schedule)
 
-        if has_control:
+        def shard_body(weights, key, context, uncond_context, y, uncond_y,
+                       hint=None, token=None):
+            k = participant_key(key, axis)
+            prog = ((token, jax.lax.axis_index(axis))
+                    if token is not None else None)
+            return self._sample_and_decode(
+                k, context, uncond_context,
+                y if has_y else None, uncond_y if has_y else None,
+                spec, spec.per_device_batch, sigmas, hint=hint,
+                progress=prog, weights=weights,
+            )
+
+        # weights lead the argument list (replicated pytree — P() broadcasts
+        # over its leaves); passing them as arguments keeps multi-GB params
+        # OUT of the lowered module (see _weights)
+        in_specs = (P(), P(), P(None, None, None), P(None, None, None),
+                    P(None, None), P(None, None))
+        if has_control and progress:
+            per_shard = (lambda w, key, c, u, y_, uy, hint, token:
+                         shard_body(w, key, c, u, y_, uy, hint, token))
+            in_specs += (P(None, None, None, None), P())
+        elif has_control:
             # control hint rides as a replicated trailing argument
-            def per_shard(key, context, uncond_context, y, uncond_y, hint):
-                k = participant_key(key, axis)
-                return self._sample_and_decode(
-                    k, context, uncond_context,
-                    y if has_y else None, uncond_y if has_y else None,
-                    spec, spec.per_device_batch, sigmas, hint=hint,
-                )
-
-            in_specs = (P(), P(None, None, None), P(None, None, None),
-                        P(None, None), P(None, None),
-                        P(None, None, None, None))
+            per_shard = (lambda w, key, c, u, y_, uy, hint:
+                         shard_body(w, key, c, u, y_, uy, hint))
+            in_specs += (P(None, None, None, None),)
+        elif progress:
+            # progress token: replicated int32 scalar, traced so one
+            # compiled program serves every run
+            per_shard = (lambda w, key, c, u, y_, uy, token:
+                         shard_body(w, key, c, u, y_, uy, None, token))
+            in_specs += (P(),)
         else:
-            def per_shard(key, context, uncond_context, y, uncond_y):
-                k = participant_key(key, axis)
-                return self._sample_and_decode(
-                    k, context, uncond_context,
-                    y if has_y else None, uncond_y if has_y else None,
-                    spec, spec.per_device_batch, sigmas,
-                )
-
-            in_specs = (P(), P(None, None, None), P(None, None, None),
-                        P(None, None), P(None, None))
+            per_shard = (lambda w, key, c, u, y_, uy:
+                         shard_body(w, key, c, u, y_, uy))
         f = jax.shard_map(
             per_shard, mesh=mesh, in_specs=in_specs,
             out_specs=P(axis, None, None, None),
         )
-        return jax.jit(f)
+        jitted = jax.jit(f)
+        weights = self._weights()
+
+        return bind_weights(jitted, weights)
 
     def img2img_fn(self, mesh: Mesh, spec: GenerationSpec,
                    axis: str = constants.AXIS_DATA):
@@ -253,37 +310,38 @@ class Txt2ImgPipeline:
         has_control = getattr(self, "_control", None) is not None
         sigmas = make_sigma_ladder(spec, self.schedule)
 
-        base_specs = (P(None, None, None, None), P(), P(None, None, None),
+        base_specs = (P(), P(None, None, None, None), P(),
+                      P(None, None, None),
                       P(None, None, None), P(None, None), P(None, None))
-        if has_control:
-            def per_shard(images, key, context, uncond_context, y, uncond_y,
-                          hint):
-                k = participant_key(key, axis)
-                lat = self.vae.encode(images.astype(jnp.float32) * 2.0 - 1.0)
-                return self._sample_and_decode(
-                    k, context, uncond_context,
-                    y if has_y else None, uncond_y if has_y else None,
-                    spec, images.shape[0], sigmas, init_latent=lat,
-                    hint=hint,
-                )
 
+        def shard_body(weights, images, key, context, uncond_context, y,
+                       uncond_y, hint=None):
+            k = participant_key(key, axis)
+            lat = self.vae.encode(images.astype(jnp.float32) * 2.0 - 1.0,
+                                  params=weights["vae_enc"])
+            return self._sample_and_decode(
+                k, context, uncond_context,
+                y if has_y else None, uncond_y if has_y else None,
+                spec, images.shape[0], sigmas, init_latent=lat,
+                hint=hint, weights=weights,
+            )
+
+        if has_control:
+            per_shard = (lambda w, im, key, c, u, y_, uy, hint:
+                         shard_body(w, im, key, c, u, y_, uy, hint))
             in_specs = base_specs + (P(None, None, None, None),)
         else:
-            def per_shard(images, key, context, uncond_context, y, uncond_y):
-                k = participant_key(key, axis)
-                lat = self.vae.encode(images.astype(jnp.float32) * 2.0 - 1.0)
-                return self._sample_and_decode(
-                    k, context, uncond_context,
-                    y if has_y else None, uncond_y if has_y else None,
-                    spec, images.shape[0], sigmas, init_latent=lat,
-                )
-
+            per_shard = (lambda w, im, key, c, u, y_, uy:
+                         shard_body(w, im, key, c, u, y_, uy))
             in_specs = base_specs
         f = jax.shard_map(
             per_shard, mesh=mesh, in_specs=in_specs,
             out_specs=P(axis, None, None, None),
         )
-        return jax.jit(f)
+        jitted = jax.jit(f)
+        weights = self._weights(img2img=True)
+
+        return bind_weights(jitted, weights)
 
     def img2img(
         self,
@@ -332,22 +390,29 @@ class Txt2ImgPipeline:
         y: Optional[jax.Array] = None,
         uncond_y: Optional[jax.Array] = None,
         hint: Optional[jax.Array] = None,
+        progress_token: Optional[int] = None,
     ) -> jax.Array:
-        """Convenience one-shot generate (compiles on first distinct spec)."""
-        fn = self._cached_fn(mesh, spec, hint=hint)
+        """Convenience one-shot generate (compiles on first distinct spec).
+        ``progress_token``: a ``ProgressTracker.start`` token — enables
+        per-step x0 streaming (one extra compiled variant, shared by every
+        tokened run)."""
+        fn = self._cached_fn(mesh, spec, hint=hint,
+                             progress=progress_token is not None)
         if y is None:
             adm = self.unet.config.adm_in_channels
             y = jnp.zeros((1, max(adm, 1)), jnp.float32)
         if uncond_y is None:
             uncond_y = jnp.zeros_like(y)
         key = jax.random.key(seed)
+        args = [key, context, uncond_context, y, uncond_y]
         if getattr(self, "_control", None) is not None:
             if hint is None:
                 raise ValueError("pipeline carries a ControlNet but no "
                                  "hint was given")
-            return fn(key, context, uncond_context, y, uncond_y,
-                      jnp.asarray(hint, jnp.float32))
-        return fn(key, context, uncond_context, y, uncond_y)
+            args.append(jnp.asarray(hint, jnp.float32))
+        if progress_token is not None:
+            args.append(jnp.asarray(progress_token, jnp.int32))
+        return fn(*args)
 
     _CACHE_MAX = 8
 
@@ -362,15 +427,16 @@ class Txt2ImgPipeline:
         return (tuple(mesh.axis_names), tuple(mesh.shape.values()),
                 tuple(d.id for d in mesh.devices.flat))
 
-    def _cached_fn(self, mesh: Mesh, spec: GenerationSpec, hint=None):
+    def _cached_fn(self, mesh: Mesh, spec: GenerationSpec, hint=None,
+                   progress: bool = False):
         if not hasattr(self, "_fn_cache"):
             self._fn_cache: "dict[tuple, Any]" = {}
         key = (self._mesh_cache_key(mesh), spec,
-               None if hint is None else tuple(hint.shape))
+               None if hint is None else tuple(hint.shape), progress)
         fn = self._fn_cache.get(key)
         if fn is None:
             if len(self._fn_cache) >= self._CACHE_MAX:
                 self._fn_cache.pop(next(iter(self._fn_cache)))
-            fn = self.generate_fn(mesh, spec)
+            fn = self.generate_fn(mesh, spec, progress=progress)
             self._fn_cache[key] = fn
         return fn

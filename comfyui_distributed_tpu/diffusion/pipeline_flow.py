@@ -23,6 +23,7 @@ from ..models.dit import DiT, DiTConfig
 from ..models.vae import AutoencoderKL
 from ..parallel.rng import participant_key
 from ..utils import constants
+from .pipeline import bind_weights
 from .samplers import sample
 from .schedules import sigmas_flow
 
@@ -44,27 +45,39 @@ class FlowPipeline:
         self.dit_params = dit_params
         self.vae = vae
 
-    def _denoiser(self, context, pooled, guidance, sp_axis=None):
+    def _weights(self) -> dict:
+        """Explicit jit-argument weight pytree (closure capture would embed
+        the params as lowered-module constants — 24 GB of MLIR for FLUX;
+        see ``Txt2ImgPipeline._weights``)."""
+        return {"dit": self.dit_params, "vae_dec": self.vae.dec_params}
+
+    def _denoiser(self, context, pooled, guidance, sp_axis=None,
+                  weights=None):
+        dit_params = (self.dit_params if weights is None
+                      else weights["dit"])
+
         def denoise(x, sigma):
             t = jnp.broadcast_to(sigma, (x.shape[0],))
             g = jnp.full((x.shape[0],), guidance)
-            v = self.dit.apply(self.dit_params, x, t, context, pooled, g,
+            v = self.dit.apply(dit_params, x, t, context, pooled, g,
                                sp_axis=sp_axis)
             return x - sigma * v
         return denoise
 
     def _sample_and_decode(self, key, context, pooled, spec: FlowSpec,
                            batch: int, sigmas, lat_hw, sp_axis=None,
-                           decode: bool = True):
+                           decode: bool = True, weights=None):
         lat_h, lat_w = lat_hw
         c = self.dit.config.in_channels
         x = jax.random.normal(key, (batch, lat_h, lat_w, c), jnp.float32)
         bc = lambda a: jnp.broadcast_to(a, (batch,) + a.shape[1:])
-        den = self._denoiser(bc(context), bc(pooled), spec.guidance, sp_axis)
+        den = self._denoiser(bc(context), bc(pooled), spec.guidance, sp_axis,
+                             weights=weights)
         x0 = sample(spec.sampler, den, x, sigmas, key=key)
         if not decode:
             return x0
-        images = self.vae.decode(x0)
+        images = self.vae.decode(
+            x0, params=None if weights is None else weights["vae_dec"])
         return jnp.clip(images / 2.0 + 0.5, 0.0, 1.0)
 
     # --- mode 1: dp seed fan-out -------------------------------------------
@@ -75,17 +88,21 @@ class FlowPipeline:
         ds = self.vae.config.downscale
         lat_hw = (spec.height // ds, spec.width // ds)
 
-        def per_shard(key, context, pooled):
+        def per_shard(weights, key, context, pooled):
             k = participant_key(key, axis)
             return self._sample_and_decode(k, context, pooled, spec,
-                                           spec.per_device_batch, sigmas, lat_hw)
+                                           spec.per_device_batch, sigmas,
+                                           lat_hw, weights=weights)
 
         f = jax.shard_map(
             per_shard, mesh=mesh,
-            in_specs=(P(), P(None, None, None), P(None, None)),
+            in_specs=(P(), P(), P(None, None, None), P(None, None)),
             out_specs=P(axis, None, None, None),
         )
-        return jax.jit(f)
+        jitted = jax.jit(f)
+        weights = self._weights()
+
+        return bind_weights(jitted, weights)
 
     def generate(self, mesh: Mesh, spec: FlowSpec, seed: int,
                  context: jax.Array, pooled: jax.Array) -> jax.Array:
@@ -102,18 +119,22 @@ class FlowPipeline:
         inserts the all-reduces. This is how FLUX-scale (12B) models run
         on 16 GB chips — a capability with no reference analogue (its
         workers each need the whole model in VRAM, README.md:186-189)."""
-        from jax.sharding import NamedSharding
-
-        from ..parallel.tensor import DIT_TP_RULES, shard_params
+        from ..parallel.tensor import (DIT_TP_RULES, require_tp_match,
+                                       shard_params, tp_fanout_call)
 
         sigmas = sigmas_flow(spec.steps, spec.shift)
         ds = self.vae.config.downscale
         lat_h, lat_w = spec.height // ds, spec.width // ds
         c = self.dit.config.in_channels
         B = mesh.shape[dp_axis] * spec.per_device_batch
+        require_tp_match(self.dit_params, mesh, DIT_TP_RULES, tp_axis, "dit")
+        # tp-placed params are passed as ARGUMENTS (committed sharded
+        # arrays) — closure capture would serialize the full weight set
+        # into the lowered module
         params = shard_params(self.dit_params, mesh, DIT_TP_RULES, tp_axis)
+        vae_dec = self.vae.dec_params
 
-        def run(keys, context, pooled):
+        def run(params, vae_dec, keys, context, pooled):
             noise = jax.vmap(
                 lambda k: jax.random.normal(k, (lat_h, lat_w, c), jnp.float32)
             )(keys)
@@ -126,18 +147,11 @@ class FlowPipeline:
                 return x - sigma * v
 
             x0 = sample(spec.sampler, denoise, noise, sigmas, key=keys[0])
-            images = self.vae.decode(x0)
+            images = self.vae.decode(x0, params=vae_dec)
             return jnp.clip(images / 2.0 + 0.5, 0.0, 1.0)
 
-        key_sharding = NamedSharding(mesh, P(dp_axis))
-        rep = NamedSharding(mesh, P())
-        jitted = jax.jit(run, in_shardings=(key_sharding, rep, rep))
-
-        def call(key, context, pooled):
-            keys = jax.vmap(lambda i: jax.random.fold_in(key, i))(jnp.arange(B))
-            return jitted(jax.device_put(keys, key_sharding), context, pooled)
-
-        return call
+        return tp_fanout_call(jax.jit(run), (params, vae_dec), mesh,
+                              dp_axis, B)
 
     # --- mode 2: sp single-image sharding ----------------------------------
 
@@ -157,29 +171,33 @@ class FlowPipeline:
         sigmas = sigmas_flow(spec.steps, spec.shift)
         rows_per = lat_h // n_sh
 
-        def per_shard(key, context, pooled):
+        def per_shard(weights, key, context, pooled):
             idx = jax.lax.axis_index(axis)
             c = self.dit.config.in_channels
             full_noise = jax.random.normal(key, (1, lat_h, lat_w, c), jnp.float32)
             x = jax.lax.dynamic_slice_in_dim(full_noise, idx * rows_per,
                                              rows_per, axis=1)
-            den = self._denoiser(context, pooled, spec.guidance, sp_axis=axis)
+            den = self._denoiser(context, pooled, spec.guidance, sp_axis=axis,
+                                 weights=weights)
             x0 = sample(spec.sampler, den, x, sigmas, key=key)
             return x0
 
         f = jax.shard_map(
             per_shard, mesh=mesh,
-            in_specs=(P(), P(None, None, None), P(None, None)),
+            in_specs=(P(), P(), P(None, None, None), P(None, None)),
             out_specs=P(None, axis, None, None),
             check_vma=False,
         )
 
-        def run(key, context, pooled):
-            latents = f(key, context, pooled)     # [1, lat_h, lat_w, c] global
-            images = self.vae.decode(latents)
+        def run(weights, key, context, pooled):
+            latents = f(weights, key, context, pooled)  # [1,lat_h,lat_w,c]
+            images = self.vae.decode(latents, params=weights["vae_dec"])
             return jnp.clip(images / 2.0 + 0.5, 0.0, 1.0)
 
-        return jax.jit(run)
+        jitted = jax.jit(run)
+        weights = self._weights()
+
+        return bind_weights(jitted, weights)
 
     def generate_sp(self, mesh: Mesh, spec: FlowSpec, seed: int,
                     context: jax.Array, pooled: jax.Array) -> jax.Array:

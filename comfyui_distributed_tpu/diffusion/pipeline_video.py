@@ -28,6 +28,7 @@ from ..models.vae import AutoencoderKL
 from ..models.video_dit import VideoDiT, pad_frames_4n1
 from ..parallel.rng import participant_key
 from ..utils import constants
+from .pipeline import bind_weights
 from .samplers import sample
 from .schedules import sigmas_flow
 
@@ -62,27 +63,45 @@ class VideoPipeline:
         VAE's temporal factor (1 for the per-frame image VAE)."""
         return (spec.padded_frames - 1) // self.temporal_downscale + 1
 
-    def decode_frames(self, latents: jax.Array) -> jax.Array:
+    def _weights(self) -> dict:
+        """Explicit jit-argument weight pytree (closure capture would
+        serialize the params into the lowered module — 28 GB of MLIR for
+        WAN-14B; see ``Txt2ImgPipeline._weights``)."""
+        return {"dit": self.dit_params, "vae_dec": self.vae.dec_params}
+
+    def decode_frames(self, latents: jax.Array, vae_params=None) -> jax.Array:
         """[B,f,h,w,c] → [B,F,H,W,3]: whole-clip decode through a 3D
-        causal VAE, per-frame decode through the image VAE."""
+        causal VAE, per-frame decode through the image VAE. Large frames
+        switch to spatially-tiled decode (``WanVAE3D.decode_tiled``) —
+        a 480p whole-frame f32 decode needs >31 GB of activations."""
         if self.temporal_downscale > 1:
-            frames = self.vae.decode(latents)
+            thresh = constants.VAE_TILE_THRESHOLD
+            if thresh and latents.shape[2] * latents.shape[3] > thresh:
+                frames = self.vae.decode_tiled(
+                    latents, params=vae_params, tile=constants.VAE_TILE,
+                    overlap=constants.VAE_TILE_OVERLAP)
+            else:
+                frames = self.vae.decode(latents, params=vae_params)
             return jnp.clip(frames / 2.0 + 0.5, 0.0, 1.0)
         B, F = latents.shape[:2]
         flat = latents.reshape((B * F,) + latents.shape[2:])
-        frames = self.vae.decode(flat)
+        frames = self.vae.decode(flat, params=vae_params)
         frames = jnp.clip(frames / 2.0 + 0.5, 0.0, 1.0)
         return frames.reshape((B, F) + frames.shape[1:])
 
     def _denoiser(self, context, pooled, guidance_scale, sp_axis=None,
-                  inp_fn=None):
+                  inp_fn=None, params=None):
         """``inp_fn`` optionally transforms the latent before the model
         sees it (i2v concatenates mask + conditioning channels); the CFG
-        machinery is shared so t2v/i2v guidance can never diverge."""
+        machinery is shared so t2v/i2v guidance can never diverge.
+        ``params`` overrides ``self.dit_params`` (tp mode passes the
+        tp-sharded tree so GSPMD sees the placements)."""
+        wts = self.dit_params if params is None else params
+
         def model_call(x, sigma, ctx, pl):
             t = jnp.broadcast_to(sigma, (x.shape[0],))
             inp = x if inp_fn is None else inp_fn(x)
-            v = self.dit.apply(self.dit_params, inp, t, ctx, pl,
+            v = self.dit.apply(wts, inp, t, ctx, pl,
                                sp_axis=sp_axis)
             return x - sigma * v
 
@@ -110,23 +129,71 @@ class VideoPipeline:
         F = self.latent_frames(spec)
         lat = (F, spec.height // ds, spec.width // ds, self.dit.config.in_channels)
 
-        def per_shard(key, context, pooled):
+        def per_shard(weights, key, context, pooled):
             k = participant_key(key, axis)
             x = jax.random.normal(k, (1,) + lat, jnp.float32)
-            den = self._denoiser(context, pooled, spec.guidance_scale)
+            den = self._denoiser(context, pooled, spec.guidance_scale,
+                                 params=weights["dit"])
             x0 = sample(spec.sampler, den, x, sigmas, key=k)
-            return self.decode_frames(x0)
+            return self.decode_frames(x0, vae_params=weights["vae_dec"])
 
         f = jax.shard_map(
             per_shard, mesh=mesh,
-            in_specs=(P(), P(None, None, None), P(None, None)),
+            in_specs=(P(), P(), P(None, None, None), P(None, None)),
             out_specs=P(axis, None, None, None, None),
         )
-        return jax.jit(f)
+        jitted = jax.jit(f)
+        weights = self._weights()
+
+        return bind_weights(jitted, weights)
 
     def generate(self, mesh: Mesh, spec: VideoSpec, seed: int,
                  context: jax.Array, pooled: jax.Array) -> jax.Array:
         return self.generate_fn(mesh, spec)(jax.random.key(seed), context, pooled)
+
+    # -- dp×tp: the WAN-14B enabler --------------------------------------
+
+    def generate_tp_fn(self, mesh: Mesh, spec: VideoSpec,
+                       dp_axis: str = constants.AXIS_DATA,
+                       tp_axis: str = constants.AXIS_TENSOR):
+        """Seeds over ``dp`` AND weights over ``tp`` in one jit. A 14B
+        WAN DiT is ~28 GB of bf16 weights — more than a v5e chip's HBM —
+        so tp sharding is what makes BASELINE's ``wan-2.2 14B t2v over
+        pod`` row runnable at all (the reference requires every GPU to
+        hold the whole model, README.md:186-189). Megatron column/row
+        rules per model family (``parallel/tensor.py``); GSPMD inserts
+        the all-reduces."""
+        from ..parallel.tensor import (DIT_TP_RULES, WAN_TP_RULES,
+                                       require_tp_match, shard_params,
+                                       tp_fanout_call)
+
+        # models declare their rule family (WanModel.tp_family = "wan");
+        # MMDiT-style video DiTs use the image-DiT fused-qkv rules
+        family = getattr(self.dit, "tp_family", "dit")
+        rules = WAN_TP_RULES if family == "wan" else DIT_TP_RULES
+        sigmas = sigmas_flow(spec.steps, spec.shift)
+        ds = self.vae.config.downscale
+        F = self.latent_frames(spec)
+        lat = (F, spec.height // ds, spec.width // ds,
+               self.dit.config.in_channels)
+        B = mesh.shape[dp_axis]
+        require_tp_match(self.dit_params, mesh, rules, tp_axis, family)
+        # tp-placed params travel as ARGUMENTS (committed sharded arrays),
+        # never closure constants (see _weights)
+        params = shard_params(self.dit_params, mesh, rules, tp_axis)
+        vae_dec = self.vae.dec_params
+
+        def run(params, vae_dec, keys, context, pooled):
+            noise = jax.vmap(
+                lambda k: jax.random.normal(k, lat, jnp.float32))(keys)
+            bc = lambda a: jnp.broadcast_to(a, (B,) + a.shape[1:])
+            den = self._denoiser(bc(context), bc(pooled),
+                                 spec.guidance_scale, params=params)
+            x0 = sample(spec.sampler, den, noise, sigmas, key=keys[0])
+            return self.decode_frames(x0, vae_params=vae_dec)
+
+        return tp_fanout_call(jax.jit(run), (params, vae_dec), mesh,
+                              dp_axis, B)
 
     # -- image→video (WAN-2.2-style latent-concat conditioning) ----------
 
@@ -152,14 +219,14 @@ class VideoPipeline:
         return y, mask.at[:, 0].set(1.0)
 
     def _denoiser_i2v(self, context, pooled, y, mask, guidance_scale,
-                      sp_axis=None):
+                      sp_axis=None, params=None):
         def inp_fn(x):
             return jnp.concatenate(
                 [x, jnp.broadcast_to(mask, x.shape[:4] + (mask.shape[-1],)),
                  jnp.broadcast_to(y, x.shape[:4] + (y.shape[-1],))], axis=-1)
 
         return self._denoiser(context, pooled, guidance_scale,
-                              sp_axis=sp_axis, inp_fn=inp_fn)
+                              sp_axis=sp_axis, inp_fn=inp_fn, params=params)
 
     def generate_i2v_fn(self, mesh: Mesh, spec: VideoSpec,
                         axis: str = constants.AXIS_DATA):
@@ -172,22 +239,26 @@ class VideoPipeline:
                     self.dit.config.in_channels)
         lat = (F, spec.height // ds, spec.width // ds, c)
 
-        def per_shard(key, context, pooled, y, mask):
+        def per_shard(weights, key, context, pooled, y, mask):
             k = participant_key(key, axis)
             x = jax.random.normal(k, (1,) + lat, jnp.float32)
             den = self._denoiser_i2v(context, pooled, y, mask,
-                                     spec.guidance_scale)
+                                     spec.guidance_scale,
+                                     params=weights["dit"])
             x0 = sample(spec.sampler, den, x, sigmas, key=k)
-            return self.decode_frames(x0)
+            return self.decode_frames(x0, vae_params=weights["vae_dec"])
 
         f = jax.shard_map(
             per_shard, mesh=mesh,
-            in_specs=(P(), P(None, None, None), P(None, None),
+            in_specs=(P(), P(), P(None, None, None), P(None, None),
                       P(None, None, None, None, None),
                       P(None, None, None, None, None)),
             out_specs=P(axis, None, None, None, None),
         )
-        return jax.jit(f)
+        jitted = jax.jit(f)
+        weights = self._weights()
+
+        return bind_weights(jitted, weights)
 
     def generate_i2v(self, mesh: Mesh, spec: VideoSpec, seed: int,
                      image: jax.Array, context: jax.Array,
@@ -214,13 +285,14 @@ class VideoPipeline:
                     self.dit.config.in_channels)
         per = F // n_sh
 
-        def per_shard(key, context, pooled, y_sh, mask_sh):
+        def per_shard(weights, key, context, pooled, y_sh, mask_sh):
             idx = jax.lax.axis_index(axis)
             full = jax.random.normal(key, (1, F, lat_h, lat_w, c),
                                      jnp.float32)
             x = jax.lax.dynamic_slice_in_dim(full, idx * per, per, axis=1)
             den = self._denoiser_i2v(context, pooled, y_sh, mask_sh,
-                                     spec.guidance_scale, sp_axis=axis)
+                                     spec.guidance_scale, sp_axis=axis,
+                                     params=weights["dit"])
             # per-shard sampler key: ancestral samplers must inject
             # DIFFERENT noise into each frame block (deterministic
             # samplers ignore the key, so sp==unsharded still holds)
@@ -229,16 +301,21 @@ class VideoPipeline:
 
         f = jax.shard_map(
             per_shard, mesh=mesh,
-            in_specs=(P(), P(None, None, None), P(None, None),
+            in_specs=(P(), P(), P(None, None, None), P(None, None),
                       P(None, axis), P(None, axis)),
             out_specs=P(None, axis, None, None, None),
             check_vma=False,
         )
 
-        def run(key, context, pooled, y, mask):
-            return self.decode_frames(f(key, context, pooled, y, mask))
+        def run(weights, key, context, pooled, y, mask):
+            return self.decode_frames(f(weights, key, context, pooled,
+                                        y, mask),
+                                      vae_params=weights["vae_dec"])
 
-        return jax.jit(run)
+        jitted = jax.jit(run)
+        weights = self._weights()
+
+        return bind_weights(jitted, weights)
 
     def generate_frames_fn(self, mesh: Mesh, spec: VideoSpec,
                            axis: str = constants.AXIS_SEQUENCE):
@@ -258,12 +335,12 @@ class VideoPipeline:
         c = self.dit.config.in_channels
         per = F // n_sh
 
-        def per_shard(key, context, pooled):
+        def per_shard(weights, key, context, pooled):
             idx = jax.lax.axis_index(axis)
             full = jax.random.normal(key, (1, F, lat_h, lat_w, c), jnp.float32)
             x = jax.lax.dynamic_slice_in_dim(full, idx * per, per, axis=1)
             den = self._denoiser(context, pooled, spec.guidance_scale,
-                                 sp_axis=axis)
+                                 sp_axis=axis, params=weights["dit"])
             # fold the shard index so ancestral samplers draw distinct
             # noise per frame block (deterministic samplers ignore it)
             return sample(spec.sampler, den, x, sigmas,
@@ -271,13 +348,16 @@ class VideoPipeline:
 
         f = jax.shard_map(
             per_shard, mesh=mesh,
-            in_specs=(P(), P(None, None, None), P(None, None)),
+            in_specs=(P(), P(), P(None, None, None), P(None, None)),
             out_specs=P(None, axis, None, None, None),
             check_vma=False,
         )
 
-        def run(key, context, pooled):
-            latents = f(key, context, pooled)
-            return self.decode_frames(latents)
+        def run(weights, key, context, pooled):
+            latents = f(weights, key, context, pooled)
+            return self.decode_frames(latents, vae_params=weights["vae_dec"])
 
-        return jax.jit(run)
+        jitted = jax.jit(run)
+        weights = self._weights()
+
+        return bind_weights(jitted, weights)

@@ -846,13 +846,14 @@ class TPUTxt2Img(NodeDef):
     OPTIONAL = {
         "sampler_name": "STRING", "scheduler": "STRING", "batch_per_device": "INT",
     }
-    HIDDEN = {"mesh": "*"}
+    HIDDEN = {"mesh": "*", "prompt_id": "STRING", "progress_tracker": "*"}
     RETURNS = ("IMAGE",)
 
     def execute(self, model, positive, negative, seed: int, steps: int,
                 cfg: float, width: int, height: int,
                 sampler_name: str = "euler", scheduler: str = "karras",
-                batch_per_device: int = 1, mesh=None, **_):
+                batch_per_device: int = 1, mesh=None, prompt_id: str = "",
+                progress_tracker=None, **_):
         from ..diffusion.pipeline import GenerationSpec
         from ..parallel.mesh import build_mesh
 
@@ -868,10 +869,33 @@ class TPUTxt2Img(NodeDef):
         uy = _adm_from_cond(negative, adm) if adm else None
         pipeline, hint = _control_from_cond(model.pipeline, positive,
                                             spec.height, spec.width)
-        images = pipeline.generate(
-            mesh, spec, int(seed), positive["context"], negative["context"],
-            y, uy, hint=hint,
-        )
+        token = None
+        if progress_tracker is not None and prompt_id:
+            from ..diffusion.progress import total_calls
+
+            token = progress_tracker.start(
+                prompt_id, total_calls(sampler_name, spec.steps))
+        ok = False
+        try:
+            images = pipeline.generate(
+                mesh, spec, int(seed), positive["context"],
+                negative["context"], y, uy, hint=hint,
+                progress_token=token,
+            )
+            if token is not None:
+                # dispatch is async — only mark done once the run really
+                # finished (downstream nodes would block here anyway).
+                # block_until_ready does NOT flush debug callbacks;
+                # effects_barrier drains them so finish() can't race the
+                # final step's events
+                jax.block_until_ready(images)
+                jax.effects_barrier()
+            ok = True
+        finally:
+            if token is not None:
+                # a failed run freezes progress where it stopped instead
+                # of rendering as 100% done
+                progress_tracker.finish(prompt_id, failed=not ok)
         return (images,)
 
 

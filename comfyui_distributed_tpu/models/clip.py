@@ -156,7 +156,9 @@ class CLIPTextModel:
         return self
 
     def __call__(self, tokens: jax.Array) -> dict[str, jax.Array]:
-        return self.module.apply(self.params, tokens)
+        from .layers import jit_apply
+
+        return jit_apply(self, self.module)(self.params, tokens)
 
 
 class SDXLTextStack:

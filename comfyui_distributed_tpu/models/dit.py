@@ -387,18 +387,25 @@ class DiT(nn.Module):
 
 def init_dit(config: DiTConfig, rng: jax.Array,
              sample_hw: tuple[int, int] = (32, 32), context_len: int = 16,
-             abstract: bool = False):
+             abstract: bool = False, param_dtype=None):
     """``abstract=True`` returns a ShapeDtypeStruct tree instead of
     materialized random params — the shape template weight conversion
-    needs without paying a 12B-param random init (FLUX-size presets)."""
+    needs without paying a 12B-param random init (FLUX-size presets).
+    ``param_dtype`` casts float params inside the fused init program
+    (see ``models/unet.init_unet``) — bf16 residency is what lets a
+    FLUX-class model fit accelerator HBM at all."""
+    from .unet import _cast_float_params
+
     model = DiT(config)
     h, w = sample_hw
     x = jnp.zeros((1, h, w, config.in_channels))
     t = jnp.zeros((1,))
     ctx = jnp.zeros((1, context_len, config.context_dim))
     pooled = jnp.zeros((1, config.pooled_dim))
+    init_fn = model.init if param_dtype is None else (
+        lambda *a: _cast_float_params(model.init(*a), param_dtype))
     if abstract:
-        params = jax.eval_shape(model.init, rng, x, t, ctx, pooled)
+        params = jax.eval_shape(init_fn, rng, x, t, ctx, pooled)
     else:
-        params = jax.jit(model.init)(rng, x, t, ctx, pooled)
+        params = jax.jit(init_fn)(rng, x, t, ctx, pooled)
     return model, params

@@ -18,6 +18,20 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 
+def jit_apply(owner, module, attr: str = "_apply", **jit_kwargs):
+    """Lazily-jitted ``module.apply`` cached on ``owner`` under ``attr``.
+
+    Params stay an ARGUMENT of the jitted function (never a closure
+    constant) and eager per-op dispatch — brutal over a tunneled
+    accelerator — is replaced by one compiled program. Shared by every
+    encoder/VAE wrapper."""
+    fn = getattr(owner, attr, None)
+    if fn is None:
+        fn = jax.jit(module.apply, **jit_kwargs)
+        setattr(owner, attr, fn)
+    return fn
+
+
 def timestep_embedding(t: jax.Array, dim: int, max_period: float = 10000.0) -> jax.Array:
     """Sinusoidal timestep embedding, [B] -> [B, dim] (DDPM convention)."""
     half = dim // 2

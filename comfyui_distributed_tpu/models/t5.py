@@ -188,7 +188,9 @@ class T5Model:
         return self
 
     def __call__(self, tokens: jax.Array, attn_mask=None) -> jax.Array:
-        return self.module.apply(self.params, tokens, attn_mask)
+        from .layers import jit_apply
+
+        return jit_apply(self, self.module)(self.params, tokens, attn_mask)
 
 
 # ---------------------------------------------------------------------------

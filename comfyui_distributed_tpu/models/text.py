@@ -109,4 +109,7 @@ class TextEncoder:
         return jnp.asarray([list(self._tokenize(t)) for t in texts], jnp.int32)
 
     def encode(self, texts: Sequence[str]) -> tuple[jax.Array, jax.Array]:
-        return self.module.apply(self.params, self.tokenize(texts))
+        from .layers import jit_apply
+
+        return jit_apply(self, self.module)(self.params,
+                                            self.tokenize(texts))

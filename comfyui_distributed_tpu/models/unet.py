@@ -201,11 +201,17 @@ def init_unet(
     sample_shape: tuple[int, int, int] = (64, 64, 4),
     context_len: int = 77,
     abstract: bool = False,
+    param_dtype=None,
 ):
     """Initialize params with a canonical dummy batch; returns (module, params).
 
     ``abstract=True`` returns a ShapeDtypeStruct tree (conversion template
-    — no multi-GB random init when every leaf is about to be replaced)."""
+    — no multi-GB random init when every leaf is about to be replaced).
+    ``param_dtype`` (e.g. ``jnp.bfloat16``) casts float params INSIDE the
+    init program: XLA fuses the cast per buffer, so peak device memory is
+    the cast tree plus one layer — never the full fp32 tree (an SDXL fp32
+    init plus a post-hoc cast transiently needs 15.6 GB; fused it's
+    ~5.5 GB, and inference weights want bf16 residency anyway)."""
     model = UNet2D(config)
     H, W, C = sample_shape
     x = jnp.zeros((1, H, W, C), jnp.float32)
@@ -215,8 +221,17 @@ def init_unet(
     # jit the init: eager tracing dispatches each initializer op through a
     # separate tiny XLA executable (~tens of seconds for a full UNet even
     # at toy sizes); one compiled program is an order of magnitude faster
+    init_fn = model.init if param_dtype is None else (
+        lambda *a: _cast_float_params(model.init(*a), param_dtype))
     if abstract:
-        params = jax.eval_shape(model.init, rng, x, t, ctx, y)
+        params = jax.eval_shape(init_fn, rng, x, t, ctx, y)
     else:
-        params = jax.jit(model.init)(rng, x, t, ctx, y)
+        params = jax.jit(init_fn)(rng, x, t, ctx, y)
     return model, params
+
+
+def _cast_float_params(params, dtype):
+    """Cast float leaves to ``dtype`` (shared by the init helpers)."""
+    return jax.tree_util.tree_map(
+        lambda p: p.astype(dtype)
+        if jnp.issubdtype(p.dtype, jnp.floating) else p, params)

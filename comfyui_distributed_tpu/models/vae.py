@@ -184,12 +184,23 @@ class AutoencoderKL:
         self.dec_params = jax.jit(self.decoder.init)(k2, lat)
         return self
 
-    def encode(self, images: jax.Array) -> jax.Array:
-        moments = self.encoder.apply(self.enc_params, images)
+    def encode(self, images: jax.Array, params=None) -> jax.Array:
+        """``params`` overrides the bundled encoder params — pipelines pass
+        weights as jit ARGUMENTS (closure capture would embed multi-GB
+        constants into the lowered MLIR; see pipeline ``_weights``).
+        The apply is jitted with params as an argument (``jit_apply``):
+        eager (node-level) calls get one program instead of per-op
+        dispatch, and inside an outer jit the call inlines."""
+        from .layers import jit_apply
+
+        moments = jit_apply(self, self.encoder, "_enc_fn")(
+            self.enc_params if params is None else params, images)
         mean, _logvar = jnp.split(moments, 2, axis=-1)
         return (mean - self.config.shift_factor) * self.config.scaling_factor
 
-    def decode(self, latents: jax.Array) -> jax.Array:
-        return self.decoder.apply(
-            self.dec_params,
+    def decode(self, latents: jax.Array, params=None) -> jax.Array:
+        from .layers import jit_apply
+
+        return jit_apply(self, self.decoder, "_dec_fn")(
+            self.dec_params if params is None else params,
             latents / self.config.scaling_factor + self.config.shift_factor)

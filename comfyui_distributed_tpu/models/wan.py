@@ -199,6 +199,9 @@ class WanModel(nn.Module):
     architecture unchanged."""
 
     config: WanConfig
+    # tensor-parallel rule family (parallel/tensor.py): separate q/k/v/o +
+    # ffn_0/ffn_2 naming — NOT the MMDiT fused-qkv layout
+    tp_family = "wan"
 
     @nn.compact
     def __call__(self, x, t, context, pooled=None,
@@ -258,16 +261,23 @@ class WanModel(nn.Module):
 
 def init_wan(config: WanConfig, rng: jax.Array,
              sample_fhw: tuple[int, int, int] = (5, 8, 8),
-             context_len: int = 16, abstract: bool = False):
+             context_len: int = 16, abstract: bool = False,
+             param_dtype=None):
+    """``param_dtype`` casts float params inside the fused init program
+    (see ``models/unet.init_unet``) — a 14B WAN never fits as fp32."""
+    from .unet import _cast_float_params
+
     model = WanModel(config)
     f, h, w = sample_fhw
     args = (rng, jnp.zeros((1, f, h, w, config.in_channels)),
             jnp.zeros((1,)),
             jnp.zeros((1, context_len, config.text_dim)),
             jnp.zeros((1, 16)))
+    init_fn = model.init if param_dtype is None else (
+        lambda *a: _cast_float_params(model.init(*a), param_dtype))
     if abstract:
-        return model, jax.eval_shape(model.init, *args)
-    return model, jax.jit(model.init)(*args)
+        return model, jax.eval_shape(init_fn, *args)
+    return model, jax.jit(init_fn)(*args)
 
 
 # ---------------------------------------------------------------------------

@@ -32,6 +32,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from flax import linen as nn
 
 
@@ -49,8 +50,11 @@ class WanVAEConfig:
     dtype: str = "float32"
 
     @classmethod
-    def wan(cls) -> "WanVAEConfig":
-        return cls()
+    def wan(cls, dtype: str = "bfloat16") -> "WanVAEConfig":
+        # bf16 compute: a 33×480×832 decode holds multiple ~[33,480,832,96]
+        # activation buffers — f32 needs >31 GB HBM (observed OOM on v5e),
+        # bf16 halves it; combined with decode_tiled it fits one chip
+        return cls(dtype=dtype)
 
     @classmethod
     def tiny(cls, **kw) -> "WanVAEConfig":
@@ -225,19 +229,37 @@ class WanVAEEncoder(nn.Module):
 
 
 class WanVAEDecoder(nn.Module):
+    """``stage`` (static) splits the decoder for tiled decode:
+
+    - ``"head"``: post-quant → conv_in → mid blocks (incl. the GLOBAL
+      SpatialAttention) at latent resolution — cheap, always whole-frame,
+      so tiling never changes the attention statistics;
+    - ``"tail"``: the upsampling stack + output conv — the memory-heavy
+      part (activations grow ×downscale² per level), safe to run on
+      spatial tiles because every op is a local conv;
+    - ``"all"``: both (the normal whole-frame decode; init uses this so
+      the param tree is identical regardless of how apply is staged).
+    """
+
     config: WanVAEConfig
 
     @nn.compact
-    def __call__(self, z: jax.Array) -> jax.Array:
+    def __call__(self, z: jax.Array, stage: str = "all") -> jax.Array:
         cfg = self.config
         dt = cfg.jnp_dtype
         dims = [cfg.base_dim * m for m in cfg.dim_mult]
-        z = nn.Dense(cfg.latent_channels, dtype=jnp.float32,
-                     name="post_quant")(z.astype(jnp.float32))
-        h = CausalConv3d(dims[-1], dtype=dt, name="conv_in")(z.astype(dt))
-        h = ResBlock3d(dims[-1], dt, name="mid_res1")(h)
-        h = SpatialAttention(dt, name="mid_attn")(h)
-        h = ResBlock3d(dims[-1], dt, name="mid_res2")(h)
+        h = z
+        if stage in ("all", "head"):
+            zq = nn.Dense(cfg.latent_channels, dtype=jnp.float32,
+                          name="post_quant")(z.astype(jnp.float32))
+            h = CausalConv3d(dims[-1], dtype=dt, name="conv_in")(
+                zq.astype(dt))
+            h = ResBlock3d(dims[-1], dt, name="mid_res1")(h)
+            h = SpatialAttention(dt, name="mid_attn")(h)
+            h = ResBlock3d(dims[-1], dt, name="mid_res2")(h)
+            if stage == "head":
+                return h
+        h = h.astype(dt)
         for level in reversed(range(len(dims))):
             for i in range(cfg.num_res_blocks + 1):
                 h = ResBlock3d(dims[level], dt,
@@ -266,7 +288,8 @@ class WanVAE3D:
         # jit once (params are traced args, so weight swaps don't stale it);
         # inside an outer jit these inline, standalone calls compile once
         self._enc_fn = jax.jit(self.encoder.apply)
-        self._dec_fn = jax.jit(self.decoder.apply)
+        self._dec_fn = jax.jit(self.decoder.apply,
+                               static_argnames=("stage",))
 
     def init(self, rng: jax.Array, frames: int = 5,
              image_hw: tuple[int, int] = (32, 32)) -> "WanVAE3D":
@@ -280,22 +303,87 @@ class WanVAE3D:
         self.dec_params = jax.jit(self.decoder.init)(k2, lat)
         return self
 
-    def encode(self, video: jax.Array) -> jax.Array:
+    def encode(self, video: jax.Array, params=None) -> jax.Array:
         """[B,T,H,W,C] → latents; a rank-4 [B,H,W,C] image is treated as
         a 1-frame video (the causal design's single-image case) and the
-        frame axis squeezed back out."""
+        frame axis squeezed back out. ``params`` overrides the bundled
+        encoder params (pipelines pass weights as jit arguments)."""
         single = video.ndim == 4
         if single:
             video = video[:, None]
-        moments = self._enc_fn(self.enc_params, video)
+        moments = self._enc_fn(
+            self.enc_params if params is None else params, video)
         mean, _ = jnp.split(moments, 2, axis=-1)
         lat = mean * self.config.scaling_factor
         return lat[:, 0] if single else lat
 
-    def decode(self, latents: jax.Array) -> jax.Array:
+    def decode(self, latents: jax.Array, params=None) -> jax.Array:
         single = latents.ndim == 4
         if single:
             latents = latents[:, None]
-        out = self._dec_fn(self.dec_params,
+        out = self._dec_fn(self.dec_params if params is None else params,
                            latents / self.config.scaling_factor)
         return out[:, 0] if single else out
+
+    def decode_tiled(self, latents: jax.Array, params=None,
+                     tile: int = 32, overlap: int = 8) -> jax.Array:
+        """Spatially-tiled decode: bound decoder activation memory for
+        large clips (the ComfyUI analogue is ``VAEDecodeTiled``; the
+        reference free-rides on it for big decodes — a 480p whole-frame
+        f32 decode needs >31 GB of activations on one chip).
+
+        Two stages (``WanVAEDecoder.stage``): the mid blocks — including
+        the decoder's GLOBAL spatial attention — run whole-frame at cheap
+        latent resolution, so tiling never changes attention statistics;
+        only the memory-heavy local-conv upsampling stack runs per tile.
+        Tiles overlap and blend with a linear feather; residual error is
+        confined to conv-halo bands at tile seams (same approximation
+        contract as ComfyUI's VAEDecodeTiled). The temporal axis stays
+        whole, so causal state is exact. Tile positions are static, so
+        this traces cleanly inside an outer jit, where XLA schedules the
+        tile decodes sequentially — exactly the memory bound we want.
+        """
+        B, f, h, w, c = latents.shape
+        if h <= tile and w <= tile:
+            return self.decode(latents, params=params)
+        p = self.dec_params if params is None else params
+        head = self._dec_fn(p, latents / self.config.scaling_factor,
+                            stage="head")          # [B,f,h,w,dims[-1]]
+        s = self.config.downscale
+        step = max(1, tile - overlap)
+
+        def starts(full):
+            if full <= tile:
+                return [0]
+            out = list(range(0, full - tile, step)) + [full - tile]
+            return sorted(set(out))
+
+        def ramp(n_lat, lo_feather, hi_feather):
+            """Per-pixel weight along one axis of a decoded tile."""
+            n = n_lat * s
+            wgt = np.ones((n,), np.float32)
+            o = overlap * s
+            if lo_feather and o:
+                wgt[:o] = np.linspace(1.0 / (o + 1), 1.0, o,
+                                      dtype=np.float32)
+            if hi_feather and o:   # and-o: wgt[-0:] is the WHOLE array
+                wgt[-o:] = np.linspace(1.0, 1.0 / (o + 1), o,
+                                       dtype=np.float32)
+            return wgt
+
+        F_out = (f - 1) * self.config.temporal_downscale + 1
+        acc = jnp.zeros((B, F_out, h * s, w * s, self.config.in_channels),
+                        jnp.float32)
+        wsum = jnp.zeros((h * s, w * s, 1), jnp.float32)
+        for y0 in starts(h):
+            for x0 in starts(w):
+                y1, x1 = min(y0 + tile, h), min(x0 + tile, w)
+                px = self._dec_fn(p, head[:, :, y0:y1, x0:x1, :],
+                                  stage="tail").astype(jnp.float32)
+                wy = ramp(y1 - y0, y0 > 0, y1 < h)
+                wx = ramp(x1 - x0, x0 > 0, x1 < w)
+                wgt = jnp.asarray(wy[:, None, None] * wx[None, :, None])
+                acc = acc.at[:, :, y0 * s:y1 * s, x0 * s:x1 * s, :].add(
+                    px * wgt)
+                wsum = wsum.at[y0 * s:y1 * s, x0 * s:x1 * s, :].add(wgt)
+        return acc / wsum

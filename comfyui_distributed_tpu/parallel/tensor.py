@@ -45,6 +45,18 @@ UNET_TP_RULES: tuple[tuple[str, tuple], ...] = (
     (r".*ff/proj_out/kernel$", ("tp", None)),
 )
 
+# WAN-class video DiT (models/wan.py): separate q/k/v/o Dense layers in
+# self/cross attention, ffn_0 (up) / ffn_2 (down). The q/k RMSNorms
+# normalize over the FULL feature dim, so GSPMD inserts the partial-sum
+# all-reduce there; attention itself stays head-local because the column
+# split lands on the head axis after the [B,N,H,D] reshape.
+WAN_TP_RULES: tuple[tuple[str, tuple], ...] = (
+    (r".*(self|cross)_attn/[qkv]/kernel$", (None, "tp")),   # column
+    (r".*(self|cross)_attn/o/kernel$",     ("tp", None)),   # row
+    (r".*ffn_0/kernel$",                   (None, "tp")),   # column
+    (r".*ffn_2/kernel$",                   ("tp", None)),   # row
+)
+
 
 def _path_str(path) -> str:
     return "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
@@ -82,6 +94,44 @@ def shard_params(
         return jax.device_put(leaf, NamedSharding(mesh, spec))
 
     return jax.tree_util.tree_map_with_path(place, params)
+
+
+def require_tp_match(params: Any, mesh: Mesh,
+                     rules: Sequence[tuple[str, tuple]], axis: str,
+                     family: str) -> None:
+    """Fail fast when no parameter matches the TP rules: a model that
+    needs this mode would OOM every chip with fully-replicated weights,
+    and the failure would otherwise surface as an opaque allocator error
+    mid-compile."""
+    if mesh.shape[axis] <= 1:
+        return
+    summary = tp_sharding_summary(params, mesh, rules, axis)
+    if summary["sharded"] == 0:
+        raise ValueError(
+            f"no parameters matched the {family!r} TP rules — a model "
+            f"this mode exists for would OOM every chip with "
+            f"fully-replicated weights")
+
+
+def tp_fanout_call(jitted, weight_args: tuple, mesh: Mesh, dp_axis: str,
+                   B: int):
+    """Shared dp×tp call wrapper: folds a base key into ``B`` per-sample
+    keys placed over ``dp``, and supplies the (tp-placed) weight args to
+    the jitted program. ``.jitted``/``.weights`` expose the AOT handles
+    (same contract as ``diffusion.pipeline.bind_weights``)."""
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec
+
+    key_sharding = NamedSharding(mesh, PartitionSpec(dp_axis))
+
+    def call(key, *rest):
+        keys = jax.vmap(lambda i: jax.random.fold_in(key, i))(jnp.arange(B))
+        return jitted(*weight_args, jax.device_put(keys, key_sharding),
+                      *rest)
+
+    call.jitted = jitted
+    call.weights = weight_args
+    return call
 
 
 def tp_sharding_summary(params: Any, mesh: Mesh,

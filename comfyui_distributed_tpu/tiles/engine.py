@@ -26,7 +26,8 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..diffusion.guidance import cfg_denoiser
-from ..diffusion.pipeline import GenerationSpec, Txt2ImgPipeline, make_sigma_ladder
+from ..diffusion.pipeline import (GenerationSpec, Txt2ImgPipeline,
+                                  bind_weights, make_sigma_ladder)
 from ..diffusion.samplers import sample
 from ..ops.blend import composite_tiles, extract_tiles, feather_mask
 from ..ops.resize import upscale_image
@@ -93,7 +94,7 @@ class TileUpscaler:
 
     def _img2img_tiles(self, tiles, key, context, uncond_context, y, uncond_y,
                        spec: UpscaleSpec, sigmas, global_idx,
-                       tile_masks=None, hint_tiles=None):
+                       tile_masks=None, hint_tiles=None, weights=None):
         """img2img a [n, ch, cw, C] tile batch on one shard.
 
         Per-tile noise keys fold in the *global* tile index, so the output
@@ -110,7 +111,9 @@ class TileUpscaler:
         pipe = self.pipeline
         vae = pipe.vae
         n = tiles.shape[0]
-        latents = vae.encode(tiles * 2.0 - 1.0)
+        latents = vae.encode(
+            tiles * 2.0 - 1.0,
+            params=None if weights is None else weights["vae_enc"])
 
         keys = jax.vmap(lambda i: jax.random.fold_in(key, i))(global_idx)
         noise = jax.vmap(
@@ -122,7 +125,8 @@ class TileUpscaler:
         bc = lambda a: jnp.broadcast_to(a, (n,) + a.shape[1:])
         if gspec.guidance_scale != 1.0:
             denoise_fn = cfg_denoiser(
-                lambda ctx, yy: pipe._denoiser(ctx, yy, hint=hint_tiles),
+                lambda ctx, yy: pipe._denoiser(ctx, yy, hint=hint_tiles,
+                                               weights=weights),
                 bc(context), bc(uncond_context), gspec.guidance_scale,
                 None if y is None else bc(y),
                 None if uncond_y is None else bc(uncond_y),
@@ -130,11 +134,12 @@ class TileUpscaler:
         else:
             denoise_fn = pipe._denoiser(bc(context),
                                         None if y is None else bc(y),
-                                        hint=hint_tiles)
+                                        hint=hint_tiles, weights=weights)
         # sampler key uses a sentinel fold well above any global tile index
         x0 = sample(gspec.sampler, denoise_fn, noised, sigmas,
                     key=jax.random.fold_in(key, jnp.uint32(0xFFFFFFFF)))
-        out = vae.decode(x0)
+        out = vae.decode(
+            x0, params=None if weights is None else weights["vae_dec"])
         out = jnp.clip(out / 2.0 + 0.5, 0.0, 1.0)
         if tile_masks is not None:
             out = tiles * (1.0 - tile_masks) + out * tile_masks
@@ -170,7 +175,7 @@ class TileUpscaler:
             grid.image_w * hf, grid.image_h * hf,
             grid.tile_w * hf, grid.tile_h * hf, grid.padding * hf)
 
-        def process_shard(tiles, stiles, htiles, key, context,
+        def process_shard(weights, tiles, stiles, htiles, key, context,
                           uncond_context, y, uncond_y):
             # tiles: [per_shard, ch, cw, C] block of this shard
             shard_i = jax.lax.axis_index(axis)
@@ -181,12 +186,14 @@ class TileUpscaler:
                 spec, sigmas, global_idx,
                 tile_masks=stiles if with_spatial else None,
                 hint_tiles=htiles if with_control else None,
+                weights=weights,
             )
 
         sharded = jax.shard_map(
             process_shard,
             mesh=mesh,
-            in_specs=(P(axis, None, None, None), P(axis, None, None, None),
+            in_specs=(P(),
+                      P(axis, None, None, None), P(axis, None, None, None),
                       P(axis, None, None, None),
                       P(), P(None, None, None),
                       P(None, None, None), P(None, None), P(None, None)),
@@ -202,7 +209,7 @@ class TileUpscaler:
                 stacked = jnp.concatenate([stacked, pad], axis=0)
             return stacked
 
-        def run(images, key, context, uncond_context, y, uncond_y,
+        def run(weights, images, key, context, uncond_context, y, uncond_y,
                 spatial=None, hint=None):
             up = upscale_image(images, spec.scale, spec.resize_method)
             all_tiles = tile_and_pad(lambda im: extract_tiles(im, grid),
@@ -219,7 +226,7 @@ class TileUpscaler:
             else:
                 htiles = jnp.zeros(
                     (all_tiles.shape[0], 8, 8, 1), all_tiles.dtype)
-            done = sharded(all_tiles, stiles, htiles, key, context,
+            done = sharded(weights, all_tiles, stiles, htiles, key, context,
                            uncond_context, y, uncond_y)
             done = done[:total]
             outs = [
@@ -230,7 +237,10 @@ class TileUpscaler:
             ]
             return jnp.stack(outs, axis=0)
 
-        return jax.jit(run)
+        jitted = jax.jit(run)
+        weights = self.pipeline._weights(img2img=True)
+
+        return bind_weights(jitted, weights)
 
     def upscale(
         self,
@@ -351,7 +361,8 @@ class TileUpscaler:
         else:
             all_stiles = jnp.ones(all_tiles.shape[:3] + (1,), all_tiles.dtype)
 
-        def process_shard(tiles, stiles, start, key, ctx, unc, yy, uyy):
+        def process_shard(weights, tiles, stiles, start, key, ctx, unc,
+                          yy, uyy):
             shard_i = jax.lax.axis_index(axis)
             global_idx = start + shard_i * per_shard + jnp.arange(per_shard)
             return self._img2img_tiles(
@@ -359,16 +370,20 @@ class TileUpscaler:
                 yy if has_y else None, uyy if has_y else None,
                 spec, sigmas, global_idx,
                 tile_masks=stiles if use_spatial else None,
+                weights=weights,
             )
 
-        sharded = jax.jit(jax.shard_map(
+        jitted = jax.jit(jax.shard_map(
             process_shard,
             mesh=mesh,
-            in_specs=(P(axis, None, None, None), P(axis, None, None, None),
+            in_specs=(P(),
+                      P(axis, None, None, None), P(axis, None, None, None),
                       P(), P(), P(None, None, None),
                       P(None, None, None), P(None, None), P(None, None)),
             out_specs=P(axis, None, None, None),
         ))
+        wts = self.pipeline._weights(img2img=True)
+        sharded = lambda *a: jitted(wts, *a)
         key = jax.random.key(seed)
 
         def run_range(start: int, end: int):

@@ -78,3 +78,11 @@ SEND_BACKOFF_BASE = _env_float("CDT_SEND_BACKOFF_BASE", 0.5)
 AXIS_DATA = "dp"
 AXIS_TENSOR = "tp"
 AXIS_SEQUENCE = "sp"
+
+# --- VAE decode tiling ------------------------------------------------------
+# 3D-VAE decodes switch to spatially-tiled mode when the latent frame area
+# exceeds this (latent pixels): a 480p WAN clip decode holds >31 GB of f32
+# activations untiled. 0 disables the threshold (always whole-frame).
+VAE_TILE_THRESHOLD = int(os.environ.get("CDT_VAE_TILE_THRESHOLD", 48 * 48))
+VAE_TILE = int(os.environ.get("CDT_VAE_TILE", 32))
+VAE_TILE_OVERLAP = int(os.environ.get("CDT_VAE_TILE_OVERLAP", 8))

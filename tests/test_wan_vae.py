@@ -213,3 +213,47 @@ class TestSingleImageAdapter:
         assert sp.shape == (1, 5, 16, 16, 3)
         np.testing.assert_allclose(np.asarray(sp), np.asarray(ref),
                                    atol=2e-4, rtol=2e-3)
+
+
+class TestTiledDecode:
+    def test_head_tail_staging_is_exact(self):
+        """Unsplit head→tail composition must equal the whole decode
+        bit-for-bit — the stage split itself changes no math; only tile
+        seams are approximate."""
+        vae = WanVAE3D(TINY).init(jax.random.key(0), frames=5,
+                                  image_hw=(8, 8))
+        lat = jax.random.normal(jax.random.key(1), (1, 3, 12, 12,
+                                                    TINY.latent_channels))
+        whole = np.asarray(vae.decode(lat))
+        head = vae._dec_fn(vae.dec_params, lat / TINY.scaling_factor,
+                           stage="head")
+        staged = np.asarray(vae._dec_fn(vae.dec_params, head,
+                                        stage="tail"))
+        np.testing.assert_allclose(staged, whole, rtol=1e-5, atol=1e-5)
+
+    def test_tiled_matches_whole_frame(self):
+        """Tiled ≈ whole decode. The mid attention runs whole-frame (see
+        decode_tiled docstring) so only conv halos at tile seams differ —
+        bounded loosely here because random init is the worst case for
+        halo decay (trained weights are far smoother)."""
+        vae = WanVAE3D(TINY).init(jax.random.key(0), frames=5,
+                                  image_hw=(8, 8))
+        lat = jax.random.normal(jax.random.key(1), (1, 3, 12, 12,
+                                                    TINY.latent_channels))
+        whole = np.asarray(vae.decode(lat))
+        tiled = np.asarray(vae.decode_tiled(lat, tile=8, overlap=4))
+        assert tiled.shape == whole.shape
+        assert np.mean(np.abs(tiled - whole)) < 5e-2
+        # more overlap → strictly better agreement
+        tiled6 = np.asarray(vae.decode_tiled(lat, tile=10, overlap=6))
+        assert (np.mean(np.abs(tiled6 - whole))
+                <= np.mean(np.abs(tiled - whole)))
+
+    def test_small_latent_bypasses_tiling(self):
+        vae = WanVAE3D(TINY).init(jax.random.key(0), frames=5,
+                                  image_hw=(8, 8))
+        lat = jax.random.normal(jax.random.key(2), (1, 3, 4, 4,
+                                                    TINY.latent_channels))
+        np.testing.assert_allclose(
+            np.asarray(vae.decode_tiled(lat, tile=8)),
+            np.asarray(vae.decode(lat)), rtol=1e-6, atol=1e-6)
