@@ -179,7 +179,24 @@ def run_benchmark(steps: int, runs: int | None, force_cpu: bool) -> dict:
     t0 = time.perf_counter()
     compiled = fn.jitted.lower(fn.weights, *args).compile()
     compile_s = time.perf_counter() - t0
-    total_flops = _cost_analysis_flops(compiled)
+    xla_flops = _cost_analysis_flops(compiled)
+
+    # analytic matmul+conv count: XLA's TPU cost analysis drops conv
+    # FLOPs that lower into custom fusions (~10× under for SDXL), which
+    # would make the MFU figure meaningless. The jaxpr walk counts the
+    # per-shard program (shard_map body once) = per-chip work.
+    total_flops, flops_source = xla_flops, "xla_cost_analysis"
+    try:
+        from comfyui_distributed_tpu.utils.flops import estimate_flops
+
+        # × n_dev: the walker counts the shard_map body once (= one
+        # chip's work); the whole program runs it on every chip
+        analytic = estimate_flops(fn.jitted, fn.weights, *args) * n_dev
+        if analytic and (not xla_flops or analytic > xla_flops):
+            total_flops, flops_source = analytic, "analytic_jaxpr"
+    except Exception as e:  # diagnostics must never sink the benchmark
+        print(f"[bench] analytic flops estimate failed: {e}",
+              file=sys.stderr)
 
     # warmup run (first execution pays allocator/init overhead)
     jax.block_until_ready(compiled(fn.weights, *args))
@@ -233,6 +250,7 @@ def run_benchmark(steps: int, runs: int | None, force_cpu: bool) -> dict:
         result["vs_baseline_note"] = note
     if flops_per_image:
         result["model_flops_per_image"] = round(flops_per_image)
+        result["flops_source"] = flops_source
     if mfu is not None:
         result["mfu"] = round(mfu, 4)
         result["peak_flops_per_chip_bf16"] = peak

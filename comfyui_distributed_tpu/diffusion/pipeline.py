@@ -268,16 +268,16 @@ class Txt2ImgPipeline:
         # weights lead the argument list (replicated pytree — P() broadcasts
         # over its leaves); passing them as arguments keeps multi-GB params
         # OUT of the lowered module (see _weights)
+        # shard_body's trailing defaults (hint=None, token=None) bind the
+        # shorter arities directly; only progress-WITHOUT-control needs a
+        # wrapper, because there the 7th positional must skip `hint`
+        per_shard = shard_body
         in_specs = (P(), P(), P(None, None, None), P(None, None, None),
                     P(None, None), P(None, None))
         if has_control and progress:
-            per_shard = (lambda w, key, c, u, y_, uy, hint, token:
-                         shard_body(w, key, c, u, y_, uy, hint, token))
             in_specs += (P(None, None, None, None), P())
         elif has_control:
             # control hint rides as a replicated trailing argument
-            per_shard = (lambda w, key, c, u, y_, uy, hint:
-                         shard_body(w, key, c, u, y_, uy, hint))
             in_specs += (P(None, None, None, None),)
         elif progress:
             # progress token: replicated int32 scalar, traced so one
@@ -285,9 +285,6 @@ class Txt2ImgPipeline:
             per_shard = (lambda w, key, c, u, y_, uy, token:
                          shard_body(w, key, c, u, y_, uy, None, token))
             in_specs += (P(),)
-        else:
-            per_shard = (lambda w, key, c, u, y_, uy:
-                         shard_body(w, key, c, u, y_, uy))
         f = jax.shard_map(
             per_shard, mesh=mesh, in_specs=in_specs,
             out_specs=P(axis, None, None, None),
@@ -326,14 +323,10 @@ class Txt2ImgPipeline:
                 hint=hint, weights=weights,
             )
 
-        if has_control:
-            per_shard = (lambda w, im, key, c, u, y_, uy, hint:
-                         shard_body(w, im, key, c, u, y_, uy, hint))
-            in_specs = base_specs + (P(None, None, None, None),)
-        else:
-            per_shard = (lambda w, im, key, c, u, y_, uy:
-                         shard_body(w, im, key, c, u, y_, uy))
-            in_specs = base_specs
+        # shard_body's hint=None default binds both arities directly
+        per_shard = shard_body
+        in_specs = (base_specs + (P(None, None, None, None),)
+                    if has_control else base_specs)
         f = jax.shard_map(
             per_shard, mesh=mesh, in_specs=in_specs,
             out_specs=P(axis, None, None, None),

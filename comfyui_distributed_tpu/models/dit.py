@@ -394,7 +394,7 @@ def init_dit(config: DiTConfig, rng: jax.Array,
     ``param_dtype`` casts float params inside the fused init program
     (see ``models/unet.init_unet``) — bf16 residency is what lets a
     FLUX-class model fit accelerator HBM at all."""
-    from .unet import _cast_float_params
+    from .unet import casting_init
 
     model = DiT(config)
     h, w = sample_hw
@@ -402,8 +402,7 @@ def init_dit(config: DiTConfig, rng: jax.Array,
     t = jnp.zeros((1,))
     ctx = jnp.zeros((1, context_len, config.context_dim))
     pooled = jnp.zeros((1, config.pooled_dim))
-    init_fn = model.init if param_dtype is None else (
-        lambda *a: _cast_float_params(model.init(*a), param_dtype))
+    init_fn = casting_init(model.init, param_dtype)
     if abstract:
         params = jax.eval_shape(init_fn, rng, x, t, ctx, pooled)
     else:

@@ -221,8 +221,7 @@ def init_unet(
     # jit the init: eager tracing dispatches each initializer op through a
     # separate tiny XLA executable (~tens of seconds for a full UNet even
     # at toy sizes); one compiled program is an order of magnitude faster
-    init_fn = model.init if param_dtype is None else (
-        lambda *a: _cast_float_params(model.init(*a), param_dtype))
+    init_fn = casting_init(model.init, param_dtype)
     if abstract:
         params = jax.eval_shape(init_fn, rng, x, t, ctx, y)
     else:
@@ -235,3 +234,13 @@ def _cast_float_params(params, dtype):
     return jax.tree_util.tree_map(
         lambda p: p.astype(dtype)
         if jnp.issubdtype(p.dtype, jnp.floating) else p, params)
+
+
+def casting_init(init_fn, param_dtype):
+    """Wrap a flax ``init`` so float params are cast to ``param_dtype``
+    inside the same compiled program (fused, per-buffer — the full-size
+    fp32 tree never materializes). No-op when ``param_dtype`` is None.
+    Shared by init_unet / init_dit / init_wan."""
+    if param_dtype is None:
+        return init_fn
+    return lambda *a: _cast_float_params(init_fn(*a), param_dtype)

@@ -265,7 +265,7 @@ def init_wan(config: WanConfig, rng: jax.Array,
              param_dtype=None):
     """``param_dtype`` casts float params inside the fused init program
     (see ``models/unet.init_unet``) — a 14B WAN never fits as fp32."""
-    from .unet import _cast_float_params
+    from .unet import casting_init
 
     model = WanModel(config)
     f, h, w = sample_fhw
@@ -273,8 +273,7 @@ def init_wan(config: WanConfig, rng: jax.Array,
             jnp.zeros((1,)),
             jnp.zeros((1, context_len, config.text_dim)),
             jnp.zeros((1, 16)))
-    init_fn = model.init if param_dtype is None else (
-        lambda *a: _cast_float_params(model.init(*a), param_dtype))
+    init_fn = casting_init(model.init, param_dtype)
     if abstract:
         return model, jax.eval_shape(init_fn, *args)
     return model, jax.jit(init_fn)(*args)

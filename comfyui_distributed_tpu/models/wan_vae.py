@@ -346,16 +346,26 @@ class WanVAE3D:
         B, f, h, w, c = latents.shape
         if h <= tile and w <= tile:
             return self.decode(latents, params=params)
+        if overlap >= tile:
+            # env-configurable (CDT_VAE_TILE*) — fail fast with a clear
+            # message instead of a trace-time shape error / step-1 blowup
+            raise ValueError(
+                f"vae tile overlap ({overlap}) must be smaller than the "
+                f"tile ({tile})")
         p = self.dec_params if params is None else params
         head = self._dec_fn(p, latents / self.config.scaling_factor,
                             stage="head")          # [B,f,h,w,dims[-1]]
         s = self.config.downscale
         step = max(1, tile - overlap)
+        # per-axis tile size: an axis smaller than `tile` is untiled, so
+        # every extracted tile has identical shape — the lax.map below
+        # requires it
+        th, tw = min(tile, h), min(tile, w)
 
-        def starts(full):
-            if full <= tile:
+        def starts(full, t):
+            if full <= t:
                 return [0]
-            out = list(range(0, full - tile, step)) + [full - tile]
+            out = list(range(0, full - t, step)) + [full - t]
             return sorted(set(out))
 
         def ramp(n_lat, lo_feather, hi_feather):
@@ -371,19 +381,30 @@ class WanVAE3D:
                                        dtype=np.float32)
             return wgt
 
+        positions = [(y0, x0) for y0 in starts(h, th)
+                     for x0 in starts(w, tw)]
+        tiles_in = jnp.stack(
+            [head[:, :, y0:y0 + th, x0:x0 + tw, :] for y0, x0 in positions])
+
+        # lax.map = hard sequentialization: unrolled tile decodes leave
+        # XLA free to interleave them, and their remat/norm temporaries
+        # then coexist (observed: 12 unrolled 480p tiles → 33 GB HBM).
+        # Mapped, one tile's activations live at a time.
+        tiles_out = jax.lax.map(
+            lambda ht: self._dec_fn(p, ht, stage="tail").astype(
+                jnp.float32),
+            tiles_in)                      # [N,B,F,th·s,tw·s,3]
+
         F_out = (f - 1) * self.config.temporal_downscale + 1
         acc = jnp.zeros((B, F_out, h * s, w * s, self.config.in_channels),
                         jnp.float32)
         wsum = jnp.zeros((h * s, w * s, 1), jnp.float32)
-        for y0 in starts(h):
-            for x0 in starts(w):
-                y1, x1 = min(y0 + tile, h), min(x0 + tile, w)
-                px = self._dec_fn(p, head[:, :, y0:y1, x0:x1, :],
-                                  stage="tail").astype(jnp.float32)
-                wy = ramp(y1 - y0, y0 > 0, y1 < h)
-                wx = ramp(x1 - x0, x0 > 0, x1 < w)
-                wgt = jnp.asarray(wy[:, None, None] * wx[None, :, None])
-                acc = acc.at[:, :, y0 * s:y1 * s, x0 * s:x1 * s, :].add(
-                    px * wgt)
-                wsum = wsum.at[y0 * s:y1 * s, x0 * s:x1 * s, :].add(wgt)
+        for i, (y0, x0) in enumerate(positions):
+            wy = ramp(th, y0 > 0, y0 + th < h)
+            wx = ramp(tw, x0 > 0, x0 + tw < w)
+            wgt = jnp.asarray(wy[:, None, None] * wx[None, :, None])
+            acc = acc.at[:, :, y0 * s:(y0 + th) * s,
+                         x0 * s:(x0 + tw) * s, :].add(tiles_out[i] * wgt)
+            wsum = wsum.at[y0 * s:(y0 + th) * s,
+                           x0 * s:(x0 + tw) * s, :].add(wgt)
         return acc / wsum

@@ -382,8 +382,7 @@ class TileUpscaler:
                       P(None, None, None), P(None, None), P(None, None)),
             out_specs=P(axis, None, None, None),
         ))
-        wts = self.pipeline._weights(img2img=True)
-        sharded = lambda *a: jitted(wts, *a)
+        sharded = bind_weights(jitted, self.pipeline._weights(img2img=True))
         key = jax.random.key(seed)
 
         def run_range(start: int, end: int):

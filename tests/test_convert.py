@@ -539,10 +539,12 @@ class TestVAEConversion:
         lat = vae.encode(jnp.zeros((1, 16, 16, 3), jnp.float32))
         np.testing.assert_allclose(
             np.asarray(lat), (mean - 0.1159) * 0.3611, atol=1e-5)
-        # decode applies the inverse affine before the decoder
+        # decode applies the inverse affine before the decoder.
+        # (decode is jitted while this reference apply is eager, so the
+        # comparison carries fusion-reordering ULP noise)
         raw = vae.decoder.apply(vae.dec_params, z / 0.3611 + 0.1159)
         np.testing.assert_allclose(np.asarray(vae.decode(z)),
-                                   np.asarray(raw), atol=1e-6)
+                                   np.asarray(raw), atol=1e-5)
 
 
 class TestLayoutDetection:
