@@ -190,7 +190,8 @@ class Txt2ImgPipeline:
                            spec: GenerationSpec, batch: int, sigmas: jax.Array,
                            init_latent: Optional[jax.Array] = None,
                            hint: Optional[jax.Array] = None,
-                           progress=None, weights=None):
+                           progress=None, weights=None,
+                           inpaint_mask: Optional[jax.Array] = None):
         """Single-shard work: noise → sampler scan → VAE decode.
 
         ``init_latent`` switches to img2img: the source latent is noised
@@ -198,7 +199,11 @@ class Txt2ImgPipeline:
         noise (k-diffusion img2img convention). ``hint`` feeds the
         pipeline's ControlNet (``with_control``). ``progress`` is an
         optional ``(token, shard_index)`` pair that streams per-step x0
-        previews to the host (``diffusion/progress.wrap_denoiser``)."""
+        previews to the host (``diffusion/progress.wrap_denoiser``).
+        ``inpaint_mask`` (latent-res [.,h,w,1], 1 = regenerate) composites
+        the source latent back into every denoised estimate — ComfyUI's
+        SetLatentNoiseMask semantics — so unmasked regions are pinned to
+        the source through the whole sampling trajectory."""
         k_noise, k_samp = jax.random.split(key)
         if init_latent is None:
             lat_h = spec.height // self.vae.config.downscale
@@ -227,6 +232,11 @@ class Txt2ImgPipeline:
                 None if y is None else jnp.broadcast_to(y, (batch,) + y.shape[1:]),
                 hint=hint, weights=weights,
             )
+        if inpaint_mask is not None and init_latent is not None:
+            base, src, m = denoise, init_latent, inpaint_mask
+
+            def denoise(xx, sigma):      # noqa: F811 — deliberate re-wrap
+                return base(xx, sigma) * m + src * (1.0 - m)
         if progress is not None:
             from .progress import wrap_denoiser
 
@@ -295,14 +305,21 @@ class Txt2ImgPipeline:
         return bind_weights(jitted, weights)
 
     def img2img_fn(self, mesh: Mesh, spec: GenerationSpec,
-                   axis: str = constants.AXIS_DATA):
+                   axis: str = constants.AXIS_DATA,
+                   with_mask: bool = False):
         """Compile the SPMD img2img program over ``mesh[axis]``.
 
         The source batch is replicated; every shard encodes it, noises it
         at the partial ladder's head (``spec.denoise`` sets the fraction)
         with its participant-folded key, samples the tail, and decodes —
         N seed-varied edits of the same source in one step-time (the
-        img2img analogue of the reference's seed-offset fan-out)."""
+        img2img analogue of the reference's seed-offset fan-out).
+
+        ``with_mask`` adds a trailing image-res mask input [B,H,W,1]
+        (1 = repaint): the program downsamples it to latent resolution
+        and pins unmasked regions to the source latent every step
+        (latent-composite inpainting, ComfyUI SetLatentNoiseMask
+        semantics)."""
         has_y = self.unet.config.adm_in_channels > 0
         has_control = getattr(self, "_control", None) is not None
         sigmas = make_sigma_ladder(spec, self.schedule)
@@ -312,21 +329,44 @@ class Txt2ImgPipeline:
                       P(None, None, None), P(None, None), P(None, None))
 
         def shard_body(weights, images, key, context, uncond_context, y,
-                       uncond_y, hint=None):
+                       uncond_y, hint=None, mask=None):
             k = participant_key(key, axis)
-            lat = self.vae.encode(images.astype(jnp.float32) * 2.0 - 1.0,
+            images = images.astype(jnp.float32)
+            lat = self.vae.encode(images * 2.0 - 1.0,
                                   params=weights["vae_enc"])
-            return self._sample_and_decode(
+            m = None
+            if mask is not None:
+                m = jax.image.resize(
+                    mask.astype(jnp.float32),
+                    (lat.shape[0], lat.shape[1], lat.shape[2], 1),
+                    method="bilinear")
+            out = self._sample_and_decode(
                 k, context, uncond_context,
                 y if has_y else None, uncond_y if has_y else None,
                 spec, images.shape[0], sigmas, init_latent=lat,
-                hint=hint, weights=weights,
+                hint=hint, weights=weights, inpaint_mask=m,
             )
+            if mask is not None:
+                # pixel-level composite: the latent pinning keeps seams
+                # coherent, but the VAE decoder's global mid-attention
+                # still bleeds repainted content everywhere — unmasked
+                # pixels must be EXACTLY the source (the final composite
+                # every inpainting UI performs)
+                out = images * (1.0 - mask) + out * mask
+            return out
 
-        # shard_body's hint=None default binds both arities directly
+        # shard_body's trailing defaults bind the shorter arities
+        # directly; mask-without-control needs a wrapper to skip `hint`
         per_shard = shard_body
-        in_specs = (base_specs + (P(None, None, None, None),)
-                    if has_control else base_specs)
+        in_specs = base_specs
+        if has_control:
+            in_specs += (P(None, None, None, None),)
+        if with_mask:
+            if not has_control:
+                per_shard = (lambda w, im, key, c, u, y_, uy, mask:
+                             shard_body(w, im, key, c, u, y_, uy,
+                                        None, mask))
+            in_specs += (P(None, None, None, None),)
         f = jax.shard_map(
             per_shard, mesh=mesh, in_specs=in_specs,
             out_specs=P(axis, None, None, None),
@@ -347,30 +387,39 @@ class Txt2ImgPipeline:
         y: Optional[jax.Array] = None,
         uncond_y: Optional[jax.Array] = None,
         hint: Optional[jax.Array] = None,
+        mask: Optional[jax.Array] = None,
     ) -> jax.Array:
-        """One-shot img2img (value-keyed compile cache)."""
+        """One-shot img2img (value-keyed compile cache). ``mask``
+        [B,H,W,1] or [B,H,W] (1 = repaint) switches to inpainting."""
+        if mask is not None:
+            mask = jnp.asarray(mask, jnp.float32)
+            if mask.ndim == 3:
+                mask = mask[..., None]
         if not hasattr(self, "_i2i_cache"):
             self._i2i_cache: "dict[tuple, Any]" = {}
         key = (self._mesh_cache_key(mesh), spec, tuple(images.shape),
-               None if hint is None else tuple(hint.shape))
+               None if hint is None else tuple(hint.shape),
+               mask is not None)
         fn = self._i2i_cache.get(key)
         if fn is None:
             if len(self._i2i_cache) >= self._CACHE_MAX:
                 self._i2i_cache.pop(next(iter(self._i2i_cache)))
-            fn = self.img2img_fn(mesh, spec)
+            fn = self.img2img_fn(mesh, spec, with_mask=mask is not None)
             self._i2i_cache[key] = fn
         if y is None:
             adm = self.unet.config.adm_in_channels
             y = jnp.zeros((1, max(adm, 1)), jnp.float32)
         if uncond_y is None:
             uncond_y = jnp.zeros_like(y)
-        args = (jnp.asarray(images, jnp.float32), jax.random.key(seed),
-                context, uncond_context, y, uncond_y)
+        args = [jnp.asarray(images, jnp.float32), jax.random.key(seed),
+                context, uncond_context, y, uncond_y]
         if getattr(self, "_control", None) is not None:
             if hint is None:
                 raise ValueError("pipeline carries a ControlNet but no "
                                  "hint was given")
-            return fn(*args, jnp.asarray(hint, jnp.float32))
+            args.append(jnp.asarray(hint, jnp.float32))
+        if mask is not None:
+            args.append(mask)
         return fn(*args)
 
     def generate(

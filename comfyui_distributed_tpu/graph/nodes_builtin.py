@@ -174,6 +174,22 @@ class AudioBatchDivider(NodeDef):
         return tuple(chunks)
 
 
+@register_node("SolidMask")
+class SolidMask(NodeDef):
+    """Constant-value mask (ComfyUI's SolidMask): the building block for
+    inpaint regions and USDU spatial conditioning."""
+
+    INPUTS = {"value": "FLOAT", "width": "INT", "height": "INT"}
+    RETURNS = ("MASK",)
+
+    def execute(self, value: float = 1.0, width: int = 64,
+                height: int = 64, **_):
+        import numpy as np
+
+        return (np.full((1, int(height), int(width)),
+                        float(value), np.float32),)
+
+
 @register_node("DistributedEmptyImage")
 class DistributedEmptyImage(NodeDef):
     """0-batch IMAGE placeholder for delegate-only masters (reference
@@ -920,28 +936,85 @@ class TPUImg2Img(NodeDef):
                 steps: int, cfg: float, denoise: float,
                 sampler_name: str = "euler", scheduler: str = "karras",
                 mesh=None, **_):
-        from ..diffusion.pipeline import GenerationSpec
-        from ..parallel.mesh import build_mesh
-
-        if mesh is None:
-            mesh = build_mesh({"dp": len(jax.devices())})
-        images = jnp.asarray(image, jnp.float32)
-        if images.ndim == 3:
-            images = images[None]
-        B, H, W, _ = images.shape
-        spec = GenerationSpec(
-            height=int(H), width=int(W), steps=int(steps),
-            sampler=sampler_name, scheduler=scheduler,
-            guidance_scale=float(cfg), per_device_batch=B,
-            denoise=float(denoise),
-        )
-        adm = model.pipeline.unet.config.adm_in_channels
-        y = _adm_from_cond(positive, adm) if adm else None
-        uy = _adm_from_cond(negative, adm) if adm else None
-        pipeline, hint = _control_from_cond(model.pipeline, positive, H, W)
+        mesh, images, spec, y, uy, pipeline, hint = _i2i_setup(
+            model, image, positive, negative, steps, cfg, denoise,
+            sampler_name, scheduler, mesh)
         out = pipeline.img2img(
             mesh, spec, int(seed), images,
             positive["context"], negative["context"], y, uy, hint=hint,
+        )
+        return (out,)
+
+
+def _i2i_setup(model, image, positive, negative, steps, cfg, denoise,
+               sampler_name, scheduler, mesh):
+    """Shared img2img/inpaint node prelude: mesh fallback, image batch
+    coercion, spec construction, ADM + ControlNet extraction."""
+    from ..diffusion.pipeline import GenerationSpec
+    from ..parallel.mesh import build_mesh
+
+    if mesh is None:
+        mesh = build_mesh({"dp": len(jax.devices())})
+    images = jnp.asarray(image, jnp.float32)
+    if images.ndim == 3:
+        images = images[None]
+    B, H, W, _ = images.shape
+    spec = GenerationSpec(
+        height=int(H), width=int(W), steps=int(steps),
+        sampler=sampler_name, scheduler=scheduler,
+        guidance_scale=float(cfg), per_device_batch=B,
+        denoise=float(denoise),
+    )
+    adm = model.pipeline.unet.config.adm_in_channels
+    y = _adm_from_cond(positive, adm) if adm else None
+    uy = _adm_from_cond(negative, adm) if adm else None
+    pipeline, hint = _control_from_cond(model.pipeline, positive, H, W)
+    return mesh, images, spec, y, uy, pipeline, hint
+
+
+@register_node("TPUInpaint")
+class TPUInpaint(NodeDef):
+    """Distributed inpainting: img2img with a repaint mask (1 = repaint,
+    0 = keep). The source latent is composited back into every denoised
+    estimate (ComfyUI SetLatentNoiseMask semantics), so unmasked regions
+    are pinned to the source through the whole sampling trajectory; each
+    chip produces its own seed-varied repaint."""
+
+    INPUTS = {
+        "model": "MODEL", "image": "IMAGE", "mask": "MASK",
+        "positive": "CONDITIONING", "negative": "CONDITIONING",
+        "seed": "INT", "steps": "INT", "cfg": "FLOAT", "denoise": "FLOAT",
+    }
+    OPTIONAL = {"sampler_name": "STRING", "scheduler": "STRING"}
+    HIDDEN = {"mesh": "*"}
+    RETURNS = ("IMAGE",)
+
+    def execute(self, model, image, mask, positive, negative, seed: int,
+                steps: int, cfg: float, denoise: float,
+                sampler_name: str = "euler", scheduler: str = "karras",
+                mesh=None, **_):
+        mesh, images, spec, y, uy, pipeline, hint = _i2i_setup(
+            model, image, positive, negative, steps, cfg, denoise,
+            sampler_name, scheduler, mesh)
+        B, H, W, _ = images.shape
+        m = jnp.asarray(mask, jnp.float32)
+        if m.ndim == 2:
+            m = m[None]
+        if m.ndim == 3:
+            m = m[..., None]
+        if m.shape[-1] > 1:      # an IMAGE wired as mask: take channel 0
+            m = m[..., :1]
+        if m.shape[0] != B:
+            m = jnp.broadcast_to(m, (B,) + m.shape[1:])
+        if m.shape[1:3] != (H, W):
+            m = jax.image.resize(m, (B, H, W, 1), method="bilinear")
+        # both composites assume a convex blend — out-of-range masks
+        # would EXTRAPOLATE pixels/latents outside [0,1]
+        m = jnp.clip(m, 0.0, 1.0)
+        out = pipeline.img2img(
+            mesh, spec, int(seed), images,
+            positive["context"], negative["context"], y, uy, hint=hint,
+            mask=m,
         )
         return (out,)
 
