@@ -43,6 +43,17 @@ class GenerationSpec:
     denoise: float = 1.0           # <1.0: img2img partial ladder (tile engine)
 
 
+def mesh_cache_key(mesh: Mesh) -> tuple:
+    """Value key for a mesh: axis names + shape + device ids.
+
+    ``id(mesh)`` is wrong here — ids are recycled after GC, so a
+    long-lived controller could be handed a stale compiled fn for a
+    *different* mesh with a coincident id. Shared by every pipeline's
+    compile cache."""
+    return (tuple(mesh.axis_names), tuple(mesh.shape.values()),
+            tuple(d.id for d in mesh.devices.flat))
+
+
 def bind_weights(jitted, weights):
     """Wrap a jitted function whose LEADING argument is the weight pytree:
     the returned callable supplies it automatically, while ``.jitted`` /
@@ -458,16 +469,8 @@ class Txt2ImgPipeline:
 
     _CACHE_MAX = 8
 
-    @staticmethod
-    def _mesh_cache_key(mesh: Mesh) -> tuple:
-        """Value key for a mesh: axis names + shape + device ids.
-
-        ``id(mesh)`` is wrong here — ids are recycled after GC, so a
-        long-lived controller could be handed a stale compiled fn for a
-        *different* mesh with a coincident id.
-        """
-        return (tuple(mesh.axis_names), tuple(mesh.shape.values()),
-                tuple(d.id for d in mesh.devices.flat))
+    # back-compat alias — the shared definition lives at module level
+    _mesh_cache_key = staticmethod(mesh_cache_key)
 
     def _cached_fn(self, mesh: Mesh, spec: GenerationSpec, hint=None,
                    progress: bool = False):

@@ -66,13 +66,18 @@ class FlowPipeline:
 
     def _sample_and_decode(self, key, context, pooled, spec: FlowSpec,
                            batch: int, sigmas, lat_hw, sp_axis=None,
-                           decode: bool = True, weights=None):
+                           decode: bool = True, weights=None,
+                           progress=None):
         lat_h, lat_w = lat_hw
         c = self.dit.config.in_channels
         x = jax.random.normal(key, (batch, lat_h, lat_w, c), jnp.float32)
         bc = lambda a: jnp.broadcast_to(a, (batch,) + a.shape[1:])
         den = self._denoiser(bc(context), bc(pooled), spec.guidance, sp_axis,
                              weights=weights)
+        if progress is not None:
+            from .progress import wrap_denoiser
+
+            den = wrap_denoiser(den, progress[0], progress[1])
         x0 = sample(spec.sampler, den, x, sigmas, key=key)
         if not decode:
             return x0
@@ -83,20 +88,26 @@ class FlowPipeline:
     # --- mode 1: dp seed fan-out -------------------------------------------
 
     def generate_fn(self, mesh: Mesh, spec: FlowSpec,
-                    axis: str = constants.AXIS_DATA):
+                    axis: str = constants.AXIS_DATA,
+                    progress: bool = False):
         sigmas = sigmas_flow(spec.steps, spec.shift)
         ds = self.vae.config.downscale
         lat_hw = (spec.height // ds, spec.width // ds)
 
-        def per_shard(weights, key, context, pooled):
+        def shard_body(weights, key, context, pooled, token=None):
             k = participant_key(key, axis)
+            prog = ((token, jax.lax.axis_index(axis))
+                    if token is not None else None)
             return self._sample_and_decode(k, context, pooled, spec,
                                            spec.per_device_batch, sigmas,
-                                           lat_hw, weights=weights)
+                                           lat_hw, weights=weights,
+                                           progress=prog)
 
+        in_specs = (P(), P(), P(None, None, None), P(None, None))
+        if progress:
+            in_specs += (P(),)     # traced int32 token, replicated
         f = jax.shard_map(
-            per_shard, mesh=mesh,
-            in_specs=(P(), P(), P(None, None, None), P(None, None)),
+            shard_body, mesh=mesh, in_specs=in_specs,
             out_specs=P(axis, None, None, None),
         )
         jitted = jax.jit(f)
@@ -104,9 +115,37 @@ class FlowPipeline:
 
         return bind_weights(jitted, weights)
 
+    _CACHE_MAX = 8
+
+    def _cached_fn(self, mesh: Mesh, spec: FlowSpec,
+                   progress: bool = False):
+        """Value-keyed compile cache (same discipline as
+        ``Txt2ImgPipeline._cached_fn`` — without it every node execution
+        re-traces the whole sampler)."""
+        from .pipeline import mesh_cache_key
+
+        if not hasattr(self, "_fn_cache"):
+            self._fn_cache = {}
+        key = (mesh_cache_key(mesh), spec, progress)
+        fn = self._fn_cache.get(key)
+        if fn is None:
+            if len(self._fn_cache) >= self._CACHE_MAX:
+                self._fn_cache.pop(next(iter(self._fn_cache)))
+            fn = self.generate_fn(mesh, spec, progress=progress)
+            self._fn_cache[key] = fn
+        return fn
+
     def generate(self, mesh: Mesh, spec: FlowSpec, seed: int,
-                 context: jax.Array, pooled: jax.Array) -> jax.Array:
-        return self.generate_fn(mesh, spec)(jax.random.key(seed), context, pooled)
+                 context: jax.Array, pooled: jax.Array,
+                 progress_token=None) -> jax.Array:
+        """One-shot generate; ``progress_token`` enables per-step x0
+        streaming (``cluster/progress.ProgressTracker.start``)."""
+        fn = self._cached_fn(mesh, spec,
+                             progress=progress_token is not None)
+        args = [jax.random.key(seed), context, pooled]
+        if progress_token is not None:
+            args.append(jnp.asarray(progress_token, jnp.int32))
+        return fn(*args)
 
     # --- mode 1b: dp×tp GSPMD (models too large for one chip) --------------
 

@@ -429,6 +429,35 @@ def _journal_key(images, spec, seed: int, index: int = 0,
     return f"usdu_{h.hexdigest()[:20]}"
 
 
+class _ProgressScope:
+    """Progress lifecycle shared by the sampler nodes: allocates a token
+    on entry; ``complete(out)`` blocks on the result AND drains pending
+    ``jax.debug.callback`` effects (block_until_ready alone does not
+    flush them) before exit marks the run done — anything else marks it
+    failed, freezing progress where it stopped instead of reporting
+    100%."""
+
+    def __init__(self, tracker, prompt_id: str, total_calls: int):
+        self.tracker, self.prompt_id = tracker, prompt_id
+        self.token = (tracker.start(prompt_id, total_calls)
+                      if tracker is not None and prompt_id else None)
+        self._ok = False
+
+    def complete(self, out) -> None:
+        if self.token is not None:
+            jax.block_until_ready(out)
+            jax.effects_barrier()
+        self._ok = True
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        if self.token is not None:
+            self.tracker.finish(self.prompt_id, failed=not self._ok)
+        return False
+
+
 def _adm_from_cond(cond: dict, adm_channels: int) -> jax.Array:
     """Build the ADM vector from pooled conditioning, zero-padded/truncated
     to the UNet's expected width (full SDXL micro-conds via
@@ -885,33 +914,16 @@ class TPUTxt2Img(NodeDef):
         uy = _adm_from_cond(negative, adm) if adm else None
         pipeline, hint = _control_from_cond(model.pipeline, positive,
                                             spec.height, spec.width)
-        token = None
-        if progress_tracker is not None and prompt_id:
-            from ..diffusion.progress import total_calls
+        from ..diffusion.progress import total_calls
 
-            token = progress_tracker.start(
-                prompt_id, total_calls(sampler_name, spec.steps))
-        ok = False
-        try:
+        with _ProgressScope(progress_tracker, prompt_id,
+                            total_calls(sampler_name, spec.steps)) as ps:
             images = pipeline.generate(
                 mesh, spec, int(seed), positive["context"],
                 negative["context"], y, uy, hint=hint,
-                progress_token=token,
+                progress_token=ps.token,
             )
-            if token is not None:
-                # dispatch is async — only mark done once the run really
-                # finished (downstream nodes would block here anyway).
-                # block_until_ready does NOT flush debug callbacks;
-                # effects_barrier drains them so finish() can't race the
-                # final step's events
-                jax.block_until_ready(images)
-                jax.effects_barrier()
-            ok = True
-        finally:
-            if token is not None:
-                # a failed run freezes progress where it stopped instead
-                # of rendering as 100% done
-                progress_tracker.finish(prompt_id, failed=not ok)
+            ps.complete(images)
         return (images,)
 
 
@@ -1035,12 +1047,13 @@ class TPUFlowTxt2Img(NodeDef):
         "guidance": "FLOAT", "shift": "FLOAT", "mode": "STRING",
         "batch_per_device": "INT",
     }
-    HIDDEN = {"mesh": "*"}
+    HIDDEN = {"mesh": "*", "prompt_id": "STRING", "progress_tracker": "*"}
     RETURNS = ("IMAGE",)
 
     def execute(self, model, positive, seed: int, steps: int, width: int,
                 height: int, guidance: float = 3.5, shift: float = 3.0,
-                mode: str = "dp", batch_per_device: int = 1, mesh=None, **_):
+                mode: str = "dp", batch_per_device: int = 1, mesh=None,
+                prompt_id: str = "", progress_tracker=None, **_):
         from ..diffusion.pipeline_flow import FlowSpec
         from ..parallel.mesh import build_mesh
 
@@ -1060,9 +1073,21 @@ class TPUFlowTxt2Img(NodeDef):
             if "sp" not in axes:   # re-lay the same devices as an sp mesh
                 mesh = build_mesh({"sp": mesh.devices.size},
                                   list(mesh.devices.flat))
+            # sp mode: single-image token sharding. Progress streaming is
+            # intentionally dp-only for now — each sp shard holds a row
+            # BLOCK, so a per-shard preview would be a partial strip; the
+            # tracker would need cross-shard assembly to be meaningful.
             images = model.pipeline.generate_sp(mesh, spec, int(seed), ctx, pooled)
         else:
-            images = model.pipeline.generate(mesh, spec, int(seed), ctx, pooled)
+            from ..diffusion.progress import total_calls
+
+            with _ProgressScope(progress_tracker, prompt_id,
+                                total_calls(spec.sampler,
+                                            spec.steps)) as ps:
+                images = model.pipeline.generate(
+                    mesh, spec, int(seed), ctx, pooled,
+                    progress_token=ps.token)
+                ps.complete(images)
         return (images,)
 
 

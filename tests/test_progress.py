@@ -188,3 +188,37 @@ def test_progress_routes(tmp_config):
         events.set_sink(None)
 
     asyncio.run(body())
+
+
+def test_flow_pipeline_progress(tracker, tmp_config):
+    """FLUX-path progress: the flow pipeline streams steps too, and its
+    compiled-fn cache keys progress separately."""
+    from comfyui_distributed_tpu.diffusion.pipeline_flow import (FlowPipeline,
+                                                                 FlowSpec)
+    from comfyui_distributed_tpu.models.dit import DiTConfig, init_dit
+    from comfyui_distributed_tpu.models.vae import AutoencoderKL, VAEConfig
+    from comfyui_distributed_tpu.parallel import build_mesh
+
+    cfg = DiTConfig.tiny()
+    model, params = init_dit(cfg, jax.random.key(0), sample_hw=(8, 8),
+                             context_len=6)
+    vae = AutoencoderKL(VAEConfig.tiny()).init(jax.random.key(1),
+                                               image_hw=(16, 16))
+    pipe = FlowPipeline(model, params, vae)
+    ctx = jnp.zeros((1, 6, cfg.context_dim))
+    pooled = jnp.zeros((1, cfg.pooled_dim))
+    mesh = build_mesh({"dp": 2})
+    spec = FlowSpec(height=16, width=16, steps=3)
+
+    token = tracker.start("flow1", total_calls(spec.sampler, spec.steps))
+    out = pipe.generate(mesh, spec, 0, ctx, pooled, progress_token=token)
+    jax.block_until_ready(out)
+    jax.effects_barrier()
+    snap = tracker.snapshot("flow1")
+    assert snap["step"] == 3, snap
+    assert snap["shards_reporting"] == 2
+    # cache: same (mesh, spec) with progress off is a separate entry that
+    # still runs
+    out2 = pipe.generate(mesh, spec, 0, ctx, pooled)
+    assert np.asarray(out2).shape == np.asarray(out).shape
+    assert len(pipe._fn_cache) == 2
