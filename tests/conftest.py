@@ -23,6 +23,15 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent compile cache: XLA:CPU compiles of the model stacks dominate the
+# suite's wall-clock (~2 h cold on this single-core host).  Caching compiled
+# executables across runs turns the re-run cost into pure execution time.
+# Same mechanism bench.py uses on the TPU (bench.py:90), separate directory so
+# CPU test artifacts never mix with TPU ones.
+_cache_dir = os.environ.get("CDT_TEST_XLA_CACHE", "/tmp/cdt_xla_cache_tests")
+jax.config.update("jax_compilation_cache_dir", _cache_dir)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+
 import pytest  # noqa: E402
 
 

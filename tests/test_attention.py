@@ -14,6 +14,8 @@ from comfyui_distributed_tpu.ops.attention import (
 )
 from comfyui_distributed_tpu.parallel import build_mesh
 
+pytestmark = pytest.mark.slow  # compile-heavy: builds/jits real model stacks
+
 
 def qkv(B=2, N=32, H=8, D=16, seed=0):
     ks = jax.random.split(jax.random.key(seed), 3)

@@ -16,6 +16,8 @@ from comfyui_distributed_tpu.models.clip import (
 from comfyui_distributed_tpu.models.convert import (
     ConversionError, convert_clip_hf, convert_clip_openclip)
 
+pytestmark = pytest.mark.slow  # compile-heavy: builds/jits real model stacks
+
 torch = pytest.importorskip("torch")
 transformers = pytest.importorskip("transformers")
 

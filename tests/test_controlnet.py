@@ -22,6 +22,8 @@ from comfyui_distributed_tpu.models.unet import UNetConfig
 from test_convert import (  # torch replica building blocks
     TDownsample, TResBlock, TSpatialTransformer, t_timestep_embedding)
 
+pytestmark = pytest.mark.slow  # compile-heavy: builds/jits real model stacks
+
 
 # ---------------------------------------------------------------------------
 # torch replica: LDM cldm ControlNet

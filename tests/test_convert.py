@@ -20,6 +20,9 @@ from comfyui_distributed_tpu.models.convert import (
 from comfyui_distributed_tpu.models.unet import UNetConfig, init_unet
 from comfyui_distributed_tpu.models.vae import AutoencoderKL, VAEConfig
 
+pytestmark = pytest.mark.slow  # compile-heavy: builds/jits real model stacks
+
+
 torch = pytest.importorskip("torch")
 nn = torch.nn
 F = torch.nn.functional

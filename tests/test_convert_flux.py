@@ -19,6 +19,9 @@ from comfyui_distributed_tpu.models.convert import (
     ConversionError, convert_flux, detect_layout)
 from comfyui_distributed_tpu.models.dit import DiT, DiTConfig, init_dit
 
+pytestmark = pytest.mark.slow  # compile-heavy: builds/jits real model stacks
+
+
 torch = pytest.importorskip("torch")
 nn = torch.nn
 F = torch.nn.functional

@@ -16,6 +16,8 @@ from comfyui_distributed_tpu.models.dit import (
 from comfyui_distributed_tpu.models.vae import AutoencoderKL, VAEConfig
 from comfyui_distributed_tpu.parallel import build_mesh
 
+pytestmark = pytest.mark.slow  # compile-heavy: builds/jits real model stacks
+
 
 def test_patchify_roundtrip():
     x = jax.random.normal(jax.random.key(0), (2, 8, 12, 5))

@@ -8,6 +8,8 @@ import pytest
 
 from comfyui_distributed_tpu.ops.flash_attention import flash_attention
 
+pytestmark = pytest.mark.slow  # compile-heavy: builds/jits real model stacks
+
 
 def dense_reference(q, k, v):
     scale = 1.0 / (q.shape[-1] ** 0.5)

@@ -14,6 +14,8 @@ from comfyui_distributed_tpu.models.registry import ModelRegistry
 from comfyui_distributed_tpu.models.unet import UNetConfig
 from comfyui_distributed_tpu.utils.exceptions import ValidationError
 
+pytestmark = pytest.mark.slow  # compile-heavy: builds/jits real model stacks
+
 
 def _leaf(tree, path):
     node = tree["params"]

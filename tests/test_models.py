@@ -1,5 +1,6 @@
 """Model zoo shape/numerics tests (tiny configs, CPU)."""
 
+import pytest
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -7,6 +8,8 @@ import numpy as np
 from comfyui_distributed_tpu.models.text import TextEncoder, TextEncoderConfig
 from comfyui_distributed_tpu.models.unet import UNetConfig, init_unet
 from comfyui_distributed_tpu.models.vae import AutoencoderKL, VAEConfig
+
+pytestmark = pytest.mark.slow  # compile-heavy: builds/jits real model stacks
 
 
 def test_unet_tiny_forward():

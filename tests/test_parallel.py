@@ -20,6 +20,8 @@ from comfyui_distributed_tpu.parallel import collectives, mesh as mesh_mod
 from comfyui_distributed_tpu.parallel.rng import participant_seeds
 from comfyui_distributed_tpu.utils.exceptions import ShardingError
 
+pytestmark = pytest.mark.slow  # compile-heavy: builds/jits real model stacks
+
 
 def test_device_census_virtual_8():
     census = device_census()

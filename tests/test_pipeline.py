@@ -17,6 +17,8 @@ from comfyui_distributed_tpu.models.unet import UNetConfig, init_unet
 from comfyui_distributed_tpu.models.vae import AutoencoderKL, VAEConfig
 from comfyui_distributed_tpu.parallel import build_mesh
 
+pytestmark = pytest.mark.slow  # compile-heavy: builds/jits real model stacks
+
 
 @pytest.fixture(scope="module")
 def tiny_pipeline():

@@ -18,6 +18,8 @@ from comfyui_distributed_tpu.diffusion.progress import (calls_per_step,
                                                         total_calls,
                                                         wrap_denoiser)
 
+pytestmark = pytest.mark.slow  # compile-heavy: builds/jits real model stacks
+
 
 @pytest.fixture
 def tracker():

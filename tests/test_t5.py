@@ -13,6 +13,8 @@ import jax.numpy as jnp
 from comfyui_distributed_tpu.models.t5 import (
     FluxTextStack, T5Config, T5Encoder, T5Model, convert_t5)
 
+pytestmark = pytest.mark.slow  # compile-heavy: builds/jits real model stacks
+
 torch = pytest.importorskip("torch")
 transformers = pytest.importorskip("transformers")
 

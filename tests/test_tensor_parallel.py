@@ -1,6 +1,7 @@
 """Tensor-parallel weight sharding: placements land where the rules say,
 and a tp-sharded forward equals the unsharded forward."""
 
+import pytest
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -14,6 +15,8 @@ from comfyui_distributed_tpu.parallel.tensor import (
     spec_for_param,
     tp_sharding_summary,
 )
+
+pytestmark = pytest.mark.slow  # compile-heavy: builds/jits real model stacks
 
 
 class TestRules:

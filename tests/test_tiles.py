@@ -15,6 +15,8 @@ from comfyui_distributed_tpu.ops.resize import upscale_image
 from comfyui_distributed_tpu.tiles.grid import compute_tile_grid, pad_count_to
 from comfyui_distributed_tpu.parallel import build_mesh
 
+pytestmark = pytest.mark.slow  # compile-heavy: builds/jits real model stacks
+
 
 def test_grid_counts_and_bounds():
     g = compute_tile_grid(100, 60, tile_w=32, tile_h=32, padding=4)

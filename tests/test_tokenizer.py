@@ -10,6 +10,7 @@ import pytest
 from comfyui_distributed_tpu.models.tokenizer import (
     CLIPBPETokenizer, SOT, EOT, bytes_to_unicode, load_sd_tokenizers)
 
+
 transformers = pytest.importorskip("transformers")
 
 

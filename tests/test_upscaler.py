@@ -21,6 +21,8 @@ from comfyui_distributed_tpu.models.convert import (
 from comfyui_distributed_tpu.models.upscaler import (
     RRDBNet, UpscalerBundle, UpscalerConfig, init_upscaler)
 
+pytestmark = pytest.mark.slow  # compile-heavy: builds/jits real model stacks
+
 
 # ---------------------------------------------------------------------------
 # torch reference (BasicSR RRDBNet topology, "new arch" naming)

@@ -19,6 +19,8 @@ from comfyui_distributed_tpu.models.video_dit import (
 )
 from comfyui_distributed_tpu.parallel import build_mesh
 
+pytestmark = pytest.mark.slow  # compile-heavy: builds/jits real model stacks
+
 
 def test_4n1_rule():
     assert [pad_frames_4n1(n) for n in (1, 2, 4, 5, 6, 16, 17)] == \

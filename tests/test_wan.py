@@ -19,6 +19,8 @@ from comfyui_distributed_tpu.models.convert import ConversionError
 from comfyui_distributed_tpu.models.wan import (
     WanConfig, WanModel, convert_wan, init_wan, video_ids)
 
+pytestmark = pytest.mark.slow  # compile-heavy: builds/jits real model stacks
+
 torch = pytest.importorskip("torch")
 nn = torch.nn
 F = torch.nn.functional

@@ -6,9 +6,12 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
+import pytest
 
 from comfyui_distributed_tpu.models.wan_vae import (
     WanVAE3D, WanVAEConfig)
+
+pytestmark = pytest.mark.slow  # compile-heavy: builds/jits real model stacks
 
 TINY = WanVAEConfig.tiny()
 

@@ -15,6 +15,8 @@ from comfyui_distributed_tpu.graph.executor import (
     validate_prompt,
 )
 
+pytestmark = pytest.mark.slow  # compile-heavy: builds/jits real model stacks
+
 WORKFLOWS = sorted(Path("workflows").glob("*.json"))
 
 
