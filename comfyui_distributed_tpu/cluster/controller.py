@@ -143,6 +143,7 @@ class Controller:
         from ..utils.network import close_client_session
 
         await self.queue.stop()
+        self.progress.close()      # release the global progress sink
         await close_client_session()
 
     # --- health -------------------------------------------------------------

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import threading
 import time
+import warnings
 from collections import OrderedDict
 from typing import Optional
 
@@ -80,7 +81,24 @@ class ProgressTracker:
         self._by_prompt: dict[str, int] = {}
         self._next_token = 1
         self._lock = threading.Lock()
+        # The event sink is process-global (one compiled program, one
+        # callback route): installing a second tracker silently steals
+        # every progress event from the first, so make it loud. Latest
+        # wins (a fresh Controller supersedes a dead one); call close()
+        # on the old tracker to hand over silently.
+        if _events.get_sink() is not None:
+            warnings.warn(
+                "ProgressTracker: a progress sink is already installed; "
+                "this tracker replaces it and the previous tracker will "
+                "stop receiving events",
+                RuntimeWarning, stacklevel=2,
+            )
         _events.set_sink(self._on_event)
+
+    def close(self) -> None:
+        """Detach from the global event sink (only if still attached)."""
+        if _events.get_sink() == self._on_event:
+            _events.set_sink(None)
 
     # --- producer side (node layer) ------------------------------------
 

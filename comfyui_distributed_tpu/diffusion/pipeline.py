@@ -103,6 +103,25 @@ def sdxl_adm(
     return jnp.concatenate([pooled] + embs, axis=-1)
 
 
+def inpaint_denoiser(base, src: jax.Array, noise: jax.Array,
+                     mask: jax.Array):
+    """ComfyUI ``KSamplerX0Inpaint`` semantics (mask: 1 = regenerate).
+
+    Both sides of every model call are composited: the sampler *input* is
+    recomposited with the source latent re-noised at the CURRENT sigma —
+    using the same fixed ``noise`` draw as the run's initial noising — and
+    the denoised *output* is pinned to the source in unmasked regions.
+    Input-side recompositing is what keeps ancestral/SDE samplers on the
+    reference trajectory near mask boundaries; output-side pinning alone
+    only hides the drift for fully-unmasked pixels."""
+
+    def denoise(xx, sigma):
+        xx = xx * mask + (src + noise * sigma) * (1.0 - mask)
+        return base(xx, sigma) * mask + src * (1.0 - mask)
+
+    return denoise
+
+
 class Txt2ImgPipeline:
     """Bundle of UNet + VAE + schedule with compiled sharded generation.
 
@@ -211,21 +230,25 @@ class Txt2ImgPipeline:
         pipeline's ControlNet (``with_control``). ``progress`` is an
         optional ``(token, shard_index)`` pair that streams per-step x0
         previews to the host (``diffusion/progress.wrap_denoiser``).
-        ``inpaint_mask`` (latent-res [.,h,w,1], 1 = regenerate) composites
-        the source latent back into every denoised estimate — ComfyUI's
-        SetLatentNoiseMask semantics — so unmasked regions are pinned to
-        the source through the whole sampling trajectory."""
+        ``inpaint_mask`` (latent-res [.,h,w,1], 1 = regenerate) applies
+        ComfyUI's KSamplerX0Inpaint semantics on both sides of each model
+        call: the sampler *input* is recomposited with the source latent
+        re-noised at the current sigma (same fixed noise draw as the
+        initial noising), and the denoised *output* is pinned to the
+        source in unmasked regions — so ancestral/SDE samplers track the
+        reference trajectory at mask boundaries, not just at the end."""
         k_noise, k_samp = jax.random.split(key)
         if init_latent is None:
             lat_h = spec.height // self.vae.config.downscale
             lat_w = spec.width // self.vae.config.downscale
-            x = jax.random.normal(
+            noise = jax.random.normal(
                 k_noise, (batch, lat_h, lat_w, self.latent_channels),
                 jnp.float32,
-            ) * sigmas[0]
+            )
+            x = noise * sigmas[0]
         else:
-            x = init_latent + jax.random.normal(
-                k_noise, init_latent.shape, jnp.float32) * sigmas[0]
+            noise = jax.random.normal(k_noise, init_latent.shape, jnp.float32)
+            x = init_latent + noise * sigmas[0]
 
         if spec.guidance_scale != 1.0:
             denoise = cfg_denoiser(
@@ -244,10 +267,8 @@ class Txt2ImgPipeline:
                 hint=hint, weights=weights,
             )
         if inpaint_mask is not None and init_latent is not None:
-            base, src, m = denoise, init_latent, inpaint_mask
-
-            def denoise(xx, sigma):      # noqa: F811 — deliberate re-wrap
-                return base(xx, sigma) * m + src * (1.0 - m)
+            denoise = inpaint_denoiser(denoise, init_latent, noise,
+                                       inpaint_mask)
         if progress is not None:
             from .progress import wrap_denoiser
 
@@ -328,9 +349,10 @@ class Txt2ImgPipeline:
 
         ``with_mask`` adds a trailing image-res mask input [B,H,W,1]
         (1 = repaint): the program downsamples it to latent resolution
-        and pins unmasked regions to the source latent every step
-        (latent-composite inpainting, ComfyUI SetLatentNoiseMask
-        semantics)."""
+        and applies ComfyUI KSamplerX0Inpaint semantics on every model
+        call — the sampler input is recomposited with the source latent
+        re-noised at the current sigma, and the denoised output is
+        pinned to the source (``inpaint_denoiser``)."""
         has_y = self.unet.config.adm_in_channels > 0
         has_control = getattr(self, "_control", None) is not None
         sigmas = make_sigma_ladder(spec, self.schedule)

@@ -118,20 +118,36 @@ class FlowPipeline:
     _CACHE_MAX = 8
 
     def _cached_fn(self, mesh: Mesh, spec: FlowSpec,
-                   progress: bool = False):
+                   progress: bool = False, mode: str = "dp",
+                   axis: Optional[str] = None):
         """Value-keyed compile cache (same discipline as
         ``Txt2ImgPipeline._cached_fn`` — without it every node execution
-        re-traces the whole sampler)."""
+        re-traces the whole sampler). Serves BOTH execution modes: ``dp``
+        seed fan-out and ``sp`` ring-attention sharding share the cache,
+        keyed by mode so a workflow that alternates between them never
+        thrashes recompiles."""
         from .pipeline import mesh_cache_key
 
         if not hasattr(self, "_fn_cache"):
             self._fn_cache = {}
-        key = (mesh_cache_key(mesh), spec, progress)
+        if mode == "sp":
+            # normalize the key: default axis resolves BEFORE keying so
+            # axis=None and axis="sp" hit the same compiled program, and
+            # sp has no progress path — a progress=True key would memoize
+            # a fn that silently drops it
+            axis = axis or constants.AXIS_SEQUENCE
+            if progress:
+                raise NotImplementedError(
+                    "progress streaming is not wired through sp mode")
+        key = (mesh_cache_key(mesh), spec, progress, mode, axis)
         fn = self._fn_cache.get(key)
         if fn is None:
             if len(self._fn_cache) >= self._CACHE_MAX:
                 self._fn_cache.pop(next(iter(self._fn_cache)))
-            fn = self.generate_fn(mesh, spec, progress=progress)
+            if mode == "sp":
+                fn = self.generate_sp_fn(mesh, spec, axis=axis)
+            else:
+                fn = self.generate_fn(mesh, spec, progress=progress)
             self._fn_cache[key] = fn
         return fn
 
@@ -240,4 +256,5 @@ class FlowPipeline:
 
     def generate_sp(self, mesh: Mesh, spec: FlowSpec, seed: int,
                     context: jax.Array, pooled: jax.Array) -> jax.Array:
-        return self.generate_sp_fn(mesh, spec)(jax.random.key(seed), context, pooled)
+        fn = self._cached_fn(mesh, spec, mode="sp")
+        return fn(jax.random.key(seed), context, pooled)

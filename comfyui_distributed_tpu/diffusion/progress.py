@@ -37,6 +37,10 @@ def set_sink(fn: Optional[Callable]) -> None:
     _SINK = fn
 
 
+def get_sink() -> Optional[Callable]:
+    return _SINK
+
+
 def _dispatch(token, shard, sigma, x0) -> None:
     sink = _SINK
     if sink is not None:
@@ -48,21 +52,21 @@ def _dispatch(token, shard, sigma, x0) -> None:
 
 # model calls the wrapped (guided) denoiser makes per sampler step; CFG is
 # batch-concatenated into one call (guidance.cfg_denoiser) so it doesn't
-# multiply. Second-order samplers call twice (their final Euler fallback
-# step calls once — the count is an upper bound; consumers clamp to 1.0).
-_CALLS_PER_STEP = {
-    "heun": 2,
-    "dpmpp_sde": 2,
-    "dpmpp_2m_sde": 1,
-}
+# multiply. Second-order samplers call twice per step EXCEPT their final
+# step (sigma_next == 0 takes the single-call Euler fallback), so their
+# exact total is 2*steps - 1 — an exact total keeps the progress bar from
+# stalling one call short of 100% until finish() clamps it.
+_SECOND_ORDER = {"heun", "dpmpp_sde"}
 
 
 def calls_per_step(sampler: str) -> int:
-    return _CALLS_PER_STEP.get(sampler, 1)
+    return 2 if sampler in _SECOND_ORDER else 1
 
 
 def total_calls(sampler: str, steps: int) -> int:
-    return calls_per_step(sampler) * steps
+    if sampler in _SECOND_ORDER:
+        return max(1, 2 * steps - 1)
+    return steps
 
 
 def wrap_denoiser(denoise, token, shard_index):

@@ -987,10 +987,12 @@ def _i2i_setup(model, image, positive, negative, steps, cfg, denoise,
 @register_node("TPUInpaint")
 class TPUInpaint(NodeDef):
     """Distributed inpainting: img2img with a repaint mask (1 = repaint,
-    0 = keep). The source latent is composited back into every denoised
-    estimate (ComfyUI SetLatentNoiseMask semantics), so unmasked regions
-    are pinned to the source through the whole sampling trajectory; each
-    chip produces its own seed-varied repaint."""
+    0 = keep). ComfyUI KSamplerX0Inpaint semantics on every model call:
+    the sampler input is recomposited with the source latent re-noised
+    at the current sigma and the denoised estimate is pinned to the
+    source (``diffusion/pipeline.inpaint_denoiser``), so unmasked
+    regions track the reference trajectory — ancestral/SDE samplers
+    included; each chip produces its own seed-varied repaint."""
 
     INPUTS = {
         "model": "MODEL", "image": "IMAGE", "mask": "MASK",

@@ -28,6 +28,7 @@ geometry and ships behind the same ``encode``/``decode`` interface as
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Optional
 
 import jax
@@ -82,6 +83,42 @@ class WanVAEConfig:
 
     def pixel_frames(self, latent_frames: int) -> int:
         return (latent_frames - 1) * self.temporal_downscale + 1
+
+
+def _tile_starts(full: int, t: int, step: int) -> list[int]:
+    """Origin-anchored tile starts with the last start clamped to
+    ``full - t`` so the final tile never runs past the edge."""
+    if full <= t:
+        return [0]
+    out = list(range(0, full - t, step)) + [full - t]
+    return sorted(set(out))
+
+
+def _pair_feathers(starts_list: list[int], t: int):
+    """Per-tile (lo, hi) feather widths in latent units: each side
+    feathers over the ACTUAL overlap with its neighbor. The last start is
+    clamped (``_tile_starts``), so its overlap with the previous tile can
+    exceed the nominal ``overlap`` — feathering only the nominal width
+    would leave a weight-1/weight-1 band that hard-averages (visible seam
+    at the final row/column)."""
+    ovs = [starts_list[i - 1] + t - starts_list[i]
+           for i in range(1, len(starts_list))]
+    return [0] + ovs, ovs + [0]
+
+
+def _axis_ramp(n_lat: int, lo_o: int, hi_o: int, *, scale: int) -> np.ndarray:
+    """Per-pixel weight along one axis of a decoded tile; ramps multiply
+    so an extra-wide lo/hi pair composes instead of one overwriting the
+    other."""
+    n = n_lat * scale
+    wgt = np.ones((n,), np.float32)
+    o = min(lo_o, n_lat) * scale
+    if o:
+        wgt[:o] *= np.linspace(1.0 / (o + 1), 1.0, o, dtype=np.float32)
+    o = min(hi_o, n_lat) * scale
+    if o:                  # guard: wgt[-0:] is the WHOLE array
+        wgt[-o:] *= np.linspace(1.0, 1.0 / (o + 1), o, dtype=np.float32)
+    return wgt
 
 
 def _pad_time_causal(x: jax.Array, n: int) -> jax.Array:
@@ -362,27 +399,14 @@ class WanVAE3D:
         # requires it
         th, tw = min(tile, h), min(tile, w)
 
-        def starts(full, t):
-            if full <= t:
-                return [0]
-            out = list(range(0, full - t, step)) + [full - t]
-            return sorted(set(out))
-
-        def ramp(n_lat, lo_feather, hi_feather):
-            """Per-pixel weight along one axis of a decoded tile."""
-            n = n_lat * s
-            wgt = np.ones((n,), np.float32)
-            o = overlap * s
-            if lo_feather and o:
-                wgt[:o] = np.linspace(1.0 / (o + 1), 1.0, o,
-                                      dtype=np.float32)
-            if hi_feather and o:   # and-o: wgt[-0:] is the WHOLE array
-                wgt[-o:] = np.linspace(1.0, 1.0 / (o + 1), o,
-                                       dtype=np.float32)
-            return wgt
-
-        positions = [(y0, x0) for y0 in starts(h, th)
-                     for x0 in starts(w, tw)]
+        ys = _tile_starts(h, th, step)
+        xs = _tile_starts(w, tw, step)
+        ylo, yhi = _pair_feathers(ys, th)
+        xlo, xhi = _pair_feathers(xs, tw)
+        ramp = functools.partial(_axis_ramp, scale=s)
+        positions = [(y0, x0) for y0 in ys for x0 in xs]
+        pos_feather = [(ylo[iy], yhi[iy], xlo[ix], xhi[ix])
+                       for iy in range(len(ys)) for ix in range(len(xs))]
         tiles_in = jnp.stack(
             [head[:, :, y0:y0 + th, x0:x0 + tw, :] for y0, x0 in positions])
 
@@ -400,8 +424,9 @@ class WanVAE3D:
                         jnp.float32)
         wsum = jnp.zeros((h * s, w * s, 1), jnp.float32)
         for i, (y0, x0) in enumerate(positions):
-            wy = ramp(th, y0 > 0, y0 + th < h)
-            wx = ramp(tw, x0 > 0, x0 + tw < w)
+            f_ylo, f_yhi, f_xlo, f_xhi = pos_feather[i]
+            wy = ramp(th, f_ylo, f_yhi)
+            wx = ramp(tw, f_xlo, f_xhi)
             wgt = jnp.asarray(wy[:, None, None] * wx[None, :, None])
             acc = acc.at[:, :, y0 * s:(y0 + th) * s,
                          x0 * s:(x0 + tw) * s, :].add(tiles_out[i] * wgt)

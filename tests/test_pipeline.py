@@ -222,6 +222,33 @@ class TestInpaint:
         plain = np.asarray(pipe.img2img(mesh, spec, 7, src, ctx, unc))
         np.testing.assert_allclose(inp, plain, rtol=1e-4, atol=1e-4)
 
+    def test_input_recomposited_with_sigma_noised_source(self):
+        """KSamplerX0Inpaint contract: the model INPUT has unmasked pixels
+        replaced by src + noise·sigma (fixed noise draw) before every
+        call, and the x0 output is pinned to src — not output-pinning
+        alone (which lets ancestral/SDE samplers drift at boundaries)."""
+        from comfyui_distributed_tpu.diffusion.pipeline import (
+            inpaint_denoiser)
+
+        seen = {}
+
+        def base(xx, sigma):
+            seen["x"] = xx
+            return jnp.zeros_like(xx)
+
+        src = jnp.full((1, 4, 4, 1), 2.0)
+        noise = jnp.full((1, 4, 4, 1), 0.5)
+        mask = jnp.concatenate([jnp.ones((1, 4, 2, 1)),
+                                jnp.zeros((1, 4, 2, 1))], axis=2)
+        den = inpaint_denoiser(base, src, noise, mask)
+        out = np.asarray(den(jnp.full((1, 4, 4, 1), -7.0), jnp.asarray(3.0)))
+
+        seen_x = np.asarray(seen["x"])
+        np.testing.assert_allclose(seen_x[:, :, :2], -7.0)        # masked: sampler x
+        np.testing.assert_allclose(seen_x[:, :, 2:], 2.0 + 0.5 * 3.0)
+        np.testing.assert_allclose(out[:, :, :2], 0.0)            # base output
+        np.testing.assert_allclose(out[:, :, 2:], 2.0)            # pinned to src
+
     def test_half_mask_repaints_only_masked_half(self):
         from comfyui_distributed_tpu.parallel import build_mesh
 

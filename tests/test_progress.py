@@ -18,9 +18,6 @@ from comfyui_distributed_tpu.diffusion.progress import (calls_per_step,
                                                         total_calls,
                                                         wrap_denoiser)
 
-pytestmark = pytest.mark.slow  # compile-heavy: builds/jits real model stacks
-
-
 @pytest.fixture
 def tracker():
     t = ProgressTracker()
@@ -107,6 +104,61 @@ class TestCallsPerStep:
         assert calls_per_step("dpmpp_sde") == 2
         assert total_calls("euler", 30) == 30
 
+    def test_second_order_total_is_exact_not_upper_bound(self):
+        """heun/dpmpp_sde take the single-call Euler fallback on their
+        final step (sigma_next == 0), so the exact total is 2n-1 — an
+        upper bound of 2n would stall the bar at (2n-1)/2n until
+        finish() clamps it."""
+        assert total_calls("heun", 30) == 59
+        assert total_calls("dpmpp_sde", 30) == 59
+        assert total_calls("heun", 1) == 1
+
+    def test_second_order_event_count_matches_total(self):
+        """Count actual wrapped-denoiser events through a jitted heun run
+        and check they land exactly on total_calls."""
+        from comfyui_distributed_tpu.diffusion import sample, sigmas_karras
+
+        seen = []
+        events.set_sink(lambda tok, sh, sig, x0: seen.append(sig))
+        try:
+            steps = 5
+            sigmas = sigmas_karras(steps, 0.03, 10.0)
+            den = wrap_denoiser(lambda x, s: x * 0.5, jnp.int32(1),
+                                jnp.int32(0))
+            out = sample("heun", den, jnp.ones((1, 4, 4, 1)), sigmas)
+            jax.block_until_ready(out)
+            jax.effects_barrier()
+            assert len(seen) == total_calls("heun", steps) == 2 * steps - 1
+        finally:
+            events.set_sink(None)
+
+
+class TestSinkCollision:
+    def test_second_tracker_warns_and_takes_over(self):
+        t1 = ProgressTracker()
+        try:
+            with pytest.warns(RuntimeWarning, match="already installed"):
+                t2 = ProgressTracker()
+            # latest wins: events route to t2 only
+            token = t2.start("p2", 4)
+            t2._on_event(token, 0, 1.0, np.zeros((1, 2, 2, 4), np.float32))
+            assert t2.snapshot("p2")["step"] == 1
+        finally:
+            events.set_sink(None)
+
+    def test_close_detaches_only_own_sink(self):
+        t1 = ProgressTracker()
+        t1.close()
+        assert events.get_sink() is None
+        t1.close()  # idempotent
+        t2 = ProgressTracker()
+        with pytest.warns(RuntimeWarning):
+            t3 = ProgressTracker()
+        t2.close()  # t2 is no longer the sink — must NOT detach t3
+        assert events.get_sink() is not None
+        t3.close()
+        assert events.get_sink() is None
+
 
 def test_wrapped_denoiser_streams_through_jit(tracker):
     """The wrapper emits one event per model call from inside a jitted
@@ -128,6 +180,7 @@ def test_wrapped_denoiser_streams_through_jit(tracker):
     assert snap["fraction"] == 1.0
 
 
+@pytest.mark.slow  # builds a real model stack
 def test_pipeline_generate_with_progress(tracker, tmp_config):
     """End-to-end: dp-sharded tiny generation with a progress token — the
     tracker sees every step and a preview from each shard."""
@@ -192,6 +245,7 @@ def test_progress_routes(tmp_config):
     asyncio.run(body())
 
 
+@pytest.mark.slow  # builds a real model stack
 def test_flow_pipeline_progress(tracker, tmp_config):
     """FLUX-path progress: the flow pipeline streams steps too, and its
     compiled-fn cache keys progress separately."""

@@ -260,3 +260,45 @@ class TestTiledDecode:
         np.testing.assert_allclose(
             np.asarray(vae.decode_tiled(lat, tile=8)),
             np.asarray(vae.decode(lat)), rtol=1e-6, atol=1e-6)
+
+
+class TestTiledFeatherGeometry:
+    """The clamped last tile can overlap its neighbor by more than the
+    nominal ``overlap``; feathering must span the ACTUAL pair overlap or
+    the un-feathered band hard-averages (a visible seam)."""
+
+    def test_pair_feathers_cover_clamped_overlap(self):
+        from comfyui_distributed_tpu.models.wan_vae import (_pair_feathers,
+                                                            _tile_starts)
+        starts = _tile_starts(9, 4, 3)
+        assert starts == [0, 3, 5]
+        lo, hi = _pair_feathers(starts, 4)
+        # middle→last overlap is 2 (clamp), not the nominal 1
+        assert lo == [0, 1, 2]
+        assert hi == [1, 2, 0]
+
+    def test_entering_tile_weight_monotone_through_overlap(self):
+        """Across every pair overlap, the entering tile's normalized
+        blend weight rises monotonically from ~0 to 1 — no flat
+        0.5/0.5 hard-average plateau (the old nominal-width bug)."""
+        from comfyui_distributed_tpu.models.wan_vae import (_axis_ramp,
+                                                            _pair_feathers,
+                                                            _tile_starts)
+        t, s = 4, 2
+        starts = _tile_starts(9, t, 3)
+        lo, hi = _pair_feathers(starts, t)
+        W = np.zeros(9 * s, np.float32)
+        ramps = []
+        for st, l, h in zip(starts, lo, hi):
+            r = _axis_ramp(t, l, h, scale=s)
+            ramps.append(r)
+            W[st * s:(st + t) * s] += r
+        assert np.all(W > 0)
+        for i in range(1, len(starts)):
+            ov_lo = starts[i] * s
+            ov_hi = (starts[i - 1] + t) * s
+            w_b = np.zeros_like(W)
+            w_b[starts[i] * s:(starts[i] + t) * s] = ramps[i]
+            frac = w_b[ov_lo:ov_hi] / W[ov_lo:ov_hi]
+            assert np.all(np.diff(frac) > 0), f"pair {i}: {frac}"
+            assert frac[0] < 0.5 and frac[-1] > 0.5
