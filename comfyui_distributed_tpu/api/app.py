@@ -14,7 +14,7 @@ from pathlib import Path
 from aiohttp import web
 
 from ..cluster.controller import Controller
-from ..utils import constants
+from ..utils import auth, constants
 from ..utils.exceptions import DistributedError, ValidationError
 from ..utils.logging import log
 from . import config_routes, info_routes, tunnel_routes, usdu_routes, worker_routes
@@ -104,11 +104,27 @@ def create_app(controller: Controller) -> web.Application:
         if permissive or safe:
             resp.headers["Access-Control-Allow-Origin"] = "*"
             resp.headers["Access-Control-Allow-Methods"] = "GET, POST, OPTIONS"
-            resp.headers["Access-Control-Allow-Headers"] = "Content-Type"
+            resp.headers["Access-Control-Allow-Headers"] = \
+                "Content-Type, " + auth.AUTH_HEADER
         return resp
+
+    @web.middleware
+    async def auth_middleware(request, handler):
+        # Optional shared-secret gate (utils/auth.py): with a token
+        # configured, every mutating route 401s without it. The reference
+        # ships public tunnels with a fully open control plane — this
+        # closes that hole while keeping probes/health/dashboard reads
+        # open and token-less deployments unchanged. resolve_token is the
+        # hot-path lookup (env, else a no-deepcopy config peek).
+        token = auth.resolve_token(getattr(controller, "config_path", None))
+        if (token and auth.requires_auth(request.method, request.path)
+                and not auth.token_matches(request.headers, token)):
+            return json_error("missing or invalid auth token", 401)
+        return await handler(request)
 
     app.middlewares.append(error_middleware)
     app.middlewares.append(cors_middleware)
+    app.middlewares.append(auth_middleware)
 
     r = app.router
 

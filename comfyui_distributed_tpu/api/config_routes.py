@@ -22,6 +22,8 @@ SETTING_SCHEMA: dict[str, type] = {
     "worker_prep_concurrency": int,
     "media_sync_concurrency": int,
     "media_sync_timeout_seconds": (int, float),
+    "permissive_cors": bool,
+    "auth_token": str,     # rotate/clear the cluster token (utils/auth.py)
 }
 
 HOST_FIELDS = {"id", "name", "address", "enabled", "type", "mesh_devices",

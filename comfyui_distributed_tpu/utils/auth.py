@@ -1,0 +1,93 @@
+"""Optional shared-secret auth for the control plane.
+
+The reference leaves every mutating route unauthenticated while
+simultaneously shipping one-click public tunnels
+(``/root/reference/utils/cloudflare/tunnel.py:19-207`` exposes the whole
+``/distributed/*`` surface to the internet); a TPU-first rebuild should
+not inherit that. One cluster-wide token (``CDT_AUTH_TOKEN`` env, or
+``settings.auth_token`` in the cluster config) gates every mutating
+route: requests must carry it in the ``X-CDT-Auth`` header (or
+``Authorization: Bearer``). Probe/health GETs stay open so liveness
+checks and dashboards keep working.
+
+No token configured → everything stays open (back-compat for private
+networks). Starting a tunnel auto-generates and persists a token if none
+exists, printing it once, so the public URL is never born unprotected.
+"""
+
+from __future__ import annotations
+
+import hmac
+import os
+import secrets
+from typing import Any, Optional
+
+AUTH_HEADER = "X-CDT-Auth"
+AUTH_ENV = "CDT_AUTH_TOKEN"
+
+def configured_token(cfg: Optional[dict[str, Any]] = None) -> Optional[str]:
+    """The cluster token, if any: the env var wins over the config so an
+    operator can rotate without editing files."""
+    env = os.environ.get(AUTH_ENV)
+    if env:
+        return env
+    if cfg:
+        tok = cfg.get("settings", {}).get("auth_token")
+        if tok:
+            return str(tok)
+    return None
+
+
+def resolve_token(config_path=None) -> Optional[str]:
+    """Hot-path token lookup: env var, else a no-deepcopy config peek
+    (``config.peek_setting`` — one stat when the mtime cache is warm).
+    Used by the per-request auth middleware and the outbound session."""
+    env = os.environ.get(AUTH_ENV)
+    if env:
+        return env
+    from .config import peek_setting
+
+    tok = peek_setting("auth_token", None, config_path)
+    return str(tok) if tok else None
+
+
+def generate_token() -> str:
+    return secrets.token_urlsafe(24)
+
+
+def token_matches(request_headers, token: str) -> bool:
+    """Constant-time check of ``X-CDT-Auth`` / ``Authorization: Bearer``.
+    Compares as bytes: ``compare_digest`` raises on non-ASCII *strings*,
+    and a malformed header must read as 401, not a 500."""
+    presented = request_headers.get(AUTH_HEADER, "")
+    if not presented:
+        bearer = request_headers.get("Authorization", "")
+        if bearer.startswith("Bearer "):
+            presented = bearer[len("Bearer "):]
+    if not presented:
+        return False
+    return hmac.compare_digest(
+        presented.encode("utf-8", "surrogateescape"),
+        token.encode("utf-8", "surrogateescape"))
+
+
+# Reads that are gated when a token is set: the config payload contains
+# the token itself, and the log surfaces can carry operational secrets
+# (and would otherwise leak whatever startup printed).
+_GATED_READ_PREFIXES = (
+    "/distributed/config",
+    "/distributed/local_log",
+    "/distributed/worker_log/",
+    "/distributed/remote_worker_log/",
+)
+
+
+def requires_auth(method: str, path: str) -> bool:
+    """Every mutating (non-GET/HEAD/OPTIONS) route needs the token —
+    cluster peers carry it automatically (``utils/network.py`` session
+    headers). Reads stay open so probes, health, the dashboard, and
+    progress polling keep working — except the config (which contains the
+    token) and the log-tail surfaces (which can carry secrets)."""
+    if any(path == p or path.startswith(p) for p in _GATED_READ_PREFIXES):
+        return True
+    return method not in ("GET", "HEAD", "OPTIONS")

@@ -180,6 +180,26 @@ def get_setting(name: str, default: Any = None, path: Path | None = None) -> Any
     return load_config(path).get("settings", {}).get(name, default)
 
 
+def peek_setting(name: str, default: Any = None,
+                 path: Path | None = None) -> Any:
+    """Read ONE settings key without deep-copying the whole config —
+    hot-path safe (one stat + dict lookup when the mtime cache is warm).
+    Use for per-request/per-call gates (auth token, debug flag); callers
+    must not mutate the returned value."""
+    p = path or config_path()
+    with _cache_lock:
+        if _cache is not None and _cache[0] == p:
+            try:
+                if p.stat().st_mtime == _cache[1]:
+                    return _cache[2].get("settings", {}).get(name, default)
+            except OSError:
+                return DEFAULT_CONFIG.get("settings", {}).get(name, default)
+    try:
+        return load_config(p).get("settings", {}).get(name, default)
+    except ConfigError:
+        return default
+
+
 def get_worker_timeout_seconds(path: Path | None = None) -> float:
     from . import constants
     v = get_setting("worker_timeout_seconds", None, path)

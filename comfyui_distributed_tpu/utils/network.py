@@ -18,22 +18,46 @@ from .logging import debug_log
 
 _session: Optional[aiohttp.ClientSession] = None
 _session_loop: Optional[asyncio.AbstractEventLoop] = None
+_session_token: Optional[str] = None
 
 # Domains that imply TLS regardless of scheme given (reference ``:96-104``)
 _HTTPS_DOMAINS = ("trycloudflare.com", "ngrok.io", "ngrok-free.app", "proxy.runpod.net")
 
 
+# Sessions displaced by a token rotation: in-flight requests keep using
+# them (closing immediately would fail mid-job calls); they are drained on
+# the next close_client_session().
+_retired_sessions: list[aiohttp.ClientSession] = []
+
+
 def get_client_session() -> aiohttp.ClientSession:
     """Shared pooled session (limit 100, 30 per host), rebuilt if the
-    running loop changed (tests create fresh loops)."""
-    global _session, _session_loop
+    running loop changed (tests create fresh loops) or the cluster auth
+    token changed (tunnel start auto-generates one — every outbound
+    peer call carries it from then on). The previous session is retired,
+    NOT closed: coroutines holding it finish their in-flight requests."""
+    global _session, _session_loop, _session_token
+    from .auth import resolve_token
+
     loop = asyncio.get_event_loop()
-    if _session is None or _session.closed or _session_loop is not loop:
+    token = resolve_token()
+    if (_session is None or _session.closed or _session_loop is not loop
+            or token != _session_token):
+        if _session is not None and not _session.closed \
+                and _session_loop is loop:
+            _retired_sessions.append(_session)
+        headers = {}
+        if token:
+            from .auth import AUTH_HEADER
+
+            headers[AUTH_HEADER] = token
         _session = aiohttp.ClientSession(
             connector=aiohttp.TCPConnector(limit=100, limit_per_host=30),
             timeout=aiohttp.ClientTimeout(total=constants.DISPATCH_TIMEOUT),
+            headers=headers,
         )
         _session_loop = loop
+        _session_token = token
     return _session
 
 
@@ -42,6 +66,13 @@ async def close_client_session() -> None:
     if _session is not None and not _session.closed:
         await _session.close()
     _session = None
+    while _retired_sessions:
+        s = _retired_sessions.pop()
+        if not s.closed:
+            try:
+                await s.close()
+            except Exception:
+                pass
 
 
 def normalize_host_url(address: str) -> str:

@@ -22,6 +22,7 @@ import os
 import re
 import shutil
 import subprocess
+import sys
 import threading
 import time
 from collections import deque
@@ -136,6 +137,7 @@ class TunnelManager:
             self.url = url
             self._persist_started(url, port)
             log(f"tunnel up: {url}")
+            self._ensure_auth_token()
             return url
 
     async def stop_tunnel(self) -> bool:
@@ -157,6 +159,29 @@ class TunnelManager:
         return was_running
 
     # --- state persistence (reference state.py:28-81) -----------------------
+
+    def _ensure_auth_token(self) -> None:
+        """A public tunnel must never expose an unauthenticated control
+        plane: if no cluster token exists, generate one, persist it, and
+        print it ONCE so the operator can hand it to workers/dashboards
+        (env ``CDT_AUTH_TOKEN`` overrides; see ``utils/auth.py``)."""
+        from .auth import AUTH_ENV, configured_token, generate_token
+
+        if configured_token(load_config(self.config_path)):
+            return
+        token = generate_token()
+
+        def mutate(cfg: dict) -> None:
+            cfg.setdefault("settings", {}).setdefault("auth_token", token)
+        update_config(mutate, self.config_path)
+        # The token goes to the operator's terminal ONLY — log() feeds the
+        # rolling buffer behind /distributed/local_log, which would leak
+        # the secret through the very tunnel it protects.
+        print(f"[Distributed-TPU] auth token generated for the public "
+              f"tunnel: {token}", file=sys.stderr, flush=True)
+        log(f"auth token generated and persisted to settings.auth_token — "
+            f"pass it to workers/dashboards via {AUTH_ENV} or the "
+            "X-CDT-Auth header; mutating routes now require it")
 
     def _persist_started(self, url: str, port: int) -> None:
         def mutate(cfg: dict) -> None:

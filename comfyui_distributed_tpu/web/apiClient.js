@@ -4,6 +4,31 @@
 
 const RETRIES = 3;
 const BACKOFF_MS = 400;
+const AUTH_STORAGE_KEY = "cdt_auth_token";
+
+// Cluster auth token (utils/auth.py): mutating routes 401 without it once
+// a token is configured (a public tunnel auto-generates one). The user
+// pastes it into the dashboard settings; persisted in localStorage.
+export function getAuthToken() {
+  try { return localStorage.getItem(AUTH_STORAGE_KEY) || ""; } catch { return ""; }
+}
+
+export function setAuthToken(token) {
+  try {
+    if (token) localStorage.setItem(AUTH_STORAGE_KEY, token);
+    else localStorage.removeItem(AUTH_STORAGE_KEY);
+  } catch { /* storage unavailable (private mode) — header still unset */ }
+}
+
+function buildHeaders(method) {
+  const headers = {};
+  // POSTs always declare JSON: the control plane rejects POSTs without a
+  // JSON content type (cross-origin simple-request guard)
+  if (method === "POST") headers["Content-Type"] = "application/json";
+  const token = getAuthToken();
+  if (token) headers["X-CDT-Auth"] = token;
+  return Object.keys(headers).length ? headers : undefined;
+}
 
 async function request(path, { method = "GET", body, retries = RETRIES, timeoutMs = 15000 } = {}) {
   let lastErr;
@@ -13,9 +38,7 @@ async function request(path, { method = "GET", body, retries = RETRIES, timeoutM
     try {
       const resp = await fetch(path, {
         method,
-        // POSTs always declare JSON: the control plane rejects POSTs
-        // without a JSON content type (cross-origin simple-request guard)
-        headers: method === "POST" ? { "Content-Type": "application/json" } : undefined,
+        headers: buildHeaders(method),
         body: body !== undefined ? JSON.stringify(body) : undefined,
         signal: ctrl.signal,
       });

@@ -2,7 +2,7 @@
 // + workerLifecycle.js status polling + workerSettings.js CRUD +
 // tunnelManager.js — SURVEY §2.7), dependency-free.
 
-import { api, probeHost, normalizeAddress } from "/web/apiClient.js";
+import { api, probeHost, normalizeAddress, getAuthToken, setAuthToken } from "/web/apiClient.js";
 
 const POLL_MS = 3000;
 const LOG_REFRESH_MS = 2000;
@@ -156,7 +156,27 @@ async function pollStatus() {
 }
 
 async function refreshConfig() {
-  state.config = await api.getConfig();
+  try {
+    state.config = await api.getConfig();
+  } catch (e) {
+    // 401 = auth token configured but not supplied (or wrong): the
+    // dashboard must still render the settings panel so the user can
+    // paste the token — otherwise a tunnel-protected deployment bricks
+    // its own recovery path.
+    state.config = null;
+    renderSettings();
+    if (e && e.status === 401) {
+      const root = $("worker-cards");
+      root.replaceChildren();
+      const note = document.createElement("div");
+      note.className = "muted";
+      note.textContent =
+        "This cluster requires an auth token — paste it under Settings.";
+      root.append(note);
+      return;
+    }
+    throw e;
+  }
   renderWorkers();
   renderSettings();
   renderMesh();
@@ -228,6 +248,19 @@ function renderSettings() {
     };
     root.append(kd, input);
   }
+  // cluster auth token: stored browser-side only (localStorage) and sent
+  // as X-CDT-Auth on every API call — never written into the config via
+  // this field (the server already knows it)
+  const kd = document.createElement("div");
+  kd.className = "k";
+  kd.textContent = "Auth token (X-CDT-Auth)";
+  const input = document.createElement("input");
+  input.type = "password";
+  input.placeholder = "paste cluster token";
+  input.autocomplete = "off";
+  input.value = getAuthToken();
+  input.onchange = () => { setAuthToken(input.value.trim()); refreshConfig(); };
+  root.append(kd, input);
 }
 
 // ---------------------------------------------------------------------------

@@ -1,0 +1,239 @@
+"""Control-plane auth: optional shared-secret token gating mutating routes.
+
+The reference exposes an unauthenticated control plane through public
+tunnels (``/root/reference/utils/cloudflare/tunnel.py``); this framework
+closes that with a cluster token (``utils/auth.py``): mutating routes 401
+without it, probes/health stay open, outbound peer calls attach it
+automatically, and starting a tunnel auto-generates one.
+"""
+
+import asyncio
+import json
+
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from comfyui_distributed_tpu.api import create_app
+from comfyui_distributed_tpu.cluster.controller import Controller
+from comfyui_distributed_tpu.utils import auth
+from comfyui_distributed_tpu.utils.config import load_config, update_config
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def make_client():
+    controller = Controller()
+    app = create_app(controller)
+    return controller, TestClient(TestServer(app))
+
+
+class TestPolicy:
+    def test_gets_open_posts_gated(self):
+        assert not auth.requires_auth("GET", "/distributed/health")
+        assert not auth.requires_auth("GET", "/distributed/progress/p1")
+        assert not auth.requires_auth("OPTIONS", "/distributed/queue")
+        assert auth.requires_auth("POST", "/distributed/queue")
+        assert auth.requires_auth("POST", "/distributed/launch_worker")
+        assert auth.requires_auth("POST", "/upload/image")
+        # the one gated read: the config payload contains the token
+        assert auth.requires_auth("GET", "/distributed/config")
+
+    def test_env_wins_over_config(self, monkeypatch):
+        monkeypatch.setenv(auth.AUTH_ENV, "env-tok")
+        cfg = {"settings": {"auth_token": "cfg-tok"}}
+        assert auth.configured_token(cfg) == "env-tok"
+        monkeypatch.delenv(auth.AUTH_ENV)
+        assert auth.configured_token(cfg) == "cfg-tok"
+        assert auth.configured_token({"settings": {}}) is None
+        assert auth.configured_token(None) is None
+
+    def test_token_matches_header_and_bearer(self):
+        assert auth.token_matches({"X-CDT-Auth": "t1"}, "t1")
+        assert auth.token_matches({"Authorization": "Bearer t1"}, "t1")
+        assert not auth.token_matches({"X-CDT-Auth": "nope"}, "t1")
+        assert not auth.token_matches({}, "t1")
+        assert not auth.token_matches({"Authorization": "Basic t1"}, "t1")
+
+    def test_non_ascii_header_is_401_not_500(self):
+        """hmac.compare_digest raises TypeError on non-ASCII *strings*;
+        a malformed credential must read as a mismatch, not a crash."""
+        assert not auth.token_matches({"X-CDT-Auth": "tokén"}, "token")
+
+    def test_log_reads_gated(self):
+        """Log surfaces can carry secrets (and the buffer once carried the
+        generated token) — they are gated reads when auth is on."""
+        assert auth.requires_auth("GET", "/distributed/local_log")
+        assert auth.requires_auth("GET", "/distributed/worker_log/w0")
+        assert auth.requires_auth("GET", "/distributed/remote_worker_log/w0")
+        assert not auth.requires_auth("GET", "/distributed/health")
+
+
+class TestRoutes:
+    def _enable(self, token="secret-token"):
+        def mutate(cfg):
+            cfg.setdefault("settings", {})["auth_token"] = token
+        update_config(mutate)
+
+    def test_mutating_401_without_token(self, tmp_config):
+        self._enable()
+
+        async def body():
+            controller, client = make_client()
+            async with client:
+                resp = await client.post("/prompt", json={"prompt": {
+                    "1": {"class_type": "PrimitiveInt",
+                          "inputs": {"value": 1}}}})
+                assert resp.status == 401
+                resp = await client.post("/distributed/queue",
+                                         json={"prompt": {"1": {}}})
+                assert resp.status == 401
+                resp = await client.get("/distributed/config")
+                assert resp.status == 401
+        run(body())
+
+    def test_mutating_200_with_header_or_bearer(self, tmp_config):
+        self._enable()
+
+        async def body():
+            controller, client = make_client()
+            async with client:
+                resp = await client.post(
+                    "/prompt",
+                    json={"prompt": {"1": {"class_type": "PrimitiveInt",
+                                           "inputs": {"value": 1}}}},
+                    headers={"X-CDT-Auth": "secret-token"})
+                assert resp.status == 200
+                resp = await client.get(
+                    "/distributed/config",
+                    headers={"Authorization": "Bearer secret-token"})
+                assert resp.status == 200
+        run(body())
+
+    def test_probes_and_reads_stay_open(self, tmp_config):
+        self._enable()
+
+        async def body():
+            controller, client = make_client()
+            async with client:
+                for path in ("/distributed/health", "/prompt",
+                             "/distributed/system_info"):
+                    resp = await client.get(path)
+                    assert resp.status == 200, path
+        run(body())
+
+    def test_no_token_configured_everything_open(self, tmp_config):
+        async def body():
+            controller, client = make_client()
+            async with client:
+                resp = await client.post("/prompt", json={"prompt": {
+                    "1": {"class_type": "PrimitiveInt",
+                          "inputs": {"value": 1}}}})
+                assert resp.status == 200
+        run(body())
+
+    def test_env_token_gates_without_config(self, tmp_config, monkeypatch):
+        monkeypatch.setenv(auth.AUTH_ENV, "env-tok")
+
+        async def body():
+            controller, client = make_client()
+            async with client:
+                resp = await client.post("/distributed/clear_memory", json={})
+                assert resp.status == 401
+                resp = await client.post("/distributed/clear_memory", json={},
+                                         headers={"X-CDT-Auth": "env-tok"})
+                assert resp.status == 200
+        run(body())
+
+
+class TestOutboundSession:
+    def test_session_carries_token_and_rotates(self, tmp_config, monkeypatch):
+        from comfyui_distributed_tpu.utils import network
+
+        async def body():
+            monkeypatch.setenv(auth.AUTH_ENV, "tok-a")
+            s1 = network.get_client_session()
+            assert s1.headers.get(auth.AUTH_HEADER) == "tok-a"
+            # same token → same session object (no churn)
+            assert network.get_client_session() is s1
+            # rotation → fresh session with the new header; the OLD
+            # session is retired but NOT closed (in-flight requests on it
+            # must complete)
+            monkeypatch.setenv(auth.AUTH_ENV, "tok-b")
+            s2 = network.get_client_session()
+            assert s2 is not s1
+            assert s2.headers.get(auth.AUTH_HEADER) == "tok-b"
+            assert not s1.closed
+            monkeypatch.delenv(auth.AUTH_ENV)
+            s3 = network.get_client_session()
+            assert auth.AUTH_HEADER not in s3.headers
+            # close drains current AND retired sessions
+            await network.close_client_session()
+            assert s1.closed and s2.closed and s3.closed
+        run(body())
+
+    def test_two_controller_roundtrip_with_auth(self, tmp_config, monkeypatch):
+        """Master→worker dispatch keeps working when BOTH sides share a
+        token: the pooled session attaches it to every outbound call."""
+        monkeypatch.setenv(auth.AUTH_ENV, "cluster-tok")
+
+        async def body():
+            from comfyui_distributed_tpu.utils import network
+
+            worker_ctl, worker_client = make_client()
+            async with worker_client:
+                addr = (f"http://{worker_client.server.host}:"
+                        f"{worker_client.server.port}")
+                session = network.get_client_session()
+                async with session.post(
+                        f"{addr}/prompt",
+                        json={"prompt": {"1": {"class_type": "PrimitiveInt",
+                                               "inputs": {"value": 2}}}},
+                ) as resp:
+                    assert resp.status == 200
+            await network.close_client_session()
+        run(body())
+
+
+class TestTunnelTokenGeneration:
+    def test_tunnel_start_generates_and_persists_once(self, tmp_config):
+        from comfyui_distributed_tpu.utils.tunnel import TunnelManager
+
+        mgr = TunnelManager()
+        mgr._ensure_auth_token()
+        tok = load_config().get("settings", {}).get("auth_token")
+        assert tok and len(tok) >= 24
+        mgr._ensure_auth_token()          # idempotent
+        assert load_config()["settings"]["auth_token"] == tok
+
+    def test_existing_token_untouched(self, tmp_config):
+        from comfyui_distributed_tpu.utils.tunnel import TunnelManager
+
+        def mutate(cfg):
+            cfg.setdefault("settings", {})["auth_token"] = "keep-me"
+        update_config(mutate)
+        TunnelManager()._ensure_auth_token()
+        assert load_config()["settings"]["auth_token"] == "keep-me"
+
+    def test_token_never_enters_log_buffer(self, tmp_config):
+        """The rolling log buffer is served by /distributed/local_log and
+        proxied cross-host; the generated secret must not appear there."""
+        from comfyui_distributed_tpu.utils.logging import get_log_buffer
+        from comfyui_distributed_tpu.utils.tunnel import TunnelManager
+
+        TunnelManager()._ensure_auth_token()
+        token = load_config()["settings"]["auth_token"]
+        assert token
+        assert all(token not in line for line in get_log_buffer())
+
+
+class TestPeekSetting:
+    def test_peek_tracks_updates_without_deepcopy(self, tmp_config):
+        from comfyui_distributed_tpu.utils.config import peek_setting
+
+        assert peek_setting("auth_token") is None
+        update_config(lambda c: c.setdefault("settings", {})
+                      .__setitem__("auth_token", "fresh"))
+        assert peek_setting("auth_token") == "fresh"
+        assert peek_setting("debug") is False   # defaults merged
