@@ -114,12 +114,14 @@ def create_app(controller: Controller) -> web.Application:
         # configured, every mutating route 401s without it. The reference
         # ships public tunnels with a fully open control plane — this
         # closes that hole while keeping probes/health/dashboard reads
-        # open and token-less deployments unchanged. resolve_token is the
-        # hot-path lookup (env, else a no-deepcopy config peek).
-        token = auth.resolve_token(getattr(controller, "config_path", None))
-        if (token and auth.requires_auth(request.method, request.path)
-                and not auth.token_matches(request.headers, token)):
-            return json_error("missing or invalid auth token", 401)
+        # open and token-less deployments unchanged. The route check runs
+        # first: ungated reads (status/progress polling) never pay the
+        # token lookup (a config stat, auth.resolve_token).
+        if auth.requires_auth(request.method, request.path):
+            token = auth.resolve_token(getattr(controller, "config_path",
+                                               None))
+            if token and not auth.token_matches(request.headers, token):
+                return json_error("missing or invalid auth token", 401)
         return await handler(request)
 
     app.middlewares.append(error_middleware)
@@ -173,6 +175,12 @@ def create_app(controller: Controller) -> web.Application:
             arr = getattr(v, "shape", None)
             if arr is not None and not isinstance(v, (int, float, bool)):
                 return {"shape": list(v.shape), "dtype": str(getattr(v, "dtype", ""))}
+            if isinstance(v, dict) and "waveform" in v:
+                wf_shape = getattr(v["waveform"], "shape", None)
+                return {"audio": {
+                    "shape": list(wf_shape) if wf_shape is not None else [],
+                    "sample_rate": int(v.get("sample_rate", 0)),
+                }}
             if isinstance(v, (dict, list, tuple)):
                 return str(type(v).__name__)
             return v if isinstance(v, (int, float, str, bool, type(None))) else str(v)

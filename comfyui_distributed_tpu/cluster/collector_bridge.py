@@ -80,10 +80,16 @@ class CollectorBridge:
                 envelope["audio"] = encode_audio(audio)
             await self._post_with_retry(session, url, envelope)
         if n == 0:
-            await self._post_with_retry(session, url, {
+            # audio-only contribution (e.g. DistributedEmptyImage feeding
+            # the image input): the completion envelope still carries the
+            # AUDIO payload — dropping it here loses the worker's clip
+            envelope = {
                 "job_id": job_id, "worker_id": worker_id, "batch_idx": -1,
                 "image": "", "is_last": True,
-            })
+            }
+            if audio is not None:
+                envelope["audio"] = encode_audio(audio)
+            await self._post_with_retry(session, url, envelope)
         debug_log(f"collector[{job_id}] worker {worker_id} sent {n} images")
 
     async def _send_frames(self, session, base_url: str, job_id: str,

@@ -32,6 +32,12 @@ class Controller:
                  mesh_devices: Optional[int] = None):
         ensure_config_exists(config_path)
         self.config_path = config_path
+        if config_path is not None:
+            # outbound peer calls must read the auth token from the SAME
+            # config this controller enforces inbound (utils/network.py)
+            from ..utils.network import set_auth_config_path
+
+            set_auth_config_path(config_path)
         self.is_worker = os.environ.get(IS_WORKER_ENV, "") not in ("", "0")
         self.store = JobStore()
         self.queue = PromptQueue(context_factory=self._execution_context)

@@ -1279,6 +1279,59 @@ class LoadImage(NodeDef):
         return (jnp.asarray(decode_png(path.read_bytes()))[None],)
 
 
+@register_node("LoadAudio")
+class LoadAudio(NodeDef):
+    """WAV file → AUDIO dict ``{"waveform": [1,C,S], "sample_rate"}``.
+
+    The reference free-rides on ComfyUI's LoadAudio for the file edge and
+    only ships the transport envelope (``utils/audio_payload.py``); here
+    the stdlib WAV codec closes the loop so audio workflows are drivable
+    end-to-end (media sync already handles ``.wav`` inputs)."""
+
+    INPUTS = {"audio": "STRING"}
+    HIDDEN = {"input_dir": "STRING"}
+    RETURNS = ("AUDIO",)
+
+    def execute(self, audio: str, input_dir: str = "", **_):
+        from ..utils.audio_payload import wav_decode
+
+        path = Path(input_dir or "input") / audio
+        if not path.exists():
+            raise ValidationError(f"audio file not found: {path}",
+                                  field="audio")
+        return (wav_decode(path.read_bytes()),)
+
+
+@register_node("SaveAudio")
+class SaveAudio(NodeDef):
+    """AUDIO → one 16-bit PCM WAV per batch element (ComfyUI SaveAudio
+    parity via the stdlib codec)."""
+
+    INPUTS = {"audio": "AUDIO"}
+    OPTIONAL = {"filename_prefix": "STRING"}
+    HIDDEN = {"output_dir": "STRING"}
+    RETURNS = ()
+    OUTPUT_NODE = True
+
+    def execute(self, audio, filename_prefix: str = "audio",
+                output_dir: str = "", **_):
+        from ..utils.audio_payload import wav_bytes
+
+        wf = np.asarray(audio["waveform"])
+        if wf.ndim == 2:               # tolerate [C,S]
+            wf = wf[None]
+        sr = int(audio.get("sample_rate", 44100))
+        out_dir = Path(output_dir or "output")
+        out_dir.mkdir(parents=True, exist_ok=True)
+        paths = []
+        for i in range(wf.shape[0]):
+            p = out_dir / f"{filename_prefix}_{i:05d}.wav"
+            p.write_bytes(wav_bytes(wf[i], sr))
+            paths.append(str(p))
+        log(f"saved {len(paths)} audio clips to {out_dir}")
+        return ()
+
+
 @register_node("PrimitiveInt")
 class PrimitiveInt(NodeDef):
     INPUTS = {"value": "INT"}

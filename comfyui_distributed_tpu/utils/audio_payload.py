@@ -56,3 +56,58 @@ def decode_audio(envelope: dict[str, Any]) -> dict[str, Any]:
         )
     wf = np.frombuffer(raw, dtype=np.float32).reshape(shape)
     return {"waveform": wf, "sample_rate": int(envelope["sample_rate"])}
+
+
+# --- WAV file codec (stdlib only) ------------------------------------------
+# The reference free-rides on ComfyUI's LoadAudio/SaveAudio for files and
+# only ships the transport envelope (utils/audio_payload.py); a standalone
+# framework needs the file edge too. 16-bit PCM WAV via the stdlib `wave`
+# module — no external deps, good enough for the speech/music clips the
+# collector/divider fabric carries.
+
+def wav_bytes(waveform: Any, sample_rate: int) -> bytes:
+    """Encode one clip ``[C, S]`` (float32, [-1, 1]) as 16-bit PCM WAV."""
+    import io
+    import wave as _wave
+
+    wf = np.asarray(waveform, dtype=np.float32)
+    if wf.ndim == 1:
+        wf = wf[None]
+    if wf.ndim != 2:
+        raise ValidationError(f"wav clip must be [C,S], got shape {wf.shape}")
+    pcm = (np.clip(wf, -1.0, 1.0) * 32767.0).astype("<i2")
+    buf = io.BytesIO()
+    with _wave.open(buf, "wb") as w:
+        w.setnchannels(pcm.shape[0])
+        w.setsampwidth(2)
+        w.setframerate(int(sample_rate))
+        w.writeframes(np.ascontiguousarray(pcm.T).tobytes())  # interleaved
+    return buf.getvalue()
+
+
+def wav_decode(data: bytes) -> dict[str, Any]:
+    """Decode a PCM WAV (8/16/32-bit int) into an AUDIO dict
+    ``{"waveform": [1, C, S] float32, "sample_rate": int}``."""
+    import io
+    import wave as _wave
+
+    try:
+        with _wave.open(io.BytesIO(data), "rb") as w:
+            n_ch = w.getnchannels()
+            width = w.getsampwidth()
+            rate = w.getframerate()
+            frames = w.readframes(w.getnframes())
+    except (_wave.Error, EOFError) as e:
+        raise ValidationError(f"invalid WAV data: {e}") from e
+    if width == 2:
+        pcm = np.frombuffer(frames, dtype="<i2").astype(np.float32) / 32768.0
+    elif width == 4:
+        pcm = np.frombuffer(frames, dtype="<i4").astype(np.float32) / 2147483648.0
+    elif width == 1:  # 8-bit WAV is unsigned
+        pcm = (np.frombuffer(frames, dtype=np.uint8).astype(np.float32) - 128.0) / 128.0
+    else:
+        raise ValidationError(f"unsupported WAV sample width {width}")
+    if n_ch > 0 and pcm.size % n_ch:
+        pcm = pcm[: pcm.size - pcm.size % n_ch]
+    wf = pcm.reshape(-1, max(1, n_ch)).T[None]          # [1, C, S]
+    return {"waveform": np.ascontiguousarray(wf), "sample_rate": int(rate)}

@@ -29,6 +29,17 @@ _HTTPS_DOMAINS = ("trycloudflare.com", "ngrok.io", "ngrok-free.app", "proxy.runp
 # the next close_client_session().
 _retired_sessions: list[aiohttp.ClientSession] = []
 
+# Config path the outbound token is read from. A Controller constructed
+# with an explicit config_path registers it here so inbound enforcement
+# and outbound credentials always read the SAME config (otherwise a
+# custom-path deployment would 401 its own peer calls).
+_auth_config_path = None
+
+
+def set_auth_config_path(path) -> None:
+    global _auth_config_path
+    _auth_config_path = path
+
 
 def get_client_session() -> aiohttp.ClientSession:
     """Shared pooled session (limit 100, 30 per host), rebuilt if the
@@ -40,7 +51,7 @@ def get_client_session() -> aiohttp.ClientSession:
     from .auth import resolve_token
 
     loop = asyncio.get_event_loop()
-    token = resolve_token()
+    token = resolve_token(_auth_config_path)
     if (_session is None or _session.closed or _session_loop is not loop
             or token != _session_token):
         if _session is not None and not _session.closed \

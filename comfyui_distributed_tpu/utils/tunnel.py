@@ -122,6 +122,11 @@ class TunnelManager:
                     "cloudflared binary not found — install it or set "
                     "CLOUDFLARED_PATH (this framework does not auto-download "
                     "executables)")
+            # arm auth BEFORE the URL becomes publicly routable — once
+            # cloudflared registers with the edge, requests can arrive;
+            # generating the token afterwards would leave a window with a
+            # fully open mutating control plane
+            self._ensure_auth_token()
             cmd = [binary, "tunnel", "--url", f"http://127.0.0.1:{port}"]
             debug_log(f"starting tunnel: {' '.join(cmd)}")
             self._proc = subprocess.Popen(
@@ -137,7 +142,6 @@ class TunnelManager:
             self.url = url
             self._persist_started(url, port)
             log(f"tunnel up: {url}")
-            self._ensure_auth_token()
             return url
 
     async def stop_tunnel(self) -> bool:

@@ -59,3 +59,48 @@ def test_audio_cap_enforced(monkeypatch):
     wf = np.zeros((1, 1, 100), np.float32)
     with pytest.raises(ValidationError):
         audio_payload.encode_audio({"waveform": wf, "sample_rate": 8000})
+
+
+class TestWavCodec:
+    """Stdlib WAV file codec (LoadAudio/SaveAudio nodes)."""
+
+    def test_roundtrip_stereo(self):
+        from comfyui_distributed_tpu.utils.audio_payload import (wav_bytes,
+                                                                 wav_decode)
+
+        t = np.linspace(0, 1, 4410, dtype=np.float32)
+        clip = np.stack([np.sin(t * 440), np.cos(t * 440)]) * 0.7
+        out = wav_decode(wav_bytes(clip, 22050))
+        assert out["sample_rate"] == 22050
+        assert out["waveform"].shape == (1, 2, 4410)
+        np.testing.assert_allclose(out["waveform"][0], clip, atol=2e-4)
+
+    def test_mono_1d_accepted(self):
+        from comfyui_distributed_tpu.utils.audio_payload import (wav_bytes,
+                                                                 wav_decode)
+
+        clip = np.zeros((100,), np.float32)
+        out = wav_decode(wav_bytes(clip, 8000))
+        assert out["waveform"].shape == (1, 1, 100)
+
+    def test_clipping_bounded(self):
+        from comfyui_distributed_tpu.utils.audio_payload import (wav_bytes,
+                                                                 wav_decode)
+
+        clip = np.full((1, 10), 3.0, np.float32)   # out of range → clipped
+        out = wav_decode(wav_bytes(clip, 8000))
+        assert np.all(out["waveform"] <= 1.0)
+
+    def test_invalid_wav_raises(self):
+        from comfyui_distributed_tpu.utils.audio_payload import wav_decode
+        from comfyui_distributed_tpu.utils.exceptions import ValidationError
+
+        with pytest.raises(ValidationError, match="invalid WAV"):
+            wav_decode(b"not a wav file")
+
+    def test_bad_shape_raises(self):
+        from comfyui_distributed_tpu.utils.audio_payload import wav_bytes
+        from comfyui_distributed_tpu.utils.exceptions import ValidationError
+
+        with pytest.raises(ValidationError, match="C,S"):
+            wav_bytes(np.zeros((1, 2, 3), np.float32), 8000)

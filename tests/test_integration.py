@@ -29,6 +29,15 @@ import pytest
 REPO = Path(__file__).resolve().parent.parent
 
 
+def _write_test_wav(path, samples=1000, rate=16000):
+    import numpy as np
+
+    from comfyui_distributed_tpu.utils.audio_payload import wav_bytes
+
+    t = np.linspace(0.0, 1.0, samples, dtype=np.float32)
+    path.write_bytes(wav_bytes(np.sin(t * 880)[None] * 0.4, rate))
+
+
 def free_port() -> int:
     s = socket.socket()
     s.bind(("127.0.0.1", 0))
@@ -86,6 +95,17 @@ def spawn_controller(port, config_path, *, worker_id=None, master_port=None,
     )
 
 
+# Audio leg: LoadAudio on both sides, AUDIO carried through the collector
+# envelope (reference nodes/collector.py:180-233) and concatenated
+# master-first along samples.
+AUDIO_COLLECT = {
+    "1": {"class_type": "LoadAudio", "inputs": {"audio": "clip.wav"}},
+    "2": {"class_type": "DistributedEmptyImage",
+          "inputs": {"height": 8, "width": 8}},
+    "3": {"class_type": "DistributedCollector",
+          "inputs": {"images": ["2", 0], "audio": ["1", 0]}},
+}
+
 TXT2IMG_TINY = {
     "1": {"class_type": "CheckpointLoader", "inputs": {"ckpt_name": "tiny"}},
     "2": {"class_type": "CLIPTextEncode",
@@ -133,9 +153,17 @@ class TestTwoProcessIntegration:
                        "enabled": True, "type": "local"}],
         }))
 
+        # shared input dir ("local"-type worker semantics): a WAV for the
+        # audio leg exists for both processes
+        input_dir = tmp_path / "input"
+        input_dir.mkdir()
+        _write_test_wav(input_dir / "clip.wav", samples=1000)
+        io_env = {"CDT_INPUT_DIR": str(input_dir),
+                  "CDT_OUTPUT_DIR": str(tmp_path / "out")}
+
         worker = spawn_controller(wport, wconfig, worker_id="w0",
-                                  master_port=mport)
-        master = spawn_controller(mport, mconfig)
+                                  master_port=mport, extra_env=io_env)
+        master = spawn_controller(mport, mconfig, extra_env=io_env)
         try:
             wait_health(wport)
             wait_health(mport)
@@ -151,6 +179,19 @@ class TestTwoProcessIntegration:
             # worker's 4 seed-varied images
             imgs = hist["outputs"]["6"][0]
             assert imgs["shape"][0] == 8, imgs
+
+            # --- audio end-to-end: AUDIO rides the collector envelope ----
+            res = http_json(
+                f"http://127.0.0.1:{mport}/distributed/queue",
+                {"prompt": AUDIO_COLLECT, "client_id": "it-audio"},
+                timeout=30)
+            assert res["worker_count"] == 1, res
+            hist = wait_history(mport, res["prompt_id"], deadline_s=120)
+            assert hist["status"] == "success", hist
+            audio = hist["outputs"]["3"][1]["audio"]
+            # master clip + worker clip concatenated along samples
+            assert audio["shape"] == [1, 1, 2000], audio
+            assert audio["sample_rate"] == 16000, audio
 
             # --- fault injection: kill the worker mid-job ----------------
             res = http_json(

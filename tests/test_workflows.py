@@ -29,7 +29,7 @@ class TestShippedWorkflows:
         names = {p.stem for p in WORKFLOWS}
         assert {"distributed-txt2img", "distributed-upscale",
                 "flux-txt2img", "wan-t2v", "wan-i2v", "video-upscale",
-                "controlnet-tile-upscale"} <= names
+                "controlnet-tile-upscale", "distributed-audio"} <= names
 
     @pytest.mark.parametrize("path", WORKFLOWS, ids=lambda p: p.stem)
     def test_validates(self, path):
@@ -140,3 +140,31 @@ class TestSmokeExecution:
         collected = np.asarray(outputs["6"][0])
         assert collected.shape[0] == len(jax.devices()) * 5
         assert collected.shape[1:] == (16, 16, 3)
+
+    def test_audio_workflow_executes(self, tmp_path):
+        """LoadAudio → collector (identity in-process) → divider →
+        SaveAudio, end-to-end through the executor, with a WAV round-trip
+        integrity check on the output chunks."""
+        from comfyui_distributed_tpu.utils.audio_payload import (wav_bytes,
+                                                                 wav_decode)
+
+        t = np.linspace(0.0, 1.0, 2000, dtype=np.float32)
+        clip = np.sin(t * 660)[None] * 0.3
+        (tmp_path / "clip.wav").write_bytes(wav_bytes(clip, 16000))
+        prompt = strip_meta(load(Path("workflows/distributed-audio.json")))
+        outputs = GraphExecutor({
+            "input_dir": str(tmp_path),
+            "output_dir": str(tmp_path / "out"),
+        }).execute(prompt)
+        # collector is identity without a bridge; divider halves samples
+        chunk = outputs["4"][0]
+        assert chunk["waveform"].shape == (1, 1, 1000)
+        wavs = sorted((tmp_path / "out").glob("*.wav"))
+        assert [p.name for p in wavs] == ["chunk_a_00000.wav",
+                                          "chunk_b_00000.wav"]
+        a = wav_decode(wavs[0].read_bytes())
+        b = wav_decode(wavs[1].read_bytes())
+        assert a["sample_rate"] == 16000
+        rejoined = np.concatenate([a["waveform"], b["waveform"]], axis=-1)
+        assert rejoined.shape == (1, 1, 2000)
+        np.testing.assert_allclose(rejoined[0], clip, atol=2e-4)
