@@ -171,6 +171,11 @@ class ProgressTracker:
             }
 
     def preview_png(self, prompt_id: str, shard: int = 0) -> Optional[bytes]:
+        """Latest preview as PNG. Image latents render as one frame; a
+        VIDEO latent ([F,h,w,c]) renders as a horizontal strip of up to
+        four evenly-spaced frames — the motion arc at a glance, which a
+        single middle frame can't show (the dashboard polls this for the
+        t2v frame strip)."""
         with self._lock:
             token = self._by_prompt.get(prompt_id)
             job = self._jobs.get(token) if token is not None else None
@@ -178,4 +183,12 @@ class ProgressTracker:
             if lat is None:
                 return None
             lat = np.array(lat)
+        if lat.ndim == 4 and lat.shape[0] > 1:
+            idxs = np.unique(np.linspace(0, lat.shape[0] - 1,
+                                         min(4, lat.shape[0])).astype(int))
+            # tile the LATENT first and normalize once: per-frame
+            # normalization would flatten real brightness changes across
+            # the clip and leave step seams between tiles
+            strip = np.concatenate([lat[i] for i in idxs], axis=1)
+            return encode_png(latent_to_rgb(strip))
         return encode_png(latent_to_rgb(lat))

@@ -54,6 +54,27 @@ def mesh_cache_key(mesh: Mesh) -> tuple:
             tuple(d.id for d in mesh.devices.flat))
 
 
+def cached_build(holder, key, builder, max_entries: int = 8):
+    """Value-keyed compile cache shared by every pipeline/tile engine.
+
+    ``holder`` is an object (cache lives on its ``_fn_cache`` attribute)
+    or a dict (module-level caches). One definition so the eviction
+    policy (FIFO at ``max_entries``) and key hygiene can't drift between
+    the five call sites that used to hand-roll this."""
+    cache = holder if isinstance(holder, dict) \
+        else getattr(holder, "_fn_cache", None)
+    if cache is None:
+        cache = {}
+        holder._fn_cache = cache
+    fn = cache.get(key)
+    if fn is None:
+        if len(cache) >= max_entries:
+            cache.pop(next(iter(cache)))
+        fn = builder()
+        cache[key] = fn
+    return fn
+
+
 def bind_weights(jitted, weights):
     """Wrap a jitted function whose LEADING argument is the weight pytree:
     the returned callable supplies it automatically, while ``.jitted`` /
@@ -496,14 +517,9 @@ class Txt2ImgPipeline:
 
     def _cached_fn(self, mesh: Mesh, spec: GenerationSpec, hint=None,
                    progress: bool = False):
-        if not hasattr(self, "_fn_cache"):
-            self._fn_cache: "dict[tuple, Any]" = {}
         key = (self._mesh_cache_key(mesh), spec,
                None if hint is None else tuple(hint.shape), progress)
-        fn = self._fn_cache.get(key)
-        if fn is None:
-            if len(self._fn_cache) >= self._CACHE_MAX:
-                self._fn_cache.pop(next(iter(self._fn_cache)))
-            fn = self.generate_fn(mesh, spec, progress=progress)
-            self._fn_cache[key] = fn
-        return fn
+        return cached_build(
+            self, key, lambda: self.generate_fn(mesh, spec,
+                                                progress=progress),
+            self._CACHE_MAX)

@@ -126,10 +126,8 @@ class FlowPipeline:
         seed fan-out and ``sp`` ring-attention sharding share the cache,
         keyed by mode so a workflow that alternates between them never
         thrashes recompiles."""
-        from .pipeline import mesh_cache_key
+        from .pipeline import cached_build, mesh_cache_key
 
-        if not hasattr(self, "_fn_cache"):
-            self._fn_cache = {}
         if mode == "sp":
             # normalize the key: default axis resolves BEFORE keying so
             # axis=None and axis="sp" hit the same compiled program, and
@@ -139,17 +137,14 @@ class FlowPipeline:
             if progress:
                 raise NotImplementedError(
                     "progress streaming is not wired through sp mode")
-        key = (mesh_cache_key(mesh), spec, progress, mode, axis)
-        fn = self._fn_cache.get(key)
-        if fn is None:
-            if len(self._fn_cache) >= self._CACHE_MAX:
-                self._fn_cache.pop(next(iter(self._fn_cache)))
+
+        def build():
             if mode == "sp":
-                fn = self.generate_sp_fn(mesh, spec, axis=axis)
-            else:
-                fn = self.generate_fn(mesh, spec, progress=progress)
-            self._fn_cache[key] = fn
-        return fn
+                return self.generate_sp_fn(mesh, spec, axis=axis)
+            return self.generate_fn(mesh, spec, progress=progress)
+
+        key = (mesh_cache_key(mesh), spec, progress, mode, axis)
+        return cached_build(self, key, build, self._CACHE_MAX)
 
     def generate(self, mesh: Mesh, spec: FlowSpec, seed: int,
                  context: jax.Array, pooled: jax.Array,

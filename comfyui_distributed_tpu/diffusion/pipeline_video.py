@@ -19,6 +19,7 @@ helpers live in ``models/video_dit.py``.
 from __future__ import annotations
 
 import dataclasses
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -122,24 +123,35 @@ class VideoPipeline:
         return denoise
 
     def generate_fn(self, mesh: Mesh, spec: VideoSpec,
-                    axis: str = constants.AXIS_DATA):
-        """dp fan-out: each shard samples a full (seed-varied) video."""
+                    axis: str = constants.AXIS_DATA,
+                    progress: bool = False):
+        """dp fan-out: each shard samples a full (seed-varied) video.
+        ``progress`` threads a traced token through the program and
+        streams per-step x0 previews (``diffusion/progress``) — t2v jobs
+        are the longest-running work the framework does, exactly where
+        the reference's per-step ComfyUI progress matters most."""
         sigmas = sigmas_flow(spec.steps, spec.shift)
         ds = self.vae.config.downscale
         F = self.latent_frames(spec)
         lat = (F, spec.height // ds, spec.width // ds, self.dit.config.in_channels)
 
-        def per_shard(weights, key, context, pooled):
+        def per_shard(weights, key, context, pooled, token=None):
             k = participant_key(key, axis)
             x = jax.random.normal(k, (1,) + lat, jnp.float32)
             den = self._denoiser(context, pooled, spec.guidance_scale,
                                  params=weights["dit"])
+            if token is not None:
+                from .progress import wrap_denoiser
+
+                den = wrap_denoiser(den, token, jax.lax.axis_index(axis))
             x0 = sample(spec.sampler, den, x, sigmas, key=k)
             return self.decode_frames(x0, vae_params=weights["vae_dec"])
 
+        in_specs = (P(), P(), P(None, None, None), P(None, None))
+        if progress:
+            in_specs += (P(),)          # traced int32 token, replicated
         f = jax.shard_map(
-            per_shard, mesh=mesh,
-            in_specs=(P(), P(), P(None, None, None), P(None, None)),
+            per_shard, mesh=mesh, in_specs=in_specs,
             out_specs=P(axis, None, None, None, None),
         )
         jitted = jax.jit(f)
@@ -147,9 +159,55 @@ class VideoPipeline:
 
         return bind_weights(jitted, weights)
 
+    _CACHE_MAX = 4
+
+    def _cached_fn(self, mesh: Mesh, spec: VideoSpec, mode: str = "dp",
+                   progress: bool = False,
+                   axis: Optional[str] = None):
+        """Value-keyed compile cache across node executions (same
+        discipline as ``FlowPipeline._cached_fn`` — a WAN compile is far
+        too expensive to pay per prompt)."""
+        from .pipeline import cached_build, mesh_cache_key
+
+        if mode in ("sp", "i2v-sp"):
+            axis = axis or constants.AXIS_SEQUENCE
+        else:
+            axis = axis or constants.AXIS_DATA
+        builder = {"dp": self.generate_fn,
+                   "sp": self.generate_frames_fn,
+                   "i2v": self.generate_i2v_fn,
+                   "i2v-sp": self.generate_i2v_frames_fn}[mode]
+        key = (mesh_cache_key(mesh), spec, mode, progress, axis)
+        return cached_build(
+            self, key, lambda: builder(mesh, spec, axis=axis,
+                                       progress=progress),
+            self._CACHE_MAX)
+
+    @staticmethod
+    def _token_args(args: list, progress_token) -> list:
+        """Single place that knows the token's wire form (trailing int32
+        scalar) — the nodes never marshal it themselves."""
+        if progress_token is not None:
+            args.append(jnp.asarray(progress_token, jnp.int32))
+        return args
+
     def generate(self, mesh: Mesh, spec: VideoSpec, seed: int,
-                 context: jax.Array, pooled: jax.Array) -> jax.Array:
-        return self.generate_fn(mesh, spec)(jax.random.key(seed), context, pooled)
+                 context: jax.Array, pooled: jax.Array,
+                 progress_token=None) -> jax.Array:
+        fn = self._cached_fn(mesh, spec, "dp",
+                             progress=progress_token is not None)
+        return fn(*self._token_args(
+            [jax.random.key(seed), context, pooled], progress_token))
+
+    def generate_frames(self, mesh: Mesh, spec: VideoSpec, seed: int,
+                        context: jax.Array, pooled: jax.Array,
+                        progress_token=None) -> jax.Array:
+        """Public sp entry (ONE video, frame blocks sharded): cached
+        compile + progress token, mirroring ``generate``."""
+        fn = self._cached_fn(mesh, spec, "sp",
+                             progress=progress_token is not None)
+        return fn(*self._token_args(
+            [jax.random.key(seed), context, pooled], progress_token))
 
     # -- dp×tp: the WAN-14B enabler --------------------------------------
 
@@ -229,7 +287,8 @@ class VideoPipeline:
                               sp_axis=sp_axis, inp_fn=inp_fn, params=params)
 
     def generate_i2v_fn(self, mesh: Mesh, spec: VideoSpec,
-                        axis: str = constants.AXIS_DATA):
+                        axis: str = constants.AXIS_DATA,
+                        progress: bool = False):
         """dp fan-out of seed-varied i2v samples from one start image
         (the conditioning latents replicate across shards)."""
         sigmas = sigmas_flow(spec.steps, spec.shift)
@@ -239,20 +298,26 @@ class VideoPipeline:
                     self.dit.config.in_channels)
         lat = (F, spec.height // ds, spec.width // ds, c)
 
-        def per_shard(weights, key, context, pooled, y, mask):
+        def per_shard(weights, key, context, pooled, y, mask, token=None):
             k = participant_key(key, axis)
             x = jax.random.normal(k, (1,) + lat, jnp.float32)
             den = self._denoiser_i2v(context, pooled, y, mask,
                                      spec.guidance_scale,
                                      params=weights["dit"])
+            if token is not None:
+                from .progress import wrap_denoiser
+
+                den = wrap_denoiser(den, token, jax.lax.axis_index(axis))
             x0 = sample(spec.sampler, den, x, sigmas, key=k)
             return self.decode_frames(x0, vae_params=weights["vae_dec"])
 
+        in_specs = (P(), P(), P(None, None, None), P(None, None),
+                    P(None, None, None, None, None),
+                    P(None, None, None, None, None))
+        if progress:
+            in_specs += (P(),)
         f = jax.shard_map(
-            per_shard, mesh=mesh,
-            in_specs=(P(), P(), P(None, None, None), P(None, None),
-                      P(None, None, None, None, None),
-                      P(None, None, None, None, None)),
+            per_shard, mesh=mesh, in_specs=in_specs,
             out_specs=P(axis, None, None, None, None),
         )
         jitted = jax.jit(f)
@@ -262,13 +327,29 @@ class VideoPipeline:
 
     def generate_i2v(self, mesh: Mesh, spec: VideoSpec, seed: int,
                      image: jax.Array, context: jax.Array,
-                     pooled: jax.Array) -> jax.Array:
+                     pooled: jax.Array, progress_token=None) -> jax.Array:
         y, mask = self.i2v_condition(image, spec)
-        return self.generate_i2v_fn(mesh, spec)(
-            jax.random.key(seed), context, pooled, y, mask)
+        fn = self._cached_fn(mesh, spec, "i2v",
+                             progress=progress_token is not None)
+        return fn(*self._token_args(
+            [jax.random.key(seed), context, pooled, y, mask],
+            progress_token))
+
+    def generate_i2v_frames(self, mesh: Mesh, spec: VideoSpec, seed: int,
+                            image: jax.Array, context: jax.Array,
+                            pooled: jax.Array,
+                            progress_token=None) -> jax.Array:
+        """Public i2v sp entry: cached compile + progress token."""
+        y, mask = self.i2v_condition(image, spec)
+        fn = self._cached_fn(mesh, spec, "i2v-sp",
+                             progress=progress_token is not None)
+        return fn(*self._token_args(
+            [jax.random.key(seed), context, pooled, y, mask],
+            progress_token))
 
     def generate_i2v_frames_fn(self, mesh: Mesh, spec: VideoSpec,
-                               axis: str = constants.AXIS_SEQUENCE):
+                               axis: str = constants.AXIS_SEQUENCE,
+                               progress: bool = False):
         """ONE i2v sample with latent frame blocks sharded over ``axis``:
         ring attention spans the full sequence; each shard sees its own
         slice of the conditioning latents/mask (frame-aligned, so the
@@ -285,7 +366,8 @@ class VideoPipeline:
                     self.dit.config.in_channels)
         per = F // n_sh
 
-        def per_shard(weights, key, context, pooled, y_sh, mask_sh):
+        def per_shard(weights, key, context, pooled, y_sh, mask_sh,
+                      token=None):
             idx = jax.lax.axis_index(axis)
             full = jax.random.normal(key, (1, F, lat_h, lat_w, c),
                                      jnp.float32)
@@ -293,23 +375,29 @@ class VideoPipeline:
             den = self._denoiser_i2v(context, pooled, y_sh, mask_sh,
                                      spec.guidance_scale, sp_axis=axis,
                                      params=weights["dit"])
+            if token is not None:
+                from .progress import wrap_denoiser
+
+                den = wrap_denoiser(den, token, idx)
             # per-shard sampler key: ancestral samplers must inject
             # DIFFERENT noise into each frame block (deterministic
             # samplers ignore the key, so sp==unsharded still holds)
             return sample(spec.sampler, den, x, sigmas,
                           key=jax.random.fold_in(key, idx))
 
+        in_specs = (P(), P(), P(None, None, None), P(None, None),
+                    P(None, axis), P(None, axis))
+        if progress:
+            in_specs += (P(),)
         f = jax.shard_map(
-            per_shard, mesh=mesh,
-            in_specs=(P(), P(), P(None, None, None), P(None, None),
-                      P(None, axis), P(None, axis)),
+            per_shard, mesh=mesh, in_specs=in_specs,
             out_specs=P(None, axis, None, None, None),
             check_vma=False,
         )
 
-        def run(weights, key, context, pooled, y, mask):
+        def run(weights, key, context, pooled, y, mask, *token):
             return self.decode_frames(f(weights, key, context, pooled,
-                                        y, mask),
+                                        y, mask, *token),
                                       vae_params=weights["vae_dec"])
 
         jitted = jax.jit(run)
@@ -318,7 +406,8 @@ class VideoPipeline:
         return bind_weights(jitted, weights)
 
     def generate_frames_fn(self, mesh: Mesh, spec: VideoSpec,
-                           axis: str = constants.AXIS_SEQUENCE):
+                           axis: str = constants.AXIS_SEQUENCE,
+                           progress: bool = False):
         """ONE video, frame blocks sharded over ``axis``; joint ring
         attention spans the full spatio-temporal sequence so motion stays
         globally coherent (this is exact attention, not windowed)."""
@@ -335,26 +424,32 @@ class VideoPipeline:
         c = self.dit.config.in_channels
         per = F // n_sh
 
-        def per_shard(weights, key, context, pooled):
+        def per_shard(weights, key, context, pooled, token=None):
             idx = jax.lax.axis_index(axis)
             full = jax.random.normal(key, (1, F, lat_h, lat_w, c), jnp.float32)
             x = jax.lax.dynamic_slice_in_dim(full, idx * per, per, axis=1)
             den = self._denoiser(context, pooled, spec.guidance_scale,
                                  sp_axis=axis, params=weights["dit"])
+            if token is not None:
+                from .progress import wrap_denoiser
+
+                den = wrap_denoiser(den, token, idx)
             # fold the shard index so ancestral samplers draw distinct
             # noise per frame block (deterministic samplers ignore it)
             return sample(spec.sampler, den, x, sigmas,
                           key=jax.random.fold_in(key, idx))
 
+        in_specs = (P(), P(), P(None, None, None), P(None, None))
+        if progress:
+            in_specs += (P(),)
         f = jax.shard_map(
-            per_shard, mesh=mesh,
-            in_specs=(P(), P(), P(None, None, None), P(None, None)),
+            per_shard, mesh=mesh, in_specs=in_specs,
             out_specs=P(None, axis, None, None, None),
             check_vma=False,
         )
 
-        def run(weights, key, context, pooled):
-            latents = f(weights, key, context, pooled)
+        def run(weights, key, context, pooled, *token):
+            latents = f(weights, key, context, pooled, *token)
             return self.decode_frames(latents, vae_params=weights["vae_dec"])
 
         jitted = jax.jit(run)

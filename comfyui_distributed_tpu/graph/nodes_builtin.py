@@ -1129,13 +1129,15 @@ class TPUTxt2Video(NodeDef):
         "width": "INT", "height": "INT",
     }
     OPTIONAL = {"cfg": "FLOAT", "shift": "FLOAT", "mode": "STRING"}
-    HIDDEN = {"mesh": "*"}
+    HIDDEN = {"mesh": "*", "prompt_id": "STRING", "progress_tracker": "*"}
     RETURNS = ("IMAGE",)
 
     def execute(self, model, positive, seed: int, frames: int, steps: int,
                 width: int, height: int, cfg: float = 1.0,
-                shift: float = 3.0, mode: str = "dp", mesh=None, **_):
+                shift: float = 3.0, mode: str = "dp", mesh=None,
+                prompt_id: str = "", progress_tracker=None, **_):
         from ..diffusion.pipeline_video import VideoSpec
+        from ..diffusion.progress import total_calls
         from ..parallel.mesh import build_mesh
 
         if mesh is None:
@@ -1146,14 +1148,22 @@ class TPUTxt2Video(NodeDef):
         ctx = positive["context"]
         pooled = _video_pooled_default(model, positive)
         key = jax.random.key(int(seed))
-        if mode == "sp":
-            if "sp" not in mesh.shape:
-                mesh = build_mesh({"sp": mesh.devices.size},
-                                  list(mesh.devices.flat))
-            videos = model.pipeline.generate_frames_fn(mesh, spec)(
-                key, ctx, pooled)
-        else:
-            videos = model.pipeline.generate(mesh, spec, int(seed), ctx, pooled)
+        # t2v is the longest-running job type — stream per-step progress
+        # and previews exactly like the image samplers do
+        with _ProgressScope(progress_tracker, prompt_id,
+                            total_calls(spec.sampler, spec.steps)) as ps:
+            if mode == "sp":
+                if "sp" not in mesh.shape:
+                    mesh = build_mesh({"sp": mesh.devices.size},
+                                      list(mesh.devices.flat))
+                videos = model.pipeline.generate_frames(
+                    mesh, spec, int(seed), ctx, pooled,
+                    progress_token=ps.token)
+            else:
+                videos = model.pipeline.generate(mesh, spec, int(seed),
+                                                 ctx, pooled,
+                                                 progress_token=ps.token)
+            ps.complete(videos)
         return (_flatten_video_batch(videos),)
 
 
@@ -1169,13 +1179,15 @@ class TPUImg2Video(NodeDef):
         "seed": "INT", "frames": "INT", "steps": "INT",
     }
     OPTIONAL = {"cfg": "FLOAT", "shift": "FLOAT", "mode": "STRING"}
-    HIDDEN = {"mesh": "*"}
+    HIDDEN = {"mesh": "*", "prompt_id": "STRING", "progress_tracker": "*"}
     RETURNS = ("IMAGE",)
 
     def execute(self, model, positive, image, seed: int, frames: int,
                 steps: int, cfg: float = 1.0, shift: float = 3.0,
-                mode: str = "dp", mesh=None, **_):
+                mode: str = "dp", mesh=None, prompt_id: str = "",
+                progress_tracker=None, **_):
         from ..diffusion.pipeline_video import VideoSpec
+        from ..diffusion.progress import total_calls
         from ..parallel.mesh import build_mesh
         from ..utils.exceptions import ValidationError
 
@@ -1197,16 +1209,20 @@ class TPUImg2Video(NodeDef):
                          guidance_scale=float(cfg))
         ctx = positive["context"]
         pooled = _video_pooled_default(model, positive)
-        if mode == "sp":
-            if "sp" not in mesh.shape:
-                mesh = build_mesh({"sp": mesh.devices.size},
-                                  list(mesh.devices.flat))
-            y, m = model.pipeline.i2v_condition(image[:1], spec)
-            videos = model.pipeline.generate_i2v_frames_fn(mesh, spec)(
-                jax.random.key(int(seed)), ctx, pooled, y, m)
-        else:
-            videos = model.pipeline.generate_i2v(mesh, spec, int(seed),
-                                                 image[:1], ctx, pooled)
+        with _ProgressScope(progress_tracker, prompt_id,
+                            total_calls(spec.sampler, spec.steps)) as ps:
+            if mode == "sp":
+                if "sp" not in mesh.shape:
+                    mesh = build_mesh({"sp": mesh.devices.size},
+                                      list(mesh.devices.flat))
+                videos = model.pipeline.generate_i2v_frames(
+                    mesh, spec, int(seed), image[:1], ctx, pooled,
+                    progress_token=ps.token)
+            else:
+                videos = model.pipeline.generate_i2v(
+                    mesh, spec, int(seed), image[:1], ctx, pooled,
+                    progress_token=ps.token)
+            ps.complete(videos)
         return (_flatten_video_batch(videos),)
 
 

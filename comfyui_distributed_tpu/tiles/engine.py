@@ -75,17 +75,16 @@ class TileUpscaler:
         ``Txt2ImgPipeline._cached_fn``): dynamic per-image farming calls
         upscale() once per image — without this it would re-trace and
         re-compile the identical program every time."""
+        from ..diffusion.pipeline import cached_build
+
         key = (Txt2ImgPipeline._mesh_cache_key(mesh), tuple(image_hw), spec,
                batch, axis, with_spatial, with_control)
-        fn = self._fn_cache.get(key)
-        if fn is None:
-            if len(self._fn_cache) >= self._CACHE_MAX:
-                self._fn_cache.pop(next(iter(self._fn_cache)))
-            fn = self.upscale_fn(mesh, tuple(image_hw), spec, batch=batch,
-                                 axis=axis, with_spatial=with_spatial,
-                                 with_control=with_control)
-            self._fn_cache[key] = fn
-        return fn
+        return cached_build(
+            self, key,
+            lambda: self.upscale_fn(mesh, tuple(image_hw), spec, batch=batch,
+                                    axis=axis, with_spatial=with_spatial,
+                                    with_control=with_control),
+            self._CACHE_MAX)
 
     def grid_for(self, image_h: int, image_w: int, spec: UpscaleSpec) -> TileGrid:
         out_h = int(round(image_h * spec.scale))

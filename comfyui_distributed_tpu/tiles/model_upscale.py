@@ -98,13 +98,13 @@ def tiled_model_upscale(
         images = jnp.pad(images, ((0, 0), (0, pad_h), (0, pad_w), (0, 0)),
                          mode="edge")
 
+    from ..diffusion.pipeline import cached_build
+
     key = (Txt2ImgPipeline._mesh_cache_key(mesh), bundle.model.config,
            images.shape, tile, padding, axis)
-    fn = _fn_cache.get(key)
-    if fn is None:
-        if len(_fn_cache) >= _CACHE_MAX:
-            _fn_cache.pop(next(iter(_fn_cache)))
-        fn = _build_fn(mesh, bundle.model, bundle.model.config,
-                       images.shape, tile, padding, axis)
-        _fn_cache[key] = fn
+    fn = cached_build(
+        _fn_cache, key,
+        lambda: _build_fn(mesh, bundle.model, bundle.model.config,
+                          images.shape, tile, padding, axis),
+        _CACHE_MAX)
     return fn(bundle.params, images)[:, :H * s, :W * s, :]
