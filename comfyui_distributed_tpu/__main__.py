@@ -93,8 +93,20 @@ def cmd_convert(args: argparse.Namespace) -> None:
     # abstract core: the converter only needs leaf shapes, and every core
     # leaf is about to be overwritten — skip the (FLUX-size: ~48 GB)
     # random init
+    if preset.moe_boundary is not None and not getattr(
+            args, "checkpoint_low", None):
+        # fail BEFORE converting 28 GB: a dual-expert checkpoint without
+        # its low expert would only crash at save time (abstract leaves)
+        sys.exit(f"preset {args.preset!r} is a dual-expert model — pass "
+                 "the low-noise transformer via --checkpoint-low")
     bundle = ModelBundle(preset, abstract_core=True)
-    bundle.load_safetensors_checkpoint(Path(args.checkpoint))
+    if getattr(args, "checkpoint_low", None):
+        # WAN-2.2 dual-expert releases: --checkpoint is the high-noise
+        # transformer, --checkpoint-low the low-noise one
+        bundle.load_safetensors_moe(Path(args.checkpoint),
+                                    Path(args.checkpoint_low))
+    else:
+        bundle.load_safetensors_checkpoint(Path(args.checkpoint))
     if getattr(args, "t5", None) or getattr(args, "clip_l", None):
         bundle.load_text_encoder_files(
             t5=Path(args.t5) if args.t5 else None,
@@ -144,6 +156,10 @@ def main(argv: list[str] | None = None) -> None:
     conv = sub.add_parser(
         "convert", help="convert a single-file .safetensors checkpoint")
     conv.add_argument("--checkpoint", required=True)
+    conv.add_argument("--checkpoint-low", dest="checkpoint_low", default=None,
+                      help="wan-2.2 dual-expert: low-noise transformer "
+                           ".safetensors (--checkpoint is then the "
+                           "high-noise expert)")
     conv.add_argument("--preset", default="sdxl")
     conv.add_argument("--out", required=True)
     conv.add_argument("--t5", default=None,

@@ -50,10 +50,74 @@ class VideoSpec:
 
 
 class VideoPipeline:
-    def __init__(self, dit: VideoDiT, dit_params, vae: AutoencoderKL):
+    """``dit_params_low``/``expert_boundary`` enable WAN-2.2-style
+    dual-expert (MoE) sampling: the published 14B t2v/i2v models are TWO
+    DiTs — a high-noise expert for timesteps ≥ boundary·1000 and a
+    low-noise expert below (t2v boundary 0.875, i2v 0.9). The sigma
+    ladder splits at the boundary and each segment runs its expert's
+    weights — two clean sampler scans, the XLA-friendly form of
+    ComfyUI's two-KSampler-pass graph (no weight-sized ``lax.cond``)."""
+
+    def __init__(self, dit: VideoDiT, dit_params, vae: AutoencoderKL,
+                 dit_params_low=None, expert_boundary: Optional[float] = None):
         self.dit = dit
         self.dit_params = dit_params
+        self.dit_params_low = dit_params_low
+        self.expert_boundary = expert_boundary
         self.vae = vae
+
+    @property
+    def is_moe(self) -> bool:
+        return (self.dit_params_low is not None
+                and self.expert_boundary is not None)
+
+    def _expert_split(self, sigmas) -> int:
+        """Number of leading sampler steps the HIGH-noise expert takes:
+        a step is 'high' when its current sigma ≥ boundary (flow sigmas
+        ARE normalized timesteps: sigma = t/1000)."""
+        import numpy as np
+
+        cur = np.asarray(sigmas)[:-1]            # per-step current sigmas
+        return int(np.sum(cur >= self.expert_boundary))
+
+    @staticmethod
+    def _progress_den(build_den, token, shard_index):
+        """Shared progress interposition for every generate_* factory:
+        ``build_den(params) -> denoiser``, wrapped with the traced token
+        when progress is on — one definition so the token plumbing can't
+        drift between the four execution modes."""
+        def make_den(params):
+            den = build_den(params)
+            if token is not None:
+                from .progress import wrap_denoiser
+
+                den = wrap_denoiser(den, token, shard_index)
+            return den
+
+        return make_den
+
+    def _sample_expert(self, spec: "VideoSpec", make_den, x, sigmas, key,
+                       weights):
+        """Run the sampler with expert switching. ``make_den(params)``
+        builds the (possibly progress-wrapped) denoiser for one expert's
+        weights; single-expert pipelines take one scan as before."""
+        if not self.is_moe:
+            return sample(spec.sampler, make_den(weights["dit"]), x,
+                          sigmas, key=key)
+        split = self._expert_split(sigmas)
+        steps = int(sigmas.shape[0]) - 1
+        if split <= 0:
+            return sample(spec.sampler, make_den(weights["dit_low"]), x,
+                          sigmas, key=key)
+        if split >= steps:
+            return sample(spec.sampler, make_den(weights["dit"]), x,
+                          sigmas, key=key)
+        x_mid = sample(spec.sampler, make_den(weights["dit"]), x,
+                       sigmas[: split + 1], key=key)
+        # distinct fold for the low segment so ancestral samplers never
+        # reuse the high segment's noise draws
+        return sample(spec.sampler, make_den(weights["dit_low"]), x_mid,
+                      sigmas[split:], key=jax.random.fold_in(key, 0x10E))
 
     @property
     def temporal_downscale(self) -> int:
@@ -68,7 +132,10 @@ class VideoPipeline:
         """Explicit jit-argument weight pytree (closure capture would
         serialize the params into the lowered module — 28 GB of MLIR for
         WAN-14B; see ``Txt2ImgPipeline._weights``)."""
-        return {"dit": self.dit_params, "vae_dec": self.vae.dec_params}
+        w = {"dit": self.dit_params, "vae_dec": self.vae.dec_params}
+        if self.dit_params_low is not None:
+            w["dit_low"] = self.dit_params_low
+        return w
 
     def decode_frames(self, latents: jax.Array, vae_params=None) -> jax.Array:
         """[B,f,h,w,c] → [B,F,H,W,3]: whole-clip decode through a 3D
@@ -138,13 +205,11 @@ class VideoPipeline:
         def per_shard(weights, key, context, pooled, token=None):
             k = participant_key(key, axis)
             x = jax.random.normal(k, (1,) + lat, jnp.float32)
-            den = self._denoiser(context, pooled, spec.guidance_scale,
-                                 params=weights["dit"])
-            if token is not None:
-                from .progress import wrap_denoiser
-
-                den = wrap_denoiser(den, token, jax.lax.axis_index(axis))
-            x0 = sample(spec.sampler, den, x, sigmas, key=k)
+            make_den = self._progress_den(
+                lambda p: self._denoiser(context, pooled,
+                                         spec.guidance_scale, params=p),
+                token, jax.lax.axis_index(axis))
+            x0 = self._sample_expert(spec, make_den, x, sigmas, k, weights)
             return self.decode_frames(x0, vae_params=weights["vae_dec"])
 
         in_specs = (P(), P(), P(None, None, None), P(None, None))
@@ -237,20 +302,28 @@ class VideoPipeline:
         B = mesh.shape[dp_axis]
         require_tp_match(self.dit_params, mesh, rules, tp_axis, family)
         # tp-placed params travel as ARGUMENTS (committed sharded arrays),
-        # never closure constants (see _weights)
-        params = shard_params(self.dit_params, mesh, rules, tp_axis)
+        # never closure constants (see _weights). Both experts of a
+        # WAN-2.2 MoE shard over tp — per-chip resident weights stay
+        # 2·(params/tp_degree), which is what makes the dual-14B config
+        # placeable at all.
+        weights = {"dit": shard_params(self.dit_params, mesh, rules,
+                                       tp_axis)}
+        if self.dit_params_low is not None:
+            weights["dit_low"] = shard_params(self.dit_params_low, mesh,
+                                              rules, tp_axis)
         vae_dec = self.vae.dec_params
 
-        def run(params, vae_dec, keys, context, pooled):
+        def run(weights, vae_dec, keys, context, pooled):
             noise = jax.vmap(
                 lambda k: jax.random.normal(k, lat, jnp.float32))(keys)
             bc = lambda a: jnp.broadcast_to(a, (B,) + a.shape[1:])
-            den = self._denoiser(bc(context), bc(pooled),
-                                 spec.guidance_scale, params=params)
-            x0 = sample(spec.sampler, den, noise, sigmas, key=keys[0])
+            make_den = lambda p: self._denoiser(
+                bc(context), bc(pooled), spec.guidance_scale, params=p)
+            x0 = self._sample_expert(spec, make_den, noise, sigmas,
+                                     keys[0], weights)
             return self.decode_frames(x0, vae_params=vae_dec)
 
-        return tp_fanout_call(jax.jit(run), (params, vae_dec), mesh,
+        return tp_fanout_call(jax.jit(run), (weights, vae_dec), mesh,
                               dp_axis, B)
 
     # -- image→video (WAN-2.2-style latent-concat conditioning) ----------
@@ -301,14 +374,11 @@ class VideoPipeline:
         def per_shard(weights, key, context, pooled, y, mask, token=None):
             k = participant_key(key, axis)
             x = jax.random.normal(k, (1,) + lat, jnp.float32)
-            den = self._denoiser_i2v(context, pooled, y, mask,
-                                     spec.guidance_scale,
-                                     params=weights["dit"])
-            if token is not None:
-                from .progress import wrap_denoiser
-
-                den = wrap_denoiser(den, token, jax.lax.axis_index(axis))
-            x0 = sample(spec.sampler, den, x, sigmas, key=k)
+            make_den = self._progress_den(
+                lambda p: self._denoiser_i2v(context, pooled, y, mask,
+                                             spec.guidance_scale, params=p),
+                token, jax.lax.axis_index(axis))
+            x0 = self._sample_expert(spec, make_den, x, sigmas, k, weights)
             return self.decode_frames(x0, vae_params=weights["vae_dec"])
 
         in_specs = (P(), P(), P(None, None, None), P(None, None),
@@ -372,18 +442,16 @@ class VideoPipeline:
             full = jax.random.normal(key, (1, F, lat_h, lat_w, c),
                                      jnp.float32)
             x = jax.lax.dynamic_slice_in_dim(full, idx * per, per, axis=1)
-            den = self._denoiser_i2v(context, pooled, y_sh, mask_sh,
-                                     spec.guidance_scale, sp_axis=axis,
-                                     params=weights["dit"])
-            if token is not None:
-                from .progress import wrap_denoiser
-
-                den = wrap_denoiser(den, token, idx)
+            make_den = self._progress_den(
+                lambda p: self._denoiser_i2v(context, pooled, y_sh, mask_sh,
+                                             spec.guidance_scale,
+                                             sp_axis=axis, params=p),
+                token, idx)
             # per-shard sampler key: ancestral samplers must inject
             # DIFFERENT noise into each frame block (deterministic
             # samplers ignore the key, so sp==unsharded still holds)
-            return sample(spec.sampler, den, x, sigmas,
-                          key=jax.random.fold_in(key, idx))
+            return self._sample_expert(spec, make_den, x, sigmas,
+                                       jax.random.fold_in(key, idx), weights)
 
         in_specs = (P(), P(), P(None, None, None), P(None, None),
                     P(None, axis), P(None, axis))
@@ -428,16 +496,15 @@ class VideoPipeline:
             idx = jax.lax.axis_index(axis)
             full = jax.random.normal(key, (1, F, lat_h, lat_w, c), jnp.float32)
             x = jax.lax.dynamic_slice_in_dim(full, idx * per, per, axis=1)
-            den = self._denoiser(context, pooled, spec.guidance_scale,
-                                 sp_axis=axis, params=weights["dit"])
-            if token is not None:
-                from .progress import wrap_denoiser
-
-                den = wrap_denoiser(den, token, idx)
+            make_den = self._progress_den(
+                lambda p: self._denoiser(context, pooled,
+                                         spec.guidance_scale,
+                                         sp_axis=axis, params=p),
+                token, idx)
             # fold the shard index so ancestral samplers draw distinct
             # noise per frame block (deterministic samplers ignore it)
-            return sample(spec.sampler, den, x, sigmas,
-                          key=jax.random.fold_in(key, idx))
+            return self._sample_expert(spec, make_den, x, sigmas,
+                                       jax.random.fold_in(key, idx), weights)
 
         in_specs = (P(), P(), P(None, None, None), P(None, None))
         if progress:

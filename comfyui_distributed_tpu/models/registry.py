@@ -33,6 +33,10 @@ class ModelPreset:
     dit: "object | None" = None               # DiTConfig for flow models
     video: "object | None" = None             # VideoDiTConfig for t2v models
     clip: "str | None" = None   # real text stack: "sdxl" | "clip-l" | "flux" (T5+CLIP-L)
+    # WAN-2.2 dual-expert (MoE) models: sigma boundary between the
+    # high-noise and low-noise expert DiTs (t2v 0.875, i2v 0.9); None =
+    # single-expert
+    moe_boundary: "float | None" = None
 
     @property
     def kind(self) -> str:
@@ -121,6 +125,30 @@ def _wan_tiny_3d_preset():
         sample_hw=(8, 8), video=WanConfig.tiny())
 
 
+def _wan22_t2v_preset():
+    from .wan import WanConfig
+    from .wan_vae import WanVAEConfig
+
+    # WAN-2.2 14B t2v IS a two-expert model: high-noise + low-noise DiTs
+    # switched at timestep boundary 0.875·1000 (the published release
+    # ships two transformer safetensors). Same architecture per expert as
+    # wan-14b; the pipeline runs the sigma ladder in two segments.
+    return ModelPreset(
+        "wan-2.2-t2v", unet=None, vae=WanVAEConfig.wan(),
+        text=TextEncoderConfig(output_dim=4096, pooled_dim=768),
+        sample_hw=(60, 104),
+        video=WanConfig.wan_14b(), clip="umt5", moe_boundary=0.875)
+
+
+def _wan22_tiny_preset():
+    from .wan import WanConfig
+
+    return ModelPreset(
+        "wan-2.2-tiny", unet=None, vae=VAEConfig.tiny(),
+        text=TextEncoderConfig.tiny(),
+        sample_hw=(8, 8), video=WanConfig.tiny(), moe_boundary=0.875)
+
+
 def _wan_mmdit_preset():
     from .video_dit import VideoDiTConfig
 
@@ -149,6 +177,8 @@ PRESETS: dict[str, ModelPreset] = {
     "wan-tiny-3d": _wan_tiny_3d_preset(),
     "wan-i2v": _wan_i2v_preset(),
     "wan-i2v-tiny": _wan_i2v_tiny_preset(),
+    "wan-2.2-t2v": _wan22_t2v_preset(),
+    "wan-2.2-tiny": _wan22_tiny_preset(),
     "video-mmdit": _wan_mmdit_preset(),
 }
 
@@ -190,7 +220,22 @@ class ModelBundle:
                     preset.video, k1,
                     sample_fhw=(5, *preset.sample_hw),
                     context_len=preset.text.max_len, abstract=abstract_core)
-            self.pipeline = VideoPipeline(model, params, vae)
+            params_low = None
+            if preset.moe_boundary is not None:
+                if not isinstance(preset.video, WanConfig):
+                    raise ValidationError(
+                        f"preset {preset.name!r}: moe_boundary is only "
+                        "supported for WAN-architecture video models")
+                # the low-noise expert is a SECOND full DiT of the same
+                # architecture (WAN-2.2's high/low pair)
+                _, params_low = init_wan(
+                    preset.video, jax.random.fold_in(k1, 1),
+                    sample_fhw=(5, *preset.sample_hw),
+                    context_len=preset.text.max_len,
+                    abstract=abstract_core)
+            self.pipeline = VideoPipeline(
+                model, params, vae, dit_params_low=params_low,
+                expert_boundary=preset.moe_boundary)
         elif preset.kind == "dit":
             from ..diffusion.pipeline_flow import FlowPipeline
             from .dit import init_dit
@@ -211,12 +256,32 @@ class ModelBundle:
             self.pipeline = Txt2ImgPipeline(model, params, vae)
         if checkpoint_dir is not None:
             p = Path(checkpoint_dir)
+            hi = p.parent / f"{p.name}.high.safetensors"
+            lo = p.parent / f"{p.name}.low.safetensors"
+            # NOT with_suffix: dotted preset names ("wan-2.2-t2v") would
+            # have ".2-t2v" treated as the suffix and silently miss
+            single = p.parent / f"{p.name}.safetensors"
             if p.is_dir():
                 self._load_checkpoint(p)
-            elif p.with_suffix(".safetensors").is_file():
+            elif preset.moe_boundary is not None and hi.is_file() \
+                    and lo.is_file():
+                # WAN-2.2 releases ship TWO transformer files; drop them
+                # as `<name>.high.safetensors` + `<name>.low.safetensors`
+                self.load_safetensors_moe(hi, lo)
+            elif preset.moe_boundary is not None and (hi.is_file()
+                                                      or lo.is_file()):
+                # one expert present, one missing/misnamed: serving random
+                # weights for the other expert would generate noise with
+                # no diagnostic
+                missing = lo if hi.is_file() else hi
+                raise ValidationError(
+                    f"dual-expert checkpoint incomplete: {missing} not "
+                    "found (need both .high.safetensors and "
+                    ".low.safetensors)")
+            elif single.is_file():
                 # drop `<name>.safetensors` next to the orbax dirs and the
                 # published checkpoint converts on first load
-                self.load_safetensors_checkpoint(p.with_suffix(".safetensors"))
+                self.load_safetensors_checkpoint(single)
 
     @property
     def kind(self) -> str:
@@ -278,6 +343,8 @@ class ModelBundle:
             "vae_enc": self.pipeline.vae.enc_params,
             "vae_dec": self.pipeline.vae.dec_params,
         }
+        if getattr(self.pipeline, "dit_params_low", None) is not None:
+            state["core_low"] = self.pipeline.dit_params_low
         if self.clip_stack is not None:
             if self.preset.clip == "sdxl":
                 state["clip_l"] = self.clip_stack.clip_l.params
@@ -295,6 +362,8 @@ class ModelBundle:
 
     def _apply_entries(self, restored: dict) -> None:
         self._set_core_params(restored["core"])
+        if "core_low" in restored:
+            self.pipeline.dit_params_low = restored["core_low"]
         self.pipeline.vae.enc_params = restored["vae_enc"]
         self.pipeline.vae.dec_params = restored["vae_dec"]
         if "clip_l" in restored:
@@ -402,6 +471,29 @@ class ModelBundle:
             # if they were real
             self.build_clip_stack()
         convert_checkpoint(path, self)
+
+    def load_safetensors_moe(self, high: Path, low: Path) -> None:
+        """Convert a WAN-2.2 dual-expert release: the high-noise
+        transformer file into the main params and the low-noise file into
+        ``dit_params_low`` (both shape-checked against this preset's
+        architecture; the experts are architecturally identical)."""
+        from .convert import convert_checkpoint
+
+        if self.preset.moe_boundary is None:
+            raise ValidationError(
+                f"preset {self.preset.name!r} is not a dual-expert model; "
+                "use load_safetensors_checkpoint for single-transformer "
+                "releases")
+        convert_checkpoint(Path(high), self)
+        hi_params = self.pipeline.dit_params
+        # the low expert converts against the low template in the same
+        # code path, then the trees swap back into place
+        self.pipeline.dit_params = self.pipeline.dit_params_low
+        try:
+            convert_checkpoint(Path(low), self)
+            self.pipeline.dit_params_low = self.pipeline.dit_params
+        finally:
+            self.pipeline.dit_params = hi_params
 
     def load_text_encoder_files(self, t5: Optional[Path] = None,
                                 clip_l: Optional[Path] = None) -> None:
