@@ -16,6 +16,7 @@ from aiohttp import web
 from ..utils import constants
 from ..utils.exceptions import ValidationError
 from ..utils.image import decode_png
+from ..utils.logging import debug_log
 from .schemas import parse_positive_int, require_fields, validate_worker_id
 
 
@@ -57,6 +58,9 @@ def register(router, controller) -> None:
         require_fields(body, "job_id", "worker_id")
         task = await store.request_work(
             body["job_id"], validate_worker_id(body["worker_id"]))
+        if task is not None:
+            debug_log(f"tile-farm[{body['job_id']}] assigned task "
+                      f"{task.get('task_id')} to {body['worker_id']}")
         return web.json_response({"task": task})
 
     async def submit_tiles(request):

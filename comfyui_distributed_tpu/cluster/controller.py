@@ -16,8 +16,8 @@ from pathlib import Path
 from typing import Any, Optional
 
 from ..utils import constants
-from ..utils.config import ensure_config_exists, load_config
-from ..utils.logging import log
+from ..utils.config import ensure_config_exists, load_config, peek_setting
+from ..utils.logging import log, set_debug_source
 from ..workers.detection import detect_environment, get_machine_id as machine_id
 from .collector_bridge import CollectorBridge
 from .job_store import JobStore
@@ -38,6 +38,12 @@ class Controller:
             from ..utils.network import set_auth_config_path
 
             set_auth_config_path(config_path)
+        # wire the config's settings.debug flag into the TTL-cached log
+        # gate (reference utils/logging.py:15-39) — without this only the
+        # CDT_DEBUG env var could enable debug logging (the gate always
+        # honors the env var on top of this source)
+        set_debug_source(
+            lambda: bool(peek_setting("debug", False, config_path)))
         self.is_worker = os.environ.get(IS_WORKER_ENV, "") not in ("", "0")
         self.store = JobStore()
         self.queue = PromptQueue(context_factory=self._execution_context)

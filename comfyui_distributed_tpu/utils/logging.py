@@ -48,13 +48,15 @@ def _debug_enabled() -> bool:
     now = time.monotonic()
     if _debug_cache is not None and now - _debug_cache[0] < _DEBUG_TTL:
         return _debug_cache[1]
-    if _debug_source is not None:
+    # the env var is ALWAYS honored; an installed source (the config's
+    # settings.debug) can only add to it — so sources never need to
+    # re-implement the env check
+    enabled = os.environ.get("CDT_DEBUG", "") not in ("", "0", "false")
+    if not enabled and _debug_source is not None:
         try:
             enabled = bool(_debug_source())
         except Exception:
             enabled = False
-    else:
-        enabled = os.environ.get("CDT_DEBUG", "") not in ("", "0", "false")
     _debug_cache = (now, enabled)
     return enabled
 
