@@ -39,14 +39,147 @@ LOG_BUFFER_LINES = 200
 
 
 def find_cloudflared() -> Optional[str]:
-    """Binary discovery (reference ``binary.py:69-83``), no download."""
+    """Binary discovery (reference ``binary.py:69-83``)."""
     env = os.environ.get("CLOUDFLARED_PATH")
     if env and Path(env).is_file():
         return env
-    local = Path(__file__).resolve().parent.parent / "bin" / "cloudflared"
+    local = _local_bin_path()
     if local.is_file():
         return str(local)
     return shutil.which("cloudflared")
+
+
+def _local_bin_path() -> Path:
+    name = "cloudflared.exe" if os.name == "nt" else "cloudflared"
+    return Path(__file__).resolve().parent.parent / "bin" / name
+
+
+# --- auto-download (reference utils/cloudflare/binary.py:47-66) -------------
+
+# Pinned by default for reproducible installs (and so a shipped
+# CDT_CLOUDFLARED_SHA256 pin stays meaningful); CDT_CLOUDFLARED_VERSION
+# overrides, "latest" opts into the moving target. A pinned tag that
+# 404s falls back to latest with a log line.
+PINNED_VERSION = "2025.2.0"
+RELEASE_URL = ("https://github.com/cloudflare/cloudflared/releases/"
+               "download/{version}/{asset}")
+LATEST_URL = ("https://github.com/cloudflare/cloudflared/releases/"
+              "latest/download/{asset}")
+
+
+def _platform_asset() -> str:
+    """Release asset name for this platform (the reference keys the same
+    GitHub release assets by os/arch)."""
+    import platform as _platform
+
+    mach = _platform.machine().lower()
+    arch = {"x86_64": "amd64", "amd64": "amd64",
+            "aarch64": "arm64", "arm64": "arm64"}.get(mach, "amd64")
+    sysname = _platform.system().lower()
+    if sysname == "windows":
+        return f"cloudflared-windows-{arch}.exe"
+    if sysname == "darwin":
+        return f"cloudflared-darwin-{arch}.tgz"
+    return f"cloudflared-linux-{arch}"
+
+
+def _http_fetch(url: str, timeout: float = 120.0) -> bytes:
+    import urllib.request
+
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read()
+
+
+def download_cloudflared(dest_dir: Optional[Path] = None, fetcher=None,
+                         expected_sha256: Optional[str] = None) -> str:
+    """Download the platform's cloudflared release into the package-local
+    ``bin/`` dir (where ``find_cloudflared`` looks first). Atomic write +
+    exec bit; the SHA-256 is always computed and logged, and enforced
+    when ``expected_sha256`` (or ``CDT_CLOUDFLARED_SHA256``) is set —
+    release assets are fetched over TLS from GitHub, and a pinned hash
+    upgrades that to content verification."""
+    import hashlib
+    import io
+    import tarfile
+
+    import tempfile
+
+    asset = _platform_asset()
+    fetch = fetcher or _http_fetch
+    version = os.environ.get("CDT_CLOUDFLARED_VERSION", PINNED_VERSION)
+    if version == "latest":
+        url = LATEST_URL.format(asset=asset)
+    else:
+        url = RELEASE_URL.format(version=version, asset=asset)
+    log(f"downloading {asset} ({version}) from GitHub releases")
+    try:
+        data = fetch(url)
+    except Exception as e:
+        if version == "latest":
+            raise
+        # a pinned tag can age out — latest keeps the feature working,
+        # at the cost of reproducibility (logged so the drift is visible)
+        log(f"pinned cloudflared {version} unavailable ({e}); "
+            "falling back to latest")
+        data = fetch(LATEST_URL.format(asset=asset))
+    expected = expected_sha256 or os.environ.get("CDT_CLOUDFLARED_SHA256")
+    digest = hashlib.sha256(data).hexdigest()
+    if expected and digest != expected.strip().lower():
+        raise TunnelError(
+            f"cloudflared download checksum mismatch: got {digest}, "
+            f"expected {expected} — refusing to install")
+    if asset.endswith(".tgz"):
+        with tarfile.open(fileobj=io.BytesIO(data), mode="r:gz") as tar:
+            try:
+                member = tar.extractfile(tar.getmember("cloudflared"))
+            except KeyError:
+                member = None
+            if member is None:
+                raise TunnelError("cloudflared missing from release tgz")
+            data = member.read()
+    dest = (Path(dest_dir) if dest_dir else _local_bin_path().parent)
+    dest.mkdir(parents=True, exist_ok=True)
+    out = dest / _local_bin_path().name
+    # unique temp + os.replace: concurrent downloaders (master + local
+    # worker) can't corrupt each other, and replace overwrites atomically
+    # on every platform (same discipline as config.save_config)
+    fd, tmp = tempfile.mkstemp(dir=str(dest), prefix=".cloudflared_")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(data)
+        os.chmod(tmp, 0o755)
+        os.replace(tmp, out)
+    except OSError:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    log(f"cloudflared installed at {out} (sha256 {digest})")
+    return str(out)
+
+
+def ensure_cloudflared(fetcher=None) -> str:
+    """Discovery first, download as the fallback (reference
+    ``binary.py:69-83`` order). ``CDT_CLOUDFLARED_AUTO_DOWNLOAD=0``
+    restores the old discovery-only behavior (e.g. air-gapped hosts
+    where the download can only time out)."""
+    found = find_cloudflared()
+    if found:
+        return found
+    auto = os.environ.get("CDT_CLOUDFLARED_AUTO_DOWNLOAD", "1")
+    if auto in ("0", "false", "no"):
+        raise TunnelError(
+            "cloudflared binary not found and auto-download is disabled — "
+            "install it or set CLOUDFLARED_PATH")
+    try:
+        return download_cloudflared(fetcher=fetcher)
+    except TunnelError:
+        raise
+    except Exception as e:
+        raise TunnelError(
+            f"cloudflared not found and download failed ({e}) — install "
+            "it manually or set CLOUDFLARED_PATH") from e
 
 
 class _ProcessReader(threading.Thread):
@@ -116,12 +249,10 @@ class TunnelManager:
         async with self._lock:
             if self.running and self.url:
                 return self.url
-            binary = find_cloudflared()
-            if not binary:
-                raise TunnelError(
-                    "cloudflared binary not found — install it or set "
-                    "CLOUDFLARED_PATH (this framework does not auto-download "
-                    "executables)")
+            # the download is blocking urllib I/O — keep it off the event
+            # loop (same executor discipline as wait_for_url below)
+            binary = await asyncio.get_running_loop().run_in_executor(
+                None, ensure_cloudflared)
             # arm auth BEFORE the URL becomes publicly routable — once
             # cloudflared registers with the edge, requests can arrive;
             # generating the token afterwards would leave a window with a

@@ -60,10 +60,146 @@ class TestDiscovery:
 
     def test_start_without_binary_raises(self, tmp_config, monkeypatch):
         monkeypatch.delenv("CLOUDFLARED_PATH", raising=False)
+        monkeypatch.setenv("CDT_CLOUDFLARED_AUTO_DOWNLOAD", "0")
         monkeypatch.setattr(tunnel_mod.shutil, "which", lambda _: None)
         mgr = tunnel_mod.TunnelManager(tmp_config)
         with pytest.raises(TunnelError, match="not found"):
             run(mgr.start_tunnel(8288))
+
+
+class TestAutoDownload:
+    """Reference parity: ``utils/cloudflare/binary.py:47-66`` downloads
+    the platform's release asset when discovery fails; mocked fetch here
+    (the suite is hermetic/zero-egress)."""
+
+    def _no_binary(self, monkeypatch, tmp_path):
+        monkeypatch.delenv("CLOUDFLARED_PATH", raising=False)
+        monkeypatch.delenv("CDT_CLOUDFLARED_AUTO_DOWNLOAD", raising=False)
+        monkeypatch.delenv("CDT_CLOUDFLARED_SHA256", raising=False)
+        monkeypatch.setattr(tunnel_mod.shutil, "which", lambda _: None)
+        monkeypatch.setattr(tunnel_mod, "_local_bin_path",
+                            lambda: tmp_path / "bin" / "cloudflared")
+
+    def test_platform_asset_is_keyed(self):
+        asset = tunnel_mod._platform_asset()
+        assert asset.startswith("cloudflared-")
+        assert any(a in asset for a in ("amd64", "arm64"))
+
+    def test_download_installs_executable(self, monkeypatch, tmp_path):
+        self._no_binary(monkeypatch, tmp_path)
+        fetched = {}
+
+        def fake_fetch(url):
+            fetched["url"] = url
+            return b"#!/bin/sh\necho fake\n"
+
+        path = tunnel_mod.ensure_cloudflared(fetcher=fake_fetch)
+        assert path == str(tmp_path / "bin" / "cloudflared")
+        assert tunnel_mod._platform_asset() in fetched["url"]
+        assert fetched["url"].startswith(
+            "https://github.com/cloudflare/cloudflared/releases/")
+        import os as _os
+
+        st = _os.stat(path)
+        assert st.st_mode & 0o111          # executable
+        # discovery now finds the installed binary: no second download
+        assert tunnel_mod.ensure_cloudflared(
+            fetcher=lambda url: (_ for _ in ()).throw(AssertionError)) == path
+
+    def test_checksum_enforced(self, monkeypatch, tmp_path):
+        self._no_binary(monkeypatch, tmp_path)
+        monkeypatch.setenv("CDT_CLOUDFLARED_SHA256", "0" * 64)
+        with pytest.raises(TunnelError, match="checksum mismatch"):
+            tunnel_mod.download_cloudflared(fetcher=lambda url: b"payload")
+        assert not (tmp_path / "bin" / "cloudflared").exists()
+
+    def test_checksum_match_accepts(self, monkeypatch, tmp_path):
+        import hashlib
+
+        self._no_binary(monkeypatch, tmp_path)
+        payload = b"real-binary-bytes"
+        monkeypatch.setenv("CDT_CLOUDFLARED_SHA256",
+                           hashlib.sha256(payload).hexdigest())
+        path = tunnel_mod.download_cloudflared(fetcher=lambda url: payload)
+        assert (tmp_path / "bin" / "cloudflared").read_bytes() == payload
+        assert path.endswith("cloudflared")
+
+    def test_download_disabled_raises(self, monkeypatch, tmp_path):
+        self._no_binary(monkeypatch, tmp_path)
+        monkeypatch.setenv("CDT_CLOUDFLARED_AUTO_DOWNLOAD", "0")
+        with pytest.raises(TunnelError, match="auto-download is disabled"):
+            tunnel_mod.ensure_cloudflared(
+                fetcher=lambda url: b"never called")
+
+    def test_fetch_failure_wraps_as_tunnel_error(self, monkeypatch, tmp_path):
+        self._no_binary(monkeypatch, tmp_path)
+
+        def boom(url):
+            raise OSError("no route to host")
+
+        with pytest.raises(TunnelError, match="download failed"):
+            tunnel_mod.ensure_cloudflared(fetcher=boom)
+
+    def test_tgz_asset_extracts_member(self, monkeypatch, tmp_path):
+        import io
+        import tarfile
+
+        self._no_binary(monkeypatch, tmp_path)
+        monkeypatch.setattr(tunnel_mod, "_platform_asset",
+                            lambda: "cloudflared-darwin-amd64.tgz")
+        buf = io.BytesIO()
+        with tarfile.open(fileobj=buf, mode="w:gz") as tar:
+            data = b"mach-o-binary"
+            info = tarfile.TarInfo("cloudflared")
+            info.size = len(data)
+            tar.addfile(info, io.BytesIO(data))
+        path = tunnel_mod.download_cloudflared(
+            fetcher=lambda url: buf.getvalue())
+        from pathlib import Path
+
+        assert Path(path).read_bytes() == b"mach-o-binary"
+
+    def test_pinned_version_with_latest_fallback(self, monkeypatch, tmp_path):
+        self._no_binary(monkeypatch, tmp_path)
+        monkeypatch.delenv("CDT_CLOUDFLARED_VERSION", raising=False)
+        urls = []
+
+        def fetch(url):
+            urls.append(url)
+            if "latest" not in url:
+                raise OSError("404")       # pinned tag aged out
+            return b"bin"
+
+        tunnel_mod.download_cloudflared(fetcher=fetch)
+        assert tunnel_mod.PINNED_VERSION in urls[0]
+        assert "latest" in urls[1]
+
+    def test_version_env_override(self, monkeypatch, tmp_path):
+        self._no_binary(monkeypatch, tmp_path)
+        monkeypatch.setenv("CDT_CLOUDFLARED_VERSION", "2099.1.0")
+        urls = []
+
+        def fetch(url):
+            urls.append(url)
+            return b"bin"
+
+        tunnel_mod.download_cloudflared(fetcher=fetch)
+        assert "2099.1.0" in urls[0] and len(urls) == 1
+
+    def test_tgz_without_member_raises_diagnostic(self, monkeypatch, tmp_path):
+        import io
+        import tarfile
+
+        self._no_binary(monkeypatch, tmp_path)
+        monkeypatch.setattr(tunnel_mod, "_platform_asset",
+                            lambda: "cloudflared-darwin-amd64.tgz")
+        buf = io.BytesIO()
+        with tarfile.open(fileobj=buf, mode="w:gz") as tar:
+            info = tarfile.TarInfo("something-else")
+            info.size = 0
+            tar.addfile(info, io.BytesIO(b""))
+        with pytest.raises(TunnelError, match="missing from release tgz"):
+            tunnel_mod.download_cloudflared(fetcher=lambda url: buf.getvalue())
 
 
 class TestLifecycle:
