@@ -115,7 +115,24 @@ def register(router, controller) -> None:
         controller._mesh = None        # rebuild lazily with the new shape
         return web.json_response({"status": "ok"})
 
+    async def auto_populate(request):
+        """Device-census → worker rows on demand (the reference's
+        masterDetection auto-populate, ``web/masterDetection.js:36-100``,
+        as an explicit button instead of a first-launch side effect).
+        Re-runs even if the first-launch guard already fired: the button
+        IS the user's consent."""
+        from ..workers.detection import auto_populate_hosts
+
+        async with config_transaction(controller.config_path) as cfg:
+            before = {h.get("id") for h in cfg.get("hosts", [])}
+            auto_populate_hosts(cfg, force=True)
+            added = [h for h in cfg.get("hosts", [])
+                     if h.get("id") not in before]
+        return web.json_response({"status": "ok", "added": added,
+                                  "total_hosts": len(before) + len(added)})
+
     router.add_get("/distributed/config", get_config)
+    router.add_post("/distributed/config/auto_populate", auto_populate)
     router.add_post("/distributed/config/update_worker", update_worker)
     router.add_post("/distributed/config/delete_worker", delete_worker)
     router.add_post("/distributed/config/update_setting", update_setting)

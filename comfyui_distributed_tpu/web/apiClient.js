@@ -80,6 +80,7 @@ export const api = {
   deleteWorker: (workerId) => request("/distributed/config/delete_worker", { method: "POST", body: { id: workerId } }),
   updateSetting: (key, value) => request("/distributed/config/update_setting", { method: "POST", body: { key, value } }),
   updateMaster: (fields) => request("/distributed/config/update_master", { method: "POST", body: fields }),
+  autoPopulate: () => request("/distributed/config/auto_populate", { method: "POST", body: {}, retries: 0 }),
 
   // queue
   queue: (prompt, opts = {}) => request("/distributed/queue", {

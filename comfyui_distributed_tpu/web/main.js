@@ -3,6 +3,7 @@
 // tunnelManager.js — SURVEY §2.7), dependency-free.
 
 import { api, probeHost, normalizeAddress, getAuthToken, setAuthToken } from "/web/apiClient.js";
+import { clampDivideBy, dividerNodes, inactiveLinks, describeAddedHosts, MAX_DIVIDE } from "/web/widgets.js";
 
 const POLL_MS = 3000;
 const LOG_REFRESH_MS = 2000;
@@ -367,8 +368,55 @@ function renderNodeWidgets() {
     ? Object.entries(prompt).filter(
         ([, n]) => n && n.class_type === "DistributedValue")
     : [];
-  if (!dvNodes.length || !hosts.length) { root.hidden = true; return; }
+  const divNodes = dividerNodes(prompt);
+  if ((!dvNodes.length || !hosts.length) && !divNodes.length) {
+    root.hidden = true;
+    return;
+  }
   root.hidden = false;
+
+  // divider dynamic outputs (parity: web/image_batch_divider.js:10-62 —
+  // there the node canvas grows/shrinks outputs; here the widget sets
+  // divide_by and flags links into chunks the new count deactivates)
+  for (const [nodeId, node] of divNodes) {
+    const inputs = node.inputs || {};
+    const box = document.createElement("div");
+    box.className = "dv-node";
+    const title = document.createElement("div");
+    title.className = "meta";
+    title.textContent = `${node.class_type} #${nodeId}`;
+    const grid = document.createElement("div");
+    grid.className = "kv";
+    const kd = document.createElement("div");
+    kd.className = "k";
+    kd.textContent = "divide_by (active outputs)";
+    const input = document.createElement("input");
+    input.type = "number";
+    input.min = "1";
+    input.max = String(MAX_DIVIDE);
+    input.value = clampDivideBy(inputs.divide_by ?? 2);
+    const warn = document.createElement("div");
+    warn.className = "meta";
+    const refreshWarn = (val) => {
+      const stale = inactiveLinks(parsePrompt(), nodeId, val);
+      warn.textContent = stale.length
+        ? `⚠ ${stale.map((s) => `#${s.consumerId}.${s.inputName} uses ` +
+            `output ${s.outputIndex}`).join("; ")} — beyond divide_by, ` +
+          "will receive an empty batch"
+        : "";
+    };
+    input.onchange = () => {
+      const val = clampDivideBy(input.value);
+      input.value = val;
+      writePromptInput(nodeId, "divide_by", val);
+      refreshWarn(val);
+    };
+    refreshWarn(clampDivideBy(inputs.divide_by ?? 2));
+    grid.append(kd, input);
+    box.append(title, grid, warn);
+    root.appendChild(box);
+  }
+  if (!dvNodes.length || !hosts.length) return;
   for (const [nodeId, node] of dvNodes) {
     const inputs = node.inputs || {};
     let mapping = {};
@@ -552,6 +600,16 @@ async function init() {
     widgetDebounce = setTimeout(renderNodeWidgets, 400);
   });
   $("btn-add-worker").onclick = () => openEditor(null);
+  $("btn-auto-populate").onclick = async () => {
+    // device census → worker rows (reference masterDetection.js:36-100)
+    try {
+      const res = await api.autoPopulate();
+      alert(res.added && res.added.length
+        ? `Added: ${describeAddedHosts(res)}`
+        : "No new slice hosts found (census advertises none beyond this host)");
+      await refreshConfig();
+    } catch (e) { alertError(e); }
+  };
   $("editor-cancel").onclick = () => { $("editor-backdrop").hidden = true; };
   $("editor-form").onsubmit = saveEditor;
   $("log-close").onclick = closeLog;

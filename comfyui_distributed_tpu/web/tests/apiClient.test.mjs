@@ -1,0 +1,37 @@
+// node:test suite for apiClient's pure helpers (URL normalization and
+// the auth-token storage contract). fetch-dependent request() paths are
+// covered by the Python route tests (tests/test_api.py, tests/test_web.py).
+import assert from "node:assert/strict";
+import { test } from "node:test";
+
+// localStorage shim: apiClient reads it lazily inside functions
+const store = new Map();
+globalThis.localStorage = {
+  getItem: (k) => (store.has(k) ? store.get(k) : null),
+  setItem: (k, v) => store.set(k, String(v)),
+  removeItem: (k) => store.delete(k),
+};
+
+const { normalizeAddress, getAuthToken, setAuthToken } =
+  await import("../apiClient.js");
+
+test("normalizeAddress schemes and cloud-https heuristics", () => {
+  assert.equal(normalizeAddress("10.0.0.2:8288"), "http://10.0.0.2:8288");
+  assert.equal(normalizeAddress("http://h:1/"), "http://h:1");
+  assert.equal(normalizeAddress(""), "");
+  assert.equal(
+    normalizeAddress("foo.trycloudflare.com"),
+    "https://foo.trycloudflare.com");
+  // http:// on a cloud domain upgrades to https
+  assert.equal(
+    normalizeAddress("http://x.ngrok-free.app"),
+    "https://x.ngrok-free.app");
+});
+
+test("auth token storage round-trip", () => {
+  assert.equal(getAuthToken(), "");
+  setAuthToken("tok-1");
+  assert.equal(getAuthToken(), "tok-1");
+  setAuthToken("");
+  assert.equal(getAuthToken(), "");
+});

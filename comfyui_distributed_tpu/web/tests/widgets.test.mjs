@@ -1,0 +1,62 @@
+// node:test suite for the DOM-free widget helpers (run via
+// scripts/test-web.sh → `node --test`; no build system, reference
+// parity with web/tests/*.test.js under vitest).
+import assert from "node:assert/strict";
+import { test } from "node:test";
+
+import {
+  clampDivideBy,
+  describeAddedHosts,
+  dividerNodes,
+  inactiveLinks,
+  MAX_DIVIDE,
+} from "../widgets.js";
+
+test("clampDivideBy bounds and coerces", () => {
+  assert.equal(clampDivideBy(3), 3);
+  assert.equal(clampDivideBy("7"), 7);
+  assert.equal(clampDivideBy(0), 1);
+  assert.equal(clampDivideBy(-5), 1);
+  assert.equal(clampDivideBy(99), MAX_DIVIDE);
+  assert.equal(clampDivideBy("junk"), 1);
+  assert.equal(clampDivideBy(2.9), 2);
+});
+
+const PROMPT = {
+  1: { class_type: "LoadImage", inputs: { image: "a.png" } },
+  2: { class_type: "ImageBatchDivider",
+       inputs: { images: ["1", 0], divide_by: 2 } },
+  3: { class_type: "SaveImage", inputs: { images: ["2", 0] } },
+  4: { class_type: "SaveImage", inputs: { images: ["2", 3] } },
+  5: { class_type: "AudioBatchDivider",
+       inputs: { audio: ["9", 0], divide_by: 4 } },
+};
+
+test("dividerNodes finds both divider classes only", () => {
+  const ids = dividerNodes(PROMPT).map(([id]) => id);
+  assert.deepEqual(ids, ["2", "5"]);
+  assert.deepEqual(dividerNodes(null), []);
+  assert.deepEqual(dividerNodes("not-an-object"), []);
+});
+
+test("inactiveLinks flags consumers past divide_by", () => {
+  const stale = inactiveLinks(PROMPT, "2", 2);
+  assert.deepEqual(stale, [
+    { consumerId: "4", inputName: "images", outputIndex: 3 },
+  ]);
+  // raising divide_by past the referenced output clears the warning
+  assert.deepEqual(inactiveLinks(PROMPT, "2", 4), []);
+  // numeric/string node-id mismatches still match
+  assert.equal(inactiveLinks(PROMPT, 2, 2).length, 1);
+});
+
+test("describeAddedHosts formats rows", () => {
+  assert.equal(
+    describeAddedHosts({ added: [
+      { id: "host1", address: "tpu-b:8288" },
+      { id: "host2", address: "tpu-c:8288" },
+    ] }),
+    "host1 → tpu-b:8288, host2 → tpu-c:8288");
+  assert.equal(describeAddedHosts({}), "");
+  assert.equal(describeAddedHosts(null), "");
+});

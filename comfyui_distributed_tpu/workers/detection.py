@@ -88,7 +88,8 @@ async def classify_host(host: dict) -> str:
     return "local" if await is_local_host(host) else "remote"
 
 
-def auto_populate_hosts(config: dict, base_port: Optional[int] = None) -> bool:
+def auto_populate_hosts(config: dict, base_port: Optional[int] = None,
+                        force: bool = False) -> bool:
     """First-launch auto-configuration (reference auto-creates one worker
     per non-master CUDA device at ports 8189+, ``web/masterDetection.js:36-100``
     guarded by ``has_auto_populated_workers``).
@@ -97,10 +98,11 @@ def auto_populate_hosts(config: dict, base_port: Optional[int] = None) -> bool:
     single controller, so nothing is populated for a single multi-chip host.
     Only when the TPU runtime advertises *other hosts* in the slice
     (``TPU_WORKER_HOSTNAMES``) does each get a controller entry. Returns
-    True when the config was modified.
+    True when the config was modified. ``force=True`` bypasses the
+    first-launch guard (the dashboard button is explicit user consent).
     """
     settings = config.setdefault("settings", {})
-    if settings.get("has_auto_populated_workers"):
+    if settings.get("has_auto_populated_workers") and not force:
         return False
     settings["has_auto_populated_workers"] = True
 

@@ -1,0 +1,12 @@
+#!/bin/sh
+# JS test runner (reference parity: scripts/test-web.sh → vitest; here
+# node's built-in test runner — zero dependencies, no build system).
+# Usage: scripts/test-web.sh
+set -e
+cd "$(dirname "$0")/.."
+if ! command -v node >/dev/null 2>&1; then
+    echo "node not found — JS tests skipped (the Python suite's" \
+         "tests/test_web.py contract checks still guard the web layer)"
+    exit 0
+fi
+exec node --test comfyui_distributed_tpu/web/tests/

@@ -176,3 +176,83 @@ class TestInterruptExecution:
                 assert q.history[pid]["status"] == "interrupted"
             await q.stop()
         run(body())
+
+
+class TestWidgetsModule:
+    """The DOM-free widget helpers (web/widgets.js) + their node:test
+    suite (web/tests/*.test.mjs, run by scripts/test-web.sh where node
+    exists); statically contract-checked here since this environment has
+    no JS runtime."""
+
+    WEB = Path(__file__).resolve().parent.parent / "comfyui_distributed_tpu" / "web"
+
+    def test_widgets_exports_match_consumers(self):
+        import re
+
+        widgets = (self.WEB / "widgets.js").read_text()
+        exported = set(re.findall(
+            r"^export (?:function|const) (\w+)", widgets, re.M))
+        main = (self.WEB / "main.js").read_text()
+        m = re.search(r'import \{([^}]*)\} from "/web/widgets.js"', main)
+        assert m, "main.js must import the widget helpers"
+        used_main = {s.strip() for s in m.group(1).split(",") if s.strip()}
+        assert used_main <= exported, used_main - exported
+        test_src = (self.WEB / "tests" / "widgets.test.mjs").read_text()
+        m = re.search(r"import \{([^}]*)\} from \"\.\./widgets.js\"",
+                      test_src, re.S)
+        assert m, "widgets.test.mjs must import from ../widgets.js"
+        used_test = {s.strip() for s in m.group(1).split(",") if s.strip()}
+        assert used_test <= exported, used_test - exported
+
+    def test_divider_widget_wired(self):
+        main = (self.WEB / "main.js").read_text()
+        assert "dividerNodes" in main
+        assert '"divide_by"' in main
+
+    def test_runner_script_executable(self):
+        import os
+
+        script = (self.WEB.parent.parent / "scripts" / "test-web.sh")
+        assert script.is_file()
+        assert os.access(script, os.X_OK)
+        assert "node --test" in script.read_text()
+
+    def test_auto_populate_route_and_button(self, tmp_config, monkeypatch):
+        monkeypatch.delenv("TPU_WORKER_HOSTNAMES", raising=False)
+        monkeypatch.delenv("TPU_WORKER_ID", raising=False)
+        html = (self.WEB / "index.html").read_text()
+        assert 'id="btn-auto-populate"' in html
+
+        async def body():
+            controller = Controller()
+            app = create_app(controller)
+            client = TestClient(TestServer(app))
+            async with client:
+                resp = await client.post(
+                    "/distributed/config/auto_populate", json={})
+                assert resp.status == 200
+                data = await resp.json()
+                # single-host census (no TPU_WORKER_HOSTNAMES): nothing
+                # added, but the call succeeds and reports totals
+                assert data["status"] == "ok"
+                assert data["added"] == []
+        run(body())
+
+    def test_auto_populate_adds_census_hosts(self, tmp_config, monkeypatch):
+        monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "tpu-a,tpu-b,tpu-c")
+        monkeypatch.setenv("TPU_WORKER_ID", "0")
+
+        async def body():
+            controller = Controller()
+            app = create_app(controller)
+            client = TestClient(TestServer(app))
+            async with client:
+                resp = await client.post(
+                    "/distributed/config/auto_populate", json={})
+                data = await resp.json()
+                assert [h["id"] for h in data["added"]] == ["host1", "host2"]
+                # idempotent: a second press adds nothing new
+                resp = await client.post(
+                    "/distributed/config/auto_populate", json={})
+                assert (await resp.json())["added"] == []
+        run(body())
