@@ -362,10 +362,11 @@ def run_usdu_benchmark(steps: int, runs: int | None, force_cpu: bool) -> dict:
 
 def run_flux_benchmark(steps: int, runs: int | None, force_cpu: bool) -> dict:
     """BASELINE row 3: FLUX-class flow txt2img 1024². Full FLUX.1 is 12B
-    params (24 GB bf16) — more than one v5e chip's 16 GB HBM; on the pod it
-    runs dp×tp (``FlowPipeline.generate_tp_fn``, dry-run validated). The
-    single tunneled chip therefore measures the FLUX *architecture* at
-    half depth (≈6B, bf16-resident) and says so in the metric name."""
+    params (24 GB bf16) — more than one v5e chip's 16 GB HBM. Default on
+    accelerators: FULL depth with host-offloaded block streaming
+    (``diffusion/offload.py``; CDT_OFFLOAD_RESIDENT_GB caps HBM
+    residency). CDT_OFFLOAD=0 falls back to the bf16-resident half-depth
+    surrogate; pods run dp×tp (``generate_tp_fn``, dry-run validated)."""
     import jax
     import jax.numpy as jnp
 
@@ -380,6 +381,12 @@ def run_flux_benchmark(steps: int, runs: int | None, force_cpu: bool) -> dict:
     from comfyui_distributed_tpu.models.dit import DiTConfig, init_dit
     from comfyui_distributed_tpu.models.vae import AutoencoderKL, VAEConfig
     from comfyui_distributed_tpu.parallel import build_mesh
+
+    if on_accel:
+        from comfyui_distributed_tpu.diffusion.offload import offload_enabled
+
+        if offload_enabled(default=True):   # full depth needs streaming
+            return _run_flux_offloaded(steps, runs, platform)
 
     half_depth = False
     if on_accel:
@@ -436,8 +443,102 @@ def run_flux_benchmark(steps: int, runs: int | None, force_cpu: bool) -> dict:
     if half_depth:
         out["note"] = ("full FLUX.1 (12B) exceeds one v5e chip's HBM; "
                        "pod runs use dp×tp (generate_tp_fn). This measures "
-                       "the architecture at depth 10/19, bf16-resident.")
+                       "the architecture at depth 10/19, bf16-resident "
+                       "(CDT_OFFLOAD=0 fallback — the default flux metric "
+                       "is full depth via host offload).")
     return out
+
+
+def _run_flux_offloaded(steps: int, runs: int | None, platform: str) -> dict:
+    """FULL-depth FLUX.1 (19/38, 12B params) on ONE chip: host-pinned
+    bf16 weights, per-block streaming with double-buffered prefetch
+    (VERDICT r3 item #2 — replaces the half-depth surrogate). Also
+    measures the raw host→device bandwidth so the transport share of the
+    step time is explicit (through a tunneled chip the stream dominates;
+    on a real v5e host DMA it approaches compute-bound)."""
+    import jax
+    import jax.numpy as jnp
+
+    from comfyui_distributed_tpu.diffusion.offload import (
+        materialize_host_params, resident_budget_bytes, tree_bytes)
+    from comfyui_distributed_tpu.diffusion.pipeline_flow import (
+        FlowPipeline, FlowSpec)
+    from comfyui_distributed_tpu.models.dit import DiTConfig, init_dit
+    from comfyui_distributed_tpu.models.vae import AutoencoderKL, VAEConfig
+
+    cfg = DiTConfig.flux()            # FULL depth: 19 double / 38 single
+    lat_hw, ctx_len = (128, 128), 512
+    print("[bench] flux-offload: materializing 12B host params",
+          file=sys.stderr, flush=True)
+    model, abstract = init_dit(cfg, jax.random.key(0), sample_hw=lat_hw,
+                               context_len=ctx_len, abstract=True,
+                               param_dtype=jnp.bfloat16)
+    params = materialize_host_params(abstract, seed=0)
+    param_bytes = tree_bytes(params)
+
+    # raw transport measurement: one streamed block, warm
+    dev = jax.devices()[0]
+    import numpy as np
+    probe = np.ones((64, 1024, 1024), np.float32)      # 256 MB
+    jax.device_put(probe, dev).block_until_ready()
+    t0 = time.perf_counter()
+    jax.device_put(probe, dev).block_until_ready()
+    h2d_gbps = 0.25 / (time.perf_counter() - t0)
+
+    print("[bench] flux-offload: building pipeline", file=sys.stderr,
+          flush=True)
+    vae_cfg = VAEConfig(latent_channels=16, scaling_factor=0.3611,
+                        shift_factor=0.1159)
+    vae = AutoencoderKL(vae_cfg).init(
+        jax.random.key(1), image_hw=(1024, 1024))
+    # the PRODUCT path end-to-end: generate_offloaded builds + caches the
+    # streamed executor, so the bench measures exactly what users run
+    pipe = FlowPipeline(model, params, vae)
+    spec = FlowSpec(height=1024, width=1024, steps=steps)
+    ctx = jnp.zeros((1, ctx_len, cfg.context_dim))
+    pooled = jnp.zeros((1, cfg.pooled_dim))
+
+    def one_image(seed):
+        jax.block_until_ready(pipe.generate_offloaded(
+            spec, seed, ctx, pooled,
+            resident_bytes=resident_budget_bytes()))
+
+    print("[bench] flux-offload: warmup image (compiles + first stream)",
+          file=sys.stderr, flush=True)
+    t0 = time.perf_counter()
+    one_image(0)
+    compile_s = time.perf_counter() - t0
+
+    runs = runs or 2                  # streamed steps are slow; 2 is honest
+    print(f"[bench] flux-offload: {runs} timed runs", file=sys.stderr,
+          flush=True)
+    times, median = _timed_runs(lambda i: one_image(i + 1), runs)
+    off = pipe._fn_cache[("offload", resident_budget_bytes(), id(params))]
+    streamed = tree_bytes(off.streamed) if off.streamed else 0
+    return {
+        "metric": f"flux_full_depth_offload_1024_{steps}step_images_per_sec",
+        "value": round(1.0 / median, 5),
+        "unit": "images/sec",
+        "vs_baseline": 1.0,
+        "vs_baseline_note": "reference publishes no numbers",
+        "platform": platform,
+        "device_kind": dev.device_kind,
+        "devices": 1, "steps": steps,
+        "median_image_latency_s": round(median, 2),
+        "per_step_s": round(median / steps, 2),
+        "compile_s": round(compile_s, 1),
+        "run_times_s": [round(t, 2) for t in times],
+        "param_bytes": param_bytes,
+        "resident_bytes": off.resident_bytes,
+        "streamed_bytes_per_step": streamed,
+        "host_to_device_gbps": round(h2d_gbps, 2),
+        "note": ("FULL FLUX.1 depth (19/38, ~12B bf16 params) on one "
+                 "chip via host offload — the streamed share of each "
+                 "step moves streamed_bytes_per_step over the measured "
+                 "host_to_device_gbps link (tunneled here; real v5e "
+                 "host DMA is ~10-40x faster and pods run dp×tp "
+                 "instead)."),
+    }
 
 
 def run_wan_benchmark(steps: int, runs: int | None, force_cpu: bool) -> dict:

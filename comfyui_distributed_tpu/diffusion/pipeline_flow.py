@@ -158,6 +158,49 @@ class FlowPipeline:
             args.append(jnp.asarray(progress_token, jnp.int32))
         return fn(*args)
 
+    # --- mode 1c: host offload (model too large for one chip, no pod) ------
+
+    def generate_offloaded(self, spec: FlowSpec, seed: int,
+                           context: jax.Array, pooled: jax.Array,
+                           params=None,
+                           resident_bytes: Optional[int] = None) -> jax.Array:
+        """ONE image on ONE device with blocks streamed from host memory
+        (``diffusion/offload.py``) — the single-chip answer to FLUX-12B's
+        24 GB of bf16 weights (CDT_OFFLOAD; dp×tp over a pod is the fast
+        path when more chips exist). ``params`` may be a host-numpy tree
+        (the usual case: a full-size random init cannot fit on device)."""
+        from .offload import OffloadedFlux, sample_euler_py
+        from .pipeline import cached_build
+
+        if spec.sampler != "euler":
+            raise ValueError(
+                "offloaded sampling currently supports the euler ladder "
+                f"(got {spec.sampler!r})")
+        if spec.per_device_batch != 1 or context.shape[0] != 1:
+            raise ValueError(
+                "offloaded generation is single-image (batch 1): the "
+                "streamed weight window serves one latent at a time")
+        # the executor (resident upload + four compiled programs) is
+        # expensive — cache it across calls like every other mode
+        src = self.dit_params if params is None else params
+        off = cached_build(
+            self, ("offload", resident_bytes, id(src)),
+            lambda: OffloadedFlux(self.dit, src,
+                                  resident_bytes=resident_bytes),
+            self._CACHE_MAX)
+        sigmas = sigmas_flow(spec.steps, spec.shift)
+        ds = self.vae.config.downscale
+        lat_h, lat_w = spec.height // ds, spec.width // ds
+        # same key derivation as dp shard 0, so offloaded == sharded run
+        key = jax.random.fold_in(jax.random.key(seed), 0)
+        x = jax.random.normal(
+            key, (1, lat_h, lat_w, self.dit.config.in_channels),
+            jnp.float32)
+        den = off.denoiser(context, pooled, spec.guidance)
+        x0 = sample_euler_py(den, jax.device_put(x, off.device), sigmas)
+        images = self.vae.decode(x0)
+        return jnp.clip(images / 2.0 + 0.5, 0.0, 1.0)
+
     # --- mode 1b: dp×tp GSPMD (models too large for one chip) --------------
 
     def generate_tp_fn(self, mesh: Mesh, spec: FlowSpec,

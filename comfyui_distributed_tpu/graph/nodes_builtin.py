@@ -1068,7 +1068,15 @@ class TPUFlowTxt2Img(NodeDef):
         pooled = positive.get("pooled")
         if pooled is None:
             pooled = jnp.zeros((1, model.pipeline.dit.config.pooled_dim))
-        if mode == "sp":
+        from ..diffusion.offload import offload_enabled
+
+        if mode == "offload" or (mode == "dp" and offload_enabled()):
+            # CDT_OFFLOAD=1 (or mode="offload"): full-size single-chip
+            # execution with host-streamed blocks — how FLUX-12B runs
+            # without a pod (docs/deployment.md §5)
+            images = model.pipeline.generate_offloaded(
+                spec, int(seed), ctx, pooled)
+        elif mode == "sp":
             from jax.sharding import Mesh
 
             axes = dict(mesh.shape)

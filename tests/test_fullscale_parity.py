@@ -1,0 +1,184 @@
+"""FULL-SIZE weight-conversion parity vs the torch LDM replica.
+
+VERDICT r3 item #1, zero-egress fallback: no published checkpoint can be
+downloaded here, so the strongest available proof that "a real
+checkpoint would load and sample correctly" is a differential test at
+the REAL architecture size — the full SD1.5 UNet (~860M params) and VAE
+decoder, fp32, converted through the exact converter path a published
+``.safetensors`` file takes (torch replica state_dict → LDM key names →
+``convert_unet``/``convert_vae``), then:
+
+- one full forward compared against torch (bit-level layout errors in
+  ANY of the 686 converted tensors would blow the tolerance), and
+- a full 30-step euler trajectory with bounded drift at every step —
+  sampler-loop accumulation is where small conversion errors compound
+  into garbage images.
+
+The tiny-shape differentials (``test_convert.py``) pin the layout walk;
+this file pins it at scale, where head counts, channel widths, and
+depth match the published model exactly. Runtime is minutes (torch on
+one CPU core) — slow-marked, part of the nightly full suite.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+torch = pytest.importorskip("torch")
+
+from comfyui_distributed_tpu.models.convert import convert_unet, convert_vae
+from comfyui_distributed_tpu.models.unet import UNetConfig, init_unet
+from comfyui_distributed_tpu.models.vae import AutoencoderKL, VAEConfig
+
+pytestmark = pytest.mark.slow  # full-size models: minutes, nightly tier
+
+# the torch LDM replicas live beside the tiny differentials
+from test_convert import TUNet, TVAEDecoder, _nchw  # noqa: E402
+
+LAT = 32          # latent 32² = 256² pixels: full channel/depth, small space
+
+
+@pytest.fixture(scope="module")
+def sd15_full():
+    """Full SD1.5 UNet pair: torch replica ↔ converted JAX params."""
+    cfg = dataclasses.replace(UNetConfig.sd15(), dtype="float32")
+    torch.manual_seed(0)
+    tmodel = TUNet(cfg, ctx_dim=cfg.context_dim).eval()
+    n_params = sum(p.numel() for p in tmodel.parameters())
+    assert n_params > 800e6, f"not full-size: {n_params/1e6:.0f}M params"
+    sd = {f"model.diffusion_model.{k}": v.numpy()
+          for k, v in tmodel.state_dict().items()}
+    model, params = init_unet(cfg, jax.random.key(0),
+                              sample_shape=(LAT, LAT, cfg.in_channels),
+                              context_len=77)
+    params = convert_unet(sd, params, cfg)
+    return cfg, tmodel, model, params
+
+
+class TestFullSizeSD15:
+    def test_forward_parity(self, sd15_full):
+        """One fp32 forward at full architecture size. Every converted
+        tensor participates; a transposed kernel or swapped block lands
+        far outside the tolerance."""
+        cfg, tmodel, model, params = sd15_full
+        rng = np.random.RandomState(1)
+        x = rng.randn(1, LAT, LAT, cfg.in_channels).astype(np.float32)
+        t = np.array([500.0], np.float32)
+        ctx = rng.randn(1, 77, cfg.context_dim).astype(np.float32)
+        with torch.no_grad():
+            ref = tmodel(_nchw(x), torch.from_numpy(t),
+                         torch.from_numpy(ctx)).numpy()
+        out = np.asarray(model.apply(params, jnp.asarray(x),
+                                     jnp.asarray(t), jnp.asarray(ctx), None))
+        ref = ref.transpose(0, 2, 3, 1)
+        # fp32 through ~700 kernels: elementwise fp reassociation only
+        np.testing.assert_allclose(out, ref, atol=5e-3, rtol=5e-3)
+        # aggregate drift must be far tighter than the elementwise bound
+        denom = float(np.abs(ref).mean()) or 1.0
+        assert float(np.abs(out - ref).mean()) / denom < 1e-3
+
+    def test_30_step_trajectory_drift_bounded(self, sd15_full):
+        """Full 30-step euler ladder, fp32, identical noise: the JAX
+        trajectory must track the torch trajectory at EVERY step. This is
+        where conversion errors compound — a 1% per-step bias becomes a
+        different image by step 30."""
+        from comfyui_distributed_tpu.diffusion.schedules import (
+            sigmas_karras, vp_schedule)
+
+        cfg, tmodel, model, params = sd15_full
+        sched = vp_schedule()
+        sigmas = np.asarray(sigmas_karras(30, 0.03, 14.6), np.float64)
+        rng = np.random.RandomState(7)
+        ctx = rng.randn(1, 77, cfg.context_dim).astype(np.float32)
+        x_j = (rng.randn(1, LAT, LAT, cfg.in_channels)
+               .astype(np.float32) * sigmas[0])
+        x_t = x_j.copy()
+
+        jfwd = jax.jit(lambda xx, tt: model.apply(
+            params, xx, tt, jnp.asarray(ctx), None))
+
+        def denoised(fwd_eps, x, sigma):
+            # eps-prediction → x0 (VP schedule), same math both sides
+            tstep = float(np.asarray(
+                sched.timestep_for_sigma(jnp.asarray([sigma]))))
+            scale = 1.0 / np.sqrt(sigma ** 2 + 1.0)
+            eps = fwd_eps((x * scale).astype(np.float32),
+                          np.array([tstep], np.float32))
+            return x - sigma * np.asarray(eps, np.float64)
+
+        def tfwd(x, t):
+            with torch.no_grad():
+                return tmodel(_nchw(x), torch.from_numpy(t),
+                              torch.from_numpy(ctx)
+                              ).numpy().transpose(0, 2, 3, 1)
+
+        max_rel = 0.0
+        for i in range(len(sigmas) - 1):
+            d_j = denoised(lambda xx, tt: jfwd(jnp.asarray(xx),
+                                               jnp.asarray(tt)),
+                           x_j, sigmas[i])
+            d_t = denoised(tfwd, x_t, sigmas[i])
+            if sigmas[i + 1] == 0.0:
+                x_j, x_t = d_j, d_t
+            else:
+                x_j = x_j + (x_j - d_j) / sigmas[i] * (sigmas[i + 1] - sigmas[i])
+                x_t = x_t + (x_t - d_t) / sigmas[i] * (sigmas[i + 1] - sigmas[i])
+            rel = (float(np.abs(x_j - x_t).mean())
+                   / (float(np.abs(x_t).mean()) or 1.0))
+            max_rel = max(max_rel, rel)
+        # the two trajectories must stay locked through all 30 steps
+        assert max_rel < 2e-2, f"trajectory drift {max_rel:.4f}"
+        np.testing.assert_allclose(
+            x_j.astype(np.float32), x_t.astype(np.float32),
+            atol=0.05, rtol=0.05)
+
+
+class TestFullSizeVAE:
+    def test_decoder_parity_at_sd_scale(self):
+        """Full SD VAE decoder (512² output from 64² latents — the real
+        decode shape for 512² generation), fp32 differential."""
+        cfg = dataclasses.replace(VAEConfig(scaling_factor=0.18215),
+                                  dtype="float32")
+        torch.manual_seed(1)
+        tdec = TVAEDecoder(cfg).eval()
+        n_params = sum(p.numel() for p in tdec.parameters())
+        assert n_params > 45e6, f"not full-size: {n_params/1e6:.1f}M"
+        sd = {f"first_stage_model.decoder.{k}": v.numpy()
+              for k, v in tdec.state_dict().items()}
+        # post_quant_conv identity-ish random completes the layout
+        pq_w = np.random.RandomState(2).randn(
+            cfg.latent_channels, cfg.latent_channels, 1, 1
+        ).astype(np.float32) * 0.1
+        pq_b = np.zeros((cfg.latent_channels,), np.float32)
+        sd["first_stage_model.post_quant_conv.weight"] = pq_w
+        sd["first_stage_model.post_quant_conv.bias"] = pq_b
+        # encoder entries must exist for convert_vae's template walk
+        vae = AutoencoderKL(cfg).init(jax.random.key(0), image_hw=(64, 64))
+        import torch.nn.functional as F  # noqa: F401
+
+        from test_convert import TVAEEncoder
+
+        tenc = TVAEEncoder(cfg).eval()
+        sd.update({f"first_stage_model.encoder.{k}": v.numpy()
+                   for k, v in tenc.state_dict().items()})
+        qc_w = np.random.RandomState(3).randn(
+            2 * cfg.latent_channels, 2 * cfg.latent_channels, 1, 1
+        ).astype(np.float32) * 0.1
+        sd["first_stage_model.quant_conv.weight"] = qc_w
+        sd["first_stage_model.quant_conv.bias"] = np.zeros(
+            (2 * cfg.latent_channels,), np.float32)
+        enc_p, dec_p = convert_vae(sd, vae.enc_params, vae.dec_params, cfg)
+        vae.enc_params, vae.dec_params = enc_p, dec_p
+
+        rng = np.random.RandomState(4)
+        z = rng.randn(1, 64, 64, cfg.latent_channels).astype(np.float32)
+        with torch.no_grad():
+            ref = tdec(torch.nn.functional.conv2d(
+                _nchw(z), torch.from_numpy(pq_w),
+                torch.from_numpy(pq_b))).numpy().transpose(0, 2, 3, 1)
+        out = np.asarray(vae.decoder.apply(vae.dec_params, jnp.asarray(z)))
+        np.testing.assert_allclose(out, ref, atol=5e-3, rtol=5e-3)

@@ -1,0 +1,211 @@
+"""Host-offloaded FLUX execution (diffusion/offload.py): block streaming
+must be numerically invisible — the offloaded forward equals DiT.apply,
+the python euler ladder equals the scan sampler, and the end-to-end
+offloaded generate equals the dp pipeline on one device."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from comfyui_distributed_tpu.diffusion.offload import (
+    OffloadedFlux,
+    materialize_host_params,
+    offload_enabled,
+    resident_budget_bytes,
+    sample_euler_py,
+    tree_bytes,
+)
+from comfyui_distributed_tpu.models.dit import DiTConfig, init_dit
+
+pytestmark = pytest.mark.slow  # compile-heavy: builds/jits real model stacks
+
+
+def _stack(pos_embed="rope"):
+    cfg = DiTConfig.tiny(pos_embed=pos_embed)
+    model, params = init_dit(cfg, jax.random.key(0), sample_hw=(8, 8),
+                             context_len=6)
+    x = jax.random.normal(jax.random.key(1), (2, 8, 8, cfg.in_channels))
+    t = jnp.array([0.7, 0.3])
+    ctx = jax.random.normal(jax.random.key(2), (2, 6, cfg.context_dim))
+    pooled = jax.random.normal(jax.random.key(3), (2, cfg.pooled_dim))
+    return cfg, model, params, x, t, ctx, pooled
+
+
+class TestForwardEquivalence:
+    @pytest.mark.parametrize("pos_embed", ["rope", "sincos"])
+    @pytest.mark.parametrize("resident_bytes", [0, 1 << 40])
+    def test_matches_monolithic_apply(self, pos_embed, resident_bytes):
+        """All-streamed (0) and all-resident (huge) partitions both equal
+        the single-program DiT forward."""
+        cfg, model, params, x, t, ctx, pooled = _stack(pos_embed)
+        g = jnp.array([3.5, 3.5]) if cfg.guidance_embed else None
+        want = np.asarray(model.apply(params, x, t, ctx, pooled, g))
+        off = OffloadedFlux(model, params, resident_bytes=resident_bytes)
+        got = np.asarray(off.forward(x, t, ctx, pooled, g))
+        np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
+
+    def test_partial_residency_matches(self):
+        """A budget that fits only SOME blocks: prefix resident, suffix
+        streamed, same numbers."""
+        cfg, model, params, x, t, ctx, pooled = _stack()
+        inner = params["params"]
+        one_block = tree_bytes(inner["double_0"])
+        glue = tree_bytes({k: v for k, v in inner.items()
+                           if not k.startswith(("double_", "single_"))})
+        off = OffloadedFlux(model, params,
+                            resident_bytes=glue + one_block * 2 + 64)
+        assert 0 < len(off.resident) < len(off.block_order)
+        assert set(off.resident) | set(off.streamed) == set(off.block_order)
+        g = jnp.array([3.5, 3.5])
+        want = np.asarray(model.apply(params, x, t, ctx, pooled, g))
+        np.testing.assert_allclose(
+            np.asarray(off.forward(x, t, ctx, pooled, g)), want,
+            rtol=2e-5, atol=2e-5)
+
+    def test_host_numpy_params_accepted(self):
+        """The real offload scenario: params arrive as host numpy (a
+        full-size init can't live on device)."""
+        cfg, model, params, x, t, ctx, pooled = _stack()
+        host = jax.tree_util.tree_map(np.asarray, params)
+        off = OffloadedFlux(model, host, resident_bytes=0)
+        g = jnp.array([3.5, 3.5])
+        want = np.asarray(model.apply(params, x, t, ctx, pooled, g))
+        np.testing.assert_allclose(
+            np.asarray(off.forward(x, t, ctx, pooled, g)), want,
+            rtol=2e-5, atol=2e-5)
+
+
+class TestEulerLadder:
+    def test_matches_scan_sampler(self):
+        from comfyui_distributed_tpu.diffusion import sample, sigmas_flow
+
+        sigmas = sigmas_flow(6, shift=1.0)
+        x = jax.random.normal(jax.random.key(0), (1, 4, 4, 2))
+        den = lambda xx, s: xx * 0.6
+        want = np.asarray(sample("euler", den, x, sigmas))
+        got = np.asarray(sample_euler_py(den, x, sigmas))
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+class TestGenerateOffloaded:
+    def test_equals_dp_generate_on_one_device(self):
+        from comfyui_distributed_tpu.diffusion.pipeline_flow import (
+            FlowPipeline, FlowSpec)
+        from comfyui_distributed_tpu.models.vae import (AutoencoderKL,
+                                                        VAEConfig)
+        from comfyui_distributed_tpu.parallel import build_mesh
+
+        cfg = DiTConfig.tiny(pos_embed="rope")
+        model, params = init_dit(cfg, jax.random.key(0), sample_hw=(8, 8),
+                                 context_len=6)
+        vae = AutoencoderKL(VAEConfig.tiny(dtype="float32")).init(
+            jax.random.key(1), image_hw=(16, 16))
+        pipe = FlowPipeline(model, params, vae)
+        ctx = jnp.ones((1, 6, cfg.context_dim)) * 0.1
+        pooled = jnp.ones((1, cfg.pooled_dim)) * 0.2
+        spec = FlowSpec(height=16, width=16, steps=3)
+        want = np.asarray(pipe.generate(build_mesh({"dp": 1}), spec, 5,
+                                        ctx, pooled))
+        got = np.asarray(pipe.generate_offloaded(spec, 5, ctx, pooled,
+                                                 resident_bytes=0))
+        np.testing.assert_allclose(got, want, rtol=5e-4, atol=5e-4)
+
+    def test_non_euler_raises(self):
+        from comfyui_distributed_tpu.diffusion.pipeline_flow import (
+            FlowPipeline, FlowSpec)
+        from comfyui_distributed_tpu.models.vae import (AutoencoderKL,
+                                                        VAEConfig)
+
+        cfg = DiTConfig.tiny()
+        model, params = init_dit(cfg, jax.random.key(0), sample_hw=(8, 8),
+                                 context_len=6)
+        vae = AutoencoderKL(VAEConfig.tiny()).init(jax.random.key(1),
+                                                   image_hw=(16, 16))
+        pipe = FlowPipeline(model, params, vae)
+        with pytest.raises(ValueError, match="euler"):
+            pipe.generate_offloaded(
+                FlowSpec(height=16, width=16, sampler="heun"), 0,
+                jnp.zeros((1, 6, cfg.context_dim)),
+                jnp.zeros((1, cfg.pooled_dim)))
+
+
+class TestPlumbing:
+    def test_materialize_host_params_shapes(self):
+        cfg = DiTConfig.tiny()
+        _, abstract = init_dit(cfg, jax.random.key(0), sample_hw=(8, 8),
+                               context_len=6, abstract=True)
+        host = materialize_host_params(abstract, seed=1)
+        a_leaves = jax.tree_util.tree_leaves(abstract)
+        h_leaves = jax.tree_util.tree_leaves(host)
+        assert all(h.shape == a.shape and h.dtype == a.dtype
+                   for h, a in zip(h_leaves, a_leaves))
+        assert all(isinstance(h, np.ndarray) for h in h_leaves)
+
+    def test_knobs(self, monkeypatch):
+        monkeypatch.delenv("CDT_OFFLOAD", raising=False)
+        assert not offload_enabled()
+        monkeypatch.setenv("CDT_OFFLOAD", "1")
+        assert offload_enabled()
+        monkeypatch.setenv("CDT_OFFLOAD_RESIDENT_GB", "2.5")
+        assert resident_budget_bytes() == int(2.5 * (1 << 30))
+
+
+class TestNodeAndCaching:
+    def test_executor_cached_across_calls(self):
+        """generate_offloaded must reuse the streamed executor (resident
+        upload + 4 compiled programs) — rebuilding per image costs
+        minutes at FLUX scale."""
+        from comfyui_distributed_tpu.diffusion.pipeline_flow import (
+            FlowPipeline, FlowSpec)
+        from comfyui_distributed_tpu.models.vae import (AutoencoderKL,
+                                                        VAEConfig)
+
+        cfg = DiTConfig.tiny(pos_embed="rope")
+        model, params = init_dit(cfg, jax.random.key(0), sample_hw=(8, 8),
+                                 context_len=6)
+        vae = AutoencoderKL(VAEConfig.tiny()).init(jax.random.key(1),
+                                                   image_hw=(16, 16))
+        pipe = FlowPipeline(model, params, vae)
+        ctx = jnp.zeros((1, 6, cfg.context_dim))
+        pooled = jnp.zeros((1, cfg.pooled_dim))
+        spec = FlowSpec(height=16, width=16, steps=2)
+        pipe.generate_offloaded(spec, 0, ctx, pooled, resident_bytes=0)
+        key = ("offload", 0, id(pipe.dit_params))
+        first = pipe._fn_cache[key]
+        pipe.generate_offloaded(spec, 1, ctx, pooled, resident_bytes=0)
+        assert pipe._fn_cache[key] is first
+
+    def test_batch_gt_one_raises(self):
+        from comfyui_distributed_tpu.diffusion.pipeline_flow import (
+            FlowPipeline, FlowSpec)
+        from comfyui_distributed_tpu.models.vae import (AutoencoderKL,
+                                                        VAEConfig)
+
+        cfg = DiTConfig.tiny()
+        model, params = init_dit(cfg, jax.random.key(0), sample_hw=(8, 8),
+                                 context_len=6)
+        vae = AutoencoderKL(VAEConfig.tiny()).init(jax.random.key(1),
+                                                   image_hw=(16, 16))
+        pipe = FlowPipeline(model, params, vae)
+        with pytest.raises(ValueError, match="batch 1"):
+            pipe.generate_offloaded(
+                FlowSpec(height=16, width=16, per_device_batch=2), 0,
+                jnp.zeros((1, 6, cfg.context_dim)),
+                jnp.zeros((1, cfg.pooled_dim)))
+
+    def test_node_offload_mode(self, tmp_config, monkeypatch):
+        """mode='offload' (or CDT_OFFLOAD=1 with dp) routes the flow node
+        through the streamed executor."""
+        from comfyui_distributed_tpu.graph.node import get_node
+        from comfyui_distributed_tpu.models.registry import (PRESETS,
+                                                             ModelBundle)
+
+        monkeypatch.delenv("CDT_OFFLOAD", raising=False)
+        bundle = ModelBundle(PRESETS["flux-tiny"])
+        node = get_node("TPUFlowTxt2Img")()
+        ctx, pooled = bundle.text_encoder.encode(["offload"])
+        (img,) = node.execute(bundle, {"context": ctx, "pooled": pooled},
+                              seed=1, steps=2, width=16, height=16,
+                              mode="offload")
+        assert np.asarray(img).shape == (1, 16, 16, 3)
