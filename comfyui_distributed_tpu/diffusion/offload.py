@@ -218,13 +218,22 @@ class OffloadedFlux:
             nxt = self._fetch(names[i + 1]) if i + 1 < len(names) else None
             if name.startswith("double"):
                 img, txt = self._dblock(cur, img, txt, vec, pe_img, pe_txt)
+                out = img
             else:
                 if xcat is None:
                     xcat = jnp.concatenate([txt, img], axis=1)
                 xcat = self._sblock(cur, xcat, vec, pe_full, T=T)
+                out = xcat
             if cur_streamed:
+                # BACKPRESSURE: without this barrier the python loop
+                # enqueues the entire ladder's transfers ahead of the
+                # device (30 steps × 24 GB of staged host buffers — a
+                # measured 130 GB host OOM). Blocking on the block output
+                # keeps at most cur (computing) + nxt (streaming) in
+                # flight while still overlapping transfer with compute.
+                jax.block_until_ready(out)
                 for leaf in jax.tree_util.tree_leaves(cur):
-                    leaf.delete()       # free HBM as soon as dispatched
+                    leaf.delete()       # free HBM as soon as consumed
             if nxt is not None:
                 cur, cur_streamed = nxt
         img = (xcat[:, T:] if xcat is not None else img)
