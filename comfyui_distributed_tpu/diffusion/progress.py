@@ -16,34 +16,76 @@ its runtime value. Callbacks are unordered; ``sigma`` (strictly decreasing
 over the ladder) is the ordering key the sink uses to keep the newest
 preview and a monotonic step count.
 
-This module is deliberately free of cluster/HTTP imports: the sink is
-injected (``set_sink``) by ``cluster/progress.ProgressTracker``.
+This module is deliberately free of cluster/HTTP imports: sinks are
+registered (``add_sink``) by ``cluster/progress.ProgressTracker``.
+
+Multiple sinks may be registered at once (an embedded master+worker pair,
+or two Controllers in one test process, each own a tracker): every event
+is fanned out to every sink, and routing falls out of token uniqueness —
+``next_token`` is a process-global allocator, so a tracker's job table
+simply misses on tokens it didn't issue.
 """
 
 from __future__ import annotations
 
+import itertools
+import threading
 from typing import Callable, Optional
 
 import jax
 import numpy as np
 
-# sink(token:int, shard:int, sigma:float, x0:np.ndarray) — installed by the
-# cluster-side tracker; None = progress events are dropped on the floor.
-_SINK: Optional[Callable] = None
+# sink(token:int, shard:int, sigma:float, x0:np.ndarray). Registry keyed by
+# handle so removal is exact; empty = events dropped on the floor.
+_LOCK = threading.Lock()
+_SINKS: "dict[int, Callable]" = {}
+_HANDLES = itertools.count(1)
+_TOKENS = itertools.count(1)
+
+
+def next_token() -> int:
+    """Process-globally unique progress token. One compiled program, one
+    callback route: uniqueness across *all* trackers is what lets every
+    sink receive every event and key only on its own jobs."""
+    with _LOCK:
+        return next(_TOKENS)
+
+
+def add_sink(fn: Callable) -> int:
+    """Register an event sink; returns a handle for ``remove_sink``."""
+    with _LOCK:
+        handle = next(_HANDLES)
+        _SINKS[handle] = fn
+        return handle
+
+
+def remove_sink(handle: int) -> None:
+    with _LOCK:
+        _SINKS.pop(handle, None)
 
 
 def set_sink(fn: Optional[Callable]) -> None:
-    global _SINK
-    _SINK = fn
+    """Legacy single-sink setter: clears the registry, then installs
+    ``fn`` (if not None) as the only sink. Kept for tests/embedders that
+    want exclusive capture."""
+    with _LOCK:
+        _SINKS.clear()
+        if fn is not None:
+            _SINKS[next(_HANDLES)] = fn
 
 
 def get_sink() -> Optional[Callable]:
-    return _SINK
+    """Any currently-registered sink (newest), or None. Legacy accessor."""
+    with _LOCK:
+        if not _SINKS:
+            return None
+        return _SINKS[max(_SINKS)]
 
 
 def _dispatch(token, shard, sigma, x0) -> None:
-    sink = _SINK
-    if sink is not None:
+    with _LOCK:
+        sinks = list(_SINKS.values())
+    for sink in sinks:
         try:
             sink(int(token), int(shard), float(sigma), np.asarray(x0))
         except Exception:  # a broken UI consumer must never kill a job
