@@ -187,17 +187,36 @@ class TileFarm:
         last_check = time.monotonic()
         log(f"tile-farm[{job_id}] master: {job.total_tasks} tasks "
             f"(chunk {chunk}, {total} tiles)")
+        # Optional grace window before the master competes for the queue:
+        # until a worker's first pull (or the window expires) the master
+        # only drains results. A warm master on a loaded host can otherwise
+        # drain every task before a cold worker's first pull — harmless in
+        # production (the job still completes) but it starves fault-
+        # injection tests that need the worker to HOLD an assignment
+        # (tests/test_integration.py). Default 0 = no behavior change.
+        import os as _os
+
+        holdback_s = float(
+            _os.environ.get("CDT_TILE_MASTER_HOLDBACK_S", "0") or 0)
+        # 0.0 = disabled (falsy); the release check below also resets it
+        holdback_until = time.monotonic() + holdback_s if holdback_s else 0.0
 
         while True:
             async with self.store.lock:
                 done = len(job.completed) >= job.total_tasks
+                if holdback_until and any(
+                        w != "master" for w in job.worker_status):
+                    holdback_until = 0.0    # a worker pulled; master joins
             if done:
                 break
             if deadline and time.monotonic() > deadline:
                 raise TileCollectionError(
                     f"tile job {job_id} timed out", job_id=job_id)
 
-            task = await self.store.request_work(job_id, "master")
+            if holdback_until and time.monotonic() < holdback_until:
+                task = None                 # leave the queue to workers
+            else:
+                task = await self.store.request_work(job_id, "master")
             if task is not None:
                 arr = await asyncio.to_thread(
                     process_fn, task["start"], task["end"])
