@@ -219,6 +219,25 @@ def register(router, controller) -> None:
                 {"error": f"workflow {name!r} is invalid JSON: {e}"},
                 status=500)
 
+    async def object_info(request):
+        """Node interface specs for the whole registry (the equivalent of
+        ComfyUI's ``/object_info``, which the reference's graph-editor
+        widgets read for free — here the dashboard's workflow parameter
+        forms are generated from this, ``web/forms.js``)."""
+        from ..graph.node import NODE_REGISTRY
+
+        out = {}
+        for name, cls in sorted(NODE_REGISTRY.items()):
+            out[name] = {
+                "required": dict(cls.INPUTS),
+                "optional": dict(cls.OPTIONAL),
+                "returns": list(cls.RETURNS),
+                "output_node": bool(cls.OUTPUT_NODE),
+                "category": cls.CATEGORY,
+            }
+        return web.json_response({"nodes": out})
+
+    router.add_get("/distributed/object_info", object_info)
     router.add_get("/distributed/workflows", list_workflows)
     router.add_get("/distributed/workflows/{name}", get_workflow)
     router.add_get("/distributed/system_info", system_info)

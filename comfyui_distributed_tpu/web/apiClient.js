@@ -102,6 +102,9 @@ export const api = {
   localWorkerStatus: () => request("/distributed/local-worker-status"),
   clearLaunching: (workerId) => request("/distributed/worker/clear_launching", { method: "POST", body: { worker_id: workerId } }),
 
+  // node interface specs (drives the workflow parameter forms)
+  objectInfo: () => request("/distributed/object_info"),
+
   // shipped workflows
   listWorkflows: () => request("/distributed/workflows"),
   getWorkflow: (name) => request(`/distributed/workflows/${encodeURIComponent(name)}`),

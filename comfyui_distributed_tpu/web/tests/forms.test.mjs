@@ -1,0 +1,168 @@
+// node:test suite for the workflow parameter-form logic (forms.js):
+// field discovery from object_info specs, coercion, write-through edits.
+import assert from "node:assert/strict";
+import { test } from "node:test";
+
+import {
+  applyFieldEdit,
+  coerceFieldValue,
+  editableFields,
+  fieldKind,
+  groupByNode,
+  isLink,
+  isMultiline,
+} from "../forms.js";
+
+const SPECS = {
+  nodes: {
+    TPUTxt2Img: {
+      required: { model_name: "STRING", positive: "STRING", seed: "INT",
+                  steps: "INT", cfg: "FLOAT", width: "INT", height: "INT" },
+      optional: { negative: "STRING", tiled_vae: "BOOLEAN" },
+      returns: ["IMAGE"],
+    },
+    SaveImage: {
+      required: { images: "IMAGE", filename_prefix: "STRING" },
+      optional: {},
+      returns: [],
+    },
+    DistributedValue: {
+      required: { default_value: "*" },
+      optional: { worker_values: "STRING", value_type: "STRING" },
+      returns: ["*"],
+    },
+    ImageBatchDivider: {
+      required: { images: "IMAGE", divide_by: "INT" },
+      optional: {},
+      returns: ["IMAGE"],
+    },
+  },
+};
+
+const PROMPT = {
+  1: { class_type: "TPUTxt2Img",
+       inputs: { model_name: "sd15", positive: "a cat", seed: 7,
+                 steps: 20, cfg: 7.5, width: 512, height: 512 } },
+  2: { class_type: "SaveImage",
+       inputs: { images: ["1", 0], filename_prefix: "out" } },
+};
+
+test("isLink recognizes graph edges only", () => {
+  assert.ok(isLink(["1", 0]));
+  assert.ok(!isLink([1, 0]));         // node id must be a string
+  assert.ok(!isLink(["1", 0.5]));
+  assert.ok(!isLink(["1", 0, 2]));
+  assert.ok(!isLink("1"));
+  assert.ok(!isLink(null));
+});
+
+test("fieldKind maps ComfyUI scalar types, rejects the rest", () => {
+  assert.equal(fieldKind("INT"), "int");
+  assert.equal(fieldKind("FLOAT"), "float");
+  assert.equal(fieldKind("STRING"), "string");
+  assert.equal(fieldKind("BOOLEAN"), "boolean");
+  assert.equal(fieldKind("IMAGE"), null);
+  assert.equal(fieldKind("*"), null);
+  assert.equal(fieldKind(undefined), null);
+});
+
+test("editableFields discovers scalars, skips links", () => {
+  const fields = editableFields(PROMPT, SPECS);
+  const names = fields.map((f) => `${f.nodeId}.${f.name}`);
+  assert.ok(names.includes("1.seed"));
+  assert.ok(names.includes("1.positive"));
+  assert.ok(names.includes("2.filename_prefix"));
+  assert.ok(!names.includes("2.images"));         // link
+  const seed = fields.find((f) => f.nodeId === "1" && f.name === "seed");
+  assert.equal(seed.kind, "int");
+  assert.equal(seed.value, 7);
+  assert.equal(seed.optional, false);
+});
+
+test("editableFields includes unset optional fields with null value", () => {
+  const fields = editableFields(PROMPT, SPECS);
+  const neg = fields.find((f) => f.nodeId === "1" && f.name === "negative");
+  assert.ok(neg);
+  assert.equal(neg.value, null);
+  assert.equal(neg.optional, true);
+});
+
+test("editableFields skips widgeted fields (worker_values, divide_by)", () => {
+  const prompt = {
+    5: { class_type: "DistributedValue",
+         inputs: { default_value: 1, worker_values: "{}", value_type: "INT" } },
+    6: { class_type: "ImageBatchDivider",
+         inputs: { images: ["1", 0], divide_by: 2 } },
+  };
+  const names = editableFields(prompt, SPECS).map((f) => f.name);
+  assert.ok(!names.includes("worker_values"));
+  assert.ok(!names.includes("divide_by"));
+  assert.ok(names.includes("value_type"));   // plain STRING, still editable
+});
+
+test("editableFields tolerates unknown classes and junk prompts", () => {
+  assert.deepEqual(editableFields(null, SPECS), []);
+  assert.deepEqual(editableFields({ 9: { class_type: "Nope", inputs: {} } },
+                                  SPECS), []);
+  assert.deepEqual(editableFields(PROMPT, null), []);
+});
+
+test("coerceFieldValue: int validates integrality", () => {
+  assert.equal(coerceFieldValue("int", "42"), 42);
+  assert.equal(coerceFieldValue("int", "-3"), -3);
+  assert.throws(() => coerceFieldValue("int", "1.5"), /not an integer/);
+  assert.throws(() => coerceFieldValue("int", "junk"), /not an integer/);
+});
+
+test("coerceFieldValue: cleared numeric fields reject (Number('')===0 trap)", () => {
+  // deleting the value in a steps/seed field must NOT write 0
+  assert.throws(() => coerceFieldValue("int", ""), /not an integer/);
+  assert.throws(() => coerceFieldValue("int", "   "), /not an integer/);
+  assert.throws(() => coerceFieldValue("float", ""), /not a number/);
+  assert.equal(coerceFieldValue("string", ""), "");   // strings may clear
+});
+
+test("coerceFieldValue: float and boolean and string", () => {
+  assert.equal(coerceFieldValue("float", "7.5"), 7.5);
+  assert.throws(() => coerceFieldValue("float", "abc"), /not a number/);
+  assert.equal(coerceFieldValue("boolean", true), true);
+  assert.equal(coerceFieldValue("boolean", "true"), true);
+  assert.equal(coerceFieldValue("boolean", "false"), false);
+  assert.equal(coerceFieldValue("string", 5), "5");
+});
+
+test("applyFieldEdit writes through to the prompt", () => {
+  const prompt = JSON.parse(JSON.stringify(PROMPT));
+  const v = applyFieldEdit(prompt, "1", "seed", "int", "123");
+  assert.equal(v, 123);
+  assert.equal(prompt[1].inputs.seed, 123);
+  applyFieldEdit(prompt, "1", "negative", "string", "blurry");
+  assert.equal(prompt[1].inputs.negative, "blurry");
+});
+
+test("applyFieldEdit rejects bad values without mutating", () => {
+  const prompt = JSON.parse(JSON.stringify(PROMPT));
+  assert.throws(() => applyFieldEdit(prompt, "1", "steps", "int", "a lot"));
+  assert.equal(prompt[1].inputs.steps, 20);     // untouched
+  assert.throws(() => applyFieldEdit(prompt, "99", "x", "int", "1"),
+                /no node 99/);
+});
+
+test("isMultiline flags prompt-ish strings and long values", () => {
+  assert.ok(isMultiline({ kind: "string", name: "positive_prompt", value: "" }));
+  assert.ok(isMultiline({ kind: "string", name: "text", value: "" }));
+  assert.ok(isMultiline({ kind: "string", name: "other",
+                          value: "x".repeat(80) }));
+  assert.ok(!isMultiline({ kind: "string", name: "filename_prefix",
+                           value: "out" }));
+  assert.ok(!isMultiline({ kind: "int", name: "text", value: 5 }));
+});
+
+test("groupByNode preserves prompt order and node identity", () => {
+  const groups = groupByNode(editableFields(PROMPT, SPECS));
+  assert.equal(groups.length, 2);
+  assert.equal(groups[0].nodeId, "1");
+  assert.equal(groups[0].classType, "TPUTxt2Img");
+  assert.ok(groups[0].fields.length >= 7);
+  assert.equal(groups[1].nodeId, "2");
+});

@@ -1,0 +1,64 @@
+// node:test suite for the progress poll state machine (progressLogic.js).
+import assert from "node:assert/strict";
+import { test } from "node:test";
+
+import { MAX_MISSES, newPollState, pollTick, progressLabel } from "../progressLogic.js";
+
+test("progressLabel covers running/done/failed", () => {
+  assert.equal(progressLabel({ step: 3, total: 30 }), "step 3/30");
+  assert.equal(progressLabel({ step: 30, total: 30, done: true }),
+               "done (30 steps)");
+  assert.equal(progressLabel({ step: 5, total: 30, failed: true }),
+               "failed at step 5/30");
+});
+
+test("misses show queued… and give up after MAX_MISSES", () => {
+  const st = newPollState();
+  const t1 = pollTick(st, null);
+  assert.equal(t1.label, "queued…");
+  assert.equal(t1.stop, false);
+  st.misses = MAX_MISSES;            // fast-forward
+  const t2 = pollTick(st, null);
+  assert.equal(t2.stop, true);
+  assert.equal(t2.hide, true);
+});
+
+test("a snapshot resets the miss counter", () => {
+  const st = newPollState();
+  pollTick(st, null);
+  pollTick(st, null);
+  assert.equal(st.misses, 2);
+  pollTick(st, { step: 1, total: 4, fraction: 0.25 });
+  assert.equal(st.misses, 0);
+});
+
+test("preview refetches only on a NEW step", () => {
+  const st = newPollState();
+  const snap = { step: 1, total: 4, fraction: 0.25 };
+  assert.equal(pollTick(st, snap).refetchPreview, true);
+  assert.equal(pollTick(st, snap).refetchPreview, false);   // same step
+  assert.equal(pollTick(st, { ...snap, step: 2, fraction: 0.5 })
+    .refetchPreview, true);
+  // step 0 (no events yet) never refetches
+  const st2 = newPollState();
+  assert.equal(pollTick(st2, { step: 0, total: 4, fraction: 0 })
+    .refetchPreview, false);
+});
+
+test("done stops polling with a full bar", () => {
+  const st = newPollState();
+  const t = pollTick(st, { step: 4, total: 4, fraction: 1, done: true });
+  assert.equal(t.stop, true);
+  assert.equal(t.hide, false);
+  assert.equal(t.widthPct, 100);
+  assert.equal(t.label, "done (4 steps)");
+});
+
+test("failed freezes the bar where it stopped and keeps it visible", () => {
+  const st = newPollState();
+  const t = pollTick(st, { step: 5, total: 30, fraction: 5 / 30,
+                           failed: true });
+  assert.equal(t.widthPct, 17);
+  assert.equal(t.label, "failed at step 5/30");
+  assert.equal(t.hide, false);
+});

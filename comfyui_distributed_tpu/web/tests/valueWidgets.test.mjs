@@ -1,0 +1,117 @@
+// node:test suite for the DistributedValue widget logic (valueWidgets.js)
+// — the coercion/resync/serialization surface the reference covers with
+// vitest over web/distributedValue.js.
+import assert from "node:assert/strict";
+import { test } from "node:test";
+
+import {
+  coerceWorkerValue,
+  distributedValueNodes,
+  hostsWithConfigIndex,
+  orphanedKeys,
+  parseWorkerValues,
+  serializeWorkerValues,
+  setWorkerValue,
+  valueType,
+  workerKey,
+} from "../valueWidgets.js";
+
+const CONFIG = {
+  hosts: [
+    { id: "w0", enabled: true },
+    { id: "w1", enabled: false },
+    { id: "w2", enabled: true },
+  ],
+};
+
+test("hostsWithConfigIndex keeps full-list positions for enabled hosts", () => {
+  const hosts = hostsWithConfigIndex(CONFIG);
+  assert.equal(hosts.length, 2);
+  assert.deepEqual(hosts.map(([w]) => w.id), ["w0", "w2"]);
+  // w2 keeps position 2 even though w1 is disabled — disabling one host
+  // must not renumber the others (stable worker_index contract)
+  assert.deepEqual(hosts.map(([, i]) => i), [0, 2]);
+  assert.equal(workerKey(2), "3");          // 1-indexed
+  assert.deepEqual(hostsWithConfigIndex(null), []);
+});
+
+test("distributedValueNodes filters by class", () => {
+  const prompt = {
+    1: { class_type: "DistributedValue", inputs: {} },
+    2: { class_type: "SaveImage", inputs: {} },
+    3: { class_type: "DistributedValue", inputs: {} },
+  };
+  assert.deepEqual(distributedValueNodes(prompt).map(([id]) => id),
+                   ["1", "3"]);
+  assert.deepEqual(distributedValueNodes(null), []);
+});
+
+test("parseWorkerValues tolerates corrupt input", () => {
+  assert.deepEqual(parseWorkerValues('{"1": 5}'), { 1: 5 });
+  assert.deepEqual(parseWorkerValues(""), {});
+  assert.deepEqual(parseWorkerValues(undefined), {});
+  assert.deepEqual(parseWorkerValues("not json"), {});
+  assert.deepEqual(parseWorkerValues("[1,2]"), {});   // array is not a map
+  assert.deepEqual(parseWorkerValues("null"), {});
+});
+
+test("valueType: explicit input wins over recorded _type", () => {
+  assert.equal(valueType({ value_type: "int" }, { _type: "FLOAT" }), "INT");
+  assert.equal(valueType({}, { _type: "FLOAT" }), "FLOAT");
+  assert.equal(valueType({}, {}), "");
+  assert.equal(valueType(null, null), "");
+});
+
+test("coerceWorkerValue by declared type", () => {
+  assert.equal(coerceWorkerValue("INT", "42"), 42);
+  assert.equal(coerceWorkerValue("FLOAT", "2.5"), 2.5);
+  assert.equal(coerceWorkerValue("BOOLEAN", "true"), true);
+  assert.equal(coerceWorkerValue("BOOLEAN", "0"), false);
+  assert.equal(coerceWorkerValue("", "free text"), "free text");
+  assert.equal(coerceWorkerValue("STRING", "7"), "7");
+});
+
+test("coerceWorkerValue rejects NaN-producing input (would serialize null)", () => {
+  // '3O' typo'd for '30': NaN would JSON.stringify as null and fail the
+  // job at DistributedValue._coerce — must throw at the form instead
+  assert.throws(() => coerceWorkerValue("INT", "3O"), /not a number/);
+  assert.throws(() => coerceWorkerValue("INT", "1.5"), /not an integer/);
+  assert.throws(() => coerceWorkerValue("FLOAT", "abc"), /not a number/);
+  // empty string never reaches coercion (setWorkerValue clears first),
+  // but reject it anyway if called directly
+  assert.throws(() => coerceWorkerValue("FLOAT", " "), /not a number/);
+});
+
+test("setWorkerValue sets, coerces, and tags _type", () => {
+  const m = setWorkerValue({}, "1", "99", "INT");
+  assert.deepEqual(m, { 1: 99, _type: "INT" });
+  setWorkerValue(m, "3", "7", "INT");
+  assert.equal(m["3"], 7);
+});
+
+test("setWorkerValue: empty string clears the override", () => {
+  const m = { 1: 5, 2: 6, _type: "INT" };
+  setWorkerValue(m, "1", "", "INT");
+  assert.deepEqual(m, { 2: 6, _type: "INT" });
+  // clearing the last value drops the _type tag too
+  setWorkerValue(m, "2", "", "INT");
+  assert.deepEqual(m, {});
+});
+
+test("setWorkerValue without a type never writes _type", () => {
+  const m = setWorkerValue({}, "1", "anything", "");
+  assert.deepEqual(m, { 1: "anything" });
+});
+
+test("serializeWorkerValues round-trips through parse", () => {
+  const m = setWorkerValue({}, "2", "1.25", "FLOAT");
+  const s = serializeWorkerValues(m);
+  assert.deepEqual(parseWorkerValues(s), { 2: 1.25, _type: "FLOAT" });
+});
+
+test("orphanedKeys flags entries beyond the host list", () => {
+  const m = { 1: "a", 3: "b", 7: "c", _type: "STRING", junk: "d" };
+  assert.deepEqual(orphanedKeys(m, CONFIG), ["7", "junk"]);
+  assert.deepEqual(orphanedKeys({}, CONFIG), []);
+  assert.deepEqual(orphanedKeys({ 1: "a" }, { hosts: [] }), ["1"]);
+});

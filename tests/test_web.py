@@ -149,14 +149,16 @@ class TestDashboardDomContract:
         """The per-node widget layer (reference web/distributedValue.js)
         edits `worker_values` maps keyed by 1-indexed worker number — the
         exact contract DistributedValue.execute reads
-        (graph/nodes_builtin.py)."""
+        (graph/nodes_builtin.py). The pure logic lives in valueWidgets.js
+        (node:test-covered); main.js must consume it."""
         main = (self.WEB / "main.js").read_text()
         assert "renderNodeWidgets" in main
-        assert '"DistributedValue"' in main
-        assert '"worker_values"' in main
+        assert "setWorkerValue" in main and "workerKey" in main
+        vw = (self.WEB / "valueWidgets.js").read_text()
+        assert '"DistributedValue"' in vw
         # 1-indexed keys pinned to FULL config-list position (the
         # orchestrator's stable worker_index contract)
-        assert "String(configIdx + 1)" in main
+        assert "String(configIndex + 1)" in vw
 
 
 class TestInterruptExecution:
@@ -216,6 +218,80 @@ class TestWidgetsModule:
         assert script.is_file()
         assert os.access(script, os.X_OK)
         assert "node --test" in script.read_text()
+
+    def _exports(self, name):
+        import re
+
+        src = (self.WEB / name).read_text()
+        return set(re.findall(r"^export (?:function|const) (\w+)", src, re.M))
+
+    def _imports(self, src_path, module):
+        import re
+
+        src = (self.WEB / src_path).read_text()
+        m = re.search(r"import \{([^}]*)\} from \"[^\"]*" +
+                      re.escape(module) + r"\"", src, re.S)
+        assert m, f"{src_path} must import from {module}"
+        return {s.strip() for s in m.group(1).split(",") if s.strip()}
+
+    def test_forms_module_exports_match_consumers(self):
+        """forms.js (workflow parameter forms — VERDICT r3 next #3) is
+        pure logic consumed by main.js and its node:test suite."""
+        exported = self._exports("forms.js")
+        assert self._imports("main.js", "forms.js") <= exported
+        assert self._imports("tests/forms.test.mjs", "forms.js") <= exported
+        # the generic form must not double-render the widgeted fields
+        forms = (self.WEB / "forms.js").read_text()
+        assert "worker_values" in forms and "divide_by" in forms
+
+    def test_value_widgets_module_exports_match_consumers(self):
+        exported = self._exports("valueWidgets.js")
+        assert self._imports("main.js", "valueWidgets.js") <= exported
+        assert self._imports("tests/valueWidgets.test.mjs",
+                             "valueWidgets.js") <= exported
+
+    def test_progress_logic_module_exports_match_consumers(self):
+        exported = self._exports("progressLogic.js")
+        assert self._imports("main.js", "progressLogic.js") <= exported
+        assert self._imports("tests/progressLogic.test.mjs",
+                             "progressLogic.js") <= exported
+
+    def test_js_suite_has_depth(self):
+        """VERDICT r3 next #8: ≥20 JS tests across the suite (reference
+        bar: ~530-LoC vitest suite over 5 files)."""
+        import re
+
+        tests_dir = self.WEB / "tests"
+        count = sum(len(re.findall(r'^test\("', p.read_text(), re.M))
+                    for p in tests_dir.glob("*.test.mjs"))
+        assert count >= 20, f"only {count} JS tests"
+
+    def test_param_forms_wired(self, tmp_config):
+        """The dashboard generates parameter edit forms from
+        /distributed/object_info: route serves every registered node's
+        INPUT specs; main.js renders into #param-forms."""
+        html = (self.WEB / "index.html").read_text()
+        assert 'id="param-forms"' in html
+        main = (self.WEB / "main.js").read_text()
+        assert "renderParamForms" in main and "editableFields" in main
+
+        from comfyui_distributed_tpu.graph.node import NODE_REGISTRY
+
+        async def body():
+            app = create_app(Controller())
+            async with TestClient(TestServer(app)) as client:
+                r = await client.get("/distributed/object_info")
+                assert r.status == 200
+                nodes = (await r.json())["nodes"]
+                assert set(nodes) == set(NODE_REGISTRY)
+                spec = nodes["TPUTxt2Img"]
+                assert spec["required"]["seed"] == "INT"
+                assert spec["required"]["steps"] == "INT"
+                assert spec["required"]["positive"] == "CONDITIONING"
+                # hidden orchestration inputs must NOT leak into forms
+                assert "mesh" not in spec["required"]
+                assert "mesh" not in spec["optional"]
+        run(body())
 
     def test_auto_populate_route_and_button(self, tmp_config, monkeypatch):
         monkeypatch.delenv("TPU_WORKER_HOSTNAMES", raising=False)
