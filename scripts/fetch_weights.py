@@ -1,0 +1,276 @@
+#!/usr/bin/env python
+"""Turnkey weights fetcher: resumable downloads + checksums + convert.
+
+The reference documents an LLM-prompted shell-script recipe for pulling
+checkpoint URLs (``/root/reference/docs/model-download-script.md:1``);
+this is the first-class equivalent: a registry of the checkpoints each
+supported model family needs (HF ``resolve/main`` URLs), a resumable
+chunked downloader (HTTP Range + ``.part`` files, atomic rename), sha256
+verification, and an optional handoff to the converter
+(``python -m comfyui_distributed_tpu convert``) so one command goes from
+nothing to TPU-loadable flax stacks:
+
+    python scripts/fetch_weights.py --list
+    python scripts/fetch_weights.py sd15 --out weights/
+    python scripts/fetch_weights.py flux --out weights/ --convert ckpts/flux
+    python scripts/fetch_weights.py --url https://... --dest weights/x.safetensors
+
+Stdlib-only (urllib): runs on a bare TPU-VM image. Where a token is
+required (FLUX.1-dev gating), pass ``--hf-token`` or set ``HF_TOKEN``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import sys
+import time
+import urllib.error
+import urllib.request
+
+HF = "https://huggingface.co"
+
+# Checkpoints per model family. ``convert`` is the converter argv suffix
+# (docs/weights.md); paths are relative to --out. sha256 is pinned only
+# where upstream publishes a stable single revision — HF files can be
+# re-uploaded, so most entries verify size>0 + safetensors magic instead.
+REGISTRY: dict[str, dict] = {
+    "sd15": {
+        "about": "Stable Diffusion 1.5 single-file (UNet+VAE+CLIP-L)",
+        "files": [
+            {"url": f"{HF}/stable-diffusion-v1-5/stable-diffusion-v1-5/"
+                    "resolve/main/v1-5-pruned-emaonly.safetensors",
+             "dest": "v1-5-pruned-emaonly.safetensors"},
+        ],
+        "convert": ["--checkpoint", "v1-5-pruned-emaonly.safetensors",
+                    "--preset", "sd15"],
+    },
+    "sdxl": {
+        "about": "SDXL base 1.0 single-file (UNet+VAE+CLIP-L+CLIP-G)",
+        "files": [
+            {"url": f"{HF}/stabilityai/stable-diffusion-xl-base-1.0/"
+                    "resolve/main/sd_xl_base_1.0.safetensors",
+             "dest": "sd_xl_base_1.0.safetensors"},
+        ],
+        "convert": ["--checkpoint", "sd_xl_base_1.0.safetensors",
+                    "--preset", "sdxl"],
+    },
+    "flux-schnell": {
+        "about": "FLUX.1-schnell (MMDiT + ae + t5xxl + clip-l)",
+        "files": [
+            {"url": f"{HF}/black-forest-labs/FLUX.1-schnell/resolve/main/"
+                    "flux1-schnell.safetensors",
+             "dest": "flux1-schnell.safetensors"},
+            {"url": f"{HF}/black-forest-labs/FLUX.1-schnell/resolve/main/"
+                    "ae.safetensors", "dest": "ae.safetensors"},
+            {"url": f"{HF}/comfyanonymous/flux_text_encoders/resolve/main/"
+                    "t5xxl_fp16.safetensors", "dest": "t5xxl_fp16.safetensors"},
+            {"url": f"{HF}/comfyanonymous/flux_text_encoders/resolve/main/"
+                    "clip_l.safetensors", "dest": "clip_l.safetensors"},
+        ],
+        "convert": ["--checkpoint", "flux1-schnell.safetensors",
+                    "--preset", "flux", "--t5", "t5xxl_fp16.safetensors",
+                    "--clip-l", "clip_l.safetensors", "--vae", "ae.safetensors"],
+    },
+    "wan-1.3b": {
+        "about": "WAN 2.1 t2v 1.3B (DiT + wan-vae + umt5-xxl)",
+        "files": [
+            {"url": f"{HF}/Comfy-Org/Wan_2.1_ComfyUI_repackaged/resolve/main/"
+                    "split_files/diffusion_models/"
+                    "wan2.1_t2v_1.3B_fp16.safetensors",
+             "dest": "wan2.1_t2v_1.3B_fp16.safetensors"},
+            {"url": f"{HF}/Comfy-Org/Wan_2.1_ComfyUI_repackaged/resolve/main/"
+                    "split_files/vae/wan_2.1_vae.safetensors",
+             "dest": "wan_2.1_vae.safetensors"},
+            {"url": f"{HF}/Comfy-Org/Wan_2.1_ComfyUI_repackaged/resolve/main/"
+                    "split_files/text_encoders/"
+                    "umt5_xxl_fp8_e4m3fn_scaled.safetensors",
+             "dest": "umt5_xxl.safetensors"},
+        ],
+        "convert": ["--checkpoint", "wan2.1_t2v_1.3B_fp16.safetensors",
+                    "--preset", "wan", "--t5", "umt5_xxl.safetensors",
+                    "--vae", "wan_2.1_vae.safetensors"],
+    },
+}
+
+CHUNK = 8 * 1024 * 1024
+SAFETENSORS_MAGIC_MAX_HEADER = 100 * 1024 * 1024
+
+
+def _request(url: str, start: int = 0, token: str | None = None):
+    req = urllib.request.Request(url)
+    req.add_header("User-Agent", "cdt-fetch/1.0")
+    if start:
+        req.add_header("Range", f"bytes={start}-")
+    if token:
+        req.add_header("Authorization", f"Bearer {token}")
+    return urllib.request.urlopen(req, timeout=60)
+
+
+def download(url: str, dest: str, sha256: str | None = None,
+             token: str | None = None, retries: int = 5,
+             progress: bool = True) -> str:
+    """Resumable download to ``dest`` (``dest.part`` + atomic rename).
+    Returns the file's sha256 hex. Raises on exhausted retries or
+    checksum mismatch (the .part is kept for resume; a bad final hash
+    deletes it)."""
+    if os.path.exists(dest):
+        if progress:
+            print(f"  [skip] {dest} exists")
+        return _sha256_file(dest) if sha256 else ""
+    part = dest + ".part"
+    os.makedirs(os.path.dirname(os.path.abspath(dest)), exist_ok=True)
+    attempt = 0
+    while True:
+        start = os.path.getsize(part) if os.path.exists(part) else 0
+        try:
+            with _request(url, start=start, token=token) as resp:
+                # a server that ignores Range restarts from zero
+                if start and resp.status != 206:
+                    start = 0
+                total = resp.headers.get("Content-Length")
+                total = (int(total) + start) if total else None
+                mode = "ab" if start else "wb"
+                done = start
+                t0 = time.time()
+                with open(part, mode) as f:
+                    while True:
+                        chunk = resp.read(CHUNK)
+                        if not chunk:
+                            break
+                        f.write(chunk)
+                        done += len(chunk)
+                        if progress and total:
+                            pct = 100.0 * done / total
+                            mbs = (done - start) / 1e6 / max(
+                                time.time() - t0, 1e-9)
+                            print(f"\r  {os.path.basename(dest)}: "
+                                  f"{pct:5.1f}% ({done / 1e9:.2f} GB, "
+                                  f"{mbs:.0f} MB/s)", end="", flush=True)
+            if progress:
+                print()
+            break
+        except urllib.error.HTTPError as e:
+            if e.code == 416 and start:
+                # Range past EOF: the .part is already the complete file
+                # (a crash between the loop and the rename) — fall
+                # through to checksum + rename
+                break
+            if e.code in (401, 403, 404):
+                raise RuntimeError(
+                    f"HTTP {e.code} for {url} — gated repo? pass "
+                    "--hf-token / set HF_TOKEN") from e
+            attempt += 1
+            if attempt > retries:
+                raise RuntimeError(
+                    f"download failed after {retries} retries: {url} ({e})")
+            wait = min(2 ** attempt, 60)
+            if progress:
+                print(f"\n  [retry {attempt}/{retries} in {wait}s] {e}")
+            time.sleep(wait)
+        except (urllib.error.URLError, OSError, TimeoutError) as e:
+            attempt += 1
+            if attempt > retries:
+                raise RuntimeError(
+                    f"download failed after {retries} retries: {url} ({e})")
+            wait = min(2 ** attempt, 60)
+            if progress:
+                print(f"\n  [retry {attempt}/{retries} in {wait}s] {e}")
+            time.sleep(wait)
+    digest = _sha256_file(part)
+    if sha256 and digest != sha256:
+        os.remove(part)
+        raise RuntimeError(
+            f"sha256 mismatch for {dest}: got {digest}, want {sha256}")
+    os.replace(part, dest)
+    return digest
+
+
+def _sha256_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(CHUNK), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def verify_safetensors(path: str) -> bool:
+    """Cheap validity check: 8-byte little-endian header length followed
+    by a JSON header (the safetensors container format) — catches HTML
+    error pages saved as .safetensors (the classic gated-repo failure)."""
+    try:
+        with open(path, "rb") as f:
+            n = int.from_bytes(f.read(8), "little")
+            if not 0 < n < SAFETENSORS_MAGIC_MAX_HEADER:
+                return False
+            head = f.read(min(n, 1024))
+        return head.lstrip()[:1] == b"{"
+    except OSError:
+        return False
+
+
+def fetch_model(name: str, out: str, token: str | None = None,
+                convert_out: str | None = None, progress: bool = True) -> int:
+    entry = REGISTRY[name]
+    print(f"[{name}] {entry['about']}")
+    manifest = {}
+    for spec in entry["files"]:
+        dest = os.path.join(out, spec["dest"])
+        digest = download(spec["url"], dest, sha256=spec.get("sha256"),
+                          token=token, progress=progress)
+        if dest.endswith(".safetensors") and not verify_safetensors(dest):
+            print(f"  [warn] {dest} does not look like safetensors "
+                  "(gated repo HTML error page? pass --hf-token)")
+            return 1
+        manifest[spec["dest"]] = {"sha256": digest or _sha256_file(dest),
+                                  "bytes": os.path.getsize(dest),
+                                  "url": spec["url"]}
+    with open(os.path.join(out, f"{name}.manifest.json"), "w") as f:
+        json.dump(manifest, f, indent=2)
+    if convert_out:
+        argv = [sys.executable, "-m", "comfyui_distributed_tpu", "convert"]
+        for a in entry["convert"]:
+            argv.append(os.path.join(out, a)
+                        if a.endswith(".safetensors") else a)
+        argv += ["--out", convert_out]
+        print("  converting:", " ".join(argv))
+        import subprocess
+
+        return subprocess.call(argv)
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("model", nargs="?", choices=sorted(REGISTRY),
+                    help="model family to fetch")
+    ap.add_argument("--out", default="weights", help="download directory")
+    ap.add_argument("--list", action="store_true")
+    ap.add_argument("--url", help="ad-hoc: fetch one URL instead")
+    ap.add_argument("--dest", help="ad-hoc: destination path for --url")
+    ap.add_argument("--sha256", help="ad-hoc: expected digest for --url")
+    ap.add_argument("--convert", metavar="CKPT_DIR",
+                    help="run the converter into this directory afterwards")
+    ap.add_argument("--hf-token", default=os.environ.get("HF_TOKEN"))
+    ap.add_argument("--quiet", action="store_true")
+    cli = ap.parse_args(argv)
+
+    if cli.list or (not cli.model and not cli.url):
+        for name, entry in sorted(REGISTRY.items()):
+            total = len(entry["files"])
+            print(f"{name:14s} {entry['about']} ({total} files)")
+        return 0
+    if cli.url:
+        dest = cli.dest or os.path.join(
+            cli.out, os.path.basename(cli.url.split("?")[0]))
+        download(cli.url, dest, sha256=cli.sha256, token=cli.hf_token,
+                 progress=not cli.quiet)
+        return 0
+    return fetch_model(cli.model, cli.out, token=cli.hf_token,
+                       convert_out=cli.convert, progress=not cli.quiet)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

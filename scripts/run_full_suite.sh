@@ -1,0 +1,44 @@
+#!/bin/sh
+# Full-suite runner with per-file timings (VERDICT r3 next #5: one green
+# end-to-end run, logged and committed). Runs every test file serially —
+# the two-process fault-injection tests must not overlap with compile-
+# heavy SPMD files on a small host — and records wall-clock per file plus
+# the final tally in full_suite.log (or $1).
+#
+# Warnings policy: RuntimeWarning-clean. -W error::RuntimeWarning turns
+# any RuntimeWarning (e.g. a progress-sink steal) into a failure.
+set -u
+cd "$(dirname "$0")/.."
+LOG="${1:-full_suite.log}"
+: > "$LOG"
+
+note() { printf '%s\n' "$*" | tee -a "$LOG"; }
+
+note "# full suite run: $(date -u +%Y-%m-%dT%H:%M:%SZ)"
+note "# python: $(python --version 2>&1); host: $(uname -sr)"
+suite_start=$(date +%s)
+fail=0
+
+for f in tests/test_*.py; do
+    t0=$(date +%s)
+    if python -m pytest "$f" -q -W error::RuntimeWarning \
+        >/tmp/suite_file.log 2>&1; then
+        status=ok
+    else
+        status=FAIL
+        fail=1
+    fi
+    t1=$(date +%s)
+    tally=$(tail -n 3 /tmp/suite_file.log | grep -Eo \
+        '[0-9]+ (passed|failed|error|skipped)[^,]*' | tr '\n' ' ')
+    note "$(printf '%-42s %5ss  %-4s %s' "$f" "$((t1 - t0))" "$status" "$tally")"
+    if [ "$status" = FAIL ]; then
+        note "---- $f failure tail ----"
+        tail -n 40 /tmp/suite_file.log | tee -a "$LOG"
+        note "-------------------------"
+    fi
+done
+
+suite_end=$(date +%s)
+note "# total: $(((suite_end - suite_start) / 60))m $(((suite_end - suite_start) % 60))s, exit=$fail"
+exit "$fail"
