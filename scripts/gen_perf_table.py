@@ -1,0 +1,139 @@
+#!/usr/bin/env python
+"""Regenerate README.md / BASELINE.md perf tables from benchmarks/*.json.
+
+VERDICT r3 weak #7: the README's perf table and BASELINE's "Achieved"
+section drifted from the committed artifacts for two rounds. This makes
+them *generated*: the newest round's artifact per workload renders into
+the blocks between ``<!-- PERF_TABLE_START/END -->`` markers, and
+``tests/test_bench_docs.py`` fails when the committed text differs from
+what the artifacts produce.
+
+    python scripts/gen_perf_table.py            # rewrite in place
+    python scripts/gen_perf_table.py --check    # exit 1 on drift
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+START, END = "<!-- PERF_TABLE_START -->", "<!-- PERF_TABLE_END -->"
+
+# benchmark file suffix → stable row order
+WORKLOADS = ["tpu", "tpu_usdu", "tpu_wan", "tpu_flux"]
+
+
+def newest_artifacts() -> dict[str, tuple[int, dict]]:
+    """suffix → (round, artifact) for the newest captured round of each
+    workload (an outage round may capture a subset; each row shows its
+    own provenance)."""
+    out: dict[str, tuple[int, dict]] = {}
+    for p in sorted((ROOT / "benchmarks").glob("r*_*.json")):
+        m = re.match(r"r(\d+)_(.+)\.json$", p.name)
+        if not m or m.group(2) not in WORKLOADS:
+            continue
+        rnd, suffix = int(m.group(1)), m.group(2)
+        try:
+            data = json.loads(p.read_text())
+        except json.JSONDecodeError:
+            continue
+        if data.get("platform") in (None, "cpu") or not data.get("value"):
+            continue
+        if suffix not in out or rnd > out[suffix][0]:
+            out[suffix] = (rnd, data)
+    return out
+
+
+def _row_txt2img(rnd: int, a: dict) -> str:
+    step_ms = a["median_step_time_s"] * 1000
+    return (f"| SDXL 1024², {a['steps']} steps, CFG | "
+            f"**{a['value']:.3f} images/s** ({step_ms:.0f} ms/step) | "
+            f"**{a['mfu'] * 100:.1f}% MFU** "
+            f"({a['model_flops_per_image'] / 1e12:.0f} analytic "
+            f"TFLOPs/image vs {a['peak_flops_per_chip_bf16'] / 1e12:.0f} "
+            f"TFLOP/s bf16 peak) — r{rnd:02d} |")
+
+
+def _row_usdu(rnd: int, a: dict) -> str:
+    hw = a.get("output_hw", [4096, 4096])
+    return (f"| 4K Ultimate SD Upscale (1024²→{hw[0]}², "
+            f"{a['tiles']} tiles × {a['steps']} steps) | "
+            f"**{a['value']:.1f} s** | chunked tile-farm path; a pod "
+            f"shards the tile axis — r{rnd:02d} |")
+
+
+def _row_wan(rnd: int, a: dict) -> str:
+    return (f"| WAN-1.3B t2v, {a['frames']} frames 480×832, "
+            f"{a['steps']} steps, CFG | **{a['value']:.1f} s** | exact WAN "
+            f"stack + 3D causal VAE, spatially-tiled decode — r{rnd:02d} |")
+
+
+def _row_flux(rnd: int, a: dict) -> str:
+    if a["metric"].startswith("flux_full_depth_offload"):
+        streamed_gb = a.get("streamed_bytes_per_step", 0) / 1e9
+        gbps = a.get("host_to_device_gbps", 0)
+        return (f"| FLUX.1 FULL depth (12B bf16) 1024², host-offload "
+                f"streaming | **{a['value']:.4f} images/s** "
+                f"({a['median_image_latency_s']:.0f} s/image) | one chip "
+                f"streams {streamed_gb:.1f} GB/step over a measured "
+                f"{gbps:.2f} GB/s link (tunneled; real v5e host DMA is "
+                f"~10-40× faster, pods run dp×tp) — r{rnd:02d} |")
+    return (f"| FLUX-architecture 1024² (half depth, bf16-resident) | "
+            f"{a['value']:.3f} images/s | full 12B exceeds one chip's HBM "
+            f"— pods run it dp×tp — r{rnd:02d} |")
+
+
+ROWS = {"tpu": _row_txt2img, "tpu_usdu": _row_usdu, "tpu_wan": _row_wan,
+        "tpu_flux": _row_flux}
+
+
+def render_table() -> str:
+    arts = newest_artifacts()
+    lines = ["| Workload | Result | Notes |", "|---|---|---|"]
+    for suffix in WORKLOADS:
+        if suffix in arts:
+            rnd, a = arts[suffix]
+            lines.append(ROWS[suffix](rnd, a))
+    return "\n".join(lines)
+
+
+def splice(path: Path, table: str) -> tuple[str, str]:
+    """Return (old_block, new_text) for the marker block in ``path``."""
+    text = path.read_text()
+    if START not in text or END not in text:
+        raise SystemExit(f"{path} is missing {START}/{END} markers")
+    pre, rest = text.split(START, 1)
+    old, post = rest.split(END, 1)
+    new = f"{pre}{START}\n{table}\n{END}{post}"
+    return old.strip(), new
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--check", action="store_true",
+                    help="exit 1 if any target is out of date")
+    cli = ap.parse_args(argv)
+    table = render_table()
+    drift = False
+    for name in ("README.md", "BASELINE.md"):
+        path = ROOT / name
+        old, new = splice(path, table)
+        if old != table:
+            drift = True
+            if cli.check:
+                print(f"[drift] {name} perf table != benchmarks/ artifacts "
+                      "(run scripts/gen_perf_table.py)")
+            else:
+                path.write_text(new)
+                print(f"[updated] {name}")
+        elif not cli.check:
+            print(f"[ok] {name}")
+    return 1 if (drift and cli.check) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
