@@ -116,6 +116,99 @@ export function applyFieldEdit(prompt, nodeId, name, kind, raw) {
   return value;
 }
 
+// Preflight prompt lint, mirroring the server's validate_prompt rules
+// (graph/executor.py:37-79: unknown class, missing required input,
+// dangling link, bad output index) plus an unknown-input-name warning
+// (the executor silently drops those at run time). The reference's
+// graph editor prevents these structurally; a JSON-first dashboard
+// must lint instead. Returns [{nodeId, level: "error"|"warning",
+// message}] — empty = clean.
+export function lintPrompt(prompt, specs) {
+  const nodes = (specs && specs.nodes) || specs || {};
+  const issues = [];
+  if (!prompt || typeof prompt !== "object") return issues;
+  const push = (nodeId, level, message) =>
+    issues.push({ nodeId, level, message });
+  for (const [nodeId, node] of Object.entries(prompt)) {
+    if (nodeId.startsWith("_")) continue;   // _meta etc. — server strips
+    if (!node || typeof node !== "object" || !node.class_type) {
+      push(nodeId, "error", "node must have class_type");
+      continue;
+    }
+    const spec = nodes[node.class_type];
+    if (!spec) {
+      // only an error when we have specs at all (no specs = can't know)
+      if (Object.keys(nodes).length) {
+        push(nodeId, "error", `unknown node class ${node.class_type}`);
+      }
+      continue;
+    }
+    const inputs = node.inputs || {};
+    for (const name of Object.keys(spec.required || {})) {
+      if (inputs[name] === undefined) {
+        push(nodeId, "error", `missing required input ${name}`);
+      }
+    }
+    const declared = new Set([
+      ...Object.keys(spec.required || {}),
+      ...Object.keys(spec.optional || {}),
+    ]);
+    for (const [name, value] of Object.entries(inputs)) {
+      if (!declared.has(name)) {
+        push(nodeId, "warning",
+             `input ${name} is not declared by ${node.class_type} ` +
+             "(the executor ignores it)");
+      }
+      if (isLink(value)) {
+        const [src, outIdx] = value;
+        const srcNode = prompt[src];
+        if (!srcNode) {
+          push(nodeId, "error",
+               `input ${name} links to missing node ${src}`);
+        } else {
+          const srcSpec = nodes[srcNode.class_type];
+          if (srcSpec && outIdx >= (srcSpec.returns || []).length) {
+            push(nodeId, "error",
+                 `input ${name} links to output ${outIdx} of ` +
+                 `${srcNode.class_type} which has ` +
+                 `${(srcSpec.returns || []).length}`);
+          }
+        }
+      }
+    }
+  }
+  // cycle check (validate_prompt runs topo_order; a cyclic prompt must
+  // not lint clean). Iterative DFS over link edges.
+  const state = new Map();                 // nodeId → 0 visiting, 1 done
+  const links = (nid) =>
+    Object.values((prompt[nid] && prompt[nid].inputs) || {})
+      .filter((v) => isLink(v) && prompt[v[0]])
+      .map((v) => v[0]);
+  for (const start of Object.keys(prompt)) {
+    if (start.startsWith("_") || state.get(start) === 1) continue;
+    const stack = [[start, 0]];
+    while (stack.length) {
+      const top = stack[stack.length - 1];
+      const [nid] = top;
+      if (top[1] === 0) state.set(nid, 0);
+      const deps = links(nid);
+      if (top[1] < deps.length) {
+        const next = deps[top[1]++];
+        if (state.get(next) === 0) {
+          push(next, "error", `cycle involving node ${next}`);
+          state.set(next, 1);
+        } else if (state.get(next) === undefined) {
+          stack.push([next, 0]);
+        }
+      } else {
+        state.set(nid, 1);
+        stack.pop();
+      }
+    }
+  }
+  return issues;
+}
+
 // Group fields by node for rendering: [[{nodeId, classType}, fields], …]
 // in prompt order.
 export function groupByNode(fields) {

@@ -4,7 +4,7 @@
 
 import { api, probeHost, normalizeAddress, getAuthToken, setAuthToken } from "/web/apiClient.js";
 import { clampDivideBy, dividerNodes, inactiveLinks, describeAddedHosts, MAX_DIVIDE } from "/web/widgets.js";
-import { editableFields, groupByNode, applyFieldEdit, isMultiline } from "/web/forms.js";
+import { editableFields, groupByNode, applyFieldEdit, isMultiline, lintPrompt } from "/web/forms.js";
 import { distributedValueNodes, hostsWithConfigIndex, workerKey, parseWorkerValues,
          valueType, setWorkerValue, serializeWorkerValues, orphanedKeys } from "/web/valueWidgets.js";
 import { newPollState, pollTick } from "/web/progressLogic.js";
@@ -358,11 +358,23 @@ function renderParamForms() {
   root.replaceChildren();
   const prompt = parsePrompt();
   const fields = editableFields(prompt, state.nodeSpecs);
-  if (!fields.length) {
+  const issues = lintPrompt(prompt, state.nodeSpecs);
+  if (!fields.length && !issues.length) {
     root.hidden = true;
     return;
   }
   root.hidden = false;
+  // preflight lint (mirrors the server's validate_prompt, so the user
+  // sees the node_errors BEFORE queueing)
+  for (const issue of issues) {
+    const div = document.createElement("div");
+    div.className = issue.level === "error" ? "error" : "meta";
+    div.textContent =
+      `${issue.level === "error" ? "✕" : "⚠"} node #${issue.nodeId}: ` +
+      issue.message;
+    root.appendChild(div);
+  }
+  if (!fields.length) return;
   const head = document.createElement("div");
   head.className = "meta";
   head.textContent = "Parameters (writes through to the JSON above)";

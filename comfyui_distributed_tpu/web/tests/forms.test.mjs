@@ -11,6 +11,7 @@ import {
   groupByNode,
   isLink,
   isMultiline,
+  lintPrompt,
 } from "../forms.js";
 
 const SPECS = {
@@ -156,6 +157,78 @@ test("isMultiline flags prompt-ish strings and long values", () => {
   assert.ok(!isMultiline({ kind: "string", name: "filename_prefix",
                            value: "out" }));
   assert.ok(!isMultiline({ kind: "int", name: "text", value: 5 }));
+});
+
+test("lintPrompt: clean prompt has no issues", () => {
+  assert.deepEqual(lintPrompt(PROMPT, SPECS), []);
+  assert.deepEqual(lintPrompt(null, SPECS), []);
+});
+
+test("lintPrompt mirrors validate_prompt error classes", () => {
+  const prompt = {
+    1: { inputs: {} },                                   // no class_type
+    2: { class_type: "Bogus", inputs: {} },              // unknown class
+    3: { class_type: "SaveImage",
+         inputs: { images: ["9", 0] } },    // dangling + missing required
+    4: { class_type: "SaveImage",
+         inputs: { images: ["3", 5], filename_prefix: "x" } },  // bad idx
+  };
+  const issues = lintPrompt(prompt, SPECS);
+  const byNode = (id) => issues.filter((i) => i.nodeId === id);
+  assert.match(byNode("1")[0].message, /class_type/);
+  assert.match(byNode("2")[0].message, /unknown node class/);
+  const n3 = byNode("3").map((i) => i.message).join("; ");
+  assert.match(n3, /missing required input filename_prefix/);
+  assert.match(n3, /links to missing node 9/);
+  assert.match(byNode("4")[0].message, /output 5 of SaveImage which has 0/);
+  assert.ok(issues.every((i) => i.level === "error"));
+});
+
+test("lintPrompt skips _meta keys (raw pasted workflow files)", () => {
+  const prompt = {
+    _meta: { title: "shipped workflow" },
+    1: { class_type: "ImageBatchDivider",
+         inputs: { images: [[0.5]], divide_by: 2 } },
+    2: { class_type: "SaveImage",
+         inputs: { images: ["1", 0], filename_prefix: "x" } },
+  };
+  assert.deepEqual(lintPrompt(prompt, SPECS), []);
+});
+
+test("lintPrompt flags cycles like the server's topo_order", () => {
+  const prompt = {
+    a: { class_type: "SaveImage",
+         inputs: { images: ["b", 0], filename_prefix: "x" } },
+    b: { class_type: "SaveImage",
+         inputs: { images: ["a", 0], filename_prefix: "x" } },
+  };
+  const issues = lintPrompt(prompt, SPECS)
+    .filter((i) => /cycle/.test(i.message));
+  assert.equal(issues.length, 1);
+  assert.equal(issues[0].level, "error");
+  // acyclic chain stays clean
+  const chain = {
+    a: { class_type: "ImageBatchDivider",
+         inputs: { images: ["b", 0], divide_by: 2 } },
+    b: { class_type: "SaveImage",
+         inputs: { images: ["c", 0], filename_prefix: "x" } },
+    c: { class_type: "SaveImage",
+         inputs: { images: [1, 2, 3], filename_prefix: "x" } },
+  };
+  assert.ok(!lintPrompt(chain, SPECS).some((i) => /cycle/.test(i.message)));
+});
+
+test("lintPrompt warns on undeclared inputs, stays quiet without specs", () => {
+  const prompt = {
+    1: { class_type: "SaveImage",
+         inputs: { images: [[0.5]], filename_prefix: "x", typo_arg: 1 } },
+  };
+  const issues = lintPrompt(prompt, SPECS);
+  assert.equal(issues.length, 1);
+  assert.equal(issues[0].level, "warning");
+  assert.match(issues[0].message, /typo_arg is not declared/);
+  // no specs loaded (older controller): unknown classes aren't flagged
+  assert.deepEqual(lintPrompt(prompt, null), []);
 });
 
 test("groupByNode preserves prompt order and node identity", () => {

@@ -27,14 +27,28 @@ import time
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", "/tmp/cdt_xla_cache_probe")
 
 
-def _median_time(fn, *args, runs: int = 10) -> float:
+def _enable_cache() -> None:
     import jax
 
-    jax.block_until_ready(fn(*args))          # warmup (compile + alloc)
+    d = os.environ["JAX_COMPILATION_CACHE_DIR"]
+    os.makedirs(d, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", d)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+
+
+def _median_time(fn, *args, runs: int = 10) -> float:
+    """Times ``fn(seed_scalar, *args)`` — the varying scalar defeats any
+    result caching in the tunneled backend (identical repeated calls
+    measured 1000x too fast), and ``float()`` forces execution +
+    device→host fetch of a scalar."""
+    import jax.numpy as jnp
+
+    float(fn(jnp.float32(0.0), *args))        # warmup (compile + alloc)
     times = []
-    for _ in range(runs):
+    runs = int(os.environ.get("CDT_PROBE_RUNS", runs))
+    for i in range(runs):
         t0 = time.perf_counter()
-        jax.block_until_ready(fn(*args))
+        float(fn(jnp.float32(i + 1), *args))
         times.append(time.perf_counter() - t0)
     return statistics.median(times)
 
@@ -44,6 +58,8 @@ def _build_unet():
     import jax.numpy as jnp
 
     from comfyui_distributed_tpu.models.unet import UNetConfig, init_unet
+
+    _enable_cache()
 
     cfg = UNetConfig.sdxl()
     model, params = init_unet(cfg, jax.random.key(0),
@@ -67,21 +83,37 @@ def _unet_inputs(batch: int, cfg):
     return x, t, ctx, y
 
 
+SCAN_LEN = 8     # forwards chained on-device per timed call: one tunnel
+                 # RTT (~70 ms here) amortizes over 8 UNet forwards, like
+                 # the pipeline's 30-step compiled scan
+
+
 def _forward_fn(model):
     import jax
+    import jax.numpy as jnp
 
     @jax.jit
-    def fwd(params, x, t, ctx, y):
-        return model.apply(params, x, t, ctx, y)
+    def fwd(seed, params, x, t, ctx, y):
+        def body(carry, _):
+            out = model.apply(params, carry, t, ctx, y)
+            return carry * 0.5 + out.astype(carry.dtype) * 0.5, None
+
+        # cast the seed perturbation to x's dtype: a strong f32 scalar
+        # would promote the whole benchmarked stack out of bf16
+        final, _ = jax.lax.scan(body, x + (seed * 1e-6).astype(x.dtype),
+                                None, length=SCAN_LEN)
+        return jnp.sum(final.astype(jnp.float32))
 
     return fwd
 
 
 def _flops_of(fn, *args) -> float:
     try:
+        import jax.numpy as jnp
+
         from comfyui_distributed_tpu.utils.flops import estimate_flops
 
-        return float(estimate_flops(fn, *args))
+        return float(estimate_flops(fn, jnp.float32(0.0), *args))
     except Exception as e:  # noqa: BLE001
         print(f"[probe] flops estimate failed: {e}", file=sys.stderr)
         return 0.0
@@ -108,7 +140,8 @@ def exp_forward(flash: str | None = None) -> None:
         args = _unet_inputs(2, cfg)
         t = _median_time(fwd, params, *args)
         flops = _flops_of(fwd, params, *args)
-        rec = {"exp": "forward", "flash": mode, "median_s": round(t, 5),
+        rec = {"exp": "forward", "flash": mode,
+               "s_per_forward": round(t / SCAN_LEN, 5),
                "flops": flops, "mfu": round(flops / t / _peak(), 4)
                if flops else None}
         print(json.dumps(rec), flush=True)
@@ -129,8 +162,9 @@ def exp_batch() -> None:
         t = _median_time(fwd, params, *args)
         flops = _flops_of(fwd, params, *args)
         print(json.dumps({
-            "exp": "batch", "unet_batch": b, "median_s": round(t, 5),
-            "s_per_cfg_image": round(t / (b // 2), 5),
+            "exp": "batch", "unet_batch": b,
+            "s_per_forward": round(t / SCAN_LEN, 5),
+            "s_per_cfg_image_step": round(t / SCAN_LEN / (b // 2), 5),
             "mfu": round(flops / t / _peak(), 4) if flops else None,
         }), flush=True)
 
@@ -150,13 +184,20 @@ def exp_attn() -> None:
         ("self64_b4", 4, 4096, 10, 64, 4096),
         ("self32_b4", 4, 1024, 20, 64, 1024),
     ]
+    def timed_attn(f):
+        return jax.jit(lambda seed, q, k, v: jnp.sum(
+            f(q + (seed * 1e-6).astype(q.dtype), k, v)
+            .astype(jnp.float32)))
+
     for name, b, nq, h, d, nk in shapes:
         q = jax.random.normal(jax.random.key(0), (b, nq, h, d), jnp.bfloat16)
         k = jax.random.normal(jax.random.key(1), (b, nk, h, d), jnp.bfloat16)
         v = jax.random.normal(jax.random.key(2), (b, nk, h, d), jnp.bfloat16)
-        t_flash = _median_time(jax.jit(
-            functools.partial(flash_attention, interpret=False)), q, k, v)
-        t_xla = _median_time(jax.jit(jax.nn.dot_product_attention), q, k, v)
+        t_flash = _median_time(
+            timed_attn(functools.partial(flash_attention, interpret=False)),
+            q, k, v)
+        t_xla = _median_time(timed_attn(jax.nn.dot_product_attention),
+                             q, k, v)
         flops = 4.0 * b * h * nq * nk * d          # fwd: QK^T + PV
         print(json.dumps({
             "exp": "attn", "shape": name,
@@ -174,14 +215,16 @@ def exp_trace(out_dir: str = "/tmp/mfu_trace") -> None:
 
     import jax
 
+    import jax.numpy as jnp
+
     os.environ.setdefault("CDT_FLASH_ATTENTION", "1")
     cfg, model, params = _build_unet()
     fwd = _forward_fn(model)
     args = _unet_inputs(2, cfg)
-    jax.block_until_ready(fwd(params, *args))
+    float(fwd(jnp.float32(0.0), params, *args))     # warmup/compile
     jax.profiler.start_trace(out_dir)
-    for _ in range(4):
-        jax.block_until_ready(fwd(params, *args))
+    for i in range(4):
+        float(fwd(jnp.float32(i + 1.0), params, *args))
     jax.profiler.stop_trace()
     print(json.dumps({"exp": "trace", "dir": out_dir}), flush=True)
 
