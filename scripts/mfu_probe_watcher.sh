@@ -1,0 +1,24 @@
+#!/bin/sh
+# Waits for the tunneled TPU to come back, then runs the MFU probe
+# experiments in sequence, capturing JSON lines to /tmp/probe_*.log.
+# (Same pattern as tpu_bench_watcher.py: the tunnel dies for hours at a
+# time; measurements must start the moment it returns.)
+set -u
+cd "$(dirname "$0")/.."
+export PYTHONPATH="/root/.axon_site:$(pwd)"
+export CDT_PROBE_RUNS="${CDT_PROBE_RUNS:-5}"
+
+while :; do
+    if timeout 120 python -c "import jax; assert jax.devices()[0].platform != 'cpu'" 2>/dev/null; then
+        echo "[probe-watcher] TPU reachable — running experiments"
+        for exp in batch forward attn; do
+            echo "[probe-watcher] $exp"
+            timeout 3000 python scripts/mfu_probe.py "$exp" \
+                > "/tmp/probe_${exp}.log" 2>&1 || \
+                echo "[probe-watcher] $exp failed/timed out"
+        done
+        exit 0
+    fi
+    echo "[probe-watcher] TPU unreachable; sleeping"
+    sleep 120
+done
