@@ -160,17 +160,39 @@ class FlowPipeline:
 
     # --- mode 1c: host offload (model too large for one chip, no pod) ------
 
+    def offload_executor(self, params=None,
+                         resident_bytes: Optional[int] = None,
+                         stream_dtype: Optional[str] = None):
+        """Build-or-fetch the cached ``OffloadedFlux`` executor (resident
+        upload + compiled programs — minutes at FLUX scale, so cached
+        like every other mode; ``bench.py`` reads residency stats off the
+        same instance the product path runs)."""
+        from .offload import OffloadedFlux, normalize_stream_dtype
+        from .pipeline import cached_build
+
+        src = self.dit_params if params is None else params
+        sd = normalize_stream_dtype(stream_dtype)
+        return cached_build(
+            self, ("offload", resident_bytes, sd, id(src)),
+            lambda: OffloadedFlux(self.dit, src,
+                                  resident_bytes=resident_bytes,
+                                  stream_dtype=sd),
+            self._CACHE_MAX)
+
     def generate_offloaded(self, spec: FlowSpec, seed: int,
                            context: jax.Array, pooled: jax.Array,
                            params=None,
-                           resident_bytes: Optional[int] = None) -> jax.Array:
-        """ONE image on ONE device with blocks streamed from host memory
-        (``diffusion/offload.py``) — the single-chip answer to FLUX-12B's
-        24 GB of bf16 weights (CDT_OFFLOAD; dp×tp over a pod is the fast
-        path when more chips exist). ``params`` may be a host-numpy tree
-        (the usual case: a full-size random init cannot fit on device)."""
-        from .offload import OffloadedFlux, sample_euler_py
-        from .pipeline import cached_build
+                           resident_bytes: Optional[int] = None,
+                           stream_dtype: Optional[str] = None) -> jax.Array:
+        """ONE image on ONE device with weights beyond the HBM budget
+        held host-side (``diffusion/offload.py``) — the single-chip
+        answer to FLUX-12B's 24 GB of bf16 weights (CDT_OFFLOAD; dp×tp
+        over a pod is the fast path when more chips exist). Under the
+        default fp8 ``stream_dtype`` the quantized block set usually fits
+        resident and nothing streams per step; ``"native"`` keeps exact
+        dtypes. ``params`` may be a host-numpy tree (the usual case: a
+        full-size init can't live on device)."""
+        from .offload import sample_euler_py
 
         if spec.sampler != "euler":
             raise ValueError(
@@ -180,14 +202,7 @@ class FlowPipeline:
             raise ValueError(
                 "offloaded generation is single-image (batch 1): the "
                 "streamed weight window serves one latent at a time")
-        # the executor (resident upload + four compiled programs) is
-        # expensive — cache it across calls like every other mode
-        src = self.dit_params if params is None else params
-        off = cached_build(
-            self, ("offload", resident_bytes, id(src)),
-            lambda: OffloadedFlux(self.dit, src,
-                                  resident_bytes=resident_bytes),
-            self._CACHE_MAX)
+        off = self.offload_executor(params, resident_bytes, stream_dtype)
         sigmas = sigmas_flow(spec.steps, spec.shift)
         ds = self.vae.config.downscale
         lat_h, lat_w = spec.height // ds, spec.width // ds

@@ -5,6 +5,7 @@ offloaded generate equals the dp pipeline on one device."""
 
 import jax
 import jax.numpy as jnp
+import ml_dtypes
 import numpy as np
 import pytest
 
@@ -96,12 +97,18 @@ class TestForwardEquivalence:
     @pytest.mark.parametrize("pos_embed", ["rope", "sincos"])
     @pytest.mark.parametrize("resident_bytes", [0, 1 << 40])
     def test_matches_monolithic_apply(self, pos_embed, resident_bytes):
-        """All-streamed (0) and all-resident (huge) partitions both equal
-        the single-program DiT forward."""
+        """All-streamed (0) and all-resident (huge — which engages the
+        single scanned program, ``off.stacked``) partitions both equal
+        the single-program DiT forward under exact ``native`` dtypes."""
         cfg, model, params, x, t, ctx, pooled = _stack(pos_embed)
         g = jnp.array([3.5, 3.5]) if cfg.guidance_embed else None
         want = np.asarray(model.apply(params, x, t, ctx, pooled, g))
-        off = OffloadedFlux(model, params, resident_bytes=resident_bytes)
+        off = OffloadedFlux(model, params, resident_bytes=resident_bytes,
+                            stream_dtype="native")
+        if resident_bytes:
+            assert off.stacked and not off.streamed and not off.resident
+        else:
+            assert off.streamed and not off.stacked
         got = np.asarray(off.forward(x, t, ctx, pooled, g))
         np.testing.assert_allclose(got, want, rtol=2e-5, atol=2e-5)
 
@@ -114,7 +121,8 @@ class TestForwardEquivalence:
         glue = tree_bytes({k: v for k, v in inner.items()
                            if not k.startswith(("double_", "single_"))})
         off = OffloadedFlux(model, params,
-                            resident_bytes=glue + one_block * 2 + 64)
+                            resident_bytes=glue + one_block * 2 + 64,
+                            stream_dtype="native")
         assert 0 < len(off.resident) < len(off.block_order)
         assert set(off.resident) | set(off.streamed) == set(off.block_order)
         g = jnp.array([3.5, 3.5])
@@ -128,12 +136,171 @@ class TestForwardEquivalence:
         full-size init can't live on device)."""
         cfg, model, params, x, t, ctx, pooled = _stack()
         host = jax.tree_util.tree_map(np.asarray, params)
-        off = OffloadedFlux(model, host, resident_bytes=0)
+        off = OffloadedFlux(model, host, resident_bytes=0,
+                            stream_dtype="native")
         g = jnp.array([3.5, 3.5])
         want = np.asarray(model.apply(params, x, t, ctx, pooled, g))
         np.testing.assert_allclose(
             np.asarray(off.forward(x, t, ctx, pooled, g)), want,
             rtol=2e-5, atol=2e-5)
+
+
+class TestFp8Quantization:
+    """r04: fp8(e4m3) weights-only quantization with per-output-channel
+    absmax scales — the optimization that makes a 12B FLUX fit RESIDENT
+    in one 16 GB chip (zero bytes streamed per step). Mirrors the
+    reference ecosystem's standard fp8 low-VRAM FLUX practice."""
+
+    def test_kernel_roundtrip_error_bounded(self):
+        from comfyui_distributed_tpu.diffusion.offload import (
+            _flatten_block, _unflatten_block)
+
+        rng = np.random.default_rng(0)
+        w = (rng.standard_normal((128, 256)) * 0.02).astype(np.float32)
+        blk = {"kernel": w}
+        bufs, treedef, metas = _flatten_block(blk, quantize=True)
+        assert "float8_e4m3fn" in bufs and "scale" in bufs
+        assert bufs["scale"].shape == (256,)       # per output channel
+        out = np.asarray(jax.jit(
+            lambda b: _unflatten_block(b, treedef, metas)["kernel"])(
+            {k: jnp.asarray(v) for k, v in bufs.items()}))
+        # e4m3 error model: ≤ half-ulp relative (1/16) in the normal
+        # range, plus half a subnormal step (2^-10 × column scale)
+        # absolute for weights tiny relative to their column absmax
+        scale = np.max(np.abs(w), axis=0) / 448.0
+        bound = np.abs(w) / 16.0 + (2.0 ** -10) * scale[None, :] + 1e-12
+        assert np.all(np.abs(out - w) <= bound)
+        rel = np.abs(out - w) / np.maximum(np.abs(w), 1e-8)
+        assert float(np.mean(rel)) < 0.03
+
+    def test_small_leaves_stay_exact(self):
+        """Biases / norms / qk-scales are not worth quantizing and must
+        round-trip bit-exact."""
+        from comfyui_distributed_tpu.diffusion.offload import (
+            _flatten_block, _unflatten_block)
+
+        blk = {"kernel": np.random.randn(128, 64).astype(np.float32),
+               "bias": np.random.randn(64).astype(np.float32),
+               "scale1d": np.random.randn(16).astype(np.float32)}
+        bufs, treedef, metas = _flatten_block(blk, quantize=True)
+        out = jax.tree_util.tree_map(np.asarray, jax.jit(
+            lambda b: _unflatten_block(b, treedef, metas))(
+            {k: jnp.asarray(v) for k, v in bufs.items()}))
+        np.testing.assert_array_equal(out["bias"], blk["bias"])
+        np.testing.assert_array_equal(out["scale1d"], blk["scale1d"])
+        assert not np.array_equal(out["kernel"], blk["kernel"])  # lossy
+
+    def test_zero_column_safe(self):
+        from comfyui_distributed_tpu.diffusion.offload import (
+            _flatten_block, _unflatten_block)
+
+        w = np.random.randn(64, 64).astype(np.float32)
+        w[:, 7] = 0.0
+        bufs, treedef, metas = _flatten_block({"k": w}, quantize=True)
+        out = np.asarray(_unflatten_block(
+            {k: jnp.asarray(v) for k, v in bufs.items()}, treedef,
+            metas)["k"])
+        assert np.all(np.isfinite(out))
+        np.testing.assert_array_equal(out[:, 7], 0.0)
+
+    def test_quantized_bytes_roughly_halved(self):
+        cfg, model, params, *_ = _stack()
+        from comfyui_distributed_tpu.diffusion.offload import \
+            _flatten_block
+
+        blk = jax.tree_util.tree_map(
+            lambda a: np.asarray(a, ml_dtypes.bfloat16)
+            if np.asarray(a).dtype == np.float32 else np.asarray(a),
+            params["params"]["double_0"])
+        full = tree_bytes(blk)
+        bufs, _, _ = _flatten_block(blk, quantize=True)
+        assert tree_bytes(bufs) < 0.62 * full
+
+    def test_fp8_forward_close_to_exact(self):
+        """End-to-end fp8 (fully-resident scan path) vs the monolithic
+        bf16 forward on random-normal weights: quantization noise
+        averages over the contraction — a few percent relative L2."""
+        cfg = DiTConfig.tiny(pos_embed="rope")
+        _, abstract = init_dit(cfg, jax.random.key(0), sample_hw=(8, 8),
+                               context_len=6, abstract=True)
+        from comfyui_distributed_tpu.diffusion.offload import \
+            materialize_host_params
+
+        from comfyui_distributed_tpu.models.dit import DiT
+        model = DiT(cfg)
+        params = materialize_host_params(abstract, seed=3)
+        x = jax.random.normal(jax.random.key(1), (1, 8, 8, cfg.in_channels))
+        t = jnp.array([0.5])
+        ctx = jax.random.normal(jax.random.key(2), (1, 6, cfg.context_dim))
+        pooled = jax.random.normal(jax.random.key(3), (1, cfg.pooled_dim))
+        g = jnp.array([3.5])
+        want = np.asarray(model.apply(params, x, t, ctx, pooled, g),
+                          np.float32)
+        off = OffloadedFlux(model, params, resident_bytes=1 << 40,
+                            stream_dtype="float8_e4m3fn")
+        assert off.stacked and not off.streamed
+        got = np.asarray(off.forward(x, t, ctx, pooled, g), np.float32)
+        rel_l2 = (np.linalg.norm(got - want)
+                  / max(np.linalg.norm(want), 1e-9))
+        assert rel_l2 < 0.05, rel_l2
+
+    def test_fp8_streaming_loop_matches_fp8_resident(self):
+        """Budget-constrained fp8 (per-block streaming loop) must equal
+        the fully-resident scan path bit-for-bit: same quantized buffers,
+        same block programs."""
+        cfg = DiTConfig.tiny(pos_embed="sincos")
+        _, abstract = init_dit(cfg, jax.random.key(0), sample_hw=(8, 8),
+                               context_len=6, abstract=True)
+        from comfyui_distributed_tpu.diffusion.offload import \
+            materialize_host_params
+
+        from comfyui_distributed_tpu.models.dit import DiT
+        model = DiT(cfg)
+        params = materialize_host_params(abstract, seed=4)
+        x = jax.random.normal(jax.random.key(1), (1, 8, 8, cfg.in_channels))
+        t = jnp.array([0.5])
+        ctx = jax.random.normal(jax.random.key(2), (1, 6, cfg.context_dim))
+        pooled = jax.random.normal(jax.random.key(3), (1, cfg.pooled_dim))
+        g = jnp.array([3.5])
+        res = OffloadedFlux(model, params, resident_bytes=1 << 40,
+                            stream_dtype="float8_e4m3fn")
+        strm = OffloadedFlux(model, params, resident_bytes=0,
+                             stream_dtype="float8_e4m3fn")
+        assert strm.streamed and not strm.stacked
+        a = np.asarray(res.forward(x, t, ctx, pooled, g), np.float32)
+        b = np.asarray(strm.forward(x, t, ctx, pooled, g), np.float32)
+        np.testing.assert_allclose(a, b, rtol=2e-5, atol=2e-5)
+
+    def test_plan_matches_build(self):
+        """``plan_offload`` (shapes-only, what bench.py's RAM guard uses)
+        must agree with the executor actually built."""
+        from comfyui_distributed_tpu.diffusion.offload import plan_offload
+
+        cfg, model, params, *_ = _stack()
+        for budget in (0, 1 << 40):
+            for sd in ("native", "float8_e4m3fn"):
+                plan = plan_offload(params, budget, sd)
+                off = OffloadedFlux(model, params, resident_bytes=budget,
+                                    stream_dtype=sd)
+                assert plan["fully_resident"] == bool(off.stacked)
+                assert set(plan["streamed"]) == set(off.streamed)
+                assert plan["resident_bytes"] == off.resident_bytes
+                if off.streamed:
+                    assert plan["streamed_bytes"] == tree_bytes(
+                        off.streamed)
+
+    def test_env_knob_and_bad_value(self, monkeypatch):
+        from comfyui_distributed_tpu.diffusion.offload import \
+            stream_dtype_default
+
+        monkeypatch.delenv("CDT_OFFLOAD_STREAM_DTYPE", raising=False)
+        assert stream_dtype_default() == "float8_e4m3fn"
+        monkeypatch.setenv("CDT_OFFLOAD_STREAM_DTYPE", "native")
+        assert stream_dtype_default() == "native"
+        cfg, model, params, *_ = _stack()
+        with pytest.raises(ValueError, match="STREAM_DTYPE"):
+            OffloadedFlux(model, params, resident_bytes=0,
+                          stream_dtype="int4")
 
 
 class TestEulerLadder:
@@ -168,7 +335,8 @@ class TestGenerateOffloaded:
         want = np.asarray(pipe.generate(build_mesh({"dp": 1}), spec, 5,
                                         ctx, pooled))
         got = np.asarray(pipe.generate_offloaded(spec, 5, ctx, pooled,
-                                                 resident_bytes=0))
+                                                 resident_bytes=0,
+                                                 stream_dtype="native"))
         np.testing.assert_allclose(got, want, rtol=5e-4, atol=5e-4)
 
     def test_non_euler_raises(self):
@@ -231,10 +399,11 @@ class TestNodeAndCaching:
         pooled = jnp.zeros((1, cfg.pooled_dim))
         spec = FlowSpec(height=16, width=16, steps=2)
         pipe.generate_offloaded(spec, 0, ctx, pooled, resident_bytes=0)
-        key = ("offload", 0, id(pipe.dit_params))
-        first = pipe._fn_cache[key]
+        first = pipe.offload_executor(resident_bytes=0)
+        assert len(pipe._fn_cache) == 1
         pipe.generate_offloaded(spec, 1, ctx, pooled, resident_bytes=0)
-        assert pipe._fn_cache[key] is first
+        assert pipe.offload_executor(resident_bytes=0) is first
+        assert len(pipe._fn_cache) == 1
 
     def test_batch_gt_one_raises(self):
         from comfyui_distributed_tpu.diffusion.pipeline_flow import (
