@@ -74,6 +74,16 @@ def _row_wan(rnd: int, a: dict) -> str:
 
 def _row_flux(rnd: int, a: dict) -> str:
     if a["metric"].startswith("flux_full_depth_offload"):
+        if a.get("fully_resident"):
+            step = a.get("per_step_s", 0)
+            return (f"| FLUX.1 FULL depth (12B) 1024², single chip, fp8 "
+                    f"weight residency | **{a['value']:.4f} images/s** "
+                    f"({a['median_image_latency_s']:.0f} s/image, "
+                    f"{step:.2f} s/step) | whole quantized block set "
+                    f"({a['resident_bytes'] / 1e9:.1f} GB e4m3, "
+                    f"per-channel scales) HBM-resident; zero bytes "
+                    f"streamed per step, one scanned program per forward "
+                    f"— r{rnd:02d} |")
         streamed_gb = a.get("streamed_bytes_per_step", 0) / 1e9
         gbps = a.get("host_to_device_gbps", 0)
         return (f"| FLUX.1 FULL depth (12B bf16) 1024², host-offload "
