@@ -20,6 +20,8 @@ verify equality against dense attention.
 
 from __future__ import annotations
 
+from typing import Optional
+
 import jax
 import jax.numpy as jnp
 
@@ -33,9 +35,23 @@ def _pvary(x, axis):
     return jax.lax.pvary(x, axis)
 
 
-def _flash_enabled() -> bool:
-    """Pallas flash attention: env-forceable, default on for TPU only
-    (the interpreter path is for tests, not production CPU use)."""
+def _flash_min_seq() -> int:
+    """Below this q length the pallas flash kernel LOSES to XLA's fused
+    attention on TPU — measured r04 (`scripts/mfu_probe.py forward`,
+    SDXL 1024²: flash 0.1763 s/fwd vs XLA 0.1677, trace shows the 10
+    flash sites at ~3 ms each): at N ≤ a few K the O(N²) score matrix
+    fits HBM comfortably, XLA fuses softmax into the matmuls, and the
+    flash kernel's running-max bookkeeping is pure overhead. Flash's win
+    is memory at long N (ring/SP sequences, video token counts)."""
+    import os
+
+    return int(os.environ.get("CDT_FLASH_MIN_SEQ", "8192"))
+
+
+def _flash_enabled(q_len: Optional[int] = None) -> bool:
+    """Pallas flash attention: env-forceable; default = TPU AND the
+    sequence is long enough that flash beats XLA's fused lowering
+    (``CDT_FLASH_MIN_SEQ``, default 8192 — see ``_flash_min_seq``)."""
     import os
 
     flag = os.environ.get("CDT_FLASH_ATTENTION", "").lower()
@@ -44,15 +60,18 @@ def _flash_enabled() -> bool:
     if flag in ("0", "false", "off"):
         return False
     try:
-        return jax.devices()[0].platform == "tpu"
+        on_tpu = jax.devices()[0].platform == "tpu"
     except RuntimeError:
         return False
+    if q_len is not None and q_len < _flash_min_seq():
+        return False
+    return on_tpu
 
 
 def full_attention(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
-    """Dense [B,N,H,D] attention: pallas flash kernel on TPU, XLA's fused
-    lowering elsewhere."""
-    if _flash_enabled():
+    """Dense [B,N,H,D] attention: pallas flash kernel on TPU for long
+    sequences, XLA's fused lowering for short ones and off-TPU."""
+    if _flash_enabled(q_len=int(q.shape[1])):
         from .flash_attention import flash_attention
 
         return flash_attention(q, k, v)

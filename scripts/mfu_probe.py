@@ -184,20 +184,33 @@ def exp_attn() -> None:
         ("self64_b4", 4, 4096, 10, 64, 4096),
         ("self32_b4", 4, 1024, 20, 64, 1024),
     ]
+    ATTN_SCAN = 64   # attention ops chained on-device per timed call —
+                     # a single op is ~µs while the tunnel RTT is ~66 ms,
+                     # so unamortized timings only measure the tunnel
+
     def timed_attn(f):
-        return jax.jit(lambda seed, q, k, v: jnp.sum(
-            f(q + (seed * 1e-6).astype(q.dtype), k, v)
-            .astype(jnp.float32)))
+        @jax.jit
+        def run(seed, q, k, v):
+            def body(carry, _):
+                out = f(carry, k, v)
+                return (q + out * (seed * 1e-6).astype(q.dtype)), None
+
+            final, _ = jax.lax.scan(body, q, None, length=ATTN_SCAN)
+            return jnp.sum(final.astype(jnp.float32))
+
+        return run
 
     for name, b, nq, h, d, nk in shapes:
+        # works for nq != nk too: attention output is q-shaped, so the
+        # scan carry stays [B, Nq, H, D] while k/v stay fixed
         q = jax.random.normal(jax.random.key(0), (b, nq, h, d), jnp.bfloat16)
         k = jax.random.normal(jax.random.key(1), (b, nk, h, d), jnp.bfloat16)
         v = jax.random.normal(jax.random.key(2), (b, nk, h, d), jnp.bfloat16)
         t_flash = _median_time(
             timed_attn(functools.partial(flash_attention, interpret=False)),
-            q, k, v)
+            q, k, v) / ATTN_SCAN
         t_xla = _median_time(timed_attn(jax.nn.dot_product_attention),
-                             q, k, v)
+                             q, k, v) / ATTN_SCAN
         flops = 4.0 * b * h * nq * nk * d          # fwd: QK^T + PV
         print(json.dumps({
             "exp": "attn", "shape": name,

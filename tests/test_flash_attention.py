@@ -115,6 +115,27 @@ class TestDispatch:
         monkeypatch.setenv("CDT_FLASH_ATTENTION", "1")
         assert attn._flash_enabled()
 
+    def test_seq_length_gate(self, monkeypatch):
+        """r04: with no explicit env the flash default is gated on q
+        length — below CDT_FLASH_MIN_SEQ the XLA fused lowering wins on
+        TPU (measured: scripts/mfu_probe.py, SDXL 1024² flash 0.1763
+        s/fwd vs XLA 0.1677), so short sequences must resolve to False
+        even on TPU. Off-TPU (this CPU host) both resolve False; the
+        explicit flags override everything."""
+        from comfyui_distributed_tpu.ops import attention as attn
+
+        monkeypatch.delenv("CDT_FLASH_ATTENTION", raising=False)
+        assert attn._flash_min_seq() == 8192
+        monkeypatch.setenv("CDT_FLASH_MIN_SEQ", "4096")
+        assert attn._flash_min_seq() == 4096
+        # short q: gated off regardless of platform
+        assert not attn._flash_enabled(q_len=4095)
+        # explicit force wins over the gate
+        monkeypatch.setenv("CDT_FLASH_ATTENTION", "1")
+        assert attn._flash_enabled(q_len=64)
+        monkeypatch.setenv("CDT_FLASH_ATTENTION", "0")
+        assert not attn._flash_enabled(q_len=1 << 20)
+
     def test_full_attention_uses_flash_when_forced(self, monkeypatch):
         from comfyui_distributed_tpu.ops import attention as attn
 
