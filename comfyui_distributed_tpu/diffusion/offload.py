@@ -283,7 +283,16 @@ class OffloadedFlux:
 
     def __init__(self, dit: DiT, params, resident_bytes: Optional[int] = None,
                  device=None, stream_dtype: Optional[str] = None):
-        self.cfg: DiTConfig = dit.config
+        import dataclasses as _dc
+
+        # memory-starved by definition (weights fill HBM): the block
+        # programs must use the pallas flash kernel — XLA's fused
+        # attention OOM'd at compile here (r04: 16.89 GB vs 15.75 HBM
+        # at 4608 tokens × 24 heads with the fp8 set resident). Applied
+        # unconditionally: this single-device executor always runs
+        # blocks with sp_axis=None, so even a "ring"-configured DiT
+        # takes the dense branch here and needs the preference.
+        self.cfg: DiTConfig = _dc.replace(dit.config, attn_backend="flash")
         self.device = device or jax.devices()[0]
         budget = (resident_budget_bytes() if resident_bytes is None
                   else int(resident_bytes))

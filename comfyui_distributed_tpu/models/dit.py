@@ -47,7 +47,12 @@ class DiTConfig:
     pooled_dim: int = 768            # CLIP pooled
     guidance_embed: bool = True      # FLUX-dev distilled guidance input
     dtype: str = "bfloat16"
-    attn_backend: str = "dense"      # "dense" | "ring"
+    attn_backend: str = "dense"      # "dense" | "ring" | "flash"
+                                     # ("flash" = dense compute with the
+                                     # pallas kernel preferred regardless
+                                     # of the seq-length gate — required
+                                     # by the memory-starved offload
+                                     # executor, ops/attention.py)
     pos_embed: str = "sincos"        # "sincos" | "rope"
     remat: bool = False              # recompute block activations (HBM relief)
     rope_theta: float = 10000.0
@@ -247,7 +252,8 @@ class DoubleBlock(nn.Module):
             q = jnp.concatenate([tq, iq], axis=1)
             k = jnp.concatenate([tk, ik], axis=1)
             v = jnp.concatenate([tv, iv], axis=1)
-            out = full_attention(q, k, v)
+            out = full_attention(q, k, v,
+                                 prefer_flash=cfg.attn_backend == "flash")
         else:
             q = jnp.concatenate([tq, iq], axis=1)
             out = joint_ring_attention(q, tk, tv, ik, iv, sp_axis)
@@ -289,7 +295,8 @@ class SingleBlock(nn.Module):
         if pe_full is not None:
             q, k = apply_rope(q, pe_full), apply_rope(k, pe_full)
         if sp_axis is None:
-            out = full_attention(q, k, v)
+            out = full_attention(q, k, v,
+                                 prefer_flash=cfg.attn_backend == "flash")
         else:
             # txt tokens lead the sequence on every shard
             tk, ik = k[:, :txt_len], k[:, txt_len:]

@@ -68,10 +68,18 @@ def _flash_enabled(q_len: Optional[int] = None) -> bool:
     return on_tpu
 
 
-def full_attention(q: jax.Array, k: jax.Array, v: jax.Array) -> jax.Array:
+def full_attention(q: jax.Array, k: jax.Array, v: jax.Array,
+                   prefer_flash: bool = False) -> jax.Array:
     """Dense [B,N,H,D] attention: pallas flash kernel on TPU for long
-    sequences, XLA's fused lowering for short ones and off-TPU."""
-    if _flash_enabled(q_len=int(q.shape[1])):
+    sequences, XLA's fused lowering for short ones and off-TPU.
+
+    ``prefer_flash=True`` skips the sequence-length gate (still TPU-only,
+    still overridable by an explicit ``CDT_FLASH_ATTENTION``): set by
+    memory-constrained callers — the fp8-resident offload executor's
+    block programs OOM'd at compile with XLA attention (measured r04:
+    16.89 GB needed vs 15.75 HBM at FLUX's 4608 tokens × 24 heads with
+    12 GB of weights resident) while flash's streamed softmax fits."""
+    if _flash_enabled(q_len=None if prefer_flash else int(q.shape[1])):
         from .flash_attention import flash_attention
 
         return flash_attention(q, k, v)

@@ -136,6 +136,20 @@ class TestDispatch:
         monkeypatch.setenv("CDT_FLASH_ATTENTION", "0")
         assert not attn._flash_enabled(q_len=1 << 20)
 
+    def test_prefer_flash_safe_off_tpu(self, monkeypatch):
+        """prefer_flash skips the seq-length gate but NOT the platform
+        check: on this CPU host it must fall through to the XLA path
+        (a pallas call would need interpret mode) and still be exact.
+        The offload executor relies on this — its block programs set
+        prefer_flash unconditionally (OOM-measured necessity on TPU)."""
+        from comfyui_distributed_tpu.ops import attention as attn
+
+        monkeypatch.delenv("CDT_FLASH_ATTENTION", raising=False)
+        q, k, v = rand_qkv(jax.random.key(11), Nq=32, Nk=32)
+        out = attn.full_attention(q, k, v, prefer_flash=True)
+        np.testing.assert_allclose(out, dense_reference(q, k, v),
+                                   atol=2e-5, rtol=2e-5)
+
     def test_full_attention_uses_flash_when_forced(self, monkeypatch):
         from comfyui_distributed_tpu.ops import attention as attn
 

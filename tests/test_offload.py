@@ -271,6 +271,15 @@ class TestFp8Quantization:
         b = np.asarray(strm.forward(x, t, ctx, pooled, g), np.float32)
         np.testing.assert_allclose(a, b, rtol=2e-5, atol=2e-5)
 
+    def test_executor_prefers_flash_attention(self):
+        """The offload executor's block programs must request the pallas
+        flash kernel regardless of the seq-length gate: with the fp8 set
+        resident, XLA attention OOM'd at compile on the chip (r04,
+        16.89 GB vs 15.75 HBM)."""
+        cfg, model, params, *_ = _stack()
+        off = OffloadedFlux(model, params, resident_bytes=1 << 40)
+        assert off.cfg.attn_backend == "flash"
+
     def test_plan_matches_build(self):
         """``plan_offload`` (shapes-only, what bench.py's RAM guard uses)
         must agree with the executor actually built."""
