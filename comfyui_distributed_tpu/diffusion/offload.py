@@ -240,6 +240,129 @@ def _unflatten_block(bufs, treedef, metas):
     return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
+def quant_cache_dir() -> Optional[str]:
+    """``CDT_OFFLOAD_CACHE_DIR``: directory for cached quantized flat
+    blocks. Quantizing a 12B model costs ~5 single-core minutes on every
+    process start; the cache cuts a warm executor build to a disk read."""
+    return os.environ.get("CDT_OFFLOAD_CACHE_DIR") or None
+
+
+def _params_fingerprint(inner, names) -> str:
+    """Cheap content fingerprint of the block params: per leaf, shape +
+    dtype + fnv1a64 of ≤4096 single bytes sampled at an even stride
+    across the buffer (full hashing of 24 GB would cost more than it
+    saves). Stale-cache safety, not cryptographic integrity: a swapped
+    checkpoint with identical shapes whose changes all fall between the
+    sampled bytes is the (documented) blind spot."""
+    from ..native import hash64
+
+    h = hash64(b"cdt-quant-cache-v1|e4m3-perchannel")
+    for name in names:
+        for leaf in jax.tree_util.tree_leaves(inner[name]):
+            a = np.ascontiguousarray(leaf)
+            raw = a.reshape(-1).view(np.uint8)
+            stride = max(1, raw.size // 4096)
+            sample = raw[::stride][:4096].tobytes()
+            mix = hash64(f"{a.shape}|{a.dtype}".encode() + sample)
+            h = (h ^ mix) * 1099511628211 & 0xFFFFFFFFFFFFFFFF
+    return f"{h:016x}"
+
+
+class _QuantCache:
+    """Per-block ``.npy`` files + a JSON manifest, all inside a
+    fingerprint-named subdirectory of the cache root — concurrent cold
+    builds of *different* checkpoints sharing one ``CDT_OFFLOAD_CACHE_DIR``
+    land in disjoint subdirs, so one can never validate the other's
+    block files. Writes are tmp+rename atomic; a fingerprint mismatch
+    or any unreadable/garbled entry falls back to re-quantizing (never
+    fatal — construct via :func:`_open_quant_cache`)."""
+
+    def __init__(self, root: str, fingerprint: str):
+        import json
+        import pathlib
+
+        self.fingerprint = fingerprint
+        self.dir = pathlib.Path(root) / fingerprint
+        self.dir.mkdir(parents=True, exist_ok=True)   # may raise: see
+        self.manifest = self.dir / "manifest.json"    # _open_quant_cache
+        self.metas: dict[str, tuple] = {}
+        self.valid = False
+        try:
+            m = json.loads(self.manifest.read_text())
+            if m.get("fingerprint") == fingerprint:
+                self.metas = {
+                    kind: tuple((bk, off, tuple(shape), s_off, dt)
+                                for bk, off, shape, s_off, dt in rows)
+                    for kind, rows in m["metas"].items()}
+                self.valid = True
+        except (OSError, ValueError, KeyError, TypeError, AttributeError):
+            pass
+
+    def load(self, name: str) -> Optional[dict]:
+        if not self.valid:
+            return None
+        out = {}
+        kind = "double" if name.startswith("double") else "single"
+        rows = self.metas.get(kind, ())
+        keys = {bk for bk, *_ in rows}
+        if any(s_off >= 0 for _, _, _, s_off, _ in rows):
+            keys.add("scale")
+        for key in keys:
+            p = self.dir / f"{name}.{key.replace('/', '_')}.npy"
+            try:
+                arr = np.load(p)
+                # np.save round-trips ml_dtypes bytes but loads them as
+                # void ('|V1'/'|V2') — re-view as the real dtype, which
+                # is the buffer key itself ('scale' buffers are f32)
+                want = jnp.dtype("float32" if key == "scale" else key)
+                if arr.dtype != want:
+                    arr = arr.view(want)
+                out[key] = arr
+            except (OSError, ValueError, TypeError):
+                return None
+        return out or None
+
+    def save(self, name: str, bufs: dict) -> None:
+        import os as _os
+
+        for key, arr in bufs.items():
+            p = self.dir / f"{name}.{key.replace('/', '_')}.npy"
+            tmp = p.with_suffix(".tmp.npy")
+            try:
+                np.save(tmp, arr)
+                _os.replace(tmp, p)
+            except OSError:
+                return
+
+    def finalize(self, metas_by_kind: dict) -> None:
+        import json
+        import os as _os
+
+        payload = json.dumps({
+            "fingerprint": self.fingerprint,
+            "metas": {k: [[bk, off, list(shape), s_off, dt]
+                          for bk, off, shape, s_off, dt in rows]
+                      for k, rows in metas_by_kind.items()}})
+        tmp = self.manifest.with_suffix(".tmp")
+        try:
+            tmp.write_text(payload)
+            _os.replace(tmp, self.manifest)
+        except OSError:
+            pass
+        self.metas = metas_by_kind
+        self.valid = True
+
+
+def _open_quant_cache(root: str, fingerprint: str) -> "Optional[_QuantCache]":
+    """Never-fatal constructor: an unwritable/uncreatable cache dir
+    (read-only mount, bad env var) degrades to no caching rather than
+    failing the executor build."""
+    try:
+        return _QuantCache(root, fingerprint)
+    except OSError:
+        return None
+
+
 class _Embed(nn.Module):
     """Pre-block glue of ``DiT.__call__`` with identical submodule names,
     so the full model's param tree slices straight in (equivalence is
@@ -317,6 +440,30 @@ class OffloadedFlux:
         # time: peak host RSS stays ~one block (or one stack row-fill)
         # above the params tree instead of a full flat copy of the model
         plan = plan_offload(params, budget, sd)
+        cache: Optional[_QuantCache] = None
+        if quantize and quant_cache_dir() and self.block_order:
+            cache = _open_quant_cache(
+                quant_cache_dir(),
+                _params_fingerprint(inner, self.block_order))
+
+        def pack(name: str):
+            """Cached-or-fresh flat buffers for one block; records the
+            per-kind layout either way."""
+            kind = "double" if name.startswith("double") else "single"
+            if cache is not None and kind in cache.metas:
+                bufs = cache.load(name)
+                if bufs is not None:
+                    self._layout.setdefault(
+                        kind, (jax.tree_util.tree_structure(inner[name]),
+                               cache.metas[kind]))
+                    return bufs
+            bufs, treedef, metas = _flatten_block(inner[name],
+                                                  quantize=quantize)
+            self._layout.setdefault(kind, (treedef, metas))
+            if cache is not None:
+                cache.save(name, bufs)
+            return bufs
+
         if plan["fully_resident"] and self.block_order:
             # everything fits: upload per-kind STACKS (one put per
             # buffer key) and run the scan fast path — zero bytes
@@ -328,9 +475,7 @@ class OffloadedFlux:
                     continue
                 rows: dict[str, np.ndarray] = {}
                 for i, name in enumerate(names):
-                    bufs, treedef, metas = _flatten_block(
-                        inner[name], quantize=quantize)
-                    self._layout.setdefault(kind, (treedef, metas))
+                    bufs = pack(name)
                     if not rows:
                         rows = {k: np.empty((len(names),) + v.shape,
                                             v.dtype)
@@ -341,16 +486,15 @@ class OffloadedFlux:
                 del rows
         else:
             for name in self.block_order:
-                bufs, treedef, metas = _flatten_block(inner[name],
-                                                      quantize=quantize)
-                kind = "double" if name.startswith("double") else "single"
-                self._layout.setdefault(kind, (treedef, metas))
+                bufs = pack(name)
                 if name in set(plan["resident"]):
                     self.resident[name] = jax.device_put(bufs, self.device)
                 else:
                     # host numpy: no device residency, fetched per step
                     # as ONE put per flat buffer
                     self.streamed[name] = bufs
+        if cache is not None and not cache.valid:
+            cache.finalize({k: v[1] for k, v in self._layout.items()})
         self.glue = jax.device_put(glue, self.device)
         self.resident_bytes = plan["resident_bytes"]
 

@@ -312,6 +312,130 @@ class TestFp8Quantization:
                           stream_dtype="int4")
 
 
+class TestQuantCache:
+    """r04: CDT_OFFLOAD_CACHE_DIR persists quantized flat blocks —
+    quantizing 12B params costs ~5 single-core minutes per process
+    start; a warm cache cuts the build to a disk read."""
+
+    def _params(self):
+        cfg = DiTConfig.tiny(pos_embed="rope")
+        from comfyui_distributed_tpu.diffusion.offload import \
+            materialize_host_params
+        from comfyui_distributed_tpu.models.dit import DiT
+        _, abstract = init_dit(cfg, jax.random.key(0), sample_hw=(8, 8),
+                               context_len=6, abstract=True)
+        return DiT(cfg), materialize_host_params(abstract, seed=7)
+
+    def _inputs(self, cfg):
+        return (jax.random.normal(jax.random.key(1),
+                                  (1, 8, 8, cfg.in_channels)),
+                jnp.array([0.5]),
+                jax.random.normal(jax.random.key(2),
+                                  (1, 6, cfg.context_dim)),
+                jax.random.normal(jax.random.key(3), (1, cfg.pooled_dim)),
+                jnp.array([3.5]))
+
+    def test_cold_build_writes_warm_build_loads(self, tmp_path,
+                                                monkeypatch):
+        import comfyui_distributed_tpu.diffusion.offload as off_mod
+
+        monkeypatch.setenv("CDT_OFFLOAD_CACHE_DIR", str(tmp_path))
+        model, params = self._params()
+        off_cold = OffloadedFlux(model, params, resident_bytes=1 << 40,
+                                 stream_dtype="float8_e4m3fn")
+        # files live in a fingerprint-named subdir: concurrent builds of
+        # DIFFERENT checkpoints in one shared dir can't cross-validate
+        assert list(tmp_path.glob("*/manifest.json"))
+        assert list(tmp_path.glob("*/double_0.*.npy"))
+
+        calls = []
+        real = off_mod._flatten_block
+        monkeypatch.setattr(off_mod, "_flatten_block",
+                            lambda *a, **k: calls.append(1) or real(*a, **k))
+        off_warm = OffloadedFlux(model, params, resident_bytes=1 << 40,
+                                 stream_dtype="float8_e4m3fn")
+        assert not calls, "warm build must not re-quantize"
+        x, t, ctx, pooled, g = self._inputs(model.config)
+        np.testing.assert_array_equal(
+            np.asarray(off_cold.forward(x, t, ctx, pooled, g)),
+            np.asarray(off_warm.forward(x, t, ctx, pooled, g)))
+
+    def test_stale_fingerprint_requantizes(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("CDT_OFFLOAD_CACHE_DIR", str(tmp_path))
+        model, params = self._params()
+        OffloadedFlux(model, params, resident_bytes=1 << 40,
+                      stream_dtype="float8_e4m3fn")
+        # different weights, same shapes → fingerprint must differ and
+        # the stale cache must be ignored (correct output, no crash)
+        _, params2 = self._params()
+        p2 = jax.tree_util.tree_map(lambda a: a * 1.5
+                                    if a.ndim >= 2 else a, params2)
+        off2 = OffloadedFlux(model, p2, resident_bytes=1 << 40,
+                             stream_dtype="float8_e4m3fn")
+        x, t, ctx, pooled, g = self._inputs(model.config)
+        want = np.asarray(model.apply(p2, x, t, ctx, pooled, g), np.float32)
+        got = np.asarray(off2.forward(x, t, ctx, pooled, g), np.float32)
+        rel = np.linalg.norm(got - want) / max(np.linalg.norm(want), 1e-9)
+        assert rel < 0.05, rel
+
+    def test_corrupt_entry_falls_back(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("CDT_OFFLOAD_CACHE_DIR", str(tmp_path))
+        model, params = self._params()
+        off1 = OffloadedFlux(model, params, resident_bytes=1 << 40,
+                             stream_dtype="float8_e4m3fn")
+        for p in tmp_path.glob("*/single_1.*.npy"):
+            p.write_bytes(b"garbage")
+        off2 = OffloadedFlux(model, params, resident_bytes=1 << 40,
+                             stream_dtype="float8_e4m3fn")
+        x, t, ctx, pooled, g = self._inputs(model.config)
+        np.testing.assert_array_equal(
+            np.asarray(off1.forward(x, t, ctx, pooled, g)),
+            np.asarray(off2.forward(x, t, ctx, pooled, g)))
+
+    def test_garbled_manifest_shapes_never_fatal(self, tmp_path,
+                                                 monkeypatch):
+        """Valid-JSON-wrong-shape manifests (a list; metas rows that
+        aren't 5-tuples) must degrade to re-quantizing, not crash the
+        build (the 'never fatal' contract)."""
+        monkeypatch.setenv("CDT_OFFLOAD_CACHE_DIR", str(tmp_path))
+        model, params = self._params()
+        off1 = OffloadedFlux(model, params, resident_bytes=1 << 40,
+                             stream_dtype="float8_e4m3fn")
+        (manifest,) = tmp_path.glob("*/manifest.json")
+        fp = manifest.parent.name
+        for garbage in ("[1, 2]",
+                        '{"fingerprint": "%s", "metas": {"double": [1]}}'
+                        % fp):
+            manifest.write_text(garbage)
+            off2 = OffloadedFlux(model, params, resident_bytes=1 << 40,
+                                 stream_dtype="float8_e4m3fn")
+            x, t, ctx, pooled, g = self._inputs(model.config)
+            np.testing.assert_array_equal(
+                np.asarray(off1.forward(x, t, ctx, pooled, g)),
+                np.asarray(off2.forward(x, t, ctx, pooled, g)))
+
+    def test_unwritable_cache_dir_never_fatal(self, tmp_path,
+                                              monkeypatch):
+        ro = tmp_path / "ro"
+        ro.mkdir()
+        ro.chmod(0o500)                      # no write permission
+        monkeypatch.setenv("CDT_OFFLOAD_CACHE_DIR", str(ro / "cache"))
+        model, params = self._params()
+        try:
+            off = OffloadedFlux(model, params, resident_bytes=1 << 40,
+                                stream_dtype="float8_e4m3fn")
+            assert off.stacked                # built fine, just uncached
+        finally:
+            ro.chmod(0o700)
+
+    def test_no_cache_without_env(self, tmp_path, monkeypatch):
+        monkeypatch.delenv("CDT_OFFLOAD_CACHE_DIR", raising=False)
+        model, params = self._params()
+        OffloadedFlux(model, params, resident_bytes=1 << 40,
+                      stream_dtype="float8_e4m3fn")
+        assert not list(tmp_path.iterdir())
+
+
 class TestEulerLadder:
     def test_matches_scan_sampler(self):
         from comfyui_distributed_tpu.diffusion import sample, sigmas_flow
