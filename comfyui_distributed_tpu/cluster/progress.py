@@ -123,6 +123,14 @@ class ProgressTracker:
                     job.calls_seen = job.total
                 job.updated = time.time()
 
+    def report(self, token: int, sigma: float, x0,
+               shard: int = 0) -> None:
+        """Host-side progress report — the offloaded samplers run their
+        ladder as a Python loop (``diffusion/offload.sample_euler_py``),
+        so they feed the SAME per-step progress/preview machinery the
+        compiled paths drive via ``jax.debug.callback``."""
+        self._on_event(token, shard, float(sigma), np.asarray(x0))
+
     # --- event sink (jax.debug.callback, runtime threads) ---------------
 
     def _on_event(self, token: int, shard: int, sigma: float,

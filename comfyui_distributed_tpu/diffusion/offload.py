@@ -129,21 +129,34 @@ def block_packed_bytes(blk, quantize: bool) -> int:
                for l in jax.tree_util.tree_leaves(blk))
 
 
+def _kind_of(name: str) -> str:
+    """Block kind = the prefix before the trailing index: ``double_3`` →
+    ``double``, ``block_17`` → ``block``. Every block of a kind shares
+    one flat layout and one compiled program."""
+    return name.rsplit("_", 1)[0]
+
+
 def plan_offload(params, budget: int,
-                 stream_dtype: Optional[str] = None) -> dict:
+                 stream_dtype: Optional[str] = None,
+                 block_prefixes: tuple = ("double", "single"),
+                 glue_keys: tuple = _GLUE_KEYS) -> dict:
     """Placement plan without building anything: which blocks would be
     resident vs streamed under ``budget``, and the per-step streamed
     byte count. ``bench.py`` uses this to run its host-RAM leak guard
-    BEFORE the multi-GB executor build."""
+    BEFORE the multi-GB executor build. ``block_prefixes`` order is
+    execution order (FLUX: doubles then singles; WAN: ``("block",)``)."""
     quantize = normalize_stream_dtype(stream_dtype) == _F8
     inner = params["params"] if "params" in params else params
-    names = ([k for k in inner if k.startswith("double_")]
-             + [k for k in inner if k.startswith("single_")])
-    glue = {k: inner[k] for k in _GLUE_KEYS if k in inner}
+    order = []
+    for prefix in block_prefixes:
+        ns = [k for k in inner
+              if k.startswith(prefix + "_")
+              and k[len(prefix) + 1:].isdigit()]
+        order += sorted(ns, key=lambda n: int(n.rsplit("_", 1)[1]))
+    glue = {k: inner[k] for k in glue_keys if k in inner}
     used = tree_bytes(glue)
     resident, streamed, streamed_bytes = [], [], 0
-    for name in sorted(names, key=lambda n: (n.split("_")[0] == "single",
-                                             int(n.split("_")[1]))):
+    for name in order:
         size = block_packed_bytes(inner[name], quantize)
         if used + size <= budget:
             resident.append(name)
@@ -151,7 +164,7 @@ def plan_offload(params, budget: int,
         else:
             streamed.append(name)
             streamed_bytes += size
-    return {"resident": resident, "streamed": streamed,
+    return {"order": order, "resident": resident, "streamed": streamed,
             "resident_bytes": used, "streamed_bytes": streamed_bytes,
             "fully_resident": not streamed}
 
@@ -302,8 +315,7 @@ class _QuantCache:
         if not self.valid:
             return None
         out = {}
-        kind = "double" if name.startswith("double") else "single"
-        rows = self.metas.get(kind, ())
+        rows = self.metas.get(_kind_of(name), ())
         keys = {bk for bk, *_ in rows}
         if any(s_off >= 0 for _, _, _, s_off, _ in rows):
             keys.add("scale")
@@ -393,6 +405,120 @@ class _Embed(nn.Module):
         return img, txt, vec
 
 
+def _build_block_store(obj, params, budget: int,
+                       stream_dtype: Optional[str],
+                       block_prefixes: tuple, glue_keys: tuple,
+                       expected_blocks: Optional[int] = None) -> None:
+    """Shared executor substrate (FLUX and WAN): quantize/flatten the
+    transformer blocks, decide residency under ``budget``, and upload.
+
+    Fills on ``obj``: ``stream_dtype``, ``block_order``, ``resident``,
+    ``streamed``, ``stacked``, ``_layout`` (per-kind ``(treedef,
+    metas)``), ``glue`` (on device), ``resident_bytes``. Requires
+    ``obj.device`` set. Packing is plan-first then one-block-at-a-time:
+    peak host RSS stays ~one block (or one stack row-fill) above the
+    params tree instead of a full flat copy of the model. With the
+    ``CDT_OFFLOAD_CACHE_DIR`` quant cache, warm builds skip quantizing
+    entirely."""
+    sd = normalize_stream_dtype(stream_dtype)
+    obj.stream_dtype = sd
+    quantize = sd == _F8
+    inner = params["params"] if "params" in params else params
+
+    glue = {k: inner[k] for k in glue_keys if k in inner}
+    plan = plan_offload(params, budget, sd, block_prefixes, glue_keys)
+    if (expected_blocks is not None
+            and len(plan["order"]) != expected_blocks):
+        # a partially-restored/mis-converted checkpoint must fail LOUDLY
+        # at build time, not execute fewer blocks and emit plausible
+        # garbage
+        raise ValueError(
+            f"offload: params hold {len(plan['order'])} transformer "
+            f"blocks ({block_prefixes}) but the config declares "
+            f"{expected_blocks}")
+    obj.block_order = plan["order"]
+    obj.resident = {}
+    obj.streamed = {}
+    obj.stacked = {}
+    # per-kind flat layout (identical across every block of a kind —
+    # same module config, same shapes): treedef + (buf_key, offset,
+    # shape, scale_off, out_dtype) per leaf, captured statically by
+    # the block programs
+    obj._layout = {}
+    cache: Optional[_QuantCache] = None
+    if quantize and quant_cache_dir() and obj.block_order:
+        cache = _open_quant_cache(
+            quant_cache_dir(),
+            _params_fingerprint(inner, obj.block_order))
+
+    def pack(name: str):
+        """Cached-or-fresh flat buffers for one block; records the
+        per-kind layout either way."""
+        kind = _kind_of(name)
+        if cache is not None and kind in cache.metas:
+            bufs = cache.load(name)
+            if bufs is not None:
+                obj._layout.setdefault(
+                    kind, (jax.tree_util.tree_structure(inner[name]),
+                           cache.metas[kind]))
+                return bufs
+        bufs, treedef, metas = _flatten_block(inner[name],
+                                              quantize=quantize)
+        obj._layout.setdefault(kind, (treedef, metas))
+        if cache is not None:
+            cache.save(name, bufs)
+        return bufs
+
+    if plan["fully_resident"] and obj.block_order:
+        # everything fits: upload per-kind STACKS (one put per buffer
+        # key) and run the scan fast path — zero bytes streamed per
+        # step, one dispatch per forward. Stacks are filled row by row
+        # so only stack + one block are live.
+        for kind in block_prefixes:
+            names = [n for n in obj.block_order if _kind_of(n) == kind]
+            if not names:
+                continue
+            rows: dict[str, np.ndarray] = {}
+            for i, name in enumerate(names):
+                bufs = pack(name)
+                if not rows:
+                    rows = {k: np.empty((len(names),) + v.shape, v.dtype)
+                            for k, v in bufs.items()}
+                for k, v in bufs.items():
+                    rows[k][i] = v
+            obj.stacked[kind] = jax.device_put(rows, obj.device)
+            del rows
+    else:
+        for name in obj.block_order:
+            bufs = pack(name)
+            if name in set(plan["resident"]):
+                obj.resident[name] = jax.device_put(bufs, obj.device)
+            else:
+                # host numpy: no device residency, fetched per step as
+                # ONE put per flat buffer
+                obj.streamed[name] = bufs
+    if cache is not None and not cache.valid:
+        cache.finalize({k: v[1] for k, v in obj._layout.items()})
+    obj.glue = jax.device_put(glue, obj.device)
+    obj.resident_bytes = plan["resident_bytes"]
+
+
+def release_store(obj) -> None:
+    """Free every device buffer an executor holds (stacked/resident
+    blocks + glue) — the dual-expert video swap uploads the other
+    expert into the same HBM. The executor object is dead afterwards;
+    build a fresh one to run again."""
+    for tree in ([obj.stacked, obj.resident]
+                 + [{"glue": getattr(obj, "glue", None)}]):
+        for leaf in jax.tree_util.tree_leaves(tree):
+            try:
+                leaf.delete()
+            except Exception:  # noqa: BLE001 — already deleted / host
+                pass
+    obj.stacked = {}
+    obj.resident = {}
+
+
 class OffloadedFlux:
     """Single-device FLUX executor with host-resident streamed blocks.
 
@@ -419,84 +545,11 @@ class OffloadedFlux:
         self.device = device or jax.devices()[0]
         budget = (resident_budget_bytes() if resident_bytes is None
                   else int(resident_bytes))
-        sd = normalize_stream_dtype(stream_dtype)
-        self.stream_dtype = sd
-        quantize = sd == _F8
-        inner = params["params"] if "params" in params else params
-
-        glue = {k: inner[k] for k in _GLUE_KEYS if k in inner}
-        self.block_order = (
-            [f"double_{i}" for i in range(self.cfg.depth_double)]
-            + [f"single_{i}" for i in range(self.cfg.depth_single)])
-        self.resident: dict[str, Any] = {}
-        self.streamed: dict[str, Any] = {}
-        self.stacked: dict[str, Any] = {}
-        # per-kind flat layout (identical across every block of a kind —
-        # same module config, same shapes): treedef + (buf_key, offset,
-        # shape, scale_off, out_dtype) per leaf, captured statically by
-        # the block programs
-        self._layout: dict[str, tuple] = {}
-        # plan from shapes alone, then pack-and-place ONE block at a
-        # time: peak host RSS stays ~one block (or one stack row-fill)
-        # above the params tree instead of a full flat copy of the model
-        plan = plan_offload(params, budget, sd)
-        cache: Optional[_QuantCache] = None
-        if quantize and quant_cache_dir() and self.block_order:
-            cache = _open_quant_cache(
-                quant_cache_dir(),
-                _params_fingerprint(inner, self.block_order))
-
-        def pack(name: str):
-            """Cached-or-fresh flat buffers for one block; records the
-            per-kind layout either way."""
-            kind = "double" if name.startswith("double") else "single"
-            if cache is not None and kind in cache.metas:
-                bufs = cache.load(name)
-                if bufs is not None:
-                    self._layout.setdefault(
-                        kind, (jax.tree_util.tree_structure(inner[name]),
-                               cache.metas[kind]))
-                    return bufs
-            bufs, treedef, metas = _flatten_block(inner[name],
-                                                  quantize=quantize)
-            self._layout.setdefault(kind, (treedef, metas))
-            if cache is not None:
-                cache.save(name, bufs)
-            return bufs
-
-        if plan["fully_resident"] and self.block_order:
-            # everything fits: upload per-kind STACKS (one put per
-            # buffer key) and run the scan fast path — zero bytes
-            # streamed per step, one dispatch per forward. Stacks are
-            # filled row by row so only stack + one block are live.
-            for kind in ("double", "single"):
-                names = [n for n in self.block_order if n.startswith(kind)]
-                if not names:
-                    continue
-                rows: dict[str, np.ndarray] = {}
-                for i, name in enumerate(names):
-                    bufs = pack(name)
-                    if not rows:
-                        rows = {k: np.empty((len(names),) + v.shape,
-                                            v.dtype)
-                                for k, v in bufs.items()}
-                    for k, v in bufs.items():
-                        rows[k][i] = v
-                self.stacked[kind] = jax.device_put(rows, self.device)
-                del rows
-        else:
-            for name in self.block_order:
-                bufs = pack(name)
-                if name in set(plan["resident"]):
-                    self.resident[name] = jax.device_put(bufs, self.device)
-                else:
-                    # host numpy: no device residency, fetched per step
-                    # as ONE put per flat buffer
-                    self.streamed[name] = bufs
-        if cache is not None and not cache.valid:
-            cache.finalize({k: v[1] for k, v in self._layout.items()})
-        self.glue = jax.device_put(glue, self.device)
-        self.resident_bytes = plan["resident_bytes"]
+        _build_block_store(self, params, budget, stream_dtype,
+                           block_prefixes=("double", "single"),
+                           glue_keys=_GLUE_KEYS,
+                           expected_blocks=(self.cfg.depth_double
+                                            + self.cfg.depth_single))
 
         cfg = self.cfg
 
@@ -650,14 +703,209 @@ class OffloadedFlux:
         return den
 
 
-def sample_euler_py(denoise, x, sigmas) -> jax.Array:
+_WAN_GLUE_KEYS = ("patch_embedding", "time_emb_0", "time_emb_2",
+                  "time_proj_1", "text_emb_0", "text_emb_2",
+                  "head_modulation", "head")
+
+
+class OffloadedWan:
+    """Single-device WAN executor with host-resident/streamed blocks —
+    the video-side counterpart of :class:`OffloadedFlux`, sharing the
+    same substrate (``_build_block_store``): fp8(e4m3) per-channel
+    weight quantization, fully-resident ``lax.scan`` fast path, streamed
+    double-buffered fallback. This is how WAN-2.1/2.2 **14B** video
+    models (28 GB bf16/expert — ~2× one chip's HBM) run on ONE chip:
+    quantized, one expert resident at a time (~14 GB fp8; blocks past
+    the budget stream per step). The reference covers this scale only
+    via multi-GPU fan-out or ComfyUI lowvram streaming
+    (``/root/reference/README.md:186-189``)."""
+
+    def __init__(self, wan, params, resident_bytes: Optional[int] = None,
+                 device=None, stream_dtype: Optional[str] = None):
+        import dataclasses as _dc
+
+        from ..models.wan import WanBlock, WanConfig  # noqa: F401
+
+        # same OOM-measured necessity as OffloadedFlux: memory-starved
+        # executors must prefer the pallas flash kernel
+        self.cfg = _dc.replace(wan.config, attn_backend="flash")
+        self.device = device or jax.devices()[0]
+        budget = (resident_budget_bytes() if resident_bytes is None
+                  else int(resident_bytes))
+        _build_block_store(self, params, budget, stream_dtype,
+                           block_prefixes=("block",),
+                           glue_keys=_WAN_GLUE_KEYS,
+                           expected_blocks=self.cfg.num_layers)
+
+        cfg = self.cfg
+
+        def embed_fn(gl, x, t, ctx_raw):
+            sub = {k: gl[k] for k in
+                   ("patch_embedding", "time_emb_0", "time_emb_2",
+                    "time_proj_1", "text_emb_0", "text_emb_2")
+                   if k in gl}
+            return _WanEmbed(cfg).apply({"params": sub}, x, t, ctx_raw)
+
+        def block_fn(bufs, tok, e0, ctx, pe):
+            bp = _unflatten_block(bufs, *self._layout["block"])
+            return WanBlock(cfg).apply({"params": bp}, tok, e0, ctx, pe,
+                                       None)
+
+        def head_fn(gl, tok, e, fhw, FHW):
+            """Exact tail of ``WanModel.__call__`` (models/wan.py) over
+            the glue params."""
+            dt = cfg.jnp_dtype
+            f, h, w = fhw
+            F, H, W = FHW
+            hm = (gl["head_modulation"].astype(jnp.float32)
+                  + e.astype(jnp.float32)[:, None, :]).astype(dt)
+            sh, sc = hm[:, 0][:, None, :], hm[:, 1][:, None, :]
+            tok = nn.LayerNorm(use_scale=False, use_bias=False,
+                               epsilon=cfg.eps, dtype=dt).apply(
+                {}, tok) * (1 + sc) + sh
+            pt, ph, pw = cfg.patch_size
+            out = nn.Dense(pt * ph * pw * cfg.out_channels,
+                           dtype=jnp.float32).apply(
+                {"params": gl["head"]}, tok.astype(jnp.float32))
+            B = tok.shape[0]
+            o = cfg.out_channels
+            out = out.reshape(B, f, h, w, pt, ph, pw, o)
+            out = out.transpose(0, 1, 4, 2, 5, 3, 6, 7)
+            return out.reshape(B, F, H, W, o)
+
+        self._embed = jax.jit(embed_fn)
+        self._block = jax.jit(block_fn)
+        self._head = jax.jit(head_fn, static_argnames=("fhw", "FHW"))
+
+        def fwd_resident(gl, bstack, x, t, ctx_raw, pe, fhw, FHW):
+            tok, e0, e, ctx = embed_fn(gl, x, t, ctx_raw)
+
+            def body(carry, bufs):
+                return block_fn(bufs, carry, e0, ctx, pe), None
+
+            tok, _ = jax.lax.scan(body, tok, bstack)
+            return head_fn(gl, tok, e, fhw, FHW)
+
+        self._fwd_resident = jax.jit(fwd_resident,
+                                     static_argnames=("fhw", "FHW"))
+
+    def _pe_tables(self, f: int, h: int, w: int):
+        from ..models.wan import video_ids
+        from ..models.dit import rope_freqs as _rope
+
+        key = (f, h, w)
+        cached = getattr(self, "_pe_cache", None)
+        if cached is not None and cached[0] == key:
+            return cached[1]
+        pe = _rope(video_ids(f, h, w), self.cfg.axes_dim, 10000.0)
+        pe = jax.device_put(pe, self.device)
+        self._pe_cache = (key, pe)
+        return pe
+
+    def _fetch(self, name: str):
+        if name in self.resident:
+            return self.resident[name], False
+        return jax.device_put(self.streamed[name], self.device), True
+
+    def forward(self, x, t, context):
+        """One velocity evaluation; equivalent to ``WanModel.apply``
+        (sp_axis None, pooled ignored) — pinned by tests (exact under
+        ``native``, to quantization tolerance under fp8)."""
+        cfg = self.cfg
+        B, F, H, W, C = x.shape
+        pt, ph, pw = cfg.patch_size
+        fhw = (F // pt, H // ph, W // pw)
+        pe = self._pe_tables(*fhw)
+        if self.stacked:
+            return self._fwd_resident(
+                self.glue, self.stacked["block"], x, t, context, pe,
+                fhw=fhw, FHW=(F, H, W))
+        tok, e0, e, ctx = self._embed(self.glue, x, t, context)
+        names = self.block_order
+        cur, cur_streamed = self._fetch(names[0])
+        for i, name in enumerate(names):
+            nxt = self._fetch(names[i + 1]) if i + 1 < len(names) else None
+            tok = self._block(cur, tok, e0, ctx, pe)
+            if cur_streamed:
+                # same backpressure as OffloadedFlux.forward: at most
+                # cur (computing) + nxt (streaming) in flight
+                jax.block_until_ready(tok)
+                for leaf in jax.tree_util.tree_leaves(cur):
+                    leaf.delete()
+            if nxt is not None:
+                cur, cur_streamed = nxt
+        return self._head(self.glue, tok, e, fhw=fhw, FHW=(F, H, W))
+
+    def denoiser(self, context, guidance_scale: float = 1.0):
+        """CFG matching ``VideoPipeline._denoiser`` exactly, but with
+        cond/uncond as two sequential forwards instead of a concat batch
+        — per-token normalizations make them bit-equivalent while
+        halving activation HBM (which is what this executor is short
+        of)."""
+        uncond_ctx = jnp.zeros_like(context)
+
+        def model_call(x, sigma, ctx):
+            t = jnp.broadcast_to(jnp.asarray(sigma), (x.shape[0],))
+            v = self.forward(x, t, ctx)
+            return x - jnp.asarray(sigma) * v
+
+        if guidance_scale == 1.0:
+            return lambda x, s: model_call(x, s, context)
+
+        def denoise(x, sigma):
+            cond = model_call(x, sigma, context)
+            uncond = model_call(x, sigma, uncond_ctx)
+            return uncond + guidance_scale * (cond - uncond)
+
+        return denoise
+
+    def release(self) -> None:
+        """Free this expert's HBM for the dual-expert swap."""
+        release_store(self)
+
+
+class _WanEmbed(nn.Module):
+    """Pre-block glue of ``WanModel.__call__`` with identical submodule
+    names so the full model's param tree slices straight in (equivalence
+    pinned by ``tests/test_offload.py``). Returns ``(tok, e0, e, ctx)``
+    — ``e`` feeds the head modulation."""
+
+    config: Any
+
+    @nn.compact
+    def __call__(self, x, t, context):
+        cfg = self.config
+        dt = cfg.jnp_dtype
+        B = x.shape[0]
+        tok = nn.Conv(cfg.dim, kernel_size=cfg.patch_size,
+                      strides=cfg.patch_size, dtype=dt,
+                      name="patch_embedding")(x.astype(dt))
+        tok = tok.reshape(B, -1, cfg.dim)
+        emb = timestep_embedding(t * 1000.0, cfg.freq_dim).astype(dt)
+        e = nn.Dense(cfg.dim, dtype=dt, name="time_emb_0")(emb)
+        e = nn.Dense(cfg.dim, dtype=dt, name="time_emb_2")(nn.silu(e))
+        e0 = nn.Dense(cfg.dim * 6, dtype=dt, name="time_proj_1")(
+            nn.silu(e)).reshape(B, 6, cfg.dim)
+        ctx = nn.Dense(cfg.dim, dtype=dt, name="text_emb_0")(
+            context.astype(dt))
+        ctx = nn.Dense(cfg.dim, dtype=dt, name="text_emb_2")(
+            nn.gelu(ctx, approximate=True))
+        return tok, e0, e, ctx
+
+
+def sample_euler_py(denoise, x, sigmas, on_step=None) -> jax.Array:
     """Python-level Euler ladder (exact math of ``samplers.sample``'s
     euler branch — pinned by tests). The offloaded denoiser cannot live
     inside a ``lax.scan``, so the loop runs host-side; for 20-50 steps
-    the per-step dispatch cost is noise next to block streaming."""
+    the per-step dispatch cost is noise next to block streaming.
+    ``on_step(sigma, x0)`` fires once per step with the denoised
+    estimate — the host-side twin of the compiled samplers' in-trace
+    progress callback (``cluster/progress.ProgressTracker.report``)."""
     sig = np.asarray(sigmas, np.float64)
     for i in range(len(sig) - 1):
         x0 = denoise(x, jnp.asarray(sig[i], jnp.float32))
+        if on_step is not None:
+            on_step(float(sig[i]), x0)
         if sig[i + 1] == 0.0:
             x = x0
         else:

@@ -183,7 +183,8 @@ class FlowPipeline:
                            context: jax.Array, pooled: jax.Array,
                            params=None,
                            resident_bytes: Optional[int] = None,
-                           stream_dtype: Optional[str] = None) -> jax.Array:
+                           stream_dtype: Optional[str] = None,
+                           on_step=None) -> jax.Array:
         """ONE image on ONE device with weights beyond the HBM budget
         held host-side (``diffusion/offload.py``) — the single-chip
         answer to FLUX-12B's 24 GB of bf16 weights (CDT_OFFLOAD; dp×tp
@@ -212,7 +213,8 @@ class FlowPipeline:
             key, (1, lat_h, lat_w, self.dit.config.in_channels),
             jnp.float32)
         den = off.denoiser(context, pooled, spec.guidance)
-        x0 = sample_euler_py(den, jax.device_put(x, off.device), sigmas)
+        x0 = sample_euler_py(den, jax.device_put(x, off.device), sigmas,
+                             on_step=on_step)
         images = self.vae.decode(x0)
         return jnp.clip(images / 2.0 + 0.5, 0.0, 1.0)
 

@@ -226,6 +226,92 @@ class VideoPipeline:
 
     _CACHE_MAX = 4
 
+    # --- host offload (expert too large for one chip, no pod) -------------
+
+    def offload_executor(self, which: str = "high",
+                         resident_bytes: Optional[int] = None,
+                         stream_dtype: Optional[str] = None):
+        """Build-or-fetch the cached ``OffloadedWan`` executor for one
+        expert (``"high"`` = ``dit_params``, ``"low"`` =
+        ``dit_params_low``)."""
+        from .offload import OffloadedWan, normalize_stream_dtype
+        from .pipeline import cached_build
+
+        src = (self.dit_params if which == "high"
+               else self.dit_params_low)
+        if src is None:
+            raise ValueError(f"no params for expert {which!r}")
+        sd = normalize_stream_dtype(stream_dtype)
+        return cached_build(
+            self, ("offload", which, resident_bytes, sd, id(src)),
+            lambda: OffloadedWan(self.dit, src,
+                                 resident_bytes=resident_bytes,
+                                 stream_dtype=sd),
+            self._CACHE_MAX)
+
+    def _evict_offload(self, which: str) -> None:
+        """Release an expert's HBM and drop it from the executor cache —
+        the dual-expert swap needs the space for the other expert."""
+        cache = getattr(self, "_fn_cache", {})
+        for key in [k for k in cache
+                    if k[0] == "offload" and k[1] == which]:
+            cache.pop(key).release()
+
+    def generate_offloaded(self, spec: VideoSpec, seed: int,
+                           context: jax.Array,
+                           pooled: Optional[jax.Array] = None,
+                           resident_bytes: Optional[int] = None,
+                           stream_dtype: Optional[str] = None,
+                           on_step=None) -> jax.Array:
+        """ONE t2v video on ONE device with quantized/streamed expert
+        weights (``diffusion/offload.py:OffloadedWan``) — the
+        single-chip answer to WAN-14B's 28 GB-per-expert (×2 for the
+        2.2 dual-expert pair). Seed derivation matches dp shard 0, so
+        offloaded == sharded run. Dual-expert jobs run the high-noise
+        segment, then RELEASE that expert's HBM and upload the low
+        expert (one swap per video; the low expert stays cached for the
+        next video, the high one re-uploads — with
+        ``CDT_OFFLOAD_CACHE_DIR`` the re-quantize is skipped). i2v
+        conditioning is not offload-supported yet; use tp or dp."""
+        from .offload import sample_euler_py
+
+        if spec.sampler != "euler":
+            raise ValueError(
+                "offloaded video sampling currently supports the euler "
+                f"ladder (got {spec.sampler!r})")
+        if context.shape[0] != 1:
+            raise ValueError("offloaded generation is single-video "
+                             "(batch 1)")
+        sigmas = sigmas_flow(spec.steps, spec.shift)
+        ds = self.vae.config.downscale
+        lat = (self.latent_frames(spec), spec.height // ds,
+               spec.width // ds, self.dit.config.in_channels)
+        key = jax.random.fold_in(jax.random.key(seed), 0)
+        x = jax.random.normal(key, (1,) + lat, jnp.float32)
+
+        def run(which, x0, sig):
+            off = self.offload_executor(which, resident_bytes,
+                                        stream_dtype)
+            den = off.denoiser(context, spec.guidance_scale)
+            return sample_euler_py(den, jax.device_put(x0, off.device),
+                                   sig, on_step=on_step)
+
+        if not self.is_moe:
+            x0 = run("high", x, sigmas)
+        else:
+            split = self._expert_split(sigmas)
+            steps = int(sigmas.shape[0]) - 1
+            if split <= 0:
+                x0 = run("low", x, sigmas)
+            elif split >= steps:
+                x0 = run("high", x, sigmas)
+            else:
+                x_mid = run("high", x, sigmas[: split + 1])
+                jax.block_until_ready(x_mid)
+                self._evict_offload("high")     # HBM for the low expert
+                x0 = run("low", x_mid, sigmas[split:])
+        return self.decode_frames(x0)
+
     def _cached_fn(self, mesh: Mesh, spec: VideoSpec, mode: str = "dp",
                    progress: bool = False,
                    axis: Optional[str] = None):

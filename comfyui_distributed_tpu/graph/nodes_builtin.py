@@ -435,7 +435,12 @@ class _ProgressScope:
     ``jax.debug.callback`` effects (block_until_ready alone does not
     flush them) before exit marks the run done — anything else marks it
     failed, freezing progress where it stopped instead of reporting
-    100%."""
+    100%. ``on_step`` is the host-side reporter for the offloaded
+    (python-ladder) samplers — same tracker, no traced token."""
+
+    def on_step(self, sigma: float, x0) -> None:
+        if self.token is not None:
+            self.tracker.report(self.token, sigma, x0)
 
     def __init__(self, tracker, prompt_id: str, total_calls: int):
         self.tracker, self.prompt_id = tracker, prompt_id
@@ -1072,10 +1077,17 @@ class TPUFlowTxt2Img(NodeDef):
 
         if mode == "offload" or (mode == "dp" and offload_enabled()):
             # CDT_OFFLOAD=1 (or mode="offload"): full-size single-chip
-            # execution with host-streamed blocks — how FLUX-12B runs
-            # without a pod (docs/deployment.md §5)
-            images = model.pipeline.generate_offloaded(
-                spec, int(seed), ctx, pooled)
+            # execution with quantized-resident/streamed blocks — how
+            # FLUX-12B runs without a pod (docs/deployment.md §5). The
+            # python ladder reports per-step progress host-side.
+            from ..diffusion.progress import total_calls
+
+            with _ProgressScope(progress_tracker, prompt_id,
+                                total_calls(spec.sampler,
+                                            spec.steps)) as ps:
+                images = model.pipeline.generate_offloaded(
+                    spec, int(seed), ctx, pooled, on_step=ps.on_step)
+                ps.complete(images)
         elif mode == "sp":
             from jax.sharding import Mesh
 
@@ -1158,9 +1170,18 @@ class TPUTxt2Video(NodeDef):
         key = jax.random.key(int(seed))
         # t2v is the longest-running job type — stream per-step progress
         # and previews exactly like the image samplers do
+        from ..diffusion.offload import offload_enabled
+
         with _ProgressScope(progress_tracker, prompt_id,
                             total_calls(spec.sampler, spec.steps)) as ps:
-            if mode == "sp":
+            if mode == "offload" or (mode == "dp" and offload_enabled()):
+                # full-size single-chip execution with quantized expert
+                # residency + dual-expert HBM swap — how WAN-14B runs
+                # without a pod (diffusion/offload.OffloadedWan). The
+                # python ladder reports per-step progress host-side.
+                videos = model.pipeline.generate_offloaded(
+                    spec, int(seed), ctx, on_step=ps.on_step)
+            elif mode == "sp":
                 if "sp" not in mesh.shape:
                     mesh = build_mesh({"sp": mesh.devices.size},
                                       list(mesh.devices.flat))

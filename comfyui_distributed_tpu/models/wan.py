@@ -55,6 +55,10 @@ class WanConfig:
     cross_attn_norm: bool = True
     dtype: str = "bfloat16"
     remat: bool = False
+    attn_backend: str = "dense"    # "dense" | "flash" — "flash" prefers
+                                   # the pallas kernel regardless of the
+                                   # seq-length gate (memory-starved
+                                   # offload executors; ops/attention.py)
 
     @classmethod
     def wan_14b(cls) -> "WanConfig":
@@ -133,7 +137,8 @@ class WanSelfAttention(nn.Module):
         k = apply_rope(k.reshape(shape), pe)
         v = v.reshape(shape)
         if sp_axis is None:
-            out = full_attention(q, k, v)
+            out = full_attention(q, k, v,
+                                 prefer_flash=cfg.attn_backend == "flash")
         else:
             out = ring_attention(q, k, v, sp_axis)
         return nn.Dense(cfg.dim, dtype=dt, name="o")(
