@@ -108,24 +108,41 @@ def normalize_stream_dtype(sd: Optional[str]) -> str:
                      f"(use {_F8!r} or 'native')")
 
 
-def _should_quantize(a: np.ndarray, quantize: bool) -> bool:
+def _should_quantize_meta(shape, dtype, quantize: bool) -> bool:
     """ONE predicate for both the size planner and the packer — if these
-    ever disagreed, ``plan_offload`` would mis-place blocks silently."""
-    is_float = a.dtype.kind == "f" or a.dtype == ml_dtypes.bfloat16
-    return (quantize and a.ndim >= 2 and a.size >= _QUANT_MIN_SIZE
+    ever disagreed, ``plan_offload`` would mis-place blocks silently.
+    Operates on (shape, dtype) so planning also works over ABSTRACT
+    trees (``jax.eval_shape`` — plan a 14B model without materializing
+    28 GB)."""
+    dt = np.dtype(dtype)
+    is_float = dt.kind == "f" or dt == ml_dtypes.bfloat16
+    size = 1
+    for s in shape:
+        size *= int(s)
+    return (quantize and len(shape) >= 2 and size >= _QUANT_MIN_SIZE
             and is_float)
 
 
-def _leaf_packed_bytes(a: np.ndarray, quantize: bool) -> int:
+def _should_quantize(a: np.ndarray, quantize: bool) -> bool:
+    return _should_quantize_meta(a.shape, a.dtype, quantize)
+
+
+def _leaf_packed_bytes(leaf, quantize: bool) -> int:
     """Packed size of one leaf WITHOUT packing it (placement planning
-    must not materialize flat copies — peak-RSS discipline)."""
-    if _should_quantize(a, quantize):
-        return int(a.size) + int(a.shape[-1]) * 4      # fp8 + f32 scales
-    return int(a.size) * a.dtype.itemsize
+    must not materialize flat copies — peak-RSS discipline). ``leaf``
+    only needs ``.shape``/``.dtype`` — ndarray, jax.Array, or
+    ShapeDtypeStruct all work."""
+    shape, dt = leaf.shape, np.dtype(leaf.dtype)
+    size = 1
+    for s in shape:
+        size *= int(s)
+    if _should_quantize_meta(shape, dt, quantize):
+        return size + int(shape[-1]) * 4               # fp8 + f32 scales
+    return size * dt.itemsize
 
 
 def block_packed_bytes(blk, quantize: bool) -> int:
-    return sum(_leaf_packed_bytes(np.asarray(l), quantize)
+    return sum(_leaf_packed_bytes(l, quantize)
                for l in jax.tree_util.tree_leaves(blk))
 
 
