@@ -853,17 +853,20 @@ class OffloadedWan:
                 cur, cur_streamed = nxt
         return self._head(self.glue, tok, e, fhw=fhw, FHW=(F, H, W))
 
-    def denoiser(self, context, guidance_scale: float = 1.0):
+    def denoiser(self, context, guidance_scale: float = 1.0,
+                 inp_fn=None):
         """CFG matching ``VideoPipeline._denoiser`` exactly, but with
         cond/uncond as two sequential forwards instead of a concat batch
         — per-token normalizations make them bit-equivalent while
         halving activation HBM (which is what this executor is short
-        of)."""
+        of). ``inp_fn`` transforms the latent before the model sees it
+        (i2v mask+conditioning concat), mirroring the dp denoiser."""
         uncond_ctx = jnp.zeros_like(context)
 
         def model_call(x, sigma, ctx):
             t = jnp.broadcast_to(jnp.asarray(sigma), (x.shape[0],))
-            v = self.forward(x, t, ctx)
+            inp = x if inp_fn is None else inp_fn(x)
+            v = self.forward(inp, t, ctx)
             return x - jnp.asarray(sigma) * v
 
         if guidance_scale == 1.0:

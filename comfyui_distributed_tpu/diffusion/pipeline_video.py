@@ -271,8 +271,35 @@ class VideoPipeline:
         segment, then RELEASE that expert's HBM and upload the low
         expert (one swap per video; the low expert stays cached for the
         next video, the high one re-uploads — with
-        ``CDT_OFFLOAD_CACHE_DIR`` the re-quantize is skipped). i2v
-        conditioning is not offload-supported yet; use tp or dp."""
+        ``CDT_OFFLOAD_CACHE_DIR`` the re-quantize is skipped). i2v:
+        ``generate_offloaded_i2v``."""
+        return self._offloaded_sample(
+            spec, seed, context, None, self.dit.config.in_channels,
+            resident_bytes, stream_dtype, on_step)
+
+    def generate_offloaded_i2v(self, spec: VideoSpec, seed: int,
+                               image: jax.Array, context: jax.Array,
+                               pooled: Optional[jax.Array] = None,
+                               resident_bytes: Optional[int] = None,
+                               stream_dtype: Optional[str] = None,
+                               on_step=None) -> jax.Array:
+        """Offloaded i2v: the same quantized-resident ladder with the
+        first-frame conditioning concat (``i2v_condition`` → mask+y)
+        applied per model call, exactly like ``_denoiser_i2v``."""
+        if image.shape[0] != 1:
+            raise ValueError("offloaded generation is single-video "
+                             "(batch 1)")
+        y, mask = self.i2v_condition(image, spec)
+        c = getattr(self.dit.config, "out_channels",
+                    self.dit.config.in_channels)
+        return self._offloaded_sample(spec, seed, context,
+                                      self._i2v_inp_fn(y, mask), c,
+                                      resident_bytes, stream_dtype,
+                                      on_step)
+
+    def _offloaded_sample(self, spec: VideoSpec, seed: int, context,
+                          inp_fn, lat_channels: int, resident_bytes,
+                          stream_dtype, on_step) -> jax.Array:
         from .offload import sample_euler_py
 
         if spec.sampler != "euler":
@@ -285,14 +312,15 @@ class VideoPipeline:
         sigmas = sigmas_flow(spec.steps, spec.shift)
         ds = self.vae.config.downscale
         lat = (self.latent_frames(spec), spec.height // ds,
-               spec.width // ds, self.dit.config.in_channels)
+               spec.width // ds, lat_channels)
         key = jax.random.fold_in(jax.random.key(seed), 0)
         x = jax.random.normal(key, (1,) + lat, jnp.float32)
 
         def run(which, x0, sig):
             off = self.offload_executor(which, resident_bytes,
                                         stream_dtype)
-            den = off.denoiser(context, spec.guidance_scale)
+            den = off.denoiser(context, spec.guidance_scale,
+                               inp_fn=inp_fn)
             return sample_euler_py(den, jax.device_put(x0, off.device),
                                    sig, on_step=on_step)
 
@@ -435,15 +463,24 @@ class VideoPipeline:
         mask = jnp.zeros(y.shape[:4] + (td,), y.dtype)
         return y, mask.at[:, 0].set(1.0)
 
-    def _denoiser_i2v(self, context, pooled, y, mask, guidance_scale,
-                      sp_axis=None, params=None):
+    @staticmethod
+    def _i2v_inp_fn(y, mask):
+        """ONE definition of the i2v model-input concat — shared by the
+        dp/sp denoiser and the offloaded ladder so the conditioning
+        layout can never desynchronize between them."""
         def inp_fn(x):
             return jnp.concatenate(
                 [x, jnp.broadcast_to(mask, x.shape[:4] + (mask.shape[-1],)),
                  jnp.broadcast_to(y, x.shape[:4] + (y.shape[-1],))], axis=-1)
 
+        return inp_fn
+
+    def _denoiser_i2v(self, context, pooled, y, mask, guidance_scale,
+                      sp_axis=None, params=None):
         return self._denoiser(context, pooled, guidance_scale,
-                              sp_axis=sp_axis, inp_fn=inp_fn, params=params)
+                              sp_axis=sp_axis,
+                              inp_fn=self._i2v_inp_fn(y, mask),
+                              params=params)
 
     def generate_i2v_fn(self, mesh: Mesh, spec: VideoSpec,
                         axis: str = constants.AXIS_DATA,

@@ -1238,9 +1238,14 @@ class TPUImg2Video(NodeDef):
                          guidance_scale=float(cfg))
         ctx = positive["context"]
         pooled = _video_pooled_default(model, positive)
+        from ..diffusion.offload import offload_enabled
+
         with _ProgressScope(progress_tracker, prompt_id,
                             total_calls(spec.sampler, spec.steps)) as ps:
-            if mode == "sp":
+            if mode == "offload" or (mode == "dp" and offload_enabled()):
+                videos = model.pipeline.generate_offloaded_i2v(
+                    spec, int(seed), image[:1], ctx, on_step=ps.on_step)
+            elif mode == "sp":
                 if "sp" not in mesh.shape:
                     mesh = build_mesh({"sp": mesh.devices.size},
                                       list(mesh.devices.flat))

@@ -529,8 +529,7 @@ class TestFullScalePlans:
     the single-chip claims numerically."""
 
     def test_flux_12b_fp8_fully_resident_at_default_budget(self):
-        from comfyui_distributed_tpu.diffusion.offload import (
-            _GLUE_KEYS, plan_offload)
+        from comfyui_distributed_tpu.diffusion.offload import plan_offload
 
         cfg = DiTConfig.flux()
         _, abstract = init_dit(cfg, jax.random.key(0),
@@ -624,6 +623,24 @@ class TestGenerateOffloadedVideo:
         # video
         kinds = {k[1] for k in pipe._fn_cache if k[0] == "offload"}
         assert kinds == {"low"}
+
+    def test_i2v_offloaded_equals_dp_on_one_device(self):
+        from comfyui_distributed_tpu.diffusion.pipeline_video import \
+            VideoSpec
+        from comfyui_distributed_tpu.models.registry import ModelRegistry
+        from comfyui_distributed_tpu.parallel import build_mesh
+
+        bundle = ModelRegistry().get("wan-i2v-tiny")
+        pipe = bundle.pipeline
+        spec = VideoSpec(frames=5, height=16, width=16, steps=2,
+                         shift=1.0)
+        ctx, pooled = bundle.text_encoder.encode(["animate"])
+        img = jnp.ones((1, 16, 16, 3)) * 0.3
+        want = np.asarray(pipe.generate_i2v(build_mesh({"dp": 1}), spec,
+                                            6, img, ctx, pooled))
+        got = np.asarray(pipe.generate_offloaded_i2v(
+            spec, 6, img, ctx, stream_dtype="native"))
+        np.testing.assert_allclose(got, want, rtol=5e-4, atol=5e-4)
 
     def test_non_euler_and_batch_guards(self):
         from comfyui_distributed_tpu.diffusion.pipeline_video import (
