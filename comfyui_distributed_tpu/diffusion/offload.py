@@ -525,13 +525,11 @@ def release_store(obj) -> None:
     blocks + glue) — the dual-expert video swap uploads the other
     expert into the same HBM. The executor object is dead afterwards;
     build a fresh one to run again."""
-    for tree in ([obj.stacked, obj.resident]
-                 + [{"glue": getattr(obj, "glue", None)}]):
+    for tree in (obj.stacked, obj.resident,
+                 {"glue": getattr(obj, "glue", None)}):
         for leaf in jax.tree_util.tree_leaves(tree):
-            try:
-                leaf.delete()
-            except Exception:  # noqa: BLE001 — already deleted / host
-                pass
+            if hasattr(leaf, "delete"):     # device arrays only;
+                leaf.delete()               # idempotent on deleted ones
     obj.stacked = {}
     obj.resident = {}
 
@@ -629,6 +627,52 @@ class OffloadedFlux:
 
         self._fwd_resident = jax.jit(fwd_resident)
 
+        def ladder(gl, dstack, sstack, x, sigs, ctx, pl, g,
+                   pe_img, pe_txt, pe_full, token):
+            """The ENTIRE euler sigma ladder as one program (fully-
+            resident only): sample()'s scan over steps wrapping
+            fwd_resident's scan over blocks — zero per-step host
+            dispatch. In-trace progress via the same wrap_denoiser the
+            compiled pipelines use."""
+            from .progress import wrap_denoiser
+            from .samplers import sample
+
+            B, H, W, C = x.shape
+
+            def den(xx, sigma):
+                t = jnp.broadcast_to(sigma, (xx.shape[0],))
+                out = fwd_resident(gl, dstack, sstack, xx, t, ctx, pl,
+                                   g, pe_img, pe_txt, pe_full)
+                return xx - sigma * unpatchify(out, (H, W),
+                                               cfg.patch_size, C)
+
+            d = den if token is None else wrap_denoiser(den, token, 0)
+            return sample("euler", d, x, sigs)
+
+        self._ladder = jax.jit(ladder)
+
+    def sample_euler_resident(self, x, sigmas, context, pooled,
+                              guidance=None, progress_token=None):
+        """Run the whole euler ladder as ONE compiled program — valid
+        only when fully resident (``self.stacked``). Removes the
+        per-step python dispatch (~70 ms RTT each through a tunneled
+        chip ≈ 2 s of a 36 s FLUX image); math identical to
+        ``sample_euler_py`` over ``forward`` (pinned by tests)."""
+        if not self.stacked:
+            raise RuntimeError(
+                "sample_euler_resident requires a fully-resident "
+                "executor (self.stacked)")
+        B, H, W, C = x.shape
+        pe_img, pe_txt, pe_full = self._rope_tables(H, W,
+                                                    context.shape[1])
+        token = (None if progress_token is None
+                 else jnp.asarray(progress_token, jnp.int32))
+        return self._ladder(
+            self.glue, self.stacked.get("double"),
+            self.stacked.get("single"), jax.device_put(x, self.device),
+            jnp.asarray(np.asarray(sigmas), jnp.float32),
+            context, pooled, guidance, pe_img, pe_txt, pe_full, token)
+
     # --- forward -----------------------------------------------------------
 
     def _rope_tables(self, H: int, W: int, txt_len: int):
@@ -725,6 +769,20 @@ _WAN_GLUE_KEYS = ("patch_embedding", "time_emb_0", "time_emb_2",
                   "head_modulation", "head")
 
 
+def i2v_input_concat(y, mask):
+    """ONE definition of the WAN i2v model-input concat
+    (``concat([x_t, mask, y])``) — used by the dp/sp denoiser
+    (``VideoPipeline._i2v_inp_fn``), the streamed offload ladder, and
+    the resident one-jit ladder, so the conditioning layout can never
+    desynchronize between execution modes."""
+    def inp_fn(x):
+        return jnp.concatenate(
+            [x, jnp.broadcast_to(mask, x.shape[:4] + (mask.shape[-1],)),
+             jnp.broadcast_to(y, x.shape[:4] + (y.shape[-1],))], axis=-1)
+
+    return inp_fn
+
+
 class OffloadedWan:
     """Single-device WAN executor with host-resident/streamed blocks —
     the video-side counterpart of :class:`OffloadedFlux`, sharing the
@@ -805,6 +863,62 @@ class OffloadedWan:
 
         self._fwd_resident = jax.jit(fwd_resident,
                                      static_argnames=("fhw", "FHW"))
+
+        def wan_ladder(gl, bstack, x, sigs, ctx, gscale, pe, y, mask,
+                       token, do_cfg):
+            """Whole euler ladder in one program (fully-resident only).
+            ``y``/``mask`` are TRACED i2v conditioning (None for t2v) —
+            traced, not closure-captured, so a new start image never
+            recompiles. CFG runs cond/uncond as two sequential in-trace
+            forwards (same memory argument as ``denoiser``)."""
+            from .progress import wrap_denoiser
+            from .samplers import sample
+
+            B, F, H, W, _ = x.shape
+            pt, ph, pw = cfg.patch_size
+            fhw, FHW = (F // pt, H // ph, W // pw), (F, H, W)
+
+            inp = ((lambda xx: xx) if y is None
+                   else i2v_input_concat(y, mask))
+
+            def model_call(xx, sigma, c):
+                t = jnp.broadcast_to(sigma, (xx.shape[0],))
+                v = fwd_resident(gl, bstack, inp(xx), t, c, pe, fhw, FHW)
+                return xx - sigma * v
+
+            def den(xx, sigma):
+                if not do_cfg:
+                    return model_call(xx, sigma, ctx)
+                cond = model_call(xx, sigma, ctx)
+                uncond = model_call(xx, sigma, jnp.zeros_like(ctx))
+                return uncond + gscale * (cond - uncond)
+
+            d = den if token is None else wrap_denoiser(den, token, 0)
+            return sample("euler", d, x, sigs)
+
+        self._ladder = jax.jit(wan_ladder, static_argnames=("do_cfg",))
+
+    def sample_euler_resident(self, x, sigmas, context,
+                              guidance_scale: float = 1.0, y=None,
+                              mask=None, progress_token=None):
+        """Run the whole euler ladder as ONE compiled program — valid
+        only when fully resident (``self.stacked``); math identical to
+        ``sample_euler_py`` over ``denoiser`` (pinned by tests)."""
+        if not self.stacked:
+            raise RuntimeError(
+                "sample_euler_resident requires a fully-resident "
+                "executor (self.stacked)")
+        B, F, H, W, _ = x.shape
+        pt, ph, pw = self.cfg.patch_size
+        pe = self._pe_tables(F // pt, H // ph, W // pw)
+        token = (None if progress_token is None
+                 else jnp.asarray(progress_token, jnp.int32))
+        return self._ladder(
+            self.glue, self.stacked["block"],
+            jax.device_put(x, self.device),
+            jnp.asarray(np.asarray(sigmas), jnp.float32), context,
+            jnp.float32(guidance_scale), pe, y, mask, token,
+            do_cfg=float(guidance_scale) != 1.0)
 
     def _pe_tables(self, f: int, h: int, w: int):
         from ..models.wan import video_ids

@@ -184,13 +184,16 @@ class FlowPipeline:
                            params=None,
                            resident_bytes: Optional[int] = None,
                            stream_dtype: Optional[str] = None,
-                           on_step=None) -> jax.Array:
+                           on_step=None, progress_token=None) -> jax.Array:
         """ONE image on ONE device with weights beyond the HBM budget
         held host-side (``diffusion/offload.py``) — the single-chip
         answer to FLUX-12B's 24 GB of bf16 weights (CDT_OFFLOAD; dp×tp
         over a pod is the fast path when more chips exist). Under the
-        default fp8 ``stream_dtype`` the quantized block set usually fits
-        resident and nothing streams per step; ``"native"`` keeps exact
+        default fp8 ``stream_dtype`` the quantized block set usually
+        fits resident, nothing streams per step, and the WHOLE sigma
+        ladder runs as one compiled program (in-trace progress via
+        ``progress_token``); streamed executors fall back to the python
+        ladder with host-side ``on_step``. ``"native"`` keeps exact
         dtypes. ``params`` may be a host-numpy tree (the usual case: a
         full-size init can't live on device)."""
         from .offload import sample_euler_py
@@ -212,9 +215,15 @@ class FlowPipeline:
         x = jax.random.normal(
             key, (1, lat_h, lat_w, self.dit.config.in_channels),
             jnp.float32)
-        den = off.denoiser(context, pooled, spec.guidance)
-        x0 = sample_euler_py(den, jax.device_put(x, off.device), sigmas,
-                             on_step=on_step)
+        if off.stacked:
+            g = jnp.full((context.shape[0],), float(spec.guidance))
+            x0 = off.sample_euler_resident(
+                x, sigmas, context, pooled, g,
+                progress_token=progress_token)
+        else:
+            den = off.denoiser(context, pooled, spec.guidance)
+            x0 = sample_euler_py(den, jax.device_put(x, off.device),
+                                 sigmas, on_step=on_step)
         images = self.vae.decode(x0)
         return jnp.clip(images / 2.0 + 0.5, 0.0, 1.0)
 

@@ -262,7 +262,8 @@ class VideoPipeline:
                            pooled: Optional[jax.Array] = None,
                            resident_bytes: Optional[int] = None,
                            stream_dtype: Optional[str] = None,
-                           on_step=None) -> jax.Array:
+                           on_step=None,
+                           progress_token=None) -> jax.Array:
         """ONE t2v video on ONE device with quantized/streamed expert
         weights (``diffusion/offload.py:OffloadedWan``) — the
         single-chip answer to WAN-14B's 28 GB-per-expert (×2 for the
@@ -274,15 +275,17 @@ class VideoPipeline:
         ``CDT_OFFLOAD_CACHE_DIR`` the re-quantize is skipped). i2v:
         ``generate_offloaded_i2v``."""
         return self._offloaded_sample(
-            spec, seed, context, None, self.dit.config.in_channels,
-            resident_bytes, stream_dtype, on_step)
+            spec, seed, context, None, None,
+            self.dit.config.in_channels, resident_bytes, stream_dtype,
+            on_step, progress_token)
 
     def generate_offloaded_i2v(self, spec: VideoSpec, seed: int,
                                image: jax.Array, context: jax.Array,
                                pooled: Optional[jax.Array] = None,
                                resident_bytes: Optional[int] = None,
                                stream_dtype: Optional[str] = None,
-                               on_step=None) -> jax.Array:
+                               on_step=None,
+                           progress_token=None) -> jax.Array:
         """Offloaded i2v: the same quantized-resident ladder with the
         first-frame conditioning concat (``i2v_condition`` → mask+y)
         applied per model call, exactly like ``_denoiser_i2v``."""
@@ -292,14 +295,14 @@ class VideoPipeline:
         y, mask = self.i2v_condition(image, spec)
         c = getattr(self.dit.config, "out_channels",
                     self.dit.config.in_channels)
-        return self._offloaded_sample(spec, seed, context,
-                                      self._i2v_inp_fn(y, mask), c,
+        return self._offloaded_sample(spec, seed, context, y, mask, c,
                                       resident_bytes, stream_dtype,
-                                      on_step)
+                                      on_step, progress_token)
 
     def _offloaded_sample(self, spec: VideoSpec, seed: int, context,
-                          inp_fn, lat_channels: int, resident_bytes,
-                          stream_dtype, on_step) -> jax.Array:
+                          y, mask, lat_channels: int, resident_bytes,
+                          stream_dtype, on_step,
+                          progress_token=None) -> jax.Array:
         from .offload import sample_euler_py
 
         if spec.sampler != "euler":
@@ -319,6 +322,13 @@ class VideoPipeline:
         def run(which, x0, sig):
             off = self.offload_executor(which, resident_bytes,
                                         stream_dtype)
+            if off.stacked:
+                # fully resident: the whole segment ladder is ONE
+                # compiled program (in-trace progress via the token)
+                return off.sample_euler_resident(
+                    x0, sig, context, spec.guidance_scale, y, mask,
+                    progress_token=progress_token)
+            inp_fn = None if y is None else self._i2v_inp_fn(y, mask)
             den = off.denoiser(context, spec.guidance_scale,
                                inp_fn=inp_fn)
             return sample_euler_py(den, jax.device_put(x0, off.device),
@@ -465,15 +475,11 @@ class VideoPipeline:
 
     @staticmethod
     def _i2v_inp_fn(y, mask):
-        """ONE definition of the i2v model-input concat — shared by the
-        dp/sp denoiser and the offloaded ladder so the conditioning
-        layout can never desynchronize between them."""
-        def inp_fn(x):
-            return jnp.concatenate(
-                [x, jnp.broadcast_to(mask, x.shape[:4] + (mask.shape[-1],)),
-                 jnp.broadcast_to(y, x.shape[:4] + (y.shape[-1],))], axis=-1)
+        """The i2v model-input concat — ONE definition shared with both
+        offloaded ladders (``diffusion/offload.i2v_input_concat``)."""
+        from .offload import i2v_input_concat
 
-        return inp_fn
+        return i2v_input_concat(y, mask)
 
     def _denoiser_i2v(self, context, pooled, y, mask, guidance_scale,
                       sp_axis=None, params=None):

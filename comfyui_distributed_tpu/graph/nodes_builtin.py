@@ -1078,15 +1078,17 @@ class TPUFlowTxt2Img(NodeDef):
         if mode == "offload" or (mode == "dp" and offload_enabled()):
             # CDT_OFFLOAD=1 (or mode="offload"): full-size single-chip
             # execution with quantized-resident/streamed blocks — how
-            # FLUX-12B runs without a pod (docs/deployment.md §5). The
-            # python ladder reports per-step progress host-side.
+            # FLUX-12B runs without a pod (docs/deployment.md §5).
+            # Progress: fully-resident runs stream in-trace via
+            # ps.token; streamed runs report host-side via ps.on_step.
             from ..diffusion.progress import total_calls
 
             with _ProgressScope(progress_tracker, prompt_id,
                                 total_calls(spec.sampler,
                                             spec.steps)) as ps:
                 images = model.pipeline.generate_offloaded(
-                    spec, int(seed), ctx, pooled, on_step=ps.on_step)
+                    spec, int(seed), ctx, pooled, on_step=ps.on_step,
+                    progress_token=ps.token)
                 ps.complete(images)
         elif mode == "sp":
             from jax.sharding import Mesh
@@ -1177,10 +1179,12 @@ class TPUTxt2Video(NodeDef):
             if mode == "offload" or (mode == "dp" and offload_enabled()):
                 # full-size single-chip execution with quantized expert
                 # residency + dual-expert HBM swap — how WAN-14B runs
-                # without a pod (diffusion/offload.OffloadedWan). The
-                # python ladder reports per-step progress host-side.
+                # without a pod (diffusion/offload.OffloadedWan).
+                # Progress: in-trace via ps.token when resident,
+                # host-side via ps.on_step when streaming.
                 videos = model.pipeline.generate_offloaded(
-                    spec, int(seed), ctx, on_step=ps.on_step)
+                    spec, int(seed), ctx, on_step=ps.on_step,
+                    progress_token=ps.token)
             elif mode == "sp":
                 if "sp" not in mesh.shape:
                     mesh = build_mesh({"sp": mesh.devices.size},
@@ -1244,7 +1248,8 @@ class TPUImg2Video(NodeDef):
                             total_calls(spec.sampler, spec.steps)) as ps:
             if mode == "offload" or (mode == "dp" and offload_enabled()):
                 videos = model.pipeline.generate_offloaded_i2v(
-                    spec, int(seed), image[:1], ctx, on_step=ps.on_step)
+                    spec, int(seed), image[:1], ctx, on_step=ps.on_step,
+                    progress_token=ps.token)
             elif mode == "sp":
                 if "sp" not in mesh.shape:
                     mesh = build_mesh({"sp": mesh.devices.size},

@@ -624,7 +624,11 @@ class TestGenerateOffloadedVideo:
         kinds = {k[1] for k in pipe._fn_cache if k[0] == "offload"}
         assert kinds == {"low"}
 
-    def test_i2v_offloaded_equals_dp_on_one_device(self):
+    @pytest.mark.parametrize("resident_bytes", [0, None])
+    def test_i2v_offloaded_equals_dp_on_one_device(self, resident_bytes):
+        """0 → streamed python ladder (inp_fn path); None (default
+        budget, tiny model fully resident) → the one-jit resident ladder
+        with traced y/mask. Both must match dp."""
         from comfyui_distributed_tpu.diffusion.pipeline_video import \
             VideoSpec
         from comfyui_distributed_tpu.models.registry import ModelRegistry
@@ -639,7 +643,28 @@ class TestGenerateOffloadedVideo:
         want = np.asarray(pipe.generate_i2v(build_mesh({"dp": 1}), spec,
                                             6, img, ctx, pooled))
         got = np.asarray(pipe.generate_offloaded_i2v(
-            spec, 6, img, ctx, stream_dtype="native"))
+            spec, 6, img, ctx, stream_dtype="native",
+            resident_bytes=resident_bytes))
+        np.testing.assert_allclose(got, want, rtol=5e-4, atol=5e-4)
+
+    @pytest.mark.parametrize("resident_bytes", [0, None])
+    def test_cfg_offloaded_equals_dp(self, resident_bytes):
+        """guidance_scale > 1 exercises the CFG branch of BOTH offload
+        ladders (in-trace cond/uncond for resident, sequential python
+        for streamed) against the dp batched-CFG path."""
+        from comfyui_distributed_tpu.diffusion.pipeline_video import (
+            VideoPipeline, VideoSpec)
+        from comfyui_distributed_tpu.parallel import build_mesh
+
+        model, hi, lo, vae, ctx, pooled = self._pipes()
+        pipe = VideoPipeline(model, hi, vae)
+        spec = VideoSpec(frames=5, height=16, width=16, steps=2,
+                         shift=1.0, guidance_scale=4.0)
+        want = np.asarray(pipe.generate(build_mesh({"dp": 1}), spec, 9,
+                                        ctx, pooled))
+        got = np.asarray(pipe.generate_offloaded(
+            spec, 9, ctx, stream_dtype="native",
+            resident_bytes=resident_bytes))
         np.testing.assert_allclose(got, want, rtol=5e-4, atol=5e-4)
 
     def test_non_euler_and_batch_guards(self):
@@ -671,7 +696,11 @@ class TestEulerLadder:
 
 
 class TestGenerateOffloaded:
-    def test_equals_dp_generate_on_one_device(self):
+    @pytest.mark.parametrize("resident_bytes", [0, 1 << 40])
+    def test_equals_dp_generate_on_one_device(self, resident_bytes):
+        """resident_bytes=0 → streamed python ladder; huge → the
+        fully-resident ONE-JIT ladder (sample_euler_resident). Both must
+        equal the dp path on one device."""
         from comfyui_distributed_tpu.diffusion.pipeline_flow import (
             FlowPipeline, FlowSpec)
         from comfyui_distributed_tpu.models.vae import (AutoencoderKL,
@@ -689,9 +718,12 @@ class TestGenerateOffloaded:
         spec = FlowSpec(height=16, width=16, steps=3)
         want = np.asarray(pipe.generate(build_mesh({"dp": 1}), spec, 5,
                                         ctx, pooled))
-        got = np.asarray(pipe.generate_offloaded(spec, 5, ctx, pooled,
-                                                 resident_bytes=0,
-                                                 stream_dtype="native"))
+        off = pipe.offload_executor(resident_bytes=resident_bytes,
+                                    stream_dtype="native")
+        assert bool(off.stacked) == bool(resident_bytes)
+        got = np.asarray(pipe.generate_offloaded(
+            spec, 5, ctx, pooled, resident_bytes=resident_bytes,
+            stream_dtype="native"))
         np.testing.assert_allclose(got, want, rtol=5e-4, atol=5e-4)
 
     def test_non_euler_raises(self):
