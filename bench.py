@@ -465,6 +465,84 @@ def _mem_available_gb() -> float:
     return 0.0
 
 
+def _probe_h2d_leak(dev) -> tuple[float, float]:
+    """Warm host→device bandwidth + RSS-leak ratio of ONE 256 MB put —
+    the tunneled IFRT-proxy client retains a host copy of every
+    device_put for the process lifetime (observed 1.05 GB RSS per GB);
+    real hosts measure ~0. Shared by every offload bench."""
+    import numpy as np
+
+    import jax
+
+    probe = np.ones((64, 1024, 1024), np.float32)      # 256 MB
+    a = jax.device_put(probe, dev)
+    a.block_until_ready()
+    a.delete()
+    rss0 = _rss_gb()
+    t0 = time.perf_counter()
+    b = jax.device_put(probe, dev)
+    b.block_until_ready()
+    h2d_gbps = 0.25 / (time.perf_counter() - t0)
+    b.delete()
+    leak_ratio = max(0.0, (_rss_gb() - rss0) / 0.25)
+    del probe, a, b
+    return h2d_gbps, leak_ratio
+
+
+def _affordable_forwards_or_raise(leak_ratio: float, param_bytes: int,
+                                  resident_bytes: int,
+                                  streamed_gb: float) -> float:
+    """Host-RAM budget under the put-leak, checked BEFORE any multi-GB
+    build: leave a 12 GB floor, reserve the flat block copies
+    (~param_bytes) and the leaked resident upload; the remainder funds
+    streamed forwards. Returns the affordable forward count (``inf``
+    when the transport doesn't leak or nothing streams); raises rather
+    than starting a run that would OOM the host. ONE budget model for
+    every offload bench (flux, wan14b)."""
+    if leak_ratio <= 0.5:
+        return float("inf")
+    headroom = max(0.0, _mem_available_gb() - 12.0 - param_bytes / 1e9)
+    upload_need = resident_bytes / 1e9 * (1.0 + leak_ratio)
+    if headroom < upload_need:
+        raise RuntimeError(
+            f"offload bench: transfer leak ({leak_ratio:.2f} GB RSS/GB)"
+            f" and only {_mem_available_gb():.0f} GB available — the "
+            f"{upload_need:.0f} GB resident upload itself would OOM the"
+            " host; refusing to start")
+    if streamed_gb <= 0.05:
+        return float("inf")
+    fwds = (headroom - upload_need) / max(streamed_gb, 0.5)
+    if fwds < 2:                             # can't even warmup + 1 step
+        raise RuntimeError(
+            f"offload bench: transfer leak ({leak_ratio:.2f} GB RSS/GB)"
+            f" and only {_mem_available_gb():.0f} GB available — fewer "
+            "than 2 affordable forwards; refusing to start a run that "
+            "would OOM the host")
+    return fwds
+
+
+def _extrapolate_steps(lat1: float, s1: int, lat2: float, s2: int,
+                       steps: int) -> tuple[float, float, dict]:
+    """Two-point per-step linear extrapolation (exact for the offload
+    ladders: every step streams identical bytes and runs the same
+    compiled program). Returns (median, per_step, derivation)."""
+    if s2 != s1:
+        per_step = (lat2 - lat1) / (s2 - s1)
+        overhead = max(0.0, lat1 - per_step * s1)
+    else:                                    # tightest budget: conservative
+        per_step, overhead = lat1 / s1, 0.0
+    median = overhead + per_step * steps
+    return median, per_step, {
+        "derived": True,
+        "measured_steps": [s1, s2],
+        "measured_latencies_s": [round(lat1, 2), round(lat2, 2)],
+        "fixed_overhead_s": round(overhead, 2),
+        "method": ("per-step linear extrapolation: every step streams "
+                   "identical bytes and runs the same compiled "
+                   "program(s)"),
+    }
+
+
 def _run_flux_offloaded(steps: int, runs: int | None, platform: str) -> dict:
     """FULL-depth FLUX.1 (19/38, 12B params) on ONE chip (VERDICT r3
     item #2 — replaces the half-depth surrogate). Under the default fp8
@@ -508,22 +586,9 @@ def _run_flux_offloaded(steps: int, runs: int | None, platform: str) -> dict:
     params = materialize_host_params(abstract, seed=0)
     param_bytes = tree_bytes(params)
 
-    # raw transport measurement (warm) + leak probe on the same put
     dev = jax.devices()[0]
-    import numpy as np
-    probe = np.ones((64, 1024, 1024), np.float32)      # 256 MB
-    a = jax.device_put(probe, dev)
-    a.block_until_ready()
-    a.delete()
-    rss0 = _rss_gb()
-    t0 = time.perf_counter()
-    b = jax.device_put(probe, dev)
-    b.block_until_ready()
-    h2d_gbps = 0.25 / (time.perf_counter() - t0)
-    b.delete()
-    leak_ratio = max(0.0, (_rss_gb() - rss0) / 0.25)
+    h2d_gbps, leak_ratio = _probe_h2d_leak(dev)
     leak = leak_ratio > 0.5
-    del probe, a, b
 
     print("[bench] flux-offload: building pipeline", file=sys.stderr,
           flush=True)
@@ -545,26 +610,9 @@ def _run_flux_offloaded(steps: int, runs: int | None, platform: str) -> dict:
     # recomputing would double-count them): leave a 12 GB floor so the
     # host never OOMs again, and reserve the flat block copies
     # (~param_bytes of host numpy).
-    budget_fwds = None
-    if leak:
-        headroom = max(0.0, _mem_available_gb() - 12.0 - param_bytes / 1e9)
-        # the one-time resident upload leaks too (stack host copies +
-        # 1:1 RSS per GB put) — refuse before paying it
-        upload_need = plan["resident_bytes"] / 1e9 * (1.0 + leak_ratio)
-        if headroom < upload_need:
-            raise RuntimeError(
-                f"flux-offload: transfer leak ({leak_ratio:.2f} GB "
-                f"RSS/GB) and only {_mem_available_gb():.0f} GB "
-                f"available — the {upload_need:.0f} GB resident upload "
-                "itself would OOM the host; refusing to start")
-        if streamed > 0:
-            budget_fwds = int((headroom - upload_need) / streamed_gb)
-            if budget_fwds < 2:              # can't even warmup + 1 step
-                raise RuntimeError(
-                    f"flux-offload: transfer leak ({leak_ratio:.2f} GB "
-                    f"RSS/GB) and only {_mem_available_gb():.0f} GB "
-                    "available — fewer than 2 affordable forwards; "
-                    "refusing to start a run that would OOM the host")
+    budget_fwds = _affordable_forwards_or_raise(
+        leak_ratio, param_bytes, plan["resident_bytes"],
+        streamed_gb if streamed > 0 else 0.0)
 
     # the PRODUCT path end-to-end: generate_offloaded builds + caches the
     # streamed executor, so the bench measures exactly what users run.
@@ -612,22 +660,9 @@ def _run_flux_offloaded(steps: int, runs: int | None, platform: str) -> dict:
         compile_s = time.perf_counter() - t0
         lat1 = one_image(1, s1)
         lat2 = one_image(2, s2) if s2 != s1 else lat1
-        if s2 != s1:
-            per_step = (lat2 - lat1) / (s2 - s1)
-            overhead = max(0.0, lat1 - per_step * s1)
-        else:                              # tightest budget: conservative
-            per_step, overhead = lat1 / s1, 0.0
-        median = overhead + per_step * steps
+        median, per_step, derivation = _extrapolate_steps(
+            lat1, s1, lat2, s2, steps)
         times = [lat1, lat2]
-        derivation = {
-            "derived": True,
-            "measured_steps": [s1, s2],
-            "measured_latencies_s": [round(lat1, 2), round(lat2, 2)],
-            "fixed_overhead_s": round(overhead, 2),
-            "method": ("per-step linear extrapolation: the python-level "
-                       "euler ladder streams identical bytes and runs "
-                       "the same compiled programs every step"),
-        }
     else:
         print("[bench] flux-offload: warmup image (compiles + first "
               "stream)", file=sys.stderr, flush=True)
@@ -747,11 +782,151 @@ def run_wan_benchmark(steps: int, runs: int | None, force_cpu: bool) -> dict:
     }
 
 
+def run_wan14b_benchmark(steps: int, runs: int | None,
+                         force_cpu: bool) -> dict:
+    """WAN-2.1 **14B** t2v on ONE chip via the quantized offload
+    executor (``diffusion/offload.OffloadedWan``) — the capability
+    artifact for 'a 28 GB-bf16 expert on a 16 GB chip'. fp8(e4m3)
+    residency holds ≥90% of the blocks in HBM (13 GB default budget);
+    the overflow streams per step, so on a leaky tunneled host the
+    latency is measured at two small step counts and extrapolated
+    per-step (exact: the ladder streams identical bytes and runs the
+    same program every step)."""
+    import dataclasses
+
+    import jax
+    import jax.numpy as jnp
+
+    if force_cpu:
+        jax.config.update("jax_platforms", "cpu")
+    _enable_compile_cache()
+    platform = jax.devices()[0].platform
+    on_accel = platform not in ("cpu",)
+
+    from comfyui_distributed_tpu.diffusion.offload import (
+        materialize_host_params, plan_offload, resident_budget_bytes,
+        tree_bytes, _WAN_GLUE_KEYS)
+    from comfyui_distributed_tpu.diffusion.pipeline_video import (
+        VideoPipeline, VideoSpec)
+    from comfyui_distributed_tpu.models.wan import WanConfig, init_wan
+    from comfyui_distributed_tpu.models.wan_vae import (WanVAE3D,
+                                                        WanVAEConfig)
+
+    if on_accel:
+        cfg, vae_cfg = WanConfig.wan_14b(), WanVAEConfig.wan()
+        spec = VideoSpec(frames=33, height=480, width=832, steps=steps)
+        ctx_len = 512
+    else:                      # CI-exercisable tiny path
+        cfg, vae_cfg = WanConfig.tiny(), WanVAEConfig.tiny()
+        spec = VideoSpec(frames=5, height=16, width=16,
+                         steps=min(steps, 2))
+        ctx_len = 16
+
+    vae = WanVAE3D(vae_cfg).init(jax.random.key(1), frames=5,
+                                 image_hw=(vae_cfg.downscale * 4,) * 2)
+    f_lat = vae_cfg.latent_frames(spec.padded_frames)
+    print(f"[bench] wan14b: materializing {cfg.dim}-dim "
+          f"{cfg.num_layers}-layer host params", file=sys.stderr,
+          flush=True)
+    model, abstract = init_wan(
+        cfg, jax.random.key(0),
+        sample_fhw=(f_lat, spec.height // vae_cfg.downscale,
+                    spec.width // vae_cfg.downscale),
+        context_len=ctx_len, abstract=True,
+        param_dtype=jnp.bfloat16 if on_accel else None)
+    params = materialize_host_params(abstract, seed=0)
+    param_bytes = tree_bytes(params)
+    plan = plan_offload(params, resident_budget_bytes(),
+                        block_prefixes=("block",),
+                        glue_keys=_WAN_GLUE_KEYS)
+    streamed_gb = plan["streamed_bytes"] / 1e9
+    if on_accel:
+        # same leaky-transport discipline as _run_flux_offloaded:
+        # probe, then refuse BEFORE paying the multi-GB quantize +
+        # upload (warmup + measurement stream 16 step-forwards total)
+        _, leak_ratio = _probe_h2d_leak(jax.devices()[0])
+        # warmup (s1 + s2 steps) + two measured videos of s1/s2 steps
+        fwds_needed = 2 * (2 + 6)
+        budget = _affordable_forwards_or_raise(
+            leak_ratio, param_bytes, plan["resident_bytes"], streamed_gb)
+        if budget < fwds_needed:
+            raise RuntimeError(
+                f"wan14b: only {budget:.0f} affordable streamed "
+                f"forwards under the transfer leak; need {fwds_needed}")
+    pipe = VideoPipeline(model, params, vae)
+    ctx = jnp.zeros((1, ctx_len, cfg.text_dim))
+
+    def one_video(seed, n_steps):
+        sp = dataclasses.replace(spec, steps=n_steps)
+        t0 = time.perf_counter()
+        jax.block_until_ready(pipe.generate_offloaded(sp, seed, ctx))
+        return time.perf_counter() - t0
+
+    print(f"[bench] wan14b: {param_bytes/1e9:.1f} GB params, plan: "
+          f"{plan['resident_bytes']/1e9:.1f} GB resident / "
+          f"{streamed_gb:.1f} GB streamed per step", file=sys.stderr,
+          flush=True)
+    derived = on_accel and streamed_gb > 0.05
+    # the resident ladder compiles per sigma-ladder LENGTH (scan over
+    # steps) — warm up at exactly the step counts that get timed
+    s1, s2 = 2, 6
+    t0 = time.perf_counter()
+    if derived:
+        one_video(0, s1)            # upload + compiles
+        one_video(0, s2)
+    else:
+        one_video(0, spec.steps)
+    compile_s = time.perf_counter() - t0
+    if derived:
+        # leaky-transport discipline (see _run_flux_offloaded): measure
+        # two small step counts, derive the requested-step latency from
+        # exact per-step linearity
+        lat1, lat2 = one_video(1, s1), one_video(2, s2)
+        median, per_step, derivation = _extrapolate_steps(
+            lat1, s1, lat2, s2, spec.steps)
+        times = [lat1, lat2]
+    else:
+        runs = runs or 2
+        times, median = _timed_runs(
+            lambda i: one_video(i + 1, spec.steps), runs)
+        per_step = median / spec.steps
+        derivation = {"derived": False}
+
+    off = pipe.offload_executor()
+    return {
+        "metric": (f"wan14b_t2v_33f_480x832_{spec.steps}step_wall_s"
+                   if on_accel else "wan14b_tiny_wall_s_cpu"),
+        "value": round(median, 2),
+        "unit": "seconds",
+        "vs_baseline": 1.0,
+        "vs_baseline_note": "reference publishes no numbers",
+        "platform": platform,
+        "device_kind": jax.devices()[0].device_kind,
+        "devices": 1, "steps": spec.steps,
+        "per_step_s": round(per_step, 2),
+        "compile_s": round(compile_s, 1),
+        "run_times_s": [round(t, 2) for t in times],
+        "param_bytes": param_bytes,
+        "resident_bytes": off.resident_bytes,
+        "streamed_bytes_per_step": (tree_bytes(off.streamed)
+                                    if off.streamed else 0),
+        "stream_dtype": off.stream_dtype,
+        "fully_resident": bool(off.stacked),
+        **derivation,
+        "note": ("WAN 14B t2v (28 GB bf16 params — ~2x one chip's HBM) "
+                 "on ONE chip via fp8(e4m3) weight residency; blocks "
+                 "past the budget stream per step. Pods run dp x tp "
+                 "instead; the WAN-2.2 dual-expert pair adds one HBM "
+                 "swap per video."),
+    }
+
+
 _WORKLOADS = {
     "txt2img": run_benchmark,
     "usdu": run_usdu_benchmark,
     "flux": run_flux_benchmark,
     "wan": run_wan_benchmark,
+    "wan14b": run_wan14b_benchmark,
 }
 
 
@@ -871,7 +1046,8 @@ def main() -> None:
     parser.add_argument("--steps", type=int, default=30)
     parser.add_argument("--runs", type=int, default=None)
     parser.add_argument("--workload",
-                        choices=["txt2img", "usdu", "flux", "wan"],
+                        choices=["txt2img", "usdu", "flux", "wan",
+                                 "wan14b"],
                         default="txt2img",
                         help="txt2img (SDXL images/sec), usdu (4K upscale "
                              "wall-clock), flux (flow images/sec), wan "

@@ -14,6 +14,13 @@ import sys
 
 def cmd_serve(args: argparse.Namespace) -> None:
     from .parallel.bootstrap import init_multihost
+    from .utils.compile_cache import enable_compile_cache
+
+    # persistent XLA compile cache BEFORE the first trace: full-scale
+    # sampler/ladder programs take minutes to compile (the offload
+    # ladders recompile per sigma-ladder length) — a server restart or
+    # step-count change must not re-pay compiles it has already done
+    enable_compile_cache()
 
     # must precede any jax device query (backend freezes on first touch);
     # no-op without a coordinator (single host)

@@ -1,0 +1,45 @@
+"""Persistent XLA compilation cache for the product server.
+
+Full-scale programs here are expensive to compile — the SDXL 30-step
+sampler scan is ~1 min on a v5e, and the offloaded one-jit ladders
+(``diffusion/offload.py``) retrace per sigma-ladder LENGTH, so a user
+changing ``steps`` from 30 to 25 pays a fresh full-model compile.
+``bench.py`` has always enabled jax's persistent cache for itself; the
+server gets the same treatment so restarts and step-count changes hit
+disk instead of the compiler.
+
+Reference analogue: ComfyUI relies on torch CUDA kernels being
+pre-built, so its server has no compile-latency problem to manage; an
+XLA-based server does, and this is the standard jax answer.
+
+Knobs: ``CDT_COMPILE_CACHE_DIR`` (default
+``~/.cache/comfyui_distributed_tpu/xla``; empty string disables).
+"""
+
+from __future__ import annotations
+
+import os
+
+_DEFAULT = os.path.join(os.path.expanduser("~"), ".cache",
+                        "comfyui_distributed_tpu", "xla")
+
+
+def enable_compile_cache(path: str | None = None) -> str | None:
+    """Point jax's persistent compilation cache at ``path`` (or the
+    ``CDT_COMPILE_CACHE_DIR``/default location). Never fatal: an
+    unwritable directory just leaves caching off. Returns the directory
+    in use, or None when disabled/unavailable."""
+    d = path if path is not None else os.environ.get(
+        "CDT_COMPILE_CACHE_DIR", _DEFAULT)
+    if not d:
+        return None
+    try:
+        os.makedirs(d, exist_ok=True)
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", d)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          1.0)
+        return d
+    except Exception:  # noqa: BLE001 — degrade, don't crash the server
+        return None

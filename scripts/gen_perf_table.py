@@ -24,7 +24,9 @@ ROOT = Path(__file__).resolve().parent.parent
 START, END = "<!-- PERF_TABLE_START -->", "<!-- PERF_TABLE_END -->"
 
 # benchmark file suffix → stable row order
-WORKLOADS = ["tpu", "tpu_usdu", "tpu_wan", "tpu_flux"]
+WORKLOADS = ["tpu", "tpu_usdu", "tpu_wan", "tpu_flux", "tpu_wan14b"]
+# wan14b is an extra capability artifact — its absence is not an error
+OPTIONAL_WORKLOADS = {"tpu_wan14b"}
 
 
 def newest_artifacts() -> dict[str, tuple[int, dict]]:
@@ -97,8 +99,18 @@ def _row_flux(rnd: int, a: dict) -> str:
             f"— pods run it dp×tp — r{rnd:02d} |")
 
 
+def _row_wan14b(rnd: int, a: dict) -> str:
+    res = a.get("resident_bytes", 0) / 1e9
+    streamed = a.get("streamed_bytes_per_step", 0) / 1e9
+    return (f"| WAN-2.1 **14B** t2v, 33 frames 480×832, "
+            f"{a['steps']} steps, single chip | **{a['value']:.0f} s** "
+            f"({a.get('per_step_s', 0):.1f} s/step) | 28 GB bf16 expert "
+            f"on one 16 GB chip: {res:.1f} GB fp8-resident, "
+            f"{streamed:.1f} GB/step streamed — r{rnd:02d} |")
+
+
 ROWS = {"tpu": _row_txt2img, "tpu_usdu": _row_usdu, "tpu_wan": _row_wan,
-        "tpu_flux": _row_flux}
+        "tpu_flux": _row_flux, "tpu_wan14b": _row_wan14b}
 
 
 def render_table() -> str:

@@ -87,6 +87,8 @@ def main() -> None:
         ("usdu", os.path.join(bdir, f"r{cli.round:02d}_tpu_usdu.json")),
         ("flux", os.path.join(bdir, f"r{cli.round:02d}_tpu_flux.json")),
         ("wan", os.path.join(bdir, f"r{cli.round:02d}_tpu_wan.json")),
+        ("wan14b",
+         os.path.join(bdir, f"r{cli.round:02d}_tpu_wan14b.json")),
     ]
     start = time.monotonic()
     while time.monotonic() - start < cli.budget_s:

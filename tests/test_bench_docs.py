@@ -24,7 +24,8 @@ def test_perf_tables_match_artifacts():
 
 def test_every_workload_has_an_artifact():
     arts = gpt.newest_artifacts()
-    missing = [w for w in gpt.WORKLOADS if w not in arts]
+    missing = [w for w in gpt.WORKLOADS
+               if w not in arts and w not in gpt.OPTIONAL_WORKLOADS]
     assert not missing, f"no TPU artifact ever captured for: {missing}"
 
 

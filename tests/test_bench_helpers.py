@@ -1,0 +1,94 @@
+"""Unit tests for bench.py's shared offload-bench helpers (r04: the
+leak budget and two-point extrapolation previously lived as diverging
+copies in the flux and wan14b benches) and the server compile cache."""
+
+import importlib.util
+from pathlib import Path
+
+import pytest
+
+ROOT = Path(__file__).resolve().parent.parent
+
+_spec = importlib.util.spec_from_file_location("bench", ROOT / "bench.py")
+bench = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(bench)
+
+
+class TestExtrapolateSteps:
+    def test_linear_two_point(self):
+        # 2 steps -> 10 s, 6 steps -> 22 s: 3 s/step + 4 s overhead
+        median, per_step, d = bench._extrapolate_steps(10.0, 2, 22.0, 6,
+                                                       30)
+        assert per_step == pytest.approx(3.0)
+        assert median == pytest.approx(4.0 + 3.0 * 30)
+        assert d["derived"] and d["measured_steps"] == [2, 6]
+        assert d["fixed_overhead_s"] == pytest.approx(4.0)
+
+    def test_degenerate_single_point_is_conservative(self):
+        median, per_step, d = bench._extrapolate_steps(10.0, 2, 10.0, 2,
+                                                       30)
+        assert per_step == pytest.approx(5.0)   # overhead folded in
+        assert median == pytest.approx(150.0)
+
+    def test_overhead_never_negative(self):
+        _, per_step, d = bench._extrapolate_steps(1.0, 1, 10.0, 2, 30)
+        assert d["fixed_overhead_s"] == 0.0
+        assert per_step == pytest.approx(9.0)
+
+
+class TestAffordableForwards:
+    def test_no_leak_is_unbounded(self):
+        assert bench._affordable_forwards_or_raise(
+            0.0, 10 ** 9, 10 ** 9, 100.0) == float("inf")
+
+    def test_upload_alone_can_refuse(self, monkeypatch):
+        monkeypatch.setattr(bench, "_mem_available_gb", lambda: 20.0)
+        with pytest.raises(RuntimeError, match="upload"):
+            bench._affordable_forwards_or_raise(
+                1.0, int(4e9), int(12e9), 1.0)
+
+    def test_streamed_budget(self, monkeypatch):
+        monkeypatch.setattr(bench, "_mem_available_gb", lambda: 100.0)
+        # headroom 100-12-4=84; upload 12*2=24; (84-24)/2 = 30 forwards
+        fwds = bench._affordable_forwards_or_raise(
+            1.0, int(4e9), int(12e9), 2.0)
+        assert fwds == pytest.approx(30.0)
+
+    def test_fewer_than_two_forwards_refuses(self, monkeypatch):
+        monkeypatch.setattr(bench, "_mem_available_gb", lambda: 40.0)
+        with pytest.raises(RuntimeError, match="fewer than 2"):
+            bench._affordable_forwards_or_raise(
+                1.0, int(4e9), int(12e9), 20.0)
+
+    def test_fully_resident_streams_nothing(self, monkeypatch):
+        monkeypatch.setattr(bench, "_mem_available_gb", lambda: 100.0)
+        assert bench._affordable_forwards_or_raise(
+            1.0, int(4e9), int(12e9), 0.0) == float("inf")
+
+
+class TestCompileCache:
+    def test_enable_and_disable(self, tmp_path, monkeypatch):
+        from comfyui_distributed_tpu.utils.compile_cache import \
+            enable_compile_cache
+
+        d = enable_compile_cache(str(tmp_path / "xla"))
+        assert d == str(tmp_path / "xla")
+        import jax
+
+        assert jax.config.jax_compilation_cache_dir == d
+        monkeypatch.setenv("CDT_COMPILE_CACHE_DIR", "")
+        assert enable_compile_cache() is None
+
+    def test_unwritable_never_fatal(self, tmp_path):
+        from comfyui_distributed_tpu.utils.compile_cache import \
+            enable_compile_cache
+
+        ro = tmp_path / "ro"
+        ro.mkdir()
+        ro.chmod(0o500)
+        try:
+            # root bypasses the permission bit, so accept either outcome
+            # — the contract is only "never raises"
+            enable_compile_cache(str(ro / "sub" / "cache"))
+        finally:
+            ro.chmod(0o700)
