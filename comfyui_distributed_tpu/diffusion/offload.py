@@ -92,6 +92,23 @@ def stream_dtype_default() -> str:
     return os.environ.get("CDT_OFFLOAD_STREAM_DTYPE", _F8)
 
 
+def ladder_mode() -> str:
+    """How a FULLY-RESIDENT offloaded sample runs its sigma ladder:
+
+    - ``"jit"`` (default): the whole ladder is ONE compiled program —
+      fastest (no per-step dispatch), but not interruptible mid-run and
+      recompiled per distinct step count;
+    - ``"step"``: python loop over the single-forward program — one
+      dispatch per step (µs on a real host), responsive to
+      ``/distributed/interrupt`` between steps, no per-step-count
+      recompiles. Streamed (partially-resident) executors always run
+      per step."""
+    v = os.environ.get("CDT_OFFLOAD_LADDER", "jit")
+    if v not in ("jit", "step"):
+        raise ValueError(f"CDT_OFFLOAD_LADDER={v!r} (use 'jit' or 'step')")
+    return v
+
+
 def normalize_stream_dtype(sd: Optional[str]) -> str:
     """Canonical stream-dtype name — ONE definition, shared by the
     executor and every cache key built over it (aliased spellings must
@@ -1027,17 +1044,33 @@ class _WanEmbed(nn.Module):
         return tok, e0, e, ctx
 
 
-def sample_euler_py(denoise, x, sigmas, on_step=None) -> jax.Array:
+def sample_euler_py(denoise, x, sigmas, on_step=None,
+                    should_stop=None) -> jax.Array:
     """Python-level Euler ladder (exact math of ``samplers.sample``'s
-    euler branch — pinned by tests). The offloaded denoiser cannot live
-    inside a ``lax.scan``, so the loop runs host-side; for 20-50 steps
-    the per-step dispatch cost is noise next to block streaming.
-    ``on_step(sigma, x0)`` fires once per step with the denoised
-    estimate — the host-side twin of the compiled samplers' in-trace
-    progress callback (``cluster/progress.ProgressTracker.report``)."""
+    euler branch — pinned by tests). The streamed offloaded denoiser
+    cannot live inside a ``lax.scan``, so the loop runs host-side; for
+    20-50 steps the per-step dispatch cost is noise next to block
+    streaming. ``on_step(sigma, x0)`` fires once per step with the
+    denoised estimate — the host-side twin of the compiled samplers'
+    in-trace progress callback
+    (``cluster/progress.ProgressTracker.report``). ``should_stop()`` is
+    checked before every step (the server's ``/distributed/interrupt``
+    — the reference likewise interrupts between steps, not inside a
+    dispatched kernel) and raises ``InterruptedError``."""
     sig = np.asarray(sigmas, np.float64)
     for i in range(len(sig) - 1):
+        if should_stop is not None and should_stop():
+            raise InterruptedError(
+                f"offloaded sampling interrupted at step {i}/"
+                f"{len(sig) - 1}")
         x0 = denoise(x, jnp.asarray(sig[i], jnp.float32))
+        if should_stop is not None:
+            # interruptibility requires per-step SYNCHRONIZATION: jax
+            # dispatch is async, so without this block the loop would
+            # enqueue the whole ladder in milliseconds and every
+            # should_stop() check would pass before any device compute
+            # ran (the check would be theater)
+            jax.block_until_ready(x0)
         if on_step is not None:
             on_step(float(sig[i]), x0)
         if sig[i + 1] == 0.0:

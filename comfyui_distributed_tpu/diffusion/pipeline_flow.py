@@ -184,7 +184,8 @@ class FlowPipeline:
                            params=None,
                            resident_bytes: Optional[int] = None,
                            stream_dtype: Optional[str] = None,
-                           on_step=None, progress_token=None) -> jax.Array:
+                           on_step=None, progress_token=None,
+                           should_stop=None) -> jax.Array:
         """ONE image on ONE device with weights beyond the HBM budget
         held host-side (``diffusion/offload.py``) — the single-chip
         answer to FLUX-12B's 24 GB of bf16 weights (CDT_OFFLOAD; dp×tp
@@ -215,15 +216,21 @@ class FlowPipeline:
         x = jax.random.normal(
             key, (1, lat_h, lat_w, self.dit.config.in_channels),
             jnp.float32)
-        if off.stacked:
+        from .offload import ladder_mode
+
+        if off.stacked and ladder_mode() == "jit":
             g = jnp.full((context.shape[0],), float(spec.guidance))
             x0 = off.sample_euler_resident(
                 x, sigmas, context, pooled, g,
                 progress_token=progress_token)
         else:
+            # per-step loop: streamed executors, or CDT_OFFLOAD_LADDER=
+            # step (interruptible serving) — resident executors still
+            # run one fused program per forward
             den = off.denoiser(context, pooled, spec.guidance)
             x0 = sample_euler_py(den, jax.device_put(x, off.device),
-                                 sigmas, on_step=on_step)
+                                 sigmas, on_step=on_step,
+                                 should_stop=should_stop)
         images = self.vae.decode(x0)
         return jnp.clip(images / 2.0 + 0.5, 0.0, 1.0)
 

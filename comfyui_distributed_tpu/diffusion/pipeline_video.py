@@ -263,7 +263,8 @@ class VideoPipeline:
                            resident_bytes: Optional[int] = None,
                            stream_dtype: Optional[str] = None,
                            on_step=None,
-                           progress_token=None) -> jax.Array:
+                           progress_token=None,
+                           should_stop=None) -> jax.Array:
         """ONE t2v video on ONE device with quantized/streamed expert
         weights (``diffusion/offload.py:OffloadedWan``) — the
         single-chip answer to WAN-14B's 28 GB-per-expert (×2 for the
@@ -277,7 +278,7 @@ class VideoPipeline:
         return self._offloaded_sample(
             spec, seed, context, None, None,
             self.dit.config.in_channels, resident_bytes, stream_dtype,
-            on_step, progress_token)
+            on_step, progress_token, should_stop)
 
     def generate_offloaded_i2v(self, spec: VideoSpec, seed: int,
                                image: jax.Array, context: jax.Array,
@@ -285,7 +286,8 @@ class VideoPipeline:
                                resident_bytes: Optional[int] = None,
                                stream_dtype: Optional[str] = None,
                                on_step=None,
-                           progress_token=None) -> jax.Array:
+                           progress_token=None,
+                           should_stop=None) -> jax.Array:
         """Offloaded i2v: the same quantized-resident ladder with the
         first-frame conditioning concat (``i2v_condition`` → mask+y)
         applied per model call, exactly like ``_denoiser_i2v``."""
@@ -297,13 +299,14 @@ class VideoPipeline:
                     self.dit.config.in_channels)
         return self._offloaded_sample(spec, seed, context, y, mask, c,
                                       resident_bytes, stream_dtype,
-                                      on_step, progress_token)
+                                      on_step, progress_token,
+                                      should_stop)
 
     def _offloaded_sample(self, spec: VideoSpec, seed: int, context,
                           y, mask, lat_channels: int, resident_bytes,
-                          stream_dtype, on_step,
-                          progress_token=None) -> jax.Array:
-        from .offload import sample_euler_py
+                          stream_dtype, on_step, progress_token=None,
+                          should_stop=None) -> jax.Array:
+        from .offload import ladder_mode, sample_euler_py
 
         if spec.sampler != "euler":
             raise ValueError(
@@ -322,7 +325,7 @@ class VideoPipeline:
         def run(which, x0, sig):
             off = self.offload_executor(which, resident_bytes,
                                         stream_dtype)
-            if off.stacked:
+            if off.stacked and ladder_mode() == "jit":
                 # fully resident: the whole segment ladder is ONE
                 # compiled program (in-trace progress via the token)
                 return off.sample_euler_resident(
@@ -332,7 +335,8 @@ class VideoPipeline:
             den = off.denoiser(context, spec.guidance_scale,
                                inp_fn=inp_fn)
             return sample_euler_py(den, jax.device_put(x0, off.device),
-                                   sig, on_step=on_step)
+                                   sig, on_step=on_step,
+                                   should_stop=should_stop)
 
         if not self.is_moe:
             x0 = run("high", x, sigmas)
@@ -346,6 +350,13 @@ class VideoPipeline:
             else:
                 x_mid = run("high", x, sigmas[: split + 1])
                 jax.block_until_ready(x_mid)
+                if should_stop is not None and should_stop():
+                    # free host-side boundary — honor an interrupt here
+                    # even in jit ladder mode rather than uploading +
+                    # running the whole low-expert segment first
+                    raise InterruptedError(
+                        "offloaded MoE sampling interrupted at the "
+                        "expert boundary")
                 self._evict_offload("high")     # HBM for the low expert
                 x0 = run("low", x_mid, sigmas[split:])
         return self.decode_frames(x0)

@@ -429,6 +429,12 @@ def _journal_key(images, spec, seed: int, index: int = 0,
     return f"usdu_{h.hexdigest()[:20]}"
 
 
+def _stop_cb(interrupt_event):
+    """should_stop callable for the offloaded python ladders — ONE
+    definition for every offload-capable sampler node."""
+    return interrupt_event.is_set if interrupt_event is not None else None
+
+
 class _ProgressScope:
     """Progress lifecycle shared by the sampler nodes: allocates a token
     on entry; ``complete(out)`` blocks on the result AND drains pending
@@ -1054,13 +1060,15 @@ class TPUFlowTxt2Img(NodeDef):
         "guidance": "FLOAT", "shift": "FLOAT", "mode": "STRING",
         "batch_per_device": "INT",
     }
-    HIDDEN = {"mesh": "*", "prompt_id": "STRING", "progress_tracker": "*"}
+    HIDDEN = {"mesh": "*", "prompt_id": "STRING", "progress_tracker": "*",
+              "interrupt_event": "*"}
     RETURNS = ("IMAGE",)
 
     def execute(self, model, positive, seed: int, steps: int, width: int,
                 height: int, guidance: float = 3.5, shift: float = 3.0,
                 mode: str = "dp", batch_per_device: int = 1, mesh=None,
-                prompt_id: str = "", progress_tracker=None, **_):
+                prompt_id: str = "", progress_tracker=None,
+                interrupt_event=None, **_):
         from ..diffusion.pipeline_flow import FlowSpec
         from ..parallel.mesh import build_mesh
 
@@ -1088,7 +1096,8 @@ class TPUFlowTxt2Img(NodeDef):
                                             spec.steps)) as ps:
                 images = model.pipeline.generate_offloaded(
                     spec, int(seed), ctx, pooled, on_step=ps.on_step,
-                    progress_token=ps.token)
+                    progress_token=ps.token,
+                    should_stop=_stop_cb(interrupt_event))
                 ps.complete(images)
         elif mode == "sp":
             from jax.sharding import Mesh
@@ -1151,13 +1160,15 @@ class TPUTxt2Video(NodeDef):
         "width": "INT", "height": "INT",
     }
     OPTIONAL = {"cfg": "FLOAT", "shift": "FLOAT", "mode": "STRING"}
-    HIDDEN = {"mesh": "*", "prompt_id": "STRING", "progress_tracker": "*"}
+    HIDDEN = {"mesh": "*", "prompt_id": "STRING", "progress_tracker": "*",
+              "interrupt_event": "*"}
     RETURNS = ("IMAGE",)
 
     def execute(self, model, positive, seed: int, frames: int, steps: int,
                 width: int, height: int, cfg: float = 1.0,
                 shift: float = 3.0, mode: str = "dp", mesh=None,
-                prompt_id: str = "", progress_tracker=None, **_):
+                prompt_id: str = "", progress_tracker=None,
+                interrupt_event=None, **_):
         from ..diffusion.pipeline_video import VideoSpec
         from ..diffusion.progress import total_calls
         from ..parallel.mesh import build_mesh
@@ -1184,7 +1195,8 @@ class TPUTxt2Video(NodeDef):
                 # host-side via ps.on_step when streaming.
                 videos = model.pipeline.generate_offloaded(
                     spec, int(seed), ctx, on_step=ps.on_step,
-                    progress_token=ps.token)
+                    progress_token=ps.token,
+                    should_stop=_stop_cb(interrupt_event))
             elif mode == "sp":
                 if "sp" not in mesh.shape:
                     mesh = build_mesh({"sp": mesh.devices.size},
@@ -1212,13 +1224,14 @@ class TPUImg2Video(NodeDef):
         "seed": "INT", "frames": "INT", "steps": "INT",
     }
     OPTIONAL = {"cfg": "FLOAT", "shift": "FLOAT", "mode": "STRING"}
-    HIDDEN = {"mesh": "*", "prompt_id": "STRING", "progress_tracker": "*"}
+    HIDDEN = {"mesh": "*", "prompt_id": "STRING", "progress_tracker": "*",
+              "interrupt_event": "*"}
     RETURNS = ("IMAGE",)
 
     def execute(self, model, positive, image, seed: int, frames: int,
                 steps: int, cfg: float = 1.0, shift: float = 3.0,
                 mode: str = "dp", mesh=None, prompt_id: str = "",
-                progress_tracker=None, **_):
+                progress_tracker=None, interrupt_event=None, **_):
         from ..diffusion.pipeline_video import VideoSpec
         from ..diffusion.progress import total_calls
         from ..parallel.mesh import build_mesh
@@ -1249,7 +1262,8 @@ class TPUImg2Video(NodeDef):
             if mode == "offload" or (mode == "dp" and offload_enabled()):
                 videos = model.pipeline.generate_offloaded_i2v(
                     spec, int(seed), image[:1], ctx, on_step=ps.on_step,
-                    progress_token=ps.token)
+                    progress_token=ps.token,
+                    should_stop=_stop_cb(interrupt_event))
             elif mode == "sp":
                 if "sp" not in mesh.shape:
                     mesh = build_mesh({"sp": mesh.devices.size},

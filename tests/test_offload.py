@@ -683,6 +683,87 @@ class TestGenerateOffloadedVideo:
                 jnp.zeros((2, 6, model.config.text_dim)))
 
 
+class TestInterruptAndLadderMode:
+    """r04: offloaded sampling honors /distributed/interrupt between
+    steps (CDT_OFFLOAD_LADDER=step keeps fully-resident runs on the
+    interruptible per-step loop; 'jit' — the default — trades that for
+    a single compiled ladder)."""
+
+    def test_should_stop_raises_between_steps(self):
+        from comfyui_distributed_tpu.diffusion import sigmas_flow
+
+        calls = []
+
+        def den(x, s):
+            calls.append(1)
+            return x * 0.5
+
+        x = jnp.ones((1, 4, 4, 2))
+        with pytest.raises(InterruptedError, match="interrupted at step"):
+            sample_euler_py(den, x, sigmas_flow(6, 1.0),
+                            should_stop=lambda: len(calls) >= 2)
+        assert len(calls) == 2          # stopped before the third step
+
+    def test_ladder_mode_env(self, monkeypatch):
+        from comfyui_distributed_tpu.diffusion.offload import ladder_mode
+
+        monkeypatch.delenv("CDT_OFFLOAD_LADDER", raising=False)
+        assert ladder_mode() == "jit"
+        monkeypatch.setenv("CDT_OFFLOAD_LADDER", "step")
+        assert ladder_mode() == "step"
+        monkeypatch.setenv("CDT_OFFLOAD_LADDER", "bogus")
+        with pytest.raises(ValueError, match="LADDER"):
+            ladder_mode()
+
+    def test_step_mode_resident_still_equals_dp(self, monkeypatch):
+        """CDT_OFFLOAD_LADDER=step on a fully-resident executor runs the
+        python loop over the fused forward — same numbers as dp."""
+        from comfyui_distributed_tpu.diffusion.pipeline_flow import (
+            FlowPipeline, FlowSpec)
+        from comfyui_distributed_tpu.models.vae import (AutoencoderKL,
+                                                        VAEConfig)
+        from comfyui_distributed_tpu.parallel import build_mesh
+
+        monkeypatch.setenv("CDT_OFFLOAD_LADDER", "step")
+        cfg = DiTConfig.tiny(pos_embed="rope")
+        model, params = init_dit(cfg, jax.random.key(0), sample_hw=(8, 8),
+                                 context_len=6)
+        vae = AutoencoderKL(VAEConfig.tiny(dtype="float32")).init(
+            jax.random.key(1), image_hw=(16, 16))
+        pipe = FlowPipeline(model, params, vae)
+        ctx = jnp.ones((1, 6, cfg.context_dim)) * 0.1
+        pooled = jnp.ones((1, cfg.pooled_dim)) * 0.2
+        spec = FlowSpec(height=16, width=16, steps=3)
+        want = np.asarray(pipe.generate(build_mesh({"dp": 1}), spec, 5,
+                                        ctx, pooled))
+        got = np.asarray(pipe.generate_offloaded(
+            spec, 5, ctx, pooled, resident_bytes=1 << 40,
+            stream_dtype="native"))
+        np.testing.assert_allclose(got, want, rtol=5e-4, atol=5e-4)
+
+    def test_node_interrupt_mid_offload(self, tmp_config, monkeypatch):
+        """A set interrupt_event + step-mode ladder aborts the offloaded
+        node with InterruptedError (the executor surfaces it like its
+        own between-node check)."""
+        import threading
+
+        from comfyui_distributed_tpu.graph.node import get_node
+        from comfyui_distributed_tpu.models.registry import (PRESETS,
+                                                             ModelBundle)
+
+        monkeypatch.setenv("CDT_OFFLOAD_LADDER", "step")
+        monkeypatch.delenv("CDT_OFFLOAD", raising=False)
+        ev = threading.Event()
+        ev.set()
+        bundle = ModelBundle(PRESETS["flux-tiny"])
+        ctx, pooled = bundle.text_encoder.encode(["stop me"])
+        with pytest.raises(InterruptedError):
+            get_node("TPUFlowTxt2Img")().execute(
+                bundle, {"context": ctx, "pooled": pooled},
+                seed=1, steps=3, width=16, height=16, mode="offload",
+                interrupt_event=ev)
+
+
 class TestEulerLadder:
     def test_matches_scan_sampler(self):
         from comfyui_distributed_tpu.diffusion import sample, sigmas_flow
