@@ -61,15 +61,31 @@ def tail_file(path: Path, max_bytes: int = 64 * 1024) -> str:
 
 
 def register(router, controller) -> None:
+    from ..utils.deadline import deadline_call
+
+    _DEGRADED = [{"error": "device backend unresponsive"}]
+
     async def system_info(request):
-        return web.json_response(controller.system_info())
+        # controller.system_info() queries the device backend, which can
+        # hang INDEFINITELY when a network-attached accelerator service
+        # dies — deadline-guard it so the control plane stays responsive
+        # (utils/deadline.py; observed during the r04 chip outage)
+        info = await deadline_call(controller.system_info, fallback=None)
+        if info is None:
+            base = controller.system_info_no_devices()
+            base["devices"] = _DEGRADED
+            return web.json_response(base)
+        return web.json_response(info)
 
     async def network_info(request):
         interfaces = _list_interfaces()
+        devices = await deadline_call(
+            lambda: controller.system_info()["devices"],
+            fallback=_DEGRADED)
         return web.json_response({
             "interfaces": interfaces,
             "recommended_ip": _recommend_ip(interfaces),
-            "devices": controller.system_info()["devices"],
+            "devices": devices,
         })
 
     async def local_log(request):
@@ -140,18 +156,24 @@ def register(router, controller) -> None:
 
     async def memory_stats(request):
         """Per-device HBM/host memory stats (None on backends that don't
-        report them, e.g. CPU)."""
-        import jax
+        report them, e.g. CPU). Deadline-guarded: per-device stats are
+        RPCs that hang forever when a tunneled backend dies."""
+        def census():
+            import jax
 
-        out = []
-        for d in jax.local_devices():
-            try:
-                stats = d.memory_stats()
-            except Exception:
-                stats = None
-            out.append({"id": d.id, "kind": getattr(d, "device_kind", "?"),
-                        "stats": stats})
-        return web.json_response({"devices": out})
+            out = []
+            for d in jax.local_devices():
+                try:
+                    stats = d.memory_stats()
+                except Exception:
+                    stats = None
+                out.append({"id": d.id,
+                            "kind": getattr(d, "device_kind", "?"),
+                            "stats": stats})
+            return out
+
+        devices = await deadline_call(census, fallback=_DEGRADED)
+        return web.json_response({"devices": devices})
 
     async def step_times(request):
         """Recent prompt durations — the step-time observability the

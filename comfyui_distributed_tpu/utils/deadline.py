@@ -1,0 +1,104 @@
+"""Deadline-guarded device-backend queries for the control plane.
+
+The r04 chip outage exposed a failure mode the reference never has
+(CUDA is local; this runtime may sit behind a network-attached device
+service): when the accelerator backend goes unreachable,
+``jax.devices()`` / per-device ``memory_stats()`` RPCs block
+**indefinitely**, and any aiohttp route that calls them synchronously
+freezes the whole event loop — including ``/distributed/health``, the
+exact endpoint peers use to decide this host is dead. Reference
+analogue for the *shape* of the guard: its worker probes use bounded
+HTTP timeouts everywhere (``utils/network.py``); the device backend
+deserves the same discipline.
+
+Leak discipline: a stalled RPC can never be cancelled, so each timeout
+permanently occupies its thread for the outage's duration. Queries run
+on dedicated **daemon** threads (never the shared default executor —
+worker launch, tunnel setup, and media hashing live there) behind a
+2-permit semaphore: at most TWO threads can ever be stuck, further
+calls fall back immediately, and interpreter shutdown is never blocked.
+A cooldown gate additionally short-circuits attempts after a stall.
+
+Exceptions are NOT conflated with stalls: a query that *fails fast*
+(e.g. a misconfigured backend raising at init) propagates to the
+caller — the app-level error middleware reports the real error — and
+does not close the gate.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+import time
+from typing import Any, Callable
+
+from .logging import log
+
+_blocked_until = 0.0
+_inflight = threading.Semaphore(2)
+
+
+def gate_open() -> bool:
+    return time.monotonic() >= _blocked_until
+
+
+def _note_stall(cooldown_s: float) -> None:
+    global _blocked_until
+    _blocked_until = time.monotonic() + cooldown_s
+
+
+def reset_gate() -> None:
+    """Test hook / manual recovery."""
+    global _blocked_until
+    _blocked_until = 0.0
+    # NOTE: permits held by genuinely-stuck threads are unrecoverable by
+    # design (the thread itself must finish to release)
+
+
+async def deadline_call(fn: Callable[[], Any], timeout_s: float = 5.0,
+                        cooldown_s: float = 120.0,
+                        fallback: Any = None) -> Any:
+    """Run a (possibly-hanging) device-backend query off the event loop
+    with a deadline.
+
+    - timeout → log, close the gate for ``cooldown_s``, return
+      ``fallback`` (the thread stays parked until the RPC dies);
+    - gate closed or both leak permits consumed → ``fallback``
+      immediately;
+    - ``fn`` raises → the exception PROPAGATES (fast failures carry
+      real diagnostics; only stalls degrade)."""
+    if not gate_open():
+        return fallback
+    if not _inflight.acquire(blocking=False):
+        return fallback
+    loop = asyncio.get_running_loop()
+    fut: asyncio.Future = loop.create_future()
+
+    def deliver(cb):
+        try:
+            loop.call_soon_threadsafe(cb)
+        except RuntimeError:
+            pass      # loop already closed — a freed stale thread's
+                      # result has nowhere to go, and that's fine
+
+    def runner():
+        try:
+            result = fn()
+        except BaseException as e:  # noqa: BLE001 — delivered, not dropped
+            deliver(lambda: fut.set_exception(e)
+                    if not fut.done() else None)
+        else:
+            deliver(lambda: fut.set_result(result)
+                    if not fut.done() else None)
+        finally:
+            _inflight.release()
+
+    threading.Thread(target=runner, daemon=True,
+                     name="cdt-device-query").start()
+    try:
+        return await asyncio.wait_for(fut, timeout=timeout_s)
+    except asyncio.TimeoutError:
+        _note_stall(cooldown_s)
+        log(f"device backend unresponsive (> {timeout_s:.0f}s) — "
+            f"degrading device queries for {cooldown_s:.0f}s")
+        return fallback

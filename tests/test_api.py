@@ -245,6 +245,95 @@ class TestRoutes:
                 assert net["recommended_ip"]
         run(body())
 
+    def test_device_routes_degrade_when_backend_hangs(self, tmp_config,
+                                                      monkeypatch):
+        """r04: a dead network-attached device backend makes
+        jax.devices()/memory_stats() block forever; the info routes must
+        answer a degraded payload within the deadline instead of
+        freezing the event loop (utils/deadline.py)."""
+        import threading
+        import time as _time
+
+        from comfyui_distributed_tpu.utils import deadline
+
+        deadline.reset_gate()
+        release = threading.Event()                # frees the stuck
+                                                   # executor thread at exit
+
+        async def body():
+            controller, client = make_client()
+            monkeypatch.setattr(
+                type(controller), "system_info",
+                lambda self: release.wait(30))     # simulated hang
+            async with client:
+                t0 = _time.monotonic()
+                info = await (await client.get(
+                    "/distributed/system_info")).json()
+                assert _time.monotonic() - t0 < 10
+                assert info["devices"][0]["error"]
+                assert "machine_id" in info        # host facts survive
+                # gate now open: subsequent calls short-circuit fast
+                t0 = _time.monotonic()
+                net = await (await client.get(
+                    "/distributed/network_info")).json()
+                assert _time.monotonic() - t0 < 2
+                assert net["devices"][0]["error"]
+                res = await (await client.get(
+                    "/distributed/memory_stats")).json()
+                assert res["devices"][0]["error"]
+        try:
+            run(body())
+        finally:
+            release.set()
+            deadline.reset_gate()
+
+    def test_deadline_call_semantics(self):
+        """Unit contract of utils/deadline.deadline_call: fast failures
+        PROPAGATE (real diagnostics), stalls degrade, and the 2-permit
+        semaphore bounds leaked threads even with the gate open."""
+        import asyncio
+        import threading
+
+        from comfyui_distributed_tpu.utils import deadline
+
+        deadline.reset_gate()
+        release = threading.Event()
+
+        async def body():
+            # exception passthrough
+            def boom():
+                raise RuntimeError("real diagnostic")
+
+            try:
+                await deadline.deadline_call(boom, timeout_s=2.0)
+                raise AssertionError("expected RuntimeError")
+            except RuntimeError as e:
+                assert "real diagnostic" in str(e)
+            assert deadline.gate_open()        # failures don't close it
+
+            # stall → fallback + gate closes; permits bound the leak
+            stalled = await deadline.deadline_call(
+                lambda: release.wait(30), timeout_s=0.3,
+                cooldown_s=0.0, fallback="degraded")
+            assert stalled == "degraded"
+            # consume the second permit too (cooldown 0 keeps gate open)
+            await deadline.deadline_call(
+                lambda: release.wait(30), timeout_s=0.3,
+                cooldown_s=0.0, fallback="degraded")
+            # third call: both permits held by stuck threads → instant
+            # fallback without spawning anything
+            t0 = asyncio.get_event_loop().time()
+            out = await deadline.deadline_call(
+                lambda: "never runs", timeout_s=5.0, fallback="degraded")
+            assert out == "degraded"
+            assert asyncio.get_event_loop().time() - t0 < 0.2
+
+        try:
+            asyncio.run(body())
+        finally:
+            release.set()
+            deadline.reset_gate()
+
     def test_profiler_and_observability_routes(self, tmp_config):
         async def body():
             controller, client = make_client()
