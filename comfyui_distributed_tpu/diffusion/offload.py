@@ -645,12 +645,13 @@ class OffloadedFlux:
         self._fwd_resident = jax.jit(fwd_resident)
 
         def ladder(gl, dstack, sstack, x, sigs, ctx, pl, g,
-                   pe_img, pe_txt, pe_full, token):
-            """The ENTIRE euler sigma ladder as one program (fully-
-            resident only): sample()'s scan over steps wrapping
-            fwd_resident's scan over blocks — zero per-step host
-            dispatch. In-trace progress via the same wrap_denoiser the
-            compiled pipelines use."""
+                   pe_img, pe_txt, pe_full, token, key, sampler):
+            """The ENTIRE sigma ladder as one program (fully-resident
+            only): sample()'s scan over steps wrapping fwd_resident's
+            scan over blocks — zero per-step host dispatch, and since
+            the whole thing is in-trace, EVERY registered sampler works
+            (the python fallback is euler-only). In-trace progress via
+            the same wrap_denoiser the compiled pipelines use."""
             from .progress import wrap_denoiser
             from .samplers import sample
 
@@ -664,31 +665,37 @@ class OffloadedFlux:
                                                cfg.patch_size, C)
 
             d = den if token is None else wrap_denoiser(den, token, 0)
-            return sample("euler", d, x, sigs)
+            return sample(sampler, d, x, sigs, key=key)
 
-        self._ladder = jax.jit(ladder)
+        self._ladder = jax.jit(ladder, static_argnames=("sampler",))
 
-    def sample_euler_resident(self, x, sigmas, context, pooled,
-                              guidance=None, progress_token=None):
-        """Run the whole euler ladder as ONE compiled program — valid
+    def sample_resident(self, x, sigmas, context, pooled,
+                        guidance=None, sampler: str = "euler",
+                        key=None, progress_token=None):
+        """Run the whole sigma ladder as ONE compiled program — valid
         only when fully resident (``self.stacked``). Removes the
         per-step python dispatch (~70 ms RTT each through a tunneled
-        chip ≈ 2 s of a 36 s FLUX image); math identical to
-        ``sample_euler_py`` over ``forward`` (pinned by tests)."""
+        chip ≈ 2 s of a 36 s FLUX image) and supports every registered
+        sampler (ancestral ones draw from ``key`` exactly like the dp
+        path); math identical to the compiled pipelines (pinned by
+        tests)."""
         if not self.stacked:
             raise RuntimeError(
-                "sample_euler_resident requires a fully-resident "
-                "executor (self.stacked)")
+                "sample_resident requires a fully-resident executor "
+                "(self.stacked)")
         B, H, W, C = x.shape
         pe_img, pe_txt, pe_full = self._rope_tables(H, W,
                                                     context.shape[1])
         token = (None if progress_token is None
                  else jnp.asarray(progress_token, jnp.int32))
+        if key is None:
+            key = jax.random.key(0)
         return self._ladder(
             self.glue, self.stacked.get("double"),
             self.stacked.get("single"), jax.device_put(x, self.device),
             jnp.asarray(np.asarray(sigmas), jnp.float32),
-            context, pooled, guidance, pe_img, pe_txt, pe_full, token)
+            context, pooled, guidance, pe_img, pe_txt, pe_full, token,
+            key, sampler)
 
     # --- forward -----------------------------------------------------------
 
@@ -882,12 +889,13 @@ class OffloadedWan:
                                      static_argnames=("fhw", "FHW"))
 
         def wan_ladder(gl, bstack, x, sigs, ctx, gscale, pe, y, mask,
-                       token, do_cfg):
-            """Whole euler ladder in one program (fully-resident only).
-            ``y``/``mask`` are TRACED i2v conditioning (None for t2v) —
-            traced, not closure-captured, so a new start image never
-            recompiles. CFG runs cond/uncond as two sequential in-trace
-            forwards (same memory argument as ``denoiser``)."""
+                       token, key, do_cfg, sampler):
+            """Whole sigma ladder in one program (fully-resident only;
+            any registered sampler). ``y``/``mask`` are TRACED i2v
+            conditioning (None for t2v) — traced, not closure-captured,
+            so a new start image never recompiles. CFG runs cond/uncond
+            as two sequential in-trace forwards (same memory argument
+            as ``denoiser``)."""
             from .progress import wrap_denoiser
             from .samplers import sample
 
@@ -911,31 +919,37 @@ class OffloadedWan:
                 return uncond + gscale * (cond - uncond)
 
             d = den if token is None else wrap_denoiser(den, token, 0)
-            return sample("euler", d, x, sigs)
+            return sample(sampler, d, x, sigs, key=key)
 
-        self._ladder = jax.jit(wan_ladder, static_argnames=("do_cfg",))
+        self._ladder = jax.jit(wan_ladder,
+                               static_argnames=("do_cfg", "sampler"))
 
-    def sample_euler_resident(self, x, sigmas, context,
-                              guidance_scale: float = 1.0, y=None,
-                              mask=None, progress_token=None):
-        """Run the whole euler ladder as ONE compiled program — valid
-        only when fully resident (``self.stacked``); math identical to
-        ``sample_euler_py`` over ``denoiser`` (pinned by tests)."""
+    def sample_resident(self, x, sigmas, context,
+                        guidance_scale: float = 1.0, y=None,
+                        mask=None, sampler: str = "euler", key=None,
+                        progress_token=None):
+        """Run the whole sigma ladder as ONE compiled program — valid
+        only when fully resident (``self.stacked``); any registered
+        sampler (ancestral ones draw from ``key`` exactly like the dp
+        path); math identical to the compiled pipelines (pinned by
+        tests)."""
         if not self.stacked:
             raise RuntimeError(
-                "sample_euler_resident requires a fully-resident "
-                "executor (self.stacked)")
+                "sample_resident requires a fully-resident executor "
+                "(self.stacked)")
         B, F, H, W, _ = x.shape
         pt, ph, pw = self.cfg.patch_size
         pe = self._pe_tables(F // pt, H // ph, W // pw)
         token = (None if progress_token is None
                  else jnp.asarray(progress_token, jnp.int32))
+        if key is None:
+            key = jax.random.key(0)
         return self._ladder(
             self.glue, self.stacked["block"],
             jax.device_put(x, self.device),
             jnp.asarray(np.asarray(sigmas), jnp.float32), context,
-            jnp.float32(guidance_scale), pe, y, mask, token,
-            do_cfg=float(guidance_scale) != 1.0)
+            jnp.float32(guidance_scale), pe, y, mask, token, key,
+            do_cfg=float(guidance_scale) != 1.0, sampler=sampler)
 
     def _pe_tables(self, f: int, h: int, w: int):
         from ..models.wan import video_ids

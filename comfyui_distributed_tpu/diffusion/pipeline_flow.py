@@ -199,34 +199,45 @@ class FlowPipeline:
         full-size init can't live on device)."""
         from .offload import sample_euler_py
 
-        if spec.sampler != "euler":
-            raise ValueError(
-                "offloaded sampling currently supports the euler ladder "
-                f"(got {spec.sampler!r})")
         if spec.per_device_batch != 1 or context.shape[0] != 1:
             raise ValueError(
                 "offloaded generation is single-image (batch 1): the "
                 "streamed weight window serves one latent at a time")
+        from .offload import ladder_mode
+
+        if ladder_mode() == "step" and spec.sampler != "euler":
+            # fail BEFORE the minutes-long quantize/upload — this half
+            # of the euler-only rule needs no executor to decide
+            raise ValueError(
+                "the per-step offloaded ladder supports euler only "
+                f"(got {spec.sampler!r}); fully-resident executors "
+                "with CDT_OFFLOAD_LADDER=jit run every sampler")
         off = self.offload_executor(params, resident_bytes, stream_dtype)
         sigmas = sigmas_flow(spec.steps, spec.shift)
         ds = self.vae.config.downscale
         lat_h, lat_w = spec.height // ds, spec.width // ds
         # same key derivation as dp shard 0, so offloaded == sharded run
+        # (noise AND the sampler's ancestral draws)
         key = jax.random.fold_in(jax.random.key(seed), 0)
         x = jax.random.normal(
             key, (1, lat_h, lat_w, self.dit.config.in_channels),
             jnp.float32)
-        from .offload import ladder_mode
-
         if off.stacked and ladder_mode() == "jit":
+            # the in-trace ladder supports EVERY registered sampler
             g = jnp.full((context.shape[0],), float(spec.guidance))
-            x0 = off.sample_euler_resident(
-                x, sigmas, context, pooled, g,
-                progress_token=progress_token)
+            x0 = off.sample_resident(
+                x, sigmas, context, pooled, g, sampler=spec.sampler,
+                key=key, progress_token=progress_token)
         else:
             # per-step loop: streamed executors, or CDT_OFFLOAD_LADDER=
             # step (interruptible serving) — resident executors still
-            # run one fused program per forward
+            # run one fused program per forward. Euler-only: the python
+            # ladder implements just the euler update.
+            if spec.sampler != "euler":
+                raise ValueError(
+                    "the per-step offloaded ladder supports euler only "
+                    f"(got {spec.sampler!r}); fully-resident executors "
+                    "with CDT_OFFLOAD_LADDER=jit run every sampler")
             den = off.denoiser(context, pooled, spec.guidance)
             x0 = sample_euler_py(den, jax.device_put(x, off.device),
                                  sigmas, on_step=on_step,

@@ -308,29 +308,41 @@ class VideoPipeline:
                           should_stop=None) -> jax.Array:
         from .offload import ladder_mode, sample_euler_py
 
-        if spec.sampler != "euler":
-            raise ValueError(
-                "offloaded video sampling currently supports the euler "
-                f"ladder (got {spec.sampler!r})")
         if context.shape[0] != 1:
             raise ValueError("offloaded generation is single-video "
                              "(batch 1)")
+        if ladder_mode() == "step" and spec.sampler != "euler":
+            # fail BEFORE any expert quantize/upload — decidable from
+            # the env + spec alone
+            raise ValueError(
+                "the per-step offloaded ladder supports euler only "
+                f"(got {spec.sampler!r}); fully-resident executors "
+                "with CDT_OFFLOAD_LADDER=jit run every sampler")
         sigmas = sigmas_flow(spec.steps, spec.shift)
         ds = self.vae.config.downscale
         lat = (self.latent_frames(spec), spec.height // ds,
                spec.width // ds, lat_channels)
+        # same key derivation as dp shard 0 (noise AND ancestral draws);
+        # the low segment folds 0x10E exactly like _sample_expert
         key = jax.random.fold_in(jax.random.key(seed), 0)
         x = jax.random.normal(key, (1,) + lat, jnp.float32)
 
-        def run(which, x0, sig):
+        def run(which, x0, sig, seg_key):
             off = self.offload_executor(which, resident_bytes,
                                         stream_dtype)
             if off.stacked and ladder_mode() == "jit":
                 # fully resident: the whole segment ladder is ONE
-                # compiled program (in-trace progress via the token)
-                return off.sample_euler_resident(
+                # compiled program supporting EVERY registered sampler
+                # (in-trace progress via the token)
+                return off.sample_resident(
                     x0, sig, context, spec.guidance_scale, y, mask,
+                    sampler=spec.sampler, key=seg_key,
                     progress_token=progress_token)
+            if spec.sampler != "euler":
+                raise ValueError(
+                    "the per-step offloaded ladder supports euler only "
+                    f"(got {spec.sampler!r}); fully-resident executors "
+                    "with CDT_OFFLOAD_LADDER=jit run every sampler")
             inp_fn = None if y is None else self._i2v_inp_fn(y, mask)
             den = off.denoiser(context, spec.guidance_scale,
                                inp_fn=inp_fn)
@@ -339,16 +351,16 @@ class VideoPipeline:
                                    should_stop=should_stop)
 
         if not self.is_moe:
-            x0 = run("high", x, sigmas)
+            x0 = run("high", x, sigmas, key)
         else:
             split = self._expert_split(sigmas)
             steps = int(sigmas.shape[0]) - 1
             if split <= 0:
-                x0 = run("low", x, sigmas)
+                x0 = run("low", x, sigmas, key)
             elif split >= steps:
-                x0 = run("high", x, sigmas)
+                x0 = run("high", x, sigmas, key)
             else:
-                x_mid = run("high", x, sigmas[: split + 1])
+                x_mid = run("high", x, sigmas[: split + 1], key)
                 jax.block_until_ready(x_mid)
                 if should_stop is not None and should_stop():
                     # free host-side boundary — honor an interrupt here
@@ -358,7 +370,8 @@ class VideoPipeline:
                         "offloaded MoE sampling interrupted at the "
                         "expert boundary")
                 self._evict_offload("high")     # HBM for the low expert
-                x0 = run("low", x_mid, sigmas[split:])
+                x0 = run("low", x_mid, sigmas[split:],
+                         jax.random.fold_in(key, 0x10E))
         return self.decode_frames(x0)
 
     def _cached_fn(self, mesh: Mesh, spec: VideoSpec, mode: str = "dp",

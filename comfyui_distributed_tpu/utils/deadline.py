@@ -85,7 +85,11 @@ async def deadline_call(fn: Callable[[], Any], timeout_s: float = 5.0,
         try:
             result = fn()
         except BaseException as e:  # noqa: BLE001 — delivered, not dropped
-            deliver(lambda: fut.set_exception(e)
+            # bind NOW: CPython clears the except-variable at block
+            # exit, racing the scheduled callback (a bare closure over
+            # `e` intermittently dies with NameError and the failure
+            # would misclassify as a stall)
+            deliver(lambda exc=e: fut.set_exception(exc)
                     if not fut.done() else None)
         else:
             deliver(lambda: fut.set_result(result)

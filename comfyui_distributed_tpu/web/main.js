@@ -206,9 +206,14 @@ async function renderMesh() {
   root.replaceChildren();
   try {
     const info = await api.systemInfo();
+    // degraded payload (device backend unresponsive): entries carry an
+    // `error` field instead of a device census — surface it, don't
+    // render "1 — undefined"
+    const devErr = (info.devices || []).find((d) => d.error);
     const rows = [
       ["Platform", `${info.platform} (${info.environment?.tpu?.tpu_accelerator_type || "no TPU env"})`],
-      ["Devices", String((info.devices || []).length) + " — " +
+      ["Devices", devErr ? `⚠ ${devErr.error}` :
+        String((info.devices || []).length) + " — " +
         [...new Set((info.devices || []).map((d) => d.kind))].join(", ")],
       ["Mesh shape", JSON.stringify((state.config || {}).mesh?.shape || {})],
       ["Machine", info.machine_id],

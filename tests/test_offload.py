@@ -673,14 +673,32 @@ class TestGenerateOffloadedVideo:
 
         model, hi, lo, vae, ctx, pooled = self._pipes()
         pipe = VideoPipeline(model, hi, vae)
-        with pytest.raises(ValueError, match="euler"):
+        # streamed (per-step) ladder: euler-only
+        with pytest.raises(ValueError, match="euler only"):
             pipe.generate_offloaded(
                 VideoSpec(frames=5, height=16, width=16,
-                          sampler="dpmpp_2m"), 0, ctx)
+                          sampler="dpmpp_2m"), 0, ctx, resident_bytes=0)
         with pytest.raises(ValueError, match="batch 1"):
             pipe.generate_offloaded(
                 VideoSpec(frames=5, height=16, width=16), 0,
                 jnp.zeros((2, 6, model.config.text_dim)))
+
+    def test_resident_video_sampler_equals_dp(self):
+        """A non-euler sampler through the resident video ladder matches
+        dp — the capability the euler-only python loop lacks."""
+        from comfyui_distributed_tpu.diffusion.pipeline_video import (
+            VideoPipeline, VideoSpec)
+        from comfyui_distributed_tpu.parallel import build_mesh
+
+        model, hi, lo, vae, ctx, pooled = self._pipes()
+        pipe = VideoPipeline(model, hi, vae)
+        spec = VideoSpec(frames=5, height=16, width=16, steps=3,
+                         shift=1.0, sampler="dpmpp_2m")
+        want = np.asarray(pipe.generate(build_mesh({"dp": 1}), spec, 13,
+                                        ctx, pooled))
+        got = np.asarray(pipe.generate_offloaded(
+            spec, 13, ctx, stream_dtype="native"))
+        np.testing.assert_allclose(got, want, rtol=5e-4, atol=5e-4)
 
 
 class TestInterruptAndLadderMode:
@@ -807,7 +825,9 @@ class TestGenerateOffloaded:
             stream_dtype="native"))
         np.testing.assert_allclose(got, want, rtol=5e-4, atol=5e-4)
 
-    def test_non_euler_raises(self):
+    def test_non_euler_streamed_raises_resident_works(self):
+        """The per-step python ladder is euler-only; the fully-resident
+        in-trace ladder runs EVERY registered sampler."""
         from comfyui_distributed_tpu.diffusion.pipeline_flow import (
             FlowPipeline, FlowSpec)
         from comfyui_distributed_tpu.models.vae import (AutoencoderKL,
@@ -819,11 +839,42 @@ class TestGenerateOffloaded:
         vae = AutoencoderKL(VAEConfig.tiny()).init(jax.random.key(1),
                                                    image_hw=(16, 16))
         pipe = FlowPipeline(model, params, vae)
-        with pytest.raises(ValueError, match="euler"):
-            pipe.generate_offloaded(
-                FlowSpec(height=16, width=16, sampler="heun"), 0,
-                jnp.zeros((1, 6, cfg.context_dim)),
-                jnp.zeros((1, cfg.pooled_dim)))
+        ctx = jnp.zeros((1, 6, cfg.context_dim))
+        pooled = jnp.zeros((1, cfg.pooled_dim))
+        spec = FlowSpec(height=16, width=16, steps=2, sampler="heun")
+        with pytest.raises(ValueError, match="euler only"):
+            pipe.generate_offloaded(spec, 0, ctx, pooled,
+                                    resident_bytes=0)
+        out = pipe.generate_offloaded(spec, 0, ctx, pooled,
+                                      resident_bytes=1 << 40)
+        assert np.asarray(out).shape == (1, 16, 16, 3)
+
+    @pytest.mark.parametrize("sampler", ["dpmpp_2m", "euler_ancestral"])
+    def test_resident_ladder_samplers_equal_dp(self, sampler):
+        """Non-euler samplers through the resident jit ladder must match
+        the dp path — including ancestral ones (the ladder threads the
+        SAME fold_in(key, 0) the dp shard-0 uses for its noise draws)."""
+        from comfyui_distributed_tpu.diffusion.pipeline_flow import (
+            FlowPipeline, FlowSpec)
+        from comfyui_distributed_tpu.models.vae import (AutoencoderKL,
+                                                        VAEConfig)
+        from comfyui_distributed_tpu.parallel import build_mesh
+
+        cfg = DiTConfig.tiny(pos_embed="rope")
+        model, params = init_dit(cfg, jax.random.key(0), sample_hw=(8, 8),
+                                 context_len=6)
+        vae = AutoencoderKL(VAEConfig.tiny(dtype="float32")).init(
+            jax.random.key(1), image_hw=(16, 16))
+        pipe = FlowPipeline(model, params, vae)
+        ctx = jnp.ones((1, 6, cfg.context_dim)) * 0.1
+        pooled = jnp.ones((1, cfg.pooled_dim)) * 0.2
+        spec = FlowSpec(height=16, width=16, steps=3, sampler=sampler)
+        want = np.asarray(pipe.generate(build_mesh({"dp": 1}), spec, 11,
+                                        ctx, pooled))
+        got = np.asarray(pipe.generate_offloaded(
+            spec, 11, ctx, pooled, resident_bytes=1 << 40,
+            stream_dtype="native"))
+        np.testing.assert_allclose(got, want, rtol=5e-4, atol=5e-4)
 
 
 class TestPlumbing:
