@@ -66,6 +66,34 @@ class TestAffordableForwards:
             1.0, int(4e9), int(12e9), 0.0) == float("inf")
 
 
+@pytest.mark.slow
+class TestWorkloadsRunOnCpu:
+    """Every bench workload's CPU tiny path must produce a valid result
+    line end-to-end — the guard that would have caught the r04 registry
+    typo before it reached the chip."""
+
+    @pytest.mark.parametrize("workload", sorted(bench._WORKLOADS))
+    def test_workload_emits_valid_result(self, workload, monkeypatch):
+        monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+        result = bench._workload_fn(workload)(2, 1, True)
+        assert result["metric"]
+        assert result["value"] > 0
+        assert result["unit"]
+        assert result["platform"] == "cpu"
+
+    def test_registry_covers_cli_choices(self):
+        """The argparse choices and the dispatch registry must agree
+        (anchored to the --workload argument so other choices= lists
+        can't be matched by mistake)."""
+        import re
+
+        src = (ROOT / "bench.py").read_text()
+        m = re.search(r'"--workload",\s*choices=\[([^]]+)\]', src)
+        assert m is not None, "--workload choices list not found"
+        choices = set(re.findall(r'["\'](\w+)["\']', m.group(1)))
+        assert choices == set(bench._WORKLOADS)
+
+
 class TestCompileCache:
     def test_enable_and_disable(self, tmp_path, monkeypatch):
         from comfyui_distributed_tpu.utils.compile_cache import \
