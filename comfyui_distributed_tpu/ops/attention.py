@@ -36,22 +36,51 @@ def _pvary(x, axis):
 
 
 def _flash_min_seq() -> int:
-    """Below this q length the pallas flash kernel LOSES to XLA's fused
-    attention on TPU — measured r04 (`scripts/mfu_probe.py forward`,
-    SDXL 1024²: flash 0.1763 s/fwd vs XLA 0.1677, trace shows the 10
-    flash sites at ~3 ms each): at N ≤ a few K the O(N²) score matrix
-    fits HBM comfortably, XLA fuses softmax into the matmuls, and the
-    flash kernel's running-max bookkeeping is pure overhead. Flash's win
-    is memory at long N (ring/SP sequences, video token counts)."""
+    """Below this q length the classic pre-transposed ([B·H,N,D]) flash
+    call LOSES to XLA's fused attention on TPU — measured r04
+    (`scripts/mfu_probe.py forward`, SDXL 1024²: flash-bh 0.1763 s/fwd
+    vs XLA 0.1677, trace shows the boundary relayout, not the kernel
+    body, as the cost): at N ≤ a few K the O(N²) score matrix fits HBM
+    comfortably and XLA fuses softmax into the matmuls. Only reached
+    when the packed-heads layout is NOT legal (see ``_flash_min_seq_
+    packed``); flash-bh's win is memory at long N (ring/SP sequences,
+    video token counts)."""
     import os
 
     return int(os.environ.get("CDT_FLASH_MIN_SEQ", "8192"))
 
 
-def _flash_enabled(q_len: Optional[int] = None) -> bool:
+def _flash_min_seq_packed() -> int:
+    """Crossover for the packed-heads ([B,N,H·D]-native) kernel, which
+    has NO boundary relayout: measured r04 it beats XLA already at the
+    SDXL self-attention shapes (4096 tokens: 3.60 vs 4.72 ms/64-op
+    chain; 1024 tokens: 1.38 vs 1.51; end-to-end UNet forward 0.1590 vs
+    0.1678 s — `scripts/mfu_probe.py attn/forward`,
+    `docs/roofline.md`)."""
+    import os
+
+    return int(os.environ.get("CDT_FLASH_MIN_SEQ_PACKED", "1024"))
+
+
+def _flash_min_kv_packed() -> int:
+    """Short-K floor for the packed kernel: at SDXL cross-attention
+    (K = 77 text tokens padded to one 512 block) the kernel wastes most
+    of its K tile and measures behind XLA (1.20 vs 1.04 ms/64-op chain,
+    r04) — those sites stay on XLA's fused lowering."""
+    import os
+
+    return int(os.environ.get("CDT_FLASH_MIN_KV_PACKED", "256"))
+
+
+def _flash_enabled(q_len: Optional[int] = None,
+                   kv_len: Optional[int] = None,
+                   num_heads: Optional[int] = None,
+                   head_dim: Optional[int] = None) -> bool:
     """Pallas flash attention: env-forceable; default = TPU AND the
-    sequence is long enough that flash beats XLA's fused lowering
-    (``CDT_FLASH_MIN_SEQ``, default 8192 — see ``_flash_min_seq``)."""
+    shape is one where flash beats XLA's fused lowering — for the
+    packed-heads layout that is q ≥ 1024 with non-tiny K; for the
+    classic transposed layout q ≥ 8192 (both measured r04, overridable
+    via ``CDT_FLASH_MIN_SEQ[_PACKED]`` / ``CDT_FLASH_MIN_KV_PACKED``)."""
     import os
 
     flag = os.environ.get("CDT_FLASH_ATTENTION", "").lower()
@@ -63,23 +92,35 @@ def _flash_enabled(q_len: Optional[int] = None) -> bool:
         on_tpu = jax.devices()[0].platform == "tpu"
     except RuntimeError:
         return False
-    if q_len is not None and q_len < _flash_min_seq():
+    if not on_tpu:
         return False
-    return on_tpu
+    if q_len is None:
+        return True
+    from .flash_attention import _layout_packed
+
+    if (num_heads is not None and head_dim is not None
+            and _layout_packed(num_heads, head_dim)):
+        return (q_len >= _flash_min_seq_packed()
+                and (kv_len is None or kv_len >= _flash_min_kv_packed()))
+    return q_len >= _flash_min_seq()
 
 
 def full_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                    prefer_flash: bool = False) -> jax.Array:
-    """Dense [B,N,H,D] attention: pallas flash kernel on TPU for long
-    sequences, XLA's fused lowering for short ones and off-TPU.
+    """Dense [B,N,H,D] attention: pallas flash kernel on TPU wherever it
+    measures faster than XLA's fused lowering (see ``_flash_enabled``),
+    XLA elsewhere and off-TPU.
 
-    ``prefer_flash=True`` skips the sequence-length gate (still TPU-only,
-    still overridable by an explicit ``CDT_FLASH_ATTENTION``): set by
+    ``prefer_flash=True`` skips the shape gates (still TPU-only, still
+    overridable by an explicit ``CDT_FLASH_ATTENTION``): set by
     memory-constrained callers — the fp8-resident offload executor's
     block programs OOM'd at compile with XLA attention (measured r04:
     16.89 GB needed vs 15.75 HBM at FLUX's 4608 tokens × 24 heads with
     12 GB of weights resident) while flash's streamed softmax fits."""
-    if _flash_enabled(q_len=None if prefer_flash else int(q.shape[1])):
+    B, Nq, H, D = q.shape
+    if _flash_enabled(q_len=None if prefer_flash else int(Nq),
+                      kv_len=int(k.shape[1]), num_heads=int(H),
+                      head_dim=int(D)):
         from .flash_attention import flash_attention
 
         return flash_attention(q, k, v)

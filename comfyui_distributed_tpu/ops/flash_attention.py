@@ -90,6 +90,68 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
         o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
 
 
+def _flash_kernel_packed(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
+                         *, kv_len: int, block_k: int, num_k_blocks: int,
+                         scale: float, precision, num_heads: int,
+                         head_dim: int):
+    """Packed-heads variant: refs are [1, block, H·D] slices of the
+    model's NATURAL layout — the fused QKV projection emits [B, N, H·D]
+    and splitting heads along the minor axis is free, so no transpose
+    ever happens at the custom-call boundary (the boundary relayout, not
+    the kernel body, is what made the classic [B·H, N, D] call lose to
+    XLA fused attention at SDXL sequence lengths — `docs/roofline.md`
+    finding 1). Heads unroll statically inside the kernel; head h's
+    running max / denominator each live in lane h of one [BQ, 128]
+    scratch (hence ``num_heads ≤ 128``)."""
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0]                                   # [BQ, H·D]
+    k = k_ref[0]                                   # [BK, H·D]
+    v = v_ref[0]                                   # [BK, H·D]
+
+    col = jax.lax.broadcasted_iota(
+        jnp.int32, (q.shape[0], block_k), 1) if kv_len % block_k else None
+
+    for h in range(num_heads):
+        sl = slice(h * head_dim, (h + 1) * head_dim)
+        s = jax.lax.dot_general(
+            q[:, sl], k[:, sl], (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=precision,
+        ) * scale                                   # [BQ, BK]
+
+        if col is not None:                        # mask the K padding tail
+            s = jnp.where(j * block_k + col < kv_len, s, NEG_INF)
+
+        m_prev = m_ref[:, h:h + 1]                 # [BQ, 1] (lane h)
+        l_prev = l_ref[:, h:h + 1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m_prev - m_new)
+        l_new = l_prev * corr + jnp.sum(p, axis=-1, keepdims=True)
+
+        pv = jax.lax.dot_general(
+            p.astype(v.dtype), v[:, sl], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=precision,
+        )                                          # [BQ, D]
+        acc_ref[:, sl] = acc_ref[:, sl] * corr + pv
+        m_ref[:, h:h + 1] = m_new
+        l_ref[:, h:h + 1] = l_new
+
+    @pl.when(j == num_k_blocks - 1)
+    def _finalize():
+        for h in range(num_heads):
+            sl = slice(h * head_dim, (h + 1) * head_dim)
+            l = l_ref[:, h:h + 1]
+            l = jnp.where(l == 0.0, 1.0, l)
+            o_ref[0, :, sl] = (acc_ref[:, sl] / l).astype(o_ref.dtype)
+
+
 def _pad_to(x: jax.Array, axis: int, multiple: int) -> jax.Array:
     n = x.shape[axis]
     pad = (-n) % multiple
@@ -162,34 +224,41 @@ def _flash_emulated(q, k, v, block_q: int, block_k: int):
     return (acc / l).astype(q.dtype)[:, :Nq]
 
 
-@functools.partial(jax.jit, static_argnames=("block_q", "block_k", "interpret"))
-def _flash_mha(q, k, v, block_q: int, block_k: int, interpret: bool):
-    BH, Nq, D = q.shape
-    _, Nk, _ = k.shape
-    scale = 1.0 / (D ** 0.5)
-
+def _pad_and_prepare(q, k, v, block_q: int, block_k: int):
+    """Shared prologue of both pallas drivers: pad q/k/v sequence dims to
+    block multiples, pick the matmul precision, and build the vma-aware
+    output aval. f32 inputs ask for real f32 matmuls (3-pass bf16 on the
+    MXU); bf16 inputs take the fast single-pass path — the production
+    dtype. Inside shard_map the output must declare which mesh axes it
+    varies over (check_vma) — it varies exactly like q does."""
     qp = _pad_to(q, 1, block_q)
     kp = _pad_to(k, 1, block_k)
     vp = _pad_to(v, 1, block_k)
-    nqb = qp.shape[1] // block_q
-    nkb = kp.shape[1] // block_k
-
-    # f32 inputs ask for real f32 matmuls (3-pass bf16 on the MXU);
-    # bf16 inputs take the fast single-pass path — the production dtype
     precision = (jax.lax.Precision.HIGHEST if q.dtype == jnp.float32
                  else jax.lax.Precision.DEFAULT)
-    kernel = functools.partial(
-        _flash_kernel, kv_len=Nk, block_k=block_k, num_k_blocks=nkb,
-        scale=scale, precision=precision)
-
-    # inside shard_map the output must declare which mesh axes it varies
-    # over (check_vma) — it varies exactly like q does
     try:
         vma = getattr(jax.typeof(qp), "vma", None)
     except Exception:  # noqa: BLE001 — typeof unavailable outside tracing
         vma = None
     out_sds = (jax.ShapeDtypeStruct(qp.shape, q.dtype, vma=vma)
                if vma else jax.ShapeDtypeStruct(qp.shape, q.dtype))
+    return qp, kp, vp, precision, out_sds
+
+
+@functools.partial(jax.jit, static_argnames=("block_q", "block_k", "interpret"))
+def _flash_mha(q, k, v, block_q: int, block_k: int, interpret: bool):
+    BH, Nq, D = q.shape
+    _, Nk, _ = k.shape
+    scale = 1.0 / (D ** 0.5)
+
+    qp, kp, vp, precision, out_sds = _pad_and_prepare(q, k, v, block_q,
+                                                      block_k)
+    nqb = qp.shape[1] // block_q
+    nkb = kp.shape[1] // block_k
+
+    kernel = functools.partial(
+        _flash_kernel, kv_len=Nk, block_k=block_k, num_k_blocks=nkb,
+        scale=scale, precision=precision)
 
     out = pl.pallas_call(
         kernel,
@@ -215,6 +284,67 @@ def _flash_mha(q, k, v, block_q: int, block_k: int, interpret: bool):
     return out[:, :Nq]
 
 
+@functools.partial(jax.jit, static_argnames=("num_heads", "block_q",
+                                             "block_k", "interpret"))
+def _flash_mha_packed(q, k, v, num_heads: int, block_q: int, block_k: int,
+                      interpret: bool):
+    """Packed-heads pallas call: operands stay [B, N, H·D] — the QKV
+    projection's own output layout — and the kernel splits heads along
+    the minor axis (free). Legality (``_layout_packed``): H·D % 128 == 0
+    and H ≤ 128 and H·D ≤ ``_PACKED_MAX_HD`` — true for SDXL (640/1280)
+    and WAN (1536); FLUX (3072) exceeds the VMEM bound and stays on the
+    classic [B·H, N, D] call."""
+    B, Nq, HD = q.shape
+    _, Nk, _ = k.shape
+    D = HD // num_heads
+    scale = 1.0 / (D ** 0.5)
+
+    qp, kp, vp, precision, out_sds = _pad_and_prepare(q, k, v, block_q,
+                                                      block_k)
+    nqb = qp.shape[1] // block_q
+    nkb = kp.shape[1] // block_k
+
+    kernel = functools.partial(
+        _flash_kernel_packed, kv_len=Nk, block_k=block_k, num_k_blocks=nkb,
+        scale=scale, precision=precision, num_heads=num_heads, head_dim=D)
+
+    q_spec = pl.BlockSpec((1, block_q, HD), lambda b, i, j: (b, i, 0),
+                          memory_space=pltpu.VMEM)
+    kv_spec = pl.BlockSpec((1, block_k, HD), lambda b, i, j: (b, j, 0),
+                           memory_space=pltpu.VMEM)
+    out = pl.pallas_call(
+        kernel,
+        grid=(B, nqb, nkb),
+        in_specs=[q_spec, kv_spec, kv_spec],
+        out_specs=q_spec,
+        out_shape=out_sds,
+        scratch_shapes=[
+            pltpu.VMEM((block_q, _LANES), jnp.float32),   # per-head max
+            pltpu.VMEM((block_q, _LANES), jnp.float32),   # per-head sum
+            pltpu.VMEM((block_q, HD), jnp.float32),       # output acc
+        ],
+        interpret=interpret,
+    )(qp, kp, vp)
+    return out[:, :Nq]
+
+
+# past this packed width the kernel's VMEM working set (double-buffered
+# K/V blocks + the f32 accumulator) outgrows the ~16 MB budget
+_PACKED_MAX_HD = 2048
+
+
+def _layout_packed(H: int, D: int) -> bool:
+    """Kernel I/O layout: ``packed`` (default where legal) keeps q/k/v in
+    the model's natural [B, N, H·D] layout and splits heads inside the
+    kernel; ``bh`` is the classic pre-transposed [B·H, N, D] call.
+    ``CDT_FLASH_LAYOUT=bh`` restores the old behavior everywhere."""
+    import os
+
+    if os.environ.get("CDT_FLASH_LAYOUT", "packed").lower() == "bh":
+        return False
+    return (H * D) % _LANES == 0 and H <= _LANES and H * D <= _PACKED_MAX_HD
+
+
 def flash_attention(
     q: jax.Array, k: jax.Array, v: jax.Array,
     block_q: int = 256, block_k: int = 512,
@@ -236,6 +366,12 @@ def flash_attention(
     if interpret and _in_manual_trace(q):
         out = _flash_emulated(to_bh(q, Nq), to_bh(k, Nk), to_bh(v, Nk),
                               block_q=block_q, block_k=block_k)
+    elif _layout_packed(H, D):
+        out = _flash_mha_packed(
+            q.reshape(B, Nq, H * D), k.reshape(B, Nk, H * D),
+            v.reshape(B, Nk, H * D), num_heads=H,
+            block_q=block_q, block_k=block_k, interpret=interpret)
+        return out.reshape(B, Nq, H, D)
     else:
         out = _flash_mha(to_bh(q, Nq), to_bh(k, Nk), to_bh(v, Nk),
                          block_q=block_q, block_k=block_k,

@@ -302,6 +302,36 @@ class TileUpscaler:
 
     # --- cross-host farm support -------------------------------------------
 
+    @staticmethod
+    def tiles_per_device_default(tile_w: int, tile_h: int) -> int:
+        """Per-device tile batch for the farm's fixed-chunk program.
+
+        Batch-1 tiles under-fill the MXU badly: a 512² tile is a 64²
+        latent whose self-attention blocks run at 1024/256 tokens —
+        matmuls far below the 128×128 systolic tile at batch 1. Measured
+        on the v5e chip (r04, `benchmarks/r04_tpu_usdu.json`): batching
+        tiles per dispatch cuts the 4K USDU wall-clock vs the one-tile
+        chunks r02 shipped. Memory bounds the batch: activations scale
+        with tile area, so the default halves as tiles grow past 512².
+        ``CDT_TILES_PER_DEVICE`` overrides.
+        """
+        import os
+
+        env = int(os.environ.get("CDT_TILES_PER_DEVICE", "0"))
+        if env > 0:
+            return env
+        try:
+            if jax.devices()[0].platform == "cpu":
+                return 1     # tests/tiny stacks: don't pad tiny jobs 8-wide
+        except RuntimeError:
+            return 1
+        area = tile_w * tile_h
+        if area <= 512 * 512:
+            return 8
+        if area <= 1024 * 1024:
+            return 4
+        return 1
+
     def range_plan(
         self,
         mesh: Mesh,
@@ -314,6 +344,7 @@ class TileUpscaler:
         uncond_y: Optional[jax.Array] = None,
         axis: str = constants.AXIS_DATA,
         spatial_cond: Optional[jax.Array] = None,
+        tiles_per_device: Optional[int] = None,
     ) -> "TileRangePlan":
         """Prepare arbitrary-range tile processing for the cross-host farm
         (``cluster/tile_farm.py``): resize + extract all crops once, and
@@ -324,13 +355,21 @@ class TileUpscaler:
         same tiles the single-program path would — the shard-count /
         host-assignment invariance that makes requeue safe (the reference
         gets this from tile IDs travelling through its HTTP queue,
-        ``upscale/job_store.py:34-80``).
+        ``upscale/job_store.py:34-80``). Results are also invariant to
+        ``tiles_per_device`` (the per-dispatch tile batch) for the same
+        reason; it is purely a throughput/memory knob.
         """
         H, W, _ = image.shape
         grid = self.grid_for(H, W, spec)
         n_shards = mesh.shape[axis]
-        chunk = n_shards        # one tile per chip per pulled task
-        per_shard = chunk // n_shards
+        if tiles_per_device is None:
+            tiles_per_device = self.tiles_per_device_default(
+                spec.tile_w, spec.tile_h)
+        # never compile a chunk wider than the job itself — a 4-tile job
+        # on an 8-device host must not pad (and denoise) 60 zero tiles
+        per_job = -(-grid.num_tiles // n_shards)
+        per_shard = max(1, min(tiles_per_device, per_job))
+        chunk = n_shards * per_shard
         sigmas = make_sigma_ladder(spec.generation_spec(), self.pipeline.schedule)
         has_y = self.pipeline.unet.config.adm_in_channels > 0
         if y is None:
@@ -384,9 +423,7 @@ class TileUpscaler:
         sharded = bind_weights(jitted, self.pipeline._weights(img2img=True))
         key = jax.random.key(seed)
 
-        def run_range(start: int, end: int):
-            import numpy as np
-
+        def run_one(start: int, end: int):
             seg = all_tiles[start:end]
             sseg = all_stiles[start:end]
             if seg.shape[0] < chunk:
@@ -396,9 +433,22 @@ class TileUpscaler:
                 spad = jnp.ones((chunk - sseg.shape[0],) + sseg.shape[1:],
                                 sseg.dtype)
                 sseg = jnp.concatenate([sseg, spad], axis=0)
-            out = sharded(seg, sseg, jnp.int32(start), key, context,
-                          uncond_context, y, uncond_y)
-            return np.asarray(out[: end - start])
+            return sharded(seg, sseg, jnp.int32(start), key, context,
+                           uncond_context, y, uncond_y)[: end - start]
+
+        def run_range(start: int, end: int):
+            """Process [start, end) with the compiled fixed-chunk program.
+
+            Ranges wider than this host's chunk loop over sub-chunks, so
+            a farm task sized by the MASTER's chunk still runs correctly
+            on a worker whose own chunk differs (fewer local devices, a
+            different ``CDT_TILES_PER_DEVICE``, a CPU fallback host) —
+            chunk mismatch costs only padding, never correctness."""
+            import numpy as np
+
+            outs = [run_one(s, min(s + chunk, end))
+                    for s in range(start, end, chunk)]
+            return np.concatenate([np.asarray(o) for o in outs], axis=0)
 
         return TileRangePlan(grid=grid, chunk=chunk, run_range=run_range,
                              feather=spec.feather)

@@ -158,3 +158,31 @@ class TestDispatch:
         out = attn.full_attention(q, k, v)
         ref = dense_reference(q, k, v)
         np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+
+
+class TestLayoutVariants:
+    """The packed-heads ([B,N,H·D]-native) pallas call and the classic
+    pre-transposed [B·H,N,D] (bh) call are the same math — packed keeps
+    q/k/v in the QKV projection's own layout and splits heads inside the
+    kernel (r04 boundary-relayout fix, docs/roofline.md finding 1)."""
+
+    @pytest.mark.parametrize("shape", [
+        (2, 300, 4, 64, 300),     # padded tails on both q and k
+        (1, 1024, 10, 64, 77),    # SDXL cross-attention geometry
+        (2, 513, 3, 128, 200),    # D=128, odd lengths
+    ])
+    def test_packed_matches_bh(self, monkeypatch, shape):
+        from comfyui_distributed_tpu.ops.flash_attention import flash_attention
+
+        b, nq, h, d, nk = shape
+        q = jax.random.normal(jax.random.key(0), (b, nq, h, d))
+        k = jax.random.normal(jax.random.key(1), (b, nk, h, d))
+        v = jax.random.normal(jax.random.key(2), (b, nk, h, d))
+        monkeypatch.setenv("CDT_FLASH_LAYOUT", "packed")
+        a = flash_attention(q, k, v, interpret=True)
+        monkeypatch.setenv("CDT_FLASH_LAYOUT", "bh")
+        b_ = flash_attention(q, k, v, interpret=True)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   atol=1e-6, rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(a), dense_reference(q, k, v),
+                                   atol=5e-2, rtol=5e-2)

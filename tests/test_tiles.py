@@ -238,3 +238,81 @@ def test_range_plan_spatial_cond_matches_upscale(tiny_stack):
     recon = np.asarray(ups.composite(out, plan))
     expect = np.asarray(upscale_image(img[None], 2.0, "lanczos3"))[0]
     np.testing.assert_allclose(recon, expect, atol=2e-2)
+
+
+def test_range_plan_tiles_per_device_invariant():
+    """``tiles_per_device`` is a pure throughput knob: per-tile noise keys
+    fold the GLOBAL tile index, so batching 2 tiles per device per
+    dispatch matches one-at-a-time dispatch (the invariance that makes
+    farm requeue and the r04 batched-chunk USDU bench safe). float32
+    stack, like ``test_upscale_shard_count_independent``: in bfloat16 the
+    bit-level result legitimately varies ~1e-2 with batch shape — round-
+    off, not a placement/batching dependence."""
+    from comfyui_distributed_tpu.diffusion.pipeline import Txt2ImgPipeline
+    from comfyui_distributed_tpu.models.text import TextEncoder, TextEncoderConfig
+    from comfyui_distributed_tpu.models.unet import UNetConfig, init_unet
+    from comfyui_distributed_tpu.models.vae import AutoencoderKL, VAEConfig
+    from comfyui_distributed_tpu.tiles.engine import TileUpscaler
+
+    model, params = init_unet(UNetConfig.tiny(dtype="float32"),
+                              jax.random.key(0),
+                              sample_shape=(8, 8, 4), context_len=16)
+    vae = AutoencoderKL(VAEConfig.tiny(dtype="float32")).init(
+        jax.random.key(1), image_hw=(16, 16))
+    pipe = Txt2ImgPipeline(model, params, vae)
+    enc = TextEncoder(TextEncoderConfig.tiny()).init(jax.random.key(2))
+    ctx, _ = enc.encode(["tile prompt"])
+    unc, _ = enc.encode([""])
+    ups = TileUpscaler(pipe)
+    mesh = build_mesh({"dp": 8})
+    img = jax.random.uniform(jax.random.key(5), (16, 16, 3))
+
+    def all_tiles(tpd):
+        plan = ups.range_plan(mesh, img, _spec(), seed=11, context=ctx,
+                              uncond_context=unc, tiles_per_device=tpd)
+        outs = []
+        for start in range(0, plan.num_tiles, plan.chunk):
+            outs.append(plan.run_range(start, min(start + plan.chunk,
+                                                  plan.num_tiles)))
+        return np.concatenate(outs, axis=0)
+
+    a = all_tiles(1)
+    b = all_tiles(2)
+    assert a.shape == b.shape
+    np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-5)
+
+
+def test_range_plan_range_wider_than_chunk():
+    """A farm task sized by the MASTER's chunk must run on a worker
+    whose own chunk is smaller (fewer devices / different
+    CDT_TILES_PER_DEVICE): run_range loops sub-chunks internally, so
+    the wide call equals the per-chunk calls. float32 stack (bf16
+    round-off varies with batch shape)."""
+    from comfyui_distributed_tpu.diffusion.pipeline import Txt2ImgPipeline
+    from comfyui_distributed_tpu.models.text import TextEncoder, TextEncoderConfig
+    from comfyui_distributed_tpu.models.unet import UNetConfig, init_unet
+    from comfyui_distributed_tpu.models.vae import AutoencoderKL, VAEConfig
+    from comfyui_distributed_tpu.tiles.engine import TileUpscaler
+
+    model, params = init_unet(UNetConfig.tiny(dtype="float32"),
+                              jax.random.key(0),
+                              sample_shape=(8, 8, 4), context_len=16)
+    vae = AutoencoderKL(VAEConfig.tiny(dtype="float32")).init(
+        jax.random.key(1), image_hw=(16, 16))
+    pipe = Txt2ImgPipeline(model, params, vae)
+    enc = TextEncoder(TextEncoderConfig.tiny()).init(jax.random.key(2))
+    ctx, _ = enc.encode(["tile prompt"])
+    unc, _ = enc.encode([""])
+    ups = TileUpscaler(pipe)
+    img = jax.random.uniform(jax.random.key(5), (16, 16, 3))
+
+    # "worker": 2-device mesh, 1 tile per device → chunk 2
+    plan = ups.range_plan(build_mesh({"dp": 2}), img, _spec(), seed=11,
+                          context=ctx, uncond_context=unc,
+                          tiles_per_device=1)
+    assert plan.chunk == 2 and plan.num_tiles == 4
+    wide = plan.run_range(0, 4)          # master-sized task: 2 sub-chunks
+    assert wide.shape[0] == 4
+    parts = np.concatenate([plan.run_range(0, 2), plan.run_range(2, 4)],
+                           axis=0)
+    np.testing.assert_allclose(wide, parts, rtol=1e-6, atol=1e-6)
