@@ -321,12 +321,13 @@ def run_usdu_benchmark(steps: int, runs: int | None, force_cpu: bool) -> dict:
         import numpy as _np
 
         plan = ups.range_plan(mesh, image[0], spec, 7, ctx, unc)
-        T, chunk = plan.num_tiles, plan.chunk
+        T = plan.num_tiles
 
         def full_pass():
-            tiles = _np.concatenate(
-                [plan.run_range(s, min(s + chunk, T))
-                 for s in range(0, T, chunk)], axis=0)
+            # one wide range: run_range loops the compiled fixed-chunk
+            # program internally, dispatching every sub-chunk before
+            # fetching any result (compute/transfer overlap)
+            tiles = plan.run_range(0, T)
             return jax.block_until_ready(ups.composite(tiles, plan))
 
         t0 = time.perf_counter()

@@ -328,9 +328,21 @@ def _flash_mha_packed(q, k, v, num_heads: int, block_q: int, block_k: int,
     return out[:, :Nq]
 
 
-# past this packed width the kernel's VMEM working set (double-buffered
-# K/V blocks + the f32 accumulator) outgrows the ~16 MB budget
+# past this packed width the kernel needs shrunken q/k blocks to keep
+# its VMEM working set (double-buffered [block, H·D] K/V tiles + the
+# f32 accumulator) inside the ~16 MB budget, and the shrink costs more
+# than the boundary relayout saves — measured r04 at FLUX's H·D = 3072:
+# 128/256 blocks ran the offload ladder at 1.34 s/step vs the classic
+# [B·H, N, D] call's 1.21 s (`benchmarks/r04_tpu_flux.json`). Wide
+# layouts therefore stay on the classic call.
 _PACKED_MAX_HD = 2048
+
+
+def _packed_blocks(hd: int, block_q: int, block_k: int) -> tuple[int, int]:
+    """Block sizes for the packed call — a hook for shapes whose VMEM
+    working set needs smaller tiles (none under the current
+    ``_PACKED_MAX_HD``; see the measured note above)."""
+    return block_q, block_k
 
 
 def _layout_packed(H: int, D: int) -> bool:
@@ -367,10 +379,11 @@ def flash_attention(
         out = _flash_emulated(to_bh(q, Nq), to_bh(k, Nk), to_bh(v, Nk),
                               block_q=block_q, block_k=block_k)
     elif _layout_packed(H, D):
+        bq, bk = _packed_blocks(H * D, block_q, block_k)
         out = _flash_mha_packed(
             q.reshape(B, Nq, H * D), k.reshape(B, Nk, H * D),
             v.reshape(B, Nk, H * D), num_heads=H,
-            block_q=block_q, block_k=block_k, interpret=interpret)
+            block_q=bq, block_k=bk, interpret=interpret)
         return out.reshape(B, Nq, H, D)
     else:
         out = _flash_mha(to_bh(q, Nq), to_bh(k, Nk), to_bh(v, Nk),

@@ -443,11 +443,15 @@ class TileUpscaler:
             a farm task sized by the MASTER's chunk still runs correctly
             on a worker whose own chunk differs (fewer local devices, a
             different ``CDT_TILES_PER_DEVICE``, a CPU fallback host) —
-            chunk mismatch costs only padding, never correctness."""
+            chunk mismatch costs only padding, never correctness. All
+            sub-chunks are dispatched before any result is fetched: JAX
+            dispatch is async, so chunk i's device→host transfer
+            overlaps chunk i+1's compute (the fetch rides a slow link on
+            tunneled hosts)."""
             import numpy as np
 
             outs = [run_one(s, min(s + chunk, end))
-                    for s in range(start, end, chunk)]
+                    for s in range(start, end, chunk)]       # all async
             return np.concatenate([np.asarray(o) for o in outs], axis=0)
 
         return TileRangePlan(grid=grid, chunk=chunk, run_range=run_range,

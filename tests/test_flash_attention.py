@@ -170,6 +170,10 @@ class TestLayoutVariants:
         (2, 300, 4, 64, 300),     # padded tails on both q and k
         (1, 1024, 10, 64, 77),    # SDXL cross-attention geometry
         (2, 513, 3, 128, 200),    # D=128, odd lengths
+        (1, 600, 24, 128, 500),   # FLUX geometry: H*D=3072 exceeds
+                                  # _PACKED_MAX_HD -> classic call (the
+                                  # packed request must fall back, not
+                                  # crash; measured slower at r04)
     ])
     def test_packed_matches_bh(self, monkeypatch, shape):
         from comfyui_distributed_tpu.ops.flash_attention import flash_attention
