@@ -313,10 +313,11 @@ def run_usdu_benchmark(steps: int, runs: int | None, force_cpu: bool) -> dict:
         # in one XLA program — right for a pod (tiles shard over chips),
         # an instant OOM for 64 4K-tiles on ONE chip. range_plan processes
         # `chunk = n_devices × tiles_per_device` tiles per dispatch (r04:
-        # batching 8 tiles/device cut the 4K wall-clock 53.3 → 39.6 s —
-        # fewer dispatch RTTs + fuller MXU at 512² tile shapes; the sweep
-        # plateaus from 4 through 16, 32 blows the compile budget),
-        # exactly how the cross-host tile farm drives a host
+        # batching 8 tiles/device + async dispatch/fetch overlap cut the
+        # 4K wall-clock 53.3 → 27.9 s — fewer dispatch RTTs, fuller MXU
+        # at 512² tile shapes, transfers hidden behind compute; the
+        # batch sweep plateaus from 4 through 16, 32 blows the compile
+        # budget), exactly how the cross-host tile farm drives a host
         # (cluster/tile_farm.py).
         import numpy as _np
 
@@ -796,7 +797,17 @@ def run_wan14b_benchmark(steps: int, runs: int | None,
     the overflow streams per step, so on a leaky tunneled host the
     latency is measured at two small step counts and extrapolated
     per-step (exact: the ladder streams identical bytes and runs the
-    same program every step)."""
+    same program every step).
+
+    Measured bound (r04, tunneled 16 GB v5e): this workload is wedged
+    on that host — ≥12.4 GB resident OOMs at runtime (both ladder
+    modes; the 33f×480×832 = 14k-token activations at dim 5120 need
+    more headroom than residency leaves), while ≤11 GB resident streams
+    more bytes per step than the leaky tunnel affords (13 forwards
+    < the 16 the protocol needs). Capturing the artifact needs a host
+    with real DMA (10-40 GB/s — any budget ≤11 GB then affords
+    hundreds of forwards) or a ≥24 GB chip; the CPU tier and
+    `tests/test_offload.py` keep the code path exercised meanwhile."""
     import dataclasses
 
     import jax
