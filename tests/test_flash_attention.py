@@ -190,3 +190,54 @@ class TestLayoutVariants:
                                    atol=1e-6, rtol=1e-6)
         np.testing.assert_allclose(np.asarray(a), dense_reference(q, k, v),
                                    atol=5e-2, rtol=5e-2)
+
+
+class TestShapeGate:
+    """r04 final gate: on TPU (simulated here by patching jax.devices)
+    the default picks flash per shape — packed-legal layouts engage at
+    q ≥ 1024 with K ≥ 256 (measured crossover, docs/roofline.md finding
+    1a), packed-illegal layouts keep the classic 8192 gate."""
+
+    @pytest.fixture()
+    def on_tpu(self, monkeypatch):
+        import types
+
+        from comfyui_distributed_tpu.ops import attention as attn
+
+        monkeypatch.delenv("CDT_FLASH_ATTENTION", raising=False)
+        monkeypatch.delenv("CDT_FLASH_MIN_SEQ", raising=False)
+        monkeypatch.delenv("CDT_FLASH_MIN_SEQ_PACKED", raising=False)
+        monkeypatch.delenv("CDT_FLASH_MIN_KV_PACKED", raising=False)
+        monkeypatch.delenv("CDT_FLASH_LAYOUT", raising=False)
+        fake = types.SimpleNamespace(platform="tpu")
+        monkeypatch.setattr(attn.jax, "devices", lambda *a: [fake])
+        return attn
+
+    def test_packed_legal_engages_at_sdxl_lengths(self, on_tpu):
+        # SDXL self-attention: 4096 tokens, 10 heads × 64
+        assert on_tpu._flash_enabled(q_len=4096, kv_len=4096,
+                                     num_heads=10, head_dim=64)
+        # the 32² block: 1024 tokens — exactly at the packed floor
+        assert on_tpu._flash_enabled(q_len=1024, kv_len=1024,
+                                     num_heads=20, head_dim=64)
+        assert not on_tpu._flash_enabled(q_len=512, kv_len=512,
+                                         num_heads=20, head_dim=64)
+
+    def test_short_kv_cross_attention_stays_on_xla(self, on_tpu):
+        # SDXL cross-attention: K = 77 text tokens → one mostly-padding
+        # K block, measured behind XLA
+        assert not on_tpu._flash_enabled(q_len=4096, kv_len=77,
+                                         num_heads=10, head_dim=64)
+
+    def test_packed_illegal_keeps_classic_gate(self, on_tpu):
+        # FLUX: H·D = 3072 > _PACKED_MAX_HD → classic call, 8192 gate
+        assert not on_tpu._flash_enabled(q_len=4608, kv_len=4608,
+                                         num_heads=24, head_dim=128)
+        assert on_tpu._flash_enabled(q_len=9000, kv_len=9000,
+                                     num_heads=24, head_dim=128)
+
+    def test_shape_free_call_keeps_classic_gate(self, on_tpu):
+        # callers that pass only q_len (no head geometry) get the
+        # classic 8192 threshold
+        assert not on_tpu._flash_enabled(q_len=4096)
+        assert on_tpu._flash_enabled(q_len=8192)
