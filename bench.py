@@ -718,9 +718,11 @@ def _run_flux_offloaded(steps: int, runs: int | None, platform: str) -> dict:
     }
 
 
-def run_wan_benchmark(steps: int, runs: int | None, force_cpu: bool) -> dict:
-    """BASELINE row 4: WAN t2v end-to-end (exact architecture over the 3D
-    causal VAE; 33 frames 480×832 on accel, tiny shapes on CPU)."""
+def _run_wan_like(steps: int, runs: int | None, force_cpu: bool,
+                  moe: bool) -> dict:
+    """Shared body of the ``wan`` / ``wan22`` workloads: identical
+    geometry, pipeline construction, timing protocol, and result shape,
+    so (wan22 − wan) isolates exactly the dual-expert switch."""
     import jax
     import jax.numpy as jnp
 
@@ -753,13 +755,22 @@ def run_wan_benchmark(steps: int, runs: int | None, force_cpu: bool) -> dict:
     vae = WanVAE3D(vae_cfg).init(jax.random.key(1), frames=5,
                                  image_hw=(vae_cfg.downscale * 4,) * 2)
     f_lat = vae_cfg.latent_frames(spec.padded_frames)
-    model, params = init_wan(
-        cfg, jax.random.key(0),
-        sample_fhw=(f_lat, spec.height // vae_cfg.downscale,
-                    spec.width // vae_cfg.downscale),
-        context_len=ctx_len,
-        param_dtype=jnp.bfloat16 if on_accel else None)
-    pipe = VideoPipeline(model, params, vae)
+    sample_fhw = (f_lat, spec.height // vae_cfg.downscale,
+                  spec.width // vae_cfg.downscale)
+    dt = jnp.bfloat16 if on_accel else None
+    model, params = init_wan(cfg, jax.random.key(0),
+                             sample_fhw=sample_fhw,
+                             context_len=ctx_len, param_dtype=dt)
+    if moe:
+        _, params_low = init_wan(cfg, jax.random.key(7),
+                                 sample_fhw=sample_fhw,
+                                 context_len=ctx_len, param_dtype=dt)
+        pipe = VideoPipeline(model, params, vae,
+                             dit_params_low=params_low,
+                             expert_boundary=0.875)
+        assert pipe.is_moe
+    else:
+        pipe = VideoPipeline(model, params, vae)
     ctx = jnp.zeros((1, ctx_len, cfg.text_dim))
     pooled = jnp.zeros((1, 16))
 
@@ -772,9 +783,14 @@ def run_wan_benchmark(steps: int, runs: int | None, force_cpu: bool) -> dict:
     times, median = _timed_runs(
         lambda i: jax.block_until_ready(
             fn(jax.random.key(i + 1), ctx, pooled)), runs)
-    return {
-        "metric": ("wan_t2v_480p_33f_wall_clock_s" if on_accel
-                   else "wan_tiny_t2v_wall_clock_s_cpu"),
+    if moe:
+        metric = ("wan22_moe_t2v_480p_33f_wall_clock_s" if on_accel
+                  else "wan22_moe_tiny_t2v_wall_clock_s_cpu")
+    else:
+        metric = ("wan_t2v_480p_33f_wall_clock_s" if on_accel
+                  else "wan_tiny_t2v_wall_clock_s_cpu")
+    out = {
+        "metric": metric,
         "value": round(median, 3),
         "unit": "seconds",
         "vs_baseline": 1.0,
@@ -786,6 +802,15 @@ def run_wan_benchmark(steps: int, runs: int | None, force_cpu: bool) -> dict:
         "compile_s": round(compile_s, 1),
         "run_times_s": [round(t, 3) for t in times],
     }
+    if moe:
+        out["expert_boundary"] = 0.875
+    return out
+
+
+def run_wan_benchmark(steps: int, runs: int | None, force_cpu: bool) -> dict:
+    """BASELINE row 4: WAN t2v end-to-end (exact architecture over the 3D
+    causal VAE; 33 frames 480×832 on accel, tiny shapes on CPU)."""
+    return _run_wan_like(steps, runs, force_cpu, moe=False)
 
 
 def run_wan14b_benchmark(steps: int, runs: int | None,
@@ -942,82 +967,14 @@ def run_wan22_benchmark(steps: int, runs: int | None,
     """WAN-2.2-style dual-expert (MoE) t2v: TWO DiTs — a high-noise
     expert for sigmas ≥ the 0.875 t2v boundary, a low-noise expert
     below — with the sigma ladder split inside ONE compiled program
-    (``pipeline_video._sample_expert``). Same geometry as the ``wan``
-    workload, so (wan22 − wan) isolates what the expert switch costs on
-    hardware: both experts' weights ride as jit arguments (2× upload,
-    bf16-resident — 1.3B-class pairs fit one chip; published 14B pairs
-    need the offload executor's HBM swap or tp over a pod)."""
-    import jax
-    import jax.numpy as jnp
-
-    if force_cpu:
-        jax.config.update("jax_platforms", "cpu")
-    _enable_compile_cache()
-    platform = jax.devices()[0].platform
-    on_accel = platform not in ("cpu",)
-
-    from comfyui_distributed_tpu.diffusion.pipeline_video import (
-        VideoPipeline, VideoSpec)
-    from comfyui_distributed_tpu.models.wan import WanConfig, init_wan
-    from comfyui_distributed_tpu.models.wan_vae import (WanVAE3D,
-                                                        WanVAEConfig)
-    from comfyui_distributed_tpu.parallel import build_mesh
-
-    if on_accel:
-        cfg, vae_cfg = WanConfig.wan_1_3b(), WanVAEConfig.wan()
-        spec = VideoSpec(frames=33, height=480, width=832, steps=steps)
-        ctx_len = 512
-    else:
-        cfg, vae_cfg = WanConfig.tiny(), WanVAEConfig.tiny()
-        spec = VideoSpec(frames=5, height=16, width=16,
-                         steps=min(steps, 2))
-        ctx_len = 16
-
-    n_dev = len(jax.devices())
-    mesh = build_mesh({"dp": n_dev})
-    vae = WanVAE3D(vae_cfg).init(jax.random.key(1), frames=5,
-                                 image_hw=(vae_cfg.downscale * 4,) * 2)
-    f_lat = vae_cfg.latent_frames(spec.padded_frames)
-    sample_fhw = (f_lat, spec.height // vae_cfg.downscale,
-                  spec.width // vae_cfg.downscale)
-    dt = jnp.bfloat16 if on_accel else None
-    model, params_high = init_wan(cfg, jax.random.key(0),
-                                  sample_fhw=sample_fhw,
-                                  context_len=ctx_len, param_dtype=dt)
-    _, params_low = init_wan(cfg, jax.random.key(7),
-                             sample_fhw=sample_fhw,
-                             context_len=ctx_len, param_dtype=dt)
-    pipe = VideoPipeline(model, params_high, vae,
-                         dit_params_low=params_low,
-                         expert_boundary=0.875)
-    assert pipe.is_moe
-    ctx = jnp.zeros((1, ctx_len, cfg.text_dim))
-    pooled = jnp.zeros((1, 16))
-
-    fn = pipe.generate_fn(mesh, spec)
-    t0 = time.perf_counter()
-    jax.block_until_ready(fn(jax.random.key(0), ctx, pooled))
-    compile_s = time.perf_counter() - t0
-
-    runs = runs or (3 if on_accel else 2)
-    times, median = _timed_runs(
-        lambda i: jax.block_until_ready(
-            fn(jax.random.key(i + 1), ctx, pooled)), runs)
-    return {
-        "metric": ("wan22_moe_t2v_480p_33f_wall_clock_s" if on_accel
-                   else "wan22_moe_tiny_t2v_wall_clock_s_cpu"),
-        "value": round(median, 3),
-        "unit": "seconds",
-        "vs_baseline": 1.0,
-        "vs_baseline_note": "reference publishes no numbers",
-        "platform": platform,
-        "device_kind": jax.devices()[0].device_kind,
-        "devices": n_dev, "steps": spec.steps,
-        "frames": spec.padded_frames, "latent_frames": f_lat,
-        "expert_boundary": 0.875,
-        "compile_s": round(compile_s, 1),
-        "run_times_s": [round(t, 3) for t in times],
-    }
+    (``pipeline_video._sample_expert``). Same geometry, protocol, and
+    result shape as ``wan`` (shared ``_run_wan_like`` body), so
+    (wan22 − wan) isolates what the expert switch costs on hardware —
+    measured r04: 32.49 vs 32.46 s, i.e. free. Both experts' weights
+    ride as jit arguments (2× upload, bf16-resident — 1.3B-class pairs
+    fit one chip; published 14B pairs need the offload executor's HBM
+    swap or tp over a pod)."""
+    return _run_wan_like(steps, runs, force_cpu, moe=True)
 
 
 _WORKLOADS = {
@@ -1151,7 +1108,10 @@ def main() -> None:
                         default="txt2img",
                         help="txt2img (SDXL images/sec), usdu (4K upscale "
                              "wall-clock), flux (flow images/sec), wan "
-                             "(t2v wall-clock)")
+                             "(t2v wall-clock), wan14b (14B t2v via the "
+                             "quantized offload executor), wan22 "
+                             "(dual-expert MoE t2v, same geometry as "
+                             "wan)")
     parser.add_argument("--inner", action="store_true",
                         help="(internal) run the measurement in-process")
     cli = parser.parse_args()
