@@ -253,6 +253,53 @@ class TestFarmMatchesSPMD:
         out = np.asarray(ups.composite(tiles, plan))
         np.testing.assert_allclose(out, ref[0], rtol=1e-5, atol=1e-5)
 
+    def test_worker_chunk_smaller_than_master_task(self, tmp_config):
+        """Cross-host chunk divergence: the MASTER sizes tasks by its own
+        chunk (tiles_per_device=2 -> 4 tiles/task on dp=2), but the
+        worker executing them compiled its plan at tiles_per_device=1
+        (chunk 2). run_range loops sub-chunks internally, so the
+        oversized task still produces the exact tiles (float32) — the
+        protocol never requires hosts to agree on a chunk size."""
+        from comfyui_distributed_tpu.diffusion.pipeline import Txt2ImgPipeline
+        from comfyui_distributed_tpu.models.text import TextEncoder, TextEncoderConfig
+        from comfyui_distributed_tpu.models.unet import UNetConfig, init_unet
+        from comfyui_distributed_tpu.models.vae import AutoencoderKL, VAEConfig
+        from comfyui_distributed_tpu.parallel import build_mesh
+        from comfyui_distributed_tpu.tiles.engine import TileUpscaler, UpscaleSpec
+
+        model, params = init_unet(UNetConfig.tiny(dtype="float32"),
+                                  jax.random.key(0), sample_shape=(8, 8, 4),
+                                  context_len=16)
+        vae = AutoencoderKL(VAEConfig.tiny(dtype="float32")).init(
+            jax.random.key(1), image_hw=(16, 16))
+        pipe = Txt2ImgPipeline(model, params, vae)
+        enc = TextEncoder(TextEncoderConfig.tiny()).init(jax.random.key(2))
+        ctx, _ = enc.encode(["tile prompt"])
+        unc, _ = enc.encode([""])
+        spec = UpscaleSpec(scale=2.0, tile_w=16, tile_h=16, padding=4,
+                           steps=2, denoise=0.4, guidance_scale=1.0)
+        ups = TileUpscaler(pipe)
+        img = jax.random.uniform(jax.random.key(3), (1, 16, 16, 3))
+        mesh = build_mesh({"dp": 2})
+
+        master = ups.range_plan(mesh, img[0], spec, seed=11, context=ctx,
+                                uncond_context=unc, tiles_per_device=2)
+        worker = ups.range_plan(mesh, img[0], spec, seed=11, context=ctx,
+                                uncond_context=unc, tiles_per_device=1)
+        assert worker.chunk < master.chunk
+
+        results = {}
+        tid = 0
+        for start in range(0, master.num_tiles, master.chunk):
+            end = min(start + master.chunk, master.num_tiles)
+            results[tid] = worker.run_range(start, end)   # oversized task
+            tid += 1
+        tiles = assemble_tiles(results, master.num_tiles, master.chunk)
+        out = np.asarray(ups.composite(tiles, master))
+        ref = np.asarray(ups.upscale(mesh, img, spec, seed=11, context=ctx,
+                                     uncond_context=unc))
+        np.testing.assert_allclose(out, ref[0], rtol=1e-5, atol=1e-5)
+
 
 class TestDynamicMode:
     """Per-image (dynamic) mode — reference upscale/modes/dynamic.py: the
