@@ -143,6 +143,53 @@ def _flash_block(q, k, v, m, l, acc, scale):
     return m_new, l_new, acc_new
 
 
+def _ring_block() -> int:
+    """K sub-block length for one ring hop's accumulation. The naive hop
+    materializes [B, H, Nq, Nk_hop] fp32 logits — at video scale (e.g.
+    WAN 32k tokens over 8 shards: 4k × 4k × H) that transient is the
+    largest allocation in the program. Scanning the hop's K/V in
+    sub-blocks bounds it at [B, H, Nq, block]; the accumulation is
+    already streaming-softmax, so the identity is exact (floating-point
+    round-off differs at the usual flash-blocking level). 0 disables
+    sub-blocking (whole hop at once, the pre-r04 behavior)."""
+    import os
+
+    return int(os.environ.get("CDT_RING_BLOCK", "1024"))
+
+
+def _hop_attend(qf, k_cur, v_cur, m, l, acc, scale):
+    """Accumulate one ring hop's K/V shard into the running softmax
+    state, walking K sub-blocks so the logits transient stays bounded
+    (`_ring_block`) for EVERY hop length — full blocks via a fori_loop
+    of dynamic slices (no transposed copy of the hop shard), plus one
+    remainder block when the length doesn't divide. Exact: each
+    sub-block is one `_flash_block` step of the same streaming
+    accumulation."""
+    Nk = k_cur.shape[1]
+    blk = _ring_block()
+    if blk <= 0 or Nk <= blk:
+        return _flash_block(qf, k_cur.astype(jnp.float32),
+                            v_cur.astype(jnp.float32), m, l, acc, scale)
+
+    def block_at(start, length):
+        kb = jax.lax.dynamic_slice_in_dim(k_cur, start, length, 1)
+        vb = jax.lax.dynamic_slice_in_dim(v_cur, start, length, 1)
+        return kb.astype(jnp.float32), vb.astype(jnp.float32)
+
+    def body(i, carry):
+        m, l, acc = carry
+        kb, vb = block_at(i * blk, blk)
+        return _flash_block(qf, kb, vb, m, l, acc, scale)
+
+    n_full = Nk // blk
+    m, l, acc = jax.lax.fori_loop(0, n_full, body, (m, l, acc))
+    rem = Nk - n_full * blk
+    if rem:                                    # static remainder tail
+        kb, vb = block_at(n_full * blk, rem)
+        m, l, acc = _flash_block(qf, kb, vb, m, l, acc, scale)
+    return m, l, acc
+
+
 def ring_attention(
     q: jax.Array, k: jax.Array, v: jax.Array,
     axis: str = constants.AXIS_SEQUENCE,
@@ -161,8 +208,7 @@ def ring_attention(
 
     def body(i, carry):
         m, l, acc, k_cur, v_cur = carry
-        m, l, acc = _flash_block(qf, k_cur.astype(jnp.float32),
-                                 v_cur.astype(jnp.float32), m, l, acc, scale)
+        m, l, acc = _hop_attend(qf, k_cur, v_cur, m, l, acc, scale)
         perm = [(j, (j + 1) % n_shards) for j in range(n_shards)]
         k_nxt = jax.lax.ppermute(k_cur, axis, perm)
         v_nxt = jax.lax.ppermute(v_cur, axis, perm)
@@ -211,8 +257,7 @@ def joint_ring_attention(
 
     def body(i, carry):
         m, l, acc, k_cur, v_cur = carry
-        m, l, acc = _flash_block(qf, k_cur.astype(jnp.float32),
-                                 v_cur.astype(jnp.float32), m, l, acc, scale)
+        m, l, acc = _hop_attend(qf, k_cur, v_cur, m, l, acc, scale)
         perm = [(j, (j + 1) % n_shards) for j in range(n_shards)]
         return (m, l, acc,
                 jax.lax.ppermute(k_cur, axis, perm),
