@@ -41,35 +41,14 @@ def _flash_min_seq() -> int:
     (`scripts/mfu_probe.py forward`, SDXL 1024²: flash-bh 0.1763 s/fwd
     vs XLA 0.1677, trace shows the boundary relayout, not the kernel
     body, as the cost): at N ≤ a few K the O(N²) score matrix fits HBM
-    comfortably and XLA fuses softmax into the matmuls. Only reached
-    when the packed-heads layout is NOT legal (see ``_flash_min_seq_
-    packed``); flash-bh's win is memory at long N (ring/SP sequences,
-    video token counts)."""
-    import os
+    comfortably and XLA fuses softmax into the matmuls. Reached when
+    the packed-heads layout is not legal AND when a packed-legal shape
+    fails the packed floors (the short-K / short-q fall-through below);
+    flash-bh's win is memory at long N (ring/SP sequences, video token
+    counts)."""
+    from ..utils.constants import env_int
 
-    return int(os.environ.get("CDT_FLASH_MIN_SEQ", "8192"))
-
-
-def _flash_min_seq_packed() -> int:
-    """Crossover for the packed-heads ([B,N,H·D]-native) kernel, which
-    has NO boundary relayout: measured r04 it beats XLA already at the
-    SDXL self-attention shapes (4096 tokens: 3.60 vs 4.72 ms/64-op
-    chain; 1024 tokens: 1.38 vs 1.51; end-to-end UNet forward 0.1590 vs
-    0.1678 s — `scripts/mfu_probe.py attn/forward`,
-    `docs/roofline.md`)."""
-    import os
-
-    return int(os.environ.get("CDT_FLASH_MIN_SEQ_PACKED", "1024"))
-
-
-def _flash_min_kv_packed() -> int:
-    """Short-K floor for the packed kernel: at SDXL cross-attention
-    (K = 77 text tokens padded to one 512 block) the kernel wastes most
-    of its K tile and measures behind XLA (1.20 vs 1.04 ms/64-op chain,
-    r04) — those sites stay on XLA's fused lowering."""
-    import os
-
-    return int(os.environ.get("CDT_FLASH_MIN_KV_PACKED", "256"))
+    return env_int("CDT_FLASH_MIN_SEQ", 8192)
 
 
 def _flash_enabled(q_len: Optional[int] = None,
@@ -99,9 +78,15 @@ def _flash_enabled(q_len: Optional[int] = None,
     from .flash_attention import _layout_packed
 
     if (num_heads is not None and head_dim is not None
-            and _layout_packed(num_heads, head_dim)):
-        return (q_len >= _flash_min_seq_packed()
-                and (kv_len is None or kv_len >= _flash_min_kv_packed()))
+            and _layout_packed(num_heads, head_dim, Nq=q_len, Nk=kv_len)):
+        # _layout_packed is env + legality + the packed seq/KV floors —
+        # the same predicate flash_attention uses for its layout choice,
+        # so gate and kernel can't drift.
+        return True
+    # Packed illegal, or a packed-legal shape failed its floors (e.g.
+    # tiny cross-attn K): the classic bh gate — at very long q the
+    # memory win of the streamed softmax still applies, and
+    # ``flash_attention`` makes the matching layout choice.
     return q_len >= _flash_min_seq()
 
 
@@ -152,9 +137,9 @@ def _ring_block() -> int:
     already streaming-softmax, so the identity is exact (floating-point
     round-off differs at the usual flash-blocking level). 0 disables
     sub-blocking (whole hop at once, the pre-r04 behavior)."""
-    import os
+    from ..utils.constants import env_int
 
-    return int(os.environ.get("CDT_RING_BLOCK", "1024"))
+    return env_int("CDT_RING_BLOCK", 1024)
 
 
 def _hop_attend(qf, k_cur, v_cur, m, l, acc, scale):

@@ -290,10 +290,10 @@ def _flash_mha_packed(q, k, v, num_heads: int, block_q: int, block_k: int,
                       interpret: bool):
     """Packed-heads pallas call: operands stay [B, N, H·D] — the QKV
     projection's own output layout — and the kernel splits heads along
-    the minor axis (free). Legality (``_layout_packed``): H·D % 128 == 0
-    and H ≤ 128 and H·D ≤ ``_PACKED_MAX_HD`` — true for SDXL (640/1280)
-    and WAN (1536); FLUX (3072) exceeds the VMEM bound and stays on the
-    classic [B·H, N, D] call."""
+    the minor axis (free). Legality (``_packed_legal``): H·D % 128 == 0,
+    H ≤ 128, H·D ≤ ``_PACKED_MAX_HD``, and D % 64 == 0 (lane-aligned
+    head slices) — true for SDXL (640/1280) and WAN (1536); FLUX (3072)
+    exceeds the VMEM bound and stays on the classic [B·H, N, D] call."""
     B, Nq, HD = q.shape
     _, Nk, _ = k.shape
     D = HD // num_heads
@@ -345,40 +345,101 @@ def _packed_blocks(hd: int, block_q: int, block_k: int) -> tuple[int, int]:
     return block_q, block_k
 
 
-def _layout_packed(H: int, D: int) -> bool:
-    """Kernel I/O layout: ``packed`` (default where legal) keeps q/k/v in
-    the model's natural [B, N, H·D] layout and splits heads inside the
-    kernel; ``bh`` is the classic pre-transposed [B·H, N, D] call.
-    ``CDT_FLASH_LAYOUT=bh`` restores the old behavior everywhere."""
+def _flash_min_seq_packed() -> int:
+    """Engagement floor for the packed-heads layout: measured r04 it
+    beats XLA already at SDXL self-attention lengths (docs/roofline.md
+    finding 1a) but not below ~1024 tokens."""
+    from ..utils.constants import env_int
+
+    return env_int("CDT_FLASH_MIN_SEQ_PACKED", 1024)
+
+
+def _flash_min_kv_packed() -> int:
+    """Short-K floor for the packed kernel: at SDXL cross-attention
+    (K = 77 text tokens padded to one 512 block) the kernel wastes most
+    of its K tile and measures behind XLA (1.20 vs 1.04 ms/64-op chain,
+    r04) — those sites stay on XLA's fused lowering / the classic bh
+    call."""
+    from ..utils.constants import env_int
+
+    return env_int("CDT_FLASH_MIN_KV_PACKED", 256)
+
+
+def _packed_legal(H: int, D: int) -> bool:
+    """Pure geometric legality of the packed-heads layout. D % 64 keeps
+    the in-kernel head slices register-lane aligned and confines the
+    layout to the tested head-dim classes (64/128); e.g. H=128, D=16
+    would pass the packed-width checks but unroll a 128-way head loop
+    over 16-wide lane slices — a shape class never measured and likely
+    Mosaic-hostile."""
+    return ((H * D) % _LANES == 0 and H <= _LANES
+            and H * D <= _PACKED_MAX_HD and D % 64 == 0)
+
+
+def _layout_packed(H: int, D: int,
+                   Nq: Optional[int] = None,
+                   Nk: Optional[int] = None) -> bool:
+    """Kernel I/O layout: ``packed`` (default where legal AND the
+    measured engagement floors hold) keeps q/k/v in the model's natural
+    [B, N, H·D] layout and splits heads inside the kernel; ``bh`` is the
+    classic pre-transposed [B·H, N, D] call.
+
+    ``CDT_FLASH_LAYOUT=bh`` restores the classic call everywhere;
+    ``CDT_FLASH_LAYOUT=packed`` is the default (packed where legal and
+    the floors hold — both env states behave identically, preserving
+    the historical meaning of an exported ``packed``). An explicit
+    per-call layout override is ``flash_attention(..., layout=...)``.
+    Without ``Nq``/``Nk`` (the shape-gate site, which applies its own
+    thresholds) only legality and the env override are checked."""
     import os
 
-    if os.environ.get("CDT_FLASH_LAYOUT", "packed").lower() == "bh":
+    env = os.environ.get("CDT_FLASH_LAYOUT", "").lower()
+    if env == "bh":
         return False
-    return (H * D) % _LANES == 0 and H <= _LANES and H * D <= _PACKED_MAX_HD
+    if not _packed_legal(H, D):
+        return False
+    # The packed call must also clear its measured floors, so a
+    # user-raised CDT_FLASH_MIN_SEQ_PACKED/KV floor is never bypassed by
+    # the shape gate's classic fall-through (r04 review finding).
+    return ((Nq is None or Nq >= _flash_min_seq_packed())
+            and (Nk is None or Nk >= _flash_min_kv_packed()))
 
 
 def flash_attention(
     q: jax.Array, k: jax.Array, v: jax.Array,
     block_q: int = 256, block_k: int = 512,
     interpret: Optional[bool] = None,
+    layout: Optional[str] = None,
 ) -> jax.Array:
     """Exact bidirectional attention, [B,N,H,D] layout (matching
     ``ops.attention.full_attention``), computed by the pallas kernel.
 
     ``interpret=None`` auto-selects: compiled on TPU, interpreter
     elsewhere (CPU tests run the same kernel code path).
+
+    ``layout`` forces the kernel I/O layout for this call: ``"packed"``
+    (where geometrically legal — illegal geometries still fall back) or
+    ``"bh"``; ``None`` auto-selects per ``_layout_packed`` (legality +
+    measured floors + ``CDT_FLASH_LAYOUT``). Used by layout-equivalence
+    tests and power users; the env var remains the global knob.
     """
     if interpret is None:
         interpret = not _on_tpu()
     B, Nq, H, D = q.shape
     _, Nk, _, _ = k.shape
+    if layout == "packed":
+        use_packed = _packed_legal(H, D)   # explicit beats env + floors
+    elif layout == "bh":
+        use_packed = False
+    else:
+        use_packed = _layout_packed(H, D, Nq=Nq, Nk=Nk)
     # [B,N,H,D] → [B·H, N, D]
     def to_bh(x, n):
         return x.transpose(0, 2, 1, 3).reshape(B * H, n, D)
     if interpret and _in_manual_trace(q):
         out = _flash_emulated(to_bh(q, Nq), to_bh(k, Nk), to_bh(v, Nk),
                               block_q=block_q, block_k=block_k)
-    elif _layout_packed(H, D):
+    elif use_packed:
         bq, bk = _packed_blocks(H * D, block_q, block_k)
         out = _flash_mha_packed(
             q.reshape(B, Nq, H * D), k.reshape(B, Nk, H * D),

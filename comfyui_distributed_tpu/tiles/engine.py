@@ -315,9 +315,9 @@ class TileUpscaler:
         with tile area, so the default halves as tiles grow past 512².
         ``CDT_TILES_PER_DEVICE`` overrides.
         """
-        import os
+        from ..utils.constants import env_int
 
-        env = int(os.environ.get("CDT_TILES_PER_DEVICE", "0"))
+        env = env_int("CDT_TILES_PER_DEVICE", 0)
         if env > 0:
             return env
         try:
@@ -436,6 +436,8 @@ class TileUpscaler:
             return sharded(seg, sseg, jnp.int32(start), key, context,
                            uncond_context, y, uncond_y)[: end - start]
 
+        _empty_spec: list = []   # cached eval_shape result for empty ranges
+
         def run_range(start: int, end: int):
             """Process [start, end) with the compiled fixed-chunk program.
 
@@ -450,6 +452,27 @@ class TileUpscaler:
             tunneled hosts)."""
             import numpy as np
 
+            if start >= end:
+                # zero-width task (e.g. a requeue race handed out an
+                # empty range): no-op instead of crashing the worker on
+                # np.concatenate([]) — shape/dtype from the compiled
+                # program's own output spec so the two paths can't
+                # drift. The abstract trace is cached after the first
+                # empty call (and never paid by plans that only run
+                # real ranges).
+                if not _empty_spec:
+                    seg = jax.ShapeDtypeStruct(
+                        (chunk,) + tuple(all_tiles.shape[1:]),
+                        all_tiles.dtype)
+                    sseg = jax.ShapeDtypeStruct(
+                        (chunk,) + tuple(all_stiles.shape[1:]),
+                        all_stiles.dtype)
+                    _empty_spec.append(jax.eval_shape(
+                        sharded, seg, sseg, jnp.int32(0), key, context,
+                        uncond_context, y, uncond_y))
+                out = _empty_spec[0]
+                return np.zeros((0,) + tuple(out.shape[1:]),
+                                dtype=out.dtype)
             outs = [run_one(s, min(s + chunk, end))
                     for s in range(start, end, chunk)]       # all async
             return np.concatenate([np.asarray(o) for o in outs], axis=0)

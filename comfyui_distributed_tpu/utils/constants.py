@@ -10,17 +10,35 @@ from __future__ import annotations
 import os
 
 
-def _env_int(name: str, default: int) -> int:
+_warned_envs: set[str] = set()
+
+
+def _warn_malformed(name: str, default) -> None:
+    if name not in _warned_envs:
+        _warned_envs.add(name)
+        from .logging import log   # lazy: keep this module stdlib-only
+
+        log(f"ignoring malformed {name}={os.environ.get(name)!r}; "
+            f"using default {default}")
+
+
+def env_int(name: str, default: int) -> int:
+    """Safe env-int read: a malformed value logs one warning and falls
+    back to the default instead of raising mid-job (an env typo must not
+    crash a worker's hot loop)."""
     try:
         return int(os.environ.get(name, default))
     except (TypeError, ValueError):
+        _warn_malformed(name, default)
         return default
 
 
-def _env_float(name: str, default: float) -> float:
+def env_float(name: str, default: float) -> float:
+    """Safe env-float read; same malformed-value fallback as ``env_int``."""
     try:
         return float(os.environ.get(name, default))
     except (TypeError, ValueError):
+        _warn_malformed(name, default)
         return default
 
 
@@ -35,41 +53,41 @@ TILE_JOURNAL_DIR = os.environ.get("CDT_TILE_JOURNAL_DIR", "")
 # HBM headroom on large latents/frames); tiny test configs ignore it.
 REMAT = os.environ.get("CDT_REMAT", "") not in ("", "0", "false")
 
-HEARTBEAT_INTERVAL = _env_float("CDT_HEARTBEAT_INTERVAL", 10.0)
-HEARTBEAT_TIMEOUT = _env_float("CDT_HEARTBEAT_TIMEOUT", 60.0)
+HEARTBEAT_INTERVAL = env_float("CDT_HEARTBEAT_INTERVAL", 10.0)
+HEARTBEAT_TIMEOUT = env_float("CDT_HEARTBEAT_TIMEOUT", 60.0)
 
 # --- payload caps ----------------------------------------------------------
 # Reference caps tile uploads at 50 MB (upscale/job_store.py:12) and audio
 # envelopes at 256 MB (utils/audio_payload.py:11-13).
-MAX_PAYLOAD_SIZE = _env_int("CDT_MAX_PAYLOAD_SIZE", 50 * 1024 * 1024)
-MAX_AUDIO_PAYLOAD_BYTES = _env_int("CDT_MAX_AUDIO_PAYLOAD_BYTES", 256 * 1024 * 1024)
+MAX_PAYLOAD_SIZE = env_int("CDT_MAX_PAYLOAD_SIZE", 50 * 1024 * 1024)
+MAX_AUDIO_PAYLOAD_BYTES = env_int("CDT_MAX_AUDIO_PAYLOAD_BYTES", 256 * 1024 * 1024)
 
 # Max result items per flush from a worker host (reference MAX_BATCH=20,
 # utils/constants.py; upscale/modes/static.py:303-306).
-MAX_BATCH = _env_int("CDT_MAX_BATCH", 20)
+MAX_BATCH = env_int("CDT_MAX_BATCH", 20)
 
 # --- orchestration concurrencies (reference utils/config.py:22-45) ---------
-WORKER_PROBE_CONCURRENCY = _env_int("CDT_PROBE_CONCURRENCY", 10)
-WORKER_PREP_CONCURRENCY = _env_int("CDT_PREP_CONCURRENCY", 4)
-MEDIA_SYNC_CONCURRENCY = _env_int("CDT_MEDIA_SYNC_CONCURRENCY", 4)
+WORKER_PROBE_CONCURRENCY = env_int("CDT_PROBE_CONCURRENCY", 10)
+WORKER_PREP_CONCURRENCY = env_int("CDT_PREP_CONCURRENCY", 4)
+MEDIA_SYNC_CONCURRENCY = env_int("CDT_MEDIA_SYNC_CONCURRENCY", 4)
 
 # --- timeouts --------------------------------------------------------------
-PROBE_TIMEOUT = _env_float("CDT_PROBE_TIMEOUT", 5.0)
-DISPATCH_TIMEOUT = _env_float("CDT_DISPATCH_TIMEOUT", 30.0)
-MEDIA_SYNC_TIMEOUT = _env_float("CDT_MEDIA_SYNC_TIMEOUT", 120.0)
-COLLECT_POLL_TIMEOUT = _env_float("CDT_COLLECT_POLL_TIMEOUT", 5.0)
+PROBE_TIMEOUT = env_float("CDT_PROBE_TIMEOUT", 5.0)
+DISPATCH_TIMEOUT = env_float("CDT_DISPATCH_TIMEOUT", 30.0)
+MEDIA_SYNC_TIMEOUT = env_float("CDT_MEDIA_SYNC_TIMEOUT", 120.0)
+COLLECT_POLL_TIMEOUT = env_float("CDT_COLLECT_POLL_TIMEOUT", 5.0)
 # On collector drain timeout, silent-but-busy workers are granted grace
 # extensions of COLLECT_GRACE_S each, at most COLLECT_MAX_GRACE_ROUNDS times
 # (reference probes /prompt and extends while queue_remaining>0,
 # nodes/collector.py:414-470).
-COLLECT_GRACE_S = _env_float("CDT_COLLECT_GRACE_S", 30.0)
-COLLECT_MAX_GRACE_ROUNDS = _env_int("CDT_COLLECT_MAX_GRACE_ROUNDS", 20)
-JOB_INIT_GRACE = _env_float("CDT_JOB_INIT_GRACE", 10.0)
-WORK_REQUEST_BUDGET = _env_float("CDT_WORK_REQUEST_BUDGET", 30.0)
+COLLECT_GRACE_S = env_float("CDT_COLLECT_GRACE_S", 30.0)
+COLLECT_MAX_GRACE_ROUNDS = env_int("CDT_COLLECT_MAX_GRACE_ROUNDS", 20)
+JOB_INIT_GRACE = env_float("CDT_JOB_INIT_GRACE", 10.0)
+WORK_REQUEST_BUDGET = env_float("CDT_WORK_REQUEST_BUDGET", 30.0)
 
 # --- retries (reference upscale/worker_comms.py:88-104) --------------------
-SEND_MAX_RETRIES = _env_int("CDT_SEND_MAX_RETRIES", 5)
-SEND_BACKOFF_BASE = _env_float("CDT_SEND_BACKOFF_BASE", 0.5)
+SEND_MAX_RETRIES = env_int("CDT_SEND_MAX_RETRIES", 5)
+SEND_BACKOFF_BASE = env_float("CDT_SEND_BACKOFF_BASE", 0.5)
 
 # --- mesh / sharding defaults ---------------------------------------------
 # Axis names used across the framework. "dp" shards independent jobs/seeds
@@ -83,6 +101,6 @@ AXIS_SEQUENCE = "sp"
 # 3D-VAE decodes switch to spatially-tiled mode when the latent frame area
 # exceeds this (latent pixels): a 480p WAN clip decode holds >31 GB of f32
 # activations untiled. 0 disables the threshold (always whole-frame).
-VAE_TILE_THRESHOLD = int(os.environ.get("CDT_VAE_TILE_THRESHOLD", 48 * 48))
-VAE_TILE = int(os.environ.get("CDT_VAE_TILE", 32))
-VAE_TILE_OVERLAP = int(os.environ.get("CDT_VAE_TILE_OVERLAP", 8))
+VAE_TILE_THRESHOLD = env_int("CDT_VAE_TILE_THRESHOLD", 48 * 48)
+VAE_TILE = env_int("CDT_VAE_TILE", 32)
+VAE_TILE_OVERLAP = env_int("CDT_VAE_TILE_OVERLAP", 8)

@@ -182,10 +182,9 @@ class TestLayoutVariants:
         q = jax.random.normal(jax.random.key(0), (b, nq, h, d))
         k = jax.random.normal(jax.random.key(1), (b, nk, h, d))
         v = jax.random.normal(jax.random.key(2), (b, nk, h, d))
-        monkeypatch.setenv("CDT_FLASH_LAYOUT", "packed")
-        a = flash_attention(q, k, v, interpret=True)
-        monkeypatch.setenv("CDT_FLASH_LAYOUT", "bh")
-        b_ = flash_attention(q, k, v, interpret=True)
+        monkeypatch.delenv("CDT_FLASH_LAYOUT", raising=False)
+        a = flash_attention(q, k, v, interpret=True, layout="packed")
+        b_ = flash_attention(q, k, v, interpret=True, layout="bh")
         np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
                                    atol=1e-6, rtol=1e-6)
         np.testing.assert_allclose(np.asarray(a), dense_reference(q, k, v),
@@ -241,3 +240,28 @@ class TestShapeGate:
         # classic 8192 threshold
         assert not on_tpu._flash_enabled(q_len=4096)
         assert on_tpu._flash_enabled(q_len=8192)
+
+    def test_short_kv_long_q_falls_through_to_classic_gate(self, on_tpu):
+        # packed-legal geometry whose KV floor fails must still reach
+        # the classic bh gate at very long q (streamed-softmax memory
+        # win), not silently drop flash entirely (r04 advisor finding)
+        assert on_tpu._flash_enabled(q_len=16384, kv_len=77,
+                                     num_heads=10, head_dim=64)
+        assert not on_tpu._flash_enabled(q_len=4096, kv_len=77,
+                                         num_heads=10, head_dim=64)
+
+    def test_packed_layout_requires_lane_aligned_head_dim(self, monkeypatch):
+        # H=128, D=16 passes the packed-width checks but would unroll a
+        # 128-way head loop over 16-wide lane slices — excluded
+        from comfyui_distributed_tpu.ops.flash_attention import _layout_packed
+
+        monkeypatch.delenv("CDT_FLASH_LAYOUT", raising=False)
+        assert not _layout_packed(128, 16)
+        assert _layout_packed(10, 64)
+        assert _layout_packed(16, 128)
+
+    def test_malformed_gate_env_falls_back(self, on_tpu, monkeypatch):
+        # an env typo must degrade to the default, not crash the gate
+        monkeypatch.setenv("CDT_FLASH_MIN_SEQ_PACKED", "banana")
+        assert on_tpu._flash_enabled(q_len=4096, kv_len=4096,
+                                     num_heads=10, head_dim=64)

@@ -240,6 +240,25 @@ def test_range_plan_spatial_cond_matches_upscale(tiny_stack):
     np.testing.assert_allclose(recon, expect, atol=2e-2)
 
 
+def test_range_plan_empty_range_noops(tiny_stack):
+    """run_range(start, start) returns an empty tile array instead of
+    crashing on np.concatenate([]) (r04 advisor finding) — a zero-width
+    farm task must no-op, not kill the worker."""
+    from comfyui_distributed_tpu.tiles.engine import TileUpscaler
+
+    pipe, ctx, unc = tiny_stack
+    ups = TileUpscaler(pipe)
+    mesh = build_mesh({"dp": 8})
+    img = jax.random.uniform(jax.random.key(3), (16, 16, 3))
+    plan = ups.range_plan(mesh, img, _spec(), seed=11, context=ctx,
+                          uncond_context=unc)
+    out = plan.run_range(2, 2)
+    assert out.shape[0] == 0
+    full = plan.run_range(0, plan.num_tiles)
+    assert out.shape[1:] == full.shape[1:]
+    assert out.dtype == full.dtype
+
+
 def test_range_plan_tiles_per_device_invariant():
     """``tiles_per_device`` is a pure throughput knob: per-tile noise keys
     fold the GLOBAL tile index, so batching 2 tiles per device per
