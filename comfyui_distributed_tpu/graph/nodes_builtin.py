@@ -33,7 +33,7 @@ import numpy as np
 
 from ..utils.exceptions import ValidationError
 from ..utils.logging import debug_log, log
-from .node import NodeDef, register_node
+from .node import NODE_REGISTRY, NodeDef, register_node
 
 
 def _chunk_bounds(total: int, parts: int) -> list[tuple[int, int]]:
@@ -1399,6 +1399,107 @@ class SaveAudio(NodeDef):
             paths.append(str(p))
         log(f"saved {len(paths)} audio clips to {out_dir}")
         return ()
+
+
+@register_node("LoadVideo")
+class LoadVideo(NodeDef):
+    """Video container → IMAGE frame batch + AUDIO + fps + frame count.
+
+    Reference-ecosystem parity: the ``VHS_LoadVideo`` node type its video
+    workflows assume (``/root/reference/workflows/
+    distributed-upscale-video.json``; the reference itself free-rides on
+    VideoHelperSuite for the file edge). Frame-selection knobs (cap /
+    skip / stride) mirror that surface. Containers: mp4/webm via OpenCV,
+    plus this framework's MJPG+PCM AVI with a truly muxed audio track
+    (``utils/video_io.py`` — no ffmpeg exists in this environment)."""
+
+    INPUTS = {"video": "STRING"}
+    OPTIONAL = {"frame_load_cap": "INT", "skip_first_frames": "INT",
+                "select_every_nth": "INT"}
+    HIDDEN = {"input_dir": "STRING"}
+    RETURNS = ("IMAGE", "AUDIO", "FLOAT", "INT")
+
+    def execute(self, video: str, frame_load_cap: int = 0,
+                skip_first_frames: int = 0, select_every_nth: int = 1,
+                input_dir: str = "", **_):
+        from ..utils.video_io import load_video
+
+        path = Path(input_dir or "input") / video
+        if not path.exists():
+            raise ValidationError(f"video file not found: {path}",
+                                  field="video")
+        clip = load_video(path, frame_load_cap=int(frame_load_cap),
+                          skip_first_frames=int(skip_first_frames),
+                          select_every_nth=int(select_every_nth))
+        # audio-less containers emit a valid zero-length AUDIO dict so
+        # any downstream AUDIO consumer (SaveAudio, dividers) degrades
+        # to a no-op instead of crashing on None
+        audio = clip["audio"] or {
+            "waveform": np.zeros((1, 1, 0), np.float32),
+            "sample_rate": 44100,
+        }
+        return (jnp.asarray(clip["frames"]), audio,
+                float(clip["fps"]), int(clip["frame_count"]))
+
+
+@register_node("SaveVideo")
+class SaveVideo(NodeDef):
+    """IMAGE frame batch (+ optional AUDIO) → playable video container.
+
+    Reference-ecosystem parity: the ``VHS_VideoCombine`` surface (frame
+    rate, format, audio mux, filename prefix). Formats: ``avi`` writes
+    MJPG+PCM with the audio track genuinely muxed (pure-Python RIFF
+    muxer); ``mp4``/``webm`` write via OpenCV with audio as a sidecar
+    ``.wav`` that ``LoadVideo`` re-attaches — a documented divergence
+    from the reference's ffmpeg mux (no ffmpeg in this image). Returns
+    the container path for downstream chaining."""
+
+    INPUTS = {"images": "IMAGE", "frame_rate": "FLOAT"}
+    OPTIONAL = {"audio": "AUDIO", "format": "STRING",
+                "filename_prefix": "STRING", "quality": "INT"}
+    HIDDEN = {"output_dir": "STRING"}
+    RETURNS = ("STRING",)
+    OUTPUT_NODE = True
+
+    _FORMATS = ("mp4", "webm", "avi")
+
+    def execute(self, images, frame_rate: float = 8.0, audio=None,
+                format: str = "mp4", filename_prefix: str = "video",
+                quality: int = 95, output_dir: str = "", **_):
+        from ..utils.video_io import save_video
+
+        # tolerate VHS-style format strings ("video/h264-mp4")
+        fmt = str(format).lower()
+        fmt = next((f for f in self._FORMATS if f in fmt), fmt)
+        if fmt not in self._FORMATS:
+            raise ValidationError(
+                f"unsupported video format {format!r} "
+                f"(supported: {list(self._FORMATS)})", field="format")
+        out_dir = Path(output_dir or "output")
+        out_dir.mkdir(parents=True, exist_ok=True)
+        # uniqueness must cover the audio-sidecar namespace too: all
+        # formats share "<stem>.wav", so a free .webm slot whose .wav is
+        # taken by an earlier .mp4 save would silently clobber that
+        # video's audio
+        i = 0
+        while True:
+            stem = out_dir / f"{filename_prefix}_{i:05d}.{fmt}"
+            if not stem.exists() and not stem.with_suffix(".wav").exists():
+                break
+            i += 1
+        written = save_video(stem, images, fps=float(frame_rate),
+                             audio=audio, quality=int(quality))
+        log(f"saved video {written[0]}"
+            + (f" (+ sidecar {written[1]})" if len(written) > 1 else ""))
+        return (written[0],)
+
+
+# Drop-in aliases so reference workflow JSON naming the VideoHelperSuite
+# node types executes unchanged (distributed-upscale-video.json uses
+# VHS_LoadVideo / VHS_VideoCombine; extra VHS-only inputs are tolerated
+# by the executor's forward-compat rule).
+NODE_REGISTRY["VHS_LoadVideo"] = LoadVideo
+NODE_REGISTRY["VHS_VideoCombine"] = SaveVideo
 
 
 @register_node("PrimitiveInt")

@@ -431,8 +431,11 @@ def flash_attention(
         use_packed = _packed_legal(H, D)   # explicit beats env + floors
     elif layout == "bh":
         use_packed = False
-    else:
+    elif layout is None:
         use_packed = _layout_packed(H, D, Nq=Nq, Nk=Nk)
+    else:
+        raise ValueError(
+            f"layout must be 'packed', 'bh', or None, got {layout!r}")
     # [B,N,H,D] → [B·H, N, D]
     def to_bh(x, n):
         return x.transpose(0, 2, 1, 3).reshape(B * H, n, D)

@@ -1,0 +1,376 @@
+"""Video container I/O — the file edge for video workflows.
+
+The reference free-rides on the VideoHelperSuite ecosystem nodes for
+this: ``VHS_LoadVideo`` / ``VHS_VideoCombine`` appear in
+``/root/reference/workflows/distributed-upscale-video.json`` and carry
+mp4/webm in and out of the graph, with audio muxed by ffmpeg. This image
+has no ffmpeg binary and no PyAV, so the TPU build closes the same loop
+with what is actually available:
+
+- **mp4 / webm** — OpenCV's ``VideoWriter``/``VideoCapture`` (mp4v /
+  VP80 fourccs verified in this image). cv2 cannot mux audio, so when an
+  AUDIO track is attached the waveform is written as a sidecar
+  ``<name>.wav`` beside the container and re-attached automatically by
+  ``load_video``.
+- **avi** — a pure-Python RIFF muxer/demuxer (MJPG video + 16-bit PCM
+  audio, interleaved per frame): the one mainstream container whose
+  writer is simple enough to own outright, giving a genuinely *muxed*
+  audio track with zero native dependencies. Playable by VLC/ffplay/
+  anything with MJPG support.
+
+Frames ride the graph as IMAGE batches ``[T, H, W, C]`` float32 in
+[0, 1] (the framework's tensor convention, ``utils/image.py``); AUDIO is
+the ``{"waveform": [B, C, S], "sample_rate"}`` dict of
+``utils/audio_payload.py``.
+"""
+
+from __future__ import annotations
+
+import struct
+from pathlib import Path
+from typing import Any, Optional
+
+import numpy as np
+
+from .exceptions import ValidationError
+
+# Writable cv2 containers (fourccs verified working in this image);
+# reading is extension-agnostic (whatever cv2's backend decodes). The
+# media-sync gating list lives in cluster/media_sync.py.
+_FOURCC = {".mp4": "mp4v", ".webm": "VP80"}
+
+
+def _require_cv2():
+    try:
+        import cv2
+    except ImportError as exc:                       # pragma: no cover
+        raise ValidationError(
+            "video container I/O needs OpenCV (cv2), which is not "
+            "importable in this environment") from exc
+    return cv2
+
+
+def _to_uint8_frames(frames: Any) -> np.ndarray:
+    """IMAGE batch → [T, H, W, 3] uint8 (grayscale replicated, alpha
+    stripped); quantization delegates to the framework-wide rule in
+    ``utils.image.to_uint8`` so video and PNG output can't diverge."""
+    from .image import to_uint8
+
+    arr = np.asarray(frames)
+    if arr.ndim == 3 and arr.shape[-1] > 4:      # [T,H,W] grayscale
+        arr = arr[..., None]
+    arr = to_uint8(arr)
+    if arr.shape[-1] == 1:
+        arr = np.repeat(arr, 3, axis=-1)
+    elif arr.shape[-1] == 4:
+        arr = arr[..., :3]
+    return arr
+
+
+def _audio_pcm16(audio: dict[str, Any]) -> tuple[np.ndarray, int]:
+    """AUDIO dict → ([S, C] int16, sample_rate); one container carries
+    one track, so a multi-clip batch keeps clip 0 and WARNS about the
+    rest (SaveAudio is the node that writes one file per element)."""
+    wf = np.asarray(audio["waveform"], dtype=np.float32)
+    if wf.ndim == 2:
+        wf = wf[None]
+    if wf.ndim != 3:
+        raise ValidationError(
+            f"audio waveform must be [B,C,S], got shape {wf.shape}")
+    if wf.shape[0] > 1:
+        from .logging import log
+
+        log(f"video audio track: batch of {wf.shape[0]} clips, muxing "
+            f"clip 0 only (use SaveAudio for one file per clip)")
+    sr = int(audio.get("sample_rate", 44100))
+    pcm = (np.clip(wf[0], -1.0, 1.0) * 32767.0).astype(np.int16)
+    return pcm.T.copy(), sr                          # [S, C]
+
+
+# --------------------------------------------------------------------------
+# AVI (RIFF) muxer: MJPG video + PCM audio, interleaved
+# --------------------------------------------------------------------------
+
+def _chunk(ckid: bytes, payload: bytes) -> bytes:
+    pad = b"\x00" if len(payload) % 2 else b""
+    return ckid + struct.pack("<I", len(payload)) + payload + pad
+
+
+def _list_chunk(list_type: bytes, payload: bytes) -> bytes:
+    return _chunk(b"LIST", list_type + payload)
+
+
+def write_avi_mjpg(path: Path, frames: np.ndarray, fps: float,
+                   pcm: Optional[np.ndarray] = None,
+                   sample_rate: int = 44100, quality: int = 95) -> None:
+    """Write an AVI container: MJPG frames + optional interleaved 16-bit
+    PCM audio. ``frames`` [T,H,W,3] uint8 RGB; ``pcm`` [S, C] int16."""
+    cv2 = _require_cv2()
+    T, H, W, _ = frames.shape
+    jpegs = []
+    for i in range(T):
+        ok, buf = cv2.imencode(
+            ".jpg", cv2.cvtColor(frames[i], cv2.COLOR_RGB2BGR),
+            [int(cv2.IMWRITE_JPEG_QUALITY), int(quality)])
+        if not ok:                                   # pragma: no cover
+            raise ValidationError(f"JPEG encode failed for frame {i}")
+        jpegs.append(buf.tobytes())
+
+    has_audio = pcm is not None and pcm.size > 0
+    n_ch = int(pcm.shape[1]) if has_audio else 0
+    block_align = 2 * n_ch
+    byte_rate = sample_rate * block_align
+
+    # ---- stream headers --------------------------------------------------
+    # fps as a rational with ms precision: rate/scale
+    scale, rate = 1000, int(round(fps * 1000))
+    strh_v = struct.pack(
+        "<4s4sIHHIIIIIIII4H", b"vids", b"MJPG", 0, 0, 0, 0,
+        scale, rate, 0, T, max(len(j) for j in jpegs), 0xFFFFFFFF, 0,
+        0, 0, W, H)
+    # BITMAPINFOHEADER
+    strf_v = struct.pack("<IiiHH4sIiiII", 40, W, H, 1, 24, b"MJPG",
+                         W * H * 3, 0, 0, 0, 0)
+    strl_v = _list_chunk(b"strl",
+                         _chunk(b"strh", strh_v) + _chunk(b"strf", strf_v))
+
+    streams = [strl_v]
+    if has_audio:
+        n_samples = pcm.shape[0]
+        strh_a = struct.pack(
+            "<4s4sIHHIIIIIIII4H", b"auds", b"\x00\x00\x00\x00", 0, 0, 0, 0,
+            block_align, byte_rate, 0,
+            n_samples * block_align // max(block_align, 1),
+            byte_rate, 0xFFFFFFFF, block_align, 0, 0, 0, 0)
+        # WAVEFORMATEX (PCM)
+        strf_a = struct.pack("<HHIIHHH", 1, n_ch, sample_rate, byte_rate,
+                             block_align, 16, 0)
+        streams.append(_list_chunk(
+            b"strl", _chunk(b"strh", strh_a) + _chunk(b"strf", strf_a)))
+
+    usec_per_frame = int(round(1_000_000 / max(fps, 1e-6)))
+    avih = struct.pack(
+        "<IIIIIIIIIIIIII", usec_per_frame,
+        int(byte_rate + np.mean([len(j) for j in jpegs]) * fps),
+        0, 0x10,                                     # AVIF_HASINDEX
+        T, 0, len(streams), max(len(j) for j in jpegs), W, H, 0, 0, 0, 0)
+    hdrl = _list_chunk(b"hdrl", _chunk(b"avih", avih) + b"".join(streams))
+
+    # ---- movi: interleave one audio slice per video frame ----------------
+    movi_parts: list[bytes] = []
+    index: list[tuple[bytes, int, int]] = []         # (ckid, offset, size)
+    offset = 4                                       # past the 'movi' tag
+    spf = sample_rate / max(fps, 1e-6)               # samples per frame
+    for i in range(T):
+        data = jpegs[i]
+        movi_parts.append(_chunk(b"00dc", data))
+        index.append((b"00dc", offset, len(data)))
+        offset += 8 + len(data) + (len(data) % 2)
+        if has_audio:
+            lo, hi = int(round(i * spf)), int(round((i + 1) * spf))
+            chunk_pcm = pcm[lo:min(hi, pcm.shape[0])]
+            if i == T - 1:                           # tail: rest of track
+                chunk_pcm = pcm[lo:]
+            if chunk_pcm.size:
+                data = chunk_pcm.tobytes()
+                movi_parts.append(_chunk(b"01wb", data))
+                index.append((b"01wb", offset, len(data)))
+                offset += 8 + len(data) + (len(data) % 2)
+    movi = _list_chunk(b"movi", b"".join(movi_parts))
+
+    idx1 = _chunk(b"idx1", b"".join(
+        struct.pack("<4sIII", ckid, 0x10, off, size)
+        for ckid, off, size in index))
+
+    riff_payload = b"AVI " + hdrl + movi + idx1
+    path.write_bytes(b"RIFF" + struct.pack("<I", len(riff_payload))
+                     + riff_payload)
+
+
+def _iter_riff_chunks(buf: bytes, start: int, end: int):
+    pos = start
+    while pos + 8 <= end:
+        ckid = buf[pos:pos + 4]
+        size = struct.unpack("<I", buf[pos + 4:pos + 8])[0]
+        yield ckid, pos + 8, size
+        pos += 8 + size + (size % 2)
+
+
+def read_avi_mjpg(path: Path) -> Optional[dict[str, Any]]:
+    """Demux an AVI written by ``write_avi_mjpg`` (or any MJPG+PCM AVI).
+    Returns ``{"frames", "fps", "audio"}`` or None if the file is not an
+    MJPG AVI (caller falls back to cv2)."""
+    cv2 = _require_cv2()
+    buf = path.read_bytes()
+    if len(buf) < 12 or buf[:4] != b"RIFF" or buf[8:12] != b"AVI ":
+        return None
+
+    fps = 30.0
+    audio_fmt: Optional[tuple[int, int]] = None      # (channels, rate)
+    jpegs: list[bytes] = []
+    pcm_parts: list[bytes] = []
+    saw_mjpg = False
+
+    def walk(start: int, end: int):
+        nonlocal fps, audio_fmt, saw_mjpg
+        pending_stream = [None]                      # fccType of last strh
+        for ckid, data_off, size in _iter_riff_chunks(buf, start, end):
+            body = buf[data_off:data_off + size]
+            if ckid == b"LIST":
+                walk(data_off + 4, data_off + size)
+            elif ckid == b"strh" and size >= 32:
+                fcc_type, handler = body[:4], body[4:8]
+                pending_stream[0] = fcc_type
+                if fcc_type == b"vids":
+                    if handler not in (b"MJPG", b"mjpg"):
+                        return
+                    saw_mjpg = True
+                    scale, rate = struct.unpack("<II", body[20:28])
+                    if scale:
+                        fps = rate / scale
+            elif ckid == b"strf" and pending_stream[0] == b"auds" \
+                    and size >= 16:
+                fmt, n_ch, sr = struct.unpack("<HHI", body[:8])
+                if fmt == 1:                         # PCM
+                    audio_fmt = (n_ch, sr)
+            elif ckid[2:] == b"dc":
+                jpegs.append(body)
+            elif ckid[2:] == b"wb":
+                pcm_parts.append(body)
+
+    walk(12, len(buf))
+    if not saw_mjpg or not jpegs:
+        return None
+
+    frames = []
+    for j in jpegs:
+        img = cv2.imdecode(np.frombuffer(j, np.uint8), cv2.IMREAD_COLOR)
+        if img is None:                              # pragma: no cover
+            return None
+        frames.append(cv2.cvtColor(img, cv2.COLOR_BGR2RGB))
+    out: dict[str, Any] = {
+        "frames": np.stack(frames).astype(np.float32) / 255.0,
+        "fps": float(fps), "audio": None,
+    }
+    if audio_fmt and pcm_parts:
+        n_ch, sr = audio_fmt
+        pcm = np.frombuffer(b"".join(pcm_parts), np.int16)
+        if n_ch and pcm.size % n_ch == 0:
+            wf = (pcm.reshape(-1, n_ch).T.astype(np.float32)
+                  / 32768.0)[None]                   # [1, C, S]
+            out["audio"] = {"waveform": wf, "sample_rate": sr}
+    return out
+
+
+# --------------------------------------------------------------------------
+# public API
+# --------------------------------------------------------------------------
+
+def save_video(path, frames, fps: float = 8.0,
+               audio: Optional[dict[str, Any]] = None,
+               quality: int = 95) -> list[str]:
+    """Write an IMAGE batch as a video container; format from suffix
+    (.mp4 / .webm / .avi). Returns the written file paths (the container
+    plus, for cv2 formats with audio, the sidecar ``.wav``)."""
+    path = Path(path)
+    ext = path.suffix.lower()
+    arr = _to_uint8_frames(frames)
+    if arr.shape[0] == 0:
+        raise ValidationError("cannot write a video with 0 frames")
+    if audio is not None and np.asarray(audio["waveform"]).size == 0:
+        audio = None                     # empty track (e.g. silent source)
+    path.parent.mkdir(parents=True, exist_ok=True)
+    written = [str(path)]
+
+    if ext == ".avi":
+        pcm, sr = _audio_pcm16(audio) if audio is not None else (None, 44100)
+        write_avi_mjpg(path, arr, fps, pcm=pcm, sample_rate=sr,
+                       quality=quality)
+        return written
+
+    if ext not in _FOURCC:
+        raise ValidationError(
+            f"unsupported video format {ext!r} (supported: "
+            f"{sorted(_FOURCC) + ['.avi']})")
+    cv2 = _require_cv2()
+    T, H, W, _ = arr.shape
+    writer = cv2.VideoWriter(str(path),
+                             cv2.VideoWriter_fourcc(*_FOURCC[ext]),
+                             float(fps), (W, H))
+    if not writer.isOpened():
+        raise ValidationError(
+            f"OpenCV cannot open a {ext} writer in this environment")
+    try:
+        for i in range(T):
+            writer.write(cv2.cvtColor(arr[i], cv2.COLOR_RGB2BGR))
+    finally:
+        writer.release()
+    if audio is not None:
+        # no ffmpeg in this image → cv2 formats carry audio as a sidecar
+        # wav that load_video re-attaches (divergence from the
+        # reference's VHS_VideoCombine, which muxes via ffmpeg; use the
+        # .avi format for a truly muxed track)
+        from .audio_payload import wav_bytes
+
+        wf = np.asarray(audio["waveform"], dtype=np.float32)
+        if wf.ndim == 2:
+            wf = wf[None]
+        sidecar = path.with_suffix(".wav")
+        sidecar.write_bytes(
+            wav_bytes(wf[0], int(audio.get("sample_rate", 44100))))
+        written.append(str(sidecar))
+    return written
+
+
+def load_video(path, frame_load_cap: int = 0, skip_first_frames: int = 0,
+               select_every_nth: int = 1) -> dict[str, Any]:
+    """Read a video container → ``{"frames" [T,H,W,3] float32 0..1,
+    "fps", "audio" (dict|None), "frame_count"}``. Frame selection
+    mirrors the reference ecosystem's VHS_LoadVideo knobs (cap / skip /
+    stride). Audio: muxed track for our AVIs, else a sidecar ``.wav``
+    beside the file."""
+    path = Path(path)
+    if not path.exists():
+        raise ValidationError(f"video file not found: {path}")
+    select_every_nth = max(1, int(select_every_nth))
+
+    result = read_avi_mjpg(path) if path.suffix.lower() == ".avi" else None
+    if result is None:
+        cv2 = _require_cv2()
+        cap = cv2.VideoCapture(str(path))
+        if not cap.isOpened():
+            raise ValidationError(f"cannot decode video: {path}")
+        fps = cap.get(cv2.CAP_PROP_FPS) or 30.0
+        frames = []
+        try:
+            while True:
+                ok, frame = cap.read()
+                if not ok:
+                    break
+                frames.append(cv2.cvtColor(frame, cv2.COLOR_BGR2RGB))
+        finally:
+            cap.release()
+        if not frames:
+            raise ValidationError(f"video has no decodable frames: {path}")
+        result = {
+            "frames": np.stack(frames).astype(np.float32) / 255.0,
+            "fps": float(fps), "audio": None,
+        }
+
+    if result["audio"] is None:
+        sidecar = path.with_suffix(".wav")
+        if sidecar.exists():
+            from .audio_payload import wav_decode
+
+            result["audio"] = wav_decode(sidecar.read_bytes())
+
+    frames = result["frames"]
+    frames = frames[int(skip_first_frames)::select_every_nth]
+    if frame_load_cap and frame_load_cap > 0:
+        frames = frames[:int(frame_load_cap)]
+    if frames.shape[0] == 0:
+        raise ValidationError(
+            "frame selection (cap/skip/stride) left 0 frames")
+    result["frames"] = np.ascontiguousarray(frames)
+    result["frame_count"] = int(frames.shape[0])
+    return result
