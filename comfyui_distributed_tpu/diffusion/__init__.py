@@ -10,7 +10,9 @@ XLA compilation per (shape, steps) pair, fully on-device.
 from .schedules import (  # noqa: F401
     NoiseSchedule,
     vp_schedule,
+    sigmas_beta,
     sigmas_karras,
+    sigmas_linear_quadratic,
     sigmas_normal,
     sigmas_flow,
     sigmas_exponential,

@@ -27,7 +27,8 @@ from ..parallel.rng import participant_key
 from ..utils import constants
 from .guidance import cfg_denoiser, eps_denoiser
 from .samplers import sample
-from .schedules import (NoiseSchedule, sigmas_exponential, sigmas_karras,
+from .schedules import (NoiseSchedule, sigmas_beta, sigmas_exponential,
+                        sigmas_karras, sigmas_linear_quadratic,
                         sigmas_normal, sigmas_sgm_uniform, vp_schedule)
 
 
@@ -37,7 +38,8 @@ class GenerationSpec:
     width: int = 1024
     steps: int = 30
     sampler: str = "euler"
-    scheduler: str = "karras"  # karras | normal | exponential | sgm_uniform
+    scheduler: str = "karras"  # karras | normal | exponential |
+    #                            sgm_uniform | beta | linear_quadratic
     guidance_scale: float = 5.0
     per_device_batch: int = 1
     denoise: float = 1.0           # <1.0: img2img partial ladder (tile engine)
@@ -102,6 +104,11 @@ def make_sigma_ladder(spec: GenerationSpec, schedule: NoiseSchedule) -> jax.Arra
                                   float(schedule.sigmas[-1]))
     elif spec.scheduler == "sgm_uniform":
         full = sigmas_sgm_uniform(spec.steps, schedule)
+    elif spec.scheduler == "beta":
+        full = sigmas_beta(spec.steps, schedule)
+    elif spec.scheduler == "linear_quadratic":
+        full = sigmas_linear_quadratic(
+            spec.steps, sigma_max=float(schedule.sigmas[-1]))
     else:
         raise ValueError(f"unknown scheduler {spec.scheduler!r}")
     # partial denoise keeps the *tail* of the ladder (img2img convention)

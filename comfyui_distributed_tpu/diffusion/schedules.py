@@ -98,6 +98,64 @@ def sigmas_sgm_uniform(n: int, schedule: NoiseSchedule) -> jax.Array:
     return jnp.concatenate([sigmas, jnp.zeros((1,))])
 
 
+def _beta_ppf(q: jax.Array, a: float, b: float) -> jax.Array:
+    """Inverse regularized incomplete beta (Beta(a,b) quantile) by
+    bisection on ``jax.scipy.special.betainc`` — scipy is not in this
+    image, and the ladder is built host-side once per job, so 60 fixed
+    halvings (≈1e−18 interval) are plenty."""
+    from jax.scipy.special import betainc
+
+    lo = jnp.zeros_like(q)
+    hi = jnp.ones_like(q)
+    for _ in range(60):
+        mid = 0.5 * (lo + hi)
+        below = betainc(a, b, mid) < q
+        lo = jnp.where(below, mid, lo)
+        hi = jnp.where(below, hi, mid)
+    return 0.5 * (lo + hi)
+
+
+def sigmas_beta(n: int, schedule: NoiseSchedule, alpha: float = 0.6,
+                beta: float = 0.6) -> jax.Array:
+    """"beta" scheduler: timesteps placed at Beta(α,β) quantiles of the
+    training table (ComfyUI's ``beta_scheduler`` recipe: ppf of
+    1 − linspace[0,1), index rounded into the table, 0-terminated). The
+    default α=β=0.6 front-loads steps at BOTH ends of the ladder —
+    where diffusion needs resolution — relative to "normal"."""
+    table = schedule.sigmas
+    T = table.shape[0]
+    ts = 1.0 - jnp.linspace(0.0, 1.0, n, endpoint=False)
+    idx = jnp.rint(_beta_ppf(ts, alpha, beta) * (T - 1)).astype(jnp.int32)
+    return jnp.concatenate([table[idx], jnp.zeros((1,))])
+
+
+def sigmas_linear_quadratic(n: int, threshold_noise: float = 0.025,
+                            linear_steps: int | None = None,
+                            sigma_max: float = 1.0) -> jax.Array:
+    """"linear_quadratic" scheduler (LTX-Video / movie-gen recipe): the
+    inverted ladder 1−σ rises linearly to ``threshold_noise`` over the
+    first ``linear_steps`` (default n//2), then quadratically to 1 —
+    continuous in value and slope at the joint. For flow models
+    σ ∈ [0, 1] directly; VP callers scale by their ``sigma_max``.
+    Returns [n+1] descending, last = 0."""
+    if n == 1:
+        return jnp.array([1.0, 0.0]) * sigma_max
+    ls = n // 2 if linear_steps is None else min(int(linear_steps), n)
+    i = jnp.arange(n + 1, dtype=jnp.float32)
+    linear = i * threshold_noise / max(ls, 1)
+    qs = max(n - ls, 1)
+    # quadratic segment a·j² + b·j + c over j = i − ls ∈ [0, qs], fitted
+    # to: value threshold_noise and slope threshold_noise/ls at j=0
+    # (C¹ joint), value 1 at j=qs
+    slope = threshold_noise / max(ls, 1)
+    a = (1.0 - threshold_noise - slope * qs) / (qs * qs)
+    j = i - ls
+    quad = a * j * j + slope * j + threshold_noise
+    inv = jnp.where(i < ls, linear, quad)
+    inv = inv.at[-1].set(1.0)
+    return (1.0 - inv) * sigma_max
+
+
 def sigmas_flow(n: int, shift: float = 1.0) -> jax.Array:
     """Rectified-flow ladder: t from 1→0 with resolution shift
     (sigma' = shift·sigma / (1 + (shift−1)·sigma)); FLUX/SD3 convention."""

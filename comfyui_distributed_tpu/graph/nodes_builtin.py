@@ -174,6 +174,23 @@ class AudioBatchDivider(NodeDef):
         return tuple(chunks)
 
 
+@register_node("ImageFromBatch")
+class ImageFromBatch(NodeDef):
+    """Slice [batch_index : batch_index+length] out of an IMAGE batch
+    (ComfyUI-core node the reference's video-upscale workflow assumes —
+    ``/root/reference/workflows/distributed-upscale-video.json``; index
+    and length clamp to the batch like the original)."""
+
+    INPUTS = {"image": "IMAGE", "batch_index": "INT", "length": "INT"}
+    RETURNS = ("IMAGE",)
+
+    def execute(self, image, batch_index: int, length: int, **_):
+        arr = jnp.asarray(image)
+        start = min(max(int(batch_index), 0), max(arr.shape[0] - 1, 0))
+        count = min(max(int(length), 1), arr.shape[0] - start)
+        return (arr[start:start + count],)
+
+
 @register_node("SolidMask")
 class SolidMask(NodeDef):
     """Constant-value mask (ComfyUI's SolidMask): the building block for
@@ -852,6 +869,34 @@ class CheckpointLoader(NodeDef):
         return (bundle, bundle.text_encoder, bundle.pipeline.vae)
 
 
+class _ShiftedModel:
+    """MODEL proxy carrying a sampling-shift override; every other
+    attribute forwards to the wrapped bundle (the ComfyUI patched-model
+    clone pattern, minus torch model cloning)."""
+
+    def __init__(self, base, shift: float):
+        self._base = base
+        self.sampling_shift = float(shift)
+
+    def __getattr__(self, name):
+        return getattr(self._base, name)
+
+
+@register_node("ModelSamplingSD3")
+class ModelSamplingSD3(NodeDef):
+    """Sigma-shift control for flow models (ComfyUI-core node used by the
+    reference's video workflow, ``distributed-upscale-video.json``):
+    returns a MODEL whose default flow shift is overridden — the flow
+    ladder becomes σ' = shift·σ / (1 + (shift−1)·σ). Sampler nodes
+    consult it whenever the graph does not wire an explicit shift."""
+
+    INPUTS = {"model": "MODEL", "shift": "FLOAT"}
+    RETURNS = ("MODEL",)
+
+    def execute(self, model, shift: float, **_):
+        return (_ShiftedModel(model, shift),)
+
+
 @register_node("CLIPTextEncode")
 class CLIPTextEncode(NodeDef):
     INPUTS = {"text": "STRING", "clip": "CLIP"}
@@ -1065,7 +1110,7 @@ class TPUFlowTxt2Img(NodeDef):
     RETURNS = ("IMAGE",)
 
     def execute(self, model, positive, seed: int, steps: int, width: int,
-                height: int, guidance: float = 3.5, shift: float = 3.0,
+                height: int, guidance: float = 3.5, shift=None,
                 mode: str = "dp", batch_per_device: int = 1, mesh=None,
                 prompt_id: str = "", progress_tracker=None,
                 interrupt_event=None, **_):
@@ -1074,6 +1119,10 @@ class TPUFlowTxt2Img(NodeDef):
 
         if mesh is None:
             mesh = build_mesh({"dp": len(jax.devices())})
+        # unwired shift falls back to a ModelSamplingSD3 override on the
+        # model, then the FLUX-convention default
+        if shift is None:
+            shift = getattr(model, "sampling_shift", 3.0)
         spec = FlowSpec(height=int(height), width=int(width), steps=int(steps),
                         shift=float(shift), guidance=float(guidance),
                         per_device_batch=int(batch_per_device))
@@ -1166,7 +1215,7 @@ class TPUTxt2Video(NodeDef):
 
     def execute(self, model, positive, seed: int, frames: int, steps: int,
                 width: int, height: int, cfg: float = 1.0,
-                shift: float = 3.0, mode: str = "dp", mesh=None,
+                shift=None, mode: str = "dp", mesh=None,
                 prompt_id: str = "", progress_tracker=None,
                 interrupt_event=None, **_):
         from ..diffusion.pipeline_video import VideoSpec
@@ -1175,6 +1224,8 @@ class TPUTxt2Video(NodeDef):
 
         if mesh is None:
             mesh = build_mesh({"dp": len(jax.devices())})
+        if shift is None:   # ModelSamplingSD3 override, then WAN default
+            shift = getattr(model, "sampling_shift", 3.0)
         spec = VideoSpec(frames=int(frames), height=int(height),
                          width=int(width), steps=int(steps),
                          shift=float(shift), guidance_scale=float(cfg))
@@ -1229,7 +1280,7 @@ class TPUImg2Video(NodeDef):
     RETURNS = ("IMAGE",)
 
     def execute(self, model, positive, image, seed: int, frames: int,
-                steps: int, cfg: float = 1.0, shift: float = 3.0,
+                steps: int, cfg: float = 1.0, shift=None,
                 mode: str = "dp", mesh=None, prompt_id: str = "",
                 progress_tracker=None, interrupt_event=None, **_):
         from ..diffusion.pipeline_video import VideoSpec
@@ -1250,6 +1301,8 @@ class TPUImg2Video(NodeDef):
         if mesh is None:
             mesh = build_mesh({"dp": len(jax.devices())})
         H, W = int(image.shape[1]), int(image.shape[2])
+        if shift is None:   # ModelSamplingSD3 override, then WAN default
+            shift = getattr(model, "sampling_shift", 3.0)
         spec = VideoSpec(frames=int(frames), height=H, width=W,
                          steps=int(steps), shift=float(shift),
                          guidance_scale=float(cfg))

@@ -67,10 +67,12 @@ def _to_uint8_frames(frames: Any) -> np.ndarray:
     return arr
 
 
-def _audio_pcm16(audio: dict[str, Any]) -> tuple[np.ndarray, int]:
-    """AUDIO dict → ([S, C] int16, sample_rate); one container carries
-    one track, so a multi-clip batch keeps clip 0 and WARNS about the
-    rest (SaveAudio is the node that writes one file per element)."""
+def _first_clip(audio: dict[str, Any]) -> tuple[np.ndarray, int]:
+    """AUDIO dict → ([C, S] float32 of clip 0, sample_rate); one
+    container carries one track, so a multi-clip batch keeps clip 0 and
+    WARNS about the rest (SaveAudio is the node that writes one file per
+    element). Shared by the AVI mux and the cv2-format sidecar path so
+    their normalization and diagnostics cannot diverge."""
     wf = np.asarray(audio["waveform"], dtype=np.float32)
     if wf.ndim == 2:
         wf = wf[None]
@@ -80,10 +82,15 @@ def _audio_pcm16(audio: dict[str, Any]) -> tuple[np.ndarray, int]:
     if wf.shape[0] > 1:
         from .logging import log
 
-        log(f"video audio track: batch of {wf.shape[0]} clips, muxing "
+        log(f"video audio track: batch of {wf.shape[0]} clips, writing "
             f"clip 0 only (use SaveAudio for one file per clip)")
-    sr = int(audio.get("sample_rate", 44100))
-    pcm = (np.clip(wf[0], -1.0, 1.0) * 32767.0).astype(np.int16)
+    return wf[0], int(audio.get("sample_rate", 44100))
+
+
+def _audio_pcm16(audio: dict[str, Any]) -> tuple[np.ndarray, int]:
+    """AUDIO dict → ([S, C] int16 of clip 0, sample_rate)."""
+    clip, sr = _first_clip(audio)
+    pcm = (np.clip(clip, -1.0, 1.0) * 32767.0).astype(np.int16)
     return pcm.T.copy(), sr                          # [S, C]
 
 
@@ -196,10 +203,15 @@ def _iter_riff_chunks(buf: bytes, start: int, end: int):
         pos += 8 + size + (size % 2)
 
 
-def read_avi_mjpg(path: Path) -> Optional[dict[str, Any]]:
+def read_avi_mjpg(path: Path, skip: int = 0, nth: int = 1,
+                  cap: int = 0) -> Optional[dict[str, Any]]:
     """Demux an AVI written by ``write_avi_mjpg`` (or any MJPG+PCM AVI).
-    Returns ``{"frames", "fps", "audio"}`` or None if the file is not an
-    MJPG AVI (caller falls back to cv2)."""
+    Returns ``{"frames", "fps", "audio", "truncated"}`` or None if the
+    file is not an MJPG AVI (caller falls back to cv2). Frame selection
+    (skip / every-nth / cap) happens BEFORE JPEG decode, so only the
+    requested frames are ever decoded or held as float arrays; raw
+    chunk bytes are cheap. ``fps`` is the SOURCE rate and ``audio`` the
+    full track — ``load_video`` rescales/trims them coherently."""
     cv2 = _require_cv2()
     buf = path.read_bytes()
     if len(buf) < 12 or buf[:4] != b"RIFF" or buf[8:12] != b"AVI ":
@@ -242,15 +254,20 @@ def read_avi_mjpg(path: Path) -> Optional[dict[str, Any]]:
     if not saw_mjpg or not jpegs:
         return None
 
+    selected = jpegs[max(0, skip)::max(1, nth)]
+    truncated = bool(cap and cap > 0 and len(selected) > cap)
+    if truncated:
+        selected = selected[:cap]
     frames = []
-    for j in jpegs:
+    for j in selected:
         img = cv2.imdecode(np.frombuffer(j, np.uint8), cv2.IMREAD_COLOR)
         if img is None:                              # pragma: no cover
             return None
         frames.append(cv2.cvtColor(img, cv2.COLOR_BGR2RGB))
     out: dict[str, Any] = {
-        "frames": np.stack(frames).astype(np.float32) / 255.0,
-        "fps": float(fps), "audio": None,
+        "frames": (np.stack(frames).astype(np.float32) / 255.0 if frames
+                   else np.zeros((0, 1, 1, 3), np.float32)),
+        "fps": float(fps), "audio": None, "truncated": truncated,
     }
     if audio_fmt and pcm_parts:
         n_ch, sr = audio_fmt
@@ -312,12 +329,9 @@ def save_video(path, frames, fps: float = 8.0,
         # .avi format for a truly muxed track)
         from .audio_payload import wav_bytes
 
-        wf = np.asarray(audio["waveform"], dtype=np.float32)
-        if wf.ndim == 2:
-            wf = wf[None]
+        clip, sr = _first_clip(audio)
         sidecar = path.with_suffix(".wav")
-        sidecar.write_bytes(
-            wav_bytes(wf[0], int(audio.get("sample_rate", 44100))))
+        sidecar.write_bytes(wav_bytes(clip, sr))
         written.append(str(sidecar))
     return written
 
@@ -327,14 +341,22 @@ def load_video(path, frame_load_cap: int = 0, skip_first_frames: int = 0,
     """Read a video container → ``{"frames" [T,H,W,3] float32 0..1,
     "fps", "audio" (dict|None), "frame_count"}``. Frame selection
     mirrors the reference ecosystem's VHS_LoadVideo knobs (cap / skip /
-    stride). Audio: muxed track for our AVIs, else a sidecar ``.wav``
-    beside the file."""
+    stride) and is applied AT DECODE TIME — only selected frames are
+    ever stored or converted, and decode stops once the cap is hit, so
+    ``frame_load_cap=16`` on an hour-long clip stays cheap. When
+    selection alters the frame set, the outputs stay coherent the way
+    VHS does it: ``fps`` is divided by the stride and the audio track is
+    trimmed to the source-time span the selected frames cover. Audio:
+    muxed track for our AVIs, else a sidecar ``.wav`` beside the file."""
     path = Path(path)
     if not path.exists():
         raise ValidationError(f"video file not found: {path}")
-    select_every_nth = max(1, int(select_every_nth))
+    nth = max(1, int(select_every_nth))
+    skip = max(0, int(skip_first_frames))
+    cap_n = int(frame_load_cap) if frame_load_cap else 0
 
-    result = read_avi_mjpg(path) if path.suffix.lower() == ".avi" else None
+    result = (read_avi_mjpg(path, skip=skip, nth=nth, cap=cap_n)
+              if path.suffix.lower() == ".avi" else None)
     if result is None:
         cv2 = _require_cv2()
         cap = cv2.VideoCapture(str(path))
@@ -342,20 +364,30 @@ def load_video(path, frame_load_cap: int = 0, skip_first_frames: int = 0,
             raise ValidationError(f"cannot decode video: {path}")
         fps = cap.get(cv2.CAP_PROP_FPS) or 30.0
         frames = []
+        truncated = False
+        i = 0
         try:
             while True:
                 ok, frame = cap.read()
                 if not ok:
                     break
-                frames.append(cv2.cvtColor(frame, cv2.COLOR_BGR2RGB))
+                if i >= skip and (i - skip) % nth == 0:
+                    if cap_n > 0 and len(frames) >= cap_n:
+                        truncated = True     # more frames were available
+                        break
+                    frames.append(cv2.cvtColor(frame, cv2.COLOR_BGR2RGB))
+                i += 1
         finally:
             cap.release()
-        if not frames:
-            raise ValidationError(f"video has no decodable frames: {path}")
         result = {
-            "frames": np.stack(frames).astype(np.float32) / 255.0,
-            "fps": float(fps), "audio": None,
+            "frames": (np.stack(frames).astype(np.float32) / 255.0
+                       if frames else np.zeros((0, 1, 1, 3), np.float32)),
+            "fps": float(fps), "audio": None, "truncated": truncated,
         }
+
+    if result["frames"].shape[0] == 0:
+        raise ValidationError(
+            f"no decodable frames after selection (cap/skip/stride): {path}")
 
     if result["audio"] is None:
         sidecar = path.with_suffix(".wav")
@@ -364,13 +396,20 @@ def load_video(path, frame_load_cap: int = 0, skip_first_frames: int = 0,
 
             result["audio"] = wav_decode(sidecar.read_bytes())
 
-    frames = result["frames"]
-    frames = frames[int(skip_first_frames)::select_every_nth]
-    if frame_load_cap and frame_load_cap > 0:
-        frames = frames[:int(frame_load_cap)]
-    if frames.shape[0] == 0:
-        raise ValidationError(
-            "frame selection (cap/skip/stride) left 0 frames")
-    result["frames"] = np.ascontiguousarray(frames)
-    result["frame_count"] = int(frames.shape[0])
+    n_sel = int(result["frames"].shape[0])
+    src_fps = result["fps"]
+    selection_active = skip > 0 or nth > 1 or result.pop("truncated", False)
+    if selection_active:
+        result["fps"] = src_fps / nth
+        if result["audio"] is not None:
+            sr = int(result["audio"].get("sample_rate", 44100))
+            lo = int(round(skip / src_fps * sr))
+            hi = int(round((skip + (n_sel - 1) * nth + 1) / src_fps * sr))
+            result["audio"] = {
+                "waveform": result["audio"]["waveform"][..., lo:hi],
+                "sample_rate": sr,
+            }
+
+    result["frames"] = np.ascontiguousarray(result["frames"])
+    result["frame_count"] = n_sel
     return result

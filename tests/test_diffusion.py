@@ -212,7 +212,249 @@ def test_stochastic_samplers_vary_with_key():
     sigmas = sigmas_karras(6, 0.03, 10.0)
     x = jax.random.normal(jax.random.key(0), (1, 4, 4, 1)) * sigmas[0]
     denoise = lambda xx, s: xx * 0.5
-    for name in ("lcm", "dpmpp_sde", "dpmpp_2m_sde"):
+    for name in ("lcm", "dpmpp_sde", "dpmpp_2m_sde", "dpmpp_3m_sde",
+                 "res_2m_ancestral", "res_2s_ancestral"):
         a = sample(name, denoise, x, sigmas, key=jax.random.key(1))
         b = sample(name, denoise, x, sigmas, key=jax.random.key(2))
         assert not np.allclose(np.asarray(a), np.asarray(b)), name
+
+
+# ---------------------------------------------------------------------------
+# round-5 sampler additions (res_2m / res_2s / dpmpp_3m_sde / uni_pc) —
+# differential tests against the solvers' published math: the linear
+# denoiser D(x,σ) = a·x makes the probability-flow ODE dx/dσ = (1−a)x/σ
+# exactly solvable (x(σ) = x₀·(σ/σ₀)^{1−a}), so each solver's measured
+# convergence order must match its nominal order.
+# ---------------------------------------------------------------------------
+
+
+def _order_probe(name, n, a=0.4, smax=10.0, smin=0.5, **kw):
+    """Max error vs the analytic solution on an n-step karras-style
+    ladder that does NOT terminate at 0 (σ=0 has no analytic value)."""
+    den = lambda x, s: a * x
+    x0 = jnp.full((1, 4, 4, 1), 2.0)
+    ramp = jnp.linspace(0, 1, n + 1)
+    sig = (smax ** (1 / 7.0)
+           + ramp * (smin ** (1 / 7.0) - smax ** (1 / 7.0))) ** 7.0
+    exact = np.asarray(x0) * (smin / smax) ** (1 - a)
+    out = sample(name, den, x0, sig, key=jax.random.key(0), **kw)
+    return float(np.abs(np.asarray(out) - exact).max())
+
+
+@pytest.mark.parametrize("name,min_order,kw", [
+    ("euler", 0.9, {}),
+    ("dpmpp_2m", 1.8, {}),
+    ("res_2m", 1.7, {}),
+    ("res_2s", 1.7, {}),
+    ("uni_pc", 2.5, {}),
+    ("dpmpp_3m_sde", 1.9, {"eta": 0.0}),
+])
+def test_solver_convergence_order(name, min_order, kw):
+    errs = [_order_probe(name, n, **kw) for n in (10, 20, 40)]
+    orders = [np.log2(errs[i] / errs[i + 1]) for i in range(2)]
+    assert min(orders) > min_order, (name, errs, orders)
+    # and higher-order solvers actually beat euler at equal step count
+    if name != "euler":
+        assert errs[0] < _order_probe("euler", 10)
+
+
+def test_res_2m_first_step_is_exponential_euler():
+    """res_2m's bootstrap step (no history) must equal the exact
+    first-order exponential integrator — which is the DDIM/dpmpp_2m
+    first-order step."""
+    sigmas = jnp.array([10.0, 5.0])
+    x = jnp.full((1, 2, 2, 1), 4.0)
+    den = lambda xx, s: xx * 0.3
+    r = sample("res_2m", den, x, sigmas)
+    d = sample("dpmpp_2m", den, x, sigmas)
+    np.testing.assert_allclose(np.asarray(r), np.asarray(d), rtol=1e-6)
+
+
+def test_res_2m_differs_from_dpmpp_2m_with_history():
+    """Once history exists the two second-order corrections differ (RES
+    integrates the first moment exactly; dpmpp_2m uses the 1/(2r)
+    midpoint weight) — they must NOT be the same sampler."""
+    sigmas = sigmas_karras(8, 0.05, 10.0)
+    x = jax.random.normal(jax.random.key(0), (1, 4, 4, 1)) * sigmas[0]
+    den = lambda xx, s: jnp.tanh(xx)
+    r = np.asarray(sample("res_2m", den, x, sigmas))
+    d = np.asarray(sample("dpmpp_2m", den, x, sigmas))
+    assert not np.allclose(r, d)
+
+
+def test_res_2s_c2_one_is_exponential_trapezoidal():
+    """At c2=1 the ExpRK2 stage lands on σ_next and the update collapses
+    to the exponential trapezoidal rule — verify against a literal
+    transcription."""
+    sigmas = jnp.array([8.0, 3.0, 1.0])
+    x = jnp.full((1, 2, 2, 1), 1.5)
+    den = lambda xx, s: jnp.tanh(xx)
+    ours = np.asarray(sample("res_2s", den, x, sigmas, c2=1.0))
+
+    xx = x
+    for i in range(2):
+        s, sn = sigmas[i], sigmas[i + 1]
+        h = -jnp.log(sn / s)
+        d0 = den(xx, s)
+        x_end = jnp.exp(-h) * xx + (-jnp.expm1(-h)) * d0
+        d1 = den(x_end, sn)
+        i0 = -jnp.expm1(-h)
+        i1 = h - i0
+        xx = jnp.exp(-h) * xx + (i0 - i1 / h) * d0 + (i1 / h) * d1
+    np.testing.assert_allclose(ours, np.asarray(xx), rtol=1e-5)
+
+
+def test_res_ancestral_eta0_equals_deterministic():
+    sigmas = sigmas_karras(8, 0.05, 10.0)
+    x = jax.random.normal(jax.random.key(1), (1, 4, 4, 1)) * sigmas[0]
+    den = lambda xx, s: xx * 0.4
+    for det, anc in (("res_2m", "res_2m_ancestral"),
+                     ("res_2s", "res_2s_ancestral")):
+        a = sample(det, den, x, sigmas)
+        b = sample(anc, den, x, sigmas, key=jax.random.key(2), eta=0.0)
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6), det
+
+
+def _kdiffusion_dpmpp_3m_sde_loop(denoise, x, sigmas, key, eta=1.0,
+                                  s_noise=1.0):
+    """Literal (non-scan) transcription of the published
+    dpmpp_3m_sde update rule with this repo's fold_in noise convention."""
+    t_fn = lambda s: -jnp.log(jnp.maximum(s, 1e-10))
+    d1 = d2 = None
+    h1 = h2 = None
+    for i in range(int(sigmas.shape[0]) - 1):
+        denoised = denoise(x, sigmas[i])
+        if float(sigmas[i + 1]) == 0.0:
+            x = denoised
+        else:
+            h = t_fn(sigmas[i + 1]) - t_fn(sigmas[i])
+            h_eta = h * (eta + 1)
+            x = jnp.exp(-h_eta) * x - jnp.expm1(-h_eta) * denoised
+            if d2 is not None:
+                r0, r1 = h1 / h, h2 / h
+                d1_0 = (denoised - d1) / r0
+                d1_1 = (d1 - d2) / r1
+                dd1 = d1_0 + (d1_0 - d1_1) * r0 / (r0 + r1)
+                dd2 = (d1_0 - d1_1) / (r0 + r1)
+                phi2 = jnp.expm1(-h_eta) / h_eta + 1
+                phi3 = phi2 / h_eta - 0.5
+                x = x + phi2 * dd1 - phi3 * dd2
+            elif d1 is not None:
+                r = h1 / h
+                phi2 = jnp.expm1(-h_eta) / h_eta + 1
+                x = x + phi2 * (denoised - d1) / r
+            if eta:
+                noise = jax.random.normal(jax.random.fold_in(key, i),
+                                          x.shape, x.dtype)
+                x = x + noise * sigmas[i + 1] * s_noise * jnp.sqrt(
+                    -jnp.expm1(-2 * h * eta))
+            d1, d2 = denoised, d1
+            h1, h2 = h, h1
+    return x
+
+
+def test_dpmpp_3m_sde_matches_reference_loop():
+    sigmas = sigmas_karras(7, 0.05, 15.0)
+    x = jax.random.normal(jax.random.key(3), (1, 4, 4, 2)) * sigmas[0]
+    den = lambda xx, s: xx * 0.3
+    key = jax.random.key(7)
+    ours = sample("dpmpp_3m_sde", den, x, sigmas, key=key)
+    ref = _kdiffusion_dpmpp_3m_sde_loop(den, x, sigmas, key)
+    np.testing.assert_allclose(np.asarray(ours), np.asarray(ref),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_beta_ladder():
+    """"beta" scheduler: Beta(α,β) quantile placement over the VP table
+    (ComfyUI's beta_scheduler recipe). α=β=1 is the uniform distribution,
+    which must reproduce uniform timestep indexing; the 0.6/0.6 default
+    front-loads BOTH ends relative to uniform."""
+    from comfyui_distributed_tpu.diffusion import sigmas_beta
+
+    sched = vp_schedule()
+    s = np.asarray(sigmas_beta(12, sched))
+    assert s.shape == (13,)
+    assert s[-1] == 0.0
+    assert np.all(np.diff(s[:-1]) < 0)          # strictly descending
+    # α=β=1 → Beta is uniform → same as rounding uniform indices
+    u = np.asarray(sigmas_beta(12, sched, alpha=1.0, beta=1.0))
+    T = sched.sigmas.shape[0]
+    ts = 1.0 - np.linspace(0.0, 1.0, 12, endpoint=False)
+    expect = np.asarray(sched.sigmas)[np.rint(ts * (T - 1)).astype(int)]
+    np.testing.assert_allclose(u[:-1], expect, rtol=1e-6)
+    # default α=β=0.6: quantiles push indices outward vs uniform at the
+    # tails (more resolution at both ends of the ladder)
+    assert s[0] >= u[0] and s[-2] <= u[-2]
+
+
+def test_linear_quadratic_ladder():
+    """"linear_quadratic" (LTX/movie-gen recipe): 1−σ rises linearly to
+    threshold_noise over the first half, then quadratically to 1, C¹ at
+    the joint."""
+    from comfyui_distributed_tpu.diffusion import sigmas_linear_quadratic
+
+    n, thr = 10, 0.025
+    s = np.asarray(sigmas_linear_quadratic(n, threshold_noise=thr))
+    assert s.shape == (n + 1,)
+    assert s[0] == 1.0 and s[-1] == 0.0
+    assert np.all(np.diff(s) < 0)
+    inv = 1.0 - s
+    ls = n // 2
+    # linear segment: constant first differences of thr/ls
+    np.testing.assert_allclose(np.diff(inv[:ls + 1]), thr / ls, rtol=1e-5)
+    assert np.isclose(inv[ls], thr, rtol=1e-5)
+    # quadratic segment: constant SECOND differences, and C¹ at the
+    # joint — the quadratic a·j² + slope·j + thr has derivative `slope`
+    # at j=0, so its first discrete step is slope + a where a = d2/2
+    d2 = np.diff(np.diff(inv[ls:]))
+    np.testing.assert_allclose(d2, d2[0], rtol=1e-4)
+    a = d2[0] / 2.0
+    np.testing.assert_allclose(np.diff(inv)[ls], thr / ls + a, rtol=1e-4)
+    # sigma_max scaling for VP callers
+    sv = np.asarray(sigmas_linear_quadratic(n, threshold_noise=thr,
+                                            sigma_max=14.6))
+    np.testing.assert_allclose(sv, s * 14.6, rtol=1e-6)
+
+
+def test_make_sigma_ladder_new_schedulers():
+    from comfyui_distributed_tpu.diffusion.pipeline import (GenerationSpec,
+                                                            make_sigma_ladder)
+
+    sched = vp_schedule()
+    for name in ("beta", "linear_quadratic"):
+        spec = GenerationSpec(width=16, height=16, steps=8, scheduler=name)
+        s = np.asarray(make_sigma_ladder(spec, sched))
+        assert s.shape == (9,)
+        assert s[-1] == 0.0 and np.all(np.diff(s) < 0), name
+        # linear_quadratic tops out at the model's sigma_max
+        if name == "linear_quadratic":
+            np.testing.assert_allclose(s[0], float(sched.sigmas[-1]),
+                                       rtol=1e-5)
+
+
+def test_uni_pc_first_transition_uses_trapezoidal_corrector():
+    """On a 2-sigma ladder uni_pc does predict (exp-Euler) then — with no
+    later eval — returns the prediction; on 3 sigmas the middle arrival
+    is corrected with the exponential-trapezoidal rule. Verify the
+    3-sigma case against a literal PECE transcription."""
+    sigmas = jnp.array([8.0, 3.0, 1.0])
+    x = jnp.full((1, 2, 2, 1), 1.5)
+    den = lambda xx, s: jnp.tanh(xx)
+    ours = np.asarray(sample("uni_pc", den, x, sigmas))
+
+    t_fn = lambda s: -jnp.log(s)
+    # predict σ0→σ1 (first order)
+    h0 = t_fn(sigmas[1]) - t_fn(sigmas[0])
+    d0 = den(x, sigmas[0])
+    x1p = jnp.exp(-h0) * x + (-jnp.expm1(-h0)) * d0
+    # eval at predicted point, correct the arrival (trapezoidal)
+    d1 = den(x1p, sigmas[1])
+    i0, i1 = -jnp.expm1(-h0), h0 - (-jnp.expm1(-h0))
+    x1c = jnp.exp(-h0) * x + i0 * d0 + i1 * (d1 - d0) / h0
+    # predict σ1→σ2 (second order, history d0)
+    h1 = t_fn(sigmas[2]) - t_fn(sigmas[1])
+    i0b = -jnp.expm1(-h1)
+    x2p = jnp.exp(-h1) * x1c + i0b * d1 \
+        + (h1 - i0b) * (d1 - d0) / h0
+    np.testing.assert_allclose(ours, np.asarray(x2p), rtol=1e-5)

@@ -142,6 +142,56 @@ class TestDividers:
         np.testing.assert_array_equal(recon, audio["waveform"])
 
 
+class TestEcosystemNodes:
+    def test_image_from_batch_slices(self):
+        node = NODE_REGISTRY["ImageFromBatch"]()
+        imgs = jnp.arange(6)[:, None, None, None] * jnp.ones((6, 2, 2, 3))
+        out = node.execute(image=imgs, batch_index=2, length=3)[0]
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(imgs[2:5]))
+
+    def test_image_from_batch_clamps(self):
+        node = NODE_REGISTRY["ImageFromBatch"]()
+        imgs = jnp.ones((4, 2, 2, 3))
+        assert node.execute(image=imgs, batch_index=10,
+                            length=5)[0].shape[0] == 1   # index→last, len→1
+        assert node.execute(image=imgs, batch_index=2,
+                            length=99)[0].shape[0] == 2  # len clamps to rest
+
+    def test_model_sampling_sd3_overrides_shift(self):
+        import types
+
+        node = NODE_REGISTRY["ModelSamplingSD3"]()
+        base = types.SimpleNamespace(pipeline="p", preset="x")
+        wrapped = node.execute(model=base, shift=7.5)[0]
+        assert wrapped.sampling_shift == 7.5
+        assert wrapped.pipeline == "p" and wrapped.preset == "x"  # forwards
+
+    def test_flow_node_uses_model_shift_when_unwired(self):
+        """TPUFlowTxt2Img with no wired shift consults the
+        ModelSamplingSD3 override; a wired shift wins."""
+        import types
+
+        seen = {}
+
+        class FakePipe:
+            def generate(self, mesh, spec, seed, ctx, pooled, **kw):
+                seen["shift"] = spec.shift
+                return jnp.zeros((1, 4, 4, 3))
+
+        base = types.SimpleNamespace(pipeline=FakePipe())
+        wrapped = NODE_REGISTRY["ModelSamplingSD3"]().execute(
+            model=base, shift=5.5)[0]
+        cond = {"context": jnp.zeros((1, 2, 8)),
+                "pooled": jnp.zeros((1, 8))}
+        node = NODE_REGISTRY["TPUFlowTxt2Img"]()
+        node.execute(model=wrapped, positive=cond, seed=0, steps=1,
+                     width=8, height=8)
+        assert seen["shift"] == 5.5
+        node.execute(model=wrapped, positive=cond, seed=0, steps=1,
+                     width=8, height=8, shift=2.0)
+        assert seen["shift"] == 2.0
+
+
 class TestDistributedValue:
     def _run(self, **kw):
         return NODE_REGISTRY["DistributedValue"]().execute(**kw)[0]

@@ -134,6 +134,36 @@ class TestSaveLoadVideo:
         full = load_video(p)["frames"]
         np.testing.assert_allclose(out["frames"], full[2::2][:3])
 
+    def test_selection_keeps_fps_and_audio_coherent(self, tmp_path):
+        """Stride divides the fps and the audio is trimmed to the span
+        the selected frames cover — the saved result plays at the same
+        wall-clock speed as the source (VHS_LoadVideo behavior)."""
+        p = tmp_path / "clip.avi"
+        audio = sine_audio(seconds=10 / 8.0)         # exactly 10 frames @ 8fps
+        save_video(p, smooth_frames(t=10), fps=8.0, audio=audio)
+        out = load_video(p, select_every_nth=2)
+        assert out["frames"].shape[0] == 5
+        assert out["fps"] == 4.0                     # 8 / stride 2
+        sr = audio["sample_rate"]
+        # span covered: frames 0..8 inclusive → 9/8 s of audio
+        assert out["audio"]["waveform"].shape[-1] == round(9 / 8.0 * sr)
+        skip = load_video(p, skip_first_frames=4)
+        # skipped prefix removed from the track
+        np.testing.assert_allclose(
+            skip["audio"]["waveform"][0, 0, :100],
+            audio["waveform"][0, 0, round(4 / 8.0 * sr):][:100], atol=1e-3)
+        assert skip["fps"] == 8.0                    # no stride → fps kept
+
+    def test_cap_stops_decode_early(self, tmp_path):
+        """frame_load_cap bounds decode work on the cv2 path (no
+        full-container materialization) — frames beyond the cap are
+        never stored."""
+        p = tmp_path / "long.mp4"
+        save_video(p, smooth_frames(t=40), fps=8.0)
+        out = load_video(p, frame_load_cap=4)
+        assert out["frames"].shape[0] == 4
+        assert out["frame_count"] == 4
+
     def test_validation_errors(self, tmp_path):
         with pytest.raises(ValidationError):
             load_video(tmp_path / "missing.mp4")
