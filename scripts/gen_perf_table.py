@@ -61,17 +61,26 @@ def _row_txt2img(rnd: int, a: dict) -> str:
             f"TFLOP/s bf16 peak) — r{rnd:02d} |")
 
 
+def _mfu_note(a: dict) -> str:
+    """r05+: every workload artifact carries mfu (VERDICT r04 weak #1);
+    older artifacts render without it."""
+    return f"**{a['mfu'] * 100:.1f}% MFU**, " if a.get("mfu") else ""
+
+
 def _row_usdu(rnd: int, a: dict) -> str:
     hw = a.get("output_hw", [4096, 4096])
+    tps = (f"{a['tiles_per_sec']:.1f} tiles/s, "
+           if a.get("tiles_per_sec") else "")
     return (f"| 4K Ultimate SD Upscale (1024²→{hw[0]}², "
             f"{a['tiles']} tiles × {a['steps']} steps) | "
-            f"**{a['value']:.1f} s** | chunked tile-farm path; a pod "
-            f"shards the tile axis — r{rnd:02d} |")
+            f"**{a['value']:.1f} s** | {_mfu_note(a)}{tps}chunked "
+            f"tile-farm path; a pod shards the tile axis — r{rnd:02d} |")
 
 
 def _row_wan(rnd: int, a: dict) -> str:
     return (f"| WAN-1.3B t2v, {a['frames']} frames 480×832, "
-            f"{a['steps']} steps, CFG | **{a['value']:.1f} s** | exact WAN "
+            f"{a['steps']} steps, CFG | **{a['value']:.1f} s** | "
+            f"{_mfu_note(a)}exact WAN "
             f"stack + 3D causal VAE, spatially-tiled decode — r{rnd:02d} |")
 
 
@@ -84,7 +93,8 @@ def _row_flux(rnd: int, a: dict) -> str:
                     f"({a['median_image_latency_s']:.0f} s/image, "
                     f"{step:.2f} s/step) | whole quantized block set "
                     f"({a['resident_bytes'] / 1e9:.1f} GB e4m3, "
-                    f"per-channel scales) HBM-resident; zero bytes "
+                    f"per-channel scales) HBM-resident; "
+                    f"{_mfu_note(a)}zero bytes "
                     f"streamed per step, one scanned program per forward "
                     f"— r{rnd:02d} |")
         streamed_gb = a.get("streamed_bytes_per_step", 0) / 1e9
@@ -113,7 +123,7 @@ def _row_wan14b(rnd: int, a: dict) -> str:
 def _row_wan22(rnd: int, a: dict) -> str:
     return (f"| WAN-2.2-style dual-expert (MoE) t2v, {a['frames']} frames "
             f"480×832, {a['steps']} steps, CFG | **{a['value']:.1f} s** | "
-            f"two 1.3B-class experts bf16-resident, sigma-boundary "
+            f"{_mfu_note(a)}two 1.3B-class experts bf16-resident, sigma-boundary "
             f"switch at {a.get('expert_boundary', 0.875)} inside one "
             f"compiled program — measured within noise of the "
             f"single-expert run (the switch is free) — r{rnd:02d} |")

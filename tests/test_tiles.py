@@ -259,6 +259,28 @@ def test_range_plan_empty_range_noops(tiny_stack):
     assert out.dtype == full.dtype
 
 
+def test_range_plan_flops_probe(tiny_stack):
+    """The plan's analytic-FLOPs hook (USDU MFU accounting): positive,
+    deterministic, and scales with the sampler step count."""
+    from comfyui_distributed_tpu.tiles.engine import TileUpscaler
+
+    pipe, ctx, unc = tiny_stack
+    ups = TileUpscaler(pipe)
+    mesh = build_mesh({"dp": 8})
+    img = jax.random.uniform(jax.random.key(3), (16, 16, 3))
+    p2 = ups.range_plan(mesh, img, _spec(), seed=1, context=ctx,
+                        uncond_context=unc)
+    f2 = p2.flops_per_dispatch()
+    assert f2 > 0 and f2 == p2.flops_per_dispatch()
+    import dataclasses as _dc
+
+    spec4 = _dc.replace(_spec(), steps=4)
+    p4 = ups.range_plan(mesh, img, spec4, seed=1, context=ctx,
+                        uncond_context=unc)
+    # denoise scales the effective step count; more steps → more flops
+    assert p4.flops_per_dispatch() > f2
+
+
 def test_range_plan_tiles_per_device_invariant():
     """``tiles_per_device`` is a pure throughput knob: per-tile noise keys
     fold the GLOBAL tile index, so batching 2 tiles per device per
