@@ -261,9 +261,19 @@ class TileFarm:
     async def worker_run_async(
         self, job_id: str, worker_id: str, master_url: str,
         process_fn: ProcessFn, max_batch: int | None = None,
-        ready_polls: int = 20, ready_interval: float = 1.0,
+        ready_polls: int | None = None, ready_interval: float = 1.0,
     ) -> int:
-        """Pull-process-submit loop; returns number of tasks completed."""
+        """Pull-process-submit loop; returns number of tasks completed.
+
+        The default ready budget (``CDT_TILE_READY_POLLS`` × 1 s) covers
+        a COLD master: the tile job is seeded only when the master's
+        executor reaches the USDU node, behind the same upstream
+        compiles the worker races through — a 20 s budget lost that race
+        on a 1-core host and the worker left with 0 tasks."""
+        if ready_polls is None:
+            from ..utils.constants import env_int
+
+            ready_polls = env_int("CDT_TILE_READY_POLLS", 120)
         max_batch = constants.MAX_BATCH if max_batch is None else max_batch
         base = normalize_host_url(master_url)
         session = get_client_session()
@@ -304,7 +314,17 @@ class TileFarm:
                         params={"job_id": job_id}) as resp:
                     if resp.status < 400:
                         body = await resp.json()
-                        if body.get("exists"):
+                        # the TILE job specifically: orchestration
+                        # pre-creates a collector-kind entry under the
+                        # same id BEFORE the master's node seeds the
+                        # tile queue — a worker that accepted it would
+                        # pull once into the not-yet-initialized farm,
+                        # read task=None as "drained", and leave with 0
+                        # tasks (observed in the 3-host integration
+                        # test; the reference covers the same race with
+                        # 404-tolerant pulls, worker_comms.py:124-169)
+                        if body.get("exists") and \
+                                body.get("kind") != "collector":
                             return True
             except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
                 pass
