@@ -98,7 +98,7 @@ def _dispatch(token, shard, sigma, x0) -> None:
 # step (sigma_next == 0 takes the single-call Euler fallback), so their
 # exact total is 2*steps - 1 — an exact total keeps the progress bar from
 # stalling one call short of 100% until finish() clamps it.
-_SECOND_ORDER = {"heun", "dpmpp_sde"}
+_SECOND_ORDER = {"heun", "dpmpp_sde", "res_2s", "res_2s_ancestral"}
 
 
 def calls_per_step(sampler: str) -> int:

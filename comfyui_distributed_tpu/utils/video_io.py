@@ -398,7 +398,10 @@ def load_video(path, frame_load_cap: int = 0, skip_first_frames: int = 0,
 
     n_sel = int(result["frames"].shape[0])
     src_fps = result["fps"]
-    selection_active = skip > 0 or nth > 1 or result.pop("truncated", False)
+    truncated = result.pop("truncated", False)   # pop unconditionally —
+    # a short-circuited `or` would leak the internal flag into the
+    # returned dict whenever skip/stride is set
+    selection_active = skip > 0 or nth > 1 or truncated
     if selection_active:
         result["fps"] = src_fps / nth
         if result["audio"] is not None:

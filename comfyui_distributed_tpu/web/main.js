@@ -2,12 +2,13 @@
 // + workerLifecycle.js status polling + workerSettings.js CRUD +
 // tunnelManager.js — SURVEY §2.7), dependency-free.
 
-import { api, probeHost, normalizeAddress, getAuthToken, setAuthToken } from "/web/apiClient.js";
-import { clampDivideBy, dividerNodes, inactiveLinks, describeAddedHosts, MAX_DIVIDE } from "/web/widgets.js";
-import { editableFields, groupByNode, applyFieldEdit, isMultiline, lintPrompt } from "/web/forms.js";
+import { api, probeHost, normalizeAddress, getAuthToken, setAuthToken } from "./apiClient.js";
+import { clampDivideBy, dividerNodes, inactiveLinks, describeAddedHosts, MAX_DIVIDE } from "./widgets.js";
+import { editableFields, groupByNode, applyFieldEdit, isMultiline, lintPrompt } from "./forms.js";
 import { distributedValueNodes, hostsWithConfigIndex, workerKey, parseWorkerValues,
-         valueType, setWorkerValue, serializeWorkerValues, orphanedKeys } from "/web/valueWidgets.js";
-import { newPollState, pollTick } from "/web/progressLogic.js";
+         valueType, setWorkerValue, serializeWorkerValues, orphanedKeys } from "./valueWidgets.js";
+import { newPollState, pollTick } from "./progressLogic.js";
+import { graphSvgFromText } from "./graphView.js";
 
 const POLL_MS = 3000;
 const LOG_REFRESH_MS = 2000;
@@ -188,6 +189,7 @@ async function refreshConfig() {
   renderMesh();
   renderNodeWidgets();
   renderParamForms();
+  renderGraphView();
 }
 
 async function refreshManaged() {
@@ -352,6 +354,25 @@ function writePromptInput(nodeId, field, value) {
   prompt[nodeId].inputs = prompt[nodeId].inputs || {};
   prompt[nodeId].inputs[field] = value;
   $("queue-prompt").value = JSON.stringify(prompt, null, 2);
+  // programmatic value assignment fires no "input" event — keep the
+  // graph view in sync with every edit path ("the graph a user sees is
+  // the graph that will be queued", docs/api.md)
+  renderGraphView();
+}
+
+// Read-only DAG render of the loaded workflow (graphView.js): the user
+// SEES the graph they are queueing — nodes, links, parameter summaries,
+// output nodes highlighted (the reference shows this via ComfyUI's
+// canvas; VERDICT r4 next #6).
+function renderGraphView() {
+  const root = $("graph-panel");
+  const outputClasses = new Set();
+  for (const [name, spec] of Object.entries((state.nodeSpecs || {}).nodes || {})) {
+    if (spec.output_node) outputClasses.add(name);
+  }
+  const svg = graphSvgFromText($("queue-prompt").value, outputClasses);
+  root.innerHTML = svg;
+  root.hidden = !svg;
 }
 
 // Parameter forms generated from node interface specs (forms.js +
@@ -420,6 +441,7 @@ function renderParamForms() {
           const raw = f.kind === "boolean" ? input.checked : input.value;
           const coerced = applyFieldEdit(prompt, f.nodeId, f.name, f.kind, raw);
           $("queue-prompt").value = JSON.stringify(prompt, null, 2);
+          renderGraphView();   // form edits fire no "input" event
           if (f.kind !== "boolean") input.value = coerced;
           input.classList.remove("invalid");
         } catch (e) {
@@ -679,6 +701,7 @@ async function init() {
       $("queue-prompt").value = JSON.stringify(wf, null, 2);
       renderNodeWidgets();
       renderParamForms();
+      renderGraphView();
     } catch (e) { alertError(e); }
   };
   let widgetDebounce = null;
@@ -687,6 +710,7 @@ async function init() {
     widgetDebounce = setTimeout(() => {
       renderNodeWidgets();
       renderParamForms();
+      renderGraphView();
     }, 400);
   });
   $("btn-add-worker").onclick = () => openEditor(null);

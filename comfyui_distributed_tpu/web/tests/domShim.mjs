@@ -1,0 +1,102 @@
+// Minimal DOM/browser shim so node:test can import main.js itself (no
+// jsdom in the toolchain — the runner is plain `node --test`). Elements
+// auto-vivify: any getElementById returns a persistent stub recording
+// the properties main.js sets, which is exactly what the tests assert.
+
+export function makeElement(id = "") {
+  const children = [];
+  const el = {
+    id,
+    children,
+    dataset: {},
+    style: {},
+    hidden: false,
+    disabled: false,
+    checked: false,
+    value: "",
+    textContent: "",
+    innerHTML: "",
+    className: "",
+    title: "",
+    src: "",
+    listeners: {},
+    appendChild(c) { children.push(c); return c; },
+    append(...cs) { children.push(...cs); },
+    replaceChildren(...cs) { children.length = 0; children.push(...cs); },
+    addEventListener(name, fn) {
+      (el.listeners[name] = el.listeners[name] || []).push(fn);
+    },
+    querySelector() { return makeElement(); },
+    querySelectorAll() { return []; },
+    setAttribute(k, v) { el[k] = v; },
+    focus() {},
+    click() { if (el.onclick) return el.onclick({ target: el }); },
+  };
+  return el;
+}
+
+export function installDom({ routes = {}, fetchLog = [] } = {}) {
+  const byId = new Map();
+  const doc = {
+    getElementById(id) {
+      if (!byId.has(id)) byId.set(id, makeElement(id));
+      return byId.get(id);
+    },
+    createElement(tag) {
+      const el = makeElement();
+      el.tagName = String(tag).toUpperCase();
+      return el;
+    },
+    body: makeElement("body"),
+  };
+
+  const storage = new Map();
+  const localStorage = {
+    getItem: (k) => (storage.has(k) ? storage.get(k) : null),
+    setItem: (k, v) => storage.set(k, String(v)),
+    removeItem: (k) => storage.delete(k),
+  };
+
+  // fetch: look up the longest matching route prefix; default 404.
+  // Routes map path-prefix → JSON payload or (url, opts) → payload fn.
+  // Path-only routes ("/distributed/...") match only SAME-ORIGIN
+  // requests — an absolute cross-origin URL (worker probes) must be
+  // registered with its full "http://host:port/..." prefix, so
+  // unregistered hosts read as offline.
+  async function fetch(url, opts = {}) {
+    const u = String(url);
+    fetchLog.push({ url: u, opts });
+    const keys = Object.keys(routes)
+      .filter((k) => (k.startsWith("http")
+        ? u.startsWith(k)
+        : !u.startsWith("http") && u.startsWith(k)))
+      .sort((a, b) => b.length - a.length);
+    if (!keys.length) {
+      return { ok: false, status: 404,
+               json: async () => ({ error: "not found" }),
+               text: async () => "not found" };
+    }
+    let payload = routes[keys[0]];
+    if (typeof payload === "function") payload = payload(u, opts);
+    return { ok: true, status: 200,
+             json: async () => payload,
+             text: async () => JSON.stringify(payload) };
+  }
+
+  class FakeAbortController {
+    constructor() { this.signal = { aborted: false }; }
+    abort() { this.signal.aborted = true; }
+  }
+
+  const timers = [];
+  const g = globalThis;
+  g.AbortController = g.AbortController || FakeAbortController;
+  g.document = doc;
+  g.localStorage = localStorage;
+  g.fetch = fetch;
+  g.alert = () => {};
+  g.confirm = () => true;
+  g.setInterval = (fn, ms) => { timers.push({ fn, ms }); return timers.length; };
+  g.clearInterval = () => {};
+  return { doc, byId, fetchLog, timers, routes };
+}

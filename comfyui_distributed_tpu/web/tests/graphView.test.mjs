@@ -1,0 +1,104 @@
+// node:test suite for the read-only DAG view (graphView.js) — pure
+// logic + SVG-string rendering, no DOM needed.
+import assert from "node:assert/strict";
+import { test } from "node:test";
+
+import {
+  graphModel,
+  graphSvgFromText,
+  layoutGraph,
+  renderGraphSvg,
+} from "../graphView.js";
+
+const PROMPT = {
+  _meta: { title: "ignored" },
+  1: { class_type: "CheckpointLoader", inputs: { ckpt_name: "tiny" } },
+  2: { class_type: "CLIPTextEncode",
+       inputs: { text: "a cat", clip: ["1", 1] } },
+  3: { class_type: "CLIPTextEncode", inputs: { text: "", clip: ["1", 1] } },
+  4: { class_type: "TPUTxt2Img",
+       inputs: { model: ["1", 0], positive: ["2", 0], negative: ["3", 0],
+                 seed: 7, steps: 30, width: 1024, height: 1024 } },
+  5: { class_type: "SaveImage", inputs: { images: ["4", 0] } },
+};
+
+test("graphModel splits links from params and skips _meta", () => {
+  const m = graphModel(PROMPT);
+  assert.equal(m.nodes.length, 5);
+  assert.equal(m.links.length, 6);     // 2 clip + 3 sampler + 1 save
+  const sampler = m.nodes.find((n) => n.id === "4");
+  assert.deepEqual(sampler.params.map(([k]) => k).sort(),
+                   ["height", "seed", "steps", "width"]);
+  const save = m.links.find((l) => l.to === "5");
+  assert.deepEqual(save, { from: "4", fromSlot: 0, to: "5",
+                           input: "images" });
+});
+
+test("graphModel tolerates malformed input", () => {
+  assert.deepEqual(graphModel(null), { nodes: [], links: [] });
+  assert.deepEqual(graphModel([1, 2]), { nodes: [], links: [] });
+  assert.deepEqual(graphModel("x"), { nodes: [], links: [] });
+  // dangling link target dropped, node kept
+  const m = graphModel({ 1: { class_type: "SaveImage",
+                              inputs: { images: ["9", 0] } } });
+  assert.equal(m.nodes.length, 1);
+  assert.equal(m.links.length, 0);
+});
+
+test("layoutGraph layers follow the longest path", () => {
+  const { pos } = layoutGraph(graphModel(PROMPT));
+  const x = (id) => pos.get(id).x;
+  assert.ok(x("1") < x("2"));          // loader left of encoders
+  assert.ok(x("2") < x("4"));          // encoders left of sampler
+  assert.ok(x("4") < x("5"));          // sampler left of save
+  assert.equal(x("2"), x("3"));        // both encoders share a column
+  assert.notEqual(pos.get("2").y, pos.get("3").y);  // distinct rows
+});
+
+test("layoutGraph survives a cycle without hanging", () => {
+  const m = graphModel({
+    a: { class_type: "X", inputs: { v: ["b", 0] } },
+    b: { class_type: "X", inputs: { v: ["a", 0] } },
+  });
+  const { pos } = layoutGraph(m);
+  assert.equal(pos.size, 2);
+});
+
+test("renderGraphSvg emits one group per node and one path per link", () => {
+  const m = graphModel(PROMPT);
+  const svg = renderGraphSvg(m, new Set(["SaveImage"]));
+  assert.equal((svg.match(/<g class="graph-node/g) || []).length, 5);
+  assert.equal((svg.match(/graph-link/g) || []).length, 6);
+  assert.ok(svg.includes("graph-node-output"));   // SaveImage highlighted
+  assert.ok(svg.includes("4 · TPUTxt2Img"));
+  assert.ok(svg.includes("seed=7"));
+});
+
+test("renderGraphSvg escapes hostile strings", () => {
+  const m = graphModel({
+    1: { class_type: "<script>alert(1)</script>",
+         inputs: { t: '"><img onerror=x>' } },
+  });
+  const svg = renderGraphSvg(m);
+  assert.ok(!svg.includes("<script>"));
+  assert.ok(!svg.includes("<img"));
+});
+
+test("graphSvgFromText handles empty and invalid JSON", () => {
+  assert.equal(graphSvgFromText(""), "");
+  assert.equal(graphSvgFromText("   "), "");
+  assert.equal(graphSvgFromText("{not json"), "");
+  assert.equal(graphSvgFromText("{}"), "");
+  const svg = graphSvgFromText(JSON.stringify(PROMPT));
+  assert.ok(svg.startsWith("<svg"));
+  assert.ok(svg.endsWith("</svg>"));
+});
+
+test("param summary truncates long values", () => {
+  const m = graphModel({
+    1: { class_type: "CLIPTextEncode",
+         inputs: { text: "a very long prompt that keeps going on" } },
+  });
+  const svg = renderGraphSvg(m);
+  assert.ok(svg.includes("…"));
+});
