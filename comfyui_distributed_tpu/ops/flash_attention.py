@@ -407,7 +407,7 @@ def _layout_packed(H: int, D: int,
 
 def flash_attention(
     q: jax.Array, k: jax.Array, v: jax.Array,
-    block_q: int = 256, block_k: int = 512,
+    block_q: Optional[int] = None, block_k: Optional[int] = None,
     interpret: Optional[bool] = None,
     layout: Optional[str] = None,
 ) -> jax.Array:
@@ -417,6 +417,11 @@ def flash_attention(
     ``interpret=None`` auto-selects: compiled on TPU, interpreter
     elsewhere (CPU tests run the same kernel code path).
 
+    ``block_q``/``block_k=None`` resolve to ``CDT_FLASH_BLOCK_Q``/
+    ``CDT_FLASH_BLOCK_K`` (defaults 256/512, measured r04; the r05 WAN
+    probes showed 512 is also the largest K block the 16 MB scoped VMEM
+    admits at H·D=1536 — docs/roofline.md).
+
     ``layout`` forces the kernel I/O layout for this call: ``"packed"``
     (where geometrically legal — illegal geometries still fall back) or
     ``"bh"``; ``None`` auto-selects per ``_layout_packed`` (legality +
@@ -425,6 +430,19 @@ def flash_attention(
     """
     if interpret is None:
         interpret = not _on_tpu()
+    if block_q is None or block_k is None:
+        from ..utils.constants import env_int
+
+        # defaults measured r04 at SDXL shapes; env knobs for per-shape
+        # tuning experiments (r05: larger K blocks probed at WAN's 14k
+        # tokens — see docs/roofline.md). Non-positive values fall back
+        # to the defaults — same no-crash contract as env_int itself.
+        if block_q is None:
+            block_q = env_int("CDT_FLASH_BLOCK_Q", 256)
+            block_q = block_q if block_q > 0 else 256
+        if block_k is None:
+            block_k = env_int("CDT_FLASH_BLOCK_K", 512)
+            block_k = block_k if block_k > 0 else 512
     B, Nq, H, D = q.shape
     _, Nk, _, _ = k.shape
     if layout == "packed":

@@ -208,6 +208,8 @@ class TestShapeGate:
         monkeypatch.delenv("CDT_FLASH_MIN_SEQ_PACKED", raising=False)
         monkeypatch.delenv("CDT_FLASH_MIN_KV_PACKED", raising=False)
         monkeypatch.delenv("CDT_FLASH_LAYOUT", raising=False)
+        monkeypatch.delenv("CDT_FLASH_BLOCK_Q", raising=False)
+        monkeypatch.delenv("CDT_FLASH_BLOCK_K", raising=False)
         fake = types.SimpleNamespace(platform="tpu")
         monkeypatch.setattr(attn.jax, "devices", lambda *a: [fake])
         return attn
@@ -265,3 +267,18 @@ class TestShapeGate:
         monkeypatch.setenv("CDT_FLASH_MIN_SEQ_PACKED", "banana")
         assert on_tpu._flash_enabled(q_len=4096, kv_len=4096,
                                      num_heads=10, head_dim=64)
+
+    def test_block_env_knobs_reach_kernel(self, monkeypatch):
+        """CDT_FLASH_BLOCK_Q/K (r05 tuning knobs) change the kernel's
+        block geometry without changing its math; non-positive values
+        fall back to the defaults instead of crashing the grid math."""
+        q, k, v = rand_qkv(jax.random.key(12), Nq=256, Nk=512)
+        ref = dense_reference(q, k, v)
+        monkeypatch.setenv("CDT_FLASH_BLOCK_Q", "128")
+        monkeypatch.setenv("CDT_FLASH_BLOCK_K", "128")
+        out = flash_attention(q, k, v, interpret=True)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
+        monkeypatch.setenv("CDT_FLASH_BLOCK_Q", "0")
+        monkeypatch.setenv("CDT_FLASH_BLOCK_K", "-64")
+        out = flash_attention(q, k, v, interpret=True)
+        np.testing.assert_allclose(out, ref, atol=2e-5, rtol=2e-5)
