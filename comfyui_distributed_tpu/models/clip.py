@@ -233,8 +233,34 @@ class CLIPConditioner:
         self.stack = stack
         self.kind = kind
         if tok_l is None and tok_g is None:
-            tok_l, tok_g = load_sd_tokenizers()
+            # tokenize each tower to ITS context length — the position
+            # tables only cover cfg.max_len, so a 77-padded sequence would
+            # not even shape-check against a shorter tower (e.g. the tiny
+            # test configs at max_len=16)
+            from .tokenizer import CLIPBPETokenizer
+
+            cfg_l = stack.clip_l.config if kind == "sdxl" else stack.config
+            tok_l, _ = load_sd_tokenizers(max_len=cfg_l.max_len)
+            if kind == "sdxl" and tok_l is not None:
+                tok_g = CLIPBPETokenizer.from_env(
+                    max_len=stack.clip_g.config.max_len, pad_token_id=0)
         self.tok_l, self.tok_g = tok_l, tok_g
+        if self.tok_l is not None:
+            towers = [("clip_l", self.tok_l,
+                       stack.clip_l.config if kind == "sdxl" else stack.config)]
+            if kind == "sdxl":
+                towers.append(("clip_g", self.tok_g, stack.clip_g.config))
+            for name, tok, cfg in towers:
+                # a mismatched vocab would not fail loudly downstream:
+                # out-of-range ids CLAMP in nn.Embed and a wrong EOT id
+                # silently pools position 0 — refuse instead
+                if tok.eot_id != cfg.eot_token_id or len(tok.vocab) > cfg.vocab_size:
+                    raise ValueError(
+                        f"CDT_TOKENIZER_DIR vocab does not match the {name} "
+                        f"tower: vocab has {len(tok.vocab)} entries with "
+                        f"EOT id {tok.eot_id}, config expects "
+                        f"vocab_size<={cfg.vocab_size} / "
+                        f"eot_token_id={cfg.eot_token_id}")
         if self.tok_l is None:
             log("WARNING: no CLIP vocab at CDT_TOKENIZER_DIR — text is "
                 "hash-tokenized; conditioning will not reflect the prompt")

@@ -1,0 +1,143 @@
+"""Real-tokenizer conditioning end-to-end (VERDICT r04 weak #4).
+
+Prompt string → CLIP BPE tokenizer (synthetic vocab via
+``CDT_TOKENIZER_DIR``) → weight-faithful CLIP-L/G stack → UNet sampling →
+image, through the graph executor — the exact production path a user with
+a real ``vocab.json``/``merges.txt`` gets, previously only tested in
+pieces (tokenizer differentially in ``test_tokenizer.py``, CLIP numerics
+in ``test_clip.py``, sampling in ``test_workflows.py``) but never wired
+together.
+
+The synthetic vocabulary places EOT/SOT at the top of a fixed-size table
+so pooling (``argmax(tokens == eot_token_id)``) is exercised with the same
+id discipline real CLIP vocabs use (eot = vocab_size - 1 = 49407)."""
+
+import json
+from pathlib import Path
+
+import jax
+import numpy as np
+import pytest
+
+from comfyui_distributed_tpu.graph.executor import GraphExecutor, strip_meta
+from comfyui_distributed_tpu.models.clip import (
+    CLIPConditioner, CLIPTextConfig, CLIPTextModel, SDXLTextStack)
+from comfyui_distributed_tpu.models.tokenizer import (
+    CLIPBPETokenizer, EOT, SOT)
+
+pytestmark = pytest.mark.slow  # compile-heavy: builds/jits real model stacks
+
+VOCAB_SIZE = 128                 # matches CLIPTextConfig.tiny()
+EOT_ID = VOCAB_SIZE - 1          # real-CLIP convention: EOT is the last id
+MAX_LEN = 16                     # matches CLIPTextConfig.tiny()
+
+
+def _build_vocab() -> tuple[dict, list]:
+    """letters (bare + ``</w>``), a few merges, filler to pin EOT at 127."""
+    vocab: dict[str, int] = {}
+    for c in "abcdefghijklmnopqrstuvwxyz":
+        vocab[c] = len(vocab)
+        vocab[c + "</w>"] = len(vocab)
+    merges = [("c", "a"), ("ca", "t</w>"), ("d", "o"), ("do", "g</w>"),
+              ("s", "e"), ("se", "a</w>")]
+    for a, b in merges:
+        vocab[a + b] = len(vocab)
+    while len(vocab) < VOCAB_SIZE - 2:
+        vocab[f"<fill{len(vocab)}>"] = len(vocab)
+    vocab[SOT] = VOCAB_SIZE - 2
+    vocab[EOT] = EOT_ID
+    return vocab, merges
+
+
+@pytest.fixture(scope="module")
+def vocab_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp("clip_vocab")
+    vocab, merges = _build_vocab()
+    (d / "vocab.json").write_text(json.dumps(vocab))
+    (d / "merges.txt").write_text(
+        "#version: 0.2\n" + "\n".join(f"{a} {b}" for a, b in merges))
+    return d
+
+
+def _tiny_stack() -> SDXLTextStack:
+    """Tiny SDXL dual-tower whose concat context (16+16=32) and projected
+    pool (16) match the ``tiny`` registry preset's UNet contract."""
+    cfg_l = CLIPTextConfig.tiny(width=16, heads=2, eot_token_id=EOT_ID)
+    cfg_g = CLIPTextConfig.tiny(width=16, heads=2, act="gelu",
+                                projection_dim=16, eot_token_id=EOT_ID)
+    k1, k2 = jax.random.split(jax.random.key(0))
+    return SDXLTextStack(CLIPTextModel(cfg_l).init(k1),
+                         CLIPTextModel(cfg_g).init(k2))
+
+
+class TestConditionerTokenizerWiring:
+    def test_loads_at_stack_max_len(self, vocab_dir, monkeypatch):
+        """The conditioner must tokenize to the stack's context length —
+        a 77-padded sequence does not shape-check against the tiny
+        towers' 16-entry position table."""
+        monkeypatch.setenv("CDT_TOKENIZER_DIR", str(vocab_dir))
+        cond = CLIPConditioner(_tiny_stack(), kind="sdxl")
+        assert cond.tok_l is not None and cond.tok_g is not None
+        assert cond.tok_l.max_len == MAX_LEN
+        assert cond.tok_g.pad_token_id == 0          # CLIP-G zero padding
+        assert cond.tok_l.pad_token_id == EOT_ID     # CLIP-L EOT padding
+
+    def test_ids_match_reference_tokenizer(self, vocab_dir, monkeypatch):
+        monkeypatch.setenv("CDT_TOKENIZER_DIR", str(vocab_dir))
+        cond = CLIPConditioner(_tiny_stack(), kind="sdxl")
+        direct = CLIPBPETokenizer.from_dir(vocab_dir, max_len=MAX_LEN)
+        ids = cond._ids(["cat dog"], cond.tok_l,
+                        cond.stack.clip_l.config, EOT_ID)
+        assert ids.tolist()[0] == direct.encode("cat dog")
+        # the BPE merges actually engaged (whole-word tokens, not letters)
+        assert direct.encode("cat dog")[1:3] == [
+            direct.vocab["cat</w>"], direct.vocab["dog</w>"]]
+
+    def test_encode_shapes_and_prompt_sensitivity(self, vocab_dir,
+                                                  monkeypatch):
+        monkeypatch.setenv("CDT_TOKENIZER_DIR", str(vocab_dir))
+        cond = CLIPConditioner(_tiny_stack(), kind="sdxl")
+        ctx, pooled = cond.encode(["cat dog"])
+        assert ctx.shape == (1, MAX_LEN, 32) and pooled.shape == (1, 16)
+        ctx2, pooled2 = cond.encode(["sea cat"])
+        assert not np.allclose(np.asarray(ctx), np.asarray(ctx2))
+        assert not np.allclose(np.asarray(pooled), np.asarray(pooled2))
+        # whitespace/case normalization is the tokenizer's, not the hash
+        # fallback's: same tokens → bitwise-identical conditioning
+        ctx3, _ = cond.encode(["  CAT   dog "])
+        np.testing.assert_array_equal(np.asarray(ctx), np.asarray(ctx3))
+
+
+class TestPromptToImage:
+    def test_txt2img_workflow_real_tokenizer(self, vocab_dir, monkeypatch,
+                                             tmp_path):
+        """The shipped txt2img graph, conditioned through the real BPE →
+        CLIP-L/G path end-to-end: string prompts in, per-chip PNGs out."""
+        from comfyui_distributed_tpu.models.registry import ModelRegistry
+
+        monkeypatch.setenv("CDT_TOKENIZER_DIR", str(vocab_dir))
+        registry = ModelRegistry()
+        bundle = registry.get("tiny")
+        stack = _tiny_stack()
+        bundle.clip_stack = stack
+        bundle.text_encoder = CLIPConditioner(stack, kind="sdxl")
+        assert bundle.text_encoder.tok_l is not None
+
+        prompt = strip_meta(json.loads(
+            Path("workflows/distributed-txt2img.json").read_text()))
+        for node in prompt.values():
+            if node["class_type"] == "CheckpointLoader":
+                node["inputs"]["ckpt_name"] = "tiny"
+            for key, val in (("width", 16), ("height", 16), ("steps", 2)):
+                if key in node.get("inputs", {}):
+                    node["inputs"][key] = val
+        prompt["2"]["inputs"]["text"] = "cat dog sea"
+        prompt["3"]["inputs"]["text"] = "dog"
+        prompt["7"]["inputs"]["output_dir"] = str(tmp_path)
+
+        outputs = GraphExecutor({"model_registry": registry}).execute(prompt)
+        n_dev = len(jax.devices())
+        imgs = np.asarray(outputs["6"][0])
+        assert imgs.shape[0] == n_dev
+        assert np.isfinite(imgs).all()
+        assert len(list(tmp_path.glob("*.png"))) == n_dev
