@@ -35,6 +35,9 @@ class FlowSpec:
     steps: int = 28
     shift: float = 3.0              # resolution-dependent sigma shift
     guidance: float = 3.5           # distilled guidance (FLUX-dev)
+    cfg: float = 1.0                # true classifier-free guidance scale
+                                    # (SD3-family; 1.0 = off, FLUX-dev
+                                    # bakes guidance into `guidance`)
     sampler: str = "euler"
     per_device_batch: int = 1
 
@@ -52,28 +55,45 @@ class FlowPipeline:
         return {"dit": self.dit_params, "vae_dec": self.vae.dec_params}
 
     def _denoiser(self, context, pooled, guidance, sp_axis=None,
-                  weights=None):
+                  weights=None, cfg: float = 1.0, uncond_context=None,
+                  uncond_pooled=None):
+        """``cfg != 1.0`` (SD3-family true CFG) batches the cond/uncond
+        passes into one doubled-batch model call (``guidance.cfg_denoiser``
+        — same discipline as the UNet path); FLUX-dev keeps cfg=1.0 and
+        its distilled ``guidance`` input."""
         dit_params = (self.dit_params if weights is None
                       else weights["dit"])
 
-        def denoise(x, sigma):
-            t = jnp.broadcast_to(sigma, (x.shape[0],))
-            g = jnp.full((x.shape[0],), guidance)
-            v = self.dit.apply(dit_params, x, t, context, pooled, g,
-                               sp_axis=sp_axis)
-            return x - sigma * v
-        return denoise
+        def make(ctx, pl):
+            def denoise(x, sigma):
+                t = jnp.broadcast_to(sigma, (x.shape[0],))
+                g = jnp.full((x.shape[0],), guidance)
+                v = self.dit.apply(dit_params, x, t, ctx, pl, g,
+                                   sp_axis=sp_axis)
+                return x - sigma * v
+            return denoise
+
+        if cfg == 1.0 or uncond_context is None:
+            return make(context, pooled)
+        from .guidance import cfg_denoiser
+
+        return cfg_denoiser(make, context, uncond_context, cfg,
+                            y=pooled, uncond_y=uncond_pooled)
 
     def _sample_and_decode(self, key, context, pooled, spec: FlowSpec,
                            batch: int, sigmas, lat_hw, sp_axis=None,
                            decode: bool = True, weights=None,
-                           progress=None):
+                           progress=None, uncond_context=None,
+                           uncond_pooled=None):
         lat_h, lat_w = lat_hw
         c = self.dit.config.in_channels
         x = jax.random.normal(key, (batch, lat_h, lat_w, c), jnp.float32)
-        bc = lambda a: jnp.broadcast_to(a, (batch,) + a.shape[1:])
+        bc = lambda a: (None if a is None
+                        else jnp.broadcast_to(a, (batch,) + a.shape[1:]))
         den = self._denoiser(bc(context), bc(pooled), spec.guidance, sp_axis,
-                             weights=weights)
+                             weights=weights, cfg=spec.cfg,
+                             uncond_context=bc(uncond_context),
+                             uncond_pooled=bc(uncond_pooled))
         if progress is not None:
             from .progress import wrap_denoiser
 

@@ -195,6 +195,18 @@ class SDXLTextStack:
         return context, out_g["projected"]
 
 
+def validate_tokenizer_vocab(tok, cfg: CLIPTextConfig, name: str) -> None:
+    """Refuse a CDT_TOKENIZER_DIR vocab that does not match a tower's
+    config: a mismatch would not fail loudly downstream — out-of-range ids
+    CLAMP in ``nn.Embed`` and a wrong EOT id silently pools position 0."""
+    if tok.eot_id != cfg.eot_token_id or len(tok.vocab) > cfg.vocab_size:
+        raise ValueError(
+            f"CDT_TOKENIZER_DIR vocab does not match the {name} tower: "
+            f"vocab has {len(tok.vocab)} entries with EOT id {tok.eot_id}, "
+            f"config expects vocab_size<={cfg.vocab_size} / "
+            f"eot_token_id={cfg.eot_token_id}")
+
+
 def tokenize_ids(texts, tok, cfg, pad_id: int) -> jax.Array:
     """Strings → [B, max_len] int32 ids: real BPE when a tokenizer is
     loaded, deterministic hash fallback (correct SOT/EOT framing so EOT
@@ -251,16 +263,7 @@ class CLIPConditioner:
             if kind == "sdxl":
                 towers.append(("clip_g", self.tok_g, stack.clip_g.config))
             for name, tok, cfg in towers:
-                # a mismatched vocab would not fail loudly downstream:
-                # out-of-range ids CLAMP in nn.Embed and a wrong EOT id
-                # silently pools position 0 — refuse instead
-                if tok.eot_id != cfg.eot_token_id or len(tok.vocab) > cfg.vocab_size:
-                    raise ValueError(
-                        f"CDT_TOKENIZER_DIR vocab does not match the {name} "
-                        f"tower: vocab has {len(tok.vocab)} entries with "
-                        f"EOT id {tok.eot_id}, config expects "
-                        f"vocab_size<={cfg.vocab_size} / "
-                        f"eot_token_id={cfg.eot_token_id}")
+                validate_tokenizer_vocab(tok, cfg, name)
         if self.tok_l is None:
             log("WARNING: no CLIP vocab at CDT_TOKENIZER_DIR — text is "
                 "hash-tokenized; conditioning will not reflect the prompt")

@@ -502,6 +502,8 @@ SD15_CLIP_PREFIX = "cond_stage_model.transformer.text_model."
 def detect_layout(sd: Mapping[str, np.ndarray]) -> str:
     if any(k.endswith("double_blocks.0.img_attn.qkv.weight") for k in sd):
         return "flux"
+    if any(k.endswith("joint_blocks.0.x_block.attn.qkv.weight") for k in sd):
+        return "sd3"
     if any(k.endswith("blocks.0.self_attn.norm_q.weight") for k in sd):
         return "wan"
     if any(k.startswith(FLUX_DIFFUSERS_HINT) for k in sd):
@@ -539,6 +541,19 @@ def convert_checkpoint(path: Path, bundle) -> None:
         bundle.pipeline.dit_params = convert_flux(
             sd, bundle.pipeline.dit_params, bundle.preset.dit, prefix)
         log("FLUX transformer converted; VAE/text encoders ship separately "
+            "and keep their current weights")
+        return
+
+    if layout == "sd3":
+        if bundle.kind != "dit":
+            raise ConversionError(
+                f"SD3 MMDiT checkpoint needs a dit preset; "
+                f"{bundle.preset.name!r} is {bundle.kind!r}")
+        prefix = (FLUX_PREFIXED if any(k.startswith(FLUX_PREFIXED)
+                                       for k in sd) else "")
+        bundle.pipeline.dit_params = convert_mmdit_sd3(
+            sd, bundle.pipeline.dit_params, bundle.preset.dit, prefix)
+        log("SD3 MMDiT converted; VAE/text encoders ship separately "
             "and keep their current weights")
         return
 
@@ -824,5 +839,124 @@ def convert_flux(sd: Mapping[str, np.ndarray], template, config,
         if leftover:
             raise ConversionError(
                 f"unconsumed FLUX keys: {leftover[:8]}"
+                f"{'…' if len(leftover) > 8 else ''}")
+    return {"params": tree}
+
+
+def convert_mmdit_sd3(sd: Mapping[str, np.ndarray], template, config,
+                      prefix: str = "") -> dict:
+    """SD3/SD3.5 MMDiT state dict → ``models/dit.DiT`` params.
+
+    Source layout: the published SAI single-file transformer keys
+    (``x_embedder.proj``, ``pos_embed``, ``context_embedder``,
+    ``t_embedder.mlp.{0,2}``, ``y_embedder.mlp.{0,2}``,
+    ``joint_blocks.N.{x_block,context_block}.*``, ``final_layer.*``), bare
+    or under ``model.diffusion_model.``. The reference runs SD3 through
+    ComfyUI's loader (SURVEY "external substrate"); here the mapping is
+    explicit and shape-checked:
+
+    - ``x_embedder.proj`` is a p×p stride-p conv: its OIHW kernel
+      transposes to our patchified-token Dense ordering (row, col, chan)
+      — ``w.transpose(2, 3, 1, 0).reshape(p·p·C, hidden)``. SD3's own
+      unpatchify uses the same (p, q, c) ordering, so ``final_layer.
+      linear`` needs NO row permutation (unlike FLUX, ``_flux_patch_perm``).
+    - ``pos_embed`` ([1, m², h] trained table) → ``pos_emb`` verbatim.
+    - ``joint_blocks.i.{x,context}_block.{adaLN_modulation.1, attn.qkv,
+      attn.proj, mlp.fc1, mlp.fc2}`` → ``double_i/{img,txt}_{mod/mod,
+      qkv/qkv, proj, mlp_up, mlp_down}`` (modulation row order
+      [shift|scale|gate]×2 matches).
+    - SD3.5 qk-norm: ``attn.ln_{q,k}.weight`` → ``{q,k}_scale`` — present
+      exactly when ``config.qk_norm``; a mismatch raises with guidance.
+    - the LAST ``context_block`` is pre-only (SD3 discards the text
+      stream after the final joint attention): its 2h-row adaLN maps into
+      the first third of ``txt_mod/mod`` and the text-side output layers
+      (``txt_proj``, ``txt_mlp_*``) — which cannot influence the image
+      output — fill with zeros.
+    - ``final_layer.adaLN_modulation.1`` (rows [shift|scale]) maps into
+      the first two thirds of ``final_mod/mod``; the unused gate third is
+      zero (same convention as the FLUX converter).
+    """
+    p = prefix
+    f = _Filler(sd, template["params"])
+    h = config.hidden
+
+    def take(key: str) -> np.ndarray:
+        if key not in sd:
+            raise ConversionError(f"missing source key {key!r}")
+        f.used.add(key)
+        return np.asarray(sd[key], np.float32)
+
+    pp, c_in = config.patch_size, config.in_channels
+    wx = take(f"{p}x_embedder.proj.weight")          # [h, C, p, p]
+    f.put_raw(wx.transpose(2, 3, 1, 0).reshape(pp * pp * c_in, h),
+              "img_in/kernel")
+    f.put(f"{p}x_embedder.proj.bias", "img_in/bias")
+    m = config.pos_embed_max_size
+    f.put_raw(take(f"{p}pos_embed").reshape(m * m, h), "pos_emb")
+    f.linear(f"{p}context_embedder", "txt_in")
+    for src, dst in (("t_embedder", "time_in"), ("y_embedder", "vector_in")):
+        f.linear(f"{p}{src}.mlp.0", f"{dst}/in_layer")
+        f.linear(f"{p}{src}.mlp.2", f"{dst}/out_layer")
+
+    qk_keys = f"{p}joint_blocks.0.x_block.attn.ln_q.weight" in sd
+    if config.qk_norm and not qk_keys:
+        raise ConversionError(
+            "preset expects RMS qk-norm (SD3.5-class) but the checkpoint "
+            "has no attn.ln_q/ln_k keys — use an SD3-medium-class preset "
+            "with qk_norm=False")
+    if qk_keys and not config.qk_norm:
+        raise ConversionError(
+            "checkpoint carries attn.ln_q/ln_k qk-norm scales but the "
+            "preset has qk_norm=False — use an SD3.5-class preset")
+
+    last = config.depth_double - 1
+    for i in range(config.depth_double):
+        dst = f"double_{i}"
+        for tag, ours in (("x_block", "img"), ("context_block", "txt")):
+            src = f"{p}joint_blocks.{i}.{tag}"
+            pre_only = tag == "context_block" and i == last
+            wm = take(f"{src}.adaLN_modulation.1.weight")
+            bm = take(f"{src}.adaLN_modulation.1.bias")
+            if pre_only:
+                if wm.shape[0] != 2 * h:
+                    raise ConversionError(
+                        f"{src}: expected pre-only 2h-row adaLN in the "
+                        f"last context block, got {wm.shape[0]} rows")
+                wm = np.concatenate(
+                    [wm, np.zeros((4 * h, h), np.float32)], axis=0)
+                bm = np.concatenate([bm, np.zeros(4 * h, np.float32)])
+            f.put_raw(wm.T, f"{dst}/{ours}_mod/mod/kernel")
+            f.put_raw(bm, f"{dst}/{ours}_mod/mod/bias")
+            f.linear(f"{src}.attn.qkv", f"{dst}/{ours}_qkv/qkv")
+            if config.qk_norm:
+                f.put(f"{src}.attn.ln_q.weight", f"{dst}/{ours}_qkv/q_scale")
+                f.put(f"{src}.attn.ln_k.weight", f"{dst}/{ours}_qkv/k_scale")
+            if pre_only:
+                f.put_raw(np.zeros((h, h), np.float32), f"{dst}/txt_proj/kernel")
+                f.put_raw(np.zeros(h, np.float32), f"{dst}/txt_proj/bias")
+                f.put_raw(np.zeros((h, 4 * h), np.float32),
+                          f"{dst}/txt_mlp_up/kernel")
+                f.put_raw(np.zeros(4 * h, np.float32), f"{dst}/txt_mlp_up/bias")
+                f.put_raw(np.zeros((4 * h, h), np.float32),
+                          f"{dst}/txt_mlp_down/kernel")
+                f.put_raw(np.zeros(h, np.float32), f"{dst}/txt_mlp_down/bias")
+            else:
+                f.linear(f"{src}.attn.proj", f"{dst}/{ours}_proj")
+                f.linear(f"{src}.mlp.fc1", f"{dst}/{ours}_mlp_up")
+                f.linear(f"{src}.mlp.fc2", f"{dst}/{ours}_mlp_down")
+
+    wf = take(f"{p}final_layer.adaLN_modulation.1.weight")      # [2h, h]
+    bf = take(f"{p}final_layer.adaLN_modulation.1.bias")
+    f.put_raw(np.concatenate([wf.T, np.zeros((h, h), np.float32)], axis=1),
+              "final_mod/mod/kernel")
+    f.put_raw(np.concatenate([bf, np.zeros(h, np.float32)]),
+              "final_mod/mod/bias")
+    f.linear(f"{p}final_layer.linear", "img_out")
+    tree = f.finish(expect_prefix=p)
+    if not p:
+        leftover = [k for k in sd if k not in f.used]
+        if leftover:
+            raise ConversionError(
+                f"unconsumed SD3 keys: {leftover[:8]}"
                 f"{'…' if len(leftover) > 8 else ''}")
     return {"params": tree}

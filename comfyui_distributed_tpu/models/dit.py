@@ -1,13 +1,17 @@
-"""FLUX-class rectified-flow MMDiT.
+"""FLUX/SD3-class rectified-flow MMDiT.
 
-Covers the BASELINE "FLUX.1-dev txt2img" config family: double-stream
-(image/text) transformer blocks followed by single-stream blocks, adaLN-Zero
-modulation from (timestep, pooled text, guidance), patchified latents,
-velocity prediction for flow matching. The reference runs FLUX through
-ComfyUI; here the architecture is native and **sequence-parallel capable**:
-``attn_backend="ring"`` runs joint attention with image tokens sharded over
-the ``sp`` mesh axis (``ops/attention.joint_ring_attention``) — the
-capability the reference entirely lacks (SURVEY §2.10: SP/CP absent).
+Covers the BASELINE "FLUX.1-dev txt2img" config family — double-stream
+(image/text) transformer blocks followed by single-stream blocks — AND the
+SD3/SD3.5 family (``sd3_medium``/``sd35_large`` presets): joint-only
+depth (``depth_single=0``), learned cropped position table, optional
+qk-norm, no distilled-guidance embedder. Both share adaLN-Zero modulation
+from (timestep, pooled text[, guidance]), patchified latents, and velocity
+prediction for flow matching. The reference runs these models through
+ComfyUI; here the architecture is native and **sequence-parallel
+capable**: ``attn_backend="ring"`` runs joint attention with image tokens
+sharded over the ``sp`` mesh axis (``ops/attention.joint_ring_attention``)
+— the capability the reference entirely lacks (SURVEY §2.10: SP/CP
+absent).
 
 Positional encoding: selectable per config —
 
@@ -17,7 +21,12 @@ Positional encoding: selectable per config —
   embeddings applied to q/k per head exactly in FLUX's layout (axis 0 =
   text/time slot, axes 1-2 = patch row/col; ``rope_axes_dim`` must sum
   to ``head_dim``) — the form real FLUX checkpoints require, so weight
-  porting needs no architectural surgery.
+  porting needs no architectural surgery;
+- ``pos_embed="learned"`` (the SD3 presets' default): a trained
+  ``pos_embed_max_size²``-entry table added to patch embeddings after a
+  CENTER crop to the sample's patch grid — SD3's exact scheme, so its
+  checkpoints port table-intact and any resolution ≤ the table's square
+  samples without interpolation.
 """
 
 from __future__ import annotations
@@ -53,7 +62,10 @@ class DiTConfig:
                                      # of the seq-length gate — required
                                      # by the memory-starved offload
                                      # executor, ops/attention.py)
-    pos_embed: str = "sincos"        # "sincos" | "rope"
+    pos_embed: str = "sincos"        # "sincos" | "rope" | "learned"
+    pos_embed_max_size: int = 0      # "learned": side of the square table
+    qk_norm: bool = True             # RMS qk-norm (FLUX, SD3.5; SD3-medium
+                                     # checkpoints have no norm scales)
     remat: bool = False              # recompute block activations (HBM relief)
     rope_theta: float = 10000.0
     rope_axes_dim: Optional[tuple[int, int, int]] = None   # None → derived
@@ -67,11 +79,40 @@ class DiTConfig:
                    remat=constants.REMAT)
 
     @classmethod
+    def sd3_medium(cls) -> "DiTConfig":
+        """SD3-medium (2B): 24 joint blocks, width 1536, no qk-norm."""
+        from ..utils import constants
+
+        return cls(hidden=1536, depth_double=24, depth_single=0, heads=24,
+                   context_dim=4096, pooled_dim=2048, guidance_embed=False,
+                   pos_embed="learned", pos_embed_max_size=192,
+                   qk_norm=False, remat=constants.REMAT)
+
+    @classmethod
+    def sd35_large(cls) -> "DiTConfig":
+        """SD3.5-large (8B): 38 joint blocks, width 2432, RMS qk-norm."""
+        from ..utils import constants
+
+        return cls(hidden=2432, depth_double=38, depth_single=0, heads=38,
+                   context_dim=4096, pooled_dim=2048, guidance_embed=False,
+                   pos_embed="learned", pos_embed_max_size=192,
+                   qk_norm=True, remat=constants.REMAT)
+
+    @classmethod
     def tiny(cls, attn_backend: str = "dense",
-             pos_embed: str = "sincos") -> "DiTConfig":
-        return cls(patch_size=2, in_channels=4, hidden=64, depth_double=2,
-                   depth_single=2, heads=4, context_dim=32, pooled_dim=16,
-                   attn_backend=attn_backend, pos_embed=pos_embed)
+             pos_embed: str = "sincos", **kw) -> "DiTConfig":
+        base = dict(patch_size=2, in_channels=4, hidden=64, depth_double=2,
+                    depth_single=2, heads=4, context_dim=32, pooled_dim=16,
+                    attn_backend=attn_backend, pos_embed=pos_embed)
+        base.update(kw)
+        return cls(**base)
+
+    @classmethod
+    def sd3_tiny(cls, attn_backend: str = "dense") -> "DiTConfig":
+        """SD3-shaped tiny: joint-only depth, learned cropped pos table."""
+        return cls.tiny(attn_backend, pos_embed="learned",
+                        pos_embed_max_size=12, depth_double=2,
+                        depth_single=0, qk_norm=False)
 
     @property
     def head_dim(self) -> int:
@@ -201,6 +242,7 @@ class _QKV(nn.Module):
     hidden: int
     heads: int
     dtype: jnp.dtype
+    qk_norm: bool = True
 
     @nn.compact
     def __call__(self, x):
@@ -209,8 +251,12 @@ class _QKV(nn.Module):
         q, k, v = jnp.split(qkv, 3, axis=-1)
         hd = self.hidden // self.heads
         shape = (B, N, self.heads, hd)
-        # qk-norm (learned-scale RMS over head_dim) as in FLUX's QKNorm —
-        # the scales land from checkpoints' {query,key}_norm.scale entries
+        if not self.qk_norm:
+            # SD3-medium: raw q/k (its checkpoints carry no norm scales)
+            return q.reshape(shape), k.reshape(shape), v.reshape(shape)
+        # qk-norm (learned-scale RMS over head_dim) as in FLUX's QKNorm /
+        # SD3.5's ln_q/ln_k — the scales land from checkpoints'
+        # {query,key}_norm.scale / ln_{q,k}.weight entries
         qs = self.param("q_scale", nn.initializers.ones, (hd,), jnp.float32)
         ks = self.param("k_scale", nn.initializers.ones, (hd,), jnp.float32)
         q = _rms(q.reshape(shape)) * qs.astype(self.dtype)
@@ -242,8 +288,8 @@ class DoubleBlock(nn.Module):
                                        dtype=dt)(img), i_sh1, i_sc1)
         txt_n = _modulate(nn.LayerNorm(use_scale=False, use_bias=False,
                                        dtype=dt)(txt), t_sh1, t_sc1)
-        iq, ik, iv = _QKV(cfg.hidden, cfg.heads, dt, name="img_qkv")(img_n)
-        tq, tk, tv = _QKV(cfg.hidden, cfg.heads, dt, name="txt_qkv")(txt_n)
+        iq, ik, iv = _QKV(cfg.hidden, cfg.heads, dt, cfg.qk_norm, name="img_qkv")(img_n)
+        tq, tk, tv = _QKV(cfg.hidden, cfg.heads, dt, cfg.qk_norm, name="txt_qkv")(txt_n)
         if pe_img is not None:
             iq, ik = apply_rope(iq, pe_img), apply_rope(ik, pe_img)
             tq, tk = apply_rope(tq, pe_txt), apply_rope(tk, pe_txt)
@@ -291,7 +337,7 @@ class SingleBlock(nn.Module):
         sh, sc, g = Modulation(1, cfg.hidden, dt, name="mod")(vec)
         xn = _modulate(nn.LayerNorm(use_scale=False, use_bias=False, dtype=dt)(x),
                        sh, sc)
-        q, k, v = _QKV(cfg.hidden, cfg.heads, dt, name="qkv")(xn)
+        q, k, v = _QKV(cfg.hidden, cfg.heads, dt, cfg.qk_norm, name="qkv")(xn)
         if pe_full is not None:
             q, k = apply_rope(q, pe_full), apply_rope(k, pe_full)
         if sp_axis is None:
@@ -341,6 +387,30 @@ class DiT(nn.Module):
             pe_txt = rope_freqs(ids_txt, cfg.axes_dim, cfg.rope_theta)
             pe_full = (jnp.concatenate([pe_txt[0], pe_img[0]], axis=0),
                        jnp.concatenate([pe_txt[1], pe_img[1]], axis=0))
+        elif cfg.pos_embed == "learned":
+            # SD3: trained (max × max) table, CENTER-cropped to the patch
+            # grid; in sp mode each shard crops its own row block of the
+            # global grid so the sharded run adds identical positions
+            m = cfg.pos_embed_max_size
+            table = self.param("pos_emb", nn.initializers.normal(0.01),
+                               (m * m, cfg.hidden)).reshape(m, m, cfg.hidden)
+            hp, wp = H // p, W // p
+            n_sh = 1 if sp_axis is None else jax.lax.axis_size(sp_axis)
+            gh = hp * n_sh                       # global patch rows
+            if gh > m or wp > m:
+                raise ValueError(
+                    f"sample grid {gh}×{wp} exceeds the learned position "
+                    f"table ({m}×{m}) — SD3-family models cannot sample "
+                    "beyond pos_embed_max_size patches per side")
+            top, left = (m - gh) // 2, (m - wp) // 2
+            rows = table[:, left:left + wp]
+            if sp_axis is None:
+                pos = rows[top:top + hp]
+            else:
+                idx = jax.lax.axis_index(sp_axis)
+                pos = jax.lax.dynamic_slice_in_dim(
+                    rows, top + idx * hp, hp, axis=0)
+            img = img + pos.reshape(hp * wp, cfg.hidden)[None].astype(dt)
         elif sp_axis is None:
             pos = sincos_2d(H // p, W // p, cfg.hidden)
             img = img + pos[None].astype(dt)

@@ -65,6 +65,37 @@ def _flux_tiny_preset():
         sample_hw=(8, 8), dit=DiTConfig.tiny())
 
 
+def _sd3_medium_preset():
+    from .dit import DiTConfig
+
+    # SD3's 16-ch KL-VAE (downscale 8); conditioning = CLIP-L/G + T5-XXL
+    # via the sd3 tri-encoder stack (build_clip_stack kind="sd3")
+    return ModelPreset(
+        "sd3-medium", unet=None,
+        vae=VAEConfig(latent_channels=16, scaling_factor=1.5305,
+                      shift_factor=0.0609),
+        text=TextEncoderConfig(output_dim=4096, pooled_dim=2048),
+        sample_hw=(128, 128), dit=DiTConfig.sd3_medium(), clip="sd3")
+
+
+def _sd35_large_preset():
+    import dataclasses as _dc
+
+    from .dit import DiTConfig
+
+    base = _sd3_medium_preset()
+    return _dc.replace(base, name="sd35-large", dit=DiTConfig.sd35_large())
+
+
+def _sd3_tiny_preset():
+    from .dit import DiTConfig
+
+    return ModelPreset(
+        "sd3-tiny", unet=None, vae=VAEConfig.tiny(),
+        text=TextEncoderConfig.tiny(), sample_hw=(8, 8),
+        dit=DiTConfig.sd3_tiny(), clip="sd3")
+
+
 def _wan_preset():
     from .wan import WanConfig
     from .wan_vae import WanVAEConfig
@@ -172,6 +203,9 @@ PRESETS: dict[str, ModelPreset] = {
                         TextEncoderConfig.tiny(), sample_hw=(8, 8)),
     "flux": _flux_preset(),
     "flux-tiny": _flux_tiny_preset(),
+    "sd3-medium": _sd3_medium_preset(),
+    "sd35-large": _sd35_large_preset(),
+    "sd3-tiny": _sd3_tiny_preset(),
     "wan": _wan_preset(),
     "wan-tiny": _wan_tiny_preset(),
     "wan-tiny-3d": _wan_tiny_3d_preset(),
@@ -331,6 +365,13 @@ class ModelBundle:
                 key, tiny=tiny, abstract_t5=abstract_t5)
             self.text_encoder = self.clip_stack
             return self.clip_stack
+        elif kind == "sd3":
+            from .t5 import SD3TextStack
+
+            self.clip_stack = SD3TextStack.init_random(
+                key, tiny=tiny, abstract_t5=abstract_t5)
+            self.text_encoder = self.clip_stack
+            return self.clip_stack
         else:
             cfg = CLIPTextConfig.tiny() if tiny else CLIPTextConfig.clip_l()
             self.clip_stack = CLIPTextModel(cfg).init(key)
@@ -352,6 +393,10 @@ class ModelBundle:
             elif self.preset.clip == "flux":
                 state["clip_l"] = self.clip_stack.clip_l.params
                 state["t5"] = self.clip_stack.t5.params
+            elif self.preset.clip == "sd3":
+                state["clip_l"] = self.clip_stack.clip_l.params
+                state["clip_g"] = self.clip_stack.clip_g.params
+                state["t5"] = self.clip_stack.t5.params
             elif self.preset.clip == "umt5":
                 state["t5"] = self.clip_stack.t5.params
             else:
@@ -372,6 +417,10 @@ class ModelBundle:
                 self.clip_stack.clip_g.params = restored["clip_g"]
             elif self.preset.clip == "flux":
                 self.clip_stack.clip_l.params = restored["clip_l"]
+                self.clip_stack.t5.params = restored["t5"]
+            elif self.preset.clip == "sd3":
+                self.clip_stack.clip_l.params = restored["clip_l"]
+                self.clip_stack.clip_g.params = restored["clip_g"]
                 self.clip_stack.t5.params = restored["t5"]
             else:
                 self.clip_stack.params = restored["clip_l"]
@@ -436,7 +485,7 @@ class ModelBundle:
                 tiny_clip = self.clip_stack.t5.config.d_model < 256
             else:
                 cl = (self.clip_stack.clip_l
-                      if self.preset.clip in ("sdxl", "flux")
+                      if self.preset.clip in ("sdxl", "flux", "sd3")
                       else self.clip_stack)
                 tiny_clip = cl.config.width < 256
         ckpt.mkdir(parents=True, exist_ok=True)
@@ -463,12 +512,12 @@ class ModelBundle:
         (SDXL/SD1.5/FLUX layout) into this bundle in place."""
         from .convert import convert_checkpoint
 
-        if self.preset.clip not in (None, "flux", "umt5"):
-            # FLUX/WAN single files carry only the transformer; the (large)
-            # T5 stacks are built on demand by load_text_encoder_files —
-            # pre-building here would materialize ~19-23 GB of random fp32
-            # T5 weights and, worse, let save_checkpoint persist them as
-            # if they were real
+        if self.preset.clip not in (None, "flux", "umt5", "sd3"):
+            # FLUX/WAN/SD3 single files carry only the transformer; the
+            # (large) T5 stacks are built on demand by
+            # load_text_encoder_files — pre-building here would
+            # materialize ~19-23 GB of random fp32 T5 weights and, worse,
+            # let save_checkpoint persist them as if they were real
             self.build_clip_stack()
         convert_checkpoint(path, self)
 
@@ -496,26 +545,30 @@ class ModelBundle:
             self.pipeline.dit_params = hi_params
 
     def load_text_encoder_files(self, t5: Optional[Path] = None,
-                                clip_l: Optional[Path] = None) -> None:
-        """Convert the standalone text-encoder ``.safetensors`` files FLUX
-        distributions ship (``t5xxl_*.safetensors`` in HF T5 layout,
-        ``clip_l.safetensors`` in HF ``text_model.*`` layout) into this
-        bundle's conditioning stack."""
+                                clip_l: Optional[Path] = None,
+                                clip_g: Optional[Path] = None) -> None:
+        """Convert the standalone text-encoder ``.safetensors`` files
+        FLUX/SD3 distributions ship (``t5xxl_*.safetensors`` in HF T5
+        layout, ``clip_l.safetensors``/``clip_g.safetensors`` in HF
+        ``text_model.*`` layout) into this bundle's conditioning stack."""
         from .convert import convert_clip_hf, load_safetensors
         from .t5 import convert_t5
 
-        if self.preset.clip not in ("flux", "umt5"):
+        if self.preset.clip not in ("flux", "umt5", "sd3"):
             raise ValidationError(
-                "separate text-encoder files are a flux/wan-stack feature; "
-                f"preset {self.preset.name!r} bundles its encoders in the "
-                "single-file checkpoint")
+                "separate text-encoder files are a flux/wan/sd3-stack "
+                f"feature; preset {self.preset.name!r} bundles its "
+                "encoders in the single-file checkpoint")
         if self.clip_stack is None:
-            from .t5 import FluxTextStack, UMT5Conditioner
+            from .t5 import FluxTextStack, SD3TextStack, UMT5Conditioner
 
             # T5-XXL random init is ~19 GB; skip it when the converter is
             # about to overwrite every leaf
             if self.preset.clip == "flux":
                 self.clip_stack = FluxTextStack.init_random(
+                    jax.random.key(0), abstract_t5=t5 is not None)
+            elif self.preset.clip == "sd3":
+                self.clip_stack = SD3TextStack.init_random(
                     jax.random.key(0), abstract_t5=t5 is not None)
             else:
                 self.clip_stack = UMT5Conditioner.init_random(
@@ -526,12 +579,18 @@ class ModelBundle:
                 load_safetensors(Path(t5)), self.clip_stack.t5.params,
                 self.clip_stack.t5.config)
         if clip_l is not None:
-            if self.preset.clip != "flux":
+            if self.preset.clip not in ("flux", "sd3"):
                 raise ValidationError(
-                    "clip_l is part of the flux stack only")
+                    "clip_l is part of the flux/sd3 stacks only")
             self.clip_stack.clip_l.params = convert_clip_hf(
                 load_safetensors(Path(clip_l)),
                 self.clip_stack.clip_l.params, self.clip_stack.clip_l.config)
+        if clip_g is not None:
+            if self.preset.clip != "sd3":
+                raise ValidationError("clip_g is part of the sd3 stack only")
+            self.clip_stack.clip_g.params = convert_clip_hf(
+                load_safetensors(Path(clip_g)),
+                self.clip_stack.clip_g.params, self.clip_stack.clip_g.config)
 
     def load_vae_file(self, path: Path) -> None:
         """Convert a standalone VAE ``.safetensors`` into this bundle.

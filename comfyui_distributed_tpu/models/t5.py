@@ -322,9 +322,14 @@ class FluxTextStack:
         self.clip_l = clip_l
         self.t5_tok = t5_tok if t5_tok is not None else load_t5_tokenizer()
         if clip_tok is None:
+            from .clip import validate_tokenizer_vocab
             from .tokenizer import load_sd_tokenizers
 
-            clip_tok, _ = load_sd_tokenizers()
+            # tokenize to the TOWER's context length (its position table
+            # only covers config.max_len), and refuse a mismatched vocab
+            clip_tok, _ = load_sd_tokenizers(max_len=clip_l.config.max_len)
+            if clip_tok is not None:
+                validate_tokenizer_vocab(clip_tok, clip_l.config, "clip_l")
         self.clip_tok = clip_tok
         from ..utils.logging import log
 
@@ -355,4 +360,96 @@ class FluxTextStack:
         cfg = self.clip_l.config
         toks = tokenize_ids(texts, self.clip_tok, cfg, cfg.eot_token_id)
         pooled = self.clip_l(toks)["pooled"]
+        return context, pooled
+
+
+class SD3TextStack:
+    """SD3-family tri-encoder conditioning (CLIP-L + CLIP-G + T5-XXL).
+
+    SD3's contract (matching sd3's own inference wiring the reference
+    inherits via ComfyUI's sd3_clip):
+
+    - ``context`` = sequence concat of the zero-padded CLIP block and the
+      T5 block: ``pad(concat_feat(L.penultimate, G.penultimate), d_t5)``
+      followed by T5 last-hidden — ``[B, 77 + T5_len, 4096]`` at full
+      size;
+    - ``pooled`` = ``concat(L.projected, G.projected)`` — ``[B, 2048]``.
+
+    ``encode(texts)`` is drop-in for ``TextEncoder.encode`` so pipelines
+    and graph nodes work unchanged.
+    """
+
+    def __init__(self, clip_l, clip_g, t5: T5Model, t5_tok=None,
+                 tok_l=None, tok_g=None):
+        from ..utils.logging import log
+        from .clip import validate_tokenizer_vocab
+        from .tokenizer import CLIPBPETokenizer, load_sd_tokenizers
+
+        self.clip_l = clip_l
+        self.clip_g = clip_g
+        self.t5 = t5
+        self.t5_tok = t5_tok if t5_tok is not None else load_t5_tokenizer()
+        if tok_l is None and tok_g is None:
+            tok_l, _ = load_sd_tokenizers(max_len=clip_l.config.max_len)
+            if tok_l is not None:
+                tok_g = CLIPBPETokenizer.from_env(
+                    max_len=clip_g.config.max_len, pad_token_id=0)
+        self.tok_l, self.tok_g = tok_l, tok_g
+        if self.tok_l is not None:
+            validate_tokenizer_vocab(self.tok_l, clip_l.config, "clip_l")
+            validate_tokenizer_vocab(self.tok_g, clip_g.config, "clip_g")
+        else:
+            log("WARNING: no CLIP vocab at CDT_TOKENIZER_DIR — text is "
+                "hash-tokenized; conditioning will not reflect the prompt")
+        if self.t5_tok is None:
+            log("WARNING: no T5 tokenizer (CDT_T5_TOKENIZER_DIR) — the T5 "
+                "context block is hash-tokenized")
+
+    @classmethod
+    def init_random(cls, rng: jax.Array, tiny: bool = False,
+                    abstract_t5: bool = False) -> "SD3TextStack":
+        import dataclasses
+
+        from .clip import CLIPTextConfig, CLIPTextModel
+
+        k1, k2, k3 = jax.random.split(rng, 3)
+        if tiny:
+            # concat widths (16+16) == T5-tiny d_model, projections 8+8
+            # == the sd3-tiny preset's pooled_dim
+            cfg_l = CLIPTextConfig.tiny(width=16, heads=2, projection_dim=8)
+            cfg_g = CLIPTextConfig.tiny(width=16, heads=2, act="gelu",
+                                        projection_dim=8)
+            t5_cfg = T5Config.tiny()
+        else:
+            cfg_l = dataclasses.replace(CLIPTextConfig.clip_l(),
+                                        projection_dim=768)
+            cfg_g = CLIPTextConfig.clip_g()
+            t5_cfg = T5Config.xxl()
+        return cls(CLIPTextModel(cfg_l).init(k1),
+                   CLIPTextModel(cfg_g).init(k2),
+                   T5Model(t5_cfg).init(k3, abstract=abstract_t5))
+
+    def encode(self, texts) -> tuple[jax.Array, jax.Array]:
+        from .clip import tokenize_ids
+
+        texts = [str(t) for t in texts]
+        l_cfg, g_cfg = self.clip_l.config, self.clip_g.config
+        out_l = self.clip_l(tokenize_ids(texts, self.tok_l, l_cfg,
+                                         l_cfg.eot_token_id))
+        out_g = self.clip_g(tokenize_ids(texts, self.tok_g, g_cfg, 0))
+        clip_ctx = jnp.concatenate(
+            [out_l["penultimate"], out_g["penultimate"]], axis=-1)
+        d = self.t5.config.d_model
+        if clip_ctx.shape[-1] > d:
+            raise ValueError(
+                f"CLIP concat width {clip_ctx.shape[-1]} exceeds the T5 "
+                f"d_model {d} — the stack's towers are mismatched")
+        clip_ctx = jnp.pad(
+            clip_ctx, ((0, 0), (0, 0), (0, d - clip_ctx.shape[-1])))
+        ids, mask = t5_token_ids(self.t5.config, self.t5_tok, texts)
+        t5_ctx = self.t5(ids, mask)
+        context = jnp.concatenate(
+            [clip_ctx, t5_ctx.astype(clip_ctx.dtype)], axis=1)
+        pooled = jnp.concatenate(
+            [out_l["projected"], out_g["projected"]], axis=-1)
         return context, pooled

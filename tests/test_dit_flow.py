@@ -47,6 +47,67 @@ def test_flux_config_shape():
     assert cfg.head_dim == 128
 
 
+def test_sd3_config_shapes():
+    m, l = DiTConfig.sd3_medium(), DiTConfig.sd35_large()
+    assert m.hidden == 1536 and m.depth_double == 24 and m.depth_single == 0
+    assert l.hidden == 2432 and l.depth_double == 38 and l.depth_single == 0
+    assert not m.qk_norm and l.qk_norm
+    for cfg in (m, l):
+        assert cfg.pos_embed == "learned" and cfg.pos_embed_max_size == 192
+        assert not cfg.guidance_embed
+        assert cfg.context_dim == 4096 and cfg.pooled_dim == 2048
+        assert cfg.head_dim == 64
+
+
+def test_sd3_tiny_forward_and_param_shape():
+    cfg = DiTConfig.sd3_tiny()
+    model, params = init_dit(cfg, jax.random.key(0), sample_hw=(8, 8),
+                             context_len=6)
+    # no qk-norm scales, a learned table, no single blocks
+    flat = jax.tree_util.tree_leaves_with_path(params)
+    paths = {"/".join(str(k.key) for k in p if hasattr(k, "key"))
+             for p, _ in flat}
+    assert not any("q_scale" in p for p in paths)
+    assert not any("single_" in p for p in paths)
+    assert any(p.endswith("pos_emb") for p in paths)
+    out = model.apply(params, jnp.ones((2, 8, 8, cfg.in_channels)),
+                      jnp.array([0.5, 0.9]),
+                      jnp.ones((2, 6, cfg.context_dim)),
+                      jnp.ones((2, cfg.pooled_dim)))
+    assert out.shape == (2, 8, 8, cfg.in_channels)
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_sd3_rejects_oversized_grid():
+    cfg = DiTConfig.sd3_tiny()          # 12×12 learned table
+    model, params = init_dit(cfg, jax.random.key(0), sample_hw=(8, 8),
+                             context_len=6)
+    with pytest.raises(ValueError, match="learned position"):
+        model.apply(params, jnp.ones((1, 32, 32, cfg.in_channels)),
+                    jnp.array([0.5]), jnp.ones((1, 6, cfg.context_dim)),
+                    jnp.ones((1, cfg.pooled_dim)))
+
+
+def test_sd3_sp_matches_single_chip():
+    """The learned-table row slicing under sp must reproduce the
+    single-chip crop exactly (same discipline as the sincos/rope tests)."""
+    cfg = DiTConfig.tiny(pos_embed="learned", pos_embed_max_size=12,
+                         depth_single=0, qk_norm=False, dtype="float32")
+    model, params = init_dit(cfg, jax.random.key(0), sample_hw=(16, 16),
+                             context_len=6)
+    vae = AutoencoderKL(VAEConfig.tiny(dtype="float32")).init(
+        jax.random.key(1), image_hw=(32, 32))
+    pipe = FlowPipeline(model, params, vae)
+    ctx, pooled = _cond(cfg)
+    spec = FlowSpec(height=32, width=32, steps=2, shift=1.0)
+    sp_out = np.asarray(pipe.generate_sp(build_mesh({"sp": 4}), spec, seed=7,
+                                         context=ctx, pooled=pooled))
+    single = np.asarray(pipe.generate_sp(build_mesh({"sp": 1}), spec, seed=7,
+                                         context=ctx, pooled=pooled))
+    assert sp_out.shape == (1, 32, 32, 3)
+    np.testing.assert_allclose(sp_out, single, rtol=2e-4, atol=2e-4)
+
+
 @pytest.fixture(scope="module")
 def flow_stack():
     cfg = DiTConfig.tiny(attn_backend="dense")
