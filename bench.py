@@ -1082,25 +1082,51 @@ def _inner_main(cli) -> None:
     _emit(result, cli.out)
 
 
+def _is_terminal_failure(errors: list[str]) -> bool:
+    """True when the last two attempts died with the IDENTICAL error tail:
+    a deterministic backend-init failure, not tunnel flake. Retrying it
+    burns the whole budget re-running the same crash (BENCH_r05 rc=124
+    root cause) — two matching attempts are terminal. Timeout kills are
+    exempt: their message is constant by construction (derived from the
+    timeout value, not the failure), and a hung tunnel is exactly the
+    transient class the retry loop exists to survive."""
+    if len(errors) < 2 or not errors[-1] or errors[-1] != errors[-2]:
+        return False
+    return not errors[-1].startswith("attempt timed out")
+
+
+def _cap_cpu_fallback(steps: int, runs: "int | None") -> tuple[int, int]:
+    """The CPU fallback exists to prove the harness end-to-end, not to
+    benchmark a laptop: cap it at tiny-preset scale (≤4 steps, ≤2 runs)
+    so it can never eat the remaining wall-clock."""
+    return min(int(steps), 4), min(int(runs) if runs else 2, 2)
+
+
 def _watchdog_main(cli) -> None:
     """Run the accelerator attempt in a subprocess so a hung tunnel (even
     inside ``jax.devices()``) can never prevent a result line; retry
-    within the budget, then fall back to CPU — loudly and explicitly."""
+    within the budget — but a repeated IDENTICAL failure is terminal
+    after 2 attempts (fail fast with evidence instead of a silent rc=124)
+    — then fall back to a tiny-capped CPU run, loudly and explicitly."""
     budget = float(os.environ.get("CDT_BENCH_BUDGET_S", "2400"))
     attempt_timeout = float(os.environ.get("CDT_BENCH_ATTEMPT_TIMEOUT_S", "1800"))
     start = time.monotonic()
     attempt = 0
     last_err = None
+    errors: list[str] = []
 
-    def launch(extra_env: dict, timeout: float) -> tuple[int, str]:
+    def launch(extra_env: dict, timeout: float, steps: "int | None" = None,
+               runs: "int | None" = None) -> tuple[int, str]:
         tmp = tempfile.NamedTemporaryFile(
             mode="r", suffix=".json", delete=False)
         env = dict(os.environ, **extra_env)
         cmd = [sys.executable, os.path.abspath(__file__), "--inner",
-               "--out", tmp.name, "--steps", str(cli.steps),
+               "--out", tmp.name,
+               "--steps", str(cli.steps if steps is None else steps),
                "--workload", cli.workload]
-        if cli.runs:
-            cmd += ["--runs", str(cli.runs)]
+        runs = cli.runs if runs is None else runs
+        if runs:
+            cmd += ["--runs", str(runs)]
         try:
             proc = subprocess.run(cmd, timeout=timeout,
                                   capture_output=True, text=True)
@@ -1152,24 +1178,37 @@ def _watchdog_main(cli) -> None:
             _emit(result, cli.out)
             return
         last_err = err_tail or f"exit code {rc}"
+        errors.append(last_err)
         print(f"[bench] accelerator attempt {attempt} failed: {last_err}",
               file=sys.stderr)
+        if _is_terminal_failure(errors):
+            # same crash twice = deterministic backend-init failure;
+            # emit evidence NOW instead of re-running it for 40 minutes
+            print(f"[bench] identical failure on {len(errors)} consecutive "
+                  "attempts — terminal; skipping further accelerator "
+                  "retries", file=sys.stderr)
+            break
         time.sleep(15)
 
     print(f"[bench] WARNING: no accelerator result after {attempt} attempts "
-          f"over {budget:.0f}s — CPU toy fallback. Last error: {last_err}",
+          f"— tiny CPU fallback. Last error: {last_err}",
           file=sys.stderr)
-    rc, err_tail = launch({"JAX_PLATFORMS": "cpu"}, attempt_timeout)
+    cpu_steps, cpu_runs = _cap_cpu_fallback(cli.steps, cli.runs)
+    rc, err_tail = launch({"JAX_PLATFORMS": "cpu"},
+                          min(attempt_timeout, 300.0),
+                          steps=cpu_steps, runs=cpu_runs)
     result = read_result()
     if rc != 0:
         result = None
     if result is None:
         _emit({"metric": "benchmark_failed", "value": 0.0, "unit": "n/a",
                "vs_baseline": 0.0, "tpu_attempted": True,
-               "tpu_error": last_err, "cpu_error": err_tail}, cli.out)
+               "tpu_error": last_err, "tpu_attempts": attempt,
+               "cpu_error": err_tail}, cli.out)
         return
     result["tpu_attempted"] = True
     result["tpu_error"] = last_err
+    result["tpu_attempts"] = attempt
     _emit(result, cli.out)
 
 

@@ -13,6 +13,7 @@ from pathlib import Path
 
 from aiohttp import web
 
+from .. import telemetry
 from ..cluster.controller import Controller
 from ..utils import auth, constants
 from ..utils.exceptions import DistributedError, ValidationError
@@ -42,6 +43,8 @@ _CORS_SAFE_PATHS = frozenset({
     "/distributed/health",
     "/distributed/system_info",
     "/distributed/network_info",
+    "/distributed/metrics",
+    "/distributed/metrics.json",
     "/prompt",
 })
 
@@ -124,9 +127,49 @@ def create_app(controller: Controller) -> web.Application:
                 return json_error("missing or invalid auth token", 401)
         return await handler(request)
 
+    @web.middleware
+    async def telemetry_middleware(request, handler):
+        # Innermost middleware: adopt an incoming X-CDT-Trace context so
+        # handler-side spans join the sender's trace (master→worker
+        # stitch), and count requests per route template. One boolean
+        # read when telemetry is off.
+        if not telemetry.enabled():
+            return await handler(request)
+        parsed = telemetry.parse_trace_header(
+            request.headers.get(telemetry.TRACE_HEADER, ""))
+        status = 500
+        try:
+            if parsed is not None:
+                request["cdt_trace"] = parsed
+                with telemetry.use_trace(parsed[0], parsed[1]):
+                    resp = await handler(request)
+            else:
+                resp = await handler(request)
+            status = resp.status
+            return resp
+        except ValidationError:
+            # exception-converted responses (error_middleware sits
+            # OUTSIDE this one) must still count, or the error rate
+            # reads 0% while every request is being rejected
+            status = 400
+            raise
+        except web.HTTPException as e:
+            status = e.status
+            raise
+        finally:
+            # label by route TEMPLATE (bounded by the route table) — raw
+            # 404 paths are peer-controlled and would blow cardinality
+            resource = request.match_info.route.resource
+            path = (resource.canonical if resource is not None
+                    else "<unmatched>")
+            telemetry.metrics.HTTP_REQUESTS.labels(
+                method=request.method, path=path,
+                status=str(status)).inc()
+
     app.middlewares.append(error_middleware)
     app.middlewares.append(cors_middleware)
     app.middlewares.append(auth_middleware)
+    app.middlewares.append(telemetry_middleware)
 
     r = app.router
 
@@ -155,8 +198,14 @@ def create_app(controller: Controller) -> web.Application:
         prompt = body.get("prompt")
         if not isinstance(prompt, dict) or not prompt:
             raise ValidationError("'prompt' must be a non-empty object")
+        # the X-CDT-Trace header (parsed by telemetry_middleware) wins
+        # over the body's trace_id: the execution span then shares the
+        # dispatching master's trace AND parents onto its dispatch span
+        hdr_trace = request.get("cdt_trace")
         prompt_id, errors = controller.queue.enqueue(
-            prompt, body.get("client_id", ""), body.get("trace_id"))
+            prompt, body.get("client_id", ""),
+            hdr_trace[0] if hdr_trace else body.get("trace_id"),
+            parent_span_id=hdr_trace[1] if hdr_trace else None)
         if errors:
             return web.json_response({"error": "validation failed",
                                       "node_errors": errors}, status=400)

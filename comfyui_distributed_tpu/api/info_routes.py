@@ -175,6 +175,46 @@ def register(router, controller) -> None:
         devices = await deadline_call(census, fallback=_DEGRADED)
         return web.json_response({"devices": devices})
 
+    # --- telemetry (docs/telemetry.md) -------------------------------------
+
+    async def metrics_prometheus(request):
+        """Prometheus text exposition of the process-global registry
+        (``telemetry/export.py``) — scrape target for a Prometheus/
+        VictoriaMetrics agent; one registry per host controller."""
+        from ..telemetry import REGISTRY
+        from ..telemetry.export import render_prometheus
+
+        return web.Response(text=render_prometheus(REGISTRY.snapshot()),
+                            content_type="text/plain", charset="utf-8")
+
+    async def metrics_json(request):
+        """Structured JSON form of the same snapshot (the dashboard's
+        telemetry panel feed)."""
+        from ..telemetry import REGISTRY
+        from ..telemetry.export import render_json
+
+        return web.json_response(render_json(REGISTRY.snapshot()))
+
+    async def trace_tree(request):
+        """Assembled span tree for a job: accepts a trace id (the
+        orchestrator's exec_… id), a prompt id, or a tile job id. Spans
+        from dispatched hosts join via the X-CDT-Trace header, so the
+        master-side dispatch span and worker-side execution span share
+        one trace."""
+        from ..telemetry import SPAN_STORE
+
+        job_id = request.match_info["job_id"]
+        trace_id = SPAN_STORE.resolve(job_id)
+        if trace_id is None:
+            return web.json_response(
+                {"error": f"no trace recorded for {job_id!r}"}, status=404)
+        return web.json_response({
+            "job_id": job_id,
+            "trace_id": trace_id,
+            "spans": SPAN_STORE.spans(trace_id),
+            "tree": SPAN_STORE.tree(trace_id),
+        })
+
     async def step_times(request):
         """Recent prompt durations — the step-time observability the
         reference's progress logs approximate."""
@@ -268,6 +308,9 @@ def register(router, controller) -> None:
     router.add_post("/distributed/profile/start", profile_start)
     router.add_post("/distributed/profile/stop", profile_stop)
     router.add_get("/distributed/memory_stats", memory_stats)
+    router.add_get("/distributed/metrics", metrics_prometheus)
+    router.add_get("/distributed/metrics.json", metrics_json)
+    router.add_get("/distributed/trace/{job_id}", trace_tree)
     router.add_get("/distributed/step_times", step_times)
     router.add_get("/distributed/progress/{prompt_id}", sampling_progress)
     router.add_get("/distributed/preview/{prompt_id}", sampling_preview)

@@ -152,8 +152,13 @@ def register(router, controller) -> None:
                                     "error": f"unknown type {data.get('type')!r}"})
                 continue
             prompt = data.get("prompt") or {}
+            # the ws connect carried X-CDT-Trace (telemetry_middleware
+            # parsed it): execution spans stitch exactly like HTTP
+            hdr_trace = request.get("cdt_trace")
             prompt_id, node_errors = controller.queue.enqueue(
-                prompt, data.get("client_id", ""), data.get("trace_id"))
+                prompt, data.get("client_id", ""),
+                hdr_trace[0] if hdr_trace else data.get("trace_id"),
+                parent_span_id=hdr_trace[1] if hdr_trace else None)
             await ws.send_json({
                 "type": "dispatch_ack",
                 "request_id": data.get("request_id"),

@@ -12,10 +12,13 @@ from __future__ import annotations
 import asyncio
 import itertools
 import json
+import time
 from typing import Any, Optional, Sequence
 
 import aiohttp
 
+from .. import telemetry
+from ..telemetry import metrics as _tm
 from ..utils import constants
 from ..utils.logging import debug_log, log, trace_info
 from ..utils.network import build_host_url, get_client_session, probe_host
@@ -47,6 +50,9 @@ async def select_active_hosts(
             offline.append(host)
         else:
             online.append({**host, "_probe": health})
+    if telemetry.enabled() and results:
+        _tm.WORKER_PROBES.labels(outcome="online").inc(len(online))
+        _tm.WORKER_PROBES.labels(outcome="offline").inc(len(offline))
     trace_info(trace_id, f"probe: {len(online)} online, {len(offline)} offline")
     return online, offline
 
@@ -82,46 +88,65 @@ async def dispatch_prompt_ws(
 
     url = build_host_url(host, "/distributed/worker_ws")
     session = get_client_session()
-    try:
-        ws_ctx = session.ws_connect(url)
-        ws = await ws_ctx.__aenter__()
-    except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
-        # connection never opened — the prompt cannot have been delivered,
-        # so the caller may safely retry over HTTP
-        err = WorkerError(
-            f"ws dispatch to {host.get('id')} unreachable: {e}",
-            worker_id=host.get("id"))
-        err.ws_undelivered = True
-        raise err from e
-    try:
-        await ws.send_json({
-            "type": "dispatch_prompt",
-            "prompt": prompt,
-            "client_id": client_id,
-            **(extra or {}),
-        })
-        msg = await ws.receive(timeout=constants.DISPATCH_TIMEOUT)
-        if msg.type != aiohttp.WSMsgType.TEXT:
-            # the send may have been delivered even though the ack never
-            # arrived — retrying over HTTP could double-enqueue; fail hard
-            raise WorkerError(
-                f"ws dispatch to {host.get('id')}: connection closed "
-                f"before ack ({msg.type})", worker_id=host.get("id"))
-        ack = json.loads(msg.data)
-        if ack.get("type") != "dispatch_ack" or not ack.get("ok", False):
-            raise WorkerError(
-                f"ws dispatch to {host.get('id')} rejected: "
-                f"{ack.get('node_errors') or ack.get('error')}",
-                worker_id=host.get("id"))
-        trace_info(trace_id, f"dispatched to {host.get('id')} (ws)")
-        return ack
-    except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
-        raise WorkerError(
-            f"ws dispatch to {host.get('id')} failed after connect: {e}",
-            worker_id=host.get("id"),
-        ) from e
-    finally:
-        await ws_ctx.__aexit__(None, None, None)
+    with telemetry.span("dispatch.ws", trace_id=trace_id,
+                        host=str(host.get("id"))):
+        t0 = time.perf_counter()
+        outcome = "error"
+        try:
+            try:
+                ws_ctx = session.ws_connect(
+                    url, headers=telemetry.trace_headers() or None)
+                ws = await ws_ctx.__aenter__()
+            except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
+                # connection never opened — the prompt cannot have been
+                # delivered, so the caller may safely retry over HTTP
+                err = WorkerError(
+                    f"ws dispatch to {host.get('id')} unreachable: {e}",
+                    worker_id=host.get("id"))
+                err.ws_undelivered = True
+                raise err from e
+            try:
+                # serialize once: measured AND sent as the same string
+                payload_s = json.dumps({
+                    "type": "dispatch_prompt",
+                    "prompt": prompt,
+                    "client_id": client_id,
+                    **(extra or {}),
+                })
+                if telemetry.enabled():
+                    _tm.DISPATCH_PAYLOAD_BYTES.labels(
+                        transport="ws").observe(
+                            len(payload_s.encode()))
+                await ws.send_str(payload_s)
+                msg = await ws.receive(timeout=constants.DISPATCH_TIMEOUT)
+                if msg.type != aiohttp.WSMsgType.TEXT:
+                    # the send may have been delivered even though the ack
+                    # never arrived — retrying over HTTP could
+                    # double-enqueue; fail hard
+                    raise WorkerError(
+                        f"ws dispatch to {host.get('id')}: connection closed "
+                        f"before ack ({msg.type})", worker_id=host.get("id"))
+                ack = json.loads(msg.data)
+                if ack.get("type") != "dispatch_ack" or not ack.get("ok", False):
+                    raise WorkerError(
+                        f"ws dispatch to {host.get('id')} rejected: "
+                        f"{ack.get('node_errors') or ack.get('error')}",
+                        worker_id=host.get("id"))
+                trace_info(trace_id, f"dispatched to {host.get('id')} (ws)")
+                outcome = "ok"
+                return ack
+            except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
+                raise WorkerError(
+                    f"ws dispatch to {host.get('id')} failed after connect: {e}",
+                    worker_id=host.get("id"),
+                ) from e
+            finally:
+                await ws_ctx.__aexit__(None, None, None)
+        finally:
+            if telemetry.enabled():
+                _tm.DISPATCH_SECONDS.labels(
+                    transport="ws", outcome=outcome).observe(
+                        time.perf_counter() - t0)
 
 
 async def dispatch_prompt(
@@ -156,22 +181,44 @@ async def dispatch_prompt(
     url = build_host_url(host, "/prompt")
     payload = {"prompt": prompt, "client_id": client_id, **(extra or {})}
     session = get_client_session()
-    try:
-        async with session.post(
-            url, json=payload,
-            timeout=aiohttp.ClientTimeout(total=constants.DISPATCH_TIMEOUT),
-        ) as resp:
-            body = await resp.json(content_type=None)
-            if resp.status >= 400:
-                raise WorkerError(
-                    f"dispatch to {host.get('id')} failed "
-                    f"({resp.status}): {body}",
-                    worker_id=host.get("id"),
-                )
-            trace_info(trace_id, f"dispatched to {host.get('id')}")
-            return body
-    except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
-        raise WorkerError(
-            f"dispatch to {host.get('id')} unreachable: {e}",
-            worker_id=host.get("id"),
-        ) from e
+    # the dispatch span's id rides the X-CDT-Trace header, so the worker's
+    # execution span parents onto THIS span and the job stitches into one
+    # cross-host timeline (docs/telemetry.md)
+    with telemetry.span("dispatch", trace_id=trace_id,
+                        host=str(host.get("id"))):
+        # serialize ONCE: the pre-encoded body both feeds the payload
+        # histogram and goes on the wire (aiohttp would otherwise
+        # re-serialize the same dict)
+        body_bytes = json.dumps(payload).encode()
+        if telemetry.enabled():
+            _tm.DISPATCH_PAYLOAD_BYTES.labels(transport="http").observe(
+                len(body_bytes))
+        t0 = time.perf_counter()
+        outcome = "error"
+        try:
+            async with session.post(
+                url, data=body_bytes,
+                timeout=aiohttp.ClientTimeout(total=constants.DISPATCH_TIMEOUT),
+                headers={"Content-Type": "application/json",
+                         **telemetry.trace_headers()},
+            ) as resp:
+                body = await resp.json(content_type=None)
+                if resp.status >= 400:
+                    raise WorkerError(
+                        f"dispatch to {host.get('id')} failed "
+                        f"({resp.status}): {body}",
+                        worker_id=host.get("id"),
+                    )
+                trace_info(trace_id, f"dispatched to {host.get('id')}")
+                outcome = "ok"
+                return body
+        except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
+            raise WorkerError(
+                f"dispatch to {host.get('id')} unreachable: {e}",
+                worker_id=host.get("id"),
+            ) from e
+        finally:
+            if telemetry.enabled():
+                _tm.DISPATCH_SECONDS.labels(
+                    transport="http", outcome=outcome).observe(
+                        time.perf_counter() - t0)

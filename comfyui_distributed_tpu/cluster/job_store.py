@@ -13,6 +13,7 @@ import asyncio
 import time
 from typing import Any, Optional
 
+from ..telemetry import enabled as _tm_enabled, metrics as _tm
 from ..utils import constants
 from ..utils.exceptions import JobQueueError
 from ..utils.logging import debug_log
@@ -24,6 +25,15 @@ class JobStore:
         self.lock = asyncio.Lock()
         self.collector_jobs: dict[str, CollectorJob] = {}
         self.tile_jobs: dict[str, TileJob] = {}
+
+    def _record_tiles(self, event: str, n: int = 1) -> None:
+        """Telemetry (call under ``self.lock``): lifecycle counter + the
+        cross-job pending-depth gauge."""
+        if not _tm_enabled() or n <= 0:
+            return
+        _tm.TILE_EVENTS.labels(event=event).inc(n)
+        _tm.TILE_QUEUE_DEPTH.set(
+            sum(len(j.pending) for j in self.tile_jobs.values()))
 
     # --- collector jobs ----------------------------------------------------
 
@@ -88,6 +98,7 @@ class JobStore:
             job = TileJob(job_id, total_tasks=len(tasks), mode=mode,
                           tasks={t.task_id: t for t in tasks}, pending=list(tasks))
             self.tile_jobs[job_id] = job
+            self._record_tiles("seeded", len(tasks))
             return job
 
     async def request_work(self, job_id: str, worker_id: str) -> Optional[dict]:
@@ -103,6 +114,7 @@ class JobStore:
                 return None
             task = job.pending.pop(0)
             job.assigned[task.task_id] = worker_id
+            self._record_tiles("assigned")
             return {**task.as_dict(), "estimated_remaining": len(job.pending)}
 
     async def submit_result(
@@ -122,6 +134,7 @@ class JobStore:
                 return False
             job.completed[task_id] = payload
             job.assigned.pop(task_id, None)
+            self._record_tiles("completed")
         await job.results.put((task_id, payload))
         return True
 
@@ -139,6 +152,7 @@ class JobStore:
             job.completed[task_id] = payload
             job.pending = [t for t in job.pending if t.task_id != task_id]
             job.assigned.pop(task_id, None)
+            self._record_tiles("restored")
             return True
 
     async def heartbeat(self, job_id: str, worker_id: str) -> bool:
@@ -180,6 +194,7 @@ class JobStore:
             if requeued:
                 # push to the FRONT so recovered work is picked up first
                 job.pending[:0] = [job.tasks[tid] for tid in requeued]
+                self._record_tiles("requeued", len(requeued))
             job.worker_status.pop(worker_id, None)
             return requeued
 
@@ -187,6 +202,9 @@ class JobStore:
         async with self.lock:
             self.collector_jobs.pop(job_id, None)
             self.tile_jobs.pop(job_id, None)
+            if _tm_enabled():
+                _tm.TILE_QUEUE_DEPTH.set(
+                    sum(len(j.pending) for j in self.tile_jobs.values()))
 
     async def prune_stale(self, max_age: float = 3600.0) -> list[str]:
         """Drop jobs older than ``max_age`` (the reference cleans up on

@@ -15,6 +15,7 @@ from __future__ import annotations
 import time
 from typing import Awaitable, Callable, Optional
 
+from ..telemetry import enabled as _tm_enabled, metrics as _tm
 from ..utils import constants
 from ..utils.logging import log
 from .job_store import JobStore
@@ -67,9 +68,15 @@ async def check_and_requeue_timed_out_workers(
         if w in spared:
             await store.heartbeat(job_id, w)
             log(f"worker {w} silent but busy — heartbeat refreshed (grace)")
+            if _tm_enabled():
+                _tm.TILE_WORKER_EVICTIONS.labels(outcome="spared").inc()
             continue
         requeued = await store.requeue_worker_tasks(job_id, w)
         if requeued:
             log(f"worker {w} timed out; requeued tasks {requeued}")
         evicted[w] = requeued
+        if _tm_enabled():
+            _tm.TILE_WORKER_EVICTIONS.labels(outcome="evicted").inc()
+            if requeued:
+                _tm.TILE_EVENTS.labels(event="timed_out").inc(len(requeued))
     return evicted

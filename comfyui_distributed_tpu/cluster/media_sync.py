@@ -24,6 +24,7 @@ from typing import Any, Optional
 
 import aiohttp
 
+from ..telemetry import enabled as _tm_enabled, metrics as _tm
 from ..utils import constants
 from ..utils.logging import debug_log, trace_info
 from ..utils.network import build_host_url, fetch_system_info, get_client_session
@@ -182,12 +183,17 @@ async def sync_host_media(
     sep = await fetch_host_path_separator(host, timeout)
     sem = asyncio.Semaphore(max(1, concurrency))
 
+    def count(outcome: str) -> None:
+        if _tm_enabled():
+            _tm.MEDIA_SYNC_FILES.labels(outcome=outcome).inc()
+
     async def sync_one(ref: MediaRef) -> None:
         async with sem:
             report.checked += 1
             local = base / ref.value.replace("\\", "/")
             if not local.is_file():
                 report.missing += 1
+                count("missing")
                 debug_log(f"media sync: {local} absent locally; skipping")
                 return
             md5 = await asyncio.get_running_loop().run_in_executor(
@@ -195,11 +201,19 @@ async def sync_host_media(
             rel = ref.value.replace("\\", "/")
             if await _check_remote_file(host, rel, md5, timeout):
                 report.skipped += 1
+                count("skipped")
                 return
             if await _upload_file(host, rel, local, timeout):
                 report.uploaded += 1
+                count("uploaded")
+                if _tm_enabled():
+                    try:
+                        _tm.MEDIA_SYNC_BYTES.inc(local.stat().st_size)
+                    except OSError:
+                        pass
             else:
                 report.failed.append(rel)
+                count("failed")
 
     await asyncio.gather(*(sync_one(r) for r in refs))
     if trace_id:

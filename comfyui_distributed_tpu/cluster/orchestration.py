@@ -95,9 +95,28 @@ class Orchestrator:
         trace_id: str | None = None,
     ) -> OrchestrationResult:
         from ..graph.executor import strip_meta
+        from ..telemetry import span as _tm_span
 
         prompt = strip_meta(prompt)
         trace_id = trace_id or new_trace_id()
+        # the orchestration trace id (exec_…) doubles as the telemetry
+        # trace id: probe/dispatch spans open underneath, dispatched hosts
+        # join via X-CDT-Trace, and /distributed/trace/{trace_id} shows
+        # the whole fan-out as one timeline
+        with _tm_span("orchestrate", trace_id=trace_id, job_id=trace_id):
+            return await self._orchestrate_inner(
+                prompt, client_id, enabled_ids, delegate_master,
+                load_balance, trace_id)
+
+    async def _orchestrate_inner(
+        self,
+        prompt: dict,
+        client_id: str,
+        enabled_ids: Optional[Sequence[str]],
+        delegate_master: Optional[bool],
+        load_balance: bool,
+        trace_id: str,
+    ) -> OrchestrationResult:
         config = self.load_config()
         all_hosts = self._normalized_hosts(config)
         candidates = self._resolve_enabled_hosts(all_hosts, enabled_ids)

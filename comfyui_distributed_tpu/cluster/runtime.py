@@ -17,7 +17,9 @@ import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Optional
 
+from .. import telemetry
 from ..graph.executor import GraphExecutor, strip_meta, validate_prompt
+from ..telemetry import metrics as _tm
 from ..utils.exceptions import ValidationError
 from ..utils.logging import log, trace_info
 
@@ -28,6 +30,9 @@ class PromptJob:
     prompt: dict
     client_id: str = ""
     trace_id: str | None = None
+    # master-side dispatch span id carried by X-CDT-Trace: the execution
+    # span parents onto it so cross-host traces stitch (telemetry/spans)
+    parent_span_id: str | None = None
     enqueued_at: float = dataclasses.field(default_factory=time.monotonic)
     future: Optional[asyncio.Future] = None
 
@@ -69,7 +74,8 @@ class PromptQueue:
     # --- producer ----------------------------------------------------------
 
     def enqueue(self, prompt: dict, client_id: str = "",
-                trace_id: str | None = None) -> tuple[str, list]:
+                trace_id: str | None = None,
+                parent_span_id: str | None = None) -> tuple[str, list]:
         """Validate + enqueue; returns (prompt_id, node_errors). Mirrors
         ``queue_prompt_payload``: validation errors reject the prompt
         before it reaches the queue (``utils/async_helpers.py:108-149``)."""
@@ -78,8 +84,11 @@ class PromptQueue:
         if errors:
             return "", [e.as_dict() for e in errors]
         prompt_id = f"p_{int(time.time()*1000)}_{secrets.token_hex(3)}"
-        job = PromptJob(prompt_id, prompt, client_id, trace_id)
+        job = PromptJob(prompt_id, prompt, client_id, trace_id,
+                        parent_span_id=parent_span_id)
         self._queue.put_nowait(job)
+        if telemetry.enabled():
+            _tm.PROMPT_QUEUE_DEPTH.set(self.queue_remaining)
         self.start()
         return prompt_id, []
 
@@ -117,14 +126,23 @@ class PromptQueue:
             self._executing = job.prompt_id
             started = time.monotonic()
             self._interrupt.clear()
+            status = "error"
             try:
                 context = dict(self._context_factory())
                 context["interrupt_event"] = self._interrupt
                 context["prompt_id"] = job.prompt_id
                 executor = GraphExecutor(context)
-                outputs = await loop.run_in_executor(
-                    self._pool, executor.execute, job.prompt
-                )
+                # the execution span adopts the orchestration trace id and
+                # parents onto the master's dispatch span (X-CDT-Trace) —
+                # this is the worker-side half of a stitched job trace
+                with telemetry.span("prompt.execute",
+                                    trace_id=job.trace_id,
+                                    parent_id=job.parent_span_id,
+                                    prompt_id=job.prompt_id):
+                    outputs = await loop.run_in_executor(
+                        self._pool, executor.execute, job.prompt
+                    )
+                status = "success"
                 self.history[job.prompt_id] = {
                     "status": "success",
                     "duration": time.monotonic() - started,
@@ -137,6 +155,7 @@ class PromptQueue:
                            f"prompt {job.prompt_id} done in "
                            f"{self.history[job.prompt_id]['duration']:.2f}s")
             except InterruptedError:
+                status = "interrupted"
                 self.history[job.prompt_id] = {
                     "status": "interrupted",
                     "duration": time.monotonic() - started,
@@ -150,6 +169,10 @@ class PromptQueue:
                 log(f"prompt {job.prompt_id} failed: {e}")
             finally:
                 self._executing = None
+                if telemetry.enabled():
+                    _tm.PROMPTS_TOTAL.labels(status=status).inc()
+                    _tm.PROMPT_SECONDS.observe(time.monotonic() - started)
+                    _tm.PROMPT_QUEUE_DEPTH.set(self.queue_remaining)
 
 
 def _is_terminal(prompt: dict, nid: str) -> bool:

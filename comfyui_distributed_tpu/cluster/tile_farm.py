@@ -32,6 +32,7 @@ from typing import Awaitable, Callable, Optional, Sequence
 import aiohttp
 import numpy as np
 
+from ..telemetry import span as _tm_span
 from ..utils import constants
 from ..utils.async_helpers import run_in_loop
 from ..utils.exceptions import TileCollectionError, WorkerError
@@ -149,12 +150,7 @@ class TileFarm:
 
     async def master_run_async(
         self, job_id: str, total: int, process_fn: ProcessFn, chunk: int = 1,
-        heartbeat_interval: float | None = None,
-        worker_timeout: float | None = None,
-        probe_fn: ProbeFn | None = None,
-        overall_timeout: float | None = None,
-        journal_dir=None,
-        journal_key: str | None = None,
+        **kw,
     ) -> dict[int, np.ndarray]:
         """Drive a tile job to completion; returns {task_id: array}.
 
@@ -163,7 +159,25 @@ class TileFarm:
         master pulls from the same queue as workers, so it naturally takes
         over everything requeued from dead workers, and the job completes
         whenever at least the master survives.
+
+        The whole job runs under a ``tile_job.master`` span, so
+        ``/distributed/trace/{job_id}`` shows where a multi-hour upscale
+        spent its wall-clock.
         """
+        with _tm_span("tile_job.master", job_id=job_id, tiles=total,
+                      chunk=chunk):
+            return await self._master_run_inner(job_id, total, process_fn,
+                                                chunk, **kw)
+
+    async def _master_run_inner(
+        self, job_id: str, total: int, process_fn: ProcessFn, chunk: int = 1,
+        heartbeat_interval: float | None = None,
+        worker_timeout: float | None = None,
+        probe_fn: ProbeFn | None = None,
+        overall_timeout: float | None = None,
+        journal_dir=None,
+        journal_key: str | None = None,
+    ) -> dict[int, np.ndarray]:
         heartbeat_interval = (constants.HEARTBEAT_INTERVAL
                               if heartbeat_interval is None else heartbeat_interval)
         job = await self.store.init_tile_job(job_id, total, chunk=chunk)
@@ -259,6 +273,16 @@ class TileFarm:
     # --- worker role --------------------------------------------------------
 
     async def worker_run_async(
+        self, job_id: str, worker_id: str, master_url: str,
+        process_fn: ProcessFn, **kw,
+    ) -> int:
+        with _tm_span("tile_job.worker", job_id=job_id,
+                      worker_id=worker_id):
+            return await self._worker_run_inner(job_id, worker_id,
+                                                master_url, process_fn,
+                                                **kw)
+
+    async def _worker_run_inner(
         self, job_id: str, worker_id: str, master_url: str,
         process_fn: ProcessFn, max_batch: int | None = None,
         ready_polls: int | None = None, ready_interval: float = 1.0,

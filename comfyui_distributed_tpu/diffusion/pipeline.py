@@ -14,11 +14,13 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import time
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
+from ..utils.jax_compat import shard_map
 
 from ..models.layers import timestep_embedding
 from ..models.unet import UNet2D, UNetConfig
@@ -77,14 +79,44 @@ def cached_build(holder, key, builder, max_entries: int = 8):
     return fn
 
 
-def bind_weights(jitted, weights):
+def bind_weights(jitted, weights, label: "str | None" = None,
+                 steps: "int | None" = None):
     """Wrap a jitted function whose LEADING argument is the weight pytree:
     the returned callable supplies it automatically, while ``.jitted`` /
     ``.weights`` expose the raw jit object for AOT use
     (``bench.py``: ``fn.jitted.lower(fn.weights, *args)``). One shared
-    definition — every pipeline factory returns this shape."""
+    definition — every pipeline factory returns this shape.
+
+    ``label`` opts the wrapper into telemetry: each call is timed to
+    completion (``block_until_ready`` — callers materialize the output
+    immediately anyway) and recorded as
+    ``cdt_pipeline_compile_seconds{pipeline=label}`` on the first call
+    (which pays trace + XLA compile) vs ``cdt_pipeline_execute_seconds``
+    after; with ``steps`` the per-step quotient also lands in
+    ``cdt_sampler_step_seconds``. With telemetry disabled (or no label)
+    the call path is exactly the old one-liner."""
+    from ..telemetry import enabled as _tm_enabled
+
+    state = {"first": True}
+
     def call(*args, **kw):
-        return jitted(weights, *args, **kw)
+        if label is None or not _tm_enabled():
+            return jitted(weights, *args, **kw)
+        from ..telemetry import metrics as _tm
+
+        t0 = time.perf_counter()
+        out = jitted(weights, *args, **kw)
+        jax.block_until_ready(out)
+        dt = time.perf_counter() - t0
+        if state["first"]:
+            state["first"] = False
+            _tm.PIPELINE_COMPILE_SECONDS.labels(pipeline=label).observe(dt)
+        else:
+            _tm.PIPELINE_EXECUTE_SECONDS.labels(pipeline=label).observe(dt)
+        if steps:
+            _tm.SAMPLER_STEP_SECONDS.labels(pipeline=label).observe(
+                dt / steps)
+        return out
 
     call.jitted = jitted
     call.weights = weights
@@ -355,14 +387,15 @@ class Txt2ImgPipeline:
             per_shard = (lambda w, key, c, u, y_, uy, token:
                          shard_body(w, key, c, u, y_, uy, None, token))
             in_specs += (P(),)
-        f = jax.shard_map(
+        f = shard_map(
             per_shard, mesh=mesh, in_specs=in_specs,
             out_specs=P(axis, None, None, None),
         )
         jitted = jax.jit(f)
         weights = self._weights()
 
-        return bind_weights(jitted, weights)
+        return bind_weights(jitted, weights, label="txt2img",
+                            steps=len(sigmas) - 1)
 
     def img2img_fn(self, mesh: Mesh, spec: GenerationSpec,
                    axis: str = constants.AXIS_DATA,
@@ -428,14 +461,15 @@ class Txt2ImgPipeline:
                              shard_body(w, im, key, c, u, y_, uy,
                                         None, mask))
             in_specs += (P(None, None, None, None),)
-        f = jax.shard_map(
+        f = shard_map(
             per_shard, mesh=mesh, in_specs=in_specs,
             out_specs=P(axis, None, None, None),
         )
         jitted = jax.jit(f)
         weights = self._weights(img2img=True)
 
-        return bind_weights(jitted, weights)
+        return bind_weights(jitted, weights, label="img2img",
+                            steps=len(sigmas) - 1)
 
     def img2img(
         self,

@@ -18,6 +18,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
+from ..utils.jax_compat import shard_map
 
 from ..models.dit import DiT, DiTConfig
 from ..models.vae import AutoencoderKL
@@ -73,8 +74,17 @@ class FlowPipeline:
                 return x - sigma * v
             return denoise
 
-        if cfg == 1.0 or uncond_context is None:
+        if cfg == 1.0:
             return make(context, pooled)
+        if uncond_context is None:
+            # silently sampling WITHOUT guidance a caller asked for would
+            # quietly produce the wrong image — fail loudly instead
+            raise ValueError(
+                f"cfg={cfg} requires negative conditioning: pass "
+                "uncond_context (and uncond_pooled) through generate/"
+                "generate_sp, or wire the FlowSampler node's 'negative' "
+                "input; FLUX-dev distilled guidance wants cfg=1.0 with "
+                "the 'guidance' field instead")
         from .guidance import cfg_denoiser
 
         return cfg_denoiser(make, context, uncond_context, cfg,
@@ -113,27 +123,43 @@ class FlowPipeline:
         sigmas = sigmas_flow(spec.steps, spec.shift)
         ds = self.vae.config.downscale
         lat_hw = (spec.height // ds, spec.width // ds)
+        # spec.cfg != 1.0 (SD3-family true CFG) adds replicated
+        # uncond_context/uncond_pooled inputs; arity is a function of
+        # spec.cfg alone, so the compile cache (keyed on spec) stays
+        # consistent
+        use_cfg = spec.cfg != 1.0
 
-        def shard_body(weights, key, context, pooled, token=None):
+        def shard_body(weights, key, context, pooled, uncond_context=None,
+                       uncond_pooled=None, token=None):
             k = participant_key(key, axis)
             prog = ((token, jax.lax.axis_index(axis))
                     if token is not None else None)
             return self._sample_and_decode(k, context, pooled, spec,
                                            spec.per_device_batch, sigmas,
                                            lat_hw, weights=weights,
-                                           progress=prog)
+                                           progress=prog,
+                                           uncond_context=uncond_context,
+                                           uncond_pooled=uncond_pooled)
 
+        per_shard = shard_body
         in_specs = (P(), P(), P(None, None, None), P(None, None))
+        if use_cfg:
+            in_specs += (P(None, None, None), P(None, None))
         if progress:
+            if not use_cfg:
+                # the 5th positional must skip the uncond slots
+                per_shard = (lambda w, key, c, pl, token:
+                             shard_body(w, key, c, pl, None, None, token))
             in_specs += (P(),)     # traced int32 token, replicated
-        f = jax.shard_map(
-            shard_body, mesh=mesh, in_specs=in_specs,
+        f = shard_map(
+            per_shard, mesh=mesh, in_specs=in_specs,
             out_specs=P(axis, None, None, None),
         )
         jitted = jax.jit(f)
         weights = self._weights()
 
-        return bind_weights(jitted, weights)
+        return bind_weights(jitted, weights, label="flow_dp",
+                            steps=spec.steps)
 
     _CACHE_MAX = 8
 
@@ -168,15 +194,34 @@ class FlowPipeline:
 
     def generate(self, mesh: Mesh, spec: FlowSpec, seed: int,
                  context: jax.Array, pooled: jax.Array,
-                 progress_token=None) -> jax.Array:
+                 progress_token=None,
+                 uncond_context: Optional[jax.Array] = None,
+                 uncond_pooled: Optional[jax.Array] = None) -> jax.Array:
         """One-shot generate; ``progress_token`` enables per-step x0
-        streaming (``cluster/progress.ProgressTracker.start``)."""
+        streaming (``cluster/progress.ProgressTracker.start``).
+        ``uncond_context``/``uncond_pooled`` carry the negative
+        conditioning when ``spec.cfg != 1.0`` (SD3-family true CFG) —
+        required then, ignored otherwise."""
+        self._require_uncond(spec, uncond_context)
         fn = self._cached_fn(mesh, spec,
                              progress=progress_token is not None)
         args = [jax.random.key(seed), context, pooled]
+        if spec.cfg != 1.0:
+            if uncond_pooled is None:
+                uncond_pooled = jnp.zeros_like(pooled)
+            args += [uncond_context, uncond_pooled]
         if progress_token is not None:
             args.append(jnp.asarray(progress_token, jnp.int32))
         return fn(*args)
+
+    @staticmethod
+    def _require_uncond(spec: FlowSpec, uncond_context) -> None:
+        if spec.cfg != 1.0 and uncond_context is None:
+            raise ValueError(
+                f"FlowSpec.cfg={spec.cfg} but no negative conditioning "
+                "was provided — pass uncond_context/uncond_pooled (the "
+                "FlowSampler node's 'negative' input). FLUX-dev distilled "
+                "guidance wants cfg=1.0 with the 'guidance' field.")
 
     # --- mode 1c: host offload (model too large for one chip, no pod) ------
 
@@ -223,6 +268,11 @@ class FlowPipeline:
             raise ValueError(
                 "offloaded generation is single-image (batch 1): the "
                 "streamed weight window serves one latent at a time")
+        if spec.cfg != 1.0:
+            raise ValueError(
+                "true CFG (spec.cfg != 1.0) is not wired through the "
+                "offload executor — use cfg=1.0 with FLUX distilled "
+                "'guidance', or run the dp/sp paths")
         from .offload import ladder_mode
 
         if ladder_mode() == "step" and spec.sampler != "euler":
@@ -279,6 +329,11 @@ class FlowPipeline:
         from ..parallel.tensor import (DIT_TP_RULES, require_tp_match,
                                        shard_params, tp_fanout_call)
 
+        if spec.cfg != 1.0:
+            raise ValueError(
+                "true CFG (spec.cfg != 1.0) is not wired through tp "
+                "mode — use cfg=1.0 with FLUX distilled 'guidance', or "
+                "run the dp/sp paths")
         sigmas = sigmas_flow(spec.steps, spec.shift)
         ds = self.vae.config.downscale
         lat_h, lat_w = spec.height // ds, spec.width // ds
@@ -327,36 +382,52 @@ class FlowPipeline:
                 f"latent rows/patch ({lat_h}/{p}) must divide over {n_sh} shards")
         sigmas = sigmas_flow(spec.steps, spec.shift)
         rows_per = lat_h // n_sh
+        use_cfg = spec.cfg != 1.0
 
-        def per_shard(weights, key, context, pooled):
+        def per_shard(weights, key, context, pooled, uncond_context=None,
+                      uncond_pooled=None):
             idx = jax.lax.axis_index(axis)
             c = self.dit.config.in_channels
             full_noise = jax.random.normal(key, (1, lat_h, lat_w, c), jnp.float32)
             x = jax.lax.dynamic_slice_in_dim(full_noise, idx * rows_per,
                                              rows_per, axis=1)
             den = self._denoiser(context, pooled, spec.guidance, sp_axis=axis,
-                                 weights=weights)
+                                 weights=weights, cfg=spec.cfg,
+                                 uncond_context=uncond_context,
+                                 uncond_pooled=uncond_pooled)
             x0 = sample(spec.sampler, den, x, sigmas, key=key)
             return x0
 
-        f = jax.shard_map(
+        in_specs = (P(), P(), P(None, None, None), P(None, None))
+        if use_cfg:
+            in_specs += (P(None, None, None), P(None, None))
+        f = shard_map(
             per_shard, mesh=mesh,
-            in_specs=(P(), P(), P(None, None, None), P(None, None)),
+            in_specs=in_specs,
             out_specs=P(None, axis, None, None),
             check_vma=False,
         )
 
-        def run(weights, key, context, pooled):
-            latents = f(weights, key, context, pooled)  # [1,lat_h,lat_w,c]
+        def run(weights, key, context, pooled, *uncond):
+            latents = f(weights, key, context, pooled, *uncond)
             images = self.vae.decode(latents, params=weights["vae_dec"])
             return jnp.clip(images / 2.0 + 0.5, 0.0, 1.0)
 
         jitted = jax.jit(run)
         weights = self._weights()
 
-        return bind_weights(jitted, weights)
+        return bind_weights(jitted, weights, label="flow_sp",
+                            steps=spec.steps)
 
     def generate_sp(self, mesh: Mesh, spec: FlowSpec, seed: int,
-                    context: jax.Array, pooled: jax.Array) -> jax.Array:
+                    context: jax.Array, pooled: jax.Array,
+                    uncond_context: Optional[jax.Array] = None,
+                    uncond_pooled: Optional[jax.Array] = None) -> jax.Array:
+        self._require_uncond(spec, uncond_context)
         fn = self._cached_fn(mesh, spec, mode="sp")
-        return fn(jax.random.key(seed), context, pooled)
+        args = [jax.random.key(seed), context, pooled]
+        if spec.cfg != 1.0:
+            if uncond_pooled is None:
+                uncond_pooled = jnp.zeros_like(pooled)
+            args += [uncond_context, uncond_pooled]
+        return fn(*args)

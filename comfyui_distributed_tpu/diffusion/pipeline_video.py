@@ -24,6 +24,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
+from ..utils.jax_compat import shard_map
 
 from ..models.vae import AutoencoderKL
 from ..models.video_dit import VideoDiT, pad_frames_4n1
@@ -215,14 +216,15 @@ class VideoPipeline:
         in_specs = (P(), P(), P(None, None, None), P(None, None))
         if progress:
             in_specs += (P(),)          # traced int32 token, replicated
-        f = jax.shard_map(
+        f = shard_map(
             per_shard, mesh=mesh, in_specs=in_specs,
             out_specs=P(axis, None, None, None, None),
         )
         jitted = jax.jit(f)
         weights = self._weights()
 
-        return bind_weights(jitted, weights)
+        return bind_weights(jitted, weights, label="video_dp",
+                            steps=spec.steps)
 
     _CACHE_MAX = 4
 
@@ -539,14 +541,15 @@ class VideoPipeline:
                     P(None, None, None, None, None))
         if progress:
             in_specs += (P(),)
-        f = jax.shard_map(
+        f = shard_map(
             per_shard, mesh=mesh, in_specs=in_specs,
             out_specs=P(axis, None, None, None, None),
         )
         jitted = jax.jit(f)
         weights = self._weights()
 
-        return bind_weights(jitted, weights)
+        return bind_weights(jitted, weights, label="video_i2v",
+                            steps=spec.steps)
 
     def generate_i2v(self, mesh: Mesh, spec: VideoSpec, seed: int,
                      image: jax.Array, context: jax.Array,
@@ -610,7 +613,7 @@ class VideoPipeline:
                     P(None, axis), P(None, axis))
         if progress:
             in_specs += (P(),)
-        f = jax.shard_map(
+        f = shard_map(
             per_shard, mesh=mesh, in_specs=in_specs,
             out_specs=P(None, axis, None, None, None),
             check_vma=False,
@@ -624,7 +627,8 @@ class VideoPipeline:
         jitted = jax.jit(run)
         weights = self._weights()
 
-        return bind_weights(jitted, weights)
+        return bind_weights(jitted, weights, label="video_i2v_sp",
+                            steps=spec.steps)
 
     def generate_frames_fn(self, mesh: Mesh, spec: VideoSpec,
                            axis: str = constants.AXIS_SEQUENCE,
@@ -662,7 +666,7 @@ class VideoPipeline:
         in_specs = (P(), P(), P(None, None, None), P(None, None))
         if progress:
             in_specs += (P(),)
-        f = jax.shard_map(
+        f = shard_map(
             per_shard, mesh=mesh, in_specs=in_specs,
             out_specs=P(None, axis, None, None, None),
             check_vma=False,
@@ -675,4 +679,5 @@ class VideoPipeline:
         jitted = jax.jit(run)
         weights = self._weights()
 
-        return bind_weights(jitted, weights)
+        return bind_weights(jitted, weights, label="video_sp",
+                            steps=spec.steps)

@@ -1102,6 +1102,7 @@ class TPUFlowTxt2Img(NodeDef):
         "seed": "INT", "steps": "INT", "width": "INT", "height": "INT",
     }
     OPTIONAL = {
+        "negative": "CONDITIONING", "cfg": "FLOAT",
         "guidance": "FLOAT", "shift": "FLOAT", "mode": "STRING",
         "batch_per_device": "INT",
     }
@@ -1110,12 +1111,14 @@ class TPUFlowTxt2Img(NodeDef):
     RETURNS = ("IMAGE",)
 
     def execute(self, model, positive, seed: int, steps: int, width: int,
-                height: int, guidance: float = 3.5, shift=None,
+                height: int, negative=None, cfg: float = 1.0,
+                guidance: float = 3.5, shift=None,
                 mode: str = "dp", batch_per_device: int = 1, mesh=None,
                 prompt_id: str = "", progress_tracker=None,
                 interrupt_event=None, **_):
         from ..diffusion.pipeline_flow import FlowSpec
         from ..parallel.mesh import build_mesh
+        from ..utils.exceptions import ValidationError
 
         if mesh is None:
             mesh = build_mesh({"dp": len(jax.devices())})
@@ -1125,11 +1128,24 @@ class TPUFlowTxt2Img(NodeDef):
             shift = getattr(model, "sampling_shift", 3.0)
         spec = FlowSpec(height=int(height), width=int(width), steps=int(steps),
                         shift=float(shift), guidance=float(guidance),
+                        cfg=float(cfg),
                         per_device_batch=int(batch_per_device))
         ctx = positive["context"]
         pooled = positive.get("pooled")
         if pooled is None:
             pooled = jnp.zeros((1, model.pipeline.dit.config.pooled_dim))
+        # true CFG (SD3-family): the 'negative' conditioning rides along;
+        # asking for cfg != 1.0 without it is a loud error, never a
+        # silent unguided sample
+        uncond_ctx = uncond_pooled = None
+        if negative is not None:
+            uncond_ctx = negative["context"]
+            uncond_pooled = negative.get("pooled")
+        if spec.cfg != 1.0 and uncond_ctx is None:
+            raise ValidationError(
+                f"cfg={spec.cfg} needs the 'negative' conditioning input "
+                "(true CFG); FLUX-dev distilled guidance uses cfg=1.0 "
+                "with 'guidance'")
         from ..diffusion.offload import offload_enabled
 
         if mode == "offload" or (mode == "dp" and offload_enabled()):
@@ -1159,7 +1175,9 @@ class TPUFlowTxt2Img(NodeDef):
             # intentionally dp-only for now — each sp shard holds a row
             # BLOCK, so a per-shard preview would be a partial strip; the
             # tracker would need cross-shard assembly to be meaningful.
-            images = model.pipeline.generate_sp(mesh, spec, int(seed), ctx, pooled)
+            images = model.pipeline.generate_sp(
+                mesh, spec, int(seed), ctx, pooled,
+                uncond_context=uncond_ctx, uncond_pooled=uncond_pooled)
         else:
             from ..diffusion.progress import total_calls
 
@@ -1168,7 +1186,9 @@ class TPUFlowTxt2Img(NodeDef):
                                             spec.steps)) as ps:
                 images = model.pipeline.generate(
                     mesh, spec, int(seed), ctx, pooled,
-                    progress_token=ps.token)
+                    progress_token=ps.token,
+                    uncond_context=uncond_ctx,
+                    uncond_pooled=uncond_pooled)
                 ps.complete(images)
         return (images,)
 

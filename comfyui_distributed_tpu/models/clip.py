@@ -244,6 +244,13 @@ class CLIPConditioner:
 
         self.stack = stack
         self.kind = kind
+        if kind == "sdxl" and (tok_l is None) != (tok_g is None):
+            # a single explicit tokenizer would crash vocab validation on
+            # the None twin (advisor r05) — require the pair, loudly
+            raise ValueError(
+                "CLIPConditioner(kind='sdxl') needs both tok_l and tok_g "
+                "(or neither, to auto-load from CDT_TOKENIZER_DIR); got "
+                f"only {'tok_l' if tok_g is None else 'tok_g'}")
         if tok_l is None and tok_g is None:
             # tokenize each tower to ITS context length — the position
             # tables only cover cfg.max_len, so a 77-padded sequence would
@@ -263,6 +270,13 @@ class CLIPConditioner:
             if kind == "sdxl":
                 towers.append(("clip_g", self.tok_g, stack.clip_g.config))
             for name, tok, cfg in towers:
+                if tok is None:
+                    # env-derived asymmetry (vocab present for one tower
+                    # only): that tower falls back to hash tokenization —
+                    # say so instead of crashing on None.eot_id
+                    log(f"WARNING: no tokenizer for the {name} tower; "
+                        "it falls back to hash tokenization")
+                    continue
                 validate_tokenizer_vocab(tok, cfg, name)
         if self.tok_l is None:
             log("WARNING: no CLIP vocab at CDT_TOKENIZER_DIR — text is "

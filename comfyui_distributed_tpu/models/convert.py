@@ -506,11 +506,20 @@ def detect_layout(sd: Mapping[str, np.ndarray]) -> str:
         return "sd3"
     if any(k.endswith("blocks.0.self_attn.norm_q.weight") for k in sd):
         return "wan"
+    # diffusers repacks: both FLUX and SD3 use transformer_blocks.*, but
+    # only FLUX carries a single_transformer_blocks.* tail — check it
+    # first so each gets the error naming ITS single-file layout
+    if any(FLUX_SINGLE_DIFFUSERS_HINT in k for k in sd):
+        raise ConversionError(
+            "diffusers-repacked FLUX transformer (transformer_blocks.*/"
+            "single_transformer_blocks.*) is not supported — convert from "
+            "the BFL single-file layout "
+            "(double_blocks.*/single_blocks.*) instead")
     if any(k.startswith(FLUX_DIFFUSERS_HINT) for k in sd):
         raise ConversionError(
-            "diffusers-repacked FLUX transformer (transformer_blocks.*) is "
-            "not supported — convert from the BFL single-file layout "
-            "(double_blocks.*/single_blocks.*) instead")
+            "diffusers-repacked SD3 MMDiT (transformer_blocks.*) is not "
+            "supported — convert from the single-file layout "
+            "(joint_blocks.*) instead")
     if any(k.startswith(SDXL_CLIP_G_PREFIX) for k in sd):
         return "sdxl"
     if any(k.startswith(SD15_CLIP_PREFIX) for k in sd):
@@ -736,6 +745,9 @@ def convert_controlnet(sd: Mapping[str, np.ndarray], template, config,
 # ---------------------------------------------------------------------------
 
 FLUX_DIFFUSERS_HINT = "transformer_blocks."      # diffusers repack: unsupported
+# FLUX's diffusers repack alone carries the single-stream tail — the
+# discriminator between diffusers-FLUX and diffusers-SD3 in detect_layout
+FLUX_SINGLE_DIFFUSERS_HINT = "single_transformer_blocks."
 FLUX_PREFIXED = "model.diffusion_model."         # ComfyUI single-file repack
 
 

@@ -37,6 +37,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from ..utils.jax_compat import axis_size as _axis_size
 from flax import linen as nn
 
 from ..ops.attention import full_attention, joint_ring_attention
@@ -395,7 +396,7 @@ class DiT(nn.Module):
             table = self.param("pos_emb", nn.initializers.normal(0.01),
                                (m * m, cfg.hidden)).reshape(m, m, cfg.hidden)
             hp, wp = H // p, W // p
-            n_sh = 1 if sp_axis is None else jax.lax.axis_size(sp_axis)
+            n_sh = 1 if sp_axis is None else _axis_size(sp_axis)
             gh = hp * n_sh                       # global patch rows
             if gh > m or wp > m:
                 raise ValueError(
@@ -417,7 +418,7 @@ class DiT(nn.Module):
         else:
             # x is this shard's row block of the global image: build the
             # global position table and slice this shard's rows
-            n_sh = jax.lax.axis_size(sp_axis)
+            n_sh = _axis_size(sp_axis)
             idx = jax.lax.axis_index(sp_axis)
             pos_full = sincos_2d((H * n_sh) // p, W // p, cfg.hidden)
             per = pos_full.shape[0] // n_sh

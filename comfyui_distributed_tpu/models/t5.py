@@ -389,6 +389,13 @@ class SD3TextStack:
         self.clip_g = clip_g
         self.t5 = t5
         self.t5_tok = t5_tok if t5_tok is not None else load_t5_tokenizer()
+        if (tok_l is None) != (tok_g is None):
+            # a single explicit tokenizer would crash vocab validation on
+            # the None twin (advisor r05) — require the pair, loudly
+            raise ValueError(
+                "SD3TextStack needs both tok_l and tok_g (or neither, to "
+                "auto-load from CDT_TOKENIZER_DIR); got only "
+                f"{'tok_l' if tok_g is None else 'tok_g'}")
         if tok_l is None and tok_g is None:
             tok_l, _ = load_sd_tokenizers(max_len=clip_l.config.max_len)
             if tok_l is not None:
@@ -397,7 +404,12 @@ class SD3TextStack:
         self.tok_l, self.tok_g = tok_l, tok_g
         if self.tok_l is not None:
             validate_tokenizer_vocab(self.tok_l, clip_l.config, "clip_l")
-            validate_tokenizer_vocab(self.tok_g, clip_g.config, "clip_g")
+            if self.tok_g is None:
+                log("WARNING: no tokenizer for the clip_g tower; it "
+                    "falls back to hash tokenization")
+            else:
+                validate_tokenizer_vocab(self.tok_g, clip_g.config,
+                                         "clip_g")
         else:
             log("WARNING: no CLIP vocab at CDT_TOKENIZER_DIR — text is "
                 "hash-tokenized; conditioning will not reflect the prompt")

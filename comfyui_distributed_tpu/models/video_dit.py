@@ -23,6 +23,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from ..utils.jax_compat import axis_size as _axis_size
 from flax import linen as nn
 
 from .dit import DiTConfig, DoubleBlock, Modulation, SingleBlock, _modulate
@@ -136,7 +137,7 @@ class VideoDiT(nn.Module):
         if sp_axis is None:
             pos = sincos_3d(F, H // p, W // p, cfg.hidden)
         else:
-            n_sh = jax.lax.axis_size(sp_axis)
+            n_sh = _axis_size(sp_axis)
             idx = jax.lax.axis_index(sp_axis)
             pos_full = sincos_3d(F * n_sh, H // p, W // p, cfg.hidden)
             per = pos_full.shape[0] // n_sh

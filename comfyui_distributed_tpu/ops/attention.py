@@ -24,15 +24,21 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from ..utils.jax_compat import axis_size as _axis_size
 
 from ..utils import constants
 
 
 def _pvary(x, axis):
-    """Mark ``x`` axis-varying (jax>=0.9 renamed pvary → pcast)."""
+    """Mark ``x`` axis-varying (jax>=0.9 renamed pvary → pcast). On
+    0.4.x neither exists — there is no varying-manual-axes type system
+    to satisfy (shard_map runs with check_rep off, utils/jax_compat), so
+    the mark is a no-op."""
     if hasattr(jax.lax, "pcast"):
         return jax.lax.pcast(x, axis, to="varying")
-    return jax.lax.pvary(x, axis)
+    if hasattr(jax.lax, "pvary"):
+        return jax.lax.pvary(x, axis)
+    return x
 
 
 def _flash_min_seq() -> int:
@@ -186,7 +192,7 @@ def ring_attention(
     makes ``s`` hops around the ring (``ppermute``), overlapping compute
     with neighbour transfers.
     """
-    n_shards = jax.lax.axis_size(axis)
+    n_shards = _axis_size(axis)
     B, Nq, H, D = q.shape
     scale = 1.0 / (D ** 0.5)
     qf = q.astype(jnp.float32)
@@ -224,7 +230,7 @@ def joint_ring_attention(
     ``q`` may contain any mix of text/image queries — every query attends
     over the full joint sequence exactly.
     """
-    n_shards = jax.lax.axis_size(axis)
+    n_shards = _axis_size(axis)
     B, Nq, H, D = q.shape
     scale = 1.0 / (D ** 0.5)
     qf = q.astype(jnp.float32)
@@ -264,7 +270,7 @@ def ulysses_attention(
     [B, N, H/s, D] (full sequence, head subset), dense local attention,
     all_to_all back. Requires ``H % axis_size == 0``.
     """
-    n_shards = jax.lax.axis_size(axis)
+    n_shards = _axis_size(axis)
     H = q.shape[2]
     if H % n_shards:
         raise ValueError(

@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+from ..utils.jax_compat import axis_size as _axis_size, shard_map
 
 from ..utils import constants
 
@@ -54,7 +55,7 @@ def ring_shift(x: jax.Array, axis: str, shift: int = 1) -> jax.Array:
     Building block for ring attention / ring-overlapped pipelines; compiles
     to ``ppermute`` which XLA maps onto ICI neighbour links.
     """
-    n = jax.lax.axis_size(axis)
+    n = _axis_size(axis)
     perm = [(i, (i + shift) % n) for i in range(n)]
     return jax.lax.ppermute(x, axis, perm)
 

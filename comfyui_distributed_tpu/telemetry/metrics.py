@@ -1,0 +1,117 @@
+"""The framework's standard metric families, declared in one place.
+
+Instrumentation sites import these objects (no stringly-typed lookups on
+the hot path) and guard every use with ``telemetry.enabled()``. Naming
+follows Prometheus conventions: ``cdt_`` prefix, base-unit suffixes
+(``_seconds``, ``_bytes``), counters end in ``_total``.
+
+Label conventions (kept deliberately low-cardinality):
+
+- ``pipeline``: compiled-program family — ``txt2img``, ``img2img``,
+  ``flow_dp``, ``flow_sp``, ``video_dp``, ``video_sp``, ``video_i2v``.
+- ``event`` (tiles): ``seeded`` / ``assigned`` / ``completed`` /
+  ``requeued`` / ``restored`` / ``timed_out``.
+- ``transport``: ``http`` / ``ws``; ``outcome``: ``ok`` / ``error`` (or
+  probe-specific ``online`` / ``offline``, eviction ``evicted`` /
+  ``spared``).
+"""
+
+from __future__ import annotations
+
+from .registry import (BYTES_BUCKETS, COMPILE_BUCKETS, REGISTRY)
+
+# --- diffusion pipelines ----------------------------------------------------
+
+SAMPLER_STEP_SECONDS = REGISTRY.histogram(
+    "cdt_sampler_step_seconds",
+    "Per-step sampler wall-clock (program wall-clock / ladder steps), by "
+    "pipeline. The first observation per program includes its compile — "
+    "cdt_pipeline_compile_seconds carries the split.",
+    ("pipeline",))
+
+PIPELINE_COMPILE_SECONDS = REGISTRY.histogram(
+    "cdt_pipeline_compile_seconds",
+    "First-call wall-clock of a compiled pipeline program (trace + XLA "
+    "compile + first execution), by pipeline.",
+    ("pipeline",), buckets=COMPILE_BUCKETS)
+
+PIPELINE_EXECUTE_SECONDS = REGISTRY.histogram(
+    "cdt_pipeline_execute_seconds",
+    "Steady-state wall-clock of a compiled pipeline program (calls after "
+    "the first), by pipeline.",
+    ("pipeline",))
+
+# --- tile farm --------------------------------------------------------------
+
+TILE_EVENTS = REGISTRY.counter(
+    "cdt_tile_tasks_total",
+    "Tile-farm task lifecycle events.",
+    ("event",))
+
+TILE_QUEUE_DEPTH = REGISTRY.gauge(
+    "cdt_tile_queue_depth",
+    "Pending (unassigned) tile tasks across all live tile jobs.")
+
+TILE_WORKER_EVICTIONS = REGISTRY.counter(
+    "cdt_tile_worker_evictions_total",
+    "Heartbeat-timeout verdicts on tile workers.",
+    ("outcome",))   # evicted | spared
+
+# --- cluster dispatch / probing --------------------------------------------
+
+DISPATCH_SECONDS = REGISTRY.histogram(
+    "cdt_dispatch_seconds",
+    "Prompt dispatch round-trip latency to a worker host.",
+    ("transport", "outcome"))
+
+DISPATCH_PAYLOAD_BYTES = REGISTRY.histogram(
+    "cdt_dispatch_payload_bytes",
+    "Serialized prompt payload size per dispatch.",
+    ("transport",), buckets=BYTES_BUCKETS)
+
+WORKER_PROBES = REGISTRY.counter(
+    "cdt_worker_probe_total",
+    "Worker health-probe outcomes (orchestration fan-out).",
+    ("outcome",))   # online | offline
+
+MEDIA_SYNC_FILES = REGISTRY.counter(
+    "cdt_media_sync_files_total",
+    "Per-file media sync outcomes (master -> remote host).",
+    ("outcome",))   # uploaded | skipped | missing | failed
+
+MEDIA_SYNC_BYTES = REGISTRY.counter(
+    "cdt_media_sync_bytes_total",
+    "Bytes uploaded by media sync.")
+
+# --- prompt queue -----------------------------------------------------------
+
+PROMPTS_TOTAL = REGISTRY.counter(
+    "cdt_prompts_total",
+    "Prompt executions by terminal status.",
+    ("status",))   # success | error | interrupted
+
+PROMPT_SECONDS = REGISTRY.histogram(
+    "cdt_prompt_duration_seconds",
+    "End-to-end graph execution wall-clock per prompt.")
+
+PROMPT_QUEUE_DEPTH = REGISTRY.gauge(
+    "cdt_prompt_queue_depth",
+    "Prompts queued or executing on this controller.")
+
+# --- HTTP control plane -----------------------------------------------------
+
+HTTP_REQUESTS = REGISTRY.counter(
+    "cdt_http_requests_total",
+    "Control-plane requests by route template and status.",
+    ("method", "path", "status"))
+
+# --- worker monitor (standalone watchdog) ----------------------------------
+# NOTE: when the monitor runs as its own OS process (the production
+# launch path, workers/lifecycle.py) this family lives in THAT process
+# and is not scrapable; it surfaces only when monitor_and_run is embedded
+# in a serving process (tests, custom supervisors).
+
+WORKER_MONITOR_CHECKS = REGISTRY.counter(
+    "cdt_worker_monitor_checks_total",
+    "Watchdog verdicts (master_died / worker_exit / signal).",
+    ("outcome",))

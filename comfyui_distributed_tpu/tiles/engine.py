@@ -24,6 +24,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
+from ..utils.jax_compat import shard_map
 
 from ..diffusion.guidance import cfg_denoiser
 from ..diffusion.pipeline import (GenerationSpec, Txt2ImgPipeline,
@@ -188,7 +189,7 @@ class TileUpscaler:
                 weights=weights,
             )
 
-        sharded = jax.shard_map(
+        sharded = shard_map(
             process_shard,
             mesh=mesh,
             in_specs=(P(),
@@ -411,7 +412,7 @@ class TileUpscaler:
                 weights=weights,
             )
 
-        jitted = jax.jit(jax.shard_map(
+        jitted = jax.jit(shard_map(
             process_shard,
             mesh=mesh,
             in_specs=(P(),

@@ -20,6 +20,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
+from ..utils.jax_compat import shard_map
 
 from ..diffusion.pipeline import Txt2ImgPipeline
 from ..ops.blend import composite_tiles, extract_tiles, feather_mask
@@ -44,7 +45,7 @@ def _build_fn(mesh: Mesh, model, config, in_shape, tile: int, padding: int,
     total = B * grid.num_tiles
     padded = pad_count_to(total, n_shards)
 
-    sharded = jax.shard_map(
+    sharded = shard_map(
         lambda params, tiles: model.apply(params, tiles),
         mesh=mesh,
         in_specs=(P(), P(axis, None, None, None)),

@@ -1,0 +1,54 @@
+"""jax version-compatibility shims.
+
+jax promoted ``shard_map`` into the top-level namespace (0.5+); 0.4.x
+only ships ``jax.experimental.shard_map``, and its replication-check
+kwarg is spelled ``check_rep`` instead of ``check_vma``. Every in-repo
+shard_map call imports the symbol from here so one build runs on both
+lines — the baked container image carries 0.4.37.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+import jax
+
+try:
+    _shard_map = jax.shard_map
+except AttributeError:                      # jax < 0.5
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+_CHECK_KW = ("check_vma"
+             if "check_vma" in inspect.signature(_shard_map).parameters
+             else "check_rep")
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None, **kw):
+    """``jax.shard_map`` with the replication-check kwarg normalized.
+
+    ``check_vma=None`` means "library default" — except on 0.4.x, where
+    the check is force-disabled: its scan-under-shard_map replication
+    inference has a known false positive ("Scan carry input and output
+    got mismatched replication types"), and jax's own error message
+    prescribes exactly this workaround. On 0.5+ the default check stays
+    on.
+    """
+    if check_vma is None and _CHECK_KW == "check_rep":
+        check_vma = False
+    if check_vma is not None:
+        kw[_CHECK_KW] = check_vma
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **kw)
+
+
+def axis_size(axis_name) -> int:
+    """``jax.lax.axis_size`` polyfill: 0.4.x lacks it; ``psum`` of a unit
+    literal is the classic equivalent (special-cased to constant-fold to
+    the mapped axis size)."""
+    try:
+        return jax.lax.axis_size(axis_name)
+    except AttributeError:                  # jax < 0.6
+        return jax.lax.psum(1, axis_name)
+
+
+__all__ = ["axis_size", "shard_map"]

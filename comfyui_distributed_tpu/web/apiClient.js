@@ -112,6 +112,8 @@ export const api = {
   // observability
   memoryStats: () => request("/distributed/memory_stats"),
   stepTimes: () => request("/distributed/step_times"),
+  metrics: () => request("/distributed/metrics.json", { retries: 0 }),
+  trace: (jobId) => request(`/distributed/trace/${encodeURIComponent(jobId)}`, { retries: 0 }),
   progress: (promptId) => request(`/distributed/progress/${encodeURIComponent(promptId)}`, { retries: 0 }),
   previewUrl: (promptId, shard = 0) => `/distributed/preview/${encodeURIComponent(promptId)}?shard=${shard}&t=${Date.now()}`,
   profileStart: (out) => request("/distributed/profile/start", { method: "POST", body: out ? { out } : {}, retries: 0 }),

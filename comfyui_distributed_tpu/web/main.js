@@ -9,6 +9,7 @@ import { distributedValueNodes, hostsWithConfigIndex, workerKey, parseWorkerValu
          valueType, setWorkerValue, serializeWorkerValues, orphanedKeys } from "./valueWidgets.js";
 import { newPollState, pollTick } from "./progressLogic.js";
 import { graphSvgFromText } from "./graphView.js";
+import { telemetryRows } from "./telemetryLogic.js";
 
 const POLL_MS = 3000;
 const LOG_REFRESH_MS = 2000;
@@ -227,6 +228,28 @@ async function renderMesh() {
     }
   } catch (e) {
     root.textContent = "system info unavailable: " + e.message;
+  }
+}
+
+// ---------------------------------------------------------------------------
+// telemetry panel (/distributed/metrics.json — docs/telemetry.md)
+// ---------------------------------------------------------------------------
+
+async function renderTelemetry() {
+  const root = $("telemetry-info");
+  let rows;
+  try {
+    const res = await api.metrics();
+    rows = telemetryRows((res && res.metrics) || {});
+  } catch (e) {
+    root.textContent = "telemetry unavailable: " + e.message;
+    return;
+  }
+  root.replaceChildren();
+  for (const [k, v] of rows) {
+    const kd = document.createElement("div"); kd.className = "k"; kd.textContent = k;
+    const vd = document.createElement("div"); vd.textContent = v;
+    root.append(kd, vd);
   }
 }
 
@@ -758,8 +781,10 @@ async function init() {
   await refreshManaged();
   await refreshTunnel();
   await pollStatus();
+  await renderTelemetry();
   setInterval(pollStatus, POLL_MS);
   setInterval(refreshTunnel, POLL_MS * 4);
+  setInterval(renderTelemetry, POLL_MS * 2);
 }
 
 init();

@@ -1,0 +1,108 @@
+// Pure transforms of the /distributed/metrics.json snapshot for the
+// dashboard's telemetry panel (node:test-covered in
+// tests/telemetryLogic.test.mjs; main.js only renders the rows).
+//
+// The snapshot shape is telemetry/export.py's render_json():
+//   { format: "cdt.metrics.v1", metrics: { name: {type, series: [...]}} }
+// counters/gauges carry {labels, value}; histograms carry
+// {labels, buckets: [[le, cumulative], ...], sum, count}.
+
+function matches(labels, filter) {
+  if (!filter) return true;
+  return Object.entries(filter).every(([k, v]) => labels[k] === v);
+}
+
+// Sum a counter/gauge family's series, optionally filtered by labels.
+export function seriesSum(metrics, name, labelFilter = null) {
+  const fam = metrics && metrics[name];
+  let total = 0;
+  for (const s of (fam && fam.series) || []) {
+    if (matches(s.labels || {}, labelFilter)) total += s.value || 0;
+  }
+  return total;
+}
+
+// Per-label-value totals of a counter family: { labelValue: sum }.
+export function countsByLabel(metrics, name, label) {
+  const fam = metrics && metrics[name];
+  const out = {};
+  for (const s of (fam && fam.series) || []) {
+    const key = (s.labels || {})[label] ?? "";
+    out[key] = (out[key] || 0) + (s.value || 0);
+  }
+  return out;
+}
+
+// Merge a histogram family's (optionally filtered) series into one
+// {buckets, sum, count} — bucket bounds are fixed per family, so the
+// cumulative counts add bucket-for-bucket.
+export function mergeHistogram(metrics, name, labelFilter = null) {
+  const fam = metrics && metrics[name];
+  let merged = null;
+  for (const s of (fam && fam.series) || []) {
+    if (!matches(s.labels || {}, labelFilter)) continue;
+    if (!merged) {
+      merged = {
+        buckets: s.buckets.map(([le, c]) => [le, c]),
+        sum: s.sum,
+        count: s.count,
+      };
+    } else {
+      s.buckets.forEach(([, c], i) => { merged.buckets[i][1] += c; });
+      merged.sum += s.sum;
+      merged.count += s.count;
+    }
+  }
+  return merged;
+}
+
+// q ∈ (0,1] → upper-bound estimate from cumulative buckets; null when the
+// histogram is empty, Infinity when the quantile lands past the last
+// finite bucket.
+export function histQuantile(hist, q) {
+  if (!hist || !hist.count) return null;
+  const target = q * hist.count;
+  for (const [le, cum] of hist.buckets) {
+    if (cum >= target) return le;
+  }
+  return Infinity;
+}
+
+export function fmtSeconds(s) {
+  if (s === null || s === undefined) return "—";
+  if (s === Infinity) return ">max";
+  if (s < 0.001) return `${(s * 1e6).toFixed(0)}µs`;
+  if (s < 1) return `${(s * 1e3).toFixed(1)}ms`;
+  return `${s.toFixed(2)}s`;
+}
+
+function fmtCounts(byLabel) {
+  const parts = Object.entries(byLabel)
+    .filter(([, v]) => v > 0)
+    .map(([k, v]) => `${v} ${k}`);
+  return parts.length ? parts.join(" · ") : "none";
+}
+
+// The panel's [label, value] rows, assembled from the standard families
+// (telemetry/metrics.py). Tolerant of absent families — an older
+// controller simply shows fewer rows.
+export function telemetryRows(metrics) {
+  const rows = [];
+  rows.push(["Prompts", fmtCounts(
+    countsByLabel(metrics, "cdt_prompts_total", "status"))]);
+  const step = mergeHistogram(metrics, "cdt_sampler_step_seconds");
+  rows.push(["Sampler step p50 / p95", step
+    ? `${fmtSeconds(histQuantile(step, 0.5))} / ${fmtSeconds(histQuantile(step, 0.95))} (${step.count} obs)`
+    : "no runs yet"]);
+  rows.push(["Tile tasks", fmtCounts(
+    countsByLabel(metrics, "cdt_tile_tasks_total", "event"))]);
+  rows.push(["Tile queue depth",
+    String(seriesSum(metrics, "cdt_tile_queue_depth"))]);
+  const disp = mergeHistogram(metrics, "cdt_dispatch_seconds");
+  rows.push(["Dispatches", disp && disp.count
+    ? `${disp.count} · p95 ${fmtSeconds(histQuantile(disp, 0.95))}`
+    : "none"]);
+  rows.push(["Worker probes", fmtCounts(
+    countsByLabel(metrics, "cdt_worker_probe_total", "outcome"))]);
+  return rows;
+}

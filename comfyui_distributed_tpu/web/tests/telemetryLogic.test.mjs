@@ -1,0 +1,85 @@
+// node:test suite for the telemetry panel's pure transforms
+// (telemetryLogic.js) over the /distributed/metrics.json snapshot shape.
+import assert from "node:assert/strict";
+import { test } from "node:test";
+
+import { countsByLabel, fmtSeconds, histQuantile, mergeHistogram,
+         seriesSum, telemetryRows } from "../telemetryLogic.js";
+
+const METRICS = {
+  cdt_prompts_total: {
+    type: "counter",
+    series: [
+      { labels: { status: "success" }, value: 3 },
+      { labels: { status: "error" }, value: 1 },
+    ],
+  },
+  cdt_tile_queue_depth: {
+    type: "gauge",
+    series: [{ labels: {}, value: 5 }],
+  },
+  cdt_sampler_step_seconds: {
+    type: "histogram",
+    series: [
+      { labels: { pipeline: "txt2img" },
+        buckets: [[0.01, 0], [0.1, 8], [1.0, 10]], sum: 1.2, count: 10 },
+      { labels: { pipeline: "flow_dp" },
+        buckets: [[0.01, 0], [0.1, 0], [1.0, 2]], sum: 1.0, count: 2 },
+    ],
+  },
+};
+
+test("seriesSum totals and filters by labels", () => {
+  assert.equal(seriesSum(METRICS, "cdt_prompts_total"), 4);
+  assert.equal(seriesSum(METRICS, "cdt_prompts_total",
+                         { status: "error" }), 1);
+  assert.equal(seriesSum(METRICS, "cdt_tile_queue_depth"), 5);
+  assert.equal(seriesSum(METRICS, "nope"), 0);
+});
+
+test("countsByLabel buckets a counter family per label value", () => {
+  assert.deepEqual(countsByLabel(METRICS, "cdt_prompts_total", "status"),
+                   { success: 3, error: 1 });
+  assert.deepEqual(countsByLabel(METRICS, "nope", "status"), {});
+});
+
+test("mergeHistogram adds cumulative counts bucket-for-bucket", () => {
+  const m = mergeHistogram(METRICS, "cdt_sampler_step_seconds");
+  assert.equal(m.count, 12);
+  assert.deepEqual(m.buckets, [[0.01, 0], [0.1, 8], [1.0, 12]]);
+  const only = mergeHistogram(METRICS, "cdt_sampler_step_seconds",
+                              { pipeline: "flow_dp" });
+  assert.equal(only.count, 2);
+  assert.equal(mergeHistogram(METRICS, "nope"), null);
+});
+
+test("histQuantile reads the cumulative buckets", () => {
+  const m = mergeHistogram(METRICS, "cdt_sampler_step_seconds");
+  assert.equal(histQuantile(m, 0.5), 0.1);    // 6th of 12 lands in ≤0.1
+  assert.equal(histQuantile(m, 0.99), 1.0);
+  assert.equal(histQuantile(null, 0.5), null);
+  assert.equal(histQuantile({ count: 0, buckets: [] }, 0.5), null);
+  // past the last finite bucket → Infinity (rendered ">max")
+  assert.equal(histQuantile({ count: 2, buckets: [[0.1, 0]] }, 0.9),
+               Infinity);
+});
+
+test("fmtSeconds picks a sane unit", () => {
+  assert.equal(fmtSeconds(0.0000005), "1µs");
+  assert.equal(fmtSeconds(0.0123), "12.3ms");
+  assert.equal(fmtSeconds(2.5), "2.50s");
+  assert.equal(fmtSeconds(null), "—");
+  assert.equal(fmtSeconds(Infinity), ">max");
+});
+
+test("telemetryRows tolerates absent families and renders the rest", () => {
+  const rows = telemetryRows(METRICS);
+  const byKey = Object.fromEntries(rows);
+  assert.match(byKey["Prompts"], /3 success/);
+  assert.match(byKey["Sampler step p50 / p95"], /12 obs/);
+  assert.equal(byKey["Tile tasks"], "none");
+  assert.equal(byKey["Tile queue depth"], "5");
+  assert.equal(byKey["Dispatches"], "none");
+  // an empty snapshot still renders every row
+  assert.equal(telemetryRows({}).length, rows.length);
+});

@@ -20,6 +20,21 @@ import time
 
 POLL_INTERVAL = float(os.environ.get("CDT_MONITOR_POLL", "2.0"))
 
+# Telemetry is OPTIONAL here: the monitor must keep working when run from
+# a bare file path with no package on sys.path (its standalone contract).
+# The telemetry core is stdlib-only, so when the package IS importable
+# this costs nothing extra.
+try:
+    from comfyui_distributed_tpu.telemetry import (enabled as _tm_enabled,
+                                                   metrics as _tm)
+except Exception:  # pragma: no cover — bare-file execution
+    _tm = None
+
+
+def _count(outcome: str) -> None:
+    if _tm is not None and _tm_enabled():
+        _tm.WORKER_MONITOR_CHECKS.labels(outcome=outcome).inc()
+
 
 def _alive(pid: int) -> bool:
     if pid <= 0:
@@ -66,6 +81,7 @@ def monitor_and_run(argv: list[str]) -> int:
             pass
 
     def on_signal(signum, frame):
+        _count("signal")
         _kill_worker(proc)
         sys.exit(128 + signum)
 
@@ -75,8 +91,10 @@ def monitor_and_run(argv: list[str]) -> int:
     while True:
         code = proc.poll()
         if code is not None:
+            _count("worker_exit")
             return code
         if master_pid and not _alive(master_pid):
+            _count("master_died")
             print(f"[worker_monitor] master {master_pid} died; stopping worker",
                   file=sys.stderr)
             _kill_worker(proc)

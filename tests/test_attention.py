@@ -6,6 +6,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import PartitionSpec as P
+from comfyui_distributed_tpu.utils.jax_compat import shard_map
 
 from comfyui_distributed_tpu.ops.attention import (
     full_attention,
@@ -43,7 +44,7 @@ def test_ring_attention_exact(n_shards):
     q, k, v = qkv()
     want = np.asarray(dense_reference(q, k, v))
 
-    f = jax.jit(jax.shard_map(
+    f = jax.jit(shard_map(
         lambda a, b, c: ring_attention(a, b, c, "sp"),
         mesh=mesh,
         in_specs=(P(None, "sp", None, None),) * 3,
@@ -59,7 +60,7 @@ def test_ulysses_attention_exact(n_shards):
     q, k, v = qkv()
     want = np.asarray(dense_reference(q, k, v))
 
-    f = jax.jit(jax.shard_map(
+    f = jax.jit(shard_map(
         lambda a, b, c: ulysses_attention(a, b, c, "sp"),
         mesh=mesh,
         in_specs=(P(None, "sp", None, None),) * 3,
@@ -75,7 +76,7 @@ def test_ring_attention_long_sequence_stability():
     q, k, v = qkv(B=1, N=64, H=4, D=8, seed=3)
     q = q * 30.0  # extreme logits
     want = np.asarray(dense_reference(q, k, v))
-    f = jax.jit(jax.shard_map(
+    f = jax.jit(shard_map(
         lambda a, b, c: ring_attention(a, b, c, "sp"),
         mesh=mesh,
         in_specs=(P(None, "sp", None, None),) * 3,
@@ -95,7 +96,7 @@ def test_ring_attention_subblocked_exact(monkeypatch, blk):
     mesh = build_mesh({"sp": 2})
     q, k, v = qkv()            # 16-length shards → 4 (or 2) sub-blocks
     want = np.asarray(dense_reference(q, k, v))
-    f = jax.jit(jax.shard_map(
+    f = jax.jit(shard_map(
         lambda a, b, c: ring_attention(a, b, c, "sp"),
         mesh=mesh,
         in_specs=(P(None, "sp", None, None),) * 3,
@@ -113,7 +114,7 @@ def test_ring_attention_subblock_indivisible_tail(monkeypatch):
     mesh = build_mesh({"sp": 2})
     q, k, v = qkv()
     want = np.asarray(dense_reference(q, k, v))
-    f = jax.jit(jax.shard_map(
+    f = jax.jit(shard_map(
         lambda a, b, c: ring_attention(a, b, c, "sp"),
         mesh=mesh,
         in_specs=(P(None, "sp", None, None),) * 3,

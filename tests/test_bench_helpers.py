@@ -137,6 +137,34 @@ class TestWorkloadsRunOnCpu:
         assert choices == set(bench._WORKLOADS)
 
 
+class TestFailFast:
+    """BENCH_r05 rc=124 root cause: the watchdog re-ran a deterministic
+    backend-init crash for the whole 2400 s budget, then timed out with no
+    JSON line. Repeated identical failures are now terminal, and the CPU
+    fallback is capped at tiny scale."""
+
+    def test_identical_consecutive_failures_are_terminal(self):
+        assert not bench._is_terminal_failure([])
+        assert not bench._is_terminal_failure(["RuntimeError: init"])
+        assert not bench._is_terminal_failure(
+            ["RuntimeError: a", "RuntimeError: b"])   # flake, keep trying
+        assert bench._is_terminal_failure(
+            ["RuntimeError: init", "RuntimeError: init"])
+        assert bench._is_terminal_failure(
+            ["timeout", "RuntimeError: init", "RuntimeError: init"])
+        # empty tails (no stderr) never match — nothing to compare
+        assert not bench._is_terminal_failure(["", ""])
+        # watchdog timeouts carry a constant message by construction — a
+        # hung tunnel is transient flake, never terminal
+        assert not bench._is_terminal_failure(
+            ["attempt timed out after 300s", "attempt timed out after 300s"])
+
+    def test_cpu_fallback_is_tiny_capped(self):
+        assert bench._cap_cpu_fallback(30, None) == (4, 2)
+        assert bench._cap_cpu_fallback(30, 5) == (4, 2)
+        assert bench._cap_cpu_fallback(2, 1) == (2, 1)
+
+
 class TestCompileCache:
     def test_enable_and_disable(self, tmp_path, monkeypatch):
         from comfyui_distributed_tpu.utils.compile_cache import \

@@ -560,6 +560,21 @@ class TestLayoutDetection:
         with pytest.raises(ConversionError):
             detect_layout({"bogus": 0})
 
+    def test_diffusers_repacks_raise_named_errors(self):
+        """Both diffusers repacks use transformer_blocks.*; only FLUX has
+        the single_transformer_blocks.* tail — each must name ITS
+        single-file layout in the error."""
+        with pytest.raises(ConversionError, match="FLUX.*double_blocks"):
+            detect_layout({
+                "transformer_blocks.0.attn.add_q_proj.weight": 0,
+                "single_transformer_blocks.0.attn.to_q.weight": 0,
+            })
+        with pytest.raises(ConversionError, match="SD3.*joint_blocks"):
+            detect_layout({
+                "transformer_blocks.0.attn.add_q_proj.weight": 0,
+                "transformer_blocks.0.norm1_context.linear.weight": 0,
+            })
+
 
 class TestSD15SingleFile:
     def test_sd15_layout_converts(self, tmp_path):

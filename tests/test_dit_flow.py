@@ -159,6 +159,88 @@ def test_flow_sp_matches_single_chip():
     np.testing.assert_allclose(sp_out, single, rtol=2e-4, atol=2e-4)
 
 
+class TestFlowTrueCfg:
+    """spec.cfg != 1.0 (SD3-family true CFG): uncond conditioning threads
+    through generate/generate_sp, and missing it fails LOUDLY instead of
+    silently sampling unguided (the r05 dead-plumbing fix)."""
+
+    def test_missing_uncond_raises(self, flow_stack):
+        mesh = build_mesh({"dp": 2})
+        spec = FlowSpec(height=16, width=16, steps=2, shift=1.0, cfg=4.0)
+        ctx, pooled = _cond(flow_stack.dit.config)
+        with pytest.raises(ValueError, match="negative conditioning"):
+            flow_stack.generate(mesh, spec, seed=0, context=ctx,
+                                pooled=pooled)
+        with pytest.raises(ValueError, match="negative conditioning"):
+            flow_stack.generate_sp(build_mesh({"sp": 2}), spec, seed=0,
+                                   context=ctx, pooled=pooled)
+
+    def test_cfg_changes_the_sample(self):
+        # random DiT init zero-inits the modulation/output projections, so
+        # the context path is numerically dead — perturb every leaf to
+        # give the conditioning real influence before testing guidance
+        cfg = DiTConfig.tiny(attn_backend="dense")
+        model, params = init_dit(cfg, jax.random.key(0), sample_hw=(8, 8),
+                                 context_len=6)
+        leaves, treedef = jax.tree_util.tree_flatten(params)
+        keys = jax.random.split(jax.random.key(9), len(leaves))
+        params = jax.tree_util.tree_unflatten(treedef, [
+            l + 0.05 * jax.random.normal(k, l.shape, l.dtype)
+            for l, k in zip(leaves, keys)])
+        vae = AutoencoderKL(VAEConfig.tiny(dtype="float32")).init(
+            jax.random.key(1), image_hw=(16, 16))
+        pipe = FlowPipeline(model, params, vae)
+        mesh = build_mesh({"dp": 2})
+        ctx, pooled = _cond(cfg)
+        unc = jnp.zeros_like(ctx)
+        base = FlowSpec(height=16, width=16, steps=2, shift=1.0)
+        plain = np.asarray(pipe.generate(
+            mesh, base, seed=3, context=ctx, pooled=pooled))
+        guided = np.asarray(pipe.generate(
+            mesh, FlowSpec(height=16, width=16, steps=2, shift=1.0,
+                           cfg=4.0),
+            seed=3, context=ctx, pooled=pooled,
+            uncond_context=unc, uncond_pooled=jnp.zeros_like(pooled)))
+        assert guided.shape == plain.shape
+        assert not np.allclose(guided, plain)
+        # cfg with uncond == cond degenerates to the plain sample:
+        # out = uncond + s·(cond − uncond) = cond
+        degen = np.asarray(pipe.generate(
+            mesh, FlowSpec(height=16, width=16, steps=2, shift=1.0,
+                           cfg=4.0),
+            seed=3, context=ctx, pooled=pooled,
+            uncond_context=ctx, uncond_pooled=pooled))
+        np.testing.assert_allclose(degen, plain, rtol=1e-5, atol=1e-5)
+
+    def test_sp_cfg_matches_single_chip(self):
+        cfg = DiTConfig(patch_size=2, in_channels=4, hidden=64,
+                        depth_double=2, depth_single=2, heads=4,
+                        context_dim=32, pooled_dim=16, dtype="float32")
+        model, params = init_dit(cfg, jax.random.key(0),
+                                 sample_hw=(16, 16), context_len=6)
+        vae = AutoencoderKL(VAEConfig.tiny(dtype="float32")).init(
+            jax.random.key(1), image_hw=(32, 32))
+        pipe = FlowPipeline(model, params, vae)
+        ctx, pooled = _cond(cfg)
+        unc = jnp.zeros_like(ctx)
+        spec = FlowSpec(height=32, width=32, steps=2, shift=1.0, cfg=3.0)
+        sp_out = np.asarray(pipe.generate_sp(
+            build_mesh({"sp": 4}), spec, seed=7, context=ctx,
+            pooled=pooled, uncond_context=unc))
+        single = np.asarray(pipe.generate_sp(
+            build_mesh({"sp": 1}), spec, seed=7, context=ctx,
+            pooled=pooled, uncond_context=unc))
+        np.testing.assert_allclose(sp_out, single, rtol=2e-4, atol=2e-4)
+
+    def test_offload_and_tp_reject_cfg(self, flow_stack):
+        spec = FlowSpec(height=16, width=16, steps=2, cfg=2.0)
+        ctx, pooled = _cond(flow_stack.dit.config)
+        with pytest.raises(ValueError, match="not wired"):
+            flow_stack.generate_offloaded(spec, 0, ctx, pooled)
+        with pytest.raises(ValueError, match="not wired"):
+            flow_stack.generate_tp_fn(build_mesh({"dp": 4, "tp": 2}), spec)
+
+
 def test_flow_sp_rejects_indivisible():
     cfg = DiTConfig.tiny()
     model, params = init_dit(cfg, jax.random.key(0), sample_hw=(8, 8),

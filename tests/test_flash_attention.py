@@ -3,6 +3,7 @@ numerics checked against dense attention)."""
 
 import jax
 import jax.numpy as jnp
+from comfyui_distributed_tpu.utils.jax_compat import shard_map
 import numpy as np
 import pytest
 
@@ -97,7 +98,7 @@ class TestShardMap:
         def per_shard(q, k, v):
             return flash_attention(q, k, v, interpret=True)
 
-        f = jax.jit(jax.shard_map(
+        f = jax.jit(shard_map(
             per_shard, mesh=mesh,
             in_specs=(P("dp"), P("dp"), P("dp")),
             out_specs=P("dp")))

@@ -33,6 +33,7 @@ CHILD = textwrap.dedent("""
     jax.config.update("jax_cpu_collectives_implementation", "gloo")
     sys.path.insert(0, os.environ["CDT_REPO"])
     from comfyui_distributed_tpu.parallel.bootstrap import init_multihost
+    from comfyui_distributed_tpu.utils.jax_compat import shard_map
 
     # no initialize_fn injection: the real jax.distributed.initialize,
     # config entirely from CDT_COORDINATOR/CDT_NUM_HOSTS/CDT_HOST_INDEX
@@ -60,7 +61,7 @@ CHILD = textwrap.dedent("""
 
     @jax.jit
     def total(x):
-        return jax.shard_map(
+        return shard_map(
             lambda s: jax.lax.psum(s, "dp"),
             mesh=mesh, in_specs=P("dp"), out_specs=P(),
         )(x)

@@ -6,6 +6,7 @@ import numpy as np
 import pytest
 from jax.sharding import PartitionSpec as P
 
+from comfyui_distributed_tpu.utils.jax_compat import shard_map
 from comfyui_distributed_tpu.parallel import (
     MeshSpec,
     build_mesh,
@@ -81,7 +82,7 @@ def test_participant_keys_match_in_and_out_of_mesh():
         k = participant_key(base, "dp")
         return jax.random.bits(k, (1, 4))
 
-    f = jax.shard_map(
+    f = shard_map(
         inner, mesh=m, in_specs=(P("dp", None),), out_specs=P("dp", None)
     )
     sharded_bits = f(jnp.zeros((8, 1)))
@@ -107,7 +108,7 @@ def test_gather_batch_order():
         return collectives.gather_batch(x + i.astype(x.dtype))
 
     f = jax.jit(
-        jax.shard_map(
+        shard_map(
             inner, mesh=m, in_specs=(P("dp", None),), out_specs=P(None, None),
             check_vma=False,
         )
@@ -126,7 +127,7 @@ def test_ring_shift():
         shifted = collectives.ring_shift(x + i, "dp", shift=1)
         return shifted
 
-    f = jax.jit(jax.shard_map(inner, mesh=m, in_specs=(P("dp", None),), out_specs=P("dp", None)))
+    f = jax.jit(shard_map(inner, mesh=m, in_specs=(P("dp", None),), out_specs=P("dp", None)))
     out = np.asarray(f(jnp.zeros((8, 1))))
     # shard i holds value of shard i-1 (ring)
     expected = (np.arange(8) - 1) % 8

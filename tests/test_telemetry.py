@@ -1,0 +1,399 @@
+"""Telemetry subsystem: registry semantics, span stitching, exporters,
+tile-farm lifecycle counters, and the route-level master→worker trace
+stitch over real HTTP. Model-compiling coverage (sampler histograms) lives
+in tests/test_telemetry_integration.py (slow tier)."""
+
+import asyncio
+import re
+import threading
+
+import numpy as np
+import pytest
+
+from comfyui_distributed_tpu import telemetry
+from comfyui_distributed_tpu.telemetry import registry as registry_mod
+from comfyui_distributed_tpu.telemetry.export import (render_json,
+                                                      render_prometheus)
+from comfyui_distributed_tpu.telemetry.registry import MetricRegistry
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+@pytest.fixture
+def fresh_telemetry():
+    """Clean process-global registry/span store, telemetry forced on."""
+    was = telemetry.enabled()
+    telemetry.set_enabled(True)
+    telemetry.REGISTRY.reset()
+    telemetry.SPAN_STORE.reset()
+    yield
+    telemetry.REGISTRY.reset()
+    telemetry.SPAN_STORE.reset()
+    telemetry.set_enabled(was)
+
+
+class TestRegistry:
+    def test_counter_labels_and_totals(self, fresh_telemetry):
+        reg = MetricRegistry()
+        c = reg.counter("t_total", "help", ("event",))
+        c.labels(event="a").inc()
+        c.labels(event="a").inc(2)
+        c.labels(event="b").inc()
+        snap = reg.snapshot()["t_total"]
+        by = {s["labels"]["event"]: s["value"] for s in snap["series"]}
+        assert by == {"a": 3.0, "b": 1.0}
+
+    def test_label_set_is_frozen(self):
+        reg = MetricRegistry()
+        c = reg.counter("t_total", "", ("event",))
+        with pytest.raises(ValueError):
+            c.labels(wrong="x")
+        with pytest.raises(ValueError):
+            c.labels()          # missing the declared label
+        with pytest.raises(ValueError):
+            c.inc()             # label-less convenience needs no labels
+
+    def test_redeclaration_is_idempotent_but_type_checked(self):
+        reg = MetricRegistry()
+        a = reg.counter("t_total", "", ("x",))
+        assert reg.counter("t_total", "", ("x",)) is a
+        with pytest.raises(ValueError):
+            reg.gauge("t_total", "", ("x",))
+        with pytest.raises(ValueError):
+            reg.counter("t_total", "", ("y",))
+
+    def test_counters_only_go_up(self):
+        reg = MetricRegistry()
+        with pytest.raises(ValueError):
+            reg.counter("t_total").inc(-1)
+        g = reg.gauge("t_gauge")
+        g.set(5)
+        g.dec(2)
+        assert reg.snapshot()["t_gauge"]["series"][0]["value"] == 3.0
+
+    def test_histogram_bucket_placement(self):
+        reg = MetricRegistry()
+        h = reg.histogram("t_seconds", "", buckets=(0.1, 1.0, 10.0))
+        for v in (0.05, 0.1, 0.5, 5.0, 100.0):
+            h.observe(v)
+        s = reg.snapshot()["t_seconds"]["series"][0]
+        # cumulative: ≤0.1 holds 0.05 and the boundary value 0.1
+        assert s["buckets"] == [[0.1, 2], [1.0, 3], [10.0, 4]]
+        assert s["count"] == 5
+        assert s["sum"] == pytest.approx(105.65)
+
+    def test_concurrent_increments_are_exact(self):
+        reg = MetricRegistry()
+        c = reg.counter("t_total", "", ("who",))
+        h = reg.histogram("t_seconds")
+
+        def work(i):
+            child = c.labels(who=str(i % 2))
+            for _ in range(500):
+                child.inc()
+                h.observe(0.01)
+
+        threads = [threading.Thread(target=work, args=(i,))
+                   for i in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        snap = reg.snapshot()
+        total = sum(s["value"] for s in snap["t_total"]["series"])
+        assert total == 8 * 500
+        assert snap["t_seconds"]["series"][0]["count"] == 8 * 500
+
+    def test_cardinality_cap_collapses_to_overflow(self):
+        reg = MetricRegistry()
+        c = reg.counter("t_total", "", ("id",))
+        n = registry_mod.MAX_SERIES + 50
+        for i in range(n):
+            c.labels(id=f"runaway-{i}").inc()
+        snap = reg.snapshot()["t_total"]
+        # bounded: the cap plus the single overflow series
+        assert len(snap["series"]) <= registry_mod.MAX_SERIES + 1
+        overflow = [s for s in snap["series"]
+                    if s["labels"]["id"] == registry_mod._OVERFLOW]
+        assert overflow and overflow[0]["value"] >= 50
+        dropped = reg.snapshot()["cdt_telemetry_series_dropped_total"]
+        assert dropped["series"][0]["value"] >= 50
+
+    def test_disabled_is_a_noop(self, fresh_telemetry):
+        reg = MetricRegistry()
+        c = reg.counter("t_total")
+        h = reg.histogram("t_seconds")
+        telemetry.set_enabled(False)
+        c.inc()
+        h.observe(1.0)
+        with telemetry.span("never") as s:
+            assert s is None
+        assert telemetry.trace_headers() == {}
+        telemetry.set_enabled(True)
+        snap = reg.snapshot()
+        assert snap["t_total"]["series"][0]["value"] == 0.0
+        assert snap["t_seconds"]["series"][0]["count"] == 0
+        assert telemetry.SPAN_STORE.spans("anything") == []
+
+
+class TestSpans:
+    def test_nesting_and_tree(self, fresh_telemetry):
+        with telemetry.span("outer", trace_id="tr1", job_id="j1"):
+            with telemetry.span("inner"):
+                pass
+        spans = telemetry.SPAN_STORE.spans("tr1")
+        by_name = {s["name"]: s for s in spans}
+        assert by_name["inner"]["parent_id"] == by_name["outer"]["span_id"]
+        tree = telemetry.SPAN_STORE.tree("tr1")
+        assert tree[0]["name"] == "outer"
+        assert tree[0]["children"][0]["name"] == "inner"
+        assert telemetry.SPAN_STORE.resolve("j1") == "tr1"
+
+    def test_durations_and_error_recording(self, fresh_telemetry):
+        with pytest.raises(RuntimeError):
+            with telemetry.span("boom", trace_id="tr2"):
+                raise RuntimeError("bad")
+        (s,) = telemetry.SPAN_STORE.spans("tr2")
+        assert s["duration_s"] >= 0
+        assert "RuntimeError" in s["error"]
+        # the duration also landed in the span histogram
+        snap = telemetry.REGISTRY.snapshot()["cdt_span_seconds"]
+        assert any(x["labels"]["name"] == "boom" and x["count"] == 1
+                   for x in snap["series"])
+
+    def test_header_round_trip_stitches_parent(self, fresh_telemetry):
+        with telemetry.span("dispatch", trace_id="trX") as (tid, sid):
+            hdr = telemetry.trace_headers()[telemetry.TRACE_HEADER]
+        parsed = telemetry.parse_trace_header(hdr)
+        assert parsed == ("trX", sid)
+        with telemetry.use_trace(*parsed):
+            with telemetry.span("remote.execute"):
+                pass
+        remote = [s for s in telemetry.SPAN_STORE.spans("trX")
+                  if s["name"] == "remote.execute"]
+        assert remote and remote[0]["parent_id"] == sid
+
+    @pytest.mark.parametrize("bad", ["", None, 17, ":", "x" * 300])
+    def test_parse_trace_header_rejects_garbage(self, bad):
+        assert telemetry.parse_trace_header(bad) is None
+
+    def test_store_is_bounded(self, fresh_telemetry):
+        store = telemetry.SPAN_STORE
+        for i in range(store.max_traces + 20):
+            with telemetry.span("s", trace_id=f"tr-{i}", job_id=f"jb-{i}"):
+                pass
+        with store._lock:
+            assert len(store._traces) <= store.max_traces
+        # evicted traces lose their job-id index too
+        assert store.resolve("jb-0") is None
+        assert store.resolve(f"jb-{store.max_traces + 19}") is not None
+
+
+class TestExporters:
+    def test_prometheus_round_trip(self, fresh_telemetry):
+        reg = MetricRegistry()
+        reg.counter("a_total", "with \"quotes\"", ("k",)).labels(
+            k='va"l\\ue').inc(2)
+        reg.gauge("b_depth").set(7)
+        h = reg.histogram("c_seconds", "", buckets=(0.1, 1.0))
+        h.observe(0.05)
+        h.observe(5.0)
+        text = render_prometheus(reg.snapshot())
+        assert '# TYPE a_total counter' in text
+        assert 'a_total{k="va\\"l\\\\ue"} 2' in text
+        assert "b_depth 7" in text
+        assert 'c_seconds_bucket{le="0.1"} 1' in text
+        assert 'c_seconds_bucket{le="+Inf"} 2' in text
+        assert "c_seconds_sum 5.05" in text
+        assert "c_seconds_count 2" in text
+        # every non-comment line is a valid exposition sample
+        sample = re.compile(r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{.*\})? \S+$')
+        for line in text.strip().splitlines():
+            if not line.startswith("#"):
+                assert sample.match(line), line
+
+    def test_json_form(self, fresh_telemetry):
+        reg = MetricRegistry()
+        reg.counter("a_total").inc()
+        doc = render_json(reg.snapshot())
+        assert doc["format"] == "cdt.metrics.v1"
+        assert doc["metrics"]["a_total"]["series"][0]["value"] == 1.0
+
+
+class TestTileLifecycleCounters:
+    def _counts(self):
+        snap = telemetry.REGISTRY.snapshot()["cdt_tile_tasks_total"]
+        return {s["labels"]["event"]: s["value"] for s in snap["series"]}
+
+    def _depth(self):
+        snap = telemetry.REGISTRY.snapshot()["cdt_tile_queue_depth"]
+        return snap["series"][0]["value"]
+
+    def test_store_lifecycle_populates_counters(self, fresh_telemetry):
+        from comfyui_distributed_tpu.cluster.job_store import JobStore
+
+        async def body():
+            store = JobStore()
+            await store.init_tile_job("tj", 4, chunk=1)
+            assert self._counts()["seeded"] == 4
+            assert self._depth() == 4
+            t = await store.request_work("tj", "w1")
+            await store.request_work("tj", "w1")
+            assert self._counts()["assigned"] == 2
+            assert self._depth() == 2
+            await store.submit_result("tj", "w1", t["task_id"],
+                                      {"image": np.zeros((1, 2, 2, 3))})
+            assert self._counts()["completed"] == 1
+            # the other assigned task times out and is requeued
+            requeued = await store.requeue_worker_tasks("tj", "w1")
+            assert len(requeued) == 1
+            assert self._counts()["requeued"] == 1
+            assert self._depth() == 3
+            await store.cleanup_job("tj")
+            assert self._depth() == 0
+
+        run(body())
+
+    def test_timeout_monitor_counts_evictions(self, fresh_telemetry):
+        from comfyui_distributed_tpu.cluster.job_store import JobStore
+        from comfyui_distributed_tpu.cluster.job_timeout import \
+            check_and_requeue_timed_out_workers
+
+        async def body():
+            store = JobStore()
+            await store.init_tile_job("tj", 2, chunk=1)
+            await store.request_work("tj", "dead")
+            await store.request_work("tj", "busy")
+
+            async def probe(worker_id):
+                return ({"queue_remaining": 3}
+                        if worker_id == "busy" else None)
+
+            evicted = await check_and_requeue_timed_out_workers(
+                store, "tj", timeout=0.0, probe_fn=probe,
+                now=1e12)   # everything looks silent
+            assert "dead" in evicted and "busy" not in evicted
+
+        run(body())
+        snap = telemetry.REGISTRY.snapshot()[
+            "cdt_tile_worker_evictions_total"]
+        by = {s["labels"]["outcome"]: s["value"] for s in snap["series"]}
+        assert by["evicted"] == 1 and by["spared"] == 1
+        assert self._counts()["timed_out"] == 1
+
+    def test_tile_farm_job_records_span(self, fresh_telemetry):
+        from comfyui_distributed_tpu.cluster.job_store import JobStore
+        from comfyui_distributed_tpu.cluster.tile_farm import TileFarm
+
+        async def body():
+            store = JobStore()
+            farm = TileFarm(store, asyncio.get_running_loop())
+            out = await farm.master_run_async(
+                "span-job", 3,
+                lambda s, e: np.zeros((e - s, 2, 2, 3), np.float32))
+            assert sorted(out) == [0, 1, 2]
+
+        run(body())
+        tid = telemetry.SPAN_STORE.resolve("span-job")
+        assert tid is not None
+        names = [s["name"] for s in telemetry.SPAN_STORE.spans(tid)]
+        assert "tile_job.master" in names
+        assert self._counts()["completed"] == 3
+
+
+class TestHttpStitch:
+    """Route-level: a real master→worker orchestration over HTTP stitches
+    one trace via X-CDT-Trace, and the scrape endpoints report the
+    dispatch/probe counters it produced (the same fan-out the reference
+    runs blind)."""
+
+    def test_orchestrate_stitches_and_populates_metrics(self, tmp_config,
+                                                        fresh_telemetry):
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from comfyui_distributed_tpu.api import create_app
+        from comfyui_distributed_tpu.cluster.controller import Controller
+        from comfyui_distributed_tpu.utils import config as config_mod
+
+        async def body():
+            worker = Controller()
+            worker.is_worker = True
+            worker.worker_id = "w0"
+            worker_server = TestServer(create_app(worker))
+            await worker_server.start_server()
+            config_mod.update_config(lambda c: (
+                c["hosts"].append(
+                    {"id": "w0",
+                     "address": f"http://127.0.0.1:{worker_server.port}",
+                     "enabled": True, "type": "local"}),
+                c["master"].update(host="127.0.0.1"),
+            ))
+            master = Controller()
+            master_server = TestServer(create_app(master))
+            await master_server.start_server()
+            config_mod.update_config(
+                lambda c: c["master"].update(port=master_server.port))
+
+            prompt = {
+                "1": {"class_type": "DistributedEmptyImage",
+                      "inputs": {"height": 4, "width": 4}},
+                "2": {"class_type": "DistributedSeed", "inputs": {"seed": 5}},
+                "3": {"class_type": "DistributedCollector",
+                      "inputs": {"images": ["1", 0]}},
+            }
+            client = TestClient(master_server)
+            async with client:
+                resp = await client.post("/distributed/queue", json={
+                    "prompt": prompt, "client_id": "tel"})
+                assert resp.status == 200
+                data = await resp.json()
+                assert data["worker_count"] == 1
+                trace_id = data["trace_id"]
+                pid = data["prompt_id"]
+                for _ in range(200):
+                    if (pid in master.queue.history
+                            and len(worker.queue.history) == 1):
+                        break
+                    await asyncio.sleep(0.05)
+                assert master.queue.history[pid]["status"] == "success"
+
+                # --- trace assembly: both sides share the trace --------
+                resp = await client.get(f"/distributed/trace/{trace_id}")
+                assert resp.status == 200
+                doc = await resp.json()
+                assert doc["trace_id"] == trace_id
+                spans = doc["spans"]
+                names = {s["name"] for s in spans}
+                assert {"orchestrate", "dispatch",
+                        "prompt.execute"} <= names
+                assert all(s["trace_id"] == trace_id for s in spans)
+                dispatch = next(s for s in spans if s["name"] == "dispatch")
+                # the worker-side execution span parents onto the
+                # master-side dispatch span — carried ONLY by X-CDT-Trace
+                stitched = [s for s in spans
+                            if s["name"] == "prompt.execute"
+                            and s["parent_id"] == dispatch["span_id"]]
+                assert stitched, (
+                    "no execution span parented on the dispatch span")
+
+                # --- scrape: fan-out metrics are populated -------------
+                resp = await client.get("/distributed/metrics")
+                assert resp.status == 200
+                text = await resp.text()
+                assert re.search(
+                    r'cdt_worker_probe_total\{outcome="online"\} [1-9]',
+                    text)
+                assert re.search(
+                    r'cdt_dispatch_seconds_count\{.*transport="http".*\} '
+                    r'[1-9]', text)
+                assert re.search(
+                    r'cdt_prompts_total\{status="success"\} [1-9]', text)
+                assert re.search(
+                    r'cdt_http_requests_total\{.*path="/distributed/queue'
+                    r'".*\} [1-9]', text)
+            await worker_server.close()
+            await master_server.close()
+
+        run(body())

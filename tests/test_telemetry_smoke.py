@@ -1,0 +1,89 @@
+"""Tier-1-safe telemetry smoke: every telemetry module imports, and the
+metrics endpoints can never silently 500 — even on a pristine registry.
+(The CI guard the ISSUE asks for: a broken exporter or a bad metric
+declaration fails here before it can take down a scrape.)"""
+
+import asyncio
+import importlib
+import pkgutil
+import re
+from pathlib import Path
+
+from aiohttp.test_utils import TestClient, TestServer
+
+import comfyui_distributed_tpu.telemetry as telemetry_pkg
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def test_every_telemetry_module_imports():
+    pkg_dir = Path(telemetry_pkg.__file__).parent
+    names = [m.name for m in pkgutil.iter_modules([str(pkg_dir)])]
+    assert set(names) >= {"registry", "spans", "export", "metrics"}
+    for name in names:
+        mod = importlib.import_module(f"comfyui_distributed_tpu.telemetry.{name}")
+        assert mod is not None
+
+
+def test_telemetry_core_is_dependency_free():
+    """The core must stay stdlib-only: importable by the standalone worker
+    monitor and never dragging jax/aiohttp into a bare process."""
+    import subprocess
+    import sys
+
+    code = (
+        "import sys\n"
+        "import comfyui_distributed_tpu.telemetry as t\n"
+        "banned = [m for m in ('jax', 'aiohttp', 'numpy') if m in sys.modules]\n"
+        "assert not banned, f'telemetry pulled in {banned}'\n"
+        "t.counter('smoke_total').inc()\n"
+        "print('ok')\n"
+    )
+    proc = subprocess.run([sys.executable, "-c", code],
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, proc.stderr
+    assert "ok" in proc.stdout
+
+
+def test_metrics_routes_never_500(tmp_config):
+    from comfyui_distributed_tpu.api import create_app
+    from comfyui_distributed_tpu.cluster.controller import Controller
+
+    async def body():
+        app = create_app(Controller())
+        async with TestClient(TestServer(app)) as client:
+            r = await client.get("/distributed/metrics")
+            assert r.status == 200
+            text = await r.text()
+            # valid exposition: every non-comment line is a sample
+            sample = re.compile(
+                r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{.*\})? \S+$')
+            lines = text.strip().splitlines()
+            assert lines
+            for line in lines:
+                if not line.startswith("#"):
+                    assert sample.match(line), line
+            # the standard families are declared even before any traffic
+            for family in ("cdt_sampler_step_seconds",
+                           "cdt_tile_tasks_total",
+                           "cdt_tile_queue_depth",
+                           "cdt_dispatch_seconds",
+                           "cdt_worker_probe_total"):
+                assert f"# TYPE {family}" in text, family
+
+            r = await client.get("/distributed/metrics.json")
+            assert r.status == 200
+            doc = await r.json()
+            assert doc["format"] == "cdt.metrics.v1"
+            assert "cdt_prompt_queue_depth" in doc["metrics"]
+
+            # unknown trace → clean 404, not a 500
+            r = await client.get("/distributed/trace/no-such-job")
+            assert r.status == 404
+            # metrics scrape is CORS-read-safe like /distributed/health
+            r = await client.get("/distributed/metrics")
+            assert r.headers.get("Access-Control-Allow-Origin") == "*"
+
+    run(body())

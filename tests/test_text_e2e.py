@@ -71,6 +71,33 @@ def _tiny_stack() -> SDXLTextStack:
 
 
 class TestConditionerTokenizerWiring:
+    def test_single_explicit_tokenizer_raises_descriptively(self,
+                                                            vocab_dir):
+        """Passing only one of tok_l/tok_g used to crash vocab validation
+        on None.eot_id (advisor r05) — now it's a clear ValueError
+        requiring the pair."""
+        tok = CLIPBPETokenizer.from_dir(vocab_dir, max_len=MAX_LEN)
+        with pytest.raises(ValueError, match="both tok_l and tok_g"):
+            CLIPConditioner(_tiny_stack(), kind="sdxl", tok_l=tok)
+        with pytest.raises(ValueError, match="both tok_l and tok_g"):
+            CLIPConditioner(_tiny_stack(), kind="sdxl", tok_g=tok)
+        # the pair still works
+        cond = CLIPConditioner(_tiny_stack(), kind="sdxl", tok_l=tok,
+                               tok_g=CLIPBPETokenizer.from_dir(
+                                   vocab_dir, max_len=MAX_LEN,
+                                   pad_token_id=0))
+        assert cond.tok_l is tok
+
+    def test_sd3_stack_single_tokenizer_raises(self, vocab_dir):
+        from comfyui_distributed_tpu.models.t5 import SD3TextStack
+
+        tok = CLIPBPETokenizer.from_dir(vocab_dir, max_len=MAX_LEN)
+        stack_parts = SD3TextStack.init_random(jax.random.key(0),
+                                               tiny=True)
+        with pytest.raises(ValueError, match="both tok_l and tok_g"):
+            SD3TextStack(stack_parts.clip_l, stack_parts.clip_g,
+                         stack_parts.t5, tok_l=tok)
+
     def test_loads_at_stack_max_len(self, vocab_dir, monkeypatch):
         """The conditioner must tokenize to the stack's context length —
         a 77-padded sequence does not shape-check against the tiny
