@@ -91,6 +91,8 @@ def register(router, controller) -> None:
         # (cluster/dispatch.py select_active_hosts)
         sem = asyncio.Semaphore(constants.WORKER_PROBE_CONCURRENCY)
 
+        from ..cluster.resilience import BREAKERS
+
         async def status_one(wid: str) -> tuple[str, dict]:
             entry: dict = {
                 "managed": wid in managed,
@@ -98,6 +100,9 @@ def register(router, controller) -> None:
                 "pid": managed.get(wid, {}).get("pid"),
                 "online": False,
                 "queue_remaining": None,
+                # circuit-breaker verdict (cluster/resilience.py): the
+                # dashboard badges quarantined hosts without probing them
+                "breaker": BREAKERS.state(wid),
             }
             host = hosts.get(wid)
             if host:

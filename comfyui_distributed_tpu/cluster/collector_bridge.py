@@ -135,20 +135,25 @@ class CollectorBridge:
             return False
 
     async def _post_with_retry(self, session, url: str, payload: dict) -> None:
-        """Exponential backoff ×SEND_MAX_RETRIES (reference
-        ``worker_comms.py:88-104``)."""
-        last: Exception | None = None
-        for attempt in range(constants.SEND_MAX_RETRIES):
-            try:
-                async with session.post(url, json=payload) as resp:
-                    if resp.status < 400:
-                        return
+        """SEND_MAX_RETRIES attempts through the unified RetryPolicy
+        (reference ``worker_comms.py:88-104``); safe to re-send because
+        the master's collector drain keys envelopes by (worker_id,
+        batch_idx) and duplicate is_last flags are idempotent."""
+        from .resilience import send_policy
+
+        async def attempt() -> None:
+            async with session.post(url, json=payload) as resp:
+                if resp.status >= 400:
                     body = await resp.text()
-                    last = WorkerError(f"{resp.status}: {body[:200]}")
-            except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
-                last = e
-            await asyncio.sleep(constants.SEND_BACKOFF_BASE * (2 ** attempt))
-        raise WorkerError(f"send to {url} failed after retries: {last}")
+                    err = WorkerError(f"{resp.status}: {body[:200]}")
+                    err.retry_safe = True
+                    raise err
+
+        try:
+            await send_policy().run(attempt, op="collect")
+        except (aiohttp.ClientError, asyncio.TimeoutError, OSError,
+                WorkerError) as e:
+            raise WorkerError(f"send to {url} failed after retries: {e}") from e
 
     # --- master role -------------------------------------------------------
 

@@ -22,6 +22,7 @@ from ..telemetry import metrics as _tm
 from ..utils import constants
 from ..utils.logging import debug_log, log, trace_info
 from ..utils.network import build_host_url, get_client_session, probe_host
+from .resilience import BREAKERS, CLOSED, RetryPolicy
 
 # Global round-robin cursor for idle-host selection (reference keeps the
 # same module-global index, dispatch.py:28)
@@ -36,24 +37,58 @@ async def select_active_hosts(
     """Probe all enabled hosts concurrently (bounded) → (online, offline).
 
     Each probe result dict gains ``_probe`` with the health payload.
+
+    Circuit-breaker gate: a host whose breaker is **open** is quarantined
+    without being probed at all (its dict gains ``_breaker: "open"``) —
+    a flapping worker costs one gauge read per job instead of a
+    PROBE_TIMEOUT stall; after the recovery window one half-open trial
+    probe decides re-admission. Probe outcomes feed the breakers.
     """
     sem = asyncio.Semaphore(probe_concurrency or constants.WORKER_PROBE_CONCURRENCY)
 
-    async def probe_one(host: dict) -> tuple[dict, Optional[dict]]:
-        async with sem:
-            return host, await probe_host(host)
+    async def probe_one(host: dict) -> tuple[dict, Optional[dict], bool]:
+        wid = str(host.get("id"))
+        if not BREAKERS.allow(wid):
+            return host, None, True             # quarantined, not probed
+        health = None
+        try:
+            async with sem:
+                health = await probe_host(host)
+        except asyncio.CancelledError:
+            # a consumed half-open trial slot must be released (allow()
+            # never re-admits a stuck half_open breaker, so a leaked slot
+            # quarantines the worker until process restart) — but an
+            # aborted orchestration is not failure evidence against a
+            # closed breaker on a healthy host
+            if BREAKERS.state(wid) != CLOSED:
+                BREAKERS.record(wid, False)
+            raise
+        except Exception as e:  # noqa: BLE001 — one bad host must not
+            # kill the whole fan-out; it just counts as offline
+            debug_log(f"probe {wid} raised unexpectedly: {e!r}")
+        BREAKERS.record(wid, health is not None)
+        return host, health, False
 
     results = await asyncio.gather(*(probe_one(h) for h in hosts))
     online, offline = [], []
-    for host, health in results:
-        if health is None:
+    quarantined = 0
+    for host, health, skipped in results:
+        if skipped:
+            quarantined += 1
+            offline.append({**host, "_breaker": "open"})
+        elif health is None:
             offline.append(host)
         else:
             online.append({**host, "_probe": health})
     if telemetry.enabled() and results:
         _tm.WORKER_PROBES.labels(outcome="online").inc(len(online))
-        _tm.WORKER_PROBES.labels(outcome="offline").inc(len(offline))
-    trace_info(trace_id, f"probe: {len(online)} online, {len(offline)} offline")
+        _tm.WORKER_PROBES.labels(outcome="offline").inc(
+            len(offline) - quarantined)
+        if quarantined:
+            _tm.WORKER_PROBES.labels(outcome="quarantined").inc(quarantined)
+    trace_info(trace_id, f"probe: {len(online)} online, "
+                         f"{len(offline) - quarantined} offline, "
+                         f"{quarantined} quarantined (breaker open)")
     return online, offline
 
 
@@ -128,10 +163,14 @@ async def dispatch_prompt_ws(
                         f"before ack ({msg.type})", worker_id=host.get("id"))
                 ack = json.loads(msg.data)
                 if ack.get("type") != "dispatch_ack" or not ack.get("ok", False):
-                    raise WorkerError(
+                    err = WorkerError(
                         f"ws dispatch to {host.get('id')} rejected: "
                         f"{ack.get('node_errors') or ack.get('error')}",
                         worker_id=host.get("id"))
+                    # a nack is the worker healthily validating; only
+                    # transport failures count against its breaker
+                    err.client_rejected = True
+                    raise err
                 trace_info(trace_id, f"dispatched to {host.get('id')} (ws)")
                 outcome = "ok"
                 return ack
@@ -164,7 +203,51 @@ async def dispatch_prompt(
     With ``via_ws`` (settings.websocket_orchestration) the WebSocket channel
     is tried first; transport errors fall back to HTTP so enabling the
     setting can't strand a cluster whose peers lack the WS route.
+
+    Resilience: the HTTP POST retries through the unified ``RetryPolicy``
+    — but **only** when the connection never opened
+    (``ClientConnectorError``: the prompt provably never left this host).
+    A timeout or mid-request error after connect is ambiguous — the
+    worker may already hold the prompt, and a re-send would double-run
+    the job — so it fails fast, exactly like the lost-WS-ack case.
+    The final outcome feeds the host's circuit breaker.
     """
+    from ..utils.exceptions import WorkerError
+
+    wid = str(host.get("id"))
+    try:
+        result = await _dispatch_prompt_once(host, prompt, client_id, extra,
+                                             trace_id, via_ws)
+    except WorkerError as e:
+        # a validation rejection (HTTP 4xx / WS nack) is the worker
+        # HEALTHILY answering a bad prompt — evidence FOR the host, not
+        # against it; a flood of invalid workflows must not open the
+        # breaker on every online worker
+        BREAKERS.record(wid, getattr(e, "client_rejected", False))
+        raise
+    BREAKERS.record(wid, True)
+    return result
+
+
+def _never_sent(e: BaseException) -> bool:
+    """Retry predicate for prompt dispatch: only failures that prove the
+    request never reached the peer are idempotent-safe to re-send."""
+    import aiohttp as _aiohttp
+
+    if isinstance(e, _aiohttp.ClientConnectorError):
+        return True
+    cause = getattr(e, "__cause__", None)
+    return isinstance(cause, _aiohttp.ClientConnectorError)
+
+
+async def _dispatch_prompt_once(
+    host: dict[str, Any],
+    prompt: dict,
+    client_id: str,
+    extra: dict | None,
+    trace_id: str | None,
+    via_ws: bool,
+) -> dict:
     from ..utils.exceptions import WorkerError
 
     if via_ws:
@@ -193,32 +276,50 @@ async def dispatch_prompt(
         if telemetry.enabled():
             _tm.DISPATCH_PAYLOAD_BYTES.labels(transport="http").observe(
                 len(body_bytes))
-        t0 = time.perf_counter()
-        outcome = "error"
-        try:
-            async with session.post(
-                url, data=body_bytes,
-                timeout=aiohttp.ClientTimeout(total=constants.DISPATCH_TIMEOUT),
-                headers={"Content-Type": "application/json",
-                         **telemetry.trace_headers()},
-            ) as resp:
-                body = await resp.json(content_type=None)
-                if resp.status >= 400:
-                    raise WorkerError(
-                        f"dispatch to {host.get('id')} failed "
-                        f"({resp.status}): {body}",
-                        worker_id=host.get("id"),
-                    )
-                trace_info(trace_id, f"dispatched to {host.get('id')}")
-                outcome = "ok"
-                return body
-        except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
-            raise WorkerError(
-                f"dispatch to {host.get('id')} unreachable: {e}",
-                worker_id=host.get("id"),
-            ) from e
-        finally:
-            if telemetry.enabled():
-                _tm.DISPATCH_SECONDS.labels(
-                    transport="http", outcome=outcome).observe(
-                        time.perf_counter() - t0)
+
+        async def attempt() -> dict:
+            t0 = time.perf_counter()
+            outcome = "error"
+            try:
+                async with session.post(
+                    url, data=body_bytes,
+                    timeout=aiohttp.ClientTimeout(
+                        total=constants.DISPATCH_TIMEOUT),
+                    headers={"Content-Type": "application/json",
+                             **telemetry.trace_headers()},
+                ) as resp:
+                    body = await resp.json(content_type=None)
+                    if resp.status >= 400:
+                        err = WorkerError(
+                            f"dispatch to {host.get('id')} failed "
+                            f"({resp.status}): {body}",
+                            worker_id=host.get("id"),
+                        )
+                        # 4xx = the host is up and rejecting the prompt;
+                        # 5xx = the host itself is failing
+                        err.client_rejected = resp.status < 500
+                        raise err
+                    trace_info(trace_id, f"dispatched to {host.get('id')}")
+                    outcome = "ok"
+                    return body
+            except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
+                err = WorkerError(
+                    f"dispatch to {host.get('id')} unreachable: {e}",
+                    worker_id=host.get("id"),
+                )
+                # connection-refused/DNS failures are provably un-sent
+                # (idempotency-safe); anything after connect is not
+                err.retry_safe = _never_sent(e)
+                raise err from e
+            finally:
+                if telemetry.enabled():
+                    _tm.DISPATCH_SECONDS.labels(
+                        transport="http", outcome=outcome).observe(
+                            time.perf_counter() - t0)
+
+        policy = RetryPolicy(max_attempts=constants.DISPATCH_MAX_RETRIES,
+                             base=constants.SEND_BACKOFF_BASE,
+                             cap=constants.RETRY_CAP_S)
+        return await policy.run(
+            attempt, op="dispatch",
+            retryable=lambda e: getattr(e, "retry_safe", False) is True)

@@ -1,0 +1,467 @@
+"""Deterministic fault injection for the cluster control plane.
+
+The reference system's failure handling was only ever exercised by
+killing real processes (slow, racy, unreproducible). This harness makes
+every failure path reproducible under plain pytest: a seeded
+:class:`FaultPlan` wraps the shared aiohttp session (and optionally the
+job store) and injects faults at chosen **call indices** per operation —
+same seed, same spec, same failures, every run.
+
+Fault kinds:
+
+- ``drop``      — connection never opens (``aiohttp.ClientConnectionError``)
+- ``latency``   — delay the call by ``value`` seconds, then proceed
+- ``http500``   — synthetic 5xx response (``value`` overrides the status)
+- ``corrupt``   — flip one byte of the outbound payload (CDTF frames are
+  crc-checked, so the receiver rejects it and the sender's RetryPolicy
+  re-sends intact bytes)
+- ``truncate``  — send only the first half of the outbound payload
+- ``silence``   — swallow the call, return a fake 200 (heartbeat loss
+  without connection errors — exactly what the timeout monitor detects)
+
+Spec grammar (``CDT_FAULTS`` env var or test fixture)::
+
+    spec    := clause (";" clause)*
+    clause  := "seed=" int
+             | op "@" sel ":" kind ["=" value]
+    op      := probe | dispatch | request_work | submit | heartbeat
+             | collect | media | http | *          (http = any unmatched)
+    sel     := "*"                                 (every call)
+             | int ("," int)* | int "-" int        (0-based call indices)
+             | "%" float                           (seeded probability)
+
+Example: ``seed=42;probe@0-1:drop;submit@3:corrupt;heartbeat@*:silence``
+kills the first two probes, corrupts the 4th tile submit, and silences
+every heartbeat — deterministically. Operations are classified by URL
+path (``op_for_url``). Disabled (zero overhead beyond one ``is None``
+check) unless a plan is active. See docs/resilience.md.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import random
+import re
+import threading
+from typing import Any, Optional
+
+from ..telemetry import enabled as _tm_enabled, metrics as _tm
+from ..utils.logging import debug_log, log
+
+FAULTS_ENV = "CDT_FAULTS"
+
+_KINDS = ("drop", "latency", "http500", "corrupt", "truncate", "silence")
+
+# URL path suffix → operation name, first match wins (order matters:
+# more specific prefixes first).
+_OP_ROUTES: tuple[tuple[str, str], ...] = (
+    ("/distributed/health", "probe"),
+    ("/distributed/worker_ws", "dispatch"),
+    ("/prompt", "dispatch"),
+    ("/distributed/request_image", "request_work"),
+    ("/distributed/submit_tiles", "submit"),
+    ("/distributed/submit_image", "submit"),
+    ("/distributed/heartbeat", "heartbeat"),
+    ("/distributed/job_complete_frames", "collect"),
+    ("/distributed/job_complete", "collect"),
+    ("/distributed/job_status", "job_status"),
+    ("/distributed/check_file", "media"),
+    ("/upload/image", "media"),
+)
+
+
+def op_for_url(url: str) -> str:
+    path = str(url).split("?", 1)[0]
+    for suffix, op in _OP_ROUTES:
+        if path.endswith(suffix):
+            return op
+    return "http"
+
+
+class FaultSpecError(ValueError):
+    """Malformed CDT_FAULTS spec."""
+
+
+class Fault:
+    """One injection rule: operation, selector, kind, optional value."""
+
+    __slots__ = ("op", "kind", "indices", "prob", "value")
+
+    def __init__(self, op: str, kind: str,
+                 indices: Optional[frozenset[int]] = None,
+                 prob: Optional[float] = None, value: float = 0.0):
+        if kind not in _KINDS:
+            raise FaultSpecError(f"unknown fault kind {kind!r} "
+                                 f"(one of {', '.join(_KINDS)})")
+        self.op = op
+        self.kind = kind
+        self.indices = indices        # None + prob None => every call
+        self.prob = prob
+        self.value = value
+
+    def matches(self, op: str, index: int, rng: random.Random) -> bool:
+        if self.op not in ("*", op):
+            return False
+        if self.prob is not None:
+            return rng.random() < self.prob
+        if self.indices is None:
+            return True
+        return index in self.indices
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        sel = ("*" if self.indices is None and self.prob is None
+               else f"%{self.prob}" if self.prob is not None
+               else ",".join(map(str, sorted(self.indices))))
+        return f"Fault({self.op}@{sel}:{self.kind}={self.value})"
+
+
+def _parse_selector(sel: str) -> tuple[Optional[frozenset[int]],
+                                       Optional[float]]:
+    sel = sel.strip()
+    if sel == "*":
+        return None, None
+    if sel.startswith("%"):
+        try:
+            p = float(sel[1:])
+        except ValueError:
+            raise FaultSpecError(f"bad probability selector {sel!r}")
+        if not 0.0 <= p <= 1.0:
+            raise FaultSpecError(f"probability out of [0,1]: {sel!r}")
+        return None, p
+    indices: set[int] = set()
+    for part in sel.split(","):
+        part = part.strip()
+        m = re.fullmatch(r"(\d+)-(\d+)", part)
+        if m:
+            lo, hi = int(m.group(1)), int(m.group(2))
+            if hi < lo:
+                raise FaultSpecError(f"empty index range {part!r}")
+            indices.update(range(lo, hi + 1))
+        elif part.isdigit():
+            indices.add(int(part))
+        else:
+            raise FaultSpecError(f"bad index selector {part!r}")
+    return frozenset(indices), None
+
+
+class FaultPlan:
+    """A seeded, ordered set of faults plus per-operation call counters.
+
+    ``next_fault(op)`` consumes one call index for ``op`` and returns the
+    matching fault (or None). All randomness (probability selectors,
+    corruption byte choice) flows from the plan's seed, so a failing
+    chaos run replays exactly with the same spec.
+    """
+
+    def __init__(self, faults: list[Fault], seed: int = 0):
+        self.faults = list(faults)
+        self.seed = seed
+        self.rng = random.Random(seed)
+        self._lock = threading.Lock()
+        self.calls: dict[str, int] = {}
+        self.injected: list[tuple[str, int, str]] = []   # (op, index, kind)
+
+    @classmethod
+    def parse(cls, spec: str) -> "FaultPlan":
+        faults: list[Fault] = []
+        seed = 0
+        for clause in spec.split(";"):
+            clause = clause.strip()
+            if not clause:
+                continue
+            if clause.startswith("seed="):
+                try:
+                    seed = int(clause[5:])
+                except ValueError:
+                    raise FaultSpecError(f"bad seed clause {clause!r}")
+                continue
+            m = re.fullmatch(
+                r"([\w.*]+)@([^:]+):([a-z0-9]+)(?:=([\d.]+))?", clause)
+            if not m:
+                raise FaultSpecError(
+                    f"bad fault clause {clause!r} "
+                    "(want op@sel:kind[=value])")
+            op, sel, kind, value = m.groups()
+            indices, prob = _parse_selector(sel)
+            faults.append(Fault(op, kind, indices, prob,
+                                float(value) if value else 0.0))
+        return cls(faults, seed=seed)
+
+    def next_fault(self, op: str) -> Optional[Fault]:
+        with self._lock:
+            index = self.calls.get(op, 0)
+            self.calls[op] = index + 1
+            for f in self.faults:
+                if f.matches(op, index, self.rng):
+                    self.injected.append((op, index, f.kind))
+                    if _tm_enabled():
+                        _tm.FAULTS_INJECTED.labels(op=op, kind=f.kind).inc()
+                    debug_log(f"faults: injecting {f.kind} into "
+                              f"{op}[{index}]")
+                    return f
+        return None
+
+    # -- payload mutation (seeded) ------------------------------------------
+
+    def corrupt_bytes(self, data: bytes) -> bytes:
+        if not data:
+            return data
+        i = self.rng.randrange(len(data))
+        return data[:i] + bytes([data[i] ^ 0xFF]) + data[i + 1:]
+
+    @staticmethod
+    def truncate_bytes(data: bytes) -> bytes:
+        return data[: max(1, len(data) // 2)] if data else data
+
+
+# ---------------------------------------------------------------------------
+# activation (env or test fixture)
+# ---------------------------------------------------------------------------
+
+_active: Optional[FaultPlan] = None
+_env_checked = False
+
+
+def activate(plan: Optional[FaultPlan]) -> Optional[FaultPlan]:
+    """Install (or clear, with None) the process-wide plan. Returns it."""
+    global _active, _env_checked
+    _active = plan
+    _env_checked = True     # explicit activation overrides the env
+    if plan is not None:
+        log(f"faults: plan active (seed={plan.seed}, "
+            f"{len(plan.faults)} rules)")
+    return plan
+
+
+def deactivate() -> None:
+    global _active, _env_checked
+    _active = None
+    _env_checked = False    # re-read CDT_FAULTS on next use
+
+
+def active_plan() -> Optional[FaultPlan]:
+    global _active, _env_checked
+    if not _env_checked:
+        _env_checked = True
+        spec = os.environ.get(FAULTS_ENV, "")
+        if spec:
+            _active = FaultPlan.parse(spec)
+            log(f"faults: {FAULTS_ENV} plan active (seed={_active.seed}, "
+                f"{len(_active.faults)} rules)")
+    return _active
+
+
+# ---------------------------------------------------------------------------
+# aiohttp session wrapper
+# ---------------------------------------------------------------------------
+
+class _FakeResponse:
+    """Minimal synthetic response for http500/silence injections."""
+
+    def __init__(self, status: int, body: str = ""):
+        self.status = status
+        self._body = body or ('{"error": "injected fault"}'
+                              if status >= 400 else '{"status": "ok"}')
+        self.headers: dict[str, str] = {"Content-Type": "application/json"}
+
+    async def text(self) -> str:
+        return self._body
+
+    async def json(self, content_type: Any = None) -> Any:
+        import json as _json
+
+        return _json.loads(self._body)
+
+    async def read(self) -> bytes:
+        return self._body.encode()
+
+    async def release(self) -> None:
+        pass
+
+    async def __aenter__(self) -> "_FakeResponse":
+        return self
+
+    async def __aexit__(self, *exc) -> None:
+        pass
+
+
+def _mutate_payload(kw: dict, fault: Fault, plan: FaultPlan) -> dict:
+    """Corrupt/truncate the outbound body: raw bytes directly; FormData by
+    rebuilding it with mutated bytes fields (the largest bytes field — the
+    CDTF frame — is the intended target; JSON metadata stays intact)."""
+    import aiohttp
+
+    mutate = (plan.corrupt_bytes if fault.kind == "corrupt"
+              else plan.truncate_bytes)
+    data = kw.get("data")
+    if isinstance(data, (bytes, bytearray)):
+        kw = {**kw, "data": mutate(bytes(data))}
+        return kw
+    if isinstance(data, aiohttp.FormData):
+        fields = getattr(data, "_fields", None)
+        if not fields:
+            return kw
+        # pick the largest bytes field (the frame, not the metadata)
+        target = None
+        for i, (opts, headers, value) in enumerate(fields):
+            if isinstance(value, (bytes, bytearray)) and (
+                    target is None
+                    or len(value) > len(fields[target][2])):
+                target = i
+        if target is None:
+            return kw
+        rebuilt = aiohttp.FormData()
+        for i, (opts, headers, value) in enumerate(fields):
+            v = (mutate(bytes(value)) if i == target else value)
+            rebuilt.add_field(
+                opts.get("name", f"field_{i}"), v,
+                filename=opts.get("filename"),
+                content_type=headers.get("Content-Type"))
+        kw = {**kw, "data": rebuilt}
+    return kw
+
+
+class _FaultRequestCtx:
+    """Async-CM shim around a (possibly faulted) request."""
+
+    def __init__(self, session, method: str, url: str, kw: dict,
+                 plan: FaultPlan):
+        self._session = session
+        self._method = method
+        self._url = url
+        self._kw = kw
+        self._plan = plan
+        self._inner = None
+
+    async def __aenter__(self):
+        import aiohttp
+
+        fault = self._plan.next_fault(op_for_url(self._url))
+        kw = self._kw
+        if fault is not None:
+            if fault.kind == "drop":
+                raise aiohttp.ClientConnectionError(
+                    f"injected drop ({self._url})")
+            if fault.kind == "silence":
+                return _FakeResponse(200)
+            if fault.kind == "http500":
+                return _FakeResponse(int(fault.value) or 500)
+            if fault.kind == "latency":
+                await asyncio.sleep(fault.value or 0.05)
+            elif fault.kind in ("corrupt", "truncate"):
+                kw = _mutate_payload(dict(kw), fault, self._plan)
+        self._inner = getattr(self._session, self._method)(self._url, **kw)
+        return await self._inner.__aenter__()
+
+    async def __aexit__(self, *exc):
+        if self._inner is not None:
+            return await self._inner.__aexit__(*exc)
+        return False
+
+
+class _FaultWSCtx:
+    def __init__(self, session, url: str, kw: dict, plan: FaultPlan):
+        self._session = session
+        self._url = url
+        self._kw = kw
+        self._plan = plan
+        self._inner = None
+
+    async def __aenter__(self):
+        import aiohttp
+
+        fault = self._plan.next_fault(op_for_url(self._url))
+        if fault is not None:
+            if fault.kind == "drop":
+                raise aiohttp.ClientConnectionError(
+                    f"injected ws drop ({self._url})")
+            if fault.kind == "latency":
+                await asyncio.sleep(fault.value or 0.05)
+        self._inner = self._session.ws_connect(self._url, **self._kw)
+        return await self._inner.__aenter__()
+
+    async def __aexit__(self, *exc):
+        if self._inner is not None:
+            return await self._inner.__aexit__(*exc)
+        return False
+
+
+class FaultSession:
+    """aiohttp-session proxy injecting the active plan's faults on
+    get/post/ws_connect; everything else passes through untouched."""
+
+    def __init__(self, session, plan: FaultPlan):
+        self._session = session
+        self._plan = plan
+
+    def get(self, url, **kw):
+        return _FaultRequestCtx(self._session, "get", url, kw, self._plan)
+
+    def post(self, url, **kw):
+        return _FaultRequestCtx(self._session, "post", url, kw, self._plan)
+
+    def ws_connect(self, url, **kw):
+        return _FaultWSCtx(self._session, url, kw, self._plan)
+
+    def __getattr__(self, name):
+        return getattr(self._session, name)
+
+
+def wrap_session(session):
+    """Return the session wrapped with the active plan, or unchanged when
+    no plan is active (the production fast path: one None check)."""
+    plan = active_plan()
+    if plan is None:
+        return session
+    return FaultSession(session, plan)
+
+
+# ---------------------------------------------------------------------------
+# job-store wrapper (in-process fault tests without HTTP)
+# ---------------------------------------------------------------------------
+
+class FaultyJobStore:
+    """JobStore proxy for in-process chaos tests: ``request_work`` /
+    ``submit_result`` / ``heartbeat`` consult the plan (ops are prefixed
+    ``store.``), everything else passes through."""
+
+    def __init__(self, store, plan: FaultPlan):
+        self._store = store
+        self._plan = plan
+
+    async def request_work(self, job_id, worker_id):
+        fault = self._plan.next_fault("store.request_work")
+        if fault is not None:
+            if fault.kind == "drop":
+                return None
+            if fault.kind == "latency":
+                await asyncio.sleep(fault.value or 0.05)
+            elif fault.kind == "http500":
+                from ..utils.exceptions import JobQueueError
+
+                raise JobQueueError("injected store failure", job_id=job_id)
+        return await self._store.request_work(job_id, worker_id)
+
+    async def submit_result(self, job_id, worker_id, task_id, payload):
+        fault = self._plan.next_fault("store.submit")
+        if fault is not None:
+            if fault.kind in ("drop", "silence"):
+                return False
+            if fault.kind == "latency":
+                await asyncio.sleep(fault.value or 0.05)
+            elif fault.kind == "http500":
+                from ..utils.exceptions import JobQueueError
+
+                raise JobQueueError("injected store failure", job_id=job_id)
+        return await self._store.submit_result(job_id, worker_id, task_id,
+                                               payload)
+
+    async def heartbeat(self, job_id, worker_id):
+        fault = self._plan.next_fault("store.heartbeat")
+        if fault is not None and fault.kind in ("drop", "silence"):
+            return False
+        return await self._store.heartbeat(job_id, worker_id)
+
+    def __getattr__(self, name):
+        return getattr(self._store, name)

@@ -57,11 +57,18 @@ class TileJob:
     worker_status: dict[str, float] = dataclasses.field(default_factory=dict)
     results: asyncio.Queue = dataclasses.field(default_factory=asyncio.Queue)
     created_at: float = dataclasses.field(default_factory=time.monotonic)
+    # task_id → times this task was requeued (eviction or processing
+    # failure); past MAX_TILE_REQUEUES the task dead-letters instead
+    requeue_counts: dict[int, int] = dataclasses.field(default_factory=dict)
+    # poison tasks: task_id → {task_id, worker_id, reason, requeues}
+    dead_letter: dict[int, dict] = dataclasses.field(default_factory=dict)
 
     def remaining(self) -> int:
-        return self.total_tasks - len(self.completed)
+        return self.total_tasks - len(self.completed) - len(self.dead_letter)
 
     def is_complete(self) -> bool:
+        """Every task reached a terminal state — completed or
+        dead-lettered. A poison tile must never hang the job."""
         return self.remaining() <= 0
 
     def heartbeat(self, worker_id: str, now: Optional[float] = None) -> None:

@@ -21,10 +21,15 @@ from .job_models import CollectorJob, TileJob, TileTask
 
 
 class JobStore:
+    # finished-job summaries retained for status queries (dead-letter
+    # forensics after the job completed); bounded FIFO
+    MAX_FINISHED = 64
+
     def __init__(self):
         self.lock = asyncio.Lock()
         self.collector_jobs: dict[str, CollectorJob] = {}
         self.tile_jobs: dict[str, TileJob] = {}
+        self.finished: dict[str, dict] = {}
 
     def _record_tiles(self, event: str, n: int = 1) -> None:
         """Telemetry (call under ``self.lock``): lifecycle counter + the
@@ -132,6 +137,12 @@ class JobStore:
             if task_id in job.completed:
                 debug_log(f"duplicate result for {job_id}:{task_id} ignored")
                 return False
+            if task_id in job.dead_letter:
+                # a presumed-poison tile finished after all (worker revived
+                # past its eviction) — a real result always wins
+                job.dead_letter.pop(task_id)
+                debug_log(f"dead-lettered task {job_id}:{task_id} "
+                          "resurrected by late result")
             job.completed[task_id] = payload
             job.assigned.pop(task_id, None)
             self._record_tiles("completed")
@@ -172,36 +183,119 @@ class JobStore:
                 return {"exists": True, "kind": "tile", "mode": tile.mode,
                         "pending": len(tile.pending),
                         "completed": len(tile.completed),
-                        "total": tile.total_tasks}
+                        "total": tile.total_tasks,
+                        "dead_letter": sorted(tile.dead_letter.values(),
+                                              key=lambda d: d["task_id"])}
             if job_id in self.collector_jobs:
                 return {"exists": True, "kind": "collector"}
+            done = self.finished.get(job_id)
+            if done is not None:
+                # job already cleaned up: dead-letter forensics survive
+                # (``exists`` stays False so worker ready-polls never
+                # mistake a finished job for a live queue)
+                return {"exists": False, "finished": True, **done}
             return {"exists": False}
 
-    async def requeue_worker_tasks(self, job_id: str, worker_id: str) -> list[int]:
+    def _dead_letter_locked(self, job, task_id: int, worker_id: str,
+                            reason: str) -> None:
+        """Move a task to the job's dead-letter list (call under
+        ``self.lock``). Terminal for completion accounting — a poison
+        tile must bound the damage instead of hanging the job."""
+        job.dead_letter[task_id] = {
+            "task_id": task_id,
+            "worker_id": worker_id,
+            "reason": reason,
+            "requeues": job.requeue_counts.get(task_id, 0),
+        }
+        job.assigned.pop(task_id, None)
+        job.pending = [t for t in job.pending if t.task_id != task_id]
+        self._record_tiles("dead_letter")
+
+    async def requeue_worker_tasks(
+        self, job_id: str, worker_id: str,
+        max_requeues: int | None = None,
+    ) -> list[int]:
         """Requeue the incomplete tasks of a (presumed dead) worker and
         evict it (reference ``_check_and_requeue_timed_out_workers`` apply
-        phase, ``upscale/job_timeout.py:111-150``)."""
+        phase, ``upscale/job_timeout.py:111-150``).
+
+        Requeues are **bounded**: a task already requeued ``max_requeues``
+        times (default ``constants.MAX_TILE_REQUEUES``) moves to the job's
+        dead-letter list instead — a tile that deterministically kills its
+        host must not cycle through the fleet forever.
+        """
+        if max_requeues is None:
+            max_requeues = constants.MAX_TILE_REQUEUES
         async with self.lock:
             job = self.tile_jobs.get(job_id)
             if job is None:
                 return []
             requeued = []
+            poisoned = []
             for task_id, owner in list(job.assigned.items()):
                 if owner != worker_id or task_id in job.completed:
                     continue
                 del job.assigned[task_id]
+                count = job.requeue_counts.get(task_id, 0) + 1
+                job.requeue_counts[task_id] = count
+                if count > max_requeues:
+                    poisoned.append(task_id)
+                    self._dead_letter_locked(
+                        job, task_id, worker_id,
+                        f"exceeded max_requeues={max_requeues} "
+                        f"(last owner {worker_id})")
+                    continue
                 requeued.append(task_id)
             if requeued:
                 # push to the FRONT so recovered work is picked up first
                 job.pending[:0] = [job.tasks[tid] for tid in requeued]
                 self._record_tiles("requeued", len(requeued))
+            if poisoned:
+                debug_log(f"tile job {job_id}: dead-lettered poison tasks "
+                          f"{poisoned} from {worker_id}")
             job.worker_status.pop(worker_id, None)
             return requeued
+
+    async def record_task_failure(
+        self, job_id: str, worker_id: str, task_id: int, reason: str,
+        max_requeues: int | None = None,
+    ) -> bool:
+        """A processing attempt raised (master-side poison tile): requeue
+        the task, or dead-letter it past the bound. Returns True while the
+        task is still live (requeued), False once dead-lettered."""
+        if max_requeues is None:
+            max_requeues = constants.MAX_TILE_REQUEUES
+        async with self.lock:
+            job = self.tile_jobs.get(job_id)
+            if job is None:
+                return False
+            if task_id in job.completed or task_id in job.dead_letter:
+                return False
+            count = job.requeue_counts.get(task_id, 0) + 1
+            job.requeue_counts[task_id] = count
+            job.assigned.pop(task_id, None)
+            if count > max_requeues:
+                self._dead_letter_locked(job, task_id, worker_id, reason)
+                return False
+            if all(t.task_id != task_id for t in job.pending):
+                job.pending.append(job.tasks[task_id])
+                self._record_tiles("requeued")
+            return True
 
     async def cleanup_job(self, job_id: str) -> None:
         async with self.lock:
             self.collector_jobs.pop(job_id, None)
-            self.tile_jobs.pop(job_id, None)
+            tile = self.tile_jobs.pop(job_id, None)
+            if tile is not None:
+                self.finished[job_id] = {
+                    "kind": "tile",
+                    "completed": len(tile.completed),
+                    "total": tile.total_tasks,
+                    "dead_letter": sorted(tile.dead_letter.values(),
+                                          key=lambda d: d["task_id"]),
+                }
+                while len(self.finished) > self.MAX_FINISHED:
+                    self.finished.pop(next(iter(self.finished)))
             if _tm_enabled():
                 _tm.TILE_QUEUE_DEPTH.set(
                     sum(len(j.pending) for j in self.tile_jobs.values()))

@@ -19,6 +19,7 @@ from ..telemetry import enabled as _tm_enabled, metrics as _tm
 from ..utils import constants
 from ..utils.logging import log
 from .job_store import JobStore
+from .resilience import BREAKERS
 
 ProbeFn = Callable[[str], Awaitable[Optional[dict]]]
 
@@ -29,12 +30,19 @@ async def check_and_requeue_timed_out_workers(
     timeout: float | None = None,
     probe_fn: ProbeFn | None = None,
     now: float | None = None,
+    max_requeues: int | None = None,
 ) -> dict[str, list[int]]:
     """Returns {worker_id: [requeued task ids]} for evicted workers.
 
     ``probe_fn(worker_id)`` returns a health dict or None; a worker whose
     health reports ``queue_remaining > 0`` is spared and its heartbeat
     refreshed (reference busy-probe grace, ``job_timeout.py:48-110``).
+
+    Requeues are bounded by ``max_requeues`` (default
+    ``constants.MAX_TILE_REQUEUES``): a task evicted more often
+    dead-letters instead of cycling forever. An eviction also trips the
+    worker's circuit breaker (``resilience.BREAKERS``) so orchestration
+    quarantines the host instead of re-probing it on the next job.
     """
     timeout = constants.HEARTBEAT_TIMEOUT if timeout is None else timeout
     now = time.monotonic() if now is None else now
@@ -71,10 +79,15 @@ async def check_and_requeue_timed_out_workers(
             if _tm_enabled():
                 _tm.TILE_WORKER_EVICTIONS.labels(outcome="spared").inc()
             continue
-        requeued = await store.requeue_worker_tasks(job_id, w)
+        requeued = await store.requeue_worker_tasks(
+            job_id, w, max_requeues=max_requeues)
         if requeued:
             log(f"worker {w} timed out; requeued tasks {requeued}")
         evicted[w] = requeued
+        if w != "master":
+            # eviction-grade evidence: open the breaker immediately so the
+            # next orchestration skips this host instead of re-probing it
+            BREAKERS.trip(w)
         if _tm_enabled():
             _tm.TILE_WORKER_EVICTIONS.labels(outcome="evicted").inc()
             if requeued:

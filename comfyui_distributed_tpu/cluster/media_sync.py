@@ -109,12 +109,24 @@ def _md5_file(path: Path) -> str:
     return h.hexdigest()
 
 
+def _media_policy():
+    """Small bounded policy for media sync: both operations are idempotent
+    (check is read-only; upload is a content-addressed overwrite), so a
+    transient drop shouldn't skip a dispatch-blocking file — but a dead
+    host must fail the whole host quickly, hence 3 attempts not 5."""
+    from .resilience import RetryPolicy
+
+    return RetryPolicy(max_attempts=3, base=constants.SEND_BACKOFF_BASE,
+                       cap=constants.RETRY_CAP_S)
+
+
 async def _check_remote_file(host: dict, rel: str, md5: str,
                              timeout: float) -> bool:
     """True iff the remote already has ``rel`` with matching content
     (reference ``:146-166`` fast path)."""
     url = build_host_url(host, "/distributed/check_file")
-    try:
+
+    async def attempt() -> bool:
         session = get_client_session()
         async with session.post(
             url, json={"path": rel, "md5": md5},
@@ -124,6 +136,9 @@ async def _check_remote_file(host: dict, rel: str, md5: str,
                 return False
             body = await resp.json()
             return bool(body.get("exists")) and bool(body.get("matches", True))
+
+    try:
+        return await _media_policy().run(attempt, op="media")
     except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
         debug_log(f"check_file {rel} on {host.get('id')} failed: {e}")
         return False
@@ -134,9 +149,13 @@ async def _upload_file(host: dict, rel: str, path: Path,
     """Upload one file via the ComfyUI-compatible ``/upload/image`` route
     (reference ``:168-193``). The file object is handed to aiohttp so the
     body streams from disk — video inputs are multi-GB and must not be
-    buffered in the controller's RAM."""
+    buffered in the controller's RAM. The file is reopened per attempt:
+    a half-streamed body can't be rewound."""
+    from ..utils.exceptions import WorkerError
+
     url = build_host_url(host, "/upload/image")
-    try:
+
+    async def attempt() -> bool:
         with open(path, "rb") as f:
             form = aiohttp.FormData()
             form.add_field("image", f, filename=rel,
@@ -146,8 +165,19 @@ async def _upload_file(host: dict, rel: str, path: Path,
                 url, data=form, timeout=aiohttp.ClientTimeout(total=timeout),
                 headers={"X-CDT-Client": "1"},
             ) as resp:
+                if resp.status >= 500:
+                    # transient server-side failure: idempotent re-upload
+                    err = WorkerError(f"upload {rel}: {resp.status}")
+                    err.retry_safe = True
+                    raise err
                 return resp.status == 200
-    except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
+
+    try:
+        return await _media_policy().run(attempt, op="media")
+    except (aiohttp.ClientError, asyncio.TimeoutError, OSError,
+            WorkerError) as e:
+        # transient transport trio + the retry-exhausted 5xx wrapper; a
+        # programming error in the upload path must still raise loudly
         debug_log(f"upload {rel} to {host.get('id')} failed: {e}")
         return False
 

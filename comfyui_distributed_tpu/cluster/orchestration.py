@@ -235,6 +235,18 @@ class Orchestrator:
             for jid in job_ids.values():
                 await self.store.prepare_collector_job(
                     jid, tuple(w for w in worker_ids if w in dispatched))
+        if delegate_master and not dispatched:
+            # graceful degradation: every dispatch failed AFTER probing
+            # succeeded (breakers/flap mid-orchestration). The delegate-
+            # pruned master prompt would execute nothing and the job
+            # would complete empty — rebuild it as a full local run
+            # instead of failing the job (docs/resilience.md).
+            trace_info(trace_id, "all dispatches failed; delegate mode "
+                                 "disabled — master computes locally")
+            master_prompt = apply_participant_overrides(
+                prompt, "master", job_ids,
+                enabled_worker_ids=(), delegate_only=False,
+            )
 
         prompt_id, node_errors = self.queue.enqueue(
             master_prompt, client_id, trace_id)

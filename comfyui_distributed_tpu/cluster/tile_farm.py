@@ -40,6 +40,7 @@ from ..utils.logging import debug_log, log
 from ..utils.network import get_client_session, normalize_host_url
 from .job_store import JobStore
 from .job_timeout import check_and_requeue_timed_out_workers
+from .resilience import send_policy, work_request_policy
 
 ProcessFn = Callable[[int, int], np.ndarray]      # (start, end) -> [n,...]
 ProbeFn = Callable[[str], Awaitable[Optional[dict]]]
@@ -217,7 +218,9 @@ class TileFarm:
 
         while True:
             async with self.store.lock:
-                done = len(job.completed) >= job.total_tasks
+                # dead-lettered tasks are terminal: a poison tile bounds
+                # the damage instead of hanging the whole job
+                done = job.is_complete()
                 if holdback_until and any(
                         w != "master" for w in job.worker_status):
                     holdback_until = 0.0    # a worker pulled; master joins
@@ -232,8 +235,21 @@ class TileFarm:
             else:
                 task = await self.store.request_work(job_id, "master")
             if task is not None:
-                arr = await asyncio.to_thread(
-                    process_fn, task["start"], task["end"])
+                try:
+                    arr = await asyncio.to_thread(
+                        process_fn, task["start"], task["end"])
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:
+                    # poison tile on the master itself: bounded requeue,
+                    # then dead-letter — never crash the whole job for
+                    # one range (degradation contract, docs/resilience.md)
+                    live = await self.store.record_task_failure(
+                        job_id, "master", task["task_id"], repr(e))
+                    log(f"tile-farm[{job_id}] task {task['task_id']} "
+                        f"failed on master ({e!r}); "
+                        f"{'requeued' if live else 'dead-lettered'}")
+                    continue
                 await self.store.submit_result(
                     job_id, "master", task["task_id"], {"image": arr})
                 if journal:
@@ -264,6 +280,11 @@ class TileFarm:
         async with self.store.lock:
             results = {tid: payload["image"]
                        for tid, payload in job.completed.items()}
+            dead = list(job.dead_letter.values())
+        if dead:
+            log(f"tile-farm[{job_id}] finished with {len(dead)} "
+                f"dead-lettered tasks: "
+                f"{[d['task_id'] for d in dead]}")
         await self.store.cleanup_job(job_id)
         if journal:
             journal.clear()
@@ -356,22 +377,30 @@ class TileFarm:
         return False
 
     async def _request_work(self, session, base, job_id, worker_id) -> Optional[dict]:
-        """30 s total budget with 404-tolerant retries (reference
-        ``worker_comms.py:124-169``)."""
-        deadline = time.monotonic() + constants.WORK_REQUEST_BUDGET
-        attempt = 0
-        while time.monotonic() < deadline:
-            try:
-                async with session.post(
-                        f"{base}/distributed/request_image",
-                        json={"job_id": job_id, "worker_id": worker_id}) as resp:
-                    if resp.status < 400:
-                        return (await resp.json()).get("task")
-            except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
-                debug_log(f"work request failed ({e}); retrying")
-            attempt += 1
-            await asyncio.sleep(min(constants.SEND_BACKOFF_BASE * (2 ** attempt), 5.0))
-        return None
+        """WORK_REQUEST_BUDGET-bounded, 404-tolerant pull (reference
+        ``worker_comms.py:124-169``) through the unified RetryPolicy:
+        full-jitter backoff instead of the old fixed ladder, so a worker
+        fleet re-polling a restarting master spreads out rather than
+        connecting in lockstep."""
+        async def attempt() -> Optional[dict]:
+            async with session.post(
+                    f"{base}/distributed/request_image",
+                    json={"job_id": job_id, "worker_id": worker_id}) as resp:
+                if resp.status >= 400:
+                    # master mid-restart / job not yet seeded: retryable
+                    err = WorkerError(f"work request {resp.status}",
+                                      worker_id=worker_id)
+                    err.retry_safe = True
+                    raise err
+                return (await resp.json()).get("task")
+
+        try:
+            return await work_request_policy().run(attempt, op="request_work")
+        except (aiohttp.ClientError, asyncio.TimeoutError, OSError,
+                WorkerError) as e:
+            debug_log(f"work request budget exhausted ({e}); "
+                      "treating queue as drained")
+            return None
 
     async def _heartbeat(self, session, base, job_id, worker_id) -> None:
         try:
@@ -430,8 +459,11 @@ class TileFarm:
     async def _post_tiles(self, session, base, job_id, worker_id, group,
                           frame_parts: dict | None = None) -> None:
         url = f"{base}/distributed/submit_tiles"
-        last: Exception | None = None
-        for attempt in range(constants.SEND_MAX_RETRIES):
+
+        async def attempt() -> None:
+            # the form is rebuilt per attempt — aiohttp consumes FormData
+            # on send, and a corrupted payload (crc-rejected by the
+            # master) must be re-encoded from the intact frames
             form = aiohttp.FormData()
             meta_doc = {
                 "job_id": job_id, "worker_id": worker_id,
@@ -446,25 +478,51 @@ class TileFarm:
                 form.add_field(f"tile_{tid}", frame,
                                filename=f"tile_{tid}.cdtf",
                                content_type="application/x-cdt-frame")
-            try:
-                async with session.post(url, data=form,
-                                        headers={"X-CDT-Client": "1"}) as resp:
-                    if resp.status < 400:
-                        return
+            async with session.post(url, data=form,
+                                    headers={"X-CDT-Client": "1"}) as resp:
+                if resp.status >= 400:
                     body = await resp.text()
-                    last = WorkerError(f"{resp.status}: {body[:200]}")
-            except (aiohttp.ClientError, asyncio.TimeoutError, OSError) as e:
-                last = e
-            await asyncio.sleep(constants.SEND_BACKOFF_BASE * (2 ** attempt))
-        raise WorkerError(f"tile submit to {url} failed after retries: {last}")
+                    # submit_result is idempotent on the master, so a
+                    # re-send can never double-record a tile
+                    err = WorkerError(f"{resp.status}: {body[:200]}",
+                                      worker_id=worker_id)
+                    err.retry_safe = True
+                    raise err
+
+        try:
+            await send_policy().run(attempt, op="submit")
+        except (aiohttp.ClientError, asyncio.TimeoutError, OSError,
+                WorkerError) as e:
+            raise WorkerError(
+                f"tile submit to {url} failed after retries: {e}") from e
 
 
 def assemble_tiles(results: dict[int, np.ndarray], total: int,
-                   chunk: int) -> np.ndarray:
-    """{task_id: [n, ch, cw, C]} → ordered [total, ch, cw, C]."""
-    parts: list[np.ndarray] = []
-    for tid in sorted(results):
-        parts.append(np.asarray(results[tid], np.float32))
+                   chunk: int, *,
+                   fallback_fn: "ProcessFn | None" = None) -> np.ndarray:
+    """{task_id: [n, ch, cw, C]} → ordered [total, ch, cw, C].
+
+    ``master_run`` returns only COMPLETED tasks — dead-lettered (poison)
+    tasks are absent. With ``fallback_fn(start, end)`` the missing
+    ranges are filled from a degraded source (e.g. the plain-resized
+    tiles, skipping diffusion) so one poison tile costs one unrefined
+    region instead of the whole job; without it, missing tasks raise a
+    descriptive :class:`TileCollectionError` naming them (never a raw
+    shape/concatenate error)."""
+    n_tasks = -(-total // chunk)
+    filled = dict(results)
+    missing = [tid for tid in range(n_tasks) if tid not in filled]
+    if missing and fallback_fn is not None:
+        for tid in missing:
+            start, end = tid * chunk, min((tid + 1) * chunk, total)
+            filled[tid] = fallback_fn(start, end)
+        log(f"assemble: filled {len(missing)} dead-lettered task(s) "
+            f"{missing} from the degraded fallback")
+    elif missing:
+        raise TileCollectionError(
+            f"tile tasks {missing} missing from results (dead-lettered? "
+            "see the job's dead_letter list in /distributed/job_status)")
+    parts = [np.asarray(filled[tid], np.float32) for tid in sorted(filled)]
     out = np.concatenate(parts, axis=0)
     if out.shape[0] < total:
         raise TileCollectionError(

@@ -391,7 +391,19 @@ class UltimateSDUpscaleDistributed(NodeDef):
                 journal_key=_journal_key(images, spec, seed, 0, 1,
                                          images.shape[0])
                 if _c.TILE_JOURNAL_DIR else None)
-            full = assemble_tiles(results, images.shape[0], 1)
+
+            def _plain_resize(start: int, end: int) -> np.ndarray:
+                # degraded fill for dead-lettered images: plain resize,
+                # no diffusion — one poison image costs one unrefined
+                # frame, not the job
+                from ..ops.resize import upscale_image
+
+                return np.asarray(upscale_image(
+                    images[start:end], spec.scale, spec.resize_method),
+                    np.float32)
+
+            full = assemble_tiles(results, images.shape[0], 1,
+                                  fallback_fn=_plain_resize)
             return (jnp.asarray(full),)
 
         outs = []
@@ -425,7 +437,8 @@ class UltimateSDUpscaleDistributed(NodeDef):
                 journal_key=_journal_key(images[b], spec, seed, b,
                                          plan.chunk, plan.num_tiles)
                 if _c.TILE_JOURNAL_DIR else None)
-            tiles = assemble_tiles(results, plan.num_tiles, plan.chunk)
+            tiles = assemble_tiles(results, plan.num_tiles, plan.chunk,
+                                   fallback_fn=plan.source_range)
             outs.append(upscaler.composite(tiles, plan))
         return (jnp.stack([jnp.asarray(o) for o in outs], axis=0),)
 

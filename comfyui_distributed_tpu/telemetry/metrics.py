@@ -72,7 +72,7 @@ DISPATCH_PAYLOAD_BYTES = REGISTRY.histogram(
 WORKER_PROBES = REGISTRY.counter(
     "cdt_worker_probe_total",
     "Worker health-probe outcomes (orchestration fan-out).",
-    ("outcome",))   # online | offline
+    ("outcome",))   # online | offline | quarantined
 
 MEDIA_SYNC_FILES = REGISTRY.counter(
     "cdt_media_sync_files_total",
@@ -82,6 +82,28 @@ MEDIA_SYNC_FILES = REGISTRY.counter(
 MEDIA_SYNC_BYTES = REGISTRY.counter(
     "cdt_media_sync_bytes_total",
     "Bytes uploaded by media sync.")
+
+# --- resilience (cluster/resilience.py + cluster/faults.py) -----------------
+
+BREAKER_STATE = REGISTRY.gauge(
+    "cdt_worker_breaker_state",
+    "Per-worker circuit breaker state (0=closed, 1=half-open, 2=open).",
+    ("worker",))
+
+BREAKER_TRANSITIONS = REGISTRY.counter(
+    "cdt_worker_breaker_transitions_total",
+    "Breaker state transitions by destination state.",
+    ("to",))   # closed | half_open | open
+
+RETRY_ATTEMPTS = REGISTRY.counter(
+    "cdt_retry_attempts_total",
+    "Retries performed by the unified RetryPolicy, by operation.",
+    ("op",))   # dispatch | request_work | submit | collect | media | ...
+
+FAULTS_INJECTED = REGISTRY.counter(
+    "cdt_faults_injected_total",
+    "Faults injected by the deterministic chaos harness (CDT_FAULTS).",
+    ("op", "kind"))
 
 # --- prompt queue -----------------------------------------------------------
 

@@ -492,9 +492,15 @@ class TileUpscaler:
                     for s in range(start, end, chunk)]       # all async
             return np.concatenate([np.asarray(o) for o in outs], axis=0)
 
+        def source_range(start: int, end: int):
+            import numpy as np
+
+            return np.asarray(all_tiles[start:end], np.float32)
+
         return TileRangePlan(grid=grid, chunk=chunk, run_range=run_range,
                              feather=spec.feather,
-                             flops_per_dispatch=flops_per_dispatch)
+                             flops_per_dispatch=flops_per_dispatch,
+                             source_range=source_range)
 
     def composite(self, tiles, plan: "TileRangePlan"):
         """Blend a complete [T, ch, cw, C] tile set into the output image
@@ -513,6 +519,9 @@ class TileRangePlan:
     run_range: "callable"
     feather: Optional[int]
     flops_per_dispatch: Optional["callable"] = None
+    # degraded fallback for dead-lettered farm tasks: the plain-resized
+    # source crops, no diffusion (cluster/tile_farm.assemble_tiles)
+    source_range: Optional["callable"] = None
 
     @property
     def num_tiles(self) -> int:

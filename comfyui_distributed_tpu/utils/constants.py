@@ -88,6 +88,24 @@ WORK_REQUEST_BUDGET = env_float("CDT_WORK_REQUEST_BUDGET", 30.0)
 # --- retries (reference upscale/worker_comms.py:88-104) --------------------
 SEND_MAX_RETRIES = env_int("CDT_SEND_MAX_RETRIES", 5)
 SEND_BACKOFF_BASE = env_float("CDT_SEND_BACKOFF_BASE", 0.5)
+# Per-sleep ceiling for the unified RetryPolicy's full-jitter backoff
+# (cluster/resilience.py) — exponential growth is clamped here.
+RETRY_CAP_S = env_float("CDT_RETRY_CAP_S", 5.0)
+# Prompt-dispatch re-sends (only for provably-unsent failures; see
+# cluster/dispatch.py idempotency notes). Deliberately smaller than
+# SEND_MAX_RETRIES: orchestration fans out and a slow host should fail
+# over quickly rather than stall the whole prep gather.
+DISPATCH_MAX_RETRIES = env_int("CDT_DISPATCH_MAX_RETRIES", 3)
+
+# --- resilience (cluster/resilience.py, docs/resilience.md) -----------------
+# Per-worker circuit breaker: consecutive failures before the breaker
+# opens, and how long it stays open before admitting one half-open trial.
+BREAKER_FAIL_THRESHOLD = env_int("CDT_BREAKER_FAIL_THRESHOLD", 3)
+BREAKER_RECOVERY_S = env_float("CDT_BREAKER_RECOVERY_S", 30.0)
+# Poison-tile bound: a task evicted/failed more than this many times moves
+# to the job's dead-letter list instead of being requeued forever
+# (surfaced via GET /distributed/job_status).
+MAX_TILE_REQUEUES = env_int("CDT_MAX_TILE_REQUEUES", 3)
 
 # --- mesh / sharding defaults ---------------------------------------------
 # Axis names used across the framework. "dp" shards independent jobs/seeds

@@ -69,7 +69,12 @@ def get_client_session() -> aiohttp.ClientSession:
         )
         _session_loop = loop
         _session_token = token
-    return _session
+    # deterministic chaos harness: when a FaultPlan is active (CDT_FAULTS
+    # or test fixture) every outbound call flows through its injector;
+    # inactive deployments pay one None check (cluster/faults.py)
+    from ..cluster import faults
+
+    return faults.wrap_session(_session)
 
 
 async def close_client_session() -> None:

@@ -25,6 +25,14 @@ const state = {
 
 const $ = (id) => document.getElementById(id);
 
+// circuit-breaker suffix for the worker-card meta line; closed (healthy)
+// stays silent — only a quarantined or probing breaker is news
+function breakerBadge(state) {
+  if (state === "open") return " · ⛔ breaker open";
+  if (state === "half_open") return " · ⚠ breaker half-open";
+  return "";
+}
+
 // ---------------------------------------------------------------------------
 // worker cards
 // ---------------------------------------------------------------------------
@@ -36,13 +44,18 @@ function workerCard(worker) {
   card.className = "worker-card" + (worker.enabled ? "" : " disabled");
 
   const dot = document.createElement("span");
-  dot.className = "dot " + (st.launching ? "launching"
+  // an open breaker (cluster/resilience.py) outranks the probe verdict:
+  // the host is quarantined — orchestration won't even probe it
+  dot.className = "dot " + (st.breaker === "open" ? "offline"
+    : st.launching ? "launching"
     : st.online ? (st.queue_remaining > 0 ? "busy" : "online") : "offline");
-  dot.title = st.online ? `queue: ${st.queue_remaining ?? 0}` : "offline";
+  dot.title = st.breaker === "open" ? "breaker open (quarantined)"
+    : st.online ? `queue: ${st.queue_remaining ?? 0}` : "offline";
 
   const info = document.createElement("div");
   info.className = "info";
   const qr = st.online && st.queue_remaining > 0 ? ` — queue ${st.queue_remaining}` : "";
+  const breaker = breakerBadge(st.breaker);
   info.innerHTML = `
     <div class="name"></div>
     <div class="addr"></div>
@@ -51,7 +64,7 @@ function workerCard(worker) {
   info.querySelector(".addr").textContent = worker.address;
   info.querySelector(".meta").textContent =
     `${worker.type || "auto"}${managed ? ` · pid ${managed.pid}` : ""}` +
-    `${st.online ? " · online" + qr : " · offline"}`;
+    `${st.online ? " · online" + qr : " · offline"}` + breaker;
 
   const toggle = document.createElement("input");
   toggle.type = "checkbox";
@@ -142,6 +155,7 @@ async function pollStatus() {
         online: !!srv.online,
         queue_remaining: srv.queue_remaining,
         launching: srv.launching || (prev.launching && !srv.online),
+        breaker: srv.breaker,
       });
       return;
     }

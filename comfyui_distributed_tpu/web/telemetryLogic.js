@@ -104,5 +104,27 @@ export function telemetryRows(metrics) {
     : "none"]);
   rows.push(["Worker probes", fmtCounts(
     countsByLabel(metrics, "cdt_worker_probe_total", "outcome"))]);
+  rows.push(["Circuit breakers", breakerSummary(metrics)]);
+  const retries = seriesSum(metrics, "cdt_retry_attempts_total");
+  if (retries > 0) rows.push(["Retries", String(retries)]);
   return rows;
+}
+
+// cdt_worker_breaker_state gauge (0=closed, 1=half-open, 2=open) →
+// "3 closed · 1 open (w1)"; names the quarantined workers because that's
+// the first question an operator asks.
+export function breakerSummary(metrics) {
+  const fam = metrics && metrics.cdt_worker_breaker_state;
+  const series = (fam && fam.series) || [];
+  if (!series.length) return "none tracked";
+  const by = { closed: [], half_open: [], open: [] };
+  for (const s of series) {
+    const name = s.value >= 2 ? "open" : s.value >= 1 ? "half_open" : "closed";
+    by[name].push((s.labels || {}).worker || "?");
+  }
+  const parts = [];
+  if (by.closed.length) parts.push(`${by.closed.length} closed`);
+  if (by.half_open.length) parts.push(`${by.half_open.length} half-open (${by.half_open.sort().join(", ")})`);
+  if (by.open.length) parts.push(`${by.open.length} open (${by.open.sort().join(", ")})`);
+  return parts.join(" · ");
 }

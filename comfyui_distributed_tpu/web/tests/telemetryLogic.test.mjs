@@ -3,8 +3,8 @@
 import assert from "node:assert/strict";
 import { test } from "node:test";
 
-import { countsByLabel, fmtSeconds, histQuantile, mergeHistogram,
-         seriesSum, telemetryRows } from "../telemetryLogic.js";
+import { breakerSummary, countsByLabel, fmtSeconds, histQuantile,
+         mergeHistogram, seriesSum, telemetryRows } from "../telemetryLogic.js";
 
 const METRICS = {
   cdt_prompts_total: {
@@ -70,6 +70,26 @@ test("fmtSeconds picks a sane unit", () => {
   assert.equal(fmtSeconds(2.5), "2.50s");
   assert.equal(fmtSeconds(null), "—");
   assert.equal(fmtSeconds(Infinity), ">max");
+});
+
+test("breakerSummary buckets workers by breaker state and names the bad ones", () => {
+  assert.equal(breakerSummary({}), "none tracked");
+  const metrics = {
+    cdt_worker_breaker_state: {
+      type: "gauge",
+      series: [
+        { labels: { worker: "w0" }, value: 0 },
+        { labels: { worker: "w1" }, value: 2 },
+        { labels: { worker: "w2" }, value: 2 },
+        { labels: { worker: "w3" }, value: 1 },
+      ],
+    },
+  };
+  assert.equal(breakerSummary(metrics),
+               "1 closed · 1 half-open (w3) · 2 open (w1, w2)");
+  // telemetryRows carries the row
+  const byKey = Object.fromEntries(telemetryRows(metrics));
+  assert.match(byKey["Circuit breakers"], /2 open \(w1, w2\)/);
 });
 
 test("telemetryRows tolerates absent families and renders the rest", () => {

@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# Chaos tier: run every fault-injection test (pytest -m chaos) with a FIXED
+# seed so a failure replays exactly (docs/resilience.md).
+#
+# The fast chaos cases already ride tier-1 (`-m 'not slow'` picks them up);
+# this script is the dedicated lane: chaos tests ONLY, slow ones included,
+# with the seed pinned and printed so CI logs carry the repro line.
+#
+# Usage: scripts/chaos_suite.sh [extra pytest args...]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+SEED="${CDT_CHAOS_SEED:-42}"
+echo "[chaos] fixed seed: ${SEED} (override with CDT_CHAOS_SEED)"
+echo "[chaos] repro: CDT_CHAOS_SEED=${SEED} scripts/chaos_suite.sh $*"
+
+exec env JAX_PLATFORMS=cpu CDT_CHAOS_SEED="${SEED}" \
+    python -m pytest tests/ -q -m chaos \
+    -p no:cacheprovider --continue-on-collection-errors "$@"

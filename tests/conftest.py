@@ -45,3 +45,31 @@ def tmp_config(tmp_path, monkeypatch):
     config_mod.invalidate_cache()
     yield path
     config_mod.invalidate_cache()
+
+
+@pytest.fixture(autouse=True)
+def _reset_resilience_state():
+    """Circuit breakers and the fault plan are process-global by design
+    (cluster/resilience.py, cluster/faults.py); without a reset, failures
+    a test injects against 'w0' would quarantine 'w0' for every later
+    test in the session."""
+    from comfyui_distributed_tpu.cluster import faults, resilience
+
+    resilience.BREAKERS.reset()
+    faults.deactivate()
+    yield
+    resilience.BREAKERS.reset()
+    faults.deactivate()
+
+
+@pytest.fixture
+def fault_plan():
+    """Activate a seeded FaultPlan for the test; returns an installer:
+    ``plan = fault_plan("probe@0:drop;...")``."""
+    from comfyui_distributed_tpu.cluster import faults
+
+    def install(spec: str):
+        return faults.activate(faults.FaultPlan.parse(spec))
+
+    yield install
+    faults.deactivate()

@@ -191,3 +191,89 @@ class TestCompileCache:
             enable_compile_cache(str(ro / "sub" / "cache"))
         finally:
             ro.chmod(0o700)
+
+
+class TestPartialResultHandler:
+    """Satellite (ISSUE 4): an external overall-timeout (`timeout -k` →
+    SIGTERM, the BENCH_r05 rc=124 shape) must leave the evidence
+    accumulated so far in the results JSON, not an empty file."""
+
+    def test_sigterm_emits_partial_json_before_nonzero_exit(self, tmp_path):
+        import json
+        import signal
+        import subprocess
+        import sys
+        import time
+
+        out = tmp_path / "partial.json"
+        child_src = tmp_path / "child.py"
+        child_src.write_text(f"""
+import importlib.util, sys, time, types
+spec = importlib.util.spec_from_file_location("bench", {str(ROOT / "bench.py")!r})
+bench = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(bench)
+cli = types.SimpleNamespace(out={str(out)!r})
+partial = {{"workload": "txt2img", "tpu_attempts": 2,
+            "tpu_errors": ["tunnel refused", "tunnel refused"],
+            "tpu_error": "tunnel refused"}}
+bench._install_partial_result_handler(cli, partial)
+print("ready", flush=True)
+time.sleep(60)
+""")
+        proc = subprocess.Popen([sys.executable, str(child_src)],
+                                stdout=subprocess.PIPE, text=True)
+        try:
+            assert proc.stdout.readline().strip() == "ready"
+            proc.send_signal(signal.SIGTERM)
+            rc = proc.wait(timeout=30)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+        assert rc == 128 + signal.SIGTERM          # nonzero, conventional
+        doc = json.loads(out.read_text())
+        assert doc["metric"] == "benchmark_partial"
+        assert doc["tpu_attempts"] == 2
+        assert doc["tpu_error"] == "tunnel refused"
+        assert doc["tpu_attempted"] is True
+        assert "signal" in doc["interrupted_by"]
+
+    def test_late_sigterm_does_not_clobber_final_result(self, tmp_path):
+        """Once a real result has been emitted, a late SIGTERM (e.g.
+        `timeout -k` firing during teardown just after success) must exit
+        without rewriting the good JSON as a zeroed partial."""
+        import json
+        import signal
+        import subprocess
+        import sys
+
+        out = tmp_path / "result.json"
+        child_src = tmp_path / "child.py"
+        child_src.write_text(f"""
+import importlib.util, sys, time, types
+spec = importlib.util.spec_from_file_location("bench", {str(ROOT / "bench.py")!r})
+bench = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(bench)
+cli = types.SimpleNamespace(out={str(out)!r})
+partial = {{"workload": "txt2img", "tpu_attempts": 1, "tpu_errors": []}}
+bench._install_partial_result_handler(cli, partial)
+partial["_final_result_emitted"] = True
+bench._emit({{"metric": "img_per_s", "value": 3.5, "unit": "img/s"}}, cli.out)
+print("ready", flush=True)
+time.sleep(60)
+""")
+        proc = subprocess.Popen([sys.executable, str(child_src)],
+                                stdout=subprocess.PIPE, text=True)
+        try:
+            line = proc.stdout.readline().strip()  # _emit echoes the JSON
+            while line and line != "ready":
+                line = proc.stdout.readline().strip()
+            assert line == "ready"
+            proc.send_signal(signal.SIGTERM)
+            rc = proc.wait(timeout=30)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+        assert rc == 128 + signal.SIGTERM
+        doc = json.loads(out.read_text())
+        assert doc["metric"] == "img_per_s"        # not benchmark_partial
+        assert doc["value"] == 3.5

@@ -64,6 +64,124 @@ class TestSelectActiveHosts:
         run(body())
         assert peak <= 3
 
+    def test_probe_exception_counts_as_offline(self, monkeypatch):
+        """An unexpected error inside one probe (e.g. a 200 with a
+        non-JSON body) must not kill the whole fan-out — the host is
+        offline and its breaker records the failure."""
+        from comfyui_distributed_tpu.cluster.resilience import BREAKERS
+
+        async def fake_probe(host, timeout=None):
+            if host["id"] == "w1":
+                raise ValueError("non-JSON health body")
+            return {"queue_remaining": 0}
+
+        monkeypatch.setattr(dispatch_mod, "probe_host", fake_probe)
+
+        async def body():
+            online, offline = await dispatch_mod.select_active_hosts(hosts(3))
+            assert [h["id"] for h in online] == ["w0", "w2"]
+            assert [h["id"] for h in offline] == ["w1"]
+        run(body())
+        assert BREAKERS.get("w1").failures == 1
+
+    def test_cancelled_probe_does_not_leak_half_open_trial(self, monkeypatch):
+        """Cancelling the selection mid-probe while a breaker's single
+        half-open trial slot is consumed must record the outcome: a
+        leaked slot would quarantine the worker until process restart
+        (allow() never re-admits a stuck half_open breaker)."""
+        from comfyui_distributed_tpu.cluster.resilience import BREAKERS
+
+        async def hanging_probe(host, timeout=None):
+            await asyncio.sleep(60)
+
+        monkeypatch.setattr(dispatch_mod, "probe_host", hanging_probe)
+
+        async def body():
+            b = BREAKERS.get("w0")
+            b.recovery_s = 0.0          # immediately half-open eligible
+            BREAKERS.trip("w0")
+            task = asyncio.ensure_future(
+                dispatch_mod.select_active_hosts(hosts(1)))
+            await asyncio.sleep(0.05)   # probe in flight, trial consumed
+            task.cancel()
+            with pytest.raises(asyncio.CancelledError):
+                await task
+            # the trial outcome was recorded (failure → re-opened), so a
+            # later selection round may admit a fresh trial probe
+            assert BREAKERS.get("w0").state != "closed"
+            assert BREAKERS.allow("w0")
+        run(body())
+
+    def test_cancelled_probe_is_not_failure_evidence_when_closed(
+            self, monkeypatch):
+        """Aborting orchestration mid-probe (client disconnect) must not
+        count failures against a healthy host's CLOSED breaker."""
+        from comfyui_distributed_tpu.cluster.resilience import BREAKERS
+
+        async def hanging_probe(host, timeout=None):
+            await asyncio.sleep(60)
+
+        monkeypatch.setattr(dispatch_mod, "probe_host", hanging_probe)
+
+        async def body():
+            task = asyncio.ensure_future(
+                dispatch_mod.select_active_hosts(hosts(1)))
+            await asyncio.sleep(0.05)
+            task.cancel()
+            with pytest.raises(asyncio.CancelledError):
+                await task
+            assert BREAKERS.get("w0").state == "closed"
+            assert BREAKERS.get("w0").failures == 0
+        run(body())
+
+
+class TestDispatchBreakerEvidence:
+    def _worker_error(self, client_rejected=None):
+        from comfyui_distributed_tpu.utils.exceptions import WorkerError
+
+        e = WorkerError("boom", worker_id="w0")
+        if client_rejected is not None:
+            e.client_rejected = client_rejected
+        return e
+
+    def _dispatch_raising(self, monkeypatch, exc):
+        async def fake_once(host, prompt, client_id, extra, trace_id, via_ws):
+            raise exc
+
+        monkeypatch.setattr(dispatch_mod, "_dispatch_prompt_once", fake_once)
+
+    def test_4xx_rejection_does_not_open_breaker(self, monkeypatch):
+        """A worker validating-and-rejecting a bad prompt (HTTP 4xx / WS
+        nack) is ALIVE — re-submitting an invalid workflow N times must
+        not quarantine the healthy fleet."""
+        from comfyui_distributed_tpu.cluster.resilience import BREAKERS
+        from comfyui_distributed_tpu.utils.exceptions import WorkerError
+
+        self._dispatch_raising(
+            monkeypatch, self._worker_error(client_rejected=True))
+
+        async def body():
+            for _ in range(5):
+                with pytest.raises(WorkerError):
+                    await dispatch_mod.dispatch_prompt(hosts(1)[0], {})
+        run(body())
+        assert BREAKERS.get("w0").state == "closed"
+        assert BREAKERS.get("w0").failures == 0
+
+    def test_transport_failures_open_breaker(self, monkeypatch):
+        from comfyui_distributed_tpu.cluster.resilience import BREAKERS
+        from comfyui_distributed_tpu.utils import constants
+        from comfyui_distributed_tpu.utils.exceptions import WorkerError
+
+        self._dispatch_raising(monkeypatch, self._worker_error())
+
+        async def body():
+            for _ in range(constants.BREAKER_FAIL_THRESHOLD):
+                with pytest.raises(WorkerError):
+                    await dispatch_mod.dispatch_prompt(hosts(1)[0], {})
+        run(body())
+        assert BREAKERS.get("w0").state == "open"
+
 
 class TestLeastBusy:
     def test_round_robin_among_idle(self):

@@ -1,0 +1,249 @@
+"""Fault-injection harness tests (cluster/faults.py): spec grammar,
+deterministic injection, and the aiohttp session wrapper over a real
+localhost server. All chaos-marked: scripts/chaos_suite.sh runs them as
+the dedicated lane; they are fast, so tier-1 picks them up too."""
+
+import asyncio
+
+import pytest
+
+from comfyui_distributed_tpu.cluster import faults
+from comfyui_distributed_tpu.cluster.faults import (
+    Fault, FaultPlan, FaultSpecError, op_for_url)
+
+pytestmark = pytest.mark.chaos
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+class TestSpecGrammar:
+    def test_full_grammar(self):
+        plan = FaultPlan.parse(
+            "seed=7;probe@0-1:drop;submit@3:corrupt;"
+            "heartbeat@*:silence;request_work@%0.25:http500=503;"
+            "dispatch@0,2:latency=0.01")
+        assert plan.seed == 7
+        kinds = {(f.op, f.kind) for f in plan.faults}
+        assert ("probe", "drop") in kinds
+        assert ("request_work", "http500") in kinds
+        lat = next(f for f in plan.faults if f.kind == "latency")
+        assert lat.indices == frozenset({0, 2}) and lat.value == 0.01
+        http = next(f for f in plan.faults if f.kind == "http500")
+        assert http.prob == 0.25 and http.value == 503.0
+
+    def test_empty_and_whitespace_clauses_ignored(self):
+        plan = FaultPlan.parse(" ; probe@0:drop ;; ")
+        assert len(plan.faults) == 1
+
+    @pytest.mark.parametrize("bad", [
+        "probe@0",                      # no kind
+        "probe@0:explode",              # unknown kind
+        "probe@x:drop",                 # bad index
+        "probe@5-2:drop",               # empty range
+        "probe@%1.5:drop",              # probability out of range
+        "seed=abc",                     # bad seed
+    ])
+    def test_malformed_specs_raise(self, bad):
+        with pytest.raises(FaultSpecError):
+            FaultPlan.parse(bad)
+
+    def test_op_for_url(self):
+        assert op_for_url("http://h:1/distributed/health") == "probe"
+        assert op_for_url("http://h:1/prompt") == "dispatch"
+        assert op_for_url("http://h:1/distributed/worker_ws") == "dispatch"
+        assert op_for_url("http://h:1/distributed/request_image") == \
+            "request_work"
+        assert op_for_url("http://h:1/distributed/submit_tiles") == "submit"
+        assert op_for_url("http://h:1/distributed/heartbeat") == "heartbeat"
+        assert op_for_url("http://h:1/distributed/job_status?job_id=j") == \
+            "job_status"
+        assert op_for_url("http://h:1/whatever") == "http"
+
+
+class TestDeterminism:
+    def test_index_selectors_fire_at_exact_calls(self):
+        plan = FaultPlan.parse("probe@1,3:drop")
+        hits = [plan.next_fault("probe") is not None for _ in range(5)]
+        assert hits == [False, True, False, True, False]
+        # other ops keep their own counters
+        assert plan.next_fault("submit") is None
+
+    def test_probability_selector_replays_with_same_seed(self):
+        def draw():
+            plan = FaultPlan.parse("seed=42;submit@%0.5:drop")
+            return [plan.next_fault("submit") is not None
+                    for _ in range(32)]
+
+        a, b = draw(), draw()
+        assert a == b               # seeded => identical run-to-run
+        assert any(a) and not all(a)
+
+    def test_star_op_matches_everything(self):
+        plan = FaultPlan.parse("*@0:drop")
+        assert plan.next_fault("probe") is not None
+        assert plan.next_fault("submit") is not None   # its own index 0
+        assert plan.next_fault("probe") is None
+
+    def test_injection_journal(self):
+        plan = FaultPlan.parse("probe@0:drop;submit@1:http500")
+        plan.next_fault("probe")
+        plan.next_fault("submit")
+        plan.next_fault("submit")
+        assert plan.injected == [("probe", 0, "drop"),
+                                 ("submit", 1, "http500")]
+
+    def test_corrupt_bytes_flips_exactly_one_byte(self):
+        plan = FaultPlan([], seed=3)
+        data = bytes(range(64))
+        bad = plan.corrupt_bytes(data)
+        assert len(bad) == len(data)
+        assert sum(a != b for a, b in zip(data, bad)) == 1
+        assert FaultPlan.truncate_bytes(data) == data[:32]
+
+
+class TestActivation:
+    def test_env_spec_activates(self, monkeypatch):
+        monkeypatch.setenv(faults.FAULTS_ENV, "seed=5;probe@0:drop")
+        faults.deactivate()          # force env re-read
+        plan = faults.active_plan()
+        assert plan is not None and plan.seed == 5
+        faults.deactivate()
+        monkeypatch.delenv(faults.FAULTS_ENV)
+        assert faults.active_plan() is None
+
+    def test_wrap_session_is_identity_when_inactive(self):
+        sentinel = object()
+        assert faults.wrap_session(sentinel) is sentinel
+
+
+class TestSessionWrapper:
+    """Faults over a real localhost aiohttp server."""
+
+    def _serve(self):
+        from aiohttp import web
+        from aiohttp.test_utils import TestClient, TestServer
+
+        calls = {"n": 0, "bodies": []}
+
+        async def echo(request):
+            calls["n"] += 1
+            calls["bodies"].append(await request.read())
+            return web.json_response({"ok": True, "n": calls["n"]})
+
+        app = web.Application()
+        app.router.add_post("/distributed/heartbeat", echo)
+        app.router.add_post("/distributed/submit_tiles", echo)
+        app.router.add_get("/distributed/health", echo)
+        return calls, TestClient(TestServer(app))
+
+    def test_drop_latency_500_silence(self, fault_plan):
+        import aiohttp
+
+        plan = fault_plan("heartbeat@0:drop;heartbeat@1:http500=502;"
+                          "heartbeat@2:silence")
+
+        async def body():
+            calls, client = self._serve()
+            async with client:
+                base = f"http://127.0.0.1:{client.port}"
+                session = faults.wrap_session(client.session)
+                url = f"{base}/distributed/heartbeat"
+                # call 0: dropped before the wire
+                with pytest.raises(aiohttp.ClientConnectionError):
+                    async with session.post(url, json={}):
+                        pass
+                # call 1: synthetic 502, never reaches the server
+                async with session.post(url, json={}) as resp:
+                    assert resp.status == 502
+                # call 2: silenced — fake 200, server never sees it
+                async with session.post(url, json={}) as resp:
+                    assert resp.status == 200
+                    assert (await resp.json())["status"] == "ok"
+                assert calls["n"] == 0
+                # call 3: no fault left — real round trip
+                async with session.post(url, json={}) as resp:
+                    assert (await resp.json())["ok"] is True
+                assert calls["n"] == 1
+            assert [k for _, _, k in plan.injected] == \
+                ["drop", "http500", "silence"]
+        run(body())
+
+    def test_corrupt_mutates_formdata_frame_only(self, fault_plan):
+        import json
+
+        import aiohttp
+
+        fault_plan("submit@0:corrupt")
+
+        async def body():
+            calls, client = self._serve()
+            async with client:
+                base = f"http://127.0.0.1:{client.port}"
+                session = faults.wrap_session(client.session)
+                frame = bytes(range(256)) * 4
+
+                def form():
+                    f = aiohttp.FormData()
+                    f.add_field("tiles_metadata",
+                                json.dumps({"job_id": "j"}),
+                                content_type="application/json")
+                    f.add_field("tile_0", frame, filename="tile_0.cdtf",
+                                content_type="application/x-cdt-frame")
+                    return f
+
+                url = f"{base}/distributed/submit_tiles"
+                async with session.post(url, data=form()) as resp:
+                    assert resp.status == 200
+                async with session.post(url, data=form()) as resp:
+                    assert resp.status == 200
+                first, second = calls["bodies"]
+                # metadata survived intact both times
+                assert b'{"job_id": "j"}' in first
+                assert b'{"job_id": "j"}' in second
+                # the frame bytes differ exactly once (call 0 corrupted)
+                assert first != second
+                assert frame in second and frame not in first
+        run(body())
+
+    def test_latency_defers_but_delivers(self, fault_plan):
+        import time
+
+        fault_plan("probe@0:latency=0.2")
+
+        async def body():
+            calls, client = self._serve()
+            async with client:
+                base = f"http://127.0.0.1:{client.port}"
+                session = faults.wrap_session(client.session)
+                t0 = time.monotonic()
+                async with session.get(
+                        f"{base}/distributed/health") as resp:
+                    assert resp.status == 200
+                assert time.monotonic() - t0 >= 0.2
+                assert calls["n"] == 1
+        run(body())
+
+
+class TestFaultyJobStore:
+    def test_store_ops_consult_plan(self):
+        from comfyui_distributed_tpu.cluster.faults import FaultyJobStore
+        from comfyui_distributed_tpu.cluster.job_store import JobStore
+
+        async def body():
+            plan = FaultPlan.parse(
+                "store.request_work@0:drop;store.submit@0:silence;"
+                "store.heartbeat@*:drop")
+            store = FaultyJobStore(JobStore(), plan)
+            await store.init_tile_job("j", 2, chunk=1)
+            assert await store.request_work("j", "w0") is None  # dropped
+            task = await store.request_work("j", "w0")          # real
+            assert task is not None
+            assert not await store.submit_result(                # swallowed
+                "j", "w0", task["task_id"], {"x": 1})
+            assert task["task_id"] not in store.tile_jobs["j"].completed
+            assert await store.submit_result(                    # real
+                "j", "w0", task["task_id"], {"x": 1})
+            assert not await store.heartbeat("j", "w0")          # silenced
+        run(body())

@@ -128,7 +128,8 @@ class TestTileJobs:
             await store.init_tile_job("t1", 3)
             s = await store.job_status("t1")
             assert s == {"exists": True, "kind": "tile", "mode": "static",
-                         "pending": 3, "completed": 0, "total": 3}
+                         "pending": 3, "completed": 0, "total": 3,
+                         "dead_letter": []}
             await store.prepare_collector_job("c1")
             assert (await store.job_status("c1"))["kind"] == "collector"
         run(body())
@@ -240,4 +241,59 @@ class TestTimeoutRequeue:
         async def body():
             assert await check_and_requeue_timed_out_workers(
                 JobStore(), "zzz", timeout=1) == {}
+        run(body())
+
+
+class TestDeadLetter:
+    """Bounded requeues + dead-letter semantics (docs/resilience.md)."""
+
+    def test_late_result_resurrects_dead_lettered_task(self):
+        async def body():
+            store = JobStore()
+            await store.init_tile_job("dl", 2, chunk=1)
+            t = await store.request_work("dl", "w1")
+            await store.requeue_worker_tasks("dl", "w1", max_requeues=0)
+            job = store.tile_jobs["dl"]
+            assert t["task_id"] in job.dead_letter
+            # a revived worker's real result always wins
+            ok = await store.submit_result("dl", "w1", t["task_id"], {"x": 1})
+            assert ok
+            assert t["task_id"] not in job.dead_letter
+            assert t["task_id"] in job.completed
+        run(body())
+
+    def test_master_failure_requeues_to_back_then_dead_letters(self):
+        async def body():
+            store = JobStore()
+            await store.init_tile_job("mf", 3, chunk=1)
+            t = await store.request_work("mf", "master")
+            live = await store.record_task_failure(
+                "mf", "master", t["task_id"], "boom", max_requeues=1)
+            assert live
+            job = store.tile_jobs["mf"]
+            # requeued to the BACK: other tasks get a chance first
+            assert job.pending[-1].task_id == t["task_id"]
+            live = await store.record_task_failure(
+                "mf", "master", t["task_id"], "boom", max_requeues=1)
+            assert not live
+            assert t["task_id"] in job.dead_letter
+            assert all(p.task_id != t["task_id"] for p in job.pending)
+        run(body())
+
+    def test_finished_summary_survives_cleanup_and_is_bounded(self):
+        async def body():
+            store = JobStore()
+            await store.init_tile_job("fin", 1, chunk=1)
+            t = await store.request_work("fin", "w1")
+            await store.requeue_worker_tasks("fin", "w1", max_requeues=0)
+            await store.cleanup_job("fin")
+            status = await store.job_status("fin")
+            assert status["exists"] is False and status["finished"] is True
+            assert status["dead_letter"][0]["task_id"] == t["task_id"]
+            # FIFO bound: old summaries age out
+            for i in range(store.MAX_FINISHED + 5):
+                await store.init_tile_job(f"j{i}", 1, chunk=1)
+                await store.cleanup_job(f"j{i}")
+            assert len(store.finished) == store.MAX_FINISHED
+            assert (await store.job_status("fin")) == {"exists": False}
         run(body())

@@ -1,0 +1,384 @@
+"""Unit tests for the resilience layer (cluster/resilience.py): retry
+policy bounds/jitter/idempotency, circuit-breaker state machine (driven
+both directly and by injected faults), breaker-gated host selection, and
+the job_timeout busy-grace + bounded-requeue paths."""
+
+import asyncio
+import random
+import time
+
+import pytest
+
+from comfyui_distributed_tpu.cluster import resilience
+from comfyui_distributed_tpu.cluster.resilience import (
+    BREAKERS, CircuitBreaker, RetryPolicy, is_retryable,
+    send_policy, work_request_policy)
+from comfyui_distributed_tpu.utils.exceptions import WorkerError
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+class TestRetryPolicy:
+    def test_needs_some_bound(self):
+        with pytest.raises(ValueError, match="max_attempts or budget_s"):
+            RetryPolicy(max_attempts=None, budget_s=None)
+
+    def test_full_jitter_bounds_and_determinism(self):
+        p = RetryPolicy(max_attempts=8, base=0.5, cap=5.0)
+        r1, r2 = random.Random(7), random.Random(7)
+        d1 = [p.delay(a, r1) for a in range(8)]
+        d2 = [p.delay(a, r2) for a in range(8)]
+        assert d1 == d2                       # seeded => reproducible
+        for a, d in enumerate(d1):
+            assert 0.0 <= d <= min(5.0, 0.5 * 2 ** a)
+        # jitter actually varies (full jitter, not fixed ladder)
+        assert len({round(d, 6) for d in d1}) > 1
+
+    def test_no_jitter_is_the_fixed_ladder(self):
+        p = RetryPolicy(max_attempts=5, base=0.5, cap=5.0, jitter=False)
+        assert [p.delay(a) for a in range(5)] == [0.5, 1.0, 2.0, 4.0, 5.0]
+
+    def test_retries_then_succeeds(self):
+        calls = []
+
+        async def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise OSError("transient")
+            return "ok"
+
+        async def no_sleep(d):
+            pass
+
+        p = RetryPolicy(max_attempts=5, base=0.01)
+        assert run(p.run(flaky, sleep=no_sleep)) == "ok"
+        assert len(calls) == 3
+
+    def test_attempt_bound_reraises_last(self):
+        async def always():
+            raise OSError("down")
+
+        async def no_sleep(d):
+            pass
+
+        p = RetryPolicy(max_attempts=3, base=0.001)
+        with pytest.raises(OSError, match="down"):
+            run(p.run(always, sleep=no_sleep))
+
+    def test_budget_bound(self):
+        calls = []
+
+        async def always():
+            calls.append(time.monotonic())
+            raise OSError("down")
+
+        p = RetryPolicy(max_attempts=None, base=0.01, cap=0.02,
+                        budget_s=0.15)
+        t0 = time.monotonic()
+        with pytest.raises(OSError):
+            run(p.run(always))
+        assert time.monotonic() - t0 < 2.0
+        assert len(calls) >= 2                # it did retry inside budget
+
+    def test_idempotency_marker_stops_retries(self):
+        """retry_safe=False must never be retried — the WS-acked dispatch
+        double-run guard."""
+        calls = []
+
+        async def unsafe():
+            calls.append(1)
+            e = WorkerError("ack lost after send")
+            e.retry_safe = False
+            raise e
+
+        p = RetryPolicy(max_attempts=5, base=0.001)
+        with pytest.raises(WorkerError):
+            run(p.run(unsafe))
+        assert len(calls) == 1
+
+    def test_explicit_retry_safe_true_retries_nontransport_errors(self):
+        calls = []
+
+        async def flagged():
+            calls.append(1)
+            if len(calls) < 2:
+                e = WorkerError("404 job not seeded yet")
+                e.retry_safe = True
+                raise e
+            return 42
+
+        async def no_sleep(d):
+            pass
+
+        p = RetryPolicy(max_attempts=3, base=0.001)
+        assert run(p.run(flagged, sleep=no_sleep)) == 42
+
+    def test_nonretryable_raises_immediately(self):
+        calls = []
+
+        async def typo():
+            calls.append(1)
+            raise ValueError("programming error")
+
+        p = RetryPolicy(max_attempts=5, base=0.001)
+        with pytest.raises(ValueError):
+            run(p.run(typo))
+        assert len(calls) == 1
+
+    def test_cancellation_propagates(self):
+        async def body():
+            async def hang():
+                raise asyncio.CancelledError()
+
+            p = RetryPolicy(max_attempts=5, base=0.001)
+            with pytest.raises(asyncio.CancelledError):
+                await p.run(hang)
+        run(body())
+
+    def test_default_predicate(self):
+        import aiohttp
+
+        assert is_retryable(OSError())
+        assert is_retryable(asyncio.TimeoutError())
+        assert is_retryable(aiohttp.ClientConnectionError())
+        assert not is_retryable(ValueError())
+        e = ValueError()
+        e.retry_safe = True
+        assert is_retryable(e)
+
+    def test_named_policies_read_live_constants(self, monkeypatch):
+        from comfyui_distributed_tpu.utils import constants
+
+        monkeypatch.setattr(constants, "SEND_MAX_RETRIES", 9)
+        monkeypatch.setattr(constants, "WORK_REQUEST_BUDGET", 1.25)
+        assert send_policy().max_attempts == 9
+        wp = work_request_policy()
+        assert wp.max_attempts is None and wp.budget_s == 1.25
+
+
+class TestCircuitBreaker:
+    def test_closed_to_open_on_threshold(self):
+        b = CircuitBreaker(failure_threshold=3, recovery_s=60.0)
+        assert b.state == "closed" and b.allow()
+        b.record_failure()
+        b.record_failure()
+        assert b.state == "closed"            # below threshold
+        b.record_failure()
+        assert b.state == "open"
+        assert not b.allow()                  # quarantined
+
+    def test_success_resets_failure_streak(self):
+        b = CircuitBreaker(failure_threshold=2, recovery_s=60.0)
+        b.record_failure()
+        b.record_success()
+        b.record_failure()
+        assert b.state == "closed"            # streak broken, not cumulative
+
+    def test_open_halfopen_closed_cycle(self):
+        now = [0.0]
+        b = CircuitBreaker(failure_threshold=1, recovery_s=10.0,
+                           clock=lambda: now[0])
+        b.record_failure()
+        assert b.state == "open" and not b.allow()
+        now[0] = 10.0                          # recovery elapsed
+        assert b.state == "half_open"
+        assert b.allow()                       # the single trial slot
+        assert not b.allow()                   # second caller still barred
+        b.record_success()
+        assert b.state == "closed" and b.allow()
+
+    def test_halfopen_failure_reopens_and_rearms(self):
+        now = [0.0]
+        b = CircuitBreaker(failure_threshold=1, recovery_s=10.0,
+                           clock=lambda: now[0])
+        b.record_failure()
+        now[0] = 10.0
+        assert b.allow()                       # trial admitted
+        b.record_failure()                     # trial failed
+        assert b.state == "open"
+        now[0] = 15.0                          # clock re-armed at t=10
+        assert not b.allow()
+        now[0] = 20.0
+        assert b.allow()                       # next trial window
+
+    def test_trip_forces_open(self):
+        b = CircuitBreaker(failure_threshold=99, recovery_s=60.0)
+        b.trip()
+        assert b.state == "open" and not b.allow()
+
+    def test_transitions_under_injected_store_faults(self):
+        """Breaker driven through the registry by deterministic faults:
+        a FaultyJobStore that errors N times trips the breaker open,
+        recovery admits a trial, success closes it."""
+        from comfyui_distributed_tpu.cluster.faults import (
+            FaultPlan, FaultyJobStore)
+        from comfyui_distributed_tpu.cluster.job_store import JobStore
+        from comfyui_distributed_tpu.utils.exceptions import JobQueueError
+
+        async def body():
+            plan = FaultPlan.parse("seed=1;store.request_work@0-2:http500")
+            store = FaultyJobStore(JobStore(), plan)
+            await store._store.init_tile_job("j", 4, chunk=1)
+            reg = resilience.BreakerRegistry(failure_threshold=3,
+                                             recovery_s=0.05)
+            for _ in range(3):
+                try:
+                    await store.request_work("j", "w0")
+                    reg.record("w0", True)
+                except JobQueueError:
+                    reg.record("w0", False)
+            assert reg.state("w0") == "open"
+            assert not reg.allow("w0")
+            await asyncio.sleep(0.06)          # recovery window
+            assert reg.state("w0") == "half_open"
+            assert reg.allow("w0")             # trial (fault indices spent)
+            task = await store.request_work("j", "w0")
+            assert task is not None
+            reg.record("w0", True)
+            assert reg.state("w0") == "closed"
+        run(body())
+
+
+class TestBreakerRegistry:
+    def test_states_and_gauge_export(self):
+        from comfyui_distributed_tpu.telemetry import REGISTRY
+
+        BREAKERS.record("wa", True)
+        BREAKERS.trip("wb")
+        states = BREAKERS.states()
+        assert states["wa"] == "closed" and states["wb"] == "open"
+        snap = REGISTRY.snapshot()["cdt_worker_breaker_state"]
+        by_worker = {s["labels"]["worker"]: s["value"]
+                     for s in snap["series"]}
+        assert by_worker["wa"] == 0 and by_worker["wb"] == 2
+
+    def test_reset_isolates_tests(self):
+        BREAKERS.trip("wz")
+        BREAKERS.reset()
+        assert BREAKERS.state("wz") == "closed"
+
+
+class TestBreakerGatedSelection:
+    def test_open_breaker_skips_probe_entirely(self, monkeypatch):
+        """select_active_hosts must not probe a quarantined host — the
+        whole point is skipping the PROBE_TIMEOUT stall."""
+        from comfyui_distributed_tpu.cluster import dispatch
+
+        probed = []
+
+        async def fake_probe(host, timeout=None):
+            probed.append(host["id"])
+            return {"queue_remaining": 0}
+
+        monkeypatch.setattr(dispatch, "probe_host", fake_probe)
+        BREAKERS.trip("w_dead")
+        hosts = [{"id": "w_ok", "address": "http://x:1"},
+                 {"id": "w_dead", "address": "http://x:2"}]
+        online, offline = run(dispatch.select_active_hosts(hosts))
+        assert [h["id"] for h in online] == ["w_ok"]
+        assert [h["id"] for h in offline] == ["w_dead"]
+        assert offline[0]["_breaker"] == "open"
+        assert probed == ["w_ok"]
+
+    def test_probe_outcomes_feed_breaker(self, monkeypatch):
+        from comfyui_distributed_tpu.cluster import dispatch
+        from comfyui_distributed_tpu.utils import constants
+
+        monkeypatch.setattr(constants, "BREAKER_FAIL_THRESHOLD", 2)
+
+        async def dead_probe(host, timeout=None):
+            return None
+
+        monkeypatch.setattr(dispatch, "probe_host", dead_probe)
+        hosts = [{"id": "w_flap", "address": "http://x:1"}]
+        run(dispatch.select_active_hosts(hosts))
+        assert BREAKERS.state("w_flap") == "closed"     # 1 failure
+        run(dispatch.select_active_hosts(hosts))
+        assert BREAKERS.state("w_flap") == "open"       # threshold hit
+
+
+class TestJobTimeoutResilience:
+    def test_busy_grace_spares_and_refreshes_heartbeat(self):
+        """Satellite: the silent-but-busy worker is spared AND its
+        heartbeat is actually refreshed (so the next sweep doesn't
+        instantly re-suspect it), and its breaker stays closed."""
+        from comfyui_distributed_tpu.cluster.job_store import JobStore
+        from comfyui_distributed_tpu.cluster.job_timeout import (
+            check_and_requeue_timed_out_workers)
+
+        async def body():
+            store = JobStore()
+            await store.init_tile_job("jg", 4, chunk=2)
+            task = await store.request_work("jg", "wbusy")
+            assert task is not None
+            stale_hb = store.tile_jobs["jg"].worker_status["wbusy"]
+
+            async def busy_probe(worker_id):
+                return {"queue_remaining": 2}
+
+            evicted = await check_and_requeue_timed_out_workers(
+                store, "jg", timeout=0.0, probe_fn=busy_probe,
+                now=time.monotonic() + 100)
+            assert evicted == {}
+            job = store.tile_jobs["jg"]
+            assert task["task_id"] in job.assigned          # still theirs
+            assert job.worker_status["wbusy"] > stale_hb    # refreshed
+            assert BREAKERS.state("wbusy") == "closed"
+        run(body())
+
+    def test_eviction_trips_breaker_and_requeues(self):
+        from comfyui_distributed_tpu.cluster.job_store import JobStore
+        from comfyui_distributed_tpu.cluster.job_timeout import (
+            check_and_requeue_timed_out_workers)
+
+        async def body():
+            store = JobStore()
+            await store.init_tile_job("je", 4, chunk=2)
+            task = await store.request_work("je", "wdead")
+
+            async def dead_probe(worker_id):
+                return None
+
+            evicted = await check_and_requeue_timed_out_workers(
+                store, "je", timeout=0.0, probe_fn=dead_probe,
+                now=time.monotonic() + 100)
+            assert evicted == {"wdead": [task["task_id"]]}
+            assert BREAKERS.state("wdead") == "open"
+            # requeued to the FRONT of pending
+            assert store.tile_jobs["je"].pending[0].task_id == task["task_id"]
+        run(body())
+
+    def test_requeue_bound_dead_letters_poison_task(self):
+        """A task evicted more than max_requeues times dead-letters
+        instead of cycling forever, and the job's completion accounting
+        treats it as terminal."""
+        from comfyui_distributed_tpu.cluster.job_store import JobStore
+
+        async def body():
+            store = JobStore()
+            await store.init_tile_job("jp", 2, chunk=1)
+            poison = None
+            for round_i in range(3):
+                task = await store.request_work("jp", f"w{round_i}")
+                poison = task["task_id"] if poison is None else poison
+                assert task["task_id"] == poison    # front-requeued
+                requeued = await store.requeue_worker_tasks(
+                    "jp", f"w{round_i}", max_requeues=2)
+                if round_i < 2:
+                    assert requeued == [poison]
+                else:
+                    assert requeued == []          # bound exceeded
+            job = store.tile_jobs["jp"]
+            assert poison in job.dead_letter
+            entry = job.dead_letter[poison]
+            assert entry["requeues"] == 3 and "max_requeues" in entry["reason"]
+            # terminal accounting: completing the OTHER task finishes it
+            other = await store.request_work("jp", "wok")
+            await store.submit_result("jp", "wok", other["task_id"], {"x": 1})
+            assert job.is_complete()
+            # and the status surface carries the forensics
+            status = await store.job_status("jp")
+            assert status["dead_letter"][0]["task_id"] == poison
+        run(body())

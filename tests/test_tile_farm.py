@@ -43,9 +43,29 @@ class TestAssemble:
         assert out.shape == (3, 4, 4, 3)
         assert out[0].max() == 0.0 and out[2].max() == 9.0
 
-    def test_shortage_raises(self):
-        with pytest.raises(TileCollectionError, match="expected 4"):
+    def test_shortage_raises_naming_missing_tasks(self):
+        with pytest.raises(TileCollectionError, match=r"tasks \[1\] missing"):
             assemble_tiles({0: np.zeros((2, 4, 4, 3))}, total=4, chunk=2)
+
+    def test_all_missing_raises_domain_error(self):
+        # never a raw np.concatenate ValueError, even with zero results
+        with pytest.raises(TileCollectionError, match=r"tasks \[0, 1\]"):
+            assemble_tiles({}, total=4, chunk=2)
+
+    def test_fallback_fills_dead_lettered_tasks(self):
+        """A dead-lettered (poison) task's range comes from the degraded
+        fallback; completed tasks keep their real results."""
+        def fallback(start, end):
+            return np.full((end - start, 4, 4, 3), -1.0, np.float32)
+
+        results = {0: np.zeros((2, 4, 4, 3)), 2: np.full((1, 4, 4, 3), 5.0)}
+        out = assemble_tiles(results, total=5, chunk=2,
+                             fallback_fn=fallback)
+        assert out.shape == (5, 4, 4, 3)
+        assert out[0].max() == 0.0          # task 0: real
+        assert out[2].min() == -1.0         # task 1 (tiles 2-3): fallback
+        assert out[3].min() == -1.0
+        assert out[4].max() == 5.0          # task 2 (trailing, short): real
 
 
 class TestMasterOnly:
