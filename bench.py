@@ -77,20 +77,23 @@ def _cost_analysis_flops(compiled) -> float | None:
 
 
 def _enable_compile_cache() -> None:
-    """Persistent XLA compilation cache: on the flaky tunneled accelerator,
-    a successful compile from ANY earlier attempt (even one whose run died
-    later) is reused, so watcher retries make monotonic progress."""
-    import jax
+    """Persistent XLA compilation cache via the ONE shared config path
+    (``utils/compile_cache.enable_compile_cache`` — same knobs as the
+    server and the warmup pass): on the flaky tunneled accelerator, a
+    successful compile from ANY earlier attempt (even one whose run died
+    later) is reused, so watcher retries make monotonic progress.
+    ``min_compile_secs=0.0``: bench wants every program persisted.
+    Bench keeps its historical tmpdir default when the env var is unset
+    (attempt subprocesses share it; a user HOME may not exist on CI)."""
+    from comfyui_distributed_tpu.utils.compile_cache import \
+        enable_compile_cache
 
     cache_dir = os.environ.get(
         "CDT_COMPILE_CACHE_DIR",
         os.path.join(tempfile.gettempdir(), "cdt_xla_cache"))
-    try:
-        os.makedirs(cache_dir, exist_ok=True)
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
-    except Exception as e:  # cache is an optimization, never a requirement
-        print(f"[bench] compile cache unavailable: {e}", file=sys.stderr)
+    if enable_compile_cache(cache_dir, min_compile_secs=0.0) is None:
+        print("[bench] compile cache unavailable (continuing without)",
+              file=sys.stderr)
 
 
 
@@ -212,6 +215,18 @@ def run_benchmark(steps: int, runs: int | None, force_cpu: bool) -> dict:
             y if y is not None else jnp.zeros((1, 1)),
             uy if uy is not None else jnp.zeros((1, 1)))
 
+    # honesty flag for the cold-vs-warm fields below: the persistent
+    # cache survives across attempts/runs BY DESIGN (watcher retries),
+    # so on a re-run the "cold" compile below is really a cache load —
+    # the artifact says so instead of overstating the delta
+    _cache_dir = os.environ.get(
+        "CDT_COMPILE_CACHE_DIR",
+        os.path.join(tempfile.gettempdir(), "cdt_xla_cache"))
+    try:
+        cache_prepopulated = bool(os.listdir(_cache_dir))
+    except OSError:
+        cache_prepopulated = False
+
     # compile (timed separately) + cost analysis for the MFU estimate.
     # Weights are explicit jit arguments (fn.weights) — passing them
     # through lower() keeps multi-GB params out of the lowered module.
@@ -236,6 +251,16 @@ def run_benchmark(steps: int, runs: int | None, force_cpu: bool) -> dict:
     except Exception as e:  # diagnostics must never sink the benchmark
         print(f"[bench] analytic flops estimate failed: {e}",
               file=sys.stderr)
+
+    # warm-restart probe (ISSUE 6): drop jax's in-memory executable
+    # caches and AOT-compile the same program again — with the
+    # persistent cache now populated this measures the cache-LOAD cost a
+    # rolling restart pays, vs the full compile above. The gap is the
+    # cold-start elimination win the warmup pass banks per shape.
+    jax.clear_caches()
+    t0 = time.perf_counter()
+    fn.jitted.lower(fn.weights, *args).compile()
+    warm_compile_s = time.perf_counter() - t0
 
     # warmup run (first execution pays allocator/init overhead)
     jax.block_until_ready(compiled(fn.weights, *args))
@@ -283,6 +308,15 @@ def run_benchmark(steps: int, runs: int | None, force_cpu: bool) -> dict:
         "median_image_latency_s": round(median, 3),
         "median_step_time_s": round(median / spec.steps, 4),
         "compile_s": round(compile_s, 1),
+        # cold vs warm-restart time-to-first-image: compile_s is the
+        # cold path ONLY when compile_cache_prepopulated is false;
+        # compile_warm_restart_s re-AOT-compiles after
+        # jax.clear_caches() with the persistent cache populated — the
+        # cost a restarted worker actually pays per shape
+        "compile_cache_prepopulated": cache_prepopulated,
+        "compile_warm_restart_s": round(warm_compile_s, 2),
+        "ttfi_cold_s": round(compile_s + median, 2),
+        "ttfi_warm_restart_s": round(warm_compile_s + median, 2),
         "run_times_s": [round(t, 3) for t in times],
     }
     if note:

@@ -103,6 +103,9 @@ def register(router, controller) -> None:
                 # circuit-breaker verdict (cluster/resilience.py): the
                 # dashboard badges quarantined hosts without probing them
                 "breaker": BREAKERS.state(wid),
+                # AOT warmup state (diffusion/warmup.py): the dashboard
+                # badges workers still compiling their catalog
+                "warmup": None,
             }
             host = hosts.get(wid)
             if host:
@@ -111,6 +114,7 @@ def register(router, controller) -> None:
                 if health is not None:
                     entry["online"] = True
                     entry["queue_remaining"] = health.get("queue_remaining")
+                    entry["warmup"] = health.get("warmup")
             return wid, entry
 
         results = await asyncio.gather(*(status_one(w) for w in ids))
@@ -173,6 +177,36 @@ def register(router, controller) -> None:
             })
         return ws
 
+    async def warmup_start(request):
+        """Kick an AOT warmup pass (``diffusion/warmup.py``): walk the
+        shape catalog and pre-lower/pre-compile every program off the
+        request path. Body (all optional): ``{"models": [...], "wait":
+        bool}`` — ``models`` restricts which bundles warm (the fleet
+        default is ``CDT_WARMUP_MODELS``), ``wait`` blocks until the
+        pass finishes and returns the full per-program report."""
+        body = {}
+        if request.can_read_body:
+            body = await _json(request)
+        models = body.get("models")
+        if models is not None and (
+                not isinstance(models, list)
+                or not all(isinstance(m, str) for m in models)):
+            raise ValidationError("'models' must be a list of strings")
+        loop = asyncio.get_running_loop()
+        run = lambda: controller.warmup.run(models=models)
+        if body.get("wait"):
+            return web.json_response(await loop.run_in_executor(None, run))
+        # fire-and-poll: compiling in a thread keeps the control plane
+        # responsive; GET /distributed/warmup reports progress
+        controller._warmup_task = loop.run_in_executor(None, run)
+        return web.json_response({"state": controller.warmup.state,
+                                  "started": True})
+
+    async def warmup_status(request):
+        return web.json_response(controller.warmup.status())
+
+    router.add_post("/distributed/warmup", warmup_start)
+    router.add_get("/distributed/warmup", warmup_status)
     router.add_post("/distributed/launch_worker", launch_worker)
     router.add_post("/distributed/stop_worker", stop_worker)
     router.add_get("/distributed/managed_workers", managed_workers)

@@ -59,6 +59,13 @@ class Controller:
         self.worker_index = int(os.environ.get("CDT_WORKER_INDEX", "0") or 0)
         from .progress import ProgressTracker
         self.progress = ProgressTracker()
+        # AOT warmup state machine (diffusion/warmup.py): health probes
+        # report cold/warming/ready and the dispatcher prefers hot hosts
+        from ..diffusion.warmup import WarmupManager
+
+        self.warmup = WarmupManager(lambda: self.model_registry,
+                                    lambda: self.mesh)
+        self._warmup_task = None
 
     def load_config(self) -> dict:
         return load_config(self.config_path)
@@ -130,6 +137,14 @@ class Controller:
             # flag (reference handshake, api/worker_routes.py:115-139);
             # reference kept so the task can't be GC'd before running
             self._ready_task = asyncio.ensure_future(self._report_ready())
+        if os.environ.get("CDT_WARMUP", "") not in ("", "0", "false"):
+            # AOT warmup off the request path: compiles run in their own
+            # thread (NOT the graph-exec pool — a dispatched prompt must
+            # not queue behind the whole catalog); health reports
+            # "warming" until the pass finishes, so the master's
+            # dispatcher steers work to already-hot peers meanwhile
+            self._warmup_task = self.loop.run_in_executor(
+                None, self.warmup.run)
 
     async def _report_ready(self) -> None:
         import aiohttp
@@ -167,6 +182,9 @@ class Controller:
             "queue_remaining": self.queue.queue_remaining,
             "executing": self.queue.executing,
             "machine_id": machine_id(),
+            # cold | warming | ready | error — dispatch prefers hosts
+            # that are not mid-warmup (cluster/dispatch.py)
+            "warmup": self.warmup.state,
         }
 
     def system_info_no_devices(self) -> dict:

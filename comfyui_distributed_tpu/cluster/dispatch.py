@@ -96,15 +96,29 @@ def queue_depth(host: dict) -> int:
     return int((host.get("_probe") or {}).get("queue_remaining", 0))
 
 
+def is_hot(host: dict) -> bool:
+    """A host mid-warmup ("warming") would stall the job behind the rest
+    of its catalog compile pass; everything else — "ready", "cold"
+    (warmup not configured), or a pre-warmup peer without the field —
+    keeps the old behavior."""
+    return (host.get("_probe") or {}).get("warmup") != "warming"
+
+
 def select_least_busy_host(online_hosts: Sequence[dict]) -> Optional[dict]:
     """Round-robin among idle hosts; else min queue depth (reference
-    ``select_least_busy_worker``, ``dispatch.py:204-268``)."""
+    ``select_least_busy_worker``, ``dispatch.py:204-268``). Hot hosts
+    (AOT-warmed / not mid-warmup) are preferred at every tier — a
+    rolling restart drains traffic toward workers that won't pay a
+    cold compile, falling back to warming hosts only when they are all
+    that's online."""
     if not online_hosts:
         return None
     idle = [h for h in online_hosts if queue_depth(h) == 0]
     if idle:
-        return idle[next(_rr_counter) % len(idle)]
-    return min(online_hosts, key=queue_depth)
+        hot = [h for h in idle if is_hot(h)] or idle
+        return hot[next(_rr_counter) % len(hot)]
+    hot = [h for h in online_hosts if is_hot(h)] or list(online_hosts)
+    return min(hot, key=queue_depth)
 
 
 async def dispatch_prompt_ws(

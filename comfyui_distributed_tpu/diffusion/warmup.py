@@ -1,0 +1,314 @@
+"""AOT warmup pass: make a worker hot the moment it joins the fleet.
+
+Walks the shape catalog (``cluster/shape_catalog.py``) and pre-lowers /
+pre-compiles every program with ``jitted.lower(...).compile()`` — the
+same AOT idiom ``bench.py`` uses for its compile measurement — entirely
+off the request path. With a populated persistent XLA cache
+(``utils/compile_cache.py``) each program resolves to a disk read
+instead of a 13.9 s compile; the pass classifies every entry as
+``cache_hit`` vs ``compiled`` by watching whether jax wrote new cache
+artifacts, so the warm-restart win is *measured*, not assumed
+(``cdt_warmup_programs_total``).
+
+Arguments are lowered as ``jax.ShapeDtypeStruct`` templates: warmup
+never allocates batch-sized activations and never executes a program —
+it only traces and compiles.
+
+A :class:`WarmupManager` owns the worker-visible state machine
+(``cold → warming → ready``; ``error`` on a failed pass). The health
+probe reports it, and ``cluster/dispatch.py`` prefers hot workers, so a
+rolling restart drains traffic toward hosts that won't stall it.
+
+Knobs: ``CDT_WARMUP=1`` warms on controller boot; ``CDT_WARMUP_MODELS``
+(csv) restricts which catalog models warm (a CPU controller must not
+try to build FLUX-12B).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+import time
+from pathlib import Path
+from typing import Callable, Iterable, Optional
+
+from ..cluster.shape_catalog import ProgramKey, ShapeCatalog
+from ..utils.logging import debug_log, log
+
+COLD, WARMING, READY, ERROR = "cold", "warming", "ready", "error"
+_STATE_GAUGE = {COLD: 0.0, WARMING: 1.0, READY: 2.0, ERROR: -1.0}
+
+
+@dataclasses.dataclass
+class WarmupEntry:
+    key: ProgramKey
+    outcome: str          # cache_hit | compiled | error | skipped
+    seconds: float = 0.0
+    detail: str = ""
+
+    def to_dict(self) -> dict:
+        return {"program": self.key.to_dict(), "outcome": self.outcome,
+                "seconds": round(self.seconds, 3), "detail": self.detail}
+
+
+def _cache_artifacts(cache_dir: Optional[str]) -> set:
+    if not cache_dir:
+        return set()
+    try:
+        return {p.name for p in Path(cache_dir).iterdir() if p.is_file()}
+    except OSError:
+        return set()
+
+
+def _abstract(shape, dtype="float32"):
+    import jax
+
+    return jax.ShapeDtypeStruct(tuple(shape), dtype)
+
+
+def lower_program(bundle, key: ProgramKey, mesh) -> None:
+    """Trace + XLA-compile ONE catalog program ahead of time. Shapes come
+    from the preset's config (context length / dims) and the key's
+    geometry; nothing executes and no batch-sized buffer is allocated.
+
+    The ``progress=True`` variant is compiled — that IS the serving
+    program: every sampler node runs with a live ProgressTracker
+    (``_ProgressScope`` always yields a token on the server path), and
+    the progress token changes the traced HLO, so warming the
+    token-less variant would leave the first real request cold."""
+    import jax
+    import jax.numpy as jnp
+
+    prng = jax.random.key(0)
+    token = _abstract((), jnp.int32)
+    if key.pipeline == "txt2img":
+        from .pipeline import GenerationSpec
+
+        spec = GenerationSpec(height=key.height, width=key.width,
+                              steps=key.steps,
+                              per_device_batch=key.batch)
+        fn = bundle.pipeline.generate_fn(mesh, spec, progress=True)
+        text = bundle.preset.text
+        ctx = _abstract((1, text.max_len, text.output_dim))
+        adm = bundle.pipeline.unet.config.adm_in_channels
+        y = _abstract((1, max(adm, 1)))
+        args = (prng, ctx, ctx, y, y, token)
+    elif key.pipeline == "flow_dp":
+        from .pipeline_flow import FlowSpec
+
+        spec = FlowSpec(height=key.height, width=key.width,
+                        steps=key.steps, per_device_batch=key.batch)
+        fn = bundle.pipeline.generate_fn(mesh, spec, progress=True)
+        cfg = bundle.pipeline.dit.config
+        ctx = _abstract((1, bundle.preset.text.max_len, cfg.context_dim))
+        pooled = _abstract((1, cfg.pooled_dim))
+        args = (prng, ctx, pooled, token)
+    elif key.pipeline == "video_dp":
+        from .pipeline_video import VideoSpec
+
+        spec = VideoSpec(frames=key.frames or 17, height=key.height,
+                         width=key.width, steps=key.steps)
+        fn = bundle.pipeline.generate_fn(mesh, spec, progress=True)
+        cfg = bundle.pipeline.dit.config
+        ctx = _abstract((1, bundle.preset.text.max_len, cfg.context_dim))
+        pooled = _abstract((1, getattr(cfg, "pooled_dim", 768)))
+        args = (prng, ctx, pooled, token)
+    else:
+        raise ValueError(f"no warmup recipe for pipeline {key.pipeline!r}")
+    fn.jitted.lower(fn.weights, *args).compile()
+
+
+def _mesh_matches(key: ProgramKey, mesh) -> bool:
+    """Empty key.mesh = "whatever this host runs"; a concrete one must
+    match exactly (a dp=8 program is not a dp=4 program)."""
+    if not key.mesh:
+        return True
+    return tuple(sorted(key.mesh)) == tuple(
+        sorted((str(a), int(n)) for a, n in mesh.shape.items()))
+
+
+def run_warmup(registry, mesh, keys: Iterable[ProgramKey],
+               models: Optional[Iterable[str]] = None,
+               on_entry: Optional[Callable[[WarmupEntry], None]] = None
+               ) -> list[WarmupEntry]:
+    """Warm every catalog program buildable on this host.
+
+    ``models`` (or ``CDT_WARMUP_MODELS``) filters which model bundles are
+    eligible — everything else is recorded ``skipped`` (warming is
+    best-effort fleet prep, and a CPU smoke host must not materialize a
+    14B checkpoint). With NO filter at all, only models already loaded
+    in the registry (plus the tiny test presets) warm: the shipped
+    workflow catalog references FLUX/WAN/SDXL, and an unqualified
+    ``CDT_WARMUP=1`` must not random-initialize tens of GB on boot —
+    pass ``CDT_WARMUP_MODELS=all`` (or an explicit list) to opt in.
+    Per-entry failures are recorded, never raised: one bad catalog row
+    must not leave the worker cold for the rest.
+    """
+    from ..telemetry import enabled as _tm_enabled
+    from ..telemetry import metrics as _tm
+    from ..utils.compile_cache import active_cache_dir
+
+    if models is None:
+        env = os.environ.get("CDT_WARMUP_MODELS", "")
+        models = [m.strip() for m in env.split(",") if m.strip()] or None
+    if models is not None and set(models) & {"all", "*"}:
+        allowed = None                      # explicit everything
+    elif models is not None:
+        allowed = set(models)
+    else:
+        # safe default: what's already hot, plus presets cheap anywhere
+        allowed = set(getattr(registry, "_cache", {})) | {
+            m for m in getattr(registry, "available", list)()
+            if "tiny" in m}
+        log("warmup: no model filter — warming only loaded/tiny presets "
+            f"({sorted(allowed)}); set CDT_WARMUP_MODELS=all to warm "
+            "everything in the catalog")
+    cache_dir = active_cache_dir()
+
+    report: list[WarmupEntry] = []
+    for key in keys:
+        if (allowed is not None and key.model not in allowed) \
+                or not _mesh_matches(key, mesh):
+            entry = WarmupEntry(key, "skipped",
+                                detail="model filtered or mesh mismatch")
+        else:
+            try:
+                # bundle build happens OUTSIDE the classification window:
+                # its own init compiles (VAE/text) would otherwise write
+                # cache artifacts and mislabel a disk-served target
+                # program "compiled"
+                t0 = time.perf_counter()
+                bundle = registry.get(key.model)
+                before = _cache_artifacts(cache_dir)
+                t0 = time.perf_counter()
+                lower_program(bundle, key, mesh)
+                dt = time.perf_counter() - t0
+                wrote = bool(_cache_artifacts(cache_dir) - before)
+                # new cache artifacts ⇒ XLA actually compiled; none (with
+                # a cache active) ⇒ the executable was deserialized from
+                # disk — the warm-restart fast path this pass exists for
+                outcome = ("compiled" if wrote or not cache_dir
+                           else "cache_hit")
+                entry = WarmupEntry(key, outcome, dt)
+            except Exception as e:  # noqa: BLE001 — per-entry isolation
+                entry = WarmupEntry(key, "error",
+                                    time.perf_counter() - t0, detail=str(e))
+                debug_log(f"warmup: {key} failed: {e}")
+        report.append(entry)
+        if _tm_enabled():
+            _tm.WARMUP_PROGRAMS.labels(outcome=entry.outcome).inc()
+            if entry.outcome in ("cache_hit", "compiled"):
+                _tm.WARMUP_SECONDS.observe(entry.seconds)
+        if on_entry is not None:
+            on_entry(entry)
+    return report
+
+
+class WarmupManager:
+    """Worker warmup state machine + pass runner.
+
+    Built lazily off the controller (registry/mesh are properties that
+    may themselves initialize jax — resolved only when a pass runs).
+    State is what health probes report: ``cold`` (never warmed),
+    ``warming`` (pass in flight), ``ready`` (pass finished), ``error``
+    (pass itself crashed — per-program errors still end ``ready``).
+    """
+
+    def __init__(self, registry_fn: Callable, mesh_fn: Callable,
+                 catalog: Optional[ShapeCatalog] = None):
+        self._registry_fn = registry_fn
+        self._mesh_fn = mesh_fn
+        self._catalog = catalog
+        self._state = COLD
+        self._lock = threading.Lock()
+        self._report: list[WarmupEntry] = []
+        self._started_at: Optional[float] = None
+        self._finished_at: Optional[float] = None
+
+    @property
+    def state(self) -> str:
+        return self._state
+
+    @property
+    def catalog(self) -> ShapeCatalog:
+        if self._catalog is None:
+            from ..cluster.shape_catalog import default_catalog
+
+            self._catalog = default_catalog()
+        return self._catalog
+
+    def _set_state(self, state: str) -> None:
+        self._state = state
+        try:
+            from ..telemetry import enabled as _tm_enabled
+            from ..telemetry import metrics as _tm
+
+            if _tm_enabled():
+                _tm.WARMUP_STATE.set(_STATE_GAUGE[state])
+        except Exception:  # noqa: BLE001
+            pass
+
+    def run(self, models: Optional[Iterable[str]] = None,
+            seed_workflows: bool = True,
+            extra_keys: Optional[Iterable[ProgramKey]] = None) -> dict:
+        """Execute one warmup pass synchronously (call from a thread
+        executor — this compiles). Concurrent calls coalesce: a second
+        caller returns the running/last report instead of doubling the
+        compile load."""
+        if not self._lock.acquire(blocking=False):
+            return self.status()
+        try:
+            self._set_state(WARMING)
+            self._started_at = time.monotonic()
+            from ..utils.compile_cache import enable_compile_cache
+
+            # persist EVERYTHING the pass compiles (min 0.0): a program
+            # too cheap to cache is still a program the next restart
+            # would recompile
+            enable_compile_cache(min_compile_secs=0.0)
+            cat = self.catalog
+            if seed_workflows:
+                cat.seed_from_workflows()
+            keys = list(cat.entries())
+            if extra_keys:
+                known = set(keys)
+                keys += [k for k in extra_keys if k not in known]
+            log(f"warmup: starting pass over {len(keys)} catalog "
+                f"program(s)")
+            self._report = run_warmup(self._registry_fn(), self._mesh_fn(),
+                                      keys, models=models)
+            cat.save()
+            self._finished_at = time.monotonic()
+            self._set_state(READY)
+            hits = sum(e.outcome == "cache_hit" for e in self._report)
+            comp = sum(e.outcome == "compiled" for e in self._report)
+            errs = sum(e.outcome == "error" for e in self._report)
+            log(f"warmup: ready — {hits} cache hit(s), {comp} compiled, "
+                f"{errs} error(s), "
+                f"{sum(e.outcome == 'skipped' for e in self._report)} "
+                f"skipped in "
+                f"{self._finished_at - self._started_at:.1f}s")
+        except Exception as e:  # noqa: BLE001 — boot must survive warmup
+            self._finished_at = time.monotonic()
+            self._set_state(ERROR)
+            log(f"warmup: pass failed: {e}")
+        finally:
+            self._lock.release()
+        return self.status()
+
+    def status(self) -> dict:
+        took = None
+        if self._started_at is not None:
+            took = (self._finished_at or time.monotonic()) - self._started_at
+        counts: dict[str, int] = {}
+        for e in self._report:
+            counts[e.outcome] = counts.get(e.outcome, 0) + 1
+        return {
+            "state": self._state,
+            "catalog_size": (len(self._catalog)
+                            if self._catalog is not None else None),
+            "outcomes": counts,
+            "seconds": None if took is None else round(took, 3),
+            "report": [e.to_dict() for e in self._report],
+        }

@@ -945,6 +945,29 @@ class EmptyLatentImage(NodeDef):
                  "height": int(height), "width": int(width)},)
 
 
+def _pinned(model):
+    """Residency pin for the duration of a generate call: with
+    ``CDT_HBM_BUDGET_GB`` set, a concurrent acquire (warmup thread,
+    another model's request) must never evict THIS bundle mid-program
+    (``cluster/residency.pinned_bundle``; no-op without a planner)."""
+    from ..cluster.residency import pinned_bundle
+
+    return pinned_bundle(model)
+
+
+def _observe_shape(pipeline: str, model, height: int, width: int,
+                   steps: int, batch: int = 1, frames: int = 0) -> None:
+    """Feed the shape catalog (``cluster/shape_catalog.py``) from the
+    request path so the NEXT restart warms the programs this fleet
+    actually serves. Never fatal, and cheap after first sight."""
+    from ..cluster.shape_catalog import observe
+
+    name = getattr(getattr(model, "preset", None), "name", None)
+    if name:
+        observe(pipeline, name, height, width, steps, batch=batch,
+                frames=frames)
+
+
 @register_node("TPUTxt2Img")
 class TPUTxt2Img(NodeDef):
     """The distributed sampler node: runs the whole sharded generation
@@ -978,6 +1001,8 @@ class TPUTxt2Img(NodeDef):
             sampler=sampler_name, scheduler=scheduler,
             guidance_scale=float(cfg), per_device_batch=int(batch_per_device),
         )
+        _observe_shape("txt2img", model, spec.height, spec.width,
+                       spec.steps, batch=spec.per_device_batch)
         adm = model.pipeline.unet.config.adm_in_channels
         y = _adm_from_cond(positive, adm) if adm else None
         uy = _adm_from_cond(negative, adm) if adm else None
@@ -985,8 +1010,9 @@ class TPUTxt2Img(NodeDef):
                                             spec.height, spec.width)
         from ..diffusion.progress import total_calls
 
-        with _ProgressScope(progress_tracker, prompt_id,
-                            total_calls(sampler_name, spec.steps)) as ps:
+        with _pinned(model), \
+                _ProgressScope(progress_tracker, prompt_id,
+                               total_calls(sampler_name, spec.steps)) as ps:
             images = pipeline.generate(
                 mesh, spec, int(seed), positive["context"],
                 negative["context"], y, uy, hint=hint,
@@ -1143,6 +1169,9 @@ class TPUFlowTxt2Img(NodeDef):
                         shift=float(shift), guidance=float(guidance),
                         cfg=float(cfg),
                         per_device_batch=int(batch_per_device))
+        if mode == "dp":
+            _observe_shape("flow_dp", model, spec.height, spec.width,
+                           spec.steps, batch=spec.per_device_batch)
         ctx = positive["context"]
         pooled = positive.get("pooled")
         if pooled is None:
@@ -1169,9 +1198,10 @@ class TPUFlowTxt2Img(NodeDef):
             # ps.token; streamed runs report host-side via ps.on_step.
             from ..diffusion.progress import total_calls
 
-            with _ProgressScope(progress_tracker, prompt_id,
-                                total_calls(spec.sampler,
-                                            spec.steps)) as ps:
+            with _pinned(model), \
+                    _ProgressScope(progress_tracker, prompt_id,
+                                   total_calls(spec.sampler,
+                                               spec.steps)) as ps:
                 images = model.pipeline.generate_offloaded(
                     spec, int(seed), ctx, pooled, on_step=ps.on_step,
                     progress_token=ps.token,
@@ -1188,15 +1218,18 @@ class TPUFlowTxt2Img(NodeDef):
             # intentionally dp-only for now — each sp shard holds a row
             # BLOCK, so a per-shard preview would be a partial strip; the
             # tracker would need cross-shard assembly to be meaningful.
-            images = model.pipeline.generate_sp(
-                mesh, spec, int(seed), ctx, pooled,
-                uncond_context=uncond_ctx, uncond_pooled=uncond_pooled)
+            with _pinned(model):
+                images = model.pipeline.generate_sp(
+                    mesh, spec, int(seed), ctx, pooled,
+                    uncond_context=uncond_ctx,
+                    uncond_pooled=uncond_pooled)
         else:
             from ..diffusion.progress import total_calls
 
-            with _ProgressScope(progress_tracker, prompt_id,
-                                total_calls(spec.sampler,
-                                            spec.steps)) as ps:
+            with _pinned(model), \
+                    _ProgressScope(progress_tracker, prompt_id,
+                                   total_calls(spec.sampler,
+                                               spec.steps)) as ps:
                 images = model.pipeline.generate(
                     mesh, spec, int(seed), ctx, pooled,
                     progress_token=ps.token,
@@ -1262,6 +1295,9 @@ class TPUTxt2Video(NodeDef):
         spec = VideoSpec(frames=int(frames), height=int(height),
                          width=int(width), steps=int(steps),
                          shift=float(shift), guidance_scale=float(cfg))
+        if mode == "dp":
+            _observe_shape("video_dp", model, spec.height, spec.width,
+                           spec.steps, frames=spec.frames)
         ctx = positive["context"]
         pooled = _video_pooled_default(model, positive)
         key = jax.random.key(int(seed))
@@ -1269,8 +1305,10 @@ class TPUTxt2Video(NodeDef):
         # and previews exactly like the image samplers do
         from ..diffusion.offload import offload_enabled
 
-        with _ProgressScope(progress_tracker, prompt_id,
-                            total_calls(spec.sampler, spec.steps)) as ps:
+        with _pinned(model), \
+                _ProgressScope(progress_tracker, prompt_id,
+                               total_calls(spec.sampler,
+                                           spec.steps)) as ps:
             if mode == "offload" or (mode == "dp" and offload_enabled()):
                 # full-size single-chip execution with quantized expert
                 # residency + dual-expert HBM swap — how WAN-14B runs
@@ -1343,8 +1381,10 @@ class TPUImg2Video(NodeDef):
         pooled = _video_pooled_default(model, positive)
         from ..diffusion.offload import offload_enabled
 
-        with _ProgressScope(progress_tracker, prompt_id,
-                            total_calls(spec.sampler, spec.steps)) as ps:
+        with _pinned(model), \
+                _ProgressScope(progress_tracker, prompt_id,
+                               total_calls(spec.sampler,
+                                           spec.steps)) as ps:
             if mode == "offload" or (mode == "dp" and offload_enabled()):
                 videos = model.pipeline.generate_offloaded_i2v(
                     spec, int(seed), image[:1], ctx, on_step=ps.on_step,

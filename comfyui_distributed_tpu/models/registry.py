@@ -592,6 +592,25 @@ class ModelBundle:
                 load_safetensors(Path(clip_g)),
                 self.clip_stack.clip_g.params, self.clip_stack.clip_g.config)
 
+    def release_device(self) -> None:
+        """Drop everything this bundle holds ON DEVICE so its HBM can be
+        reused (residency-planner eviction, ``cluster/residency.py``):
+        offload executors' stacked/resident blocks are freed explicitly
+        (``diffusion/offload.release_store``), and every pipeline compile
+        cache is cleared so no jitted closure keeps device arrays alive.
+        Host-side params (numpy/orbax trees) survive — re-acquiring the
+        bundle re-uploads, it does not re-convert."""
+        from ..diffusion.offload import release_store
+
+        for cache_name in ("_fn_cache", "_i2i_cache", "_control_clones"):
+            cache = getattr(self.pipeline, cache_name, None)
+            if not isinstance(cache, dict):
+                continue
+            for v in cache.values():
+                if hasattr(v, "stacked") and hasattr(v, "resident"):
+                    release_store(v)
+            cache.clear()
+
     def load_vae_file(self, path: Path) -> None:
         """Convert a standalone VAE ``.safetensors`` into this bundle.
 
@@ -623,9 +642,23 @@ class ModelBundle:
 
 
 class ModelRegistry:
-    def __init__(self, checkpoint_root: Optional[Path] = None):
+    def __init__(self, checkpoint_root: Optional[Path] = None,
+                 hbm_budget_bytes: Optional[int] = None):
+        """``hbm_budget_bytes`` (default: ``CDT_HBM_BUDGET_GB``) attaches
+        the multi-model residency planner (``cluster/residency.py``):
+        cached bundles then live under a per-chip HBM budget with
+        LRU/priority eviction instead of accumulating until OOM."""
         self.checkpoint_root = Path(checkpoint_root) if checkpoint_root else None
         self._cache: dict[str, ModelBundle] = {}
+        self.residency = None
+        if hbm_budget_bytes is None:
+            from ..cluster.residency import hbm_budget_bytes as _budget
+
+            hbm_budget_bytes = _budget()
+        if hbm_budget_bytes and hbm_budget_bytes > 0:
+            from ..cluster.residency import BundleResidency
+
+            self.residency = BundleResidency(self, hbm_budget_bytes)
 
     def available(self) -> list[str]:
         return sorted(PRESETS)
@@ -637,4 +670,19 @@ class ModelRegistry:
                 raise ValidationError(f"unknown model {name!r}; have {self.available()}")
             ckpt = self.checkpoint_root / name if self.checkpoint_root else None
             self._cache[name] = ModelBundle(preset, ckpt)
-        return self._cache[name]
+        bundle = self._cache[name]
+        if self.residency is not None:
+            try:
+                self.residency.note_use(name, bundle)
+            except Exception:
+                # an unplaceable bundle must not squat in the cache
+                # (permanently over budget, unevictable because it was
+                # never registered) — drop it and re-raise
+                self._cache.pop(name, None)
+                bundle.release_device()
+                raise
+            # back-ref so holders (sampler nodes) can pin the bundle for
+            # the duration of a generate call without reaching the
+            # registry (cluster/residency.pinned_bundle)
+            bundle._residency = self.residency
+        return bundle

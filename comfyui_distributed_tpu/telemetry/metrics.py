@@ -105,6 +105,42 @@ FAULTS_INJECTED = REGISTRY.counter(
     "Faults injected by the deterministic chaos harness (CDT_FAULTS).",
     ("op", "kind"))
 
+# --- cold start: compile cache / warmup / residency -------------------------
+# (utils/compile_cache.py, diffusion/warmup.py, cluster/residency.py)
+
+COMPILE_CACHE_ENABLED = REGISTRY.gauge(
+    "cdt_compile_cache_enabled",
+    "1 when the persistent XLA compilation cache is active, 0 when "
+    "disabled or unavailable (the reason is logged at enable time).")
+
+WARMUP_PROGRAMS = REGISTRY.counter(
+    "cdt_warmup_programs_total",
+    "AOT warmup outcomes per catalog program.",
+    ("outcome",))   # cache_hit | compiled | error | skipped
+
+WARMUP_SECONDS = REGISTRY.histogram(
+    "cdt_warmup_seconds",
+    "Per-program AOT lower+compile wall-clock during warmup (cache hits "
+    "land in the low buckets; fresh compiles in the high ones).",
+    buckets=COMPILE_BUCKETS)
+
+WARMUP_STATE = REGISTRY.gauge(
+    "cdt_warmup_state",
+    "Worker warmup state (0=cold, 1=warming, 2=ready, -1=error).")
+
+RESIDENCY_EVICTIONS = REGISTRY.counter(
+    "cdt_residency_evictions_total",
+    "Model bundles evicted by the HBM residency planner.",
+    ("reason",))   # budget | manual
+
+RESIDENT_MODELS = REGISTRY.gauge(
+    "cdt_resident_models",
+    "Model bundles currently resident under the HBM residency planner.")
+
+RESIDENT_BYTES = REGISTRY.gauge(
+    "cdt_resident_bytes",
+    "Estimated bytes of resident model bundles (planner accounting).")
+
 # --- prompt queue -----------------------------------------------------------
 
 PROMPTS_TOTAL = REGISTRY.counter(

@@ -1,12 +1,14 @@
-"""Persistent XLA compilation cache for the product server.
+"""Persistent XLA compilation cache for the product server AND bench.
 
 Full-scale programs here are expensive to compile — the SDXL 30-step
 sampler scan is ~1 min on a v5e, and the offloaded one-jit ladders
 (``diffusion/offload.py``) retrace per sigma-ladder LENGTH, so a user
 changing ``steps`` from 30 to 25 pays a fresh full-model compile.
-``bench.py`` has always enabled jax's persistent cache for itself; the
-server gets the same treatment so restarts and step-count changes hit
-disk instead of the compiler.
+This module is the ONE cache-config path: the server enables it at
+controller boot, ``bench.py`` with ``min_compile_secs=0.0`` (on the
+flaky tunneled accelerator a compile from ANY earlier attempt must be
+reusable), and the warmup pass (``diffusion/warmup.py``) reads the same
+directory to classify cache hits vs fresh compiles.
 
 Reference analogue: ComfyUI relies on torch CUDA kernels being
 pre-built, so its server has no compile-latency problem to manage; an
@@ -19,19 +21,48 @@ Knobs: ``CDT_COMPILE_CACHE_DIR`` (default
 from __future__ import annotations
 
 import os
+from typing import Optional
+
+from .logging import log
 
 _DEFAULT = os.path.join(os.path.expanduser("~"), ".cache",
                         "comfyui_distributed_tpu", "xla")
 
+# resolved state of the last enable_compile_cache call — the warmup
+# pass and telemetry read it instead of re-deriving the env logic
+_state: dict = {"dir": None, "reason": "never enabled"}
 
-def enable_compile_cache(path: str | None = None) -> str | None:
+
+def cache_dir_default() -> str:
+    """The directory ``enable_compile_cache()`` would resolve to (env or
+    default), WITHOUT enabling anything — the shape catalog persists
+    next to it even when caching is off."""
+    return os.environ.get("CDT_COMPILE_CACHE_DIR", _DEFAULT) or _DEFAULT
+
+
+def active_cache_dir() -> Optional[str]:
+    """Directory the live jax process is actually caching into (None
+    when disabled/never enabled)."""
+    return _state["dir"]
+
+
+def enable_compile_cache(path: Optional[str] = None,
+                         min_compile_secs: float = 1.0) -> Optional[str]:
     """Point jax's persistent compilation cache at ``path`` (or the
     ``CDT_COMPILE_CACHE_DIR``/default location). Never fatal: an
-    unwritable directory just leaves caching off. Returns the directory
-    in use, or None when disabled/unavailable."""
+    unwritable directory just leaves caching off — but never *silently*:
+    the resolved directory (or the reason caching is off) is logged and
+    exported as the ``cdt_compile_cache_enabled`` gauge. Returns the
+    directory in use, or None when disabled/unavailable.
+
+    ``min_compile_secs``: persistence threshold. The server default
+    (1.0 s) skips trivial programs; bench and warmup pass 0.0 so every
+    program a retry might need lands on disk.
+    """
     d = path if path is not None else os.environ.get(
         "CDT_COMPILE_CACHE_DIR", _DEFAULT)
     if not d:
+        _set_state(None, "disabled (CDT_COMPILE_CACHE_DIR='')")
         return None
     try:
         os.makedirs(d, exist_ok=True)
@@ -39,7 +70,26 @@ def enable_compile_cache(path: str | None = None) -> str | None:
 
         jax.config.update("jax_compilation_cache_dir", d)
         jax.config.update("jax_persistent_cache_min_compile_time_secs",
-                          1.0)
+                          float(min_compile_secs))
+        _set_state(d, None)
         return d
-    except Exception:  # noqa: BLE001 — degrade, don't crash the server
+    except Exception as e:  # noqa: BLE001 — degrade, don't crash the server
+        _set_state(None, f"unavailable: {e}")
         return None
+
+
+def _set_state(d: Optional[str], reason: Optional[str]) -> None:
+    _state["dir"] = d
+    _state["reason"] = reason
+    if d is not None:
+        log(f"compile cache: persisting XLA programs under {d}")
+    else:
+        log(f"compile cache: OFF — {reason}")
+    try:
+        from ..telemetry import enabled as _tm_enabled
+        from ..telemetry import metrics as _tm
+
+        if _tm_enabled():
+            _tm.COMPILE_CACHE_ENABLED.set(1.0 if d else 0.0)
+    except Exception:  # noqa: BLE001 — telemetry is never load-bearing
+        pass

@@ -33,6 +33,15 @@ function breakerBadge(state) {
   return "";
 }
 
+// AOT warmup suffix (diffusion/warmup.py): only a still-compiling worker
+// is news — ready/cold/legacy probes stay silent, matching the
+// dispatcher's hot-host preference (cluster/dispatch.py is_hot)
+function warmupBadge(state) {
+  if (state === "warming") return " · 🔥 warming";
+  if (state === "error") return " · ⚠ warmup failed";
+  return "";
+}
+
 // ---------------------------------------------------------------------------
 // worker cards
 // ---------------------------------------------------------------------------
@@ -64,7 +73,8 @@ function workerCard(worker) {
   info.querySelector(".addr").textContent = worker.address;
   info.querySelector(".meta").textContent =
     `${worker.type || "auto"}${managed ? ` · pid ${managed.pid}` : ""}` +
-    `${st.online ? " · online" + qr : " · offline"}` + breaker;
+    `${st.online ? " · online" + qr : " · offline"}` + breaker +
+    warmupBadge(st.warmup);
 
   const toggle = document.createElement("input");
   toggle.type = "checkbox";

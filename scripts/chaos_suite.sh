@@ -14,6 +14,17 @@ SEED="${CDT_CHAOS_SEED:-42}"
 echo "[chaos] fixed seed: ${SEED} (override with CDT_CHAOS_SEED)"
 echo "[chaos] repro: CDT_CHAOS_SEED=${SEED} scripts/chaos_suite.sh $*"
 
+# Stage 1 — seeded rolling-restart event (ISSUE 6): a worker dies
+# mid-job holding work; its warm restart (shared compile cache + shape
+# catalog) must rejoin with a pure cache-hit warmup pass and the job
+# must complete with nothing dropped or dead-lettered.
+echo "[chaos] stage 1: rolling-restart event (warm worker rejoin)"
+env JAX_PLATFORMS=cpu CDT_CHAOS_SEED="${SEED}" \
+    python -m pytest tests/ -q -m chaos -k "rolling_restart" \
+    -p no:cacheprovider --continue-on-collection-errors "$@"
+
+# Stage 2 — the rest of the chaos tier
+echo "[chaos] stage 2: full chaos tier"
 exec env JAX_PLATFORMS=cpu CDT_CHAOS_SEED="${SEED}" \
-    python -m pytest tests/ -q -m chaos \
+    python -m pytest tests/ -q -m chaos -k "not rolling_restart" \
     -p no:cacheprovider --continue-on-collection-errors "$@"

@@ -193,3 +193,132 @@ class TestChaosAcceptance:
                 assert "poison" in dead["reason"]
                 assert status["completed"] == 5 and status["total"] == 6
         asyncio.run(body())
+
+
+class TestRollingRestart:
+    """Seeded rolling-restart event (ISSUE 6): a worker dies mid-job
+    holding an assignment; its warm-restarted replacement — same compile
+    cache, same shape catalog — rejoins, reports ``ready`` after a pure
+    cache-hit warmup pass (recompilation demonstrably skipped), and the
+    job completes bit-identically with nothing dropped or dead-lettered.
+    """
+
+    def test_warm_restarted_worker_rejoins_without_dropping_jobs(
+            self, tmp_config, tmp_path, monkeypatch):
+        import jax
+
+        from comfyui_distributed_tpu.cluster.shape_catalog import (
+            ProgramKey, ShapeCatalog)
+        from comfyui_distributed_tpu.diffusion.warmup import WarmupManager
+        from comfyui_distributed_tpu.models.registry import ModelRegistry
+        from comfyui_distributed_tpu.parallel import build_mesh
+        from comfyui_distributed_tpu.utils import compile_cache as cc
+
+        # session-persistent cache dir shared with tests/test_warmup.py:
+        # whichever test runs first on a fresh machine pays the one cold
+        # compile; every later pass is the cache-load path under test
+        import os as _os
+        warm_cache = _os.environ.get(
+            "CDT_TEST_XLA_CACHE", "/tmp/cdt_xla_cache_tests") + "_warmup"
+        saved_dir = jax.config.jax_compilation_cache_dir
+        saved_min = jax.config.jax_persistent_cache_min_compile_time_secs
+        saved_state = dict(cc._state)
+        monkeypatch.setenv("CDT_COMPILE_CACHE_DIR", warm_cache)
+        monkeypatch.setenv("CDT_SHAPE_CATALOG",
+                           str(tmp_path / "fleet_catalog.json"))
+        try:
+            catalog = ShapeCatalog(tmp_path / "fleet_catalog.json")
+            catalog.add(ProgramKey("txt2img", "tiny", 32, 32, 1))
+            catalog.save()
+            mesh = build_mesh({"dp": 1}, jax.devices()[:1])
+
+            # generation 1 warms (cold on a fresh machine, hit after) and
+            # persists the catalog+cache the restart will reuse
+            gen1 = WarmupManager(ModelRegistry, lambda: mesh,
+                                 catalog=catalog)
+            status1 = gen1.run(models=["tiny"], seed_workflows=False)
+            assert status1["state"] == "ready"
+
+            # big enough that the job is still mid-flight when the
+            # restarted worker finishes its (seconds-long) warmup pass
+            # and rejoins — the master alone grinds at 0.15 s/tile
+            ROLL_TOTAL = 150
+
+            # fault-free reference output
+            async def reference():
+                store = JobStore()
+                farm = TileFarm(store, asyncio.get_running_loop())
+                results = await farm.master_run_async(
+                    "roll-ref", total=ROLL_TOTAL, process_fn=make_proc(),
+                    chunk=CHUNK, heartbeat_interval=0.2)
+                return assemble_tiles(results, ROLL_TOTAL, CHUNK)
+
+            ref = asyncio.run(reference())
+
+            async def rolling_restart():
+                controller, client = _serve_master()
+                async with client:
+                    base = f"http://127.0.0.1:{client.port}"
+
+                    # the warm-restarted replacement boots FIRST (rolling
+                    # deploys bring the new generation up before draining
+                    # the old one): same catalog, same compile cache ⇒
+                    # warmup is pure cache hits — the "skips
+                    # recompilation" acceptance, asserted
+                    jax.clear_caches()   # a new process holds nothing
+                    gen2 = WarmupManager(ModelRegistry, lambda: mesh,
+                                         catalog=ShapeCatalog(
+                                             tmp_path
+                                             / "fleet_catalog.json"))
+                    loop = asyncio.get_running_loop()
+                    status2 = await loop.run_in_executor(
+                        None, lambda: gen2.run(models=["tiny"],
+                                               seed_workflows=False))
+                    assert status2["state"] == "ready"
+                    assert status2["outcomes"] == {"cache_hit": 1}, \
+                        status2["outcomes"]
+
+                    master_task = asyncio.create_task(
+                        controller.tile_farm.master_run_async(
+                            "roll", total=ROLL_TOTAL,
+                            process_fn=make_proc(delay=0.15), chunk=CHUNK,
+                            heartbeat_interval=0.2, worker_timeout=0.5))
+                    await asyncio.sleep(0.05)
+
+                    # the outgoing process: pulls work, then its network
+                    # partitions while it HOLDS an assignment — the
+                    # restart window of a rolling deploy
+                    held = await _doomed_worker(client, base, "roll",
+                                                "w-roll", seed=7)
+                    assert held, "outgoing worker never got work"
+
+                    # ...the (already-warm) replacement rejoins the SAME
+                    # job under the same worker id, completing what the
+                    # dead generation held
+                    farm_w = TileFarm(JobStore(),
+                                      asyncio.get_running_loop())
+                    done = await farm_w.worker_run_async(
+                        "roll", "w-roll", base, make_proc(),
+                        max_batch=1)
+                    results = await asyncio.wait_for(master_task,
+                                                     timeout=90)
+                    assert done > 0, "restarted worker did no work"
+
+                    # nothing dropped, nothing dead-lettered
+                    async with client.session.get(
+                            f"{base}/distributed/job_status",
+                            params={"job_id": "roll"}) as resp:
+                        job = await resp.json()
+                    assert job["finished"] is True
+                    assert job["dead_letter"] == []
+                    assert job["completed"] == ROLL_TOTAL
+                    return results
+
+            results = asyncio.run(rolling_restart())
+            out = assemble_tiles(results, ROLL_TOTAL, CHUNK)
+            np.testing.assert_array_equal(out, ref)
+        finally:
+            jax.config.update("jax_compilation_cache_dir", saved_dir)
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", saved_min)
+            cc._state.update(saved_state)
