@@ -11,6 +11,7 @@ reference's least-busy scheduler reads (``dispatch.py:225-268``).
 from __future__ import annotations
 
 import asyncio
+import contextvars
 import dataclasses
 import secrets
 import time
@@ -139,8 +140,14 @@ class PromptQueue:
                                     trace_id=job.trace_id,
                                     parent_id=job.parent_span_id,
                                     prompt_id=job.prompt_id):
+                    # run_in_executor does NOT propagate contextvars, so
+                    # spans opened during graph execution (pipeline_call
+                    # with its attn_kernels label, node-level spans)
+                    # would start orphan traces; copying the context in
+                    # parents them under this execution span
+                    ctx = contextvars.copy_context()
                     outputs = await loop.run_in_executor(
-                        self._pool, executor.execute, job.prompt
+                        self._pool, ctx.run, executor.execute, job.prompt
                     )
                 status = "success"
                 self.history[job.prompt_id] = {

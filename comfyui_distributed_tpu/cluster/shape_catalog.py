@@ -34,6 +34,7 @@ import threading
 from pathlib import Path
 from typing import Iterable, Optional
 
+from ..utils.jsonio import atomic_write_json, read_json
 from ..utils.logging import debug_log, log
 
 CATALOG_VERSION = 1
@@ -144,10 +145,10 @@ class ShapeCatalog:
         """Merge the on-disk entries into memory (union — another process
         may have written since our last save). Unreadable/garbled files
         degrade to an empty load, never a crash."""
+        raw = read_json(self.path)
         try:
-            raw = json.loads(self.path.read_text())
             entries = raw.get("entries", [])
-        except (OSError, ValueError, AttributeError):
+        except AttributeError:
             return 0
         added = 0
         for d in entries:
@@ -163,19 +164,12 @@ class ShapeCatalog:
         atomically (tmp+rename). Never fatal."""
         self.load()
         with self._lock:
-            payload = json.dumps(
-                {"version": CATALOG_VERSION,
-                 "entries": [k.to_dict() for k in sorted(self._keys)]},
-                indent=1)
-        tmp = self.path.with_suffix(".tmp")
-        try:
-            self.path.parent.mkdir(parents=True, exist_ok=True)
-            tmp.write_text(payload)
-            os.replace(tmp, self.path)
+            payload = {"version": CATALOG_VERSION,
+                       "entries": [k.to_dict() for k in sorted(self._keys)]}
+        if atomic_write_json(self.path, payload):
             return True
-        except OSError as e:
-            debug_log(f"shape catalog: save to {self.path} failed: {e}")
-            return False
+        debug_log(f"shape catalog: save to {self.path} failed")
+        return False
 
     # --- workflow seeding ---------------------------------------------------
 

@@ -79,6 +79,17 @@ def cached_build(holder, key, builder, max_entries: int = 8):
     return fn
 
 
+class _AttnKernelSummary:
+    """Span-attr shim: ``telemetry.spans`` stringifies attrs when the
+    span CLOSES, so this resolves the kernel-selection summary after the
+    wrapped call's trace has run its dispatch."""
+
+    def __str__(self) -> str:
+        from ..ops.attention import selection_summary
+
+        return selection_summary() or "none"
+
+
 def bind_weights(jitted, weights, label: "str | None" = None,
                  steps: "int | None" = None):
     """Wrap a jitted function whose LEADING argument is the weight pytree:
@@ -103,10 +114,18 @@ def bind_weights(jitted, weights, label: "str | None" = None,
         if label is None or not _tm_enabled():
             return jitted(weights, *args, **kw)
         from ..telemetry import metrics as _tm
+        from ..telemetry.spans import span
 
         t0 = time.perf_counter()
-        out = jitted(weights, *args, **kw)
-        jax.block_until_ready(out)
+        # the attn_kernels attr records which kernel tier served each
+        # geometry this program traced (ops/attention.py dispatch), so
+        # the trace view answers "which kernel ran this step" without a
+        # profiler. Lazy: spans stringify attrs at close, AFTER the
+        # first call's trace has made its selections.
+        with span("pipeline_call", pipeline=label,
+                  attn_kernels=_AttnKernelSummary()):
+            out = jitted(weights, *args, **kw)
+            jax.block_until_ready(out)
         dt = time.perf_counter() - t0
         if state["first"]:
             state["first"] = False

@@ -99,8 +99,30 @@ class ResBlock(nn.Module):
         return x + h
 
 
+class _ProjKernel(nn.Module):
+    """Bare [in, out] projection weight under a Dense-compatible param
+    path (``<name>/kernel``, lecun-normal init) — the fused attention
+    tier consumes the raw matrix instead of applying the layer, so the
+    activations never round-trip HBM, while checkpoints keep loading
+    into the exact tree ``nn.Dense(use_bias=False)`` would own."""
+
+    features: int
+
+    @nn.compact
+    def __call__(self, in_features: int) -> jax.Array:
+        return self.param("kernel", nn.initializers.lecun_normal(),
+                          (in_features, self.features))
+
+
 class Attention(nn.Module):
-    """Multi-head attention over [B, N, C] with optional cross context."""
+    """Multi-head attention over [B, N, C] with optional cross context.
+
+    Self-attention sites (no context) are fusable: projection feeds
+    attention with nothing in between, so when the kernel dispatcher
+    (``ops/attention.select_kernel`` — tuning table > env > defaults)
+    picks the fused tier, the QKV matmuls fold into the flash grid
+    (``ops/flash_attention.fused_qkv_attention``) and q/k/v never
+    materialize in HBM. Either branch owns the identical param tree."""
 
     num_heads: int
     head_dim: int
@@ -110,17 +132,51 @@ class Attention(nn.Module):
     def __call__(self, x: jax.Array, context: Optional[jax.Array] = None) -> jax.Array:
         ctx = x if context is None else context
         inner = self.num_heads * self.head_dim
-        q = nn.Dense(inner, use_bias=False, dtype=self.dtype, name="to_q")(x)
-        k = nn.Dense(inner, use_bias=False, dtype=self.dtype, name="to_k")(ctx)
-        v = nn.Dense(inner, use_bias=False, dtype=self.dtype, name="to_v")(ctx)
-        B, N, _ = q.shape
-        M = k.shape[1]
-        q = q.reshape(B, N, self.num_heads, self.head_dim)
-        k = k.reshape(B, M, self.num_heads, self.head_dim)
-        v = v.reshape(B, M, self.num_heads, self.head_dim)
-        from ..ops.attention import full_attention
+        B, N, C = x.shape
+        M = ctx.shape[1]
+        from ..ops.attention import select_kernel
 
-        out = full_attention(q, k, v)
+        choice = select_kernel(int(N), int(M), self.num_heads,
+                               self.head_dim, dtype=self.dtype,
+                               fusable=context is None)
+        use_fused = choice.tier == "fused" and context is None
+        if use_fused:
+            # the table/policy validated fused feasibility assuming
+            # C == H·D (true for every zoo config); this site's REAL
+            # channel width may differ — re-check with it so an
+            # infeasible width degrades to the dense path instead of
+            # raising mid-forward
+            from ..ops.autotune import itemsize_of
+            from ..ops.flash_attention import (_DEFAULT_BLOCK_K,
+                                               _DEFAULT_BLOCK_Q,
+                                               _fused_feasible)
+
+            use_fused = _fused_feasible(
+                int(C), self.num_heads, self.head_dim,
+                choice.block_q or _DEFAULT_BLOCK_Q,
+                choice.block_k or _DEFAULT_BLOCK_K,
+                itemsize_of(self.dtype)) is not None
+        if use_fused:
+            from ..ops.flash_attention import fused_qkv_attention
+
+            wq = _ProjKernel(inner, name="to_q")(C)
+            wk = _ProjKernel(inner, name="to_k")(C)
+            wv = _ProjKernel(inner, name="to_v")(C)
+            out = fused_qkv_attention(
+                x.astype(self.dtype), wq.astype(self.dtype),
+                wk.astype(self.dtype), wv.astype(self.dtype),
+                self.num_heads, block_q=choice.block_q,
+                block_k=choice.block_k)
+        else:
+            q = nn.Dense(inner, use_bias=False, dtype=self.dtype, name="to_q")(x)
+            k = nn.Dense(inner, use_bias=False, dtype=self.dtype, name="to_k")(ctx)
+            v = nn.Dense(inner, use_bias=False, dtype=self.dtype, name="to_v")(ctx)
+            q = q.reshape(B, N, self.num_heads, self.head_dim)
+            k = k.reshape(B, M, self.num_heads, self.head_dim)
+            v = v.reshape(B, M, self.num_heads, self.head_dim)
+            from ..ops.attention import full_attention
+
+            out = full_attention(q, k, v)
         out = out.reshape(B, N, inner)
         return nn.Dense(x.shape[-1], dtype=self.dtype, name="to_out")(out)
 

@@ -96,26 +96,168 @@ def _flash_enabled(q_len: Optional[int] = None,
     return q_len >= _flash_min_seq()
 
 
+# --- kernel-tier dispatch ----------------------------------------------------
+# selections made at trace time, remembered for observability: the log
+# line fires once per (geometry, choice), the counter feeds
+# cdt_attn_kernel_selected, and selection_summary() labels pipeline
+# spans so traces show which tier served each step without a profiler.
+
+import threading as _threading
+
+_SELECTIONS: "dict[str, str]" = {}
+_SELECTIONS_LOCK = _threading.Lock()
+
+
+def _note_selection(geometry: str, choice) -> None:
+    desc = choice.tier
+    if choice.block_q is not None:
+        desc += f":{choice.block_q}/{choice.block_k}"
+    with _SELECTIONS_LOCK:
+        if _SELECTIONS.get(geometry) == desc:
+            return
+        _SELECTIONS[geometry] = desc
+    from ..utils.logging import log
+
+    why = f" ({choice.reason})" if choice.reason else ""
+    log(f"attention: {geometry} → {desc} [{choice.source}]{why}")
+    try:
+        from ..telemetry import enabled as _tm_enabled
+        from ..telemetry import metrics as _tm
+
+        if _tm_enabled():
+            _tm.ATTN_KERNEL_SELECTED.labels(
+                tier=choice.tier, geometry=geometry).inc()
+    except Exception:  # noqa: BLE001 — observability must not sink dispatch
+        pass
+
+
+def selection_summary() -> str:
+    """Compact 'geometry=tier' list of every kernel choice this process
+    has traced — attached to pipeline-call spans as ``attn_kernels``."""
+    with _SELECTIONS_LOCK:
+        return ",".join(f"{g}={d}" for g, d in sorted(_SELECTIONS.items()))
+
+
+def reset_selections() -> None:
+    with _SELECTIONS_LOCK:
+        _SELECTIONS.clear()
+
+
+def select_kernel(q_len: int, kv_len: int, num_heads: int, head_dim: int,
+                  dtype="bfloat16", fusable: bool = False,
+                  prefer_flash: bool = False):
+    """Resolve the kernel tier + block config for one attention geometry.
+
+    Precedence: explicit ``CDT_FLASH_ATTENTION`` > tuning table
+    (``ops/autotune.py`` — the per-geometry swept winner) > env knobs
+    (``CDT_FLASH_LAYOUT``/``CDT_FLASH_BLOCK_Q/K``) > measured-floor
+    defaults (the r04/r05 gates in ``_flash_enabled``). Deterministic:
+    same geometry + same table ⇒ same choice with no env set.
+
+    ``fusable=True`` marks a projection→attention site with nothing in
+    between (SDXL UNet self-attention) where the fused QKV tier is
+    executable; elsewhere a table entry saying ``fused`` downgrades to
+    the packed tier with the same blocks — same layout family, q/k/v
+    just arrive pre-projected. ``prefer_flash`` (memory-constrained
+    callers, see ``full_attention``) keeps its guarantee ahead of the
+    table: a table entry saying ``xla`` is ignored there, because the
+    sweep optimized for time while the caller needs the streamed
+    softmax to fit HBM at all."""
+    import os
+
+    from .autotune import KernelChoice, GeometryKey, lookup
+
+    geometry = GeometryKey.from_shape(num_heads, head_dim, q_len, kv_len,
+                                      dtype).key_str()
+    flag = os.environ.get("CDT_FLASH_ATTENTION", "").lower()
+    if flag in ("0", "false", "off"):
+        choice = KernelChoice("xla", source="env",
+                              reason="CDT_FLASH_ATTENTION=0")
+        _note_selection(geometry, choice)
+        return choice
+    forced = flag in ("1", "true", "on")
+    try:
+        on_tpu = jax.devices()[0].platform == "tpu"
+    except RuntimeError:
+        on_tpu = False
+    if not on_tpu and not forced:
+        # off-accelerator serving always takes XLA (interpret-mode pallas
+        # is a test vehicle, not a CPU fallback); not recorded — CPU
+        # hosts would flood the selection log with xla lines
+        return KernelChoice("xla", reason="not on TPU")
+
+    tuned = lookup(num_heads, head_dim, q_len, kv_len, dtype)
+    # a table "xla" entry yields to BOTH explicit force (=1 promised
+    # flash) and prefer_flash (the sweep optimized for time; the caller
+    # needs the streamed softmax to fit HBM at all)
+    if tuned is not None and not ((forced or prefer_flash)
+                                  and tuned.tier == "xla"):
+        choice = tuned
+        if choice.tier == "fused" and not fusable:
+            from .autotune import itemsize_of
+            from .flash_attention import _packed_feasible
+
+            feas = _packed_feasible(num_heads, head_dim,
+                                    choice.block_q, choice.block_k,
+                                    itemsize_of(dtype))
+            choice = KernelChoice(
+                "packed" if feas else "bh",
+                *(feas or (choice.block_q, choice.block_k)),
+                source="table",
+                reason="fused choice at a non-fusable site")
+        _note_selection(geometry, choice)
+        return choice
+
+    # env knobs + measured-floor defaults (the pre-table behavior)
+    from .flash_attention import _layout_packed
+
+    if forced or prefer_flash:
+        use_flash = True
+        why = ("CDT_FLASH_ATTENTION=1" if forced
+               else "prefer_flash (memory-constrained caller)")
+    else:
+        use_flash = _flash_enabled(q_len=q_len, kv_len=kv_len,
+                                   num_heads=num_heads, head_dim=head_dim)
+        why = "measured r04 shape gates"
+    if not use_flash:
+        choice = KernelChoice("xla", reason=why)
+    elif _layout_packed(num_heads, head_dim, Nq=q_len, Nk=kv_len):
+        # the same legality + measured-floors + CDT_FLASH_LAYOUT
+        # predicate flash_attention's auto layout used, so forced-flash
+        # keeps its historical layout choices
+        choice = KernelChoice("packed", reason=why)
+    else:
+        choice = KernelChoice("bh", reason=why)
+    _note_selection(geometry, choice)
+    return choice
+
+
 def full_attention(q: jax.Array, k: jax.Array, v: jax.Array,
                    prefer_flash: bool = False) -> jax.Array:
-    """Dense [B,N,H,D] attention: pallas flash kernel on TPU wherever it
-    measures faster than XLA's fused lowering (see ``_flash_enabled``),
-    XLA elsewhere and off-TPU.
+    """Dense [B,N,H,D] attention dispatched per geometry: the tuning
+    table's swept winner where one exists (``select_kernel`` — table >
+    env knobs > measured defaults), the r04 shape gates otherwise, XLA
+    off-TPU.
 
-    ``prefer_flash=True`` skips the shape gates (still TPU-only, still
-    overridable by an explicit ``CDT_FLASH_ATTENTION``): set by
-    memory-constrained callers — the fp8-resident offload executor's
-    block programs OOM'd at compile with XLA attention (measured r04:
-    16.89 GB needed vs 15.75 HBM at FLUX's 4608 tokens × 24 heads with
-    12 GB of weights resident) while flash's streamed softmax fits."""
+    ``prefer_flash=True`` skips the shape gates AND table ``xla``
+    entries (still TPU-only, still overridable by an explicit
+    ``CDT_FLASH_ATTENTION``): set by memory-constrained callers — the
+    fp8-resident offload executor's block programs OOM'd at compile with
+    XLA attention (measured r04: 16.89 GB needed vs 15.75 HBM at FLUX's
+    4608 tokens × 24 heads with 12 GB of weights resident) while flash's
+    streamed softmax fits."""
     B, Nq, H, D = q.shape
-    if _flash_enabled(q_len=None if prefer_flash else int(Nq),
-                      kv_len=int(k.shape[1]), num_heads=int(H),
-                      head_dim=int(D)):
-        from .flash_attention import flash_attention
+    choice = select_kernel(int(Nq), int(k.shape[1]), int(H), int(D),
+                           dtype=q.dtype, prefer_flash=prefer_flash)
+    if choice.tier == "xla":
+        return jax.nn.dot_product_attention(q, k, v)
+    from .flash_attention import flash_attention
 
-        return flash_attention(q, k, v)
-    return jax.nn.dot_product_attention(q, k, v)
+    # a "fused" table entry reaching this pre-projected site runs the
+    # same packed layout family (select_kernel already downgraded it)
+    layout = "packed" if choice.tier == "packed" else "bh"
+    return flash_attention(q, k, v, block_q=choice.block_q,
+                           block_k=choice.block_k, layout=layout)
 
 
 def _flash_block(q, k, v, m, l, acc, scale):

@@ -1,0 +1,686 @@
+"""Persistent per-shape attention-kernel autotuner.
+
+``docs/roofline.md`` ended r05 with every workload pinned to a measured
+attention config — but the measurements lived in a human's shell
+history as ``CDT_FLASH_BLOCK_Q/K`` experiments. This module makes them
+an artifact: the first time a (heads, head_dim, N, dtype) geometry is
+met, a sweep walks the legal kernel tiers and block sizes, and the
+winner persists to a tuning table consulted by ``ops/attention.py``'s
+dispatcher ahead of the env knobs — so every new model generation lands
+on its best kernel config without code edits, and a fleet shares one
+table the way it shares one XLA cache.
+
+Layout of the decision data:
+
+- **GeometryKey** — (num_heads, head_dim, q_bucket, kv_bucket, dtype);
+  sequence lengths bucket to the next power of two so one entry serves
+  a resolution family instead of every ±8-token variant compiling its
+  own sweep.
+- **KernelChoice** — (tier, block_q, block_k): tier is one of ``fused``
+  (QKV projection folded into the flash grid), ``packed`` ([B, N, H·D]
+  native layout, VMEM-shrunk blocks where needed), ``bh`` (classic
+  [B·H, N, D] call), ``xla`` (the fused XLA lowering).
+- **TuningTable** — two layers: the resolved table for the known model
+  zoo shipped in-repo (``ops/attn_table_default.json``, rebakeable with
+  ``scripts/autotune_sweep.py``) plus a local overlay persisted next to
+  the XLA compilation cache, stored and atomically merged exactly like
+  the shape catalog (``utils/jsonio.py``: tmp+rename writes, merge on
+  save, corrupt files degrade to empty).
+
+Sweeps run OFF the request path: ``diffusion/warmup.py`` tunes every
+catalog geometry during the worker's AOT pass (the worker reports
+``warming`` until its geometries are tuned), and the CLI pre-bakes
+fleet images. On hardware the sweep times real candidates; off
+hardware (``mode="dry"``) it resolves the same deterministic
+legality-ranked policy the shipped table was baked with — same
+geometry + same table ⇒ same choice, always.
+
+Knobs: ``CDT_ATTN_TABLE`` (local overlay path; default
+``<CDT_COMPILE_CACHE_DIR>/attn_tuning.json``), ``CDT_ATTN_TUNE=0``
+disables table lookups AND sweeps (env knobs and measured defaults
+rule, the pre-tuning-table behavior).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+import time
+from pathlib import Path
+from typing import Callable, Iterable, Optional
+
+from ..utils.jsonio import atomic_write_json, read_json
+from ..utils.logging import debug_log, log
+
+TABLE_VERSION = 1
+TIERS = ("fused", "packed", "bh", "xla")
+
+# the in-repo resolved table for the known model zoo
+_SHIPPED_PATH = Path(__file__).resolve().parent / "attn_table_default.json"
+
+_DTYPE_NAMES = {"bfloat16": "bf16", "float32": "f32", "float16": "f16",
+                "bf16": "bf16", "f32": "f32", "f16": "f16"}
+
+
+def dtype_name(dtype) -> str:
+    """Canonical short dtype tag for table keys ('bf16', 'f32', ...).
+    Accepts numpy/jax dtypes, scalar types (``jnp.bfloat16``) and
+    strings; already-short tags pass through."""
+    import numpy as np
+
+    try:
+        name = np.dtype(dtype).name
+    except TypeError:
+        name = getattr(dtype, "name", None) or str(dtype)
+    return _DTYPE_NAMES.get(name, name)
+
+
+def itemsize_of(dtype) -> int:
+    """Operand byte width for the VMEM working-set model. One
+    definition — the dispatcher, the validator and the policy all key
+    legality on it, and a drift between them would approve blocks the
+    kernel can't fit."""
+    return 4 if dtype_name(dtype) == "f32" else 2
+
+
+def seq_bucket(n: int) -> int:
+    """Next power of two ≥ n, floored at 128 — one table entry serves a
+    resolution family (SDXL 4096 → 4096, WAN 14040 → 16384, a 77-token
+    text context → 128) instead of every exact length sweeping anew."""
+    b = 128
+    while b < n:
+        b *= 2
+    return b
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class GeometryKey:
+    """One attention geometry as the dispatcher sees it at trace time."""
+
+    num_heads: int
+    head_dim: int
+    q_bucket: int
+    kv_bucket: int
+    dtype: str = "bf16"
+
+    def __post_init__(self):
+        if self.num_heads <= 0 or self.head_dim <= 0:
+            raise ValueError(f"bad geometry {self!r}")
+
+    @classmethod
+    def from_shape(cls, num_heads: int, head_dim: int, q_len: int,
+                   kv_len: int, dtype="bfloat16") -> "GeometryKey":
+        return cls(num_heads=int(num_heads), head_dim=int(head_dim),
+                   q_bucket=seq_bucket(int(q_len)),
+                   kv_bucket=seq_bucket(int(kv_len)),
+                   dtype=dtype_name(dtype))
+
+    def key_str(self) -> str:
+        """Stable JSON map key / telemetry geometry label."""
+        return (f"h{self.num_heads}.d{self.head_dim}.q{self.q_bucket}"
+                f".kv{self.kv_bucket}.{self.dtype}")
+
+    @classmethod
+    def from_key_str(cls, s: str) -> "GeometryKey":
+        try:
+            h, d, q, kv, dt = s.split(".")
+            return cls(num_heads=int(h[1:]), head_dim=int(d[1:]),
+                       q_bucket=int(q[1:]), kv_bucket=int(kv[2:]), dtype=dt)
+        except (ValueError, IndexError):
+            raise ValueError(f"malformed geometry key {s!r}") from None
+
+
+@dataclasses.dataclass(frozen=True)
+class KernelChoice:
+    """A resolved kernel config: what ``full_attention`` should run."""
+
+    tier: str
+    block_q: Optional[int] = None      # None: tier has no blocks (xla)
+    block_k: Optional[int] = None
+    source: str = "default"            # default | env | table | sweep
+    reason: str = ""
+
+    def __post_init__(self):
+        if self.tier not in TIERS:
+            raise ValueError(f"unknown kernel tier {self.tier!r}; "
+                             f"have {TIERS}")
+
+    def to_dict(self) -> dict:
+        d = {"tier": self.tier}
+        if self.block_q is not None:
+            d["block_q"] = self.block_q
+        if self.block_k is not None:
+            d["block_k"] = self.block_k
+        if self.reason:
+            d["reason"] = self.reason
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict, source: str = "table") -> "KernelChoice":
+        return cls(tier=str(d["tier"]),
+                   block_q=(int(d["block_q"]) if d.get("block_q") is not None
+                            else None),
+                   block_k=(int(d["block_k"]) if d.get("block_k") is not None
+                            else None),
+                   source=source, reason=str(d.get("reason", "")))
+
+
+def validate_entry(key: GeometryKey, choice: KernelChoice) -> list[str]:
+    """Legality errors for one table entry (empty = legal). The shipped
+    table's tier-1 test and the CLI both run every entry through this,
+    so a bad bake fails fast instead of failing in Mosaic lowering on a
+    serving host."""
+    from . import flash_attention as fa
+
+    errors: list[str] = []
+    itemsize = itemsize_of(key.dtype)
+    H, D = key.num_heads, key.head_dim
+    if choice.tier == "xla":
+        if choice.block_q is not None or choice.block_k is not None:
+            errors.append("xla tier takes no block sizes")
+        return errors
+    try:
+        bq, bk = fa.resolve_flash_blocks(choice.block_q, choice.block_k)
+    except ValueError as e:
+        return [str(e)]
+    if choice.tier == "packed":
+        feas = fa._packed_feasible(H, D, bq, bk, itemsize)
+        if feas is None:
+            errors.append(
+                f"packed tier infeasible at H={H}, D={D} ({key.dtype})")
+        elif feas != (bq, bk):
+            errors.append(
+                f"blocks {bq}/{bk} exceed the VMEM model at H·D={H * D} "
+                f"({key.dtype}); largest feasible {feas[0]}/{feas[1]}")
+    elif choice.tier == "fused":
+        feas = fa._fused_feasible(H * D, H, D, bq, bk, itemsize)
+        if feas is None:
+            errors.append(
+                f"fused tier infeasible at C=H·D={H * D} ({key.dtype})")
+        elif feas != (bq, bk):
+            errors.append(
+                f"fused blocks {bq}/{bk} exceed the VMEM model at "
+                f"C=H·D={H * D} ({key.dtype}); largest feasible "
+                f"{feas[0]}/{feas[1]}")
+    return errors
+
+
+def table_path() -> Path:
+    env = os.environ.get("CDT_ATTN_TABLE")
+    if env:
+        return Path(env)
+    from ..utils.compile_cache import cache_dir_default
+
+    return Path(cache_dir_default()) / "attn_tuning.json"
+
+
+class TuningTable:
+    """Layered geometry → KernelChoice map.
+
+    The shipped layer (in-repo, read-only) resolves the known model zoo;
+    the local layer (next to the XLA cache) holds sweep results and
+    overrides shipped entries on conflict — a fleet that re-swept a
+    geometry on its own hardware generation trusts its own numbers.
+    Thread-safe; persistence follows the shape-catalog contract (atomic
+    tmp+rename, merge-on-save, corrupt files degrade to empty)."""
+
+    def __init__(self, path: "Path | str | None" = None,
+                 shipped: bool = True, autoload: bool = True):
+        self.path = Path(path) if path is not None else table_path()
+        self._lock = threading.Lock()
+        self._shipped: dict[GeometryKey, KernelChoice] = {}
+        self._local: dict[GeometryKey, KernelChoice] = {}
+        if autoload:
+            if shipped:
+                self._shipped = self._load_file(_SHIPPED_PATH,
+                                                source="table")
+            self.load()
+
+    @staticmethod
+    def _load_file(path: Path, source: str) -> dict:
+        raw = read_json(path)
+        entries = raw.get("entries", {}) if isinstance(raw, dict) else {}
+        out: dict[GeometryKey, KernelChoice] = {}
+        if not isinstance(entries, dict):
+            return out
+        for ks, d in entries.items():
+            try:
+                out[GeometryKey.from_key_str(ks)] = \
+                    KernelChoice.from_dict(d, source=source)
+            except (KeyError, TypeError, ValueError):
+                debug_log(f"attn table: skipping malformed entry "
+                          f"{ks!r} in {path}")
+        return out
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(set(self._shipped) | set(self._local))
+
+    def entries(self) -> dict[GeometryKey, KernelChoice]:
+        """Effective view, local overriding shipped; sorted for
+        deterministic walks."""
+        with self._lock:
+            merged = dict(self._shipped)
+            merged.update(self._local)
+        return dict(sorted(merged.items()))
+
+    def lookup(self, num_heads: int, head_dim: int, q_len: int,
+               kv_len: int, dtype="bfloat16") -> Optional[KernelChoice]:
+        key = GeometryKey.from_shape(num_heads, head_dim, q_len, kv_len,
+                                     dtype)
+        with self._lock:
+            return self._local.get(key) or self._shipped.get(key)
+
+    def get(self, key: GeometryKey) -> Optional[KernelChoice]:
+        with self._lock:
+            return self._local.get(key) or self._shipped.get(key)
+
+    def record(self, key: GeometryKey, choice: KernelChoice,
+               save: bool = True) -> None:
+        with self._lock:
+            self._local[key] = choice
+        if save:
+            self.save()
+
+    # --- persistence (local layer only — shipped is read-only) -------------
+
+    def load(self) -> int:
+        """Merge the on-disk local layer into memory. In-memory entries
+        win on conflict (they are newer sweeps)."""
+        loaded = self._load_file(self.path, source="table")
+        added = 0
+        with self._lock:
+            for k, v in loaded.items():
+                if k not in self._local:
+                    self._local[k] = v
+                    added += 1
+        return added
+
+    def save(self) -> bool:
+        """Merge-write the local layer (re-load first so concurrent
+        sweepers union; atomic tmp+rename)."""
+        self.load()
+        with self._lock:
+            payload = {
+                "version": TABLE_VERSION,
+                "entries": {k.key_str(): v.to_dict()
+                            for k, v in sorted(self._local.items())},
+            }
+        if atomic_write_json(self.path, payload):
+            return True
+        debug_log(f"attn table: save to {self.path} failed")
+        return False
+
+
+# --- process-global default table -------------------------------------------
+
+_default: "TuningTable | None" = None
+_default_lock = threading.Lock()
+
+
+def tuning_enabled() -> bool:
+    return os.environ.get("CDT_ATTN_TUNE", "1") not in ("0", "false", "off")
+
+
+def default_table() -> TuningTable:
+    global _default
+    with _default_lock:
+        if _default is None:
+            _default = TuningTable()
+        return _default
+
+
+def reset_default_table() -> None:
+    """Test isolation: drop the cached instance so env-var paths
+    re-resolve."""
+    global _default
+    with _default_lock:
+        _default = None
+
+
+def lookup(num_heads: int, head_dim: int, q_len: int, kv_len: int,
+           dtype="bfloat16") -> Optional[KernelChoice]:
+    """Table consultation for the dispatcher: None when tuning is
+    disabled, the table is empty for this geometry, or the lookup itself
+    fails (a corrupt table must never take attention down)."""
+    if not tuning_enabled():
+        return None
+    try:
+        return default_table().lookup(num_heads, head_dim, q_len, kv_len,
+                                      dtype)
+    except Exception as e:  # noqa: BLE001 — lookup is advisory
+        debug_log(f"attn table: lookup failed: {e}")
+        return None
+
+
+# --- sweeping ----------------------------------------------------------------
+
+BLOCK_Q_CANDIDATES = (128, 256, 512)
+BLOCK_K_CANDIDATES = (128, 256, 512, 1024)
+
+# engagement floors measured r04 (docs/roofline.md finding 1a): below
+# them XLA's fused lowering wins and the sweep doesn't bother timing
+# pallas tiers — they'd be legal but pointless
+_PACKED_MIN_Q = 1024
+_PACKED_MIN_KV = 256
+_BH_MIN_Q = 8192
+
+
+def candidates_for(key: GeometryKey) -> list[KernelChoice]:
+    """Deterministic candidate list for one geometry: every legal
+    (tier, block_q, block_k) worth timing, xla always last (the
+    baseline). Order is fixed so timed ties and dry-mode policy picks
+    are reproducible."""
+    from . import flash_attention as fa
+
+    itemsize = itemsize_of(key.dtype)
+    H, D = key.num_heads, key.head_dim
+    out: list[KernelChoice] = []
+    long_enough = (key.q_bucket >= _PACKED_MIN_Q
+                   and key.kv_bucket >= _PACKED_MIN_KV)
+    # fused is self-attention only (q and k/v project from the SAME x);
+    # cross geometries never get fused candidates — no fusable site can
+    # present them, and timing one would race an Nq×Nq problem against
+    # the other tiers' Nq×Nk
+    if long_enough and key.q_bucket == key.kv_bucket:
+        for bq in BLOCK_Q_CANDIDATES:
+            for bk in BLOCK_K_CANDIDATES:
+                if fa._fused_feasible(H * D, H, D, bq, bk,
+                                      itemsize) == (bq, bk):
+                    out.append(KernelChoice("fused", bq, bk,
+                                            source="sweep"))
+        for bq in BLOCK_Q_CANDIDATES:
+            for bk in BLOCK_K_CANDIDATES:
+                if fa._packed_feasible(H, D, bq, bk, itemsize) == (bq, bk):
+                    out.append(KernelChoice("packed", bq, bk,
+                                            source="sweep"))
+    if key.q_bucket >= _BH_MIN_Q or long_enough:
+        for bq, bk in ((256, 512), (256, 1024), (512, 512)):
+            out.append(KernelChoice("bh", bq, bk, source="sweep"))
+    out.append(KernelChoice("xla", source="sweep"))
+    return out
+
+
+def resolve_policy_choice(key: GeometryKey) -> KernelChoice:
+    """Deterministic no-timing resolution — what ``mode=\"dry\"`` sweeps
+    and the shipped-table bake use. Encodes the r04/r05 measurements as
+    a ranking instead of a stopwatch: fused where it fits with real
+    tiles (boundary cost beats the K/V-projection recompute only when
+    the working set isn't starved), else packed (VMEM-shrunk blocks
+    where the native ceiling is exceeded), else the classic bh call at
+    long-N, else xla. A timed sweep on hardware overrides all of this."""
+    from . import flash_attention as fa
+
+    itemsize = itemsize_of(key.dtype)
+    H, D = key.num_heads, key.head_dim
+    if key.q_bucket < _PACKED_MIN_Q or key.kv_bucket < _PACKED_MIN_KV:
+        if key.q_bucket >= _BH_MIN_Q:
+            return KernelChoice("bh", fa._DEFAULT_BLOCK_Q,
+                                fa._DEFAULT_BLOCK_K, source="sweep",
+                                reason="long q, short kv: streamed "
+                                       "softmax memory win (r04 gate)")
+        return KernelChoice("xla", source="sweep",
+                            reason="below packed floors (r04: XLA fused "
+                                   "lowering wins short sequences)")
+    fused = (fa._fused_feasible(H * D, H, D, itemsize=itemsize)
+             if key.q_bucket == key.kv_bucket else None)  # self-attn only
+    if fused is not None and fused[0] >= 128 and fused[1] >= 256:
+        return KernelChoice("fused", fused[0], fused[1], source="sweep",
+                            reason="fused feasible with non-starved "
+                                   "tiles: boundary cost > projection "
+                                   "recompute")
+    packed = fa._packed_feasible(H, D, itemsize=itemsize)
+    if packed is not None:
+        why = ("native packed layout (r04 finding 1a)"
+               if H * D <= fa._PACKED_MAX_HD
+               else "VMEM-shrunk packed tiles past the native H·D "
+                    "ceiling (block-shrink legality path, ISSUE 8)")
+        return KernelChoice("packed", packed[0], packed[1],
+                            source="sweep", reason=why)
+    return KernelChoice("bh", fa._DEFAULT_BLOCK_Q, fa._DEFAULT_BLOCK_K,
+                        source="sweep",
+                        reason="packed geometrically illegal")
+
+
+def _time_candidate(key: GeometryKey, choice: KernelChoice,
+                    runs: int = 3) -> float:
+    """Median seconds/op of one candidate on the live backend (chained
+    scan so per-op time isn't swamped by dispatch overhead)."""
+    import jax
+    import jax.numpy as jnp
+
+    from . import flash_attention as fa
+
+    dt = {"bf16": jnp.bfloat16, "f32": jnp.float32,
+          "f16": jnp.float16}[key.dtype]
+    H, D = key.num_heads, key.head_dim
+    B, Nq, Nk = 1, key.q_bucket, key.kv_bucket
+    scan_len = 8
+
+    if choice.tier == "fused":
+        C = H * D
+        x = jax.random.normal(jax.random.key(0), (B, Nq, C), dt)
+        ws = [jax.random.normal(jax.random.key(i), (C, C), dt) / (C ** 0.5)
+              for i in (1, 2, 3)]
+
+        def op(carry):
+            o = fa.fused_qkv_attention(carry, *ws, H,
+                                       block_q=choice.block_q,
+                                       block_k=choice.block_k,
+                                       interpret=False)
+            return o.reshape(B, Nq, C)
+    else:
+        q = jax.random.normal(jax.random.key(0), (B, Nq, H, D), dt)
+        k = jax.random.normal(jax.random.key(1), (B, Nk, H, D), dt)
+        v = jax.random.normal(jax.random.key(2), (B, Nk, H, D), dt)
+
+        if choice.tier == "xla":
+            def op(carry):
+                return jax.nn.dot_product_attention(carry, k, v)
+        else:
+            def op(carry):
+                return fa.flash_attention(
+                    carry, k, v, block_q=choice.block_q,
+                    block_k=choice.block_k, interpret=False,
+                    layout="packed" if choice.tier == "packed" else "bh")
+
+    @jax.jit
+    def run(seed, first):
+        def body(carry, _):
+            out = op(carry)
+            return (first + out * (seed * 1e-6).astype(first.dtype)), None
+
+        final, _ = jax.lax.scan(body, first, None, length=scan_len)
+        return jnp.sum(final.astype(jnp.float32))
+
+    first = x if choice.tier == "fused" else q
+    import statistics
+
+    float(run(jnp.float32(0.0), first))            # compile + warm
+    times = []
+    for i in range(runs):
+        t0 = time.perf_counter()
+        float(run(jnp.float32(i + 1.0), first))
+        times.append(time.perf_counter() - t0)
+    return statistics.median(times) / scan_len
+
+
+@dataclasses.dataclass
+class SweepEntry:
+    key: GeometryKey
+    choice: Optional[KernelChoice]
+    outcome: str                  # swept | dry | cached | error
+    seconds: float = 0.0
+    detail: str = ""
+
+    def to_dict(self) -> dict:
+        return {"geometry": self.key.key_str(),
+                "choice": self.choice.to_dict() if self.choice else None,
+                "outcome": self.outcome,
+                "seconds": round(self.seconds, 3),
+                "detail": self.detail}
+
+
+def sweep_geometry(key: GeometryKey, mode: str = "auto",
+                   runs: int = 3) -> SweepEntry:
+    """Resolve the best kernel config for one geometry.
+
+    ``mode="timed"`` measures every candidate on the live backend (TPU);
+    ``mode="dry"`` resolves the deterministic policy (CPU-safe, what the
+    shipped table was baked with); ``mode="auto"`` picks timed on TPU,
+    dry elsewhere. Per-geometry failures are recorded, never raised."""
+    from .flash_attention import _on_tpu
+
+    if mode == "auto":
+        mode = "timed" if _on_tpu() else "dry"
+    t0 = time.perf_counter()
+    try:
+        if mode == "dry":
+            choice = resolve_policy_choice(key)
+            return SweepEntry(key, choice, "dry",
+                              time.perf_counter() - t0)
+        timings = []
+        for cand in candidates_for(key):
+            try:
+                timings.append((_time_candidate(key, cand, runs), cand))
+            except Exception as e:  # noqa: BLE001 — candidate isolation
+                debug_log(f"autotune: candidate {cand.tier} "
+                          f"{cand.block_q}/{cand.block_k} failed on "
+                          f"{key.key_str()}: {e}")
+        if not timings:
+            return SweepEntry(key, None, "error",
+                              time.perf_counter() - t0,
+                              detail="every candidate failed")
+        best_t, best = min(timings, key=lambda tc: tc[0])
+        best = dataclasses.replace(
+            best, reason=f"timed sweep: {best_t * 1e6:.0f} us/op over "
+                         f"{len(timings)} candidates")
+        return SweepEntry(key, best, "swept", time.perf_counter() - t0)
+    except Exception as e:  # noqa: BLE001 — sweeps must never sink warmup
+        return SweepEntry(key, None, "error", time.perf_counter() - t0,
+                          detail=str(e))
+
+
+def ensure_tuned(geometries: Iterable[GeometryKey],
+                 table: Optional[TuningTable] = None, mode: str = "auto",
+                 on_entry: Optional[Callable[[SweepEntry], None]] = None
+                 ) -> list[SweepEntry]:
+    """Sweep every geometry not already in the table; persist winners
+    once at the end (one atomic merge-write). Already-tuned geometries
+    report ``cached`` — same geometry + same table ⇒ same config, no
+    re-sweep, which is what keeps the tuner off the request path after
+    the first boot."""
+    from ..telemetry import enabled as _tm_enabled
+    from ..telemetry import metrics as _tm
+
+    if table is None:
+        table = default_table()
+    report: list[SweepEntry] = []
+    dirty = False
+    for key in sorted(set(geometries)):
+        existing = table.get(key)
+        if existing is not None:
+            entry = SweepEntry(key, existing, "cached")
+        else:
+            entry = sweep_geometry(key, mode=mode)
+            if entry.choice is not None:
+                table.record(key, entry.choice, save=False)
+                dirty = True
+            if _tm_enabled():
+                _tm.AUTOTUNE_SWEEP_SECONDS.observe(entry.seconds)
+        report.append(entry)
+        if on_entry is not None:
+            on_entry(entry)
+    if dirty:
+        table.save()
+    return report
+
+
+# --- geometry derivation (warmup + CLI) --------------------------------------
+
+
+def _cfg_heads_dim(cfg) -> tuple[int, int]:
+    heads = getattr(cfg, "num_heads", None) or getattr(cfg, "heads")
+    width = getattr(cfg, "dim", None) or getattr(cfg, "hidden")
+    head_dim = getattr(cfg, "head_dim", None) or width // heads
+    return int(heads), int(head_dim)
+
+
+def geometries_for_program(bundle, key) -> list[GeometryKey]:
+    """Attention geometries one catalog program (``ProgramKey``) will
+    trace — what the warmup pass hands to ``ensure_tuned`` so a worker
+    reports ready only once its serving geometries are tuned. Geometry
+    math mirrors the model definitions (UNet level downsampling, DiT
+    patchify, WAN 3D-VAE temporal compression); unknown pipeline shapes
+    raise — the caller records the error per program."""
+    out: list[GeometryKey] = []
+    text_len = int(bundle.preset.text.max_len)
+    if key.pipeline == "txt2img":
+        cfg = bundle.pipeline.unet.config
+        dt = cfg.dtype
+        lat_h, lat_w = key.height // 8, key.width // 8
+        for level, depth in enumerate(cfg.transformer_depth):
+            if not depth:
+                continue
+            tokens = (lat_h >> level) * (lat_w >> level)
+            ch = cfg.model_channels * cfg.channel_mult[level]
+            heads = (cfg.num_heads if cfg.num_heads > 0
+                     else ch // cfg.head_dim)
+            head_dim = ch // heads
+            out.append(GeometryKey.from_shape(heads, head_dim, tokens,
+                                              tokens, dt))
+            out.append(GeometryKey.from_shape(heads, head_dim, tokens,
+                                              text_len, dt))
+    elif key.pipeline == "flow_dp":
+        cfg = bundle.pipeline.dit.config
+        heads, head_dim = _cfg_heads_dim(cfg)
+        patch = int(getattr(cfg, "patch_size", 2))
+        img_tokens = (key.height // 8 // patch) * (key.width // 8 // patch)
+        joint = img_tokens + text_len
+        out.append(GeometryKey.from_shape(heads, head_dim, joint, joint,
+                                          cfg.dtype))
+    elif key.pipeline == "video_dp":
+        pipeline = bundle.pipeline
+        cfg = pipeline.dit.config
+        heads, head_dim = _cfg_heads_dim(cfg)
+        patch = getattr(cfg, "patch_size", (1, 2, 2))
+        if isinstance(patch, int):
+            patch = (1, patch, patch)
+        pt, ph, pw = patch
+        frames = key.frames or 17
+        padded = frames + (-(frames - 1)) % 4     # pad_frames_4n1
+        tds = int(getattr(pipeline, "temporal_downscale", 1))
+        lat_f = (padded - 1) // tds + 1
+        tokens = ((lat_f // pt) * (key.height // 8 // ph)
+                  * (key.width // 8 // pw))
+        out.append(GeometryKey.from_shape(heads, head_dim, tokens, tokens,
+                                          cfg.dtype))
+        out.append(GeometryKey.from_shape(heads, head_dim, tokens,
+                                          text_len, cfg.dtype))
+    else:
+        raise ValueError(f"no geometry recipe for pipeline "
+                         f"{key.pipeline!r}")
+    return out
+
+
+def model_zoo_geometries() -> dict[str, GeometryKey]:
+    """The known model zoo's serving geometries (docs/roofline.md r05
+    table) — what the shipped table resolves and what the CLI and the
+    r07 bench A/B walk. Static so baking needs no checkpoints."""
+    zoo = {
+        # SDXL UNet at 1024²: 64²=4096 tokens @ 10 heads × 64, 32²=1024
+        # tokens @ 20 × 64, plus the 77-token cross-attention contexts
+        "sdxl_self64": GeometryKey.from_shape(10, 64, 4096, 4096),
+        "sdxl_self32": GeometryKey.from_shape(20, 64, 1024, 1024),
+        "sdxl_cross64": GeometryKey.from_shape(10, 64, 4096, 77),
+        "sdxl_cross32": GeometryKey.from_shape(20, 64, 1024, 77),
+        # FLUX-12B at 1024²: 4096 image + 512 text joint tokens,
+        # 24 heads × 128 (H·D = 3072 — past the native packed ceiling)
+        "flux_joint": GeometryKey.from_shape(24, 128, 4608, 4608),
+        # WAN-1.3B t2v 33f 480p: 14040 spatio-temporal tokens,
+        # 12 heads × 128, plus the 512-token text cross-attention
+        "wan_self": GeometryKey.from_shape(12, 128, 14040, 14040),
+        "wan_cross": GeometryKey.from_shape(12, 128, 14040, 512),
+    }
+    return zoo

@@ -28,6 +28,7 @@ masked with a static-length comparison — shapes stay static for XLA.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Optional
 
 import jax
@@ -37,7 +38,61 @@ from jax.experimental.pallas import tpu as pltpu
 
 # lane width: scratch vectors m/l are stored lane-replicated (BQ, 128)
 _LANES = 128
+_SUBLANES = 8        # f32 sublane tile height — block_q granularity
 NEG_INF = -1e30      # large-but-finite: -inf breaks max on fully-masked rows
+
+_DEFAULT_BLOCK_Q = 256   # measured r04 at SDXL shapes (docs/roofline.md)
+_DEFAULT_BLOCK_K = 512
+
+
+def _parse_block_env(name: str, multiple: int) -> Optional[int]:
+    """Parse one ``CDT_FLASH_BLOCK_*`` knob, rejecting values pallas
+    would only reject deep in Mosaic lowering (or worse, mis-tile): the
+    block size must be a positive multiple of the hardware tile for its
+    axis (``block_q``: 8 sublanes, ``block_k``: 128 lanes). Unset/empty
+    returns None (caller applies the default)."""
+    raw = os.environ.get(name)
+    if raw is None or raw.strip() == "":
+        return None
+    try:
+        value = int(raw)
+    except (TypeError, ValueError):
+        raise ValueError(
+            f"{name}={raw!r} is not an integer: flash block sizes must be "
+            f"positive multiples of {multiple}") from None
+    _check_block(name, value, multiple)
+    return value
+
+
+def _check_block(name: str, value: int, multiple: int) -> None:
+    if value <= 0 or value % multiple:
+        raise ValueError(
+            f"{name}={value} is not a legal flash block size: must be a "
+            f"positive multiple of {multiple} (TPU "
+            f"{'sublane' if multiple == _SUBLANES else 'lane'} tiling) — "
+            "pallas would fail during Mosaic lowering otherwise")
+
+
+def resolve_flash_blocks(block_q: Optional[int] = None,
+                         block_k: Optional[int] = None) -> tuple[int, int]:
+    """Resolve (block_q, block_k): explicit args win, then the
+    ``CDT_FLASH_BLOCK_Q``/``CDT_FLASH_BLOCK_K`` env knobs, then the
+    measured defaults (256/512, r04). Both sources are validated at
+    parse time — a non-positive or non-(8,128)-divisible value raises a
+    descriptive ``ValueError`` here instead of letting pallas fail deep
+    in lowering (tuning-table entries pass through the same check via
+    ``ops/autotune.py``)."""
+    if block_q is None:
+        block_q = _parse_block_env("CDT_FLASH_BLOCK_Q", _SUBLANES)
+        block_q = _DEFAULT_BLOCK_Q if block_q is None else block_q
+    else:
+        _check_block("block_q", block_q, _SUBLANES)
+    if block_k is None:
+        block_k = _parse_block_env("CDT_FLASH_BLOCK_K", _LANES)
+        block_k = _DEFAULT_BLOCK_K if block_k is None else block_k
+    else:
+        _check_block("block_k", block_k, _LANES)
+    return block_q, block_k
 
 
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
@@ -90,31 +145,15 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
         o_ref[0] = (acc_ref[:] / l).astype(o_ref.dtype)
 
 
-def _flash_kernel_packed(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
-                         *, kv_len: int, block_k: int, num_k_blocks: int,
-                         scale: float, precision, num_heads: int,
-                         head_dim: int):
-    """Packed-heads variant: refs are [1, block, H·D] slices of the
-    model's NATURAL layout — the fused QKV projection emits [B, N, H·D]
-    and splitting heads along the minor axis is free, so no transpose
-    ever happens at the custom-call boundary (the boundary relayout, not
-    the kernel body, is what made the classic [B·H, N, D] call lose to
-    XLA fused attention at SDXL sequence lengths — `docs/roofline.md`
-    finding 1). Heads unroll statically inside the kernel; head h's
-    running max / denominator each live in lane h of one [BQ, 128]
-    scratch (hence ``num_heads ≤ 128``)."""
-    j = pl.program_id(2)
-
-    @pl.when(j == 0)
-    def _init():
-        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
-        l_ref[:] = jnp.zeros_like(l_ref)
-        acc_ref[:] = jnp.zeros_like(acc_ref)
-
-    q = q_ref[0]                                   # [BQ, H·D]
-    k = k_ref[0]                                   # [BK, H·D]
-    v = v_ref[0]                                   # [BK, H·D]
-
+def _accumulate_packed_heads(q, k, v, j, m_ref, l_ref, acc_ref, *,
+                             kv_len: int, block_k: int, scale: float,
+                             precision, num_heads: int, head_dim: int):
+    """One K-block accumulation over statically-unrolled heads, operands
+    in the packed [block, H·D] layout. Head h's running max/denominator
+    live in lane h of the [BQ, 128] m/l scratches (hence ``num_heads ≤
+    128``). Shared by the packed and fused kernel tiers — the fused tier
+    differs only in where q/k/v come from (projected in-kernel), not in
+    the accumulation math."""
     col = jax.lax.broadcasted_iota(
         jnp.int32, (q.shape[0], block_k), 1) if kv_len % block_k else None
 
@@ -143,13 +182,100 @@ def _flash_kernel_packed(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
         m_ref[:, h:h + 1] = m_new
         l_ref[:, h:h + 1] = l_new
 
+
+def _finalize_packed_heads(o_ref, m_ref, l_ref, acc_ref, *,
+                           num_heads: int, head_dim: int):
+    """Write the normalized output block once, on the final K step."""
+    for h in range(num_heads):
+        sl = slice(h * head_dim, (h + 1) * head_dim)
+        l = l_ref[:, h:h + 1]
+        l = jnp.where(l == 0.0, 1.0, l)            # fully-masked rows → 0
+        o_ref[0, :, sl] = (acc_ref[:, sl] / l).astype(o_ref.dtype)
+
+
+def _flash_kernel_packed(q_ref, k_ref, v_ref, o_ref, m_ref, l_ref, acc_ref,
+                         *, kv_len: int, block_k: int, num_k_blocks: int,
+                         scale: float, precision, num_heads: int,
+                         head_dim: int):
+    """Packed-heads variant: refs are [1, block, H·D] slices of the
+    model's NATURAL layout — the fused QKV projection emits [B, N, H·D]
+    and splitting heads along the minor axis is free, so no transpose
+    ever happens at the custom-call boundary (the boundary relayout, not
+    the kernel body, is what made the classic [B·H, N, D] call lose to
+    XLA fused attention at SDXL sequence lengths — `docs/roofline.md`
+    finding 1)."""
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    _accumulate_packed_heads(
+        q_ref[0], k_ref[0], v_ref[0], j, m_ref, l_ref, acc_ref,
+        kv_len=kv_len, block_k=block_k, scale=scale, precision=precision,
+        num_heads=num_heads, head_dim=head_dim)
+
     @pl.when(j == num_k_blocks - 1)
     def _finalize():
-        for h in range(num_heads):
-            sl = slice(h * head_dim, (h + 1) * head_dim)
-            l = l_ref[:, h:h + 1]
-            l = jnp.where(l == 0.0, 1.0, l)
-            o_ref[0, :, sl] = (acc_ref[:, sl] / l).astype(o_ref.dtype)
+        _finalize_packed_heads(o_ref, m_ref, l_ref, acc_ref,
+                               num_heads=num_heads, head_dim=head_dim)
+
+
+def _flash_kernel_fused(xq_ref, xkv_ref, wq_ref, wk_ref, wv_ref, o_ref,
+                        q_ref, m_ref, l_ref, acc_ref, *,
+                        kv_len: int, block_k: int, num_k_blocks: int,
+                        scale: float, precision, num_heads: int,
+                        head_dim: int):
+    """Fused QKV-projection + attention: the kernel's inputs are the
+    attention block's INPUT activations (x, [1, block, C] row tiles) and
+    the three [C, H·D] projection weights — q/k/v are projected on-chip
+    and never round-trip HBM, so there is no custom-call boundary for
+    XLA to lose fusions at (the ~15 ms/forward relayout + lost-fusion
+    cost `docs/roofline.md` finding 1 measured).
+
+    Schedule: the q row-block is projected ONCE per grid row (j == 0)
+    into VMEM scratch; each K step projects its own [BK, C]·[C, H·D]
+    k/v tiles before the shared packed-heads accumulation. The K/V
+    projection is therefore recomputed once per q block — ``Nq/block_q``
+    times total, an extra ``C/block_q`` of the attention FLOPs — which
+    is why the tier is selected per geometry by the autotune sweep
+    (``ops/autotune.py``) rather than by default: it wins where the
+    boundary cost beats the recompute (narrow C, long N), loses where it
+    doesn't. Projections accumulate in f32 on the MXU and cast back to
+    the operand dtype, matching the out-of-kernel Dense numerics."""
+    j = pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        q = jax.lax.dot_general(
+            xq_ref[0], wq_ref[:], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32, precision=precision)
+        q_ref[:] = q.astype(q_ref.dtype)
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    xkv = xkv_ref[0]                               # [BK, C]
+    k = jax.lax.dot_general(
+        xkv, wk_ref[:], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32, precision=precision,
+    ).astype(q_ref.dtype)                          # [BK, H·D]
+    v = jax.lax.dot_general(
+        xkv, wv_ref[:], (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32, precision=precision,
+    ).astype(q_ref.dtype)
+
+    _accumulate_packed_heads(
+        q_ref[:], k, v, j, m_ref, l_ref, acc_ref,
+        kv_len=kv_len, block_k=block_k, scale=scale, precision=precision,
+        num_heads=num_heads, head_dim=head_dim)
+
+    @pl.when(j == num_k_blocks - 1)
+    def _finalize():
+        _finalize_packed_heads(o_ref, m_ref, l_ref, acc_ref,
+                               num_heads=num_heads, head_dim=head_dim)
 
 
 def _pad_to(x: jax.Array, axis: int, multiple: int) -> jax.Array:
@@ -330,19 +456,127 @@ def _flash_mha_packed(q, k, v, num_heads: int, block_q: int, block_k: int,
 
 # past this packed width the kernel needs shrunken q/k blocks to keep
 # its VMEM working set (double-buffered [block, H·D] K/V tiles + the
-# f32 accumulator) inside the ~16 MB budget, and the shrink costs more
-# than the boundary relayout saves — measured r04 at FLUX's H·D = 3072:
-# 128/256 blocks ran the offload ladder at 1.34 s/step vs the classic
-# [B·H, N, D] call's 1.21 s (`benchmarks/r04_tpu_flux.json`). Wide
-# layouts therefore stay on the classic call.
+# f32 accumulator) inside the ~16 MB budget. The DEFAULT auto-layout
+# stays classic past this width — the one shrink probed at r04 (FLUX's
+# H·D = 3072, 128/256 blocks) ran the offload ladder at 1.34 s/step vs
+# the classic [B·H, N, D] call's 1.21 s (`benchmarks/r04_tpu_flux.json`)
+# — but shrunken-packed is now *reachable* (explicit ``layout="packed"``
+# or a tuning-table entry, ``ops/autotune.py``): the r04 probe tried one
+# block pair, and the autotune sweep walks the whole feasible set.
 _PACKED_MAX_HD = 2048
 
+# scoped-VMEM budget the working-set model checks against. The r05 WAN
+# probe anchors it: 1024 K-blocks at H·D=1536 died at 25.09 MB scoped
+# vs the chip's 16 MB, 512 K-blocks fit (docs/roofline.md).
+_VMEM_BUDGET_BYTES = 16 * 1024 * 1024
+_MIN_BLOCK_Q = 64     # shrink floors: below these tiles the grid is all
+_MIN_BLOCK_K = 128    # overhead (one lane tile / 8 sublane tiles)
 
-def _packed_blocks(hd: int, block_q: int, block_k: int) -> tuple[int, int]:
-    """Block sizes for the packed call — a hook for shapes whose VMEM
-    working set needs smaller tiles (none under the current
-    ``_PACKED_MAX_HD``; see the measured note above)."""
-    return block_q, block_k
+
+def _packed_vmem_bytes(hd: int, block_q: int, block_k: int,
+                       itemsize: int) -> int:
+    """Working-set estimate of one packed-kernel grid step: double-
+    buffered q/k/v/out tiles in the operand dtype plus the f32 output
+    accumulator and the two lane-replicated m/l scratches."""
+    io = 2 * (2 * block_q * hd + 2 * block_k * hd) * itemsize
+    scratch = block_q * hd * 4 + 2 * block_q * _LANES * 4
+    return io + scratch
+
+
+def _fused_vmem_bytes(c: int, hd: int, block_q: int, block_k: int,
+                      itemsize: int) -> int:
+    """Working set of one fused-kernel grid step: double-buffered x
+    row-tiles ([block, C]) and the out tile, the three resident [C, H·D]
+    projection weights (constant index map — fetched once, not double-
+    buffered), the projected-q scratch (operand dtype) and the f32
+    accumulator + m/l scratches."""
+    io = 2 * (block_q * c + block_k * c + block_q * hd) * itemsize
+    weights = 3 * c * hd * itemsize
+    scratch = (block_q * hd * itemsize          # projected q
+               + block_q * hd * 4               # f32 accumulator
+               + 2 * block_q * _LANES * 4)      # m / l
+    return io + weights + scratch
+
+
+def _shrink_blocks_for_vmem(bytes_fn, block_q: int, block_k: int
+                            ) -> Optional[tuple[int, int]]:
+    """Halve block_k (first — K tiles dominate the working set), then
+    block_q, until ``bytes_fn(bq, bk)`` fits ``_VMEM_BUDGET_BYTES``;
+    None when even the floor tiles blow the budget. Deterministic: the
+    same request always shrinks to the same blocks."""
+    bq, bk = block_q, block_k
+    while bytes_fn(bq, bk) > _VMEM_BUDGET_BYTES:
+        if bk > _MIN_BLOCK_K:
+            bk //= 2
+        elif bq > _MIN_BLOCK_Q:
+            bq //= 2
+        else:
+            return None
+    return bq, bk
+
+
+_shrink_logged: set = set()
+
+
+def _log_shrink(hd: int, block_q: int, block_k: int,
+                shrunk: Optional[tuple[int, int]], itemsize: int) -> None:
+    """Once per combination: a VMEM shrink of OPERATOR-requested blocks
+    is never silent — block-tuning experiments (`CDT_FLASH_BLOCK_Q/K`,
+    docs/roofline.md r05) must not measure different blocks than they
+    record. Candidate enumeration (the sweep) calls the feasibility
+    helpers directly and is exempt by construction."""
+    if not shrunk or shrunk == (block_q, block_k):
+        return
+    sig = (hd, block_q, block_k, itemsize)
+    if sig in _shrink_logged:
+        return
+    _shrink_logged.add(sig)
+    from ..utils.logging import log
+
+    log(f"flash packed: requested blocks {block_q}/{block_k} exceed the "
+        f"VMEM model at H·D={hd} ({itemsize}B operands); shrunk to "
+        f"{shrunk[0]}/{shrunk[1]}")
+
+
+def _packed_blocks(hd: int, block_q: int, block_k: int,
+                   itemsize: int = 2) -> tuple[int, int]:
+    """Block sizes for the packed call: the requested blocks, shrunk
+    (K first) until the VMEM working-set model fits — the legality path
+    that lets geometries past the native ``_PACKED_MAX_HD`` ceiling
+    (FLUX's H·D = 3072) run packed with shrunken [block, H·D] tiles
+    instead of falling back to the classic [B·H, N, D] call. Raises when
+    no feasible blocks exist (callers check ``_packed_feasible`` first).
+
+    A shrink is LOGGED (once per combination): block-tuning experiments
+    (`CDT_FLASH_BLOCK_Q/K`, docs/roofline.md r05) must never silently
+    measure different blocks than the operator requested."""
+    shrunk = _shrink_blocks_for_vmem(
+        functools.partial(_packed_vmem_bytes, hd, itemsize=itemsize),
+        block_q, block_k)
+    if shrunk is None:
+        raise ValueError(
+            f"packed flash attention infeasible at H·D={hd}: even "
+            f"{_MIN_BLOCK_Q}/{_MIN_BLOCK_K} blocks exceed the "
+            f"{_VMEM_BUDGET_BYTES >> 20} MB VMEM budget")
+    _log_shrink(hd, block_q, block_k, shrunk, itemsize)
+    return shrunk
+
+
+def _packed_feasible(H: int, D: int, block_q: int = _DEFAULT_BLOCK_Q,
+                     block_k: int = _DEFAULT_BLOCK_K,
+                     itemsize: int = 2) -> Optional[tuple[int, int]]:
+    """Shrink-aware packed legality: the geometric constraints of
+    ``_packed_legal`` minus its native width ceiling, plus a feasible
+    block pair under the VMEM model. Returns the (possibly shrunken)
+    blocks, or None. Used by explicit ``layout=\"packed\"`` requests and
+    tuning-table entries; the DEFAULT auto layout keeps the conservative
+    ``_packed_legal`` ceiling (shrunken-packed engages only where a
+    sweep or an operator asked for it)."""
+    if not ((H * D) % _LANES == 0 and H <= _LANES and D % 64 == 0):
+        return None
+    return _shrink_blocks_for_vmem(
+        functools.partial(_packed_vmem_bytes, H * D, itemsize=itemsize),
+        block_q, block_k)
 
 
 def _flash_min_seq_packed() -> int:
@@ -420,37 +654,38 @@ def flash_attention(
     ``block_q``/``block_k=None`` resolve to ``CDT_FLASH_BLOCK_Q``/
     ``CDT_FLASH_BLOCK_K`` (defaults 256/512, measured r04; the r05 WAN
     probes showed 512 is also the largest K block the 16 MB scoped VMEM
-    admits at H·D=1536 — docs/roofline.md).
+    admits at H·D=1536 — docs/roofline.md). Both the env knobs and
+    explicit arguments are validated at parse time
+    (``resolve_flash_blocks``): non-positive or non-(8,128)-divisible
+    values raise a descriptive error instead of failing in lowering.
 
     ``layout`` forces the kernel I/O layout for this call: ``"packed"``
-    (where geometrically legal — illegal geometries still fall back) or
+    (where geometrically feasible — including widths past the native
+    ``_PACKED_MAX_HD`` ceiling via VMEM-model block shrinking; truly
+    infeasible geometries still fall back to the classic call) or
     ``"bh"``; ``None`` auto-selects per ``_layout_packed`` (legality +
-    measured floors + ``CDT_FLASH_LAYOUT``). Used by layout-equivalence
-    tests and power users; the env var remains the global knob.
+    measured floors + ``CDT_FLASH_LAYOUT``). Used by the tuning table
+    (``ops/autotune.py``), layout-equivalence tests and power users; the
+    env var remains the global knob.
     """
     if interpret is None:
         interpret = not _on_tpu()
-    if block_q is None or block_k is None:
-        from ..utils.constants import env_int
-
-        # defaults measured r04 at SDXL shapes; env knobs for per-shape
-        # tuning experiments (r05: larger K blocks probed at WAN's 14k
-        # tokens — see docs/roofline.md). Non-positive values fall back
-        # to the defaults — same no-crash contract as env_int itself.
-        if block_q is None:
-            block_q = env_int("CDT_FLASH_BLOCK_Q", 256)
-            block_q = block_q if block_q > 0 else 256
-        if block_k is None:
-            block_k = env_int("CDT_FLASH_BLOCK_K", 512)
-            block_k = block_k if block_k > 0 else 512
+    block_q, block_k = resolve_flash_blocks(block_q, block_k)
     B, Nq, H, D = q.shape
     _, Nk, _, _ = k.shape
+    itemsize = jnp.dtype(q.dtype).itemsize
+    packed_blocks: Optional[tuple[int, int]] = None
     if layout == "packed":
-        use_packed = _packed_legal(H, D)   # explicit beats env + floors
+        # explicit beats env + floors; shrink-aware so FLUX-width
+        # geometries run packed instead of silently degrading to classic
+        packed_blocks = _packed_feasible(H, D, block_q, block_k, itemsize)
+        _log_shrink(H * D, block_q, block_k, packed_blocks, itemsize)
     elif layout == "bh":
-        use_packed = False
+        packed_blocks = None
     elif layout is None:
-        use_packed = _layout_packed(H, D, Nq=Nq, Nk=Nk)
+        if _layout_packed(H, D, Nq=Nq, Nk=Nk):
+            packed_blocks = _packed_blocks(H * D, block_q, block_k,
+                                           itemsize)
     else:
         raise ValueError(
             f"layout must be 'packed', 'bh', or None, got {layout!r}")
@@ -460,8 +695,8 @@ def flash_attention(
     if interpret and _in_manual_trace(q):
         out = _flash_emulated(to_bh(q, Nq), to_bh(k, Nk), to_bh(v, Nk),
                               block_q=block_q, block_k=block_k)
-    elif use_packed:
-        bq, bk = _packed_blocks(H * D, block_q, block_k)
+    elif packed_blocks is not None:
+        bq, bk = packed_blocks
         out = _flash_mha_packed(
             q.reshape(B, Nq, H * D), k.reshape(B, Nk, H * D),
             v.reshape(B, Nk, H * D), num_heads=H,
@@ -472,3 +707,169 @@ def flash_attention(
                          block_q=block_q, block_k=block_k,
                          interpret=interpret)
     return out.reshape(B, H, Nq, D).transpose(0, 2, 1, 3)
+
+
+# --- fused QKV-projection + attention tier ----------------------------------
+
+
+def _fused_feasible(C: int, H: int, D: int,
+                    block_q: int = _DEFAULT_BLOCK_Q,
+                    block_k: int = _DEFAULT_BLOCK_K,
+                    itemsize: int = 2) -> Optional[tuple[int, int]]:
+    """Hardware legality of the fused tier: packed-heads geometric
+    constraints plus a lane-aligned model width (C on the x-tile minor
+    axis) plus a feasible block pair under the fused VMEM model — the
+    three resident [C, H·D] weights dominate it, so wide models (WAN
+    1536, FLUX 3072) are fused-infeasible on chip and take the packed
+    (possibly block-shrunk) tier from the tuning table instead. Returns
+    the (possibly shrunken) blocks, or None."""
+    HD = H * D
+    if not (HD % _LANES == 0 and H <= _LANES and D % 64 == 0
+            and C % _LANES == 0):
+        return None
+    return _shrink_blocks_for_vmem(
+        functools.partial(_fused_vmem_bytes, C, HD, itemsize=itemsize),
+        block_q, block_k)
+
+
+def split_qkv_weight(w_qkv: jax.Array) -> tuple[jax.Array, jax.Array,
+                                                jax.Array]:
+    """[C, 3·H·D] fused-projection weight → (wq, wk, wv) static slices
+    (the layout ``models/dit.py``'s ``qkv`` Dense emits)."""
+    hd = w_qkv.shape[-1] // 3
+    return w_qkv[:, :hd], w_qkv[:, hd:2 * hd], w_qkv[:, 2 * hd:]
+
+
+def _fused_emulated(x, wq, wk, wv, num_heads: int, block_q: int,
+                    block_k: int):
+    """Fused tier in plain JAX ops: projection (f32 MXU accumulation,
+    cast back to the operand dtype — exactly the kernel's epilogue) then
+    the shared `_flash_emulated` block schedule. The CPU/shard_map
+    stand-in that keeps the fused tier testable everywhere the pallas
+    interpreter can't run; the block schedule and masking are identical,
+    so parity tests of this path cover the kernel's math."""
+    B, N, C = x.shape
+    HD = wq.shape[-1]
+    D = HD // num_heads
+
+    def proj(w):
+        y = jax.lax.dot_general(x, w, (((2,), (0,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        return y.astype(x.dtype)
+
+    def to_bh(t):
+        return (t.reshape(B, N, num_heads, D)
+                .transpose(0, 2, 1, 3).reshape(B * num_heads, N, D))
+
+    out = _flash_emulated(to_bh(proj(wq)), to_bh(proj(wk)), to_bh(proj(wv)),
+                          block_q=block_q, block_k=block_k)
+    return (out.reshape(B, num_heads, N, D).transpose(0, 2, 1, 3))
+
+
+@functools.partial(jax.jit, static_argnames=("num_heads", "block_q",
+                                             "block_k", "interpret"))
+def _flash_mha_fused(x, wq, wk, wv, num_heads: int, block_q: int,
+                     block_k: int, interpret: bool):
+    B, N, C = x.shape
+    HD = wq.shape[-1]
+    D = HD // num_heads
+    scale = 1.0 / (D ** 0.5)
+    precision = (jax.lax.Precision.HIGHEST if x.dtype == jnp.float32
+                 else jax.lax.Precision.DEFAULT)
+
+    # x is streamed twice under different paddings: q row-tiles walk
+    # block_q-grained rows, k/v row-tiles walk block_k-grained rows
+    xq = _pad_to(x, 1, block_q)
+    xkv = _pad_to(x, 1, block_k)
+    nqb = xq.shape[1] // block_q
+    nkb = xkv.shape[1] // block_k
+
+    try:
+        vma = getattr(jax.typeof(xq), "vma", None)
+    except Exception:  # noqa: BLE001 — typeof unavailable outside tracing
+        vma = None
+    out_shape = (B, xq.shape[1], HD)
+    out_sds = (jax.ShapeDtypeStruct(out_shape, x.dtype, vma=vma)
+               if vma else jax.ShapeDtypeStruct(out_shape, x.dtype))
+
+    kernel = functools.partial(
+        _flash_kernel_fused, kv_len=N, block_k=block_k, num_k_blocks=nkb,
+        scale=scale, precision=precision, num_heads=num_heads, head_dim=D)
+
+    w_spec = pl.BlockSpec((C, HD), lambda b, i, j: (0, 0),
+                          memory_space=pltpu.VMEM)
+    out = pl.pallas_call(
+        kernel,
+        grid=(B, nqb, nkb),
+        in_specs=[
+            pl.BlockSpec((1, block_q, C), lambda b, i, j: (b, i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, C), lambda b, i, j: (b, j, 0),
+                         memory_space=pltpu.VMEM),
+            w_spec, w_spec, w_spec,
+        ],
+        out_specs=pl.BlockSpec((1, block_q, HD), lambda b, i, j: (b, i, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=out_sds,
+        scratch_shapes=[
+            pltpu.VMEM((block_q, HD), x.dtype),           # projected q
+            pltpu.VMEM((block_q, _LANES), jnp.float32),   # per-head max
+            pltpu.VMEM((block_q, _LANES), jnp.float32),   # per-head sum
+            pltpu.VMEM((block_q, HD), jnp.float32),       # output acc
+        ],
+        interpret=interpret,
+    )(xq, xkv, wq, wk, wv)
+    return out[:, :N]
+
+
+def fused_qkv_attention(
+    x: jax.Array, wq: jax.Array, wk: jax.Array, wv: jax.Array,
+    num_heads: int,
+    block_q: Optional[int] = None, block_k: Optional[int] = None,
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Self-attention computed straight from the block's input
+    activations: ``x`` [B, N, C] and the three bias-free projection
+    weights [C, H·D] (``split_qkv_weight`` splits a packed [C, 3·H·D]).
+    Returns [B, N, H, D] — the same contract as ``full_attention`` on
+    the projected operands, without q/k/v ever materializing in HBM.
+
+    Serves projection→attention sites with nothing in between (SDXL
+    UNet self-attention); sites that qk-norm/RoPE between projection and
+    attention (FLUX, WAN) cannot fuse and take the packed tier instead.
+    ``interpret=None`` auto-selects like ``flash_attention``; blocks
+    resolve via the same validated env knobs. On hardware, infeasible
+    geometries (the VMEM model — weights resident) raise; in interpret
+    mode the requested blocks run regardless, keeping every geometry
+    CPU-testable."""
+    if interpret is None:
+        interpret = not _on_tpu()
+    block_q, block_k = resolve_flash_blocks(block_q, block_k)
+    B, N, C = x.shape
+    HD = wq.shape[-1]
+    if wq.shape != (C, HD) or wk.shape != (C, HD) or wv.shape != (C, HD):
+        raise ValueError(
+            f"fused qkv attention needs three [C, H·D] weights; got "
+            f"wq={wq.shape}, wk={wk.shape}, wv={wv.shape} for C={C}")
+    if HD % num_heads:
+        raise ValueError(
+            f"projection width {HD} not divisible by num_heads={num_heads}")
+    D = HD // num_heads
+    if interpret and _in_manual_trace(x):
+        return _fused_emulated(x, wq, wk, wv, num_heads,
+                               block_q=block_q, block_k=block_k)
+    itemsize = jnp.dtype(x.dtype).itemsize
+    blocks = _fused_feasible(C, num_heads, D, block_q, block_k, itemsize)
+    if blocks is None:
+        if not interpret:
+            raise ValueError(
+                f"fused qkv attention infeasible at C={C}, H·D={HD} "
+                f"({x.dtype}): the resident projection weights exceed the "
+                f"{_VMEM_BUDGET_BYTES >> 20} MB VMEM budget at any block "
+                "size — use the packed tier (ops/autotune.py picks this "
+                "per geometry)")
+        blocks = (block_q, block_k)
+    bq, bk = blocks
+    out = _flash_mha_fused(x, wq, wk, wv, num_heads=num_heads,
+                           block_q=bq, block_k=bk, interpret=interpret)
+    return out.reshape(B, N, num_heads, D)

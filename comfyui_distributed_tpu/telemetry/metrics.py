@@ -41,6 +41,24 @@ PIPELINE_EXECUTE_SECONDS = REGISTRY.histogram(
     "the first), by pipeline.",
     ("pipeline",))
 
+# --- attention kernel dispatch / autotune (ops/attention.py, ops/autotune.py)
+
+ATTN_KERNEL_SELECTED = REGISTRY.counter(
+    "cdt_attn_kernel_selected",
+    "Attention kernel-tier selections at trace time, by tier "
+    "(fused/packed/bh/xla) and geometry (hH.dD.qN.kvN.dtype — bucketed, "
+    "so cardinality is bounded by the model zoo). Increments once per "
+    "traced program per geometry; the dispatch decision is observable "
+    "without a profiler.",
+    ("tier", "geometry"))
+
+AUTOTUNE_SWEEP_SECONDS = REGISTRY.histogram(
+    "cdt_autotune_sweep_seconds",
+    "Wall-clock of one attention autotune sweep (all candidates for one "
+    "geometry). Runs off the request path — during warmup or the "
+    "autotune_sweep.py CLI.",
+    buckets=COMPILE_BUCKETS)
+
 # --- tile farm --------------------------------------------------------------
 
 TILE_EVENTS = REGISTRY.counter(

@@ -170,12 +170,15 @@ def exp_batch() -> None:
 
 
 def exp_attn() -> None:
-    """Attention microbench at SDXL's two self-attention shapes and the
-    cross-attention shape, flash vs XLA."""
+    """Attention microbench: flash (auto layout) vs XLA, plus the fused
+    QKV+attention tier at self-attention shapes where C == H·D. Shapes
+    cover SDXL, the FLUX H·D=3072 width (shrunk-packed since ISSUE 8) and
+    WAN's ~14k-token geometry."""
     import jax
     import jax.numpy as jnp
 
-    from comfyui_distributed_tpu.ops.flash_attention import flash_attention
+    from comfyui_distributed_tpu.ops.flash_attention import (
+        flash_attention, fused_qkv_attention)
 
     shapes = [
         ("self64", 2, 4096, 10, 64, 4096),
@@ -183,6 +186,8 @@ def exp_attn() -> None:
         ("cross32", 2, 1024, 20, 64, 77),
         ("self64_b4", 4, 4096, 10, 64, 4096),
         ("self32_b4", 4, 1024, 20, 64, 1024),
+        ("flux3072", 1, 4608, 24, 128, 4608),
+        ("wan14k", 1, 14040, 12, 128, 14040),
     ]
     ATTN_SCAN = 64   # attention ops chained on-device per timed call —
                      # a single op is ~µs while the tunnel RTT is ~66 ms,
@@ -200,6 +205,19 @@ def exp_attn() -> None:
 
         return run
 
+    def timed_fused(h, ws):
+        @jax.jit
+        def run(seed, x):
+            def body(carry, _):
+                out = fused_qkv_attention(carry, *ws, h, interpret=False)
+                out = out.reshape(carry.shape)
+                return (x + out * (seed * 1e-6).astype(x.dtype)), None
+
+            final, _ = jax.lax.scan(body, x, None, length=ATTN_SCAN)
+            return jnp.sum(final.astype(jnp.float32))
+
+        return run
+
     for name, b, nq, h, d, nk in shapes:
         # works for nq != nk too: attention output is q-shaped, so the
         # scan carry stays [B, Nq, H, D] while k/v stay fixed
@@ -212,13 +230,29 @@ def exp_attn() -> None:
         t_xla = _median_time(timed_attn(jax.nn.dot_product_attention),
                              q, k, v) / ATTN_SCAN
         flops = 4.0 * b * h * nq * nk * d          # fwd: QK^T + PV
-        print(json.dumps({
+        rec = {
             "exp": "attn", "shape": name,
             "flash_us": round(t_flash * 1e6, 1),
             "xla_us": round(t_xla * 1e6, 1),
             "flash_tflops": round(flops / t_flash / 1e12, 1),
             "xla_tflops": round(flops / t_xla / 1e12, 1),
-        }), flush=True)
+        }
+        if nq == nk:   # self-attention: fused tier (C == H·D) if feasible
+            from comfyui_distributed_tpu.ops.flash_attention import (
+                _fused_feasible)
+
+            C = h * d
+            if _fused_feasible(C, h, d) is not None:
+                x = jax.random.normal(jax.random.key(3), (b, nq, C),
+                                      jnp.bfloat16)
+                ws = [jax.random.normal(jax.random.key(4 + i), (C, C),
+                                        jnp.bfloat16) / (C ** 0.5)
+                      for i in range(3)]
+                t_fused = _median_time(timed_fused(h, ws), x) / ATTN_SCAN
+                # the fused op also does the QKV projection; its FLOPs
+                # column includes that so tiers stay comparable per op
+                rec["fused_us"] = round(t_fused * 1e6, 1)
+        print(json.dumps(rec), flush=True)
 
 
 def exp_trace(out_dir: str = "/tmp/mfu_trace") -> None:

@@ -1,0 +1,387 @@
+"""Attention autotuner (ops/autotune.py): table persistence + merge,
+shipped-table legality, deterministic sweeps, and dispatcher precedence
+(table > env knobs > measured defaults). Fast — no model builds, no
+pallas execution; tier-1."""
+
+import json
+import types
+
+import pytest
+
+from comfyui_distributed_tpu.ops import autotune
+from comfyui_distributed_tpu.ops.autotune import (
+    GeometryKey, KernelChoice, TuningTable)
+
+
+def geom(h=10, d=64, q=4096, kv=4096, dtype="bf16"):
+    return GeometryKey(num_heads=h, head_dim=d, q_bucket=q, kv_bucket=kv,
+                       dtype=dtype)
+
+
+class TestGeometryKey:
+    def test_bucketing(self):
+        assert autotune.seq_bucket(77) == 128
+        assert autotune.seq_bucket(128) == 128
+        assert autotune.seq_bucket(129) == 256
+        assert autotune.seq_bucket(4096) == 4096
+        assert autotune.seq_bucket(14040) == 16384
+
+    def test_key_str_round_trip(self):
+        k = GeometryKey.from_shape(12, 128, 14040, 512, "bfloat16")
+        assert k.q_bucket == 16384 and k.kv_bucket == 512
+        assert GeometryKey.from_key_str(k.key_str()) == k
+
+    def test_dtype_names(self):
+        import jax.numpy as jnp
+
+        assert autotune.dtype_name(jnp.bfloat16) == "bf16"
+        assert autotune.dtype_name("float32") == "f32"
+        assert autotune.dtype_name("bf16") == "bf16"
+
+    def test_malformed_key_str_raises(self):
+        with pytest.raises(ValueError, match="malformed"):
+            GeometryKey.from_key_str("not-a-key")
+
+
+class TestTableRoundTrip:
+    def test_record_save_load(self, tmp_path):
+        path = tmp_path / "table.json"
+        t = TuningTable(path=path, shipped=False)
+        t.record(geom(), KernelChoice("packed", 256, 512, source="sweep"))
+        t2 = TuningTable(path=path, shipped=False)
+        got = t2.get(geom())
+        assert got is not None
+        assert (got.tier, got.block_q, got.block_k) == ("packed", 256, 512)
+
+    def test_atomic_merge_across_writers(self, tmp_path):
+        """Two processes sweeping different geometries into one file must
+        union, not clobber (the shape-catalog contract)."""
+        path = tmp_path / "table.json"
+        a = TuningTable(path=path, shipped=False)
+        b = TuningTable(path=path, shipped=False)
+        a.record(geom(h=10), KernelChoice("fused", 256, 512, source="sweep"))
+        b.record(geom(h=20, q=1024, kv=1024),
+                 KernelChoice("xla", source="sweep"))
+        merged = TuningTable(path=path, shipped=False)
+        assert merged.get(geom(h=10)) is not None
+        assert merged.get(geom(h=20, q=1024, kv=1024)) is not None
+
+    def test_corrupt_file_degrades_to_empty(self, tmp_path):
+        path = tmp_path / "table.json"
+        path.write_text("{not json")
+        t = TuningTable(path=path, shipped=False)
+        assert len(t) == 0
+        # and the next save heals the file
+        t.record(geom(), KernelChoice("packed", 256, 512, source="sweep"))
+        assert json.loads(path.read_text())["entries"]
+
+    def test_malformed_entries_skipped(self, tmp_path):
+        path = tmp_path / "table.json"
+        path.write_text(json.dumps({
+            "version": 1,
+            "entries": {
+                "h10.d64.q4096.kv4096.bf16": {"tier": "packed",
+                                              "block_q": 256,
+                                              "block_k": 512},
+                "garbage": {"tier": "packed"},
+                "h2.d64.q128.kv128.bf16": {"tier": "warp-drive"},
+            }}))
+        t = TuningTable(path=path, shipped=False)
+        assert len(t) == 1
+
+    def test_local_overrides_shipped(self, tmp_path):
+        t = TuningTable(path=tmp_path / "t.json", shipped=True)
+        shipped_geom = GeometryKey.from_shape(24, 128, 4608, 4608)
+        assert t.get(shipped_geom) is not None          # shipped FLUX entry
+        t.record(shipped_geom, KernelChoice("bh", 256, 512, source="sweep"))
+        assert t.get(shipped_geom).tier == "bh"
+
+
+class TestShippedTable:
+    """The resolved model-zoo table that ships in-repo must parse and
+    every entry must pass the legality checks — a bad bake fails here,
+    not in Mosaic lowering on a serving host."""
+
+    def test_parses_and_covers_the_zoo(self):
+        t = TuningTable(shipped=True, path="/nonexistent/none.json",
+                        autoload=True)
+        entries = t.entries()
+        assert entries, "shipped table is empty"
+        zoo = autotune.model_zoo_geometries()
+        for name, key in zoo.items():
+            assert t.get(key) is not None, f"zoo geometry {name} untuned"
+
+    def test_every_entry_passes_legality(self):
+        t = TuningTable(shipped=True, path="/nonexistent/none.json")
+        for key, choice in t.entries().items():
+            errors = autotune.validate_entry(key, choice)
+            assert not errors, f"{key.key_str()}: {errors}"
+
+    def test_flux_geometry_does_not_fall_back_to_classic(self):
+        """Acceptance: H·D=3072 gets shrunken packed tiles (or fused),
+        not the classic bh call."""
+        t = TuningTable(shipped=True, path="/nonexistent/none.json")
+        choice = t.get(GeometryKey.from_shape(24, 128, 4608, 4608))
+        assert choice.tier in ("packed", "fused")
+
+    def test_validate_entry_catches_vmem_blowout(self):
+        errors = autotune.validate_entry(
+            geom(h=12, d=128, q=16384, kv=16384),
+            KernelChoice("packed", 256, 1024))
+        assert errors and "VMEM" in errors[0]
+
+    def test_validate_entry_catches_bad_blocks(self):
+        errors = autotune.validate_entry(
+            geom(), KernelChoice("packed", 100, 512))
+        assert errors and "multiple of 8" in errors[0]
+
+
+class TestSweep:
+    def test_dry_sweep_deterministic(self):
+        k = geom(h=12, d=128, q=16384, kv=16384)
+        a = autotune.sweep_geometry(k, mode="dry")
+        b = autotune.sweep_geometry(k, mode="dry")
+        assert a.choice == b.choice
+        assert a.choice.tier == "packed"
+
+    def test_dry_policy_short_sequences_stay_xla(self):
+        e = autotune.sweep_geometry(geom(q=512, kv=512), mode="dry")
+        assert e.choice.tier == "xla"
+
+    def test_dry_policy_flux_width_gets_shrunk_packed(self):
+        e = autotune.sweep_geometry(
+            geom(h=24, d=128, q=8192, kv=8192), mode="dry")
+        assert e.choice.tier == "packed"
+        assert (e.choice.block_q, e.choice.block_k) == (256, 256)
+
+    def test_candidates_deterministic_and_legal(self):
+        k = geom(h=24, d=128, q=8192, kv=8192)
+        cands = autotune.candidates_for(k)
+        assert cands == autotune.candidates_for(k)
+        assert cands[-1].tier == "xla"
+        for c in cands:
+            assert not autotune.validate_entry(k, c)
+
+    def test_ensure_tuned_records_and_caches(self, tmp_path):
+        t = TuningTable(path=tmp_path / "t.json", shipped=False)
+        keys = [geom(), geom(h=20, q=1024, kv=1024)]
+        first = autotune.ensure_tuned(keys, table=t, mode="dry")
+        assert all(e.outcome == "dry" for e in first)
+        again = autotune.ensure_tuned(keys, table=t, mode="dry")
+        assert all(e.outcome == "cached" for e in again)
+        # persisted: a fresh instance sees both entries
+        t2 = TuningTable(path=tmp_path / "t.json", shipped=False)
+        assert all(t2.get(k) is not None for k in keys)
+
+
+class TestDispatcherPrecedence:
+    """select_kernel: explicit CDT_FLASH_ATTENTION > tuning table > env
+    knobs > measured defaults; deterministic given a table."""
+
+    @pytest.fixture()
+    def on_tpu(self, monkeypatch):
+        from comfyui_distributed_tpu.ops import attention as attn
+
+        for var in ("CDT_FLASH_ATTENTION", "CDT_FLASH_LAYOUT",
+                    "CDT_FLASH_BLOCK_Q", "CDT_FLASH_BLOCK_K",
+                    "CDT_FLASH_MIN_SEQ", "CDT_FLASH_MIN_SEQ_PACKED",
+                    "CDT_FLASH_MIN_KV_PACKED", "CDT_ATTN_TUNE"):
+            monkeypatch.delenv(var, raising=False)
+        fake = types.SimpleNamespace(platform="tpu")
+        monkeypatch.setattr(attn.jax, "devices", lambda *a: [fake])
+        attn.reset_selections()
+        return attn
+
+    def table_with(self, key, choice):
+        autotune.reset_default_table()
+        t = autotune.default_table()
+        t.record(key, choice, save=False)
+        return t
+
+    def test_table_beats_env_knobs(self, on_tpu, monkeypatch):
+        key = GeometryKey.from_shape(10, 64, 4096, 4096)
+        self.table_with(key, KernelChoice("bh", 128, 256, source="sweep"))
+        monkeypatch.setenv("CDT_FLASH_LAYOUT", "packed")
+        monkeypatch.setenv("CDT_FLASH_BLOCK_Q", "512")
+        choice = on_tpu.select_kernel(4096, 4096, 10, 64)
+        assert (choice.tier, choice.block_q, choice.block_k) == \
+            ("bh", 128, 256)
+
+    def test_env_knobs_beat_defaults_without_table(self, on_tpu,
+                                                   monkeypatch):
+        autotune.reset_default_table()
+        monkeypatch.setenv("CDT_ATTN_TUNE", "0")   # no table layer at all
+        # CDT_FLASH_LAYOUT=bh keeps the r04 semantics: packed disabled,
+        # classic call only past its 8192 gate
+        monkeypatch.setenv("CDT_FLASH_LAYOUT", "bh")
+        assert on_tpu.select_kernel(9000, 9000, 10, 64).tier == "bh"
+        assert on_tpu.select_kernel(4096, 4096, 10, 64).tier == "xla"
+        monkeypatch.delenv("CDT_FLASH_LAYOUT")
+        choice = on_tpu.select_kernel(4096, 4096, 10, 64)
+        assert choice.tier == "packed"             # r04 default
+
+    def test_explicit_flag_beats_table(self, on_tpu, monkeypatch):
+        key = GeometryKey.from_shape(10, 64, 4096, 4096)
+        self.table_with(key, KernelChoice("packed", 256, 512,
+                                          source="sweep"))
+        monkeypatch.setenv("CDT_FLASH_ATTENTION", "0")
+        assert on_tpu.select_kernel(4096, 4096, 10, 64).tier == "xla"
+
+    def test_deterministic_given_table(self, on_tpu):
+        key = GeometryKey.from_shape(12, 128, 14040, 14040)
+        self.table_with(key, KernelChoice("packed", 256, 512,
+                                          source="sweep"))
+        a = on_tpu.select_kernel(14040, 14040, 12, 128)
+        b = on_tpu.select_kernel(14040, 14040, 12, 128)
+        assert a == b
+        assert (a.tier, a.block_q, a.block_k) == ("packed", 256, 512)
+
+    def test_fused_downgrades_at_non_fusable_site(self, on_tpu):
+        key = GeometryKey.from_shape(10, 64, 4096, 4096)
+        self.table_with(key, KernelChoice("fused", 256, 512,
+                                          source="sweep"))
+        fus = on_tpu.select_kernel(4096, 4096, 10, 64, fusable=True)
+        assert fus.tier == "fused"
+        non = on_tpu.select_kernel(4096, 4096, 10, 64, fusable=False)
+        assert non.tier == "packed"
+        assert (non.block_q, non.block_k) == (256, 512)
+
+    def test_explicit_force_beats_table_xla(self, on_tpu, monkeypatch):
+        """CDT_FLASH_ATTENTION=1 promises flash; a table 'xla' entry
+        must yield to it (review finding: precedence says explicit env
+        beats the table both ways, not just for =0)."""
+        key = GeometryKey.from_shape(10, 64, 4096, 128)
+        self.table_with(key, KernelChoice("xla", source="sweep"))
+        monkeypatch.setenv("CDT_FLASH_ATTENTION", "1")
+        assert on_tpu.select_kernel(4096, 128, 10, 64).tier != "xla"
+
+    def test_itemsize_of_handles_scalar_types(self):
+        import jax.numpy as jnp
+
+        assert autotune.itemsize_of(jnp.float32) == 4
+        assert autotune.itemsize_of(jnp.bfloat16) == 2
+        assert autotune.itemsize_of("f32") == 4
+        assert autotune.itemsize_of("bfloat16") == 2
+
+    def test_policy_fused_gate_checks_both_block_axes(self):
+        """(256, 128) must NOT pass the 'non-starved tiles' fused gate
+        (review finding: `>= (128, 256)` compared lexicographically)."""
+        from comfyui_distributed_tpu.ops import flash_attention as fa
+
+        # H·D=1344 (H=21 illegal: 21·64=1344 % 128 != 0)... use a direct
+        # probe of the gate instead: feed the policy a geometry whose
+        # fused feasibility lands at a K floor and assert it avoids fused
+        key = geom(h=12, d=128, q=16384, kv=16384)   # WAN: fused (64,128)
+        assert fa._fused_feasible(1536, 12, 128) == (64, 128)
+        choice = autotune.resolve_policy_choice(key)
+        assert choice.tier != "fused"
+
+    def test_prefer_flash_ignores_table_xla(self, on_tpu):
+        """The memory-constrained caller's guarantee survives a
+        speed-optimized table entry."""
+        key = GeometryKey.from_shape(24, 128, 4608, 4608)
+        self.table_with(key, KernelChoice("xla", source="sweep"))
+        choice = on_tpu.select_kernel(4608, 4608, 24, 128,
+                                      prefer_flash=True)
+        assert choice.tier != "xla"
+
+    def test_off_tpu_defaults_to_xla(self, monkeypatch):
+        from comfyui_distributed_tpu.ops import attention as attn
+
+        monkeypatch.delenv("CDT_FLASH_ATTENTION", raising=False)
+        choice = attn.select_kernel(4096, 4096, 10, 64)
+        assert choice.tier == "xla"
+
+    def test_selection_telemetry_counter(self, on_tpu):
+        from comfyui_distributed_tpu.telemetry import metrics as tm
+
+        key = GeometryKey.from_shape(10, 64, 4096, 4096)
+        self.table_with(key, KernelChoice("packed", 256, 512,
+                                          source="sweep"))
+        on_tpu.reset_selections()
+        before = {tuple(sorted(lbl.items())): snap.get("value", 0)
+                  for lbl, snap in tm.ATTN_KERNEL_SELECTED.series()}
+        on_tpu.select_kernel(4096, 4096, 10, 64)
+        on_tpu.select_kernel(4096, 4096, 10, 64)   # dedup: one increment
+        series = {tuple(sorted(lbl.items())): snap.get("value", 0)
+                  for lbl, snap in tm.ATTN_KERNEL_SELECTED.series()}
+        lbl = tuple(sorted({"tier": "packed",
+                            "geometry": key.key_str()}.items()))
+        assert series.get(lbl, 0) - before.get(lbl, 0) == 1
+        assert key.key_str() in on_tpu.selection_summary()
+
+
+class TestGeometryDerivation:
+    def test_zoo_geometries_cover_roofline_workloads(self):
+        zoo = autotune.model_zoo_geometries()
+        assert zoo["flux_joint"].num_heads * zoo["flux_joint"].head_dim \
+            == 3072
+        assert zoo["wan_self"].q_bucket >= 14040
+        assert zoo["sdxl_self64"].q_bucket == 4096
+
+    def test_geometries_for_txt2img_program(self):
+        """UNet derivation straight from a tiny config — levels with
+        transformer blocks contribute self+cross geometries at the
+        level's downsampled token count."""
+        from comfyui_distributed_tpu.cluster.shape_catalog import ProgramKey
+        from comfyui_distributed_tpu.models.unet import UNetConfig
+
+        cfg = UNetConfig(model_channels=64, channel_mult=(1, 2),
+                         transformer_depth=(0, 1), head_dim=64,
+                         context_dim=128)
+        bundle = types.SimpleNamespace(
+            pipeline=types.SimpleNamespace(
+                unet=types.SimpleNamespace(config=cfg)),
+            preset=types.SimpleNamespace(
+                text=types.SimpleNamespace(max_len=77)))
+        key = ProgramKey(pipeline="txt2img", model="tiny", height=256,
+                         width=256, steps=4)
+        geoms = autotune.geometries_for_program(bundle, key)
+        # one transformer level: 256/8/2 = 16 → 256 tokens, 128ch → 2 heads
+        assert GeometryKey.from_shape(2, 64, 256, 256) in geoms
+        assert GeometryKey.from_shape(2, 64, 256, 77) in geoms
+
+
+@pytest.mark.slow
+class TestSweepCLI:
+    """scripts/autotune_sweep.py end to end (the full zoo sweep — slow
+    tier; the fast shipped-table assertions above ride tier-1)."""
+
+    def test_dry_run_rebakes_identical_table(self, tmp_path):
+        import json
+        import subprocess
+        import sys
+        from pathlib import Path
+
+        repo = Path(__file__).resolve().parents[1]
+        out = tmp_path / "rebaked.json"
+        proc = subprocess.run(
+            [sys.executable, str(repo / "scripts" / "autotune_sweep.py"),
+             "--dry-run", "--out", str(out)],
+            capture_output=True, text=True, timeout=300)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        rebaked = json.loads(out.read_text())["entries"]
+        shipped = json.loads(
+            (repo / "comfyui_distributed_tpu" / "ops"
+             / "attn_table_default.json").read_text())["entries"]
+        # the deterministic policy reproduces the shipped bake exactly —
+        # drift means someone changed policy/legality without re-baking
+        assert rebaked == shipped
+
+    def test_explicit_geometry_sweep(self, tmp_path):
+        import json
+        import subprocess
+        import sys
+        from pathlib import Path
+
+        repo = Path(__file__).resolve().parents[1]
+        out = tmp_path / "one.json"
+        proc = subprocess.run(
+            [sys.executable, str(repo / "scripts" / "autotune_sweep.py"),
+             "--dry-run", "--out", str(out),
+             "--geometry", "h12.d128.q16384.kv16384.bf16"],
+            capture_output=True, text=True, timeout=300)
+        assert proc.returncode == 0, proc.stderr[-2000:]
+        entries = json.loads(out.read_text())["entries"]
+        assert list(entries) == ["h12.d128.q16384.kv16384.bf16"]
+        assert entries["h12.d128.q16384.kv16384.bf16"]["tier"] == "packed"
