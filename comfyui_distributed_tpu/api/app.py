@@ -45,6 +45,7 @@ _CORS_SAFE_PATHS = frozenset({
     "/distributed/network_info",
     "/distributed/metrics",
     "/distributed/metrics.json",
+    "/distributed/frontdoor",
     "/prompt",
 })
 
@@ -252,23 +253,52 @@ def create_app(controller: Controller) -> web.Application:
     # --- public queue API (reference api/job_routes.py:206-236) ------------
     async def distributed_queue(request):
         payload = parse_queue_request_payload(await _json_body(request))
-        result = await controller.orchestrator.orchestrate(
-            payload.prompt,
-            client_id=payload.client_id,
-            enabled_ids=payload.enabled_worker_ids,
-            delegate_master=payload.delegate_master,
-            load_balance=payload.load_balance,
-            trace_id=payload.trace_id,
-        )
+        fd = getattr(controller, "frontdoor", None)
+        if fd is None:
+            # CDT_FRONTDOOR=0: the pre-front-door path, verbatim
+            result = await controller.orchestrator.orchestrate(
+                payload.prompt,
+                client_id=payload.client_id,
+                enabled_ids=payload.enabled_worker_ids,
+                delegate_master=payload.delegate_master,
+                load_balance=payload.load_balance,
+                trace_id=payload.trace_id,
+            )
+            return web.json_response({
+                "prompt_id": result.prompt_id,
+                "number": 0,
+                "node_errors": result.node_errors,
+                "worker_count": result.worker_count,
+                "trace_id": result.trace_id,
+            })
+        res = await fd.submit(payload)
+        if res.outcome == "shed":
+            # explicit overload shedding: deterministic 429 + Retry-After
+            # (docs/serving.md) — clients back off instead of timing out
+            return web.json_response(
+                {"error": "overloaded", "outcome": "shed",
+                 "reason": res.reason,
+                 "retry_after_s": res.retry_after_s, "status": 429},
+                status=429,
+                headers={"Retry-After": str(int(res.retry_after_s) or 1)})
         return web.json_response({
-            "prompt_id": result.prompt_id,
+            "prompt_id": res.prompt_id,
             "number": 0,
-            "node_errors": result.node_errors,
-            "worker_count": result.worker_count,
-            "trace_id": result.trace_id,
+            "node_errors": res.node_errors,
+            "worker_count": res.worker_count,
+            "trace_id": res.trace_id,
+            "outcome": res.outcome,
+            "batched": res.batched,
         })
 
+    async def frontdoor_stats(request):
+        fd = getattr(controller, "frontdoor", None)
+        if fd is None:
+            return web.json_response({"enabled": False})
+        return web.json_response(fd.stats())
+
     r.add_post("/distributed/queue", distributed_queue)
+    r.add_get("/distributed/frontdoor", frontdoor_stats)
 
     # --- collector ingest (reference api/job_routes.py:273-343) ------------
     async def job_complete(request):

@@ -9,7 +9,10 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, Optional
 
+from ..utils import constants
 from ..utils.exceptions import ValidationError
+from .schemas import (validate_deadline_ms, validate_priority,
+                      validate_tenant)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -20,6 +23,11 @@ class QueueRequestPayload:
     delegate_master: Optional[bool] = None
     load_balance: bool = False
     trace_id: Optional[str] = None
+    # --- serving front door (docs/serving.md) ------------------------------
+    # all optional and defaulted so pre-front-door clients are untouched
+    tenant: str = constants.DEFAULT_TENANT
+    priority: str = constants.DEFAULT_PRIORITY
+    deadline_ms: Optional[int] = None
 
 
 def parse_queue_request_payload(payload: Any) -> QueueRequestPayload:
@@ -51,6 +59,13 @@ def parse_queue_request_payload(payload: Any) -> QueueRequestPayload:
     if not isinstance(client_id, str):
         raise ValidationError("'client_id' must be a string", field="client_id")
 
+    tenant = validate_tenant(payload.get("tenant", constants.DEFAULT_TENANT))
+    priority = validate_priority(
+        payload.get("priority", constants.DEFAULT_PRIORITY))
+    deadline_ms = payload.get("deadline_ms")
+    if deadline_ms is not None:
+        deadline_ms = validate_deadline_ms(deadline_ms)
+
     return QueueRequestPayload(
         prompt=prompt,
         client_id=client_id,
@@ -58,4 +73,7 @@ def parse_queue_request_payload(payload: Any) -> QueueRequestPayload:
         delegate_master=delegate,
         load_balance=bool(payload.get("load_balance", False)),
         trace_id=payload.get("trace_id") or None,
+        tenant=tenant,
+        priority=priority,
+        deadline_ms=deadline_ms,
     )

@@ -39,3 +39,37 @@ def parse_positive_float(value: Any, field: str) -> float:
     if out < 0:
         raise ValidationError(f"{field} must be non-negative", field=field)
     return out
+
+
+# --- serving front door fields (docs/serving.md) ---------------------------
+
+MAX_TENANT_LEN = 64
+
+
+def validate_tenant(value: Any) -> str:
+    """Tenant id: non-empty string, bounded (it keys token buckets and
+    telemetry — unbounded ids would be a cardinality leak)."""
+    if (not isinstance(value, str) or not value
+            or len(value) > MAX_TENANT_LEN):
+        raise ValidationError(
+            f"'tenant' must be a non-empty string of at most "
+            f"{MAX_TENANT_LEN} characters", field="tenant")
+    return value
+
+
+def validate_priority(value: Any) -> str:
+    from ..utils import constants
+
+    if value not in constants.PRIORITY_CLASSES:
+        raise ValidationError(
+            f"'priority' must be one of {list(constants.PRIORITY_CLASSES)}, "
+            f"got {value!r}", field="priority")
+    return value
+
+
+def validate_deadline_ms(value: Any) -> int:
+    if isinstance(value, bool) or not isinstance(value, int) or value <= 0:
+        raise ValidationError(
+            "'deadline_ms' must be a positive integer (milliseconds)",
+            field="deadline_ms")
+    return value

@@ -49,6 +49,13 @@ class Controller:
         self.queue = PromptQueue(context_factory=self._execution_context)
         self.orchestrator = Orchestrator(self.store, self.queue,
                                          config_loader=self.load_config)
+        # serving front door (cluster/frontdoor): admission control +
+        # cross-user microbatching in front of the queue; None under
+        # CDT_FRONTDOOR=0 (the API layer then serves the legacy path)
+        from .frontdoor import build_frontdoor
+
+        self.frontdoor = build_frontdoor(self.queue, self.orchestrator,
+                                         config_loader=self.load_config)
         self.loop: Optional[asyncio.AbstractEventLoop] = None
         self.bridge: Optional[CollectorBridge] = None
         self.tile_farm = None
@@ -130,6 +137,8 @@ class Controller:
                                       host_resolver=self.host_by_id)
         self.tile_farm = TileFarm(self.store, self.loop)
         self.queue.start()
+        if self.frontdoor is not None:
+            self.frontdoor.start()
         role = "worker" if self.is_worker else "master"
         log(f"controller up as {role} (machine {machine_id()})")
         if self.is_worker and self.worker_id:
@@ -169,6 +178,8 @@ class Controller:
     async def shutdown(self) -> None:
         from ..utils.network import close_client_session
 
+        if self.frontdoor is not None:
+            await self.frontdoor.stop()
         await self.queue.stop()
         self.progress.close()      # release the global progress sink
         await close_client_session()
@@ -185,6 +196,11 @@ class Controller:
             # cold | warming | ready | error — dispatch prefers hosts
             # that are not mid-warmup (cluster/dispatch.py)
             "warmup": self.warmup.state,
+            # coalescing + queued depth the admission layer sheds on
+            "frontdoor": (None if self.frontdoor is None
+                          else {"depth": self.frontdoor.depth(),
+                                "coalescing":
+                                    self.frontdoor.batcher.pending_count}),
         }
 
     def system_info_no_devices(self) -> dict:

@@ -1,0 +1,224 @@
+"""Multi-tenant serving front door (ROADMAP item 1).
+
+The cluster used to hand every ``POST /distributed/queue`` request
+straight to the orchestrator, which executes one prompt-queue job at a
+time per host. Under production traffic — thousands of concurrent
+requests against a handful of compiled programs — that serializes the
+fleet on Python dispatch overhead and gives no story for overload. The
+front door is the subsystem in between:
+
+- :mod:`classifier` decides whether a request is *microbatchable* (one
+  ``TPUTxt2Img`` over a statically-known program geometry) and under
+  which :class:`~.classifier.GroupKey` same-shape requests coalesce.
+- :mod:`admission` gates the doorway: priority classes, per-tenant
+  token-bucket fairness, queue-depth backpressure, and explicit
+  overload shedding (HTTP 429 + ``Retry-After``) wired into the
+  circuit-breaker health signal.
+- :mod:`batcher` holds admitted batchable requests in a short per-key
+  coalescing window and flushes same-shape groups to the prompt queue
+  as one batch job, highest priority first.
+- :mod:`microbatch` executes a flushed group: per-member graph prefixes,
+  ONE microbatched SPMD program for the sampler stage
+  (``diffusion.pipeline.generate_microbatch`` — outputs bit-identical
+  to solo runs), then per-member suffixes, with per-member error
+  isolation and solo fallback.
+
+Non-batchable requests pass through to the orchestrator unchanged (the
+legacy path, still behind admission control). ``CDT_FRONTDOOR=0``
+removes the subsystem entirely.
+
+See ``docs/serving.md`` for the request lifecycle and tuning knobs.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import os
+import secrets
+import time
+from typing import Optional
+
+from ... import telemetry
+from ...telemetry import metrics as _tm
+from ...utils import constants
+from ...utils.logging import log
+from ..runtime import PromptJob, PromptQueue
+from .admission import AdmissionController, Decision
+from .batcher import CoalescingBatcher
+from .classifier import Classification, classify
+
+
+def frontdoor_enabled() -> bool:
+    return os.environ.get("CDT_FRONTDOOR", "1") not in ("0", "false")
+
+
+@dataclasses.dataclass
+class FrontDoorResult:
+    """What ``POST /distributed/queue`` answers with.
+
+    ``outcome``: ``admitted`` | ``queued`` | ``shed``. Shed results carry
+    ``retry_after_s`` and never a prompt id; admitted results carry the
+    member/orchestration prompt id (or ``node_errors``)."""
+
+    outcome: str
+    prompt_id: str = ""
+    node_errors: list = dataclasses.field(default_factory=list)
+    worker_count: int = 0
+    trace_id: str = ""
+    batched: bool = False
+    reason: str = ""
+    retry_after_s: float = 0.0
+
+
+class FrontDoor:
+    """The serving front door: admission → classification → coalescing.
+
+    One instance per controller, started on the controller's event loop.
+    """
+
+    def __init__(self, queue: PromptQueue, orchestrator,
+                 config_loader=None):
+        self.queue = queue
+        self.orchestrator = orchestrator
+        self.load_config = config_loader
+        self.admission = AdmissionController(depth_provider=self.depth)
+        # capacity gate = continuous batching: while FD_INFLIGHT batch
+        # jobs sit in the queue, ready groups keep absorbing same-shape
+        # arrivals instead of fragmenting into singleton flushes
+        self.batcher = CoalescingBatcher(
+            self._enqueue_group,
+            capacity=lambda: queue.queue_remaining < constants.FD_INFLIGHT)
+        self._task: Optional[asyncio.Task] = None
+        self._classified: dict[str, int] = {}   # reason -> count (stats)
+
+    # --- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        if self._task is None or self._task.done():
+            self._task = asyncio.ensure_future(self.batcher.run())
+        # completed jobs free queue slots: wake the batcher so the next
+        # ready group flushes immediately instead of on its timer
+        self.queue.add_job_done_callback(self.batcher.wake)
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+
+    # --- signals ------------------------------------------------------------
+
+    def depth(self) -> int:
+        """The admission/backpressure signal: everything queued or
+        executing on this controller PLUS everything coalescing in the
+        front door — the same quantity ``cdt_prompt_queue_depth`` exports
+        at the queue layer, extended by the pre-queue window."""
+        return self.queue.queue_remaining + self.batcher.pending_count
+
+    # --- the doorway --------------------------------------------------------
+
+    async def submit(self, payload) -> FrontDoorResult:
+        """Admission-check, classify, and route one queue request.
+
+        ``payload`` is an ``api.queue_request.QueueRequestPayload``."""
+        decision: Decision = self.admission.admit(payload.tenant,
+                                                  payload.priority)
+        if decision.outcome == "shed":
+            return FrontDoorResult(outcome="shed", reason=decision.reason,
+                                   retry_after_s=decision.retry_after_s)
+
+        deadline_at = (time.monotonic() + payload.deadline_ms / 1000.0
+                       if payload.deadline_ms else None)
+        cls: Classification = classify(payload.prompt)
+        self._classified[cls.reason] = self._classified.get(cls.reason, 0) + 1
+
+        if not cls.batchable:
+            # legacy path: full orchestration (fan-out, media sync, …),
+            # now carrying the request's admission metadata into the queue
+            result = await self.orchestrator.orchestrate(
+                payload.prompt,
+                client_id=payload.client_id,
+                enabled_ids=payload.enabled_worker_ids,
+                delegate_master=payload.delegate_master,
+                load_balance=payload.load_balance,
+                trace_id=payload.trace_id,
+                queue_meta={"tenant": payload.tenant,
+                            "priority": payload.priority,
+                            "deadline_at": deadline_at},
+            )
+            return FrontDoorResult(
+                outcome=decision.outcome, prompt_id=result.prompt_id,
+                node_errors=result.node_errors,
+                worker_count=result.worker_count,
+                trace_id=result.trace_id, reason=cls.reason)
+
+        # batchable: validate NOW (the legacy path rejects invalid prompts
+        # synchronously; coalescing must not turn that into a deferred
+        # history-only error), then coalesce
+        from ...graph.executor import strip_meta, validate_prompt
+
+        prompt = strip_meta(payload.prompt)
+        errors = validate_prompt(prompt)
+        if errors:
+            return FrontDoorResult(outcome=decision.outcome,
+                                   node_errors=[e.as_dict() for e in errors],
+                                   reason=cls.reason)
+        from ...utils.logging import new_trace_id
+
+        trace_id = payload.trace_id or new_trace_id()
+        member = PromptJob(
+            prompt_id=f"p_{int(time.time()*1000)}_{secrets.token_hex(3)}",
+            prompt=prompt, client_id=payload.client_id,
+            trace_id=trace_id,
+            tenant=payload.tenant, priority=payload.priority,
+            deadline_at=deadline_at,
+        )
+        self.batcher.submit(cls.group_key, member,
+                            sampler_node_id=cls.sampler_node_id)
+        if telemetry.enabled():
+            _tm.FD_QUEUE_DEPTH.labels(
+                stage="coalescing", priority=payload.priority).set(
+                    self.batcher.pending_by_priority().get(
+                        payload.priority, 0))
+        return FrontDoorResult(outcome=decision.outcome,
+                               prompt_id=member.prompt_id,
+                               trace_id=trace_id,
+                               batched=True, reason=cls.reason)
+
+    # --- plumbing -----------------------------------------------------------
+
+    def _enqueue_group(self, members: list, sampler_node_ids: dict) -> None:
+        self.queue.enqueue_batch(members, sampler_node_ids)
+        if telemetry.enabled():
+            for prio, n in self.batcher.pending_by_priority().items():
+                _tm.FD_QUEUE_DEPTH.labels(stage="coalescing",
+                                          priority=prio).set(n)
+
+    def stats(self) -> dict:
+        """The ``GET /distributed/frontdoor`` payload (dashboard row +
+        operator probe)."""
+        return {
+            "enabled": True,
+            "depth": self.depth(),
+            "queue_remaining": self.queue.queue_remaining,
+            "coalescing": self.batcher.pending_count,
+            "pending_by_priority": self.batcher.pending_by_priority(),
+            "groups": self.batcher.group_summary(),
+            "admission": self.admission.summary(),
+            "classified": dict(self._classified),
+            "window_ms": self.batcher.window_ms,
+            "max_batch": self.batcher.max_batch,
+        }
+
+
+def build_frontdoor(queue: PromptQueue, orchestrator,
+                    config_loader=None) -> Optional[FrontDoor]:
+    """Controller hook: the front door, or None under CDT_FRONTDOOR=0."""
+    if not frontdoor_enabled():
+        log("front door disabled (CDT_FRONTDOOR=0) — legacy queue path")
+        return None
+    return FrontDoor(queue, orchestrator, config_loader)

@@ -1,0 +1,186 @@
+"""Admission control: who gets in, who waits, who is told to come back.
+
+Three gates, applied in order, all deterministic (testable with a fake
+clock):
+
+1. **Per-tenant token bucket** — a tenant sustaining more than
+   ``CDT_FD_TENANT_RATE`` req/s (burst ``CDT_FD_TENANT_BURST``) is shed
+   with ``Retry-After`` sized to the bucket's refill, regardless of how
+   idle the fleet is. This is the fairness floor: one hot tenant cannot
+   monopolize the coalescing windows or starve the queue.
+2. **Priority-aware depth shedding** — the controller depth signal
+   (queued + executing + coalescing; the quantity
+   ``cdt_prompt_queue_depth`` exports, extended by the front-door
+   window) is compared against ``CDT_FD_SHED_DEPTH``. The lowest
+   priority class sheds at half the threshold, so background load
+   drains out of an overloaded fleet first.
+3. **Breaker-scaled capacity** — when the circuit-breaker registry
+   reports a degraded fleet (workers open/half-open), the shed
+   threshold scales down by the healthy fraction: a half-dead fleet
+   sheds at half depth instead of queueing work it will time out on
+   (docs/resilience.md).
+
+Outcomes map onto ``cdt_admission_total{outcome=admitted|queued|shed}``:
+``queued`` is an *accepted* request past the soft high-watermark
+(``CDT_FD_SOFT_DEPTH``) — the client proceeds, but the response says the
+fleet is busy.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+import time
+from collections import OrderedDict
+from typing import Callable, Optional
+
+from ... import telemetry
+from ...telemetry import metrics as _tm
+from ...utils import constants
+
+
+@dataclasses.dataclass(frozen=True)
+class Decision:
+    outcome: str                 # admitted | queued | shed
+    reason: str = ""             # ok | busy | overload | tenant_rate
+    retry_after_s: float = 0.0
+    depth: int = 0
+
+
+class TokenBucket:
+    """Classic token bucket, clock-injected for determinism."""
+
+    def __init__(self, rate: float, burst: float,
+                 clock: Callable[[], float] = time.monotonic):
+        self.rate = max(rate, 1e-9)
+        self.burst = burst
+        self._level = burst
+        self._clock = clock
+        self._last = clock()
+
+    def _refill(self) -> None:
+        now = self._clock()
+        self._level = min(self.burst,
+                          self._level + (now - self._last) * self.rate)
+        self._last = now
+
+    def take(self) -> bool:
+        self._refill()
+        if self._level >= 1.0:
+            self._level -= 1.0
+            return True
+        return False
+
+    def seconds_until_token(self) -> float:
+        self._refill()
+        if self._level >= 1.0:
+            return 0.0
+        return (1.0 - self._level) / self.rate
+
+
+def breaker_healthy_fraction() -> float:
+    """Closed breakers / tracked breakers (half-open counts half); 1.0
+    when nothing is tracked (single-host or fresh boot)."""
+    from ..resilience import BREAKERS
+
+    states = BREAKERS.states()
+    if not states:
+        return 1.0
+    score = {"closed": 1.0, "half_open": 0.5, "open": 0.0}
+    return sum(score.get(s, 0.0) for s in states.values()) / len(states)
+
+
+class AdmissionController:
+    def __init__(
+        self,
+        depth_provider: Callable[[], int],
+        *,
+        soft_depth: Optional[int] = None,
+        shed_depth: Optional[int] = None,
+        tenant_rate: Optional[float] = None,
+        tenant_burst: Optional[float] = None,
+        healthy_fraction: Callable[[], float] = breaker_healthy_fraction,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.depth_provider = depth_provider
+        self.soft_depth = (constants.FD_SOFT_DEPTH if soft_depth is None
+                           else soft_depth)
+        self.shed_depth = (constants.FD_SHED_DEPTH if shed_depth is None
+                           else shed_depth)
+        self.tenant_rate = (constants.FD_TENANT_RATE if tenant_rate is None
+                            else tenant_rate)
+        self.tenant_burst = (constants.FD_TENANT_BURST if tenant_burst is None
+                             else tenant_burst)
+        self.healthy_fraction = healthy_fraction
+        self._clock = clock
+        self._buckets: "OrderedDict[str, TokenBucket]" = OrderedDict()
+        self._counts: dict[str, int] = {}
+
+    # --- tenant buckets -----------------------------------------------------
+
+    def _bucket(self, tenant: str) -> TokenBucket:
+        b = self._buckets.get(tenant)
+        if b is None:
+            if len(self._buckets) >= constants.FD_MAX_TENANTS:
+                # LRU eviction: an evicted-then-returning tenant simply
+                # gets a fresh (full) bucket — bounded memory beats
+                # perfect rate memory for the long tail
+                self._buckets.popitem(last=False)
+            b = TokenBucket(self.tenant_rate, self.tenant_burst,
+                            clock=self._clock)
+            self._buckets[tenant] = b
+        else:
+            self._buckets.move_to_end(tenant)
+        return b
+
+    # --- the decision -------------------------------------------------------
+
+    def shed_threshold(self, priority: str) -> int:
+        """Effective shed depth for one priority class right now:
+        breaker-degraded fleets scale it down (never below a quarter —
+        a fully-open registry still serves the master's own capacity),
+        and the lowest class sheds at half."""
+        frac = max(0.25, self.healthy_fraction())
+        threshold = max(1, int(self.shed_depth * frac))
+        if priority == constants.PRIORITY_CLASSES[-1]:
+            threshold = max(1, threshold // 2)
+        return threshold
+
+    def admit(self, tenant: str, priority: str) -> Decision:
+        depth = int(self.depth_provider())
+        threshold = self.shed_threshold(priority)
+
+        # depth shed BEFORE the token bucket: an overload shed must not
+        # burn the tenant's rate budget — a client that obeys Retry-After
+        # would otherwise drain its bucket on rejected requests and keep
+        # shedding (with the wrong reason) after the overload clears
+        if depth >= threshold:
+            ratio = depth / max(1, threshold)
+            retry = min(30.0, math.ceil(constants.FD_RETRY_AFTER_S * ratio))
+            decision = Decision("shed", "overload", retry_after_s=retry,
+                                depth=depth)
+        elif not self._bucket(tenant).take():
+            wait = self._bucket(tenant).seconds_until_token()
+            decision = Decision("shed", "tenant_rate",
+                                retry_after_s=max(1.0, math.ceil(wait)),
+                                depth=depth)
+        elif depth >= min(self.soft_depth, threshold):
+            decision = Decision("queued", "busy", depth=depth)
+        else:
+            decision = Decision("admitted", "ok", depth=depth)
+
+        self._counts[decision.outcome] = \
+            self._counts.get(decision.outcome, 0) + 1
+        if telemetry.enabled():
+            _tm.ADMISSION_TOTAL.labels(outcome=decision.outcome,
+                                       priority=priority).inc()
+        return decision
+
+    def summary(self) -> dict:
+        return {
+            "outcomes": dict(self._counts),
+            "tenants_tracked": len(self._buckets),
+            "soft_depth": self.soft_depth,
+            "shed_depth": self.shed_depth,
+            "healthy_fraction": round(self.healthy_fraction(), 3),
+        }

@@ -1,0 +1,197 @@
+"""Per-program-key coalescing: hold batchable requests briefly, flush
+same-shape groups as one batch job.
+
+The window trades a bounded latency cost (``CDT_FD_WINDOW_MS``, default
+25 ms — noise against a multi-second diffusion program) for batch
+occupancy. Flushing is *continuous-batching* shaped: groups only drain
+while the prompt queue has capacity (``CDT_FD_INFLIGHT`` batch slots),
+so under load a waiting group keeps absorbing same-shape arrivals up to
+``CDT_FD_MAX_BATCH`` instead of fragmenting into singleton jobs — the
+queue-depth signal *is* the batching signal. A safety valve
+(``CDT_FD_MAX_WAIT_MS``) force-flushes any group whose oldest member has
+waited too long, so a wedged queue degrades to bounded latency, never to
+an unbounded hold.
+
+Flush order is strict priority (``constants.PRIORITY_CLASSES`` rank of
+the group's most urgent member), then group age — interactive traffic
+boards first, background batch rides the remaining slots.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import os
+import time
+from typing import Callable, Optional
+
+from ...utils import constants
+from ...utils.logging import debug_log
+from .classifier import GroupKey
+
+
+def _max_wait_ms() -> float:
+    env = os.environ.get("CDT_FD_MAX_WAIT_MS", "")
+    if env:
+        return float(env)
+    return constants.FD_WINDOW_MS * 20.0
+
+
+@dataclasses.dataclass
+class _Group:
+    key: GroupKey
+    members: list = dataclasses.field(default_factory=list)
+    sampler_node_ids: dict = dataclasses.field(default_factory=dict)
+    opened_at: float = 0.0
+
+    def priority_rank(self) -> int:
+        ranks = [
+            constants.PRIORITY_CLASSES.index(m.priority)
+            if m.priority in constants.PRIORITY_CLASSES
+            else len(constants.PRIORITY_CLASSES)
+            for m in self.members
+        ]
+        return min(ranks) if ranks else len(constants.PRIORITY_CLASSES)
+
+
+class CoalescingBatcher:
+    """Holds admitted batchable members per :class:`GroupKey` and flushes
+    ready groups through ``enqueue`` (one call per microbatch)."""
+
+    def __init__(
+        self,
+        enqueue: Callable[[list, dict], None],
+        *,
+        window_ms: Optional[float] = None,
+        max_batch: Optional[int] = None,
+        capacity: Optional[Callable[[], bool]] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.enqueue = enqueue
+        self.window_ms = (constants.FD_WINDOW_MS if window_ms is None
+                          else window_ms)
+        self.max_batch = max(1, constants.FD_MAX_BATCH if max_batch is None
+                             else max_batch)
+        self.capacity = capacity or (lambda: True)
+        self._clock = clock
+        self._groups: dict[GroupKey, _Group] = {}
+        self._wake = asyncio.Event()
+        self.flushed_groups = 0
+        self.flushed_members = 0
+
+    # --- producer side ------------------------------------------------------
+
+    def submit(self, key: GroupKey, member, sampler_node_id: str) -> None:
+        group = self._groups.get(key)
+        if group is None:
+            group = self._groups[key] = _Group(key=key,
+                                               opened_at=self._clock())
+        group.members.append(member)
+        group.sampler_node_ids[member.prompt_id] = sampler_node_id
+        self.wake()
+
+    def wake(self) -> None:
+        self._wake.set()
+
+    # --- introspection ------------------------------------------------------
+
+    @property
+    def pending_count(self) -> int:
+        return sum(len(g.members) for g in self._groups.values())
+
+    def pending_by_priority(self) -> dict[str, int]:
+        out = {p: 0 for p in constants.PRIORITY_CLASSES}
+        for g in self._groups.values():
+            for m in g.members:
+                out[m.priority] = out.get(m.priority, 0) + 1
+        return out
+
+    def group_summary(self) -> list[dict]:
+        now = self._clock()
+        return [{"key": g.key.label(), "size": len(g.members),
+                 "age_ms": round((now - g.opened_at) * 1000.0, 1)}
+                for g in sorted(self._groups.values(),
+                                key=lambda g: g.opened_at)]
+
+    # --- scheduler ----------------------------------------------------------
+
+    def _ready(self, group: _Group, now: float) -> bool:
+        return (len(group.members) >= self.max_batch
+                or (now - group.opened_at) * 1000.0 >= self.window_ms)
+
+    def _overdue(self, group: _Group, now: float) -> bool:
+        return (now - group.opened_at) * 1000.0 >= _max_wait_ms()
+
+    def _next_deadline(self) -> Optional[float]:
+        """The next moment flush_ready could change its answer on a
+        TIMER: a pending group's window expiry, or a capacity-blocked
+        ready group's overdue valve. Already-ready groups waiting only
+        on capacity have no earlier timer — their wake signal is the
+        job-done callback — so using their (expired) window here would
+        spin the loop at the 1 ms clamp for the whole duration of the
+        running program."""
+        if not self._groups:
+            return None
+        now = self._clock()
+        deadlines = []
+        for g in self._groups.values():
+            if self._ready(g, now):
+                deadlines.append(g.opened_at + _max_wait_ms() / 1000.0)
+            else:
+                deadlines.append(g.opened_at + self.window_ms / 1000.0)
+        return min(deadlines)
+
+    def flush_ready(self) -> int:
+        """Flush every ready group the queue has capacity for (overdue
+        groups flush regardless — each is checked, so a blocked
+        high-priority group can't starve an overdue lower one). Returns
+        members flushed. Called from the scheduler loop and directly by
+        tests."""
+        flushed = 0
+        while True:
+            now = self._clock()
+            ready = [g for g in self._groups.values() if self._ready(g, now)]
+            if not ready:
+                return flushed
+            ready.sort(key=lambda g: (g.priority_rank(), g.opened_at))
+            if self.capacity():
+                group = ready[0]
+            else:
+                overdue = [g for g in ready if self._overdue(g, now)]
+                if not overdue:
+                    return flushed
+                group = overdue[0]
+            take = group.members[:self.max_batch]
+            rest = group.members[self.max_batch:]
+            ids = {m.prompt_id: group.sampler_node_ids[m.prompt_id]
+                   for m in take}
+            if rest:
+                group.members = rest
+                group.sampler_node_ids = {
+                    m.prompt_id: group.sampler_node_ids[m.prompt_id]
+                    for m in rest}
+                # leftovers missed this bus but keep their seniority:
+                # the window they already served counts
+                group.opened_at = min(m.enqueued_at for m in rest)
+            else:
+                del self._groups[group.key]
+            debug_log(f"front door: flushing {len(take)} member(s) "
+                      f"for {group.key.label()}")
+            self.enqueue(take, ids)
+            self.flushed_groups += 1
+            self.flushed_members += len(take)
+            flushed += len(take)
+
+    async def run(self) -> None:
+        """The coalescing loop: sleep until the earliest window expires or
+        someone wakes us (new member, job completed), then flush."""
+        while True:
+            deadline = self._next_deadline()
+            timeout = (None if deadline is None
+                       else max(0.001, deadline - self._clock()))
+            try:
+                await asyncio.wait_for(self._wake.wait(), timeout)
+            except asyncio.TimeoutError:
+                pass
+            self._wake.clear()
+            self.flush_ready()

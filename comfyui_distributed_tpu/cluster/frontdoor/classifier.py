@@ -1,0 +1,167 @@
+"""Request classification: which queue requests can ride one microbatch.
+
+Two requests may share a compiled program — and therefore a microbatch —
+exactly when they resolve to the same :class:`GroupKey`: same model,
+geometry, step count, guidance, sampler family, and per-device batch.
+The classifier derives that key *statically* from the prompt graph (the
+same literal-derivation discipline as ``cluster/shape_catalog``), and is
+deliberately conservative: anything it cannot prove batchable passes
+through to the legacy orchestration path untouched. A wrong "not
+batchable" costs a solo execution; a wrong "batchable" could corrupt a
+user's image — so the allowlist below names every node class whose
+semantics are known to be safe alongside cross-request batching.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from ...diffusion.pipeline import DETERMINISTIC_SAMPLERS
+from ..shape_catalog import ProgramKey
+
+# The one sampler node the microbatch executor knows how to stack.
+BATCHABLE_SAMPLER = "TPUTxt2Img"
+
+# Node classes that may appear ANYWHERE in a batchable prompt. Everything
+# else — other samplers (their programs differ), tile/video machinery,
+# collector fan-out (needs the job-store lifecycle), LoRA/ControlNet
+# (mutate the model/conditioning in ways the group key cannot see) —
+# routes to the legacy path.
+BATCHABLE_NODE_ALLOWLIST = frozenset({
+    BATCHABLE_SAMPLER,
+    "CheckpointLoader",
+    "CLIPTextEncode",
+    "DistributedSeed",
+    "DistributedValue",
+    "EmptyLatentImage",
+    "ImageScale",
+    "ImageScaleBy",
+    "ImageFromBatch",
+    "SaveImage",
+    "PreviewImage",
+    "PrimitiveInt",
+    "PrimitiveFloat",
+    "PrimitiveString",
+})
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class GroupKey:
+    """Identity of the compiled program a request needs — requests with
+    equal keys coalesce into one microbatch. Mirrors
+    ``shape_catalog.ProgramKey`` plus the sampler knobs that change the
+    traced program (cfg toggles the CFG branch; sampler/scheduler change
+    the step body/ladder)."""
+
+    model: str
+    height: int
+    width: int
+    steps: int
+    cfg: float
+    sampler: str
+    scheduler: str
+    batch_per_device: int = 1
+
+    def program_key(self) -> ProgramKey:
+        """The shape-catalog identity this group lands on (warmup/telemetry
+        join on it)."""
+        return ProgramKey(pipeline="txt2img", model=self.model,
+                          height=self.height, width=self.width,
+                          steps=self.steps, batch=self.batch_per_device)
+
+    def label(self) -> str:
+        """Low-cardinality telemetry/debug label."""
+        return (f"{self.model}/{self.height}x{self.width}"
+                f"/s{self.steps}/{self.sampler}")
+
+
+@dataclasses.dataclass(frozen=True)
+class Classification:
+    batchable: bool
+    reason: str
+    group_key: Optional[GroupKey] = None
+    sampler_node_id: Optional[str] = None
+
+
+def _literal_num(v):
+    if isinstance(v, bool):
+        return None
+    if isinstance(v, (int, float)):
+        return v
+    return None
+
+
+def _not(reason: str) -> Classification:
+    return Classification(batchable=False, reason=reason)
+
+
+def classify(prompt: dict) -> Classification:
+    """Statically classify one prompt. Never raises on malformed input —
+    malformed prompts are "not batchable" and fail loudly downstream on
+    the legacy path's validation."""
+    if not isinstance(prompt, dict) or not prompt:
+        return _not("empty")
+    nodes = {k: v for k, v in prompt.items()
+             if isinstance(v, dict) and v.get("class_type")}
+    if len(nodes) != len(prompt):
+        return _not("malformed_nodes")
+
+    samplers = [nid for nid, n in nodes.items()
+                if n["class_type"] == BATCHABLE_SAMPLER]
+    if not samplers:
+        return _not("no_batchable_sampler")
+    if len(samplers) > 1:
+        return _not("multiple_samplers")
+    outside = sorted({n["class_type"] for n in nodes.values()
+                      if n["class_type"] not in BATCHABLE_NODE_ALLOWLIST})
+    if outside:
+        return _not(f"node_outside_allowlist:{outside[0]}")
+
+    nid = samplers[0]
+    inputs = nodes[nid].get("inputs", {})
+    height = _literal_num(inputs.get("height"))
+    width = _literal_num(inputs.get("width"))
+    steps = _literal_num(inputs.get("steps"))
+    cfg = _literal_num(inputs.get("cfg"))
+    if None in (height, width, steps, cfg):
+        return _not("dynamic_geometry")
+
+    sampler = inputs.get("sampler_name", "euler")
+    scheduler = inputs.get("scheduler", "karras")
+    if not isinstance(sampler, str) or not isinstance(scheduler, str):
+        return _not("dynamic_sampler")
+    if sampler not in DETERMINISTIC_SAMPLERS:
+        # stochastic step noise is shaped by the whole batch — a
+        # microbatched run could not reproduce the solo trajectories
+        return _not(f"stochastic_sampler:{sampler}")
+    bpd = inputs.get("batch_per_device", 1)
+    bpd = _literal_num(bpd)
+    if bpd is None or int(bpd) != bpd:
+        return _not("dynamic_batch")
+
+    model = _resolve_checkpoint(inputs.get("model"), nodes)
+    if model is None:
+        return _not("unresolvable_model")
+
+    key = GroupKey(model=model, height=int(height), width=int(width),
+                   steps=int(steps), cfg=float(cfg), sampler=sampler,
+                   scheduler=scheduler, batch_per_device=int(bpd))
+    return Classification(batchable=True, reason="batchable",
+                          group_key=key, sampler_node_id=nid)
+
+
+def _resolve_checkpoint(link, nodes: dict) -> Optional[str]:
+    """``model`` must link (one hop, the shipped-workflow idiom the shape
+    catalog also assumes) to a ``CheckpointLoader`` with a literal
+    ``ckpt_name`` — model identity must be knowable without executing
+    anything."""
+    if not (isinstance(link, (list, tuple)) and len(link) == 2):
+        return None
+    src = nodes.get(str(link[0]))
+    if src is None or src.get("class_type") != "CheckpointLoader":
+        return None
+    if link[1] != 0:
+        return None
+    name = src.get("inputs", {}).get("ckpt_name")
+    return name if isinstance(name, str) and name else None

@@ -1,0 +1,282 @@
+"""Group execution: N coalesced prompts, ONE sampler program.
+
+A flushed group executes in the prompt queue's graph-exec thread as a
+single unit:
+
+1. **Prefix** — each member's graph runs normally up to (excluding) its
+   sampler node: checkpoint load, text encode, seed derivation. Members
+   share the model registry, so the checkpoint builds once.
+2. **Stack** — each member's sampler inputs are resolved exactly as the
+   executor would (``graph.executor.node_kwargs``) and sub-grouped by
+   execution signature (pipeline identity, spec, conditioning shapes) —
+   the classifier's static key is re-checked against *runtime* facts, so
+   a tokenizer emitting a different context length degrades that member
+   to solo instead of corrupting the stack.
+3. **One program** — each sub-group of ≥2 runs
+   ``pipeline.generate_microbatch`` (bit-identical demux; see
+   ``diffusion/pipeline.py``); singletons run the sampler node's own
+   ``execute`` — the *pass-through path*, byte-for-byte the solo code.
+4. **Suffix** — each member's remaining nodes run with the demuxed
+   images injected as the sampler's output.
+
+Error isolation: a member failing in prefix/suffix fails alone; a failed
+*batched program* falls every member of that sub-group back to a full
+solo execution — an admitted job is never lost to batching.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Optional
+
+from ... import telemetry
+from ...graph.executor import GraphExecutor, node_kwargs, topo_order
+from ...telemetry import metrics as _tm
+from ...utils.logging import debug_log, log
+
+
+def downstream_nodes(prompt: dict, root: str) -> set:
+    """Transitive consumers of ``root``'s outputs (not including it)."""
+    consumers: dict[str, set] = {}
+    for nid, node in prompt.items():
+        for v in node.get("inputs", {}).values():
+            if isinstance(v, (list, tuple)) and len(v) == 2:
+                consumers.setdefault(str(v[0]), set()).add(nid)
+    out: set = set()
+    frontier = [root]
+    while frontier:
+        for nxt in consumers.get(frontier.pop(), ()):
+            if nxt not in out:
+                out.add(nxt)
+                frontier.append(nxt)
+    return out
+
+
+class _Prepared:
+    """One member after prefix execution, ready to stack."""
+
+    def __init__(self, member, sampler_id: str, executor: GraphExecutor,
+                 cache: dict, order: list, kwargs: dict):
+        self.member = member
+        self.sampler_id = sampler_id
+        self.executor = executor
+        self.cache = cache
+        self.order = order
+        self.kwargs = kwargs
+        self.spec = None
+        self.seed = None
+        self.context = None
+        self.uncond = None
+        self.y = None
+        self.uy = None
+        self.pipeline = None
+        self.model = None
+        self.mesh = None
+        self.stackable = False
+        self.why_solo = ""
+
+    def signature(self) -> tuple:
+        return (id(self.pipeline), self.spec,
+                tuple(self.context.shape), tuple(self.uncond.shape),
+                None if self.y is None else tuple(self.y.shape))
+
+
+def _prepare(member, sampler_id: str, base_context: dict) -> _Prepared:
+    """Run one member's prefix and resolve its sampler-call inputs."""
+    from ...diffusion.pipeline import GenerationSpec
+    from ...graph import nodes_builtin as nb
+
+    prompt = member.prompt
+    context = dict(base_context)
+    context["prompt_id"] = member.prompt_id
+    executor = GraphExecutor(context)
+    order = topo_order(prompt)
+    down = downstream_nodes(prompt, sampler_id)
+    prefix = [n for n in order if n != sampler_id and n not in down]
+    cache: dict[str, tuple] = {}
+    executor.execute_nodes(prompt, prefix, cache)
+
+    kwargs = node_kwargs(prompt, sampler_id, cache, context)
+    prep = _Prepared(member, sampler_id, executor, cache, order, kwargs)
+
+    model = kwargs["model"]
+    prep.model = model
+    positive, negative = kwargs["positive"], kwargs["negative"]
+    prep.spec = GenerationSpec(
+        height=int(kwargs["height"]), width=int(kwargs["width"]),
+        steps=int(kwargs["steps"]),
+        sampler=kwargs.get("sampler_name", "euler"),
+        scheduler=kwargs.get("scheduler", "karras"),
+        guidance_scale=float(kwargs["cfg"]),
+        per_device_batch=int(kwargs.get("batch_per_device", 1)),
+    )
+    prep.seed = int(kwargs["seed"])
+    prep.pipeline = model.pipeline
+    prep.mesh = context.get("mesh")
+    if isinstance(positive, dict) and positive.get("control"):
+        # classifier can't see control riding the conditioning dict
+        prep.why_solo = "control_conditioning"
+        return prep
+    adm = model.pipeline.unet.config.adm_in_channels
+    prep.context = positive["context"]
+    prep.uncond = negative["context"]
+    prep.y = nb._adm_from_cond(positive, adm) if adm else None
+    prep.uy = nb._adm_from_cond(negative, adm) if adm else None
+    if prep.mesh is None:
+        prep.why_solo = "no_mesh"
+        return prep
+    if not hasattr(prep.pipeline, "generate_microbatch"):
+        prep.why_solo = "pipeline_unsupported"
+        return prep
+    prep.stackable = True
+    return prep
+
+
+def _finish(prep: _Prepared, images) -> dict:
+    """Inject the sampler output, run the suffix, return the full cache."""
+    prep.cache[prep.sampler_id] = (images,)
+    suffix = [n for n in prep.order if n not in prep.cache]
+    prep.executor.execute_nodes(prep.member.prompt, suffix, prep.cache)
+    return prep.cache
+
+
+def _solo(prep: _Prepared) -> Any:
+    """Pass-through: the sampler node's OWN execute (identical to a solo
+    queue job — same compiled program, same progress streaming)."""
+    from ...graph.node import get_node
+
+    cls = get_node(prep.member.prompt[prep.sampler_id]["class_type"])
+    return cls().execute(**prep.kwargs)[0]
+
+
+def execute_group(members: list, sampler_node_ids: dict,
+                  base_context: dict) -> dict:
+    """Execute one flushed group. Returns ``{prompt_id: entry}`` where
+    each entry mirrors a PromptQueue history record
+    (``status``/``outputs``/``error`` + ``batch_size``). On interrupt
+    the PARTIAL results are returned — members that already finished
+    keep their success entries; the runtime marks the missing ones
+    interrupted (solo jobs that finish before an interrupt keep their
+    history too; batch members must not be worse off)."""
+    results: dict[str, dict] = {}
+    try:
+        _execute_group_inner(members, sampler_node_ids, base_context,
+                             results)
+    except InterruptedError:
+        pass
+    return results
+
+
+def _execute_group_inner(members: list, sampler_node_ids: dict,
+                         base_context: dict, results: dict) -> None:
+    t0 = time.monotonic()
+    prepared: list[_Prepared] = []
+
+    for m in members:
+        try:
+            prepared.append(_prepare(m, sampler_node_ids[m.prompt_id],
+                                     base_context))
+        except InterruptedError:
+            raise
+        except Exception as e:  # noqa: BLE001 — member isolation barrier
+            results[m.prompt_id] = {"status": "error", "error": str(e)}
+            log(f"front door: prefix failed for {m.prompt_id}: {e}")
+
+    # sub-group by runtime signature; order within a sub-group is
+    # submission order (members arrive FIFO from the batcher)
+    groups: dict[tuple, list[_Prepared]] = {}
+    singles: list[_Prepared] = []
+    for p in prepared:
+        if p.stackable:
+            groups.setdefault(p.signature(), []).append(p)
+        else:
+            singles.append(p)
+
+    def run_solo(p: _Prepared, batch_size: int = 1) -> None:
+        try:
+            images = _solo(p)
+            cache = _finish(p, images)
+            results[p.member.prompt_id] = {
+                "status": "success", "outputs": cache,
+                "batch_size": batch_size}
+        except InterruptedError:
+            raise
+        except Exception as e:  # noqa: BLE001 — member isolation barrier
+            results[p.member.prompt_id] = {"status": "error",
+                                           "error": str(e)}
+            log(f"front door: solo member {p.member.prompt_id} "
+                f"failed: {e}")
+
+    for p in singles:
+        if telemetry.enabled():
+            _tm.BATCH_SIZE.observe(1)
+        run_solo(p)
+
+    for sig, grp in groups.items():
+        if len(grp) == 1:
+            if telemetry.enabled():
+                _tm.BATCH_SIZE.observe(1)
+            run_solo(grp[0])
+            continue
+        lead = grp[0]
+        try:
+            # same residency discipline as the solo node path: with an
+            # HBM budget set, a concurrent acquire must not evict this
+            # bundle mid-program (cluster/residency.pinned_bundle)
+            from ..residency import pinned_bundle
+
+            with pinned_bundle(lead.model):
+                outs = lead.pipeline.generate_microbatch(
+                    lead.mesh, lead.spec,
+                    seeds=[p.seed for p in grp],
+                    contexts=[p.context for p in grp],
+                    uncond_contexts=[p.uncond for p in grp],
+                    ys=[p.y for p in grp], uys=[p.uy for p in grp],
+                )
+            if telemetry.enabled():
+                _tm.BATCH_SIZE.observe(len(grp))
+        except InterruptedError:
+            raise
+        except Exception as e:  # noqa: BLE001 — fall back, never lose jobs
+            log(f"front door: microbatch of {len(grp)} failed ({e}); "
+                f"falling back to solo execution")
+            if telemetry.enabled():
+                _tm.BATCH_FALLBACKS.inc()
+            for p in grp:
+                if telemetry.enabled():
+                    _tm.BATCH_SIZE.observe(1)
+                run_solo(p)
+            continue
+        _observe_group_shape(lead, len(grp))
+        for p, images in zip(grp, outs):
+            try:
+                cache = _finish(p, images)
+                results[p.member.prompt_id] = {
+                    "status": "success", "outputs": cache,
+                    "batch_size": len(grp)}
+            except InterruptedError:
+                raise
+            except Exception as e:  # noqa: BLE001 — member isolation
+                results[p.member.prompt_id] = {"status": "error",
+                                               "error": str(e)}
+                log(f"front door: suffix failed for "
+                    f"{p.member.prompt_id}: {e}")
+
+    debug_log(f"front door: group of {len(members)} done in "
+              f"{time.monotonic() - t0:.2f}s "
+              f"({len(groups)} stack(s), {len(singles)} solo)")
+
+
+def _observe_group_shape(lead: _Prepared, n: int) -> None:
+    """Feed the shape catalog like the solo node path does — a batched
+    program the fleet serves is a program the next restart should warm."""
+    from ..shape_catalog import observe
+
+    name = getattr(getattr(lead.kwargs.get("model"), "preset", None),
+                   "name", None)
+    if name:
+        try:
+            observe("txt2img", name, lead.spec.height, lead.spec.width,
+                    lead.spec.steps, batch=lead.spec.per_device_batch)
+        except Exception:  # noqa: BLE001 — observation must never sink a job
+            pass

@@ -93,6 +93,7 @@ class Orchestrator:
         delegate_master: Optional[bool] = None,
         load_balance: bool = False,
         trace_id: str | None = None,
+        queue_meta: Optional[dict] = None,
     ) -> OrchestrationResult:
         from ..graph.executor import strip_meta
         from ..telemetry import span as _tm_span
@@ -106,7 +107,7 @@ class Orchestrator:
         with _tm_span("orchestrate", trace_id=trace_id, job_id=trace_id):
             return await self._orchestrate_inner(
                 prompt, client_id, enabled_ids, delegate_master,
-                load_balance, trace_id)
+                load_balance, trace_id, queue_meta or {})
 
     async def _orchestrate_inner(
         self,
@@ -116,6 +117,7 @@ class Orchestrator:
         delegate_master: Optional[bool],
         load_balance: bool,
         trace_id: str,
+        queue_meta: dict,
     ) -> OrchestrationResult:
         config = self.load_config()
         all_hosts = self._normalized_hosts(config)
@@ -248,8 +250,11 @@ class Orchestrator:
                 enabled_worker_ids=(), delegate_only=False,
             )
 
+        # front-door metadata (tenant/priority/deadline) rides into the
+        # queue so non-batchable requests still get admission-class
+        # telemetry and deadline handling
         prompt_id, node_errors = self.queue.enqueue(
-            master_prompt, client_id, trace_id)
+            master_prompt, client_id, trace_id, **queue_meta)
         return OrchestrationResult(
             prompt_id=prompt_id,
             node_errors=node_errors,

@@ -6,6 +6,13 @@ the standalone equivalent: an asyncio consumer that validates prompts,
 executes them in a worker thread (JAX compute must not block the loop),
 and exposes ``queue_remaining`` for health probes — the field the
 reference's least-busy scheduler reads (``dispatch.py:225-268``).
+
+Two job shapes ride the same queue: classic solo prompts, and *batch
+jobs* from the serving front door (``cluster/frontdoor``) — N coalesced
+member prompts executed as one unit with a shared microbatched sampler
+program. Either way execution is serialized per controller (one mesh,
+one program at a time); batching raises the work per program, not the
+number of concurrent programs.
 """
 
 from __future__ import annotations
@@ -21,6 +28,7 @@ from typing import Any, Callable, Optional
 from .. import telemetry
 from ..graph.executor import GraphExecutor, strip_meta, validate_prompt
 from ..telemetry import metrics as _tm
+from ..utils import constants
 from ..utils.exceptions import ValidationError
 from ..utils.logging import log, trace_info
 
@@ -36,6 +44,19 @@ class PromptJob:
     parent_span_id: str | None = None
     enqueued_at: float = dataclasses.field(default_factory=time.monotonic)
     future: Optional[asyncio.Future] = None
+    # --- serving front door metadata (cluster/frontdoor) -------------------
+    tenant: str = constants.DEFAULT_TENANT
+    priority: str = constants.DEFAULT_PRIORITY
+    # monotonic deadline; a job still queued past it is recorded
+    # "expired" instead of executed (the client asked for freshness)
+    deadline_at: float | None = None
+    # batch jobs: the coalesced member jobs (each with its own prompt_id/
+    # deadline) and each member's sampler node id. ``prompt`` is unused.
+    group: "list[PromptJob] | None" = None
+    sampler_node_ids: dict | None = None
+
+    def expired(self, now: float) -> bool:
+        return self.deadline_at is not None and now >= self.deadline_at
 
 
 class PromptQueue:
@@ -55,6 +76,8 @@ class PromptQueue:
         self._executing: Optional[str] = None
         self._interrupt = threading.Event()
         self.history: dict[str, dict] = {}
+        self._job_done_callbacks: list[Callable[[], None]] = []
+        self._pending_by_priority: dict[str, int] = {}
 
     # --- lifecycle ---------------------------------------------------------
 
@@ -72,11 +95,21 @@ class PromptQueue:
             self._task = None
         self._pool.shutdown(wait=False, cancel_futures=True)
 
+    def add_job_done_callback(self, cb: Callable[[], None]) -> None:
+        """Called (on the event loop) after every job finishes — the
+        front door uses it to flush the next coalesced group the moment
+        a queue slot frees."""
+        if cb not in self._job_done_callbacks:
+            self._job_done_callbacks.append(cb)
+
     # --- producer ----------------------------------------------------------
 
     def enqueue(self, prompt: dict, client_id: str = "",
                 trace_id: str | None = None,
-                parent_span_id: str | None = None) -> tuple[str, list]:
+                parent_span_id: str | None = None,
+                tenant: str = constants.DEFAULT_TENANT,
+                priority: str = constants.DEFAULT_PRIORITY,
+                deadline_at: float | None = None) -> tuple[str, list]:
         """Validate + enqueue; returns (prompt_id, node_errors). Mirrors
         ``queue_prompt_payload``: validation errors reject the prompt
         before it reaches the queue (``utils/async_helpers.py:108-149``)."""
@@ -86,12 +119,48 @@ class PromptQueue:
             return "", [e.as_dict() for e in errors]
         prompt_id = f"p_{int(time.time()*1000)}_{secrets.token_hex(3)}"
         job = PromptJob(prompt_id, prompt, client_id, trace_id,
-                        parent_span_id=parent_span_id)
+                        parent_span_id=parent_span_id, tenant=tenant,
+                        priority=priority, deadline_at=deadline_at)
+        self._put(job)
+        return prompt_id, []
+
+    def enqueue_batch(self, members: "list[PromptJob]",
+                      sampler_node_ids: dict) -> list[str]:
+        """Enqueue one batch job carrying pre-validated member prompts
+        (the front door validates at submission). Returns member ids."""
+        if not members:
+            return []
+        job = PromptJob(
+            prompt_id=f"b_{int(time.time()*1000)}_{secrets.token_hex(3)}",
+            prompt={}, group=list(members),
+            sampler_node_ids=dict(sampler_node_ids),
+            trace_id=members[0].trace_id,
+            priority=min((m.priority for m in members),
+                         key=_priority_rank),
+        )
+        self._put(job)
+        return [m.prompt_id for m in members]
+
+    def _put(self, job: PromptJob) -> None:
         self._queue.put_nowait(job)
+        for prio, n in _job_members(job):
+            self._pending_by_priority[prio] = \
+                self._pending_by_priority.get(prio, 0) + n
         if telemetry.enabled():
             _tm.PROMPT_QUEUE_DEPTH.set(self.queue_remaining)
+            self._export_priority_depth()
         self.start()
-        return prompt_id, []
+
+    def _job_finished_accounting(self, job: PromptJob) -> None:
+        for prio, n in _job_members(job):
+            left = self._pending_by_priority.get(prio, 0) - n
+            self._pending_by_priority[prio] = max(0, left)
+        if telemetry.enabled():
+            self._export_priority_depth()
+
+    def _export_priority_depth(self) -> None:
+        for prio, n in self._pending_by_priority.items():
+            _tm.FD_QUEUE_DEPTH.labels(stage="queued", priority=prio).set(n)
 
     @property
     def queue_remaining(self) -> int:
@@ -100,16 +169,19 @@ class PromptQueue:
     def interrupt(self) -> int:
         """Drop pending prompts and flag the running one (checked between
         nodes — parity with the reference's interrupt fan-out,
-        ``web/workerUtils.js:73-95``). Returns number of dropped jobs."""
+        ``web/workerUtils.js:73-95``). Returns number of dropped jobs
+        (batch members count individually)."""
         dropped = 0
         while True:
             try:
                 job = self._queue.get_nowait()
             except asyncio.QueueEmpty:
                 break
-            self.history[job.prompt_id] = {"status": "interrupted",
-                                           "duration": 0.0}
-            dropped += 1
+            for member in (job.group or [job]):
+                self.history[member.prompt_id] = {"status": "interrupted",
+                                                  "duration": 0.0}
+                dropped += 1
+            self._job_finished_accounting(job)
         if self._executing:
             self._interrupt.set()
         return dropped
@@ -127,59 +199,166 @@ class PromptQueue:
             self._executing = job.prompt_id
             started = time.monotonic()
             self._interrupt.clear()
-            status = "error"
+            statuses: list[str] = []
             try:
-                context = dict(self._context_factory())
-                context["interrupt_event"] = self._interrupt
-                context["prompt_id"] = job.prompt_id
-                executor = GraphExecutor(context)
-                # the execution span adopts the orchestration trace id and
-                # parents onto the master's dispatch span (X-CDT-Trace) —
-                # this is the worker-side half of a stitched job trace
-                with telemetry.span("prompt.execute",
-                                    trace_id=job.trace_id,
-                                    parent_id=job.parent_span_id,
-                                    prompt_id=job.prompt_id):
-                    # run_in_executor does NOT propagate contextvars, so
-                    # spans opened during graph execution (pipeline_call
-                    # with its attn_kernels label, node-level spans)
-                    # would start orphan traces; copying the context in
-                    # parents them under this execution span
-                    ctx = contextvars.copy_context()
-                    outputs = await loop.run_in_executor(
-                        self._pool, ctx.run, executor.execute, job.prompt
-                    )
-                status = "success"
-                self.history[job.prompt_id] = {
-                    "status": "success",
-                    "duration": time.monotonic() - started,
-                    "outputs": {
-                        nid: out for nid, out in outputs.items()
-                        if _is_terminal(job.prompt, nid)
-                    },
-                }
-                trace_info(job.trace_id,
-                           f"prompt {job.prompt_id} done in "
-                           f"{self.history[job.prompt_id]['duration']:.2f}s")
-            except InterruptedError:
-                status = "interrupted"
-                self.history[job.prompt_id] = {
-                    "status": "interrupted",
-                    "duration": time.monotonic() - started,
-                }
-                log(f"prompt {job.prompt_id} interrupted")
-            except Exception as e:  # noqa: BLE001 — job isolation barrier
-                self.history[job.prompt_id] = {
-                    "status": "error", "error": str(e),
-                    "duration": time.monotonic() - started,
-                }
-                log(f"prompt {job.prompt_id} failed: {e}")
+                if telemetry.enabled():
+                    for m in (job.group or [job]):
+                        _tm.QUEUE_WAIT_SECONDS.labels(
+                            priority=m.priority).observe(
+                                started - m.enqueued_at)
+                if job.group is not None:
+                    statuses = await self._run_group(loop, job, started)
+                else:
+                    statuses = [await self._run_solo(loop, job, started)]
             finally:
                 self._executing = None
+                self._job_finished_accounting(job)
                 if telemetry.enabled():
-                    _tm.PROMPTS_TOTAL.labels(status=status).inc()
+                    for status in statuses:
+                        _tm.PROMPTS_TOTAL.labels(status=status).inc()
                     _tm.PROMPT_SECONDS.observe(time.monotonic() - started)
                     _tm.PROMPT_QUEUE_DEPTH.set(self.queue_remaining)
+                for cb in self._job_done_callbacks:
+                    try:
+                        cb()
+                    except Exception:  # noqa: BLE001 — observer isolation
+                        pass
+
+    async def _run_solo(self, loop, job: PromptJob, started: float) -> str:
+        if job.expired(started):
+            self.history[job.prompt_id] = {
+                "status": "expired", "duration": 0.0,
+                "error": "deadline_ms elapsed before execution",
+            }
+            log(f"prompt {job.prompt_id} expired in queue")
+            return "expired"
+        try:
+            context = dict(self._context_factory())
+            context["interrupt_event"] = self._interrupt
+            context["prompt_id"] = job.prompt_id
+            executor = GraphExecutor(context)
+            # the execution span adopts the orchestration trace id and
+            # parents onto the master's dispatch span (X-CDT-Trace) —
+            # this is the worker-side half of a stitched job trace
+            with telemetry.span("prompt.execute",
+                                trace_id=job.trace_id,
+                                parent_id=job.parent_span_id,
+                                prompt_id=job.prompt_id):
+                # run_in_executor does NOT propagate contextvars, so
+                # spans opened during graph execution (pipeline_call
+                # with its attn_kernels label, node-level spans)
+                # would start orphan traces; copying the context in
+                # parents them under this execution span
+                ctx = contextvars.copy_context()
+                outputs = await loop.run_in_executor(
+                    self._pool, ctx.run, executor.execute, job.prompt
+                )
+            self.history[job.prompt_id] = {
+                "status": "success",
+                "duration": time.monotonic() - started,
+                "outputs": {
+                    nid: out for nid, out in outputs.items()
+                    if _is_terminal(job.prompt, nid)
+                },
+            }
+            trace_info(job.trace_id,
+                       f"prompt {job.prompt_id} done in "
+                       f"{self.history[job.prompt_id]['duration']:.2f}s")
+            return "success"
+        except InterruptedError:
+            self.history[job.prompt_id] = {
+                "status": "interrupted",
+                "duration": time.monotonic() - started,
+            }
+            log(f"prompt {job.prompt_id} interrupted")
+            return "interrupted"
+        except Exception as e:  # noqa: BLE001 — job isolation barrier
+            self.history[job.prompt_id] = {
+                "status": "error", "error": str(e),
+                "duration": time.monotonic() - started,
+            }
+            log(f"prompt {job.prompt_id} failed: {e}")
+            return "error"
+
+    async def _run_group(self, loop, job: PromptJob,
+                         started: float) -> list[str]:
+        """Execute a front-door batch job: expire stale members, run the
+        rest through the microbatch group executor, record per-member
+        history. A group never loses a member silently — every member id
+        ends with a terminal history entry."""
+        from .frontdoor.microbatch import execute_group
+
+        live: list[PromptJob] = []
+        statuses: list[str] = []
+        for m in job.group:
+            if m.expired(started):
+                self.history[m.prompt_id] = {
+                    "status": "expired", "duration": 0.0,
+                    "error": "deadline_ms elapsed before execution",
+                }
+                statuses.append("expired")
+            else:
+                live.append(m)
+        if not live:
+            return statuses
+
+        try:
+            # context build INSIDE the barrier: a transient factory error
+            # (mesh/registry build) must error the members, not kill the
+            # consumer task and strand every future job (_run has no
+            # except of its own)
+            context = dict(self._context_factory())
+            context["interrupt_event"] = self._interrupt
+            with telemetry.span("prompt.execute_batch",
+                                trace_id=job.trace_id,
+                                prompt_id=job.prompt_id,
+                                batch=len(live)):
+                ctx = contextvars.copy_context()
+                results = await loop.run_in_executor(
+                    self._pool, ctx.run, execute_group,
+                    live, job.sampler_node_ids, context)
+        except Exception as e:  # noqa: BLE001 — group isolation barrier
+            # a failure this far out (not member-isolated by the group
+            # executor) marks every unfinished member errored — never lost
+            log(f"batch {job.prompt_id} failed: {e}")
+            results = {m.prompt_id: {"status": "error", "error": str(e)}
+                       for m in live}
+        duration = time.monotonic() - started
+        for m in live:
+            entry = results.get(m.prompt_id,
+                                {"status": "interrupted"})
+            status = entry.get("status", "error")
+            record = {"status": status,
+                      "duration": duration,
+                      "batch_size": entry.get("batch_size")}
+            if entry.get("error"):
+                record["error"] = entry["error"]
+            if status == "success":
+                record["outputs"] = {
+                    nid: out
+                    for nid, out in (entry.get("outputs") or {}).items()
+                    if _is_terminal(m.prompt, nid)
+                }
+            self.history[m.prompt_id] = record
+            statuses.append(status)
+        trace_info(job.trace_id,
+                   f"batch {job.prompt_id} ({len(live)} member(s)) done "
+                   f"in {duration:.2f}s")
+        return statuses
+
+
+def _priority_rank(priority: str) -> int:
+    try:
+        return constants.PRIORITY_CLASSES.index(priority)
+    except ValueError:
+        return len(constants.PRIORITY_CLASSES)
+
+
+def _job_members(job: PromptJob) -> "list[tuple[str, int]]":
+    counts: dict[str, int] = {}
+    for m in (job.group or [job]):
+        counts[m.priority] = counts.get(m.priority, 0) + 1
+    return list(counts.items())
 
 
 def _is_terminal(prompt: dict, nid: str) -> bool:

@@ -583,3 +583,145 @@ class Txt2ImgPipeline:
             self, key, lambda: self.generate_fn(mesh, spec,
                                                 progress=progress),
             self._CACHE_MAX)
+
+    # --- cross-request microbatching (cluster/frontdoor) -------------------
+
+    def microbatch_fn(self, mesh: Mesh, spec: GenerationSpec,
+                      n_requests: int, axis: str = constants.AXIS_DATA):
+        """Compile ONE SPMD program executing ``n_requests`` independent
+        generations (stacked seeds + per-request conditioning) in a single
+        dispatch — the front door's cross-user microbatch.
+
+        Bit-identity contract: each request's subgraph is the *solo*
+        program's math, unrolled — per-request ``fold_in`` of its own
+        seed, per-request noise draw with the solo tensor shapes, and a
+        trailing concat along the batch axis. Stacking requests *inside*
+        the matmul batch dimension instead (one ``[R·B, …]`` UNet call)
+        is NOT used: XLA's reduction strategy changes with the batch
+        extent, which breaks the bit-identical-to-solo guarantee the
+        demux relies on (measured on CPU: ~1e-2 drift after 3 steps).
+        The unrolled form keeps every per-request tensor shape equal to
+        the solo program's, so XLA computes identical values while still
+        amortizing dispatch, scheduling the independent subgraphs inside
+        one executable, and emitting one sharded output.
+
+        Output rows are shard-major then request-major then batch:
+        request ``r`` occupies rows ``[i·R·B + r·B, i·R·B + (r+1)·B)`` of
+        each shard block ``i`` (see :func:`demux_microbatch`).
+
+        Only deterministic samplers are microbatchable: stochastic
+        samplers draw step noise shaped by the whole batch from one key
+        (``samplers.py``), which cannot reproduce N solo runs.
+        """
+        if spec.sampler not in DETERMINISTIC_SAMPLERS:
+            raise ValueError(
+                f"sampler {spec.sampler!r} is stochastic — microbatching "
+                f"requires one of {sorted(DETERMINISTIC_SAMPLERS)}")
+        if getattr(self, "_control", None) is not None:
+            raise ValueError("microbatching does not support ControlNet "
+                             "pipelines (per-request hints are not stacked)")
+        has_y = self.unet.config.adm_in_channels > 0
+        sigmas = make_sigma_ladder(spec, self.schedule)
+        R, B = int(n_requests), spec.per_device_batch
+
+        def shard_body(weights, seeds, contexts, uncond_contexts, ys, uys):
+            outs = []
+            for r in range(R):
+                k = participant_key(jax.random.key(seeds[r]), axis)
+                outs.append(self._sample_and_decode(
+                    k, contexts[r:r + 1], uncond_contexts[r:r + 1],
+                    ys[r:r + 1] if has_y else None,
+                    uys[r:r + 1] if has_y else None,
+                    spec, B, sigmas, weights=weights))
+            return jnp.concatenate(outs, axis=0)
+
+        f = shard_map(
+            shard_body, mesh=mesh,
+            in_specs=(P(), P(), P(None, None, None), P(None, None, None),
+                      P(None, None), P(None, None)),
+            out_specs=P(axis, None, None, None),
+        )
+        return bind_weights(jax.jit(f), self._weights(),
+                            label="txt2img_mb", steps=len(sigmas) - 1)
+
+    def generate_microbatch(
+        self,
+        mesh: Mesh,
+        spec: GenerationSpec,
+        seeds: "list[int]",
+        contexts: "list[jax.Array]",
+        uncond_contexts: "list[jax.Array]",
+        ys: "list[Optional[jax.Array]] | None" = None,
+        uys: "list[Optional[jax.Array]] | None" = None,
+    ) -> "list[jax.Array]":
+        """Execute N same-shape requests as one microbatched program and
+        demux: returns one ``[n_dp · per_device_batch, H, W, 3]`` array
+        per request, each bit-identical to
+        ``generate(mesh, spec, seeds[r], contexts[r], …)``.
+
+        Group size is bucketed to the next power of two (compile-count
+        bound: programs exist only for R ∈ {2, 4, 8, …}); the pad slots
+        repeat request 0 and their outputs are dropped at demux. Every
+        request's context/uncond/y must share one shape — the front
+        door's batcher sub-groups by shape before calling."""
+        R = len(seeds)
+        if not (R == len(contexts) == len(uncond_contexts)):
+            raise ValueError("seeds/contexts/uncond_contexts length mismatch")
+        adm = self.unet.config.adm_in_channels
+
+        def norm_y(y):
+            return (jnp.zeros((1, max(adm, 1)), jnp.float32)
+                    if y is None else jnp.asarray(y, jnp.float32))
+
+        ys = [norm_y(y) for y in (ys or [None] * R)]
+        uys = [norm_y(y) for y in (uys or [None] * R)]
+        bucket = 1
+        while bucket < R:
+            bucket *= 2
+        pad = bucket - R
+        seeds_arr = jnp.asarray(list(seeds) + [seeds[0]] * pad, jnp.int32)
+        ctx = jnp.concatenate(list(contexts) + [contexts[0]] * pad, axis=0)
+        unc = jnp.concatenate(
+            list(uncond_contexts) + [uncond_contexts[0]] * pad, axis=0)
+        y_s = jnp.concatenate(ys + [ys[0]] * pad, axis=0)
+        uy_s = jnp.concatenate(uys + [uys[0]] * pad, axis=0)
+
+        if not hasattr(self, "_mb_cache"):
+            self._mb_cache: "dict[tuple, Any]" = {}
+        key = (self._mesh_cache_key(mesh), spec, bucket,
+               tuple(ctx.shape[1:]), tuple(unc.shape[1:]),
+               tuple(y_s.shape[1:]))
+        fn = cached_build(self._mb_cache, key,
+                          lambda: self.microbatch_fn(mesh, spec, bucket),
+                          self._CACHE_MAX)
+        out = fn(seeds_arr, ctx, unc, y_s, uy_s)
+        return demux_microbatch(out, mesh, bucket,
+                                spec.per_device_batch)[:R]
+
+
+# samplers whose trajectory is a pure function of (noise, conditioning):
+# their compiled step never consumes the sampling key, so N solo runs can
+# be replayed exactly inside one microbatched program. The stochastic
+# families (euler_ancestral, lcm, dpmpp_sde, ddim with eta>0) draw
+# batch-shaped step noise from a single key and are excluded.
+DETERMINISTIC_SAMPLERS = frozenset({"euler", "heun", "dpmpp_2m", "ddim"})
+
+
+def demux_microbatch(out: jax.Array, mesh: Mesh, n_requests: int,
+                     per_device_batch: int,
+                     axis: str = constants.AXIS_DATA) -> "list[jax.Array]":
+    """Split a microbatched program's output back into per-request arrays
+    matching each request's solo output row order (shard-major, batch-
+    minor — the Collector ordering contract ``generate_fn`` documents)."""
+    n_dp = dict(mesh.shape)[axis]
+    R, B = int(n_requests), int(per_device_batch)
+    if out.shape[0] != n_dp * R * B:
+        raise ValueError(
+            f"microbatch output has {out.shape[0]} rows, expected "
+            f"n_dp({n_dp}) · R({R}) · B({B}) = {n_dp * R * B}")
+    per_request = []
+    for r in range(R):
+        blocks = [out[i * R * B + r * B: i * R * B + (r + 1) * B]
+                  for i in range(n_dp)]
+        per_request.append(jnp.concatenate(blocks, axis=0))
+    return per_request

@@ -102,6 +102,29 @@ def topo_order(prompt: Prompt) -> list[str]:
     return order
 
 
+def node_kwargs(prompt: Prompt, nid: str, cache: dict[str, tuple],
+                context: dict[str, Any]) -> dict[str, Any]:
+    """Resolve one node's call kwargs: links from ``cache``, literals as
+    given, HIDDEN names from ``context``. Shared by the full executor and
+    the front door's microbatch executor (``cluster/frontdoor``), which
+    resolves a sampler's inputs without invoking it."""
+    node = prompt[nid]
+    cls = get_node(node["class_type"])
+    kwargs: dict[str, Any] = {}
+    for name, value in node.get("inputs", {}).items():
+        if name not in cls.all_input_names():
+            continue              # tolerate extra inputs (forward compat)
+        if is_link(value):
+            src, out_idx = value
+            kwargs[name] = cache[src][out_idx]
+        else:
+            kwargs[name] = value
+    for name in cls.HIDDEN:
+        if name not in kwargs and name in context:
+            kwargs[name] = context[name]
+    return kwargs
+
+
 class GraphExecutor:
     """Execute a validated prompt. ``context`` is shared framework state
     (mesh, pipelines, job store handles) that nodes may request via their
@@ -119,28 +142,28 @@ class GraphExecutor:
                 "; ".join(f"{e.node_id}: {e.message}" for e in errs)
             )
         cache: dict[str, tuple] = {}
+        self.execute_nodes(prompt, topo_order(prompt), cache)
+        if outputs_for is not None:
+            return {nid: cache[nid] for nid in outputs_for if nid in cache}
+        return cache
+
+    def execute_nodes(self, prompt: Prompt, node_ids: list[str],
+                      cache: dict[str, tuple]) -> dict[str, tuple]:
+        """Execute ``node_ids`` in the given order into ``cache`` (which
+        may carry already-computed results — the microbatch executor runs
+        a prompt's prefix, injects the batched sampler output, then runs
+        the suffix through this same loop). Callers own validation and
+        ordering."""
         interrupt = self.context.get("interrupt_event")
-        for nid in topo_order(prompt):
+        for nid in node_ids:
             if interrupt is not None and interrupt.is_set():
                 # checked between nodes (the reference checks ComfyUI's
                 # interrupt flag inside its drain/tile loops; an in-flight
                 # XLA dispatch itself is not preemptible)
                 raise InterruptedError(f"execution interrupted before {nid}")
-            node = prompt[nid]
-            cls = get_node(node["class_type"])
-            kwargs: dict[str, Any] = {}
-            for name, value in node.get("inputs", {}).items():
-                if name not in cls.all_input_names():
-                    continue          # tolerate extra inputs (forward compat)
-                if is_link(value):
-                    src, out_idx = value
-                    kwargs[name] = cache[src][out_idx]
-                else:
-                    kwargs[name] = value
-            for name in cls.HIDDEN:
-                if name not in kwargs and name in self.context:
-                    kwargs[name] = self.context[name]
+            if nid in cache:
+                continue
+            cls = get_node(prompt[nid]["class_type"])
+            kwargs = node_kwargs(prompt, nid, cache, self.context)
             cache[nid] = tuple(cls().execute(**kwargs))
-        if outputs_for is not None:
-            return {nid: cache[nid] for nid in outputs_for if nid in cache}
         return cache

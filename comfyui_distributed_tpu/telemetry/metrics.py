@@ -159,12 +159,44 @@ RESIDENT_BYTES = REGISTRY.gauge(
     "cdt_resident_bytes",
     "Estimated bytes of resident model bundles (planner accounting).")
 
+# --- serving front door (cluster/frontdoor, docs/serving.md) ---------------
+
+ADMISSION_TOTAL = REGISTRY.counter(
+    "cdt_admission_total",
+    "Front-door admission decisions. admitted = fast path; queued = "
+    "accepted past the soft high-watermark; shed = refused with 429 + "
+    "Retry-After (overload or tenant rate).",
+    ("outcome", "priority"))   # admitted | queued | shed
+
+BATCH_SIZE = REGISTRY.histogram(
+    "cdt_batch_size",
+    "Microbatch occupancy per executed sampler program (1 = solo "
+    "pass-through). Mean > 1 means cross-user coalescing is working.",
+    buckets=(1, 2, 4, 8, 16, 32, 64))
+
+BATCH_FALLBACKS = REGISTRY.counter(
+    "cdt_batch_fallbacks_total",
+    "Microbatched programs that failed and fell back to per-member solo "
+    "execution (admitted jobs are retried solo, never dropped).")
+
+FD_QUEUE_DEPTH = REGISTRY.gauge(
+    "cdt_fd_queue_depth",
+    "Per-priority-class request depth by stage: coalescing (held in a "
+    "front-door window) or queued (in the prompt queue).",
+    ("stage", "priority"))
+
+QUEUE_WAIT_SECONDS = REGISTRY.histogram(
+    "cdt_queue_wait_seconds",
+    "Time-in-queue per request (submission to execution start, "
+    "coalescing window included), by priority class.",
+    ("priority",))
+
 # --- prompt queue -----------------------------------------------------------
 
 PROMPTS_TOTAL = REGISTRY.counter(
     "cdt_prompts_total",
     "Prompt executions by terminal status.",
-    ("status",))   # success | error | interrupted
+    ("status",))   # success | error | interrupted | expired
 
 PROMPT_SECONDS = REGISTRY.histogram(
     "cdt_prompt_duration_seconds",

@@ -115,6 +115,35 @@ AXIS_DATA = "dp"
 AXIS_TENSOR = "tp"
 AXIS_SEQUENCE = "sp"
 
+# --- serving front door (cluster/frontdoor, docs/serving.md) ---------------
+# Priority classes in strict order (first = most latency-sensitive; the
+# lowest class sheds first under overload). The queue-request `priority`
+# field validates against this tuple.
+PRIORITY_CLASSES = ("interactive", "batch")
+DEFAULT_PRIORITY = "interactive"
+DEFAULT_TENANT = "default"
+# Coalescing window: how long a group waits for same-shape company before
+# flushing (ms), and the largest microbatch one program executes.
+FD_WINDOW_MS = env_float("CDT_FD_WINDOW_MS", 25.0)
+FD_MAX_BATCH = env_int("CDT_FD_MAX_BATCH", 8)
+# Batch jobs the front door keeps in the prompt queue at once; pending
+# groups keep coalescing while the queue is at this depth (continuous
+# batching: later arrivals join the waiting group instead of a new one).
+FD_INFLIGHT = env_int("CDT_FD_INFLIGHT", 2)
+# Backpressure thresholds on the controller depth signal (queued +
+# executing + coalescing): past SOFT the admission outcome is "queued"
+# (accepted, but the client is told the fleet is busy); past SHED the
+# request is refused with 429 + Retry-After. The lowest priority class
+# sheds at half the threshold.
+FD_SOFT_DEPTH = env_int("CDT_FD_SOFT_DEPTH", 64)
+FD_SHED_DEPTH = env_int("CDT_FD_SHED_DEPTH", 256)
+# Per-tenant token bucket: sustained requests/second and burst capacity.
+FD_TENANT_RATE = env_float("CDT_FD_TENANT_RATE", 20.0)
+FD_TENANT_BURST = env_float("CDT_FD_TENANT_BURST", 40.0)
+FD_MAX_TENANTS = env_int("CDT_FD_MAX_TENANTS", 1024)
+# Base Retry-After seconds for shed responses (scaled by overload ratio).
+FD_RETRY_AFTER_S = env_float("CDT_FD_RETRY_AFTER_S", 2.0)
+
 # --- VAE decode tiling ------------------------------------------------------
 # 3D-VAE decodes switch to spatially-tiled mode when the latent frame area
 # exceeds this (latent pixels): a 480p WAN clip decode holds >31 GB of f32

@@ -107,7 +107,29 @@ export function telemetryRows(metrics) {
   rows.push(["Circuit breakers", breakerSummary(metrics)]);
   const retries = seriesSum(metrics, "cdt_retry_attempts_total");
   if (retries > 0) rows.push(["Retries", String(retries)]);
+  rows.push(["Front door", frontDoorSummary(metrics)]);
   return rows;
+}
+
+// Serving front door (cluster/frontdoor): admission outcomes, mean
+// microbatch occupancy, and queue-wait p95 — the three numbers that say
+// whether cross-user batching is earning its window.
+export function frontDoorSummary(metrics) {
+  const admissions = countsByLabel(metrics, "cdt_admission_total", "outcome");
+  const total = Object.values(admissions).reduce((a, b) => a + b, 0);
+  if (!total) return "no traffic";
+  const parts = [fmtCounts(admissions)];
+  const occ = mergeHistogram(metrics, "cdt_batch_size");
+  if (occ && occ.count) {
+    parts.push(`batch x̄ ${(occ.sum / occ.count).toFixed(2)}`);
+  }
+  const wait = mergeHistogram(metrics, "cdt_queue_wait_seconds");
+  if (wait && wait.count) {
+    parts.push(`wait p95 ${fmtSeconds(histQuantile(wait, 0.95))}`);
+  }
+  const fallbacks = seriesSum(metrics, "cdt_batch_fallbacks_total");
+  if (fallbacks > 0) parts.push(`${fallbacks} fallback`);
+  return parts.join(" · ");
 }
 
 // cdt_worker_breaker_state gauge (0=closed, 1=half-open, 2=open) →

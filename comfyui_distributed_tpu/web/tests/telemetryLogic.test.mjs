@@ -3,8 +3,9 @@
 import assert from "node:assert/strict";
 import { test } from "node:test";
 
-import { breakerSummary, countsByLabel, fmtSeconds, histQuantile,
-         mergeHistogram, seriesSum, telemetryRows } from "../telemetryLogic.js";
+import { breakerSummary, countsByLabel, fmtSeconds, frontDoorSummary,
+         histQuantile, mergeHistogram, seriesSum,
+         telemetryRows } from "../telemetryLogic.js";
 
 const METRICS = {
   cdt_prompts_total: {
@@ -90,6 +91,41 @@ test("breakerSummary buckets workers by breaker state and names the bad ones", (
   // telemetryRows carries the row
   const byKey = Object.fromEntries(telemetryRows(metrics));
   assert.match(byKey["Circuit breakers"], /2 open \(w1, w2\)/);
+});
+
+test("frontDoorSummary reports admissions, occupancy, and queue wait", () => {
+  assert.equal(frontDoorSummary({}), "no traffic");
+  const metrics = {
+    cdt_admission_total: {
+      type: "counter",
+      series: [
+        { labels: { outcome: "admitted", priority: "interactive" }, value: 10 },
+        { labels: { outcome: "shed", priority: "batch" }, value: 4 },
+      ],
+    },
+    cdt_batch_size: {
+      type: "histogram",
+      series: [{ labels: {}, buckets: [[1, 2], [2, 5], [4, 6]],
+                 sum: 14, count: 6 }],
+    },
+    cdt_queue_wait_seconds: {
+      type: "histogram",
+      series: [{ labels: { priority: "interactive" },
+                 buckets: [[0.1, 3], [1.0, 6]], sum: 1.2, count: 6 }],
+    },
+    cdt_batch_fallbacks_total: {
+      type: "counter",
+      series: [{ labels: {}, value: 1 }],
+    },
+  };
+  const row = frontDoorSummary(metrics);
+  assert.match(row, /10 admitted · 4 shed/);
+  assert.match(row, /batch x̄ 2\.33/);
+  assert.match(row, /wait p95 1\.00s/);
+  assert.match(row, /1 fallback/);
+  // telemetryRows carries the row
+  const byKey = Object.fromEntries(telemetryRows(metrics));
+  assert.match(byKey["Front door"], /batch x̄/);
 });
 
 test("telemetryRows tolerates absent families and renders the rest", () => {

@@ -19,12 +19,25 @@ echo "[chaos] repro: CDT_CHAOS_SEED=${SEED} scripts/chaos_suite.sh $*"
 # catalog) must rejoin with a pure cache-hit warmup pass and the job
 # must complete with nothing dropped or dead-lettered.
 echo "[chaos] stage 1: rolling-restart event (warm worker rejoin)"
+# (filter matches test_warm_restarted_worker_rejoins_without_dropping_jobs;
+# the old "rolling_restart" pattern matched nothing and rc=5 aborted the
+# whole suite under set -e)
 env JAX_PLATFORMS=cpu CDT_CHAOS_SEED="${SEED}" \
-    python -m pytest tests/ -q -m chaos -k "rolling_restart" \
+    python -m pytest tests/ -q -m chaos -k "warm_restarted" \
     -p no:cacheprovider --continue-on-collection-errors "$@"
 
-# Stage 2 — the rest of the chaos tier
-echo "[chaos] stage 2: full chaos tier"
+# Stage 2 — seeded front-door overload event (ISSUE 9, docs/serving.md):
+# 4× capacity of seeded mixed-tenant load against a pinned-low shed
+# threshold. Asserted: surplus requests get deterministic 429s with
+# Retry-After (never hangs), queue depth stays bounded under the
+# threshold, zero admitted-job loss, and both tenants complete work.
+echo "[chaos] stage 2: front-door overload (shed 429s, zero admitted loss)"
+env JAX_PLATFORMS=cpu CDT_CHAOS_SEED="${SEED}" \
+    python -m pytest tests/ -q -m chaos -k "overload" \
+    -p no:cacheprovider --continue-on-collection-errors "$@"
+
+# Stage 3 — the rest of the chaos tier
+echo "[chaos] stage 3: full chaos tier"
 exec env JAX_PLATFORMS=cpu CDT_CHAOS_SEED="${SEED}" \
-    python -m pytest tests/ -q -m chaos -k "not rolling_restart" \
+    python -m pytest tests/ -q -m chaos -k "not warm_restarted and not overload" \
     -p no:cacheprovider --continue-on-collection-errors "$@"

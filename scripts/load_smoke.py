@@ -1,0 +1,279 @@
+#!/usr/bin/env python
+"""Synthetic-load generator for the serving front door (docs/serving.md).
+
+Builds a SEEDED mixed workload — mixed shapes (so several coalescing
+groups exist), mixed tenants, mixed priority classes — and drives it at
+bounded concurrency against either:
+
+- a live controller (``--url http://host:8288``), or
+- an in-process controller (``--in-process``; real tiny-preset compiles
+  on CPU — slow the first time, cache-served after).
+
+Prints admission outcomes, per-tenant completion, microbatch occupancy
+(from ``/distributed/metrics.json``), and submit→terminal latency
+percentiles. The tier-1 test (``tests/test_frontdoor_load.py``) imports
+``build_workload``/``run_load`` and drives them against a stubbed
+sampler, so the scheduler logic is exercised on every CI run without a
+single compile.
+
+Exit status: 0 on a clean run (every admitted request reached a terminal
+status), 1 otherwise — the zero-loss guarantee is the smoke check.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import math
+import random
+import sys
+import time
+from typing import Any, Callable, Optional
+
+
+def prompt_for(seed: int, text: str, wh: int, steps: int,
+               model: str = "tiny", cfg: float = 2.0) -> dict:
+    """A minimal batchable txt2img graph (classifier allowlist only)."""
+    return {
+        "1": {"class_type": "CheckpointLoader",
+              "inputs": {"ckpt_name": model}},
+        "2": {"class_type": "CLIPTextEncode",
+              "inputs": {"text": text, "clip": ["1", 1]}},
+        "3": {"class_type": "CLIPTextEncode",
+              "inputs": {"text": "", "clip": ["1", 1]}},
+        "4": {"class_type": "TPUTxt2Img", "inputs": {
+            "model": ["1", 0], "positive": ["2", 0], "negative": ["3", 0],
+            "seed": seed, "steps": steps, "cfg": cfg,
+            "width": wh, "height": wh}},
+    }
+
+
+def build_workload(seed: int, n: int, *,
+                   shapes: tuple = ((16, 2), (24, 2)),
+                   tenants: tuple = ("tenant-a", "tenant-b"),
+                   priorities: tuple = ("interactive", "batch"),
+                   model: str = "tiny") -> list[dict]:
+    """N deterministic ``POST /distributed/queue`` payloads. Same seed →
+    same workload, byte for byte — chaos runs replay exactly."""
+    rng = random.Random(seed)
+    out = []
+    for i in range(n):
+        wh, steps = shapes[rng.randrange(len(shapes))]
+        tenant = tenants[rng.randrange(len(tenants))]
+        priority = priorities[rng.randrange(len(priorities))]
+        out.append({
+            "prompt": prompt_for(seed=1000 + i, text=f"load {i}",
+                                 wh=wh, steps=steps, model=model),
+            "tenant": tenant,
+            "priority": priority,
+            "client_id": f"load_smoke_{i}",
+        })
+    return out
+
+
+def percentile(values: list, q: float) -> float:
+    if not values:
+        return float("nan")
+    s = sorted(values)
+    idx = min(len(s) - 1, max(0, math.ceil(q * len(s)) - 1))
+    return s[idx]
+
+
+async def run_load(submit: Callable[[dict], Any],
+                   requests: list[dict], *,
+                   concurrency: int = 16,
+                   wait_done: Optional[Callable[[str], Any]] = None
+                   ) -> dict:
+    """Drive ``requests`` through async ``submit(payload) -> (status,
+    body)`` at bounded concurrency; optionally await per-id completion
+    via ``wait_done(prompt_id) -> terminal history entry``. Returns the
+    stats dict the CLI prints."""
+    sem = asyncio.Semaphore(concurrency)
+    stats: dict = {
+        "submitted": 0, "admitted": 0, "queued": 0, "shed": 0,
+        "rejected": 0, "completed": 0, "errors": 0, "expired": 0,
+        "by_tenant": {}, "latency_s": [],
+        "shed_retry_after": [],
+    }
+
+    async def one(payload: dict) -> None:
+        async with sem:
+            t0 = time.monotonic()
+            status, body = await submit(payload)
+            stats["submitted"] += 1
+            tenant = payload.get("tenant", "default")
+            per = stats["by_tenant"].setdefault(
+                tenant, {"admitted": 0, "shed": 0, "completed": 0})
+            if status == 429:
+                stats["shed"] += 1
+                per["shed"] += 1
+                ra = body.get("retry_after_s")
+                if ra is not None:
+                    stats["shed_retry_after"].append(ra)
+                return
+            if status != 200 or not body.get("prompt_id"):
+                stats["rejected"] += 1
+                return
+            outcome = body.get("outcome", "admitted")
+            stats["admitted" if outcome != "queued" else "queued"] += 1
+            per["admitted"] += 1
+            if wait_done is None:
+                return
+            entry = await wait_done(body["prompt_id"])
+            stats["latency_s"].append(time.monotonic() - t0)
+            final = (entry or {}).get("status")
+            if final == "success":
+                stats["completed"] += 1
+                per["completed"] += 1
+            elif final == "expired":
+                stats["expired"] += 1
+            else:
+                stats["errors"] += 1
+
+    await asyncio.gather(*(one(p) for p in requests))
+    lat = stats.pop("latency_s")
+    stats["latency_p50_s"] = round(percentile(lat, 0.50), 4) if lat else None
+    stats["latency_p99_s"] = round(percentile(lat, 0.99), 4) if lat else None
+    return stats
+
+
+# --- transports -------------------------------------------------------------
+
+
+async def _run_http(url: str, requests: list[dict], concurrency: int,
+                    wait: bool, timeout_s: float) -> dict:
+    import aiohttp
+
+    async with aiohttp.ClientSession() as session:
+
+        async def submit(payload):
+            async with session.post(f"{url}/distributed/queue",
+                                    json=payload) as resp:
+                try:
+                    body = await resp.json()
+                except Exception:  # noqa: BLE001 — non-JSON error body
+                    body = {}
+                return resp.status, body
+
+        async def wait_done(prompt_id):
+            deadline = time.monotonic() + timeout_s
+            while time.monotonic() < deadline:
+                async with session.get(
+                        f"{url}/distributed/history/{prompt_id}") as resp:
+                    if resp.status == 200:
+                        body = await resp.json()
+                        if body.get("status") in ("success", "error",
+                                                  "interrupted", "expired"):
+                            return body
+                await asyncio.sleep(0.2)
+            return {"status": "timeout"}
+
+        stats = await run_load(submit, requests, concurrency=concurrency,
+                               wait_done=wait_done if wait else None)
+        stats["metrics"] = await _fetch_occupancy(session, url)
+        return stats
+
+
+def _occupancy_from_snapshot(snap: dict) -> dict:
+    """``{batch_programs, mean_batch_size}`` from a metrics.json-shaped
+    snapshot — shared by the HTTP and in-process modes (and consumed by
+    bench.py's serving workload) so the definition can't drift."""
+    fam = (snap.get("metrics") or {}).get("cdt_batch_size") or {}
+    series = fam.get("series") or []
+    total = sum(s.get("count", 0) for s in series)
+    ssum = sum(s.get("sum", 0) for s in series)
+    return {"batch_programs": total,
+            "mean_batch_size": round(ssum / total, 3) if total else None}
+
+
+async def _fetch_occupancy(session, url: str) -> dict:
+    try:
+        async with session.get(f"{url}/distributed/metrics.json") as resp:
+            snap = await resp.json()
+    except Exception:  # noqa: BLE001 — metrics are optional decoration
+        return {}
+    return _occupancy_from_snapshot(snap)
+
+
+async def _run_in_process(requests: list[dict], concurrency: int,
+                          wait: bool, timeout_s: float) -> dict:
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from comfyui_distributed_tpu.api import create_app
+    from comfyui_distributed_tpu.cluster.controller import Controller
+
+    controller = Controller()
+    client = TestClient(TestServer(create_app(controller)))
+    await client.start_server()
+    try:
+
+        async def submit(payload):
+            resp = await client.post("/distributed/queue", json=payload)
+            try:
+                body = await resp.json()
+            except Exception:  # noqa: BLE001 — non-JSON error body
+                body = {}
+            return resp.status, body
+
+        async def wait_done(prompt_id):
+            deadline = time.monotonic() + timeout_s
+            while time.monotonic() < deadline:
+                entry = controller.queue.history.get(prompt_id)
+                if entry is not None:
+                    return entry
+                await asyncio.sleep(0.05)
+            return {"status": "timeout"}
+
+        stats = await run_load(submit, requests, concurrency=concurrency,
+                               wait_done=wait_done if wait else None)
+        from comfyui_distributed_tpu import telemetry
+
+        if telemetry.enabled():
+            from comfyui_distributed_tpu.telemetry.export import render_json
+            from comfyui_distributed_tpu.telemetry.registry import REGISTRY
+
+            stats["metrics"] = _occupancy_from_snapshot(
+                render_json(REGISTRY.snapshot()))
+        return stats
+    finally:
+        await client.close()
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--url", default=None,
+                    help="target controller base URL (default: in-process)")
+    ap.add_argument("--in-process", action="store_true",
+                    help="spin a controller in this process (tiny preset)")
+    ap.add_argument("--n", type=int, default=64)
+    ap.add_argument("--concurrency", type=int, default=16)
+    ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--no-wait", action="store_true",
+                    help="submit only; skip waiting for completion")
+    ap.add_argument("--timeout-s", type=float, default=600.0)
+    cli = ap.parse_args()
+
+    requests = build_workload(cli.seed, cli.n)
+    wait = not cli.no_wait
+    if cli.url:
+        stats = asyncio.run(_run_http(cli.url, requests, cli.concurrency,
+                                      wait, cli.timeout_s))
+    else:
+        stats = asyncio.run(_run_in_process(requests, cli.concurrency,
+                                            wait, cli.timeout_s))
+    print(json.dumps(stats, indent=2, default=str))
+    accepted = stats["admitted"] + stats["queued"]
+    accounted = (stats["completed"] + stats["errors"] + stats["expired"])
+    if wait and accounted != accepted:
+        print(f"LOSS: {accepted} accepted but only {accounted} reached a "
+              f"terminal status", file=sys.stderr)
+        return 1
+    if wait and stats["errors"]:
+        print(f"{stats['errors']} request(s) errored", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,498 @@
+"""Serving front door unit tests (cluster/frontdoor, docs/serving.md):
+classification rules, admission gates (fake clock — fully deterministic),
+coalescing/flush scheduling, payload validation, and the prompt queue's
+batch-job path with stubbed execution. No model compiles here — the
+real-model equivalence lives in test_frontdoor_equivalence.py."""
+
+import asyncio
+
+import pytest
+
+from comfyui_distributed_tpu.api.queue_request import (
+    parse_queue_request_payload)
+from comfyui_distributed_tpu.cluster.frontdoor.admission import (
+    AdmissionController, TokenBucket)
+from comfyui_distributed_tpu.cluster.frontdoor.batcher import (
+    CoalescingBatcher)
+from comfyui_distributed_tpu.cluster.frontdoor.classifier import (
+    GroupKey, classify)
+from comfyui_distributed_tpu.cluster.runtime import PromptJob, PromptQueue
+from comfyui_distributed_tpu.utils.exceptions import ValidationError
+
+
+def batchable_prompt(seed=1, wh=16, steps=2, cfg=2.0, sampler="euler",
+                     model="tiny"):
+    return {
+        "1": {"class_type": "CheckpointLoader",
+              "inputs": {"ckpt_name": model}},
+        "2": {"class_type": "CLIPTextEncode",
+              "inputs": {"text": "x", "clip": ["1", 1]}},
+        "3": {"class_type": "CLIPTextEncode",
+              "inputs": {"text": "", "clip": ["1", 1]}},
+        "4": {"class_type": "TPUTxt2Img", "inputs": {
+            "model": ["1", 0], "positive": ["2", 0], "negative": ["3", 0],
+            "seed": seed, "steps": steps, "cfg": cfg,
+            "width": wh, "height": wh, "sampler_name": sampler}},
+    }
+
+
+class FakeClock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# --------------------------------------------------------------------------
+# classifier
+# --------------------------------------------------------------------------
+
+
+class TestClassifier:
+    def test_batchable_minimal_graph(self):
+        c = classify(batchable_prompt())
+        assert c.batchable and c.sampler_node_id == "4"
+        assert c.group_key == GroupKey(model="tiny", height=16, width=16,
+                                       steps=2, cfg=2.0, sampler="euler",
+                                       scheduler="karras")
+
+    def test_same_shape_different_text_share_a_key(self):
+        a = classify(batchable_prompt(seed=1))
+        b = classify(batchable_prompt(seed=999))
+        assert a.group_key == b.group_key
+
+    def test_different_geometry_different_key(self):
+        a = classify(batchable_prompt(wh=16))
+        b = classify(batchable_prompt(wh=24))
+        assert a.group_key != b.group_key
+
+    def test_seed_may_ride_a_link(self):
+        p = batchable_prompt()
+        p["5"] = {"class_type": "DistributedSeed", "inputs": {"seed": 9}}
+        p["4"]["inputs"]["seed"] = ["5", 0]
+        assert classify(p).batchable
+
+    @pytest.mark.parametrize("mutate,reason", [
+        (lambda p: p["4"]["inputs"].update(sampler_name="euler_ancestral"),
+         "stochastic_sampler"),
+        (lambda p: p["4"]["inputs"].update(width=["2", 0]),
+         "dynamic_geometry"),
+        (lambda p: p["4"]["inputs"].update(model="literal-not-a-link"),
+         "unresolvable_model"),
+        (lambda p: p.update({"9": {"class_type": "DistributedCollector",
+                                   "inputs": {"images": ["4", 0]}}}),
+         "node_outside_allowlist"),
+        (lambda p: p.update({"9": {"class_type": "LoraLoader",
+                                   "inputs": {"model": ["1", 0],
+                                              "clip": ["1", 1],
+                                              "lora_name": "l"}}}),
+         "node_outside_allowlist"),
+        (lambda p: p.update(
+            {"9": dict(p["4"], inputs=dict(p["4"]["inputs"]))}),
+         "multiple_samplers"),
+    ])
+    def test_not_batchable(self, mutate, reason):
+        p = batchable_prompt()
+        mutate(p)
+        c = classify(p)
+        assert not c.batchable
+        assert c.reason.startswith(reason)
+
+    def test_no_sampler_and_malformed(self):
+        assert classify({}).reason == "empty"
+        assert classify({"1": {"class_type": "SaveImage",
+                               "inputs": {}}}).reason == \
+            "no_batchable_sampler"
+        assert not classify({"1": "not a node"}).batchable
+
+    def test_group_key_maps_to_shape_catalog(self):
+        key = classify(batchable_prompt()).group_key
+        pk = key.program_key()
+        assert (pk.pipeline, pk.model, pk.height, pk.steps) == \
+            ("txt2img", "tiny", 16, 2)
+
+
+# --------------------------------------------------------------------------
+# admission
+# --------------------------------------------------------------------------
+
+
+class TestAdmission:
+    def make(self, depth=0, **kw):
+        holder = {"depth": depth}
+        clock = FakeClock()
+        ctrl = AdmissionController(
+            depth_provider=lambda: holder["depth"],
+            soft_depth=4, shed_depth=8,
+            tenant_rate=10.0, tenant_burst=3.0,
+            healthy_fraction=kw.pop("healthy_fraction", lambda: 1.0),
+            clock=clock, **kw)
+        return ctrl, holder, clock
+
+    def test_admitted_then_queued_then_shed(self):
+        ctrl, holder, _ = self.make()
+        assert ctrl.admit("t", "interactive").outcome == "admitted"
+        holder["depth"] = 5
+        d = ctrl.admit("t", "interactive")
+        assert (d.outcome, d.reason) == ("queued", "busy")
+        holder["depth"] = 8
+        d = ctrl.admit("t", "interactive")
+        assert (d.outcome, d.reason) == ("shed", "overload")
+        assert d.retry_after_s >= 1
+
+    def test_lowest_class_sheds_at_half_threshold(self):
+        ctrl, holder, _ = self.make(depth=4)
+        assert ctrl.admit("t", "batch").outcome == "shed"
+        assert ctrl.admit("t", "interactive").outcome == "queued"
+
+    def test_tenant_token_bucket_rate_limits_and_refills(self):
+        ctrl, _, clock = self.make()
+        outcomes = [ctrl.admit("hot", "interactive").outcome
+                    for _ in range(5)]
+        assert outcomes[:3] == ["admitted"] * 3      # burst
+        assert outcomes[3:] == ["shed"] * 2          # bucket dry
+        d = ctrl.admit("hot", "interactive")
+        assert d.reason == "tenant_rate" and d.retry_after_s >= 1
+        # other tenants are unaffected — that's the fairness floor
+        assert ctrl.admit("cold", "interactive").outcome == "admitted"
+        clock.advance(1.0)                           # 10 tokens refill
+        assert ctrl.admit("hot", "interactive").outcome == "admitted"
+
+    def test_degraded_fleet_scales_threshold_down(self):
+        ctrl, holder, _ = self.make(
+            depth=4, healthy_fraction=lambda: 0.5)
+        # threshold 8 * 0.5 = 4 → depth 4 sheds
+        assert ctrl.admit("t", "interactive").outcome == "shed"
+
+    def test_retry_after_scales_with_overload_and_caps(self):
+        ctrl, holder, _ = self.make(depth=8)
+        base = ctrl.admit("a", "interactive").retry_after_s
+        holder["depth"] = 80
+        worse = ctrl.admit("b", "interactive").retry_after_s
+        assert worse > base
+        holder["depth"] = 100000
+        assert ctrl.admit("c", "interactive").retry_after_s <= 30
+
+    def test_overload_shed_does_not_burn_tenant_tokens(self):
+        """Review-hardening: a compliant client retrying per Retry-After
+        during an overload must not drain its bucket on rejected
+        requests (which would flip the shed reason to tenant_rate and
+        keep shedding after the overload clears)."""
+        ctrl, holder, _ = self.make(depth=8)
+        for _ in range(10):
+            assert ctrl.admit("polite", "interactive").reason == "overload"
+        holder["depth"] = 0
+        assert ctrl.admit("polite", "interactive").outcome == "admitted"
+
+    def test_bucket_seconds_until_token(self):
+        clock = FakeClock()
+        b = TokenBucket(rate=2.0, burst=1.0, clock=clock)
+        assert b.take()
+        assert not b.take()
+        assert b.seconds_until_token() == pytest.approx(0.5)
+        clock.advance(0.5)
+        assert b.take()
+
+
+# --------------------------------------------------------------------------
+# batcher
+# --------------------------------------------------------------------------
+
+
+def member(pid, priority="interactive", t=0.0):
+    job = PromptJob(prompt_id=pid, prompt={}, priority=priority)
+    job.enqueued_at = t
+    return job
+
+
+class TestBatcher:
+    def make(self, capacity=None, **kw):
+        flushed = []
+        clock = FakeClock()
+        b = CoalescingBatcher(
+            lambda members, ids: flushed.append((members, ids)),
+            window_ms=25, max_batch=4,
+            capacity=capacity or (lambda: True), clock=clock, **kw)
+        return b, flushed, clock
+
+    def key(self, wh=16):
+        return classify(batchable_prompt(wh=wh)).group_key
+
+    def test_window_elapse_flushes_group(self):
+        b, flushed, clock = self.make()
+        b.submit(self.key(), member("p1"), "4")
+        b.submit(self.key(), member("p2"), "4")
+        assert b.flush_ready() == 0              # window still open
+        clock.advance(0.03)
+        assert b.flush_ready() == 2
+        (members, ids), = flushed
+        assert [m.prompt_id for m in members] == ["p1", "p2"]
+        assert ids == {"p1": "4", "p2": "4"}
+        assert b.pending_count == 0
+
+    def test_full_group_flushes_before_window(self):
+        b, flushed, clock = self.make()
+        for i in range(5):
+            b.submit(self.key(), member(f"p{i}", t=clock.t), "4")
+        assert b.flush_ready() == 4              # max_batch bus departs
+        assert b.pending_count == 1              # leftover keeps waiting
+        clock.advance(0.03)
+        assert b.flush_ready() == 1
+
+    def test_distinct_keys_never_mix(self):
+        b, flushed, clock = self.make()
+        b.submit(self.key(16), member("a"), "4")
+        b.submit(self.key(24), member("b"), "4")
+        clock.advance(0.03)
+        assert b.flush_ready() == 2
+        assert len(flushed) == 2
+        assert all(len(m) == 1 for m, _ in flushed)
+
+    def test_priority_groups_flush_first(self):
+        b, flushed, clock = self.make()
+        b.submit(self.key(16), member("bg", priority="batch"), "4")
+        clock.advance(0.001)
+        b.submit(self.key(24), member("fg", priority="interactive"), "4")
+        clock.advance(0.03)
+        b.flush_ready()
+        order = [m[0].prompt_id for m, _ in flushed]
+        assert order == ["fg", "bg"]
+
+    def test_capacity_gate_holds_then_overdue_valve_fires(self, monkeypatch):
+        gate = {"open": False}
+        b, flushed, clock = self.make(capacity=lambda: gate["open"])
+        b.submit(self.key(), member("p1"), "4")
+        clock.advance(0.03)
+        assert b.flush_ready() == 0              # queue full: keep holding
+        b.submit(self.key(), member("p2"), "4")  # continuous batching
+        clock.advance(0.03)
+        assert b.flush_ready() == 0
+        monkeypatch.setenv("CDT_FD_MAX_WAIT_MS", "40")
+        assert b.flush_ready() == 2              # safety valve
+        gate["open"] = True
+        assert b.pending_count == 0
+
+    def test_overdue_lower_priority_group_not_starved_by_blocked_leader(
+            self, monkeypatch):
+        """Review-hardening: the overdue valve must scan ALL ready
+        groups — a capacity-blocked fresh interactive group ahead in
+        priority order must not keep an overdue batch group held
+        forever."""
+        monkeypatch.setenv("CDT_FD_MAX_WAIT_MS", "100")
+        b, flushed, clock = self.make(capacity=lambda: False)
+        b.submit(self.key(16), member("old-bg", priority="batch",
+                                      t=clock.t), "4")
+        clock.advance(0.2)               # bg group now overdue
+        b.submit(self.key(24), member("fresh-fg", t=clock.t), "4")
+        clock.advance(0.05)              # fg ready but NOT overdue
+        assert b.flush_ready() == 1
+        assert [m[0].prompt_id for m, _ in flushed] == ["old-bg"]
+
+    def test_next_deadline_ignores_expired_windows_of_blocked_groups(self):
+        """Review-hardening: a ready-but-capacity-blocked group's wake
+        timer is its overdue valve, not its (already expired) window —
+        otherwise the scheduler loop spins at the 1 ms clamp for the
+        whole running program."""
+        b, _, clock = self.make(capacity=lambda: False)
+        b.submit(self.key(), member("p", t=clock.t), "4")
+        clock.advance(0.1)               # window (25 ms) long expired
+        deadline = b._next_deadline()
+        assert deadline is not None and deadline > clock()
+
+    def test_pending_by_priority(self):
+        b, _, _ = self.make()
+        b.submit(self.key(), member("a", priority="batch"), "4")
+        b.submit(self.key(), member("b"), "4")
+        assert b.pending_by_priority() == {"interactive": 1, "batch": 1}
+
+
+# --------------------------------------------------------------------------
+# payload schema
+# --------------------------------------------------------------------------
+
+
+class TestPayloadFields:
+    def test_defaults_keep_legacy_clients_untouched(self):
+        p = parse_queue_request_payload({"prompt": {"1": {}}})
+        assert (p.tenant, p.priority, p.deadline_ms) == \
+            ("default", "interactive", None)
+
+    def test_valid_fields(self):
+        p = parse_queue_request_payload(
+            {"prompt": {"1": {}}, "tenant": "acme", "priority": "batch",
+             "deadline_ms": 1500})
+        assert (p.tenant, p.priority, p.deadline_ms) == \
+            ("acme", "batch", 1500)
+
+    @pytest.mark.parametrize("bad", [
+        {"tenant": ""},
+        {"tenant": 7},
+        {"tenant": "x" * 65},
+        {"priority": "urgent"},
+        {"priority": 1},
+        {"deadline_ms": 0},
+        {"deadline_ms": -5},
+        {"deadline_ms": "soon"},
+        {"deadline_ms": True},
+    ])
+    def test_invalid_fields_rejected_loudly(self, bad):
+        with pytest.raises(ValidationError):
+            parse_queue_request_payload({"prompt": {"1": {}}, **bad})
+
+
+# --------------------------------------------------------------------------
+# prompt queue batch jobs (stubbed group executor)
+# --------------------------------------------------------------------------
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+class TestQueueBatchJobs:
+    def _stub_group(self, monkeypatch, fn):
+        from comfyui_distributed_tpu.cluster.frontdoor import microbatch
+
+        monkeypatch.setattr(microbatch, "execute_group", fn)
+
+    def test_batch_members_get_individual_history(self, monkeypatch):
+        def fake_group(members, ids, ctx):
+            return {m.prompt_id: {"status": "success", "outputs": {},
+                                  "batch_size": len(members)}
+                    for m in members}
+
+        self._stub_group(monkeypatch, fake_group)
+
+        async def body():
+            q = PromptQueue()
+            members = [PromptJob(prompt_id=f"m{i}", prompt={"1": {}})
+                       for i in range(3)]
+            ids = q.enqueue_batch(members, {m.prompt_id: "1"
+                                            for m in members})
+            q.start()
+            for _ in range(100):
+                if all(i in q.history for i in ids):
+                    break
+                await asyncio.sleep(0.01)
+            await q.stop()
+            assert [q.history[i]["status"] for i in ids] == ["success"] * 3
+            assert q.history[ids[0]]["batch_size"] == 3
+
+        run(body())
+
+    def test_expired_members_never_execute(self, monkeypatch):
+        executed = []
+
+        def fake_group(members, ids, ctx):
+            executed.extend(m.prompt_id for m in members)
+            return {m.prompt_id: {"status": "success", "outputs": {}}
+                    for m in members}
+
+        self._stub_group(monkeypatch, fake_group)
+
+        async def body():
+            import time as _time
+
+            q = PromptQueue()
+            fresh = PromptJob(prompt_id="fresh", prompt={"1": {}})
+            stale = PromptJob(prompt_id="stale", prompt={"1": {}},
+                              deadline_at=_time.monotonic() - 1.0)
+            q.enqueue_batch([fresh, stale], {"fresh": "1", "stale": "1"})
+            q.start()
+            for _ in range(100):
+                if "fresh" in q.history and "stale" in q.history:
+                    break
+                await asyncio.sleep(0.01)
+            await q.stop()
+            assert q.history["stale"]["status"] == "expired"
+            assert q.history["fresh"]["status"] == "success"
+            assert executed == ["fresh"]
+
+        run(body())
+
+    def test_group_level_failure_errors_every_member(self, monkeypatch):
+        def boom(members, ids, ctx):
+            raise RuntimeError("mesh fell over")
+
+        self._stub_group(monkeypatch, boom)
+
+        async def body():
+            q = PromptQueue()
+            members = [PromptJob(prompt_id=f"m{i}", prompt={"1": {}})
+                       for i in range(2)]
+            q.enqueue_batch(members, {m.prompt_id: "1" for m in members})
+            q.start()
+            for _ in range(100):
+                if all(m.prompt_id in q.history for m in members):
+                    break
+                await asyncio.sleep(0.01)
+            await q.stop()
+            for m in members:
+                assert q.history[m.prompt_id]["status"] == "error"
+                assert "mesh fell over" in q.history[m.prompt_id]["error"]
+
+        run(body())
+
+    def test_interrupt_drops_queued_batch_members(self):
+        async def body():
+            q = PromptQueue()
+            members = [PromptJob(prompt_id=f"m{i}", prompt={"1": {}})
+                       for i in range(2)]
+            q.enqueue_batch(members, {m.prompt_id: "1" for m in members})
+            # no await since enqueue: the consumer task exists but has
+            # not run yet, so interrupt drains deterministically
+            dropped = q.interrupt()
+            assert dropped == 2
+            assert all(q.history[m.prompt_id]["status"] == "interrupted"
+                       for m in members)
+            await q.stop()
+
+        run(body())
+
+    def test_interrupt_keeps_finished_members_results(self, monkeypatch):
+        """Review-hardening: members that finished before an interrupt
+        keep their success entries (parity with solo jobs); only the
+        unfinished ones are marked interrupted."""
+        from comfyui_distributed_tpu.cluster.frontdoor import microbatch
+
+        def partial_then_interrupt(members, ids, ctx, results):
+            results[members[0].prompt_id] = {"status": "success",
+                                             "outputs": {}}
+            raise InterruptedError("stop")
+
+        monkeypatch.setattr(microbatch, "_execute_group_inner",
+                            partial_then_interrupt)
+
+        async def body():
+            q = PromptQueue()
+            members = [PromptJob(prompt_id=f"m{i}", prompt={"1": {}})
+                       for i in range(2)]
+            q.enqueue_batch(members, {m.prompt_id: "1" for m in members})
+            q.start()
+            for _ in range(100):
+                if all(m.prompt_id in q.history for m in members):
+                    break
+                await asyncio.sleep(0.01)
+            await q.stop()
+            assert q.history["m0"]["status"] == "success"
+            assert q.history["m1"]["status"] == "interrupted"
+
+        run(body())
+
+    def test_enqueue_batch_priority_accounting(self):
+        async def body():
+            q = PromptQueue()
+            members = [PromptJob(prompt_id="a", prompt={},
+                                 priority="batch"),
+                       PromptJob(prompt_id="b", prompt={},
+                                 priority="interactive")]
+            q.enqueue_batch(members, {"a": "1", "b": "1"})
+            assert q._pending_by_priority == {"batch": 1,
+                                              "interactive": 1}
+            await q.stop()
+
+        run(body())

@@ -1,0 +1,271 @@
+"""Front-door acceptance under synthetic concurrent load (ISSUE 9).
+
+The sampler programs are STUBBED (deterministic arrays derived from the
+request seed, a small sleep standing in for step time) so this runs in
+seconds on every tier-1 pass — it exercises the real HTTP route, real
+admission, real coalescing windows, real batch-job queue path, and real
+demux bookkeeping, everything except XLA. The real-program bit-identity
+guarantee lives in test_frontdoor_equivalence.py.
+
+Acceptance asserted here (driven through scripts/load_smoke.py, the same
+harness operators run):
+
+- 64 concurrent mixed-shape requests coalesce (mean cdt_batch_size > 1),
+- every admitted request reaches a terminal history status (zero loss),
+- each request's output rides its own seed (no demux cross-wiring),
+- per-tenant fairness at 2 priority classes (no tenant starved),
+- offered load past the shed threshold gets deterministic 429s with
+  Retry-After while queue depth stays bounded — and still zero loss.
+"""
+
+import asyncio
+import importlib.util
+import time
+from pathlib import Path
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient, TestServer
+
+from comfyui_distributed_tpu.api import create_app
+from comfyui_distributed_tpu.cluster.controller import Controller
+from comfyui_distributed_tpu.diffusion.pipeline import Txt2ImgPipeline
+
+_spec = importlib.util.spec_from_file_location(
+    "load_smoke",
+    Path(__file__).resolve().parent.parent / "scripts" / "load_smoke.py")
+load_smoke = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(load_smoke)
+
+
+def _fake_image(seed: int, h: int, w: int):
+    return jnp.full((1, int(h), int(w), 3), (seed % 997) / 997.0,
+                    jnp.float32)
+
+
+@pytest.fixture
+def stub_sampler(monkeypatch):
+    """Replace both generate paths with seed-tagged stubs + a step-time
+    sleep; record every microbatch occupancy."""
+    batches: list[int] = []
+
+    def fake_generate(self, mesh, spec, seed, context, uncond_context,
+                      y=None, uncond_y=None, hint=None,
+                      progress_token=None):
+        time.sleep(0.02)
+        return _fake_image(seed, spec.height, spec.width)
+
+    def fake_microbatch(self, mesh, spec, seeds, contexts,
+                        uncond_contexts, ys=None, uys=None):
+        time.sleep(0.02)          # one program, not N — that's the point
+        batches.append(len(seeds))
+        return [_fake_image(s, spec.height, spec.width) for s in seeds]
+
+    monkeypatch.setattr(Txt2ImgPipeline, "generate", fake_generate)
+    monkeypatch.setattr(Txt2ImgPipeline, "generate_microbatch",
+                        fake_microbatch)
+    return batches
+
+
+class _Served:
+    """Controller + client builder; both must be born inside the running
+    loop (aiohttp TestClient binds it at construction)."""
+
+    def __init__(self):
+        self.controller = None
+        self.client = None
+
+    async def start(self):
+        self.controller = Controller()
+        assert self.controller.frontdoor is not None
+        # front door tuned for test timescales (instance attrs — no
+        # env/re-import games)
+        self.controller.frontdoor.batcher.window_ms = 30
+        self.controller.frontdoor.batcher.max_batch = 8
+        self.client = TestClient(TestServer(create_app(self.controller)))
+        await self.client.start_server()
+        return self
+
+
+@pytest.fixture
+def served(tmp_config, stub_sampler):
+    return _Served(), stub_sampler
+
+
+async def _submit(client):
+    async def submit(payload):
+        resp = await client.post("/distributed/queue", json=payload)
+        try:
+            body = await resp.json()
+        except Exception:  # noqa: BLE001
+            body = {}
+        return resp.status, body
+
+    return submit
+
+
+async def _wait_done(controller, prompt_id, timeout_s=30.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        entry = controller.queue.history.get(prompt_id)
+        if entry is not None:
+            return entry
+        await asyncio.sleep(0.01)
+    return {"status": "timeout"}
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def test_64_concurrent_mixed_load_coalesces_and_loses_nothing(served):
+    srv, batches = served
+
+    async def body():
+        await srv.start()
+        try:
+            requests = load_smoke.build_workload(
+                seed=7, n=64, shapes=((16, 2), (24, 2)),
+                tenants=("tenant-a", "tenant-b"),
+                priorities=("interactive", "batch"))
+            submit = await _submit(srv.client)
+            stats = await load_smoke.run_load(
+                submit, requests, concurrency=64,
+                wait_done=lambda pid: _wait_done(srv.controller, pid))
+            return stats
+        finally:
+            await srv.client.close()
+
+    stats = run(body())
+    accepted = stats["admitted"] + stats["queued"]
+    assert stats["submitted"] == 64
+    assert accepted + stats["shed"] == 64
+    # zero loss: every accepted request reached a terminal status
+    assert stats["completed"] + stats["errors"] + stats["expired"] == \
+        accepted
+    assert stats["errors"] == 0
+    # coalescing actually happened: mean executed batch size > 1
+    assert batches, "no microbatched program ever executed"
+    solo_runs = stats["completed"] - sum(batches)
+    mean_batch = stats["completed"] / (len(batches) + max(solo_runs, 0))
+    assert mean_batch > 1.0, (batches, solo_runs)
+    assert max(batches) <= 8
+    # fairness: both tenants completed work
+    for tenant, per in stats["by_tenant"].items():
+        if per["admitted"]:
+            assert per["completed"] > 0, (tenant, stats["by_tenant"])
+
+
+def test_outputs_ride_their_own_seed(served):
+    """Demux safety: under concurrency, each request's history output is
+    the stub image derived from ITS seed — a cross-wired batch would
+    swap them."""
+    srv, _ = served
+
+    async def body():
+        await srv.start()
+        try:
+            submit = await _submit(srv.client)
+            payloads = [
+                {"prompt": load_smoke.prompt_for(seed=s, text=f"t{s}",
+                                                 wh=16, steps=2),
+                 "tenant": "t"}
+                for s in (101, 202, 303, 404)
+            ]
+            results = await asyncio.gather(*(submit(p) for p in payloads))
+            ids = [body["prompt_id"] for status, body in results
+                   if status == 200]
+            assert len(ids) == 4
+            entries = [await _wait_done(srv.controller, pid)
+                       for pid in ids]
+            return ids, entries
+        finally:
+            await srv.client.close()
+
+    ids, entries = run(body())
+    for seed, entry in zip((101, 202, 303, 404), entries):
+        assert entry["status"] == "success"
+        (out,) = [v for v in entry["outputs"].values()]
+        img = np.asarray(out[0])
+        assert img.shape == (1, 16, 16, 3)
+        assert float(img[0, 0, 0, 0]) == pytest.approx((seed % 997) / 997.0)
+
+
+@pytest.mark.chaos
+def test_overload_sheds_deterministic_429s_and_keeps_depth_bounded(served):
+    """4× capacity: with the shed threshold pinned low and execution
+    slowed, the surplus must get 429 + Retry-After (not hangs, not
+    errors), the queue depth must stay under the threshold, and every
+    admitted request must still complete."""
+    srv, _ = served
+
+    async def body():
+        await srv.start()
+        try:
+            srv.controller.frontdoor.admission.soft_depth = 4
+            srv.controller.frontdoor.admission.shed_depth = 8
+            requests = load_smoke.build_workload(
+                seed=11, n=32, shapes=((16, 2),),
+                tenants=("tenant-a", "tenant-b"))
+            submit = await _submit(srv.client)
+            depths = []
+
+            async def probe_depth():
+                while True:
+                    depths.append(srv.controller.frontdoor.depth())
+                    await asyncio.sleep(0.01)
+
+            probe = asyncio.ensure_future(probe_depth())
+            try:
+                stats = await load_smoke.run_load(
+                    submit, requests, concurrency=32,
+                    wait_done=lambda pid: _wait_done(srv.controller, pid))
+            finally:
+                probe.cancel()
+            return stats, depths
+        finally:
+            await srv.client.close()
+
+    stats, depths = run(body())
+    accepted = stats["admitted"] + stats["queued"]
+    assert stats["shed"] > 0, "overload never shed"
+    # shed responses carried a usable Retry-After
+    assert stats["shed_retry_after"]
+    assert all(r >= 1 for r in stats["shed_retry_after"])
+    # bounded depth: never above the shed threshold plus the in-flight job
+    assert max(depths) <= 8 + 1, max(depths)
+    # zero admitted-job loss, no hangs
+    assert stats["completed"] + stats["errors"] + stats["expired"] == \
+        accepted
+    assert stats["errors"] == 0
+    # fairness under overload: both tenants landed completions
+    completions = {t: per["completed"]
+                   for t, per in stats["by_tenant"].items()}
+    assert all(v > 0 for v in completions.values()), completions
+
+
+def test_deadline_expires_in_queue(served):
+    srv, _ = served
+
+    async def body():
+        await srv.start()
+        try:
+            submit = await _submit(srv.client)
+            # a wave to occupy the queue, then a 1 ms-deadline straggler
+            wave = [{"prompt": load_smoke.prompt_for(seed=i, text=f"w{i}",
+                                                     wh=16, steps=2)}
+                    for i in range(6)]
+            await asyncio.gather(*(submit(p) for p in wave))
+            status, body_ = await submit(
+                {"prompt": load_smoke.prompt_for(seed=99, text="late",
+                                                 wh=16, steps=2),
+                 "deadline_ms": 1})
+            assert status == 200
+            entry = await _wait_done(srv.controller, body_["prompt_id"])
+            return entry
+        finally:
+            await srv.client.close()
+
+    entry = run(body())
+    assert entry["status"] == "expired"
