@@ -1353,6 +1353,210 @@ def _serving_offered_load(n: int = 16, concurrency: int = 16) -> dict:
     }
 
 
+def run_elastic_benchmark(steps: int, runs: int | None,
+                          force_cpu: bool) -> dict:
+    """Elastic scale event A/B (ISSUE 10, docs/elasticity.md): a mixed
+    two-job tile load driven over the real HTTP control plane — real
+    pull/submit wire traffic, real drain route — run (a) with a static
+    2-worker fleet and (b) with a fleet that scales up one worker mid-run
+    (the steal scheduler hands it pending tiles; its arrival→first-result
+    latency is the ``steal_pickup_s`` number) and gracefully drains
+    another mid-run. Per-tile compute is one jitted matmul chain keyed on
+    the GLOBAL tile index, so both runs must be bit-identical — the
+    zero-loss check is part of the bench, not a separate test."""
+    import asyncio
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    if force_cpu:
+        jax.config.update("jax_platforms", "cpu")
+    _enable_compile_cache()
+    platform = jax.devices()[0].platform
+    on_accel = platform not in ("cpu",)
+
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from comfyui_distributed_tpu.api.app import create_app
+    from comfyui_distributed_tpu.cluster.controller import Controller
+    from comfyui_distributed_tpu.cluster.job_store import JobStore
+    from comfyui_distributed_tpu.cluster.tile_farm import (TileFarm,
+                                                           assemble_tiles)
+
+    os.environ.setdefault("CDT_CONFIG_PATH",
+                          os.path.join(tempfile.mkdtemp(prefix="cdt_bench_"),
+                                       "config.json"))
+    inner_steps = max(2, min(int(steps), 8))
+
+    @jax.jit
+    def _tile_program(x):
+        for _ in range(inner_steps):
+            x = jnp.tanh(x @ x) + 0.1
+        return x
+
+    dim = 128 if on_accel else 32
+
+    def make_proc(marker: float):
+        def proc(start, end):
+            out = []
+            for i in range(start, end):
+                x = jnp.full((dim, dim), 0.01 * (i + 1) + marker,
+                             jnp.float32)
+                out.append(np.asarray(jax.block_until_ready(
+                    _tile_program(x))))
+            return np.stack(out)
+        return proc
+
+    totals = {"sdxl": 24, "usdu": 16}
+    procs = {"sdxl": make_proc(0.0), "usdu": make_proc(0.5)}
+    # warm the program once so neither leg pays the compile
+    jax.block_until_ready(_tile_program(jnp.zeros((dim, dim))))
+    # pace each tile so the run is long enough for mid-run events to
+    # land while work is pending (a real tile is a multi-second SPMD
+    # program; this bench measures the CONTROL PLANE around it)
+    pace_s = 0.05
+
+    def paced(fn):
+        def proc(start, end):
+            time.sleep(pace_s * (end - start))
+            return fn(start, end)
+        return proc
+
+    paced_procs = {jid: paced(fn) for jid, fn in procs.items()}
+
+    def resolver_for(tag: str):
+        """Steal grants carry the full job id ("{tag}-{kind}"); map it
+        back to the kind's process_fn."""
+        def resolve(job_id: str):
+            prefix = f"{tag}-"
+            if not job_id.startswith(prefix):
+                return None
+            return paced_procs.get(job_id[len(prefix):])
+        return resolve
+
+    async def drive(elastic: bool, tag: str) -> dict:
+        # the lifecycle registry is process-global (like the breakers):
+        # a drain from the previous leg must not carry into this one
+        from comfyui_distributed_tpu.cluster.elastic.states import DRAIN
+
+        DRAIN.reset()
+        controller = Controller()
+        client = TestClient(TestServer(create_app(controller)))
+        await client.start_server()
+        t0 = time.monotonic()
+        pickup = {}
+        try:
+            base = f"http://127.0.0.1:{client.port}"
+            loop = asyncio.get_running_loop()
+
+            def steal_worker(wid, resolve=None):
+                farm = TileFarm(JobStore(), loop)
+                return farm.worker_steal_run_async(
+                    wid, base, resolve or resolver_for(tag),
+                    idle_polls=3, idle_interval=0.1)
+
+            masters = [asyncio.create_task(
+                controller.tile_farm.master_run_async(
+                    f"{tag}-{jid}", total=total,
+                    process_fn=paced_procs[jid], chunk=1,
+                    heartbeat_interval=0.5, worker_timeout=30.0))
+                for jid, total in totals.items()]
+            await asyncio.sleep(0.05)
+            workers = {w: asyncio.create_task(steal_worker(w))
+                       for w in ("w0", "w1")}
+            if elastic:
+                await asyncio.sleep(0.3)
+                # mid-run arrival: w2 steals from the open jobs; pickup
+                # latency = arrival → its FIRST processed grant
+                arrived = time.monotonic()
+                first_grant: dict = {}
+
+                base_resolve = resolver_for(tag)
+
+                def recording_resolve(jid):
+                    fn = base_resolve(jid)
+                    if fn is None:
+                        return None
+
+                    def wrapped(start, end):
+                        first_grant.setdefault("t", time.monotonic())
+                        return fn(start, end)
+                    return wrapped
+
+                workers["w2"] = asyncio.create_task(
+                    steal_worker("w2", recording_resolve))
+                # mid-run graceful departure: drain w1
+                async with client.session.post(
+                        f"{base}/distributed/worker/w1/drain",
+                        json={"deadline_s": 0.5,
+                              "stop_process": False}) as r:
+                    assert r.status == 200, await r.text()
+            results = await asyncio.gather(*masters)
+            done = await asyncio.gather(*workers.values())
+            if elastic:
+                done_by = dict(zip(workers, done))
+                if first_grant.get("t"):
+                    pickup["steal_pickup_s"] = round(
+                        first_grant["t"] - arrived, 3)
+                pickup["scaleup_tasks"] = sum(done_by["w2"].values())
+            out = {}
+            for (jid, total), res in zip(totals.items(), results):
+                out[jid] = assemble_tiles(res, total, 1)
+            status = {jid: await controller.store.job_status(f"{tag}-{jid}")
+                      for jid in totals}
+            dead = sum(len(s.get("dead_letter") or [])
+                       for s in status.values())
+            return {"wall_s": time.monotonic() - t0, "outputs": out,
+                    "dead_letters": dead, **pickup}
+        finally:
+            await client.close()
+
+    def one_rep(i: int) -> dict:
+        async def body():
+            static = await drive(elastic=False, tag=f"st{i}")
+            elastic = await drive(elastic=True, tag=f"el{i}")
+            identical = all(
+                np.array_equal(static["outputs"][j], elastic["outputs"][j])
+                for j in totals)
+            return {
+                "static_wall_s": round(static["wall_s"], 3),
+                "elastic_wall_s": round(elastic["wall_s"], 3),
+                "bit_identical": identical,
+                "dead_letters": static["dead_letters"]
+                + elastic["dead_letters"],
+                "steal_pickup_s": elastic.get("steal_pickup_s"),
+                "scaleup_tasks": elastic.get("scaleup_tasks", 0),
+            }
+        return asyncio.run(body())
+
+    reps = runs or 2
+    rep_results = [one_rep(i) for i in range(reps)]
+    overheads = sorted(r["elastic_wall_s"] / r["static_wall_s"]
+                       for r in rep_results)
+    median = overheads[len(overheads) // 2]
+    pickups = [r["steal_pickup_s"] for r in rep_results
+               if r.get("steal_pickup_s") is not None]
+
+    return {
+        "metric": ("elastic_scale_event_overhead" if on_accel
+                   else "elastic_scale_event_overhead_cpu"),
+        "value": round(median, 4),
+        "unit": "x (scale-event wall / static-fleet wall, same work)",
+        "vs_baseline": 1.0,
+        "vs_baseline_note": "no published elastic baseline",
+        "platform": platform,
+        "device_kind": getattr(jax.devices()[0], "device_kind", platform),
+        "devices": len(jax.devices()),
+        "steps": inner_steps,
+        "jobs": totals,
+        "reps": rep_results,
+        "steal_pickup_s_best": min(pickups) if pickups else None,
+        "all_bit_identical": all(r["bit_identical"] for r in rep_results),
+        "total_dead_letters": sum(r["dead_letters"] for r in rep_results),
+    }
+
+
 _WORKLOADS = {
     "txt2img": run_benchmark,
     "usdu": run_usdu_benchmark,
@@ -1362,6 +1566,7 @@ _WORKLOADS = {
     "wan22": run_wan22_benchmark,
     "attn": run_attn_benchmark,
     "serving": run_serving_benchmark,
+    "elastic": run_elastic_benchmark,
 }
 
 
@@ -1573,7 +1778,8 @@ def main() -> None:
     parser.add_argument("--runs", type=int, default=None)
     parser.add_argument("--workload",
                         choices=["txt2img", "usdu", "flux", "wan",
-                                 "wan14b", "wan22", "attn", "serving"],
+                                 "wan14b", "wan22", "attn", "serving",
+                                 "elastic"],
                         default="txt2img",
                         help="txt2img (SDXL images/sec), usdu (4K upscale "
                              "wall-clock), flux (flow images/sec), wan "
@@ -1583,7 +1789,9 @@ def main() -> None:
                              "wan), attn (per-geometry attention A/B "
                              "from the tuning table), serving (front-door "
                              "microbatch vs sequential + offered-load "
-                             "latency, docs/serving.md)")
+                             "latency, docs/serving.md), elastic "
+                             "(scale-event overhead + steal pickup "
+                             "latency, docs/elasticity.md)")
     parser.add_argument("--inner", action="store_true",
                         help="(internal) run the measurement in-process")
     cli = parser.parse_args()

@@ -53,14 +53,41 @@ def register(router, controller) -> None:
 
     async def request_image(request):
         """Pull-based assignment for both modes
-        (reference ``api/usdu_routes.py:168-215``)."""
+        (reference ``api/usdu_routes.py:168-215``).
+
+        ``job_id="*"`` is the cross-job steal pull (cluster/elastic/
+        scheduler): the grant may come from ANY open tile job and
+        carries its ``job_id``. A draining worker (cluster/elastic/
+        states) is answered ``{"task": null, "draining": true}`` without
+        touching any queue — it must stop pulling and flush, and the
+        refusal is intentional, not an empty queue."""
+        from ..cluster.elastic.states import DRAIN
+        from ..telemetry import enabled as _tm_enabled, metrics as _tm
+
         body = await _json(request)
         require_fields(body, "job_id", "worker_id")
-        task = await store.request_work(
-            body["job_id"], validate_worker_id(body["worker_id"]))
+        worker_id = validate_worker_id(body["worker_id"])
+        if DRAIN.is_leaving(worker_id):
+            debug_log(f"tile-farm: refusing work to draining worker "
+                      f"{worker_id}")
+            return web.json_response({"task": None, "draining": True})
+        if body["job_id"] == "*":
+            exclude = body.get("exclude_jobs") or []
+            if (not isinstance(exclude, list)
+                    or len(exclude) > 256
+                    or not all(isinstance(j, str) for j in exclude)):
+                raise ValidationError(
+                    "'exclude_jobs' must be a list of ≤256 job id strings")
+            task = await store.request_any_work(worker_id, exclude=exclude)
+        else:
+            task = await store.request_work(body["job_id"], worker_id)
         if task is not None:
-            debug_log(f"tile-farm[{body['job_id']}] assigned task "
-                      f"{task.get('task_id')} to {body['worker_id']}")
+            if _tm_enabled():
+                _tm.STEAL_ASSIGNMENTS.labels(
+                    kind="stolen" if body["job_id"] == "*"
+                    else "own_job").inc()
+            debug_log(f"tile-farm[{task.get('job_id', body['job_id'])}] "
+                      f"assigned task {task.get('task_id')} to {worker_id}")
         return web.json_response({"task": task})
 
     async def submit_tiles(request):
@@ -163,6 +190,18 @@ def register(router, controller) -> None:
             body["job_id"], validate_worker_id(body["worker_id"]), task_id, payload)
         return web.json_response({"status": "ok", "accepted": int(ok)})
 
+    async def handback(request):
+        """A worker returns work it cannot (or may no longer) serve —
+        an unservable steal grant, or a self-initiated drain flush. The
+        requeue is intentional-departure accounting: no poison-bound
+        count, no breaker evidence (cluster/elastic, docs/elasticity.md)."""
+        body = await _json(request)
+        require_fields(body, "job_id", "worker_id")
+        requeued = await store.requeue_worker_tasks(
+            body["job_id"], validate_worker_id(body["worker_id"]),
+            count_requeue=False)
+        return web.json_response({"status": "ok", "requeued": requeued})
+
     async def job_status(request):
         job_id = request.query.get("job_id", "")
         if not job_id:
@@ -178,5 +217,6 @@ def register(router, controller) -> None:
     router.add_post("/distributed/request_image", request_image)
     router.add_post("/distributed/submit_tiles", submit_tiles)
     router.add_post("/distributed/submit_image", submit_image)
+    router.add_post("/distributed/handback", handback)
     router.add_get("/distributed/job_status", job_status)
     router.add_get("/distributed/queue_status/{job_id}", queue_status)

@@ -91,6 +91,7 @@ def register(router, controller) -> None:
         # (cluster/dispatch.py select_active_hosts)
         sem = asyncio.Semaphore(constants.WORKER_PROBE_CONCURRENCY)
 
+        from ..cluster.elastic.states import DRAIN
         from ..cluster.resilience import BREAKERS
 
         async def status_one(wid: str) -> tuple[str, dict]:
@@ -103,6 +104,9 @@ def register(router, controller) -> None:
                 # circuit-breaker verdict (cluster/resilience.py): the
                 # dashboard badges quarantined hosts without probing them
                 "breaker": BREAKERS.state(wid),
+                # lifecycle state (cluster/elastic): draining workers are
+                # leaving on purpose — badge them distinctly from broken
+                "drain": DRAIN.state(wid),
                 # AOT warmup state (diffusion/warmup.py): the dashboard
                 # badges workers still compiling their catalog
                 "warmup": None,
@@ -205,8 +209,53 @@ def register(router, controller) -> None:
     async def warmup_status(request):
         return web.json_response(controller.warmup.status())
 
+    # --- elastic fleet (cluster/elastic, docs/elasticity.md) ---------------
+
+    def _elastic():
+        el = getattr(controller, "elastic", None)
+        if el is None:
+            raise ValidationError("elastic manager not started")
+        return el
+
+    async def drain_worker(request):
+        """Begin a graceful drain: the worker stops receiving new
+        dispatch/tile work immediately, in-flight work finishes or is
+        handed back at the deadline, then the worker is decommissioned.
+        Intentional departure — never breaker evidence. Body (optional):
+        ``{"deadline_s": float, "stop_process": bool}``."""
+        wid = validate_worker_id(request.match_info["worker_id"])
+        body = {}
+        if request.can_read_body:
+            body = await _json(request)
+        deadline_s = body.get("deadline_s")
+        if deadline_s is not None:
+            try:
+                deadline_s = float(deadline_s)
+            except (TypeError, ValueError):
+                raise ValidationError("'deadline_s' must be a number")
+            if deadline_s <= 0:
+                raise ValidationError("'deadline_s' must be positive")
+        report = _elastic().coordinator.begin(
+            wid, deadline_s=deadline_s,
+            stop_process=bool(body.get("stop_process", True)))
+        return web.json_response({"status": "draining", **report})
+
+    async def undrain_worker(request):
+        """Cancel a drain / reactivate a departed worker id."""
+        wid = validate_worker_id(request.match_info["worker_id"])
+        cleared = _elastic().coordinator.undrain(wid)
+        return web.json_response({"status": "active", "cleared": cleared})
+
+    async def elastic_status(request):
+        """Autoscaler signals/decisions + drain states (dashboard +
+        operator probe)."""
+        return web.json_response(_elastic().status())
+
     router.add_post("/distributed/warmup", warmup_start)
     router.add_get("/distributed/warmup", warmup_status)
+    router.add_post("/distributed/worker/{worker_id}/drain", drain_worker)
+    router.add_post("/distributed/worker/{worker_id}/undrain", undrain_worker)
+    router.add_get("/distributed/elastic", elastic_status)
     router.add_post("/distributed/launch_worker", launch_worker)
     router.add_post("/distributed/stop_worker", stop_worker)
     router.add_get("/distributed/managed_workers", managed_workers)

@@ -73,6 +73,11 @@ class Controller:
         self.warmup = WarmupManager(lambda: self.model_registry,
                                     lambda: self.mesh)
         self._warmup_task = None
+        # elastic fleet (cluster/elastic): drain coordination always;
+        # the autoscaler loop only under CDT_AUTOSCALE=1. Built at
+        # startup — the drain coordinator schedules asyncio tasks and
+        # needs the serving loop.
+        self.elastic = None
 
     def load_config(self) -> dict:
         return load_config(self.config_path)
@@ -139,6 +144,10 @@ class Controller:
         self.queue.start()
         if self.frontdoor is not None:
             self.frontdoor.start()
+        from .elastic import build_elastic
+
+        self.elastic = build_elastic(self)
+        self.elastic.start()
         role = "worker" if self.is_worker else "master"
         log(f"controller up as {role} (machine {machine_id()})")
         if self.is_worker and self.worker_id:
@@ -178,6 +187,8 @@ class Controller:
     async def shutdown(self) -> None:
         from ..utils.network import close_client_session
 
+        if self.elastic is not None:
+            await self.elastic.stop()
         if self.frontdoor is not None:
             await self.frontdoor.stop()
         await self.queue.stop()

@@ -43,13 +43,23 @@ async def select_active_hosts(
     a flapping worker costs one gauge read per job instead of a
     PROBE_TIMEOUT stall; after the recovery window one half-open trial
     probe decides re-admission. Probe outcomes feed the breakers.
+
+    Drain gate (checked FIRST): a host that is draining/decommissioned
+    (``cluster/elastic/states``) is *intentionally* unavailable — skipped
+    without probing (``_drain`` marks the dict, ``outcome="draining"`` in
+    telemetry) and, critically, without feeding its breaker: an asked-to-
+    leave worker must never accumulate failure evidence on the way out.
     """
+    from .elastic.states import DRAIN
+
     sem = asyncio.Semaphore(probe_concurrency or constants.WORKER_PROBE_CONCURRENCY)
 
-    async def probe_one(host: dict) -> tuple[dict, Optional[dict], bool]:
+    async def probe_one(host: dict) -> "tuple[dict, Optional[dict], str]":
         wid = str(host.get("id"))
+        if DRAIN.is_leaving(wid):
+            return host, None, "draining"       # leaving, not broken
         if not BREAKERS.allow(wid):
-            return host, None, True             # quarantined, not probed
+            return host, None, "quarantined"    # quarantined, not probed
         health = None
         try:
             async with sem:
@@ -67,15 +77,18 @@ async def select_active_hosts(
             # kill the whole fan-out; it just counts as offline
             debug_log(f"probe {wid} raised unexpectedly: {e!r}")
         BREAKERS.record(wid, health is not None)
-        return host, health, False
+        return host, health, ""
 
     results = await asyncio.gather(*(probe_one(h) for h in hosts))
     online, offline = [], []
-    quarantined = 0
+    quarantined = draining = 0
     for host, health, skipped in results:
-        if skipped:
+        if skipped == "quarantined":
             quarantined += 1
             offline.append({**host, "_breaker": "open"})
+        elif skipped == "draining":
+            draining += 1
+            offline.append({**host, "_drain": DRAIN.state(str(host.get("id")))})
         elif health is None:
             offline.append(host)
         else:
@@ -83,12 +96,15 @@ async def select_active_hosts(
     if telemetry.enabled() and results:
         _tm.WORKER_PROBES.labels(outcome="online").inc(len(online))
         _tm.WORKER_PROBES.labels(outcome="offline").inc(
-            len(offline) - quarantined)
+            len(offline) - quarantined - draining)
         if quarantined:
             _tm.WORKER_PROBES.labels(outcome="quarantined").inc(quarantined)
+        if draining:
+            _tm.WORKER_PROBES.labels(outcome="draining").inc(draining)
     trace_info(trace_id, f"probe: {len(online)} online, "
-                         f"{len(offline) - quarantined} offline, "
-                         f"{quarantined} quarantined (breaker open)")
+                         f"{len(offline) - quarantined - draining} offline, "
+                         f"{quarantined} quarantined (breaker open), "
+                         f"{draining} draining")
     return online, offline
 
 

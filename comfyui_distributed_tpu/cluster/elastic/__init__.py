@@ -1,0 +1,171 @@
+"""Elastic fleet subsystem (ROADMAP item 5, docs/elasticity.md).
+
+PR 3's resilience layer handles workers *dying*; this package handles
+workers *arriving and leaving on purpose* — the other half of running a
+fleet that serves real traffic:
+
+- :mod:`states` — the master-side lifecycle registry
+  (active → draining → decommissioned) every failure-evidence site
+  consults, so an intentional departure is never mistaken for a fault;
+- :mod:`drain` — graceful drain/decommission: stop new work, let
+  in-flight work finish or hand it back cleanly at a deadline, then
+  stop the process;
+- :mod:`autoscaler` — the telemetry-driven policy loop that sizes the
+  fleet to offered work, with hysteresis, cooldowns, a min/max
+  envelope, and a pluggable capacity provider (local processes in-repo,
+  remote/tunnel via ``CDT_SCALE_PROVIDER``);
+- :mod:`scheduler` — the deterministic cross-job steal policy behind
+  ``JobStore.request_any_work`` (mixed workloads keep every chip busy;
+  a scale-up worker immediately picks up pending work from *any* open
+  job).
+
+The :class:`ElasticManager` binds the pieces to one controller and is
+what ``GET /distributed/elastic`` and the drain routes talk to.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import importlib
+import os
+from typing import Optional
+
+from ...utils.logging import log
+from .autoscaler import (AutoscalePolicy, Autoscaler, FleetSignals,
+                         LocalProcessProvider, ScaleProvider)
+from .drain import DrainCoordinator
+from .scheduler import JobView, StealPolicy
+from .states import ACTIVE, DECOMMISSIONED, DRAIN, DRAINING, DrainRegistry
+
+__all__ = [
+    "ACTIVE", "DRAINING", "DECOMMISSIONED", "DRAIN", "DrainRegistry",
+    "DrainCoordinator", "Autoscaler", "AutoscalePolicy", "FleetSignals",
+    "ScaleProvider", "LocalProcessProvider", "StealPolicy", "JobView",
+    "ElasticManager", "build_elastic", "autoscale_enabled",
+]
+
+
+def autoscale_enabled() -> bool:
+    return os.environ.get("CDT_AUTOSCALE", "") not in ("", "0", "false")
+
+
+def _step_time_p50() -> "float | None":
+    """Median sampler step time from the ``cdt_sampler_step_seconds``
+    histogram (all pipelines merged) — the latency context the
+    autoscaler reports alongside the depth pressure. None until the
+    first sampled program runs (or telemetry is off)."""
+    from ... import telemetry
+    from ...telemetry.registry import REGISTRY
+
+    if not telemetry.enabled():
+        return None
+    fam = REGISTRY.snapshot().get("cdt_sampler_step_seconds")
+    series = (fam or {}).get("series") or []
+    total = sum(s.get("count", 0) for s in series)
+    if not total:
+        return None
+    # merge the per-pipeline cumulative buckets (bounds are shared)
+    merged: dict[float, int] = {}
+    for s in series:
+        for le, cum in s.get("buckets", []):
+            merged[le] = merged.get(le, 0) + cum
+    target = total / 2
+    for le in sorted(merged):
+        if merged[le] >= target:
+            return le
+    return None
+
+
+def _load_provider_factory():
+    """``CDT_SCALE_PROVIDER="pkg.mod:factory"`` → callable(controller)
+    building a custom :class:`ScaleProvider` (remote/tunnel capacity).
+    A broken spec logs and falls back to the local provider — an env
+    typo must not take autoscaling down with it."""
+    spec = os.environ.get("CDT_SCALE_PROVIDER", "")
+    if not spec:
+        return None
+    try:
+        mod_name, _, attr = spec.partition(":")
+        mod = importlib.import_module(mod_name)
+        return getattr(mod, attr or "build_provider")
+    except Exception as e:  # noqa: BLE001 — fall back, loudly
+        log(f"elastic: bad CDT_SCALE_PROVIDER={spec!r} ({e}); "
+            "using the local process provider")
+        return None
+
+
+class ElasticManager:
+    """One controller's elasticity surface: drain coordination always,
+    the autoscaler loop when ``CDT_AUTOSCALE=1``."""
+
+    def __init__(self, controller):
+        from ...workers.process_manager import get_worker_manager
+
+        self.controller = controller
+        self.registry = DRAIN
+        manager = get_worker_manager(controller.config_path)
+        self.coordinator = DrainCoordinator(
+            controller.store,
+            process_stopper=manager.stop_worker)
+        factory = _load_provider_factory()
+        if factory is not None:
+            self.provider: ScaleProvider = factory(controller)
+        else:
+            self.provider = LocalProcessProvider(
+                controller.load_config, manager, self.coordinator)
+        self.autoscaler = Autoscaler(self._signals, self.provider)
+        self._task: Optional[asyncio.Task] = None
+
+    # --- signals ------------------------------------------------------------
+
+    def _signals(self) -> FleetSignals:
+        c = self.controller
+        fd = getattr(c, "frontdoor", None)
+        queue_depth = fd.depth() if fd is not None else c.queue.queue_remaining
+        # racy unlocked read of list lengths — fine for a gauge-grade
+        # signal (the policy's hysteresis absorbs one stale tick)
+        tile_depth = sum(len(j.pending)
+                         for j in c.store.tile_jobs.values())
+        workers = self.provider.list_workers()
+        active = sum(1 for w in workers.values()
+                     if w.get("running") and w.get("state") == ACTIVE)
+        draining = sum(1 for w in workers.values()
+                       if w.get("state") == DRAINING)
+        decommissioned = sum(1 for w in workers.values()
+                             if w.get("state") == DECOMMISSIONED)
+        return FleetSignals(queue_depth=queue_depth, tile_depth=tile_depth,
+                            step_time_p50=_step_time_p50(),
+                            active_workers=active,
+                            draining_workers=draining,
+                            decommissioned_workers=decommissioned)
+
+    # --- lifecycle ----------------------------------------------------------
+
+    def start(self) -> None:
+        if autoscale_enabled() and (
+                self._task is None or self._task.done()):
+            log("elastic: autoscaler loop up (CDT_AUTOSCALE=1)")
+            self._task = asyncio.ensure_future(self.autoscaler.run())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+            self._task = None
+        await self.coordinator.close()
+
+    # --- status -------------------------------------------------------------
+
+    def status(self) -> dict:
+        return {
+            "autoscale_enabled": autoscale_enabled(),
+            "autoscaler": self.autoscaler.status(),
+            "drain": self.coordinator.status(),
+        }
+
+
+def build_elastic(controller) -> ElasticManager:
+    return ElasticManager(controller)

@@ -1,0 +1,295 @@
+"""Telemetry-driven autoscaling: size the fleet to offered concurrency.
+
+"Exploring the limits of Concurrency in ML Training on Google TPUs"
+(PAPERS.md) frames fleet sizing as matching parallel capacity to offered
+work rather than provisioning a static pool; the serving analogue here
+drives a policy loop off the signals the repo already exports — the
+front door's depth (``cdt_fd_queue_depth``'s underlying quantity), the
+cross-job tile backlog (``cdt_tile_queue_depth``'s), and the sampler
+step-time — and turns them into scale-up / scale-down decisions.
+
+Decisions are deliberately boring:
+
+- **pressure** = (prompt depth + tile backlog) / serving capacity
+  (active workers + the master itself);
+- **hysteresis**: pressure must hold above ``scale_up_depth`` (below
+  ``scale_down_depth``) for N consecutive evaluations before anything
+  happens — one bursty poll must not flap the fleet;
+- **cooldowns**: independent up/down refractory windows, because adding
+  capacity should be fast and removing it should be reluctant;
+- **envelope**: a ``[min_workers, max_workers]`` clamp the policy can
+  never leave, whatever the signals say.
+
+Execution goes through a :class:`ScaleProvider`: the in-repo
+:class:`LocalProcessProvider` launches/drains managed local processes
+(``workers/process_manager.py``); remote/tunnel capacity (the source
+paper's cloud-presets model) plugs in via ``CDT_SCALE_PROVIDER`` with a
+``module:factory`` path. Scale-down is NEVER a kill: it begins a
+graceful drain (:mod:`.drain`), so in-flight work finishes or hands back
+and the breaker layer sees an intentional departure.
+
+Every verdict — including holds — is itself telemetry:
+``cdt_autoscale_decisions_total{direction,reason}``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import time
+from typing import Callable, Optional, Protocol
+
+from ...telemetry import enabled as _tm_enabled, metrics as _tm
+from ...utils import constants
+from ...utils.logging import debug_log, log
+from .states import DRAIN, DrainRegistry
+
+
+@dataclasses.dataclass(frozen=True)
+class FleetSignals:
+    """One evaluation tick's inputs (all instantaneous reads)."""
+
+    queue_depth: int            # prompts queued/executing (+ coalescing)
+    tile_depth: int             # pending tile tasks across open jobs
+    step_time_p50: Optional[float] = None   # informational, for reports
+    active_workers: int = 0
+    draining_workers: int = 0
+    decommissioned_workers: int = 0
+
+    @property
+    def work(self) -> int:
+        return self.queue_depth + self.tile_depth
+
+
+@dataclasses.dataclass(frozen=True)
+class AutoscalePolicy:
+    min_workers: int = 0
+    max_workers: int = 4
+    scale_up_depth: float = 4.0     # work per capacity unit → add a worker
+    scale_down_depth: float = 0.5   # work per capacity unit → drain one
+    up_streak: int = 2              # consecutive ticks before acting
+    down_streak: int = 4
+    up_cooldown_s: float = 30.0
+    down_cooldown_s: float = 120.0
+
+    @classmethod
+    def from_env(cls) -> "AutoscalePolicy":
+        return cls(
+            min_workers=constants.AUTOSCALE_MIN,
+            max_workers=constants.AUTOSCALE_MAX,
+            scale_up_depth=constants.AUTOSCALE_UP_DEPTH,
+            scale_down_depth=constants.AUTOSCALE_DOWN_DEPTH,
+            up_streak=constants.AUTOSCALE_UP_STREAK,
+            down_streak=constants.AUTOSCALE_DOWN_STREAK,
+            up_cooldown_s=constants.AUTOSCALE_UP_COOLDOWN_S,
+            down_cooldown_s=constants.AUTOSCALE_DOWN_COOLDOWN_S,
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class Decision:
+    direction: str              # up | down | hold
+    reason: str
+    worker_id: Optional[str] = None
+    pressure: float = 0.0
+
+
+class ScaleProvider(Protocol):
+    """What the policy loop needs from a capacity backend."""
+
+    def list_workers(self) -> dict[str, dict]:
+        """worker_id → {"state": lifecycle state, "running": bool}."""
+        ...
+
+    def scale_up(self) -> Optional[str]:
+        """Bring one worker up; returns its id (None = no capacity)."""
+        ...
+
+    def scale_down(self, worker_id: str) -> None:
+        """Begin a GRACEFUL departure (drain, never kill)."""
+        ...
+
+
+class LocalProcessProvider:
+    """Managed local worker processes as the capacity pool.
+
+    Scale-up launches the first enabled, configured, not-running local
+    host (``workers/process_manager.py``); scale-down hands the chosen
+    worker to the drain coordinator. The config's host list *is* the
+    envelope of launchable capacity — remote providers replace this
+    class, not the policy loop.
+    """
+
+    def __init__(self, config_loader, manager, coordinator,
+                 registry: DrainRegistry = DRAIN):
+        self.load_config = config_loader
+        self.manager = manager
+        self.coordinator = coordinator
+        self.registry = registry
+
+    def _local_hosts(self) -> list[dict]:
+        return [h for h in self.load_config().get("hosts", [])
+                if h.get("type") == "local" and h.get("enabled", True)
+                and h.get("id")]
+
+    def list_workers(self) -> dict[str, dict]:
+        managed = self.manager.get_managed_workers()
+        out: dict[str, dict] = {}
+        for h in self._local_hosts():
+            wid = str(h["id"])
+            out[wid] = {"state": self.registry.state(wid),
+                        "running": wid in managed}
+        for wid in managed:
+            out.setdefault(wid, {"state": self.registry.state(wid),
+                                 "running": True})
+        return out
+
+    def scale_up(self) -> Optional[str]:
+        managed = self.manager.get_managed_workers()
+        for h in self._local_hosts():
+            wid = str(h["id"])
+            if wid in managed:
+                continue
+            # a previously drained id coming back is a fresh worker
+            self.registry.reactivate(wid)
+            try:
+                self.manager.launch_worker(wid)
+            except Exception as e:  # noqa: BLE001 — a single unlaunchable
+                # host must not stop the sweep over the rest of the pool
+                debug_log(f"autoscale: launch {wid} failed: {e}")
+                continue
+            return wid
+        return None
+
+    def scale_down(self, worker_id: str) -> None:
+        self.coordinator.begin(worker_id)
+
+
+class Autoscaler:
+    """The policy loop. ``evaluate()`` is a pure-ish, clock-injected
+    single tick (what the tests drive); ``run()`` is the controller's
+    background task around it."""
+
+    def __init__(self, signals: Callable[[], FleetSignals],
+                 provider: ScaleProvider,
+                 policy: Optional[AutoscalePolicy] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.signals = signals
+        self.provider = provider
+        self.policy = policy or AutoscalePolicy.from_env()
+        self._clock = clock
+        self._up_streak = 0
+        self._down_streak = 0
+        self._last_up = float("-inf")
+        self._last_down = float("-inf")
+        self.decisions: list[Decision] = []   # bounded history (status)
+
+    # --- one tick -----------------------------------------------------------
+
+    def evaluate(self) -> Decision:
+        pol = self.policy
+        sig = self.signals()
+        now = self._clock()
+        # the master always serves, so capacity is never zero — a
+        # 0-worker fleet with deep queues must still read as pressured
+        capacity = max(1, sig.active_workers + 1)
+        pressure = sig.work / capacity
+
+        if pressure >= pol.scale_up_depth:
+            self._up_streak += 1
+            self._down_streak = 0
+        elif pressure <= pol.scale_down_depth:
+            self._down_streak += 1
+            self._up_streak = 0
+        else:
+            self._up_streak = self._down_streak = 0
+
+        decision = self._decide(sig, now, pressure)
+        self._record(decision, sig)
+        return decision
+
+    def _decide(self, sig: FleetSignals, now: float,
+                pressure: float) -> Decision:
+        pol = self.policy
+        if self._up_streak >= pol.up_streak:
+            if sig.active_workers >= pol.max_workers:
+                return Decision("hold", "envelope_max", pressure=pressure)
+            if now - self._last_up < pol.up_cooldown_s:
+                return Decision("hold", "cooldown", pressure=pressure)
+            wid = self.provider.scale_up()
+            if wid is None:
+                return Decision("hold", "no_capacity", pressure=pressure)
+            self._last_up = now
+            self._up_streak = 0
+            log(f"autoscale: scale UP -> {wid} "
+                f"(pressure {pressure:.2f}, work {sig.work})")
+            return Decision("up", "queue_pressure", worker_id=wid,
+                            pressure=pressure)
+        if self._down_streak >= pol.down_streak:
+            if sig.active_workers <= pol.min_workers:
+                return Decision("hold", "envelope_min", pressure=pressure)
+            if now - self._last_down < pol.down_cooldown_s:
+                return Decision("hold", "cooldown", pressure=pressure)
+            wid = self._pick_scale_down()
+            if wid is None:
+                return Decision("hold", "no_candidate", pressure=pressure)
+            self.provider.scale_down(wid)
+            self._last_down = now
+            self._down_streak = 0
+            log(f"autoscale: scale DOWN (drain) -> {wid} "
+                f"(pressure {pressure:.2f})")
+            return Decision("down", "idle_fleet", worker_id=wid,
+                            pressure=pressure)
+        return Decision("hold", "steady", pressure=pressure)
+
+    def _pick_scale_down(self) -> Optional[str]:
+        """Deterministic victim selection: the lexicographically-last
+        running, active worker — stable under replay, and biased away
+        from the long-lived low-numbered workers a config lists first."""
+        workers = self.provider.list_workers()
+        candidates = sorted(
+            wid for wid, info in workers.items()
+            if info.get("running") and info.get("state") == "active")
+        return candidates[-1] if candidates else None
+
+    def _record(self, decision: Decision, sig: FleetSignals) -> None:
+        self.decisions.append(decision)
+        del self.decisions[:-50]
+        if _tm_enabled():
+            _tm.AUTOSCALE_DECISIONS.labels(direction=decision.direction,
+                                           reason=decision.reason).inc()
+            # gauge from the tick's own signal snapshot — no second
+            # provider.list_workers() (each one re-reads the config from
+            # disk on the serving loop in the local provider)
+            _tm.FLEET_SIZE.labels(state="active").set(sig.active_workers)
+            _tm.FLEET_SIZE.labels(state="draining").set(
+                sig.draining_workers)
+            _tm.FLEET_SIZE.labels(state="decommissioned").set(
+                sig.decommissioned_workers)
+
+    # --- background loop ----------------------------------------------------
+
+    async def run(self, interval_s: Optional[float] = None) -> None:
+        interval_s = (constants.AUTOSCALE_INTERVAL_S
+                      if interval_s is None else interval_s)
+        while True:
+            try:
+                self.evaluate()
+            except Exception as e:  # noqa: BLE001 — the loop must survive
+                # a transient signals/provider error (config mid-write,
+                # manager race); the next tick re-reads everything
+                debug_log(f"autoscale tick failed: {e!r}")
+            await asyncio.sleep(interval_s)
+
+    def status(self) -> dict:
+        sig = self.signals()
+        return {
+            "policy": dataclasses.asdict(self.policy),
+            "signals": dataclasses.asdict(sig),
+            "pressure": round(
+                sig.work / max(1, sig.active_workers + 1), 3),
+            "streaks": {"up": self._up_streak, "down": self._down_streak},
+            "recent_decisions": [dataclasses.asdict(d)
+                                 for d in self.decisions[-10:]],
+            "workers": self.provider.list_workers(),
+        }

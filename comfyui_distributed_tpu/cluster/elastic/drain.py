@@ -1,0 +1,176 @@
+"""Graceful drain/decommission: the deliberate way out of the fleet.
+
+``POST /distributed/worker/{id}/drain`` (api/worker_routes.py) lands
+here. The lifecycle:
+
+1. **Mark draining** (:mod:`.states`): from this instant
+   ``select_active_hosts`` skips the host without probing it, the tile
+   scheduler stops granting it work (``/distributed/request_image``
+   answers ``draining: true``), and the front door's healthy-fraction
+   math ignores it.
+2. **Let in-flight work finish**: the coordinator polls the job store
+   until the worker holds no assignments — completed tiles flow back
+   through the normal submit path, so a clean drain loses nothing and
+   requeues nothing.
+3. **Deadline handback**: work still held when the drain deadline
+   expires is returned to the front of its job's queue via
+   ``JobStore.handback_worker_tasks`` — requeued WITHOUT poison-bound
+   accounting and WITHOUT breaker evidence (the worker didn't fail; it
+   was told to go). The heartbeat-eviction path applies the same
+   accounting to a draining worker that goes silent early, and both
+   paths clear assignments under the store lock, so a tile is handed
+   back exactly once.
+4. **Decommission**: the managed process (if any) is stopped and the
+   registry records ``decommissioned``. ``undrain`` at any point before
+   that reactivates the worker (scale-up reusing a drained id does the
+   same).
+
+Every step is observable: ``cdt_worker_drain_state``,
+``cdt_drain_handbacks_total``, and the per-drain report kept for
+``GET /distributed/elastic``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Callable, Optional
+
+from ...utils import constants
+from ...utils.logging import log
+from .states import DRAIN, DrainRegistry
+
+
+class DrainCoordinator:
+    """Runs drains as asyncio tasks on the controller loop; one live
+    drain per worker id (a second request is a no-op reporting the
+    existing drain)."""
+
+    def __init__(self, store, *, registry: DrainRegistry = DRAIN,
+                 process_stopper: Optional[Callable[[str], bool]] = None,
+                 poll_interval: float = 0.25):
+        self.store = store
+        self.registry = registry
+        # stops the local managed process after handback (process
+        # manager hook; None for externally-managed / remote workers)
+        self.process_stopper = process_stopper
+        self.poll_interval = poll_interval
+        self._tasks: dict[str, asyncio.Task] = {}
+        # worker_id → last drain report (kept after completion for the
+        # status surface; bounded by fleet size)
+        self.reports: dict[str, dict] = {}
+
+    # --- public API ---------------------------------------------------------
+
+    def begin(self, worker_id: str,
+              deadline_s: Optional[float] = None,
+              stop_process: bool = True) -> dict:
+        """Start (or report an already-running) drain. Returns the
+        current report snapshot."""
+        wid = str(worker_id)
+        if deadline_s is None:
+            deadline_s = constants.DRAIN_DEADLINE_S
+        live = self._tasks.get(wid)
+        if live is not None and not live.done():
+            return dict(self.reports.get(wid, {"worker_id": wid,
+                                               "phase": "draining"}))
+        if not self.registry.mark_draining(wid, deadline_s=deadline_s):
+            # already draining/decommissioned with no live task (e.g.
+            # marked by a peer path) — report what we know
+            return dict(self.reports.get(
+                wid, {"worker_id": wid, "phase": self.registry.state(wid)}))
+        self.reports[wid] = {
+            "worker_id": wid, "phase": "draining",
+            "deadline_s": deadline_s, "handed_back": {}, "held_at_start": {},
+        }
+        self._tasks[wid] = asyncio.ensure_future(
+            self._drain(wid, deadline_s, stop_process))
+        return dict(self.reports[wid])
+
+    def undrain(self, worker_id: str) -> bool:
+        """Cancel a drain-in-progress and reactivate the worker."""
+        wid = str(worker_id)
+        task = self._tasks.pop(wid, None)
+        if task is not None and not task.done():
+            task.cancel()
+        cleared = self.registry.reactivate(wid)
+        if cleared:
+            self.reports.setdefault(wid, {"worker_id": wid})
+            self.reports[wid]["phase"] = "reactivated"
+        return cleared
+
+    async def wait(self, worker_id: str) -> Optional[dict]:
+        """Await a live drain (tests / synchronous callers)."""
+        task = self._tasks.get(str(worker_id))
+        if task is not None:
+            try:
+                await task
+            except asyncio.CancelledError:
+                pass
+        return self.reports.get(str(worker_id))
+
+    def status(self) -> dict:
+        return {
+            "states": self.registry.states(),
+            "reports": {w: dict(r) for w, r in self.reports.items()},
+        }
+
+    async def close(self) -> None:
+        """Cancel in-flight drains (controller shutdown): the registry
+        keeps its states — a restart resumes from them — but no task may
+        outlive the loop."""
+        for task in list(self._tasks.values()):
+            if not task.done():
+                task.cancel()
+                try:
+                    await task
+                except asyncio.CancelledError:
+                    pass
+        self._tasks.clear()
+
+    # --- the drain itself ---------------------------------------------------
+
+    async def _drain(self, wid: str, deadline_s: float,
+                     stop_process: bool) -> None:
+        report = self.reports[wid]
+        report["held_at_start"] = await self.store.worker_held_tasks(wid)
+        # the registry's deadline (stamped by mark_draining) is the ONE
+        # source of truth — it is what the status surface reports, so
+        # the coordinator must act on the same clock
+        deadline = self.registry.deadline(wid)
+        if deadline is None:
+            deadline = time.monotonic() + deadline_s
+        try:
+            while time.monotonic() < deadline:
+                if self.registry.state(wid) != "draining":
+                    # undrained concurrently — stop quietly
+                    return
+                held = await self.store.worker_held_tasks(wid)
+                if not held:
+                    break
+                await asyncio.sleep(self.poll_interval)
+            # deadline (or clean finish): hand back whatever is left —
+            # no-op when the worker finished everything
+            handed = await self.store.handback_worker_tasks(wid)
+            report["handed_back"] = handed
+            if handed:
+                log(f"drain[{wid}] deadline handback: "
+                    f"{ {j: len(t) for j, t in handed.items()} }")
+            if stop_process and self.process_stopper is not None:
+                try:
+                    report["process_stopped"] = bool(
+                        await asyncio.to_thread(self.process_stopper, wid))
+                except Exception as e:  # noqa: BLE001 — decommission must
+                    # not hang on a process-manager error; the registry
+                    # state is what the fleet acts on
+                    report["process_stop_error"] = str(e)
+            self.registry.mark_decommissioned(wid)
+            report["phase"] = "decommissioned"
+        except asyncio.CancelledError:
+            # undrain() sets phase="reactivated" right after cancelling
+            # this task; the handler runs on a LATER loop tick and must
+            # not overwrite that verdict (shutdown-time cancellation
+            # still records "cancelled")
+            if report.get("phase") == "draining":
+                report["phase"] = "cancelled"
+            raise

@@ -1,0 +1,78 @@
+"""Cross-job work stealing: one master-side scheduler over every open
+tile job.
+
+The tile farm's pull queue was strictly per-job: a worker dispatched into
+job A polls job A until it drains, then leaves — even while job B's queue
+is deep and A's is empty. Under a mixed SDXL/USDU/video load that leaves
+chips idle exactly when the fleet is busiest, and a newly arrived
+(scale-up) worker can only join jobs dispatched *after* it came up.
+
+This module generalizes the pull: a worker may ask for work from *any*
+open job (``job_id="*"`` on ``POST /distributed/request_image``), and the
+:class:`StealPolicy` decides which job's task it gets. The grant carries
+the task's ``job_id`` so results route back to the right queue — tile
+task ranges are defined on global tile indices and per-tile noise keys
+fold the global index (tile_farm.py module docs), so *who* processes a
+range is numerically invisible and stealing can never change output bits.
+
+Determinism: the policy is a pure function of (ordered open-job state,
+worker_id, seed). Jobs are ranked most-starved first — fewest distinct
+workers currently assigned, then most pending work — with ties broken by
+a seeded stable hash of (job seq, worker_id). Same seed + same event
+order ⇒ the same assignment schedule, which is what lets the chaos suite
+replay a scale event bit-for-bit.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import os
+from typing import Optional, Sequence
+
+
+def _stable_tiebreak(seed: int, job_seq: int, worker_id: str) -> int:
+    """Deterministic across processes and Python hash randomization."""
+    digest = hashlib.sha256(
+        f"{seed}:{job_seq}:{worker_id}".encode()).digest()
+    return int.from_bytes(digest[:8], "big")
+
+
+@dataclasses.dataclass(frozen=True)
+class JobView:
+    """The slice of TileJob state the policy ranks on (built under the
+    store lock; the policy itself never touches the store)."""
+
+    job_id: str
+    seq: int                    # creation order, process-unique
+    pending: int                # unassigned tasks
+    active_workers: int         # distinct non-master workers assigned
+
+
+class StealPolicy:
+    """Rank open jobs for a pulling worker; deterministic under a seed.
+
+    Most-starved-first: a job nobody is serving beats a well-staffed one
+    (a fresh scale-up worker lands where it helps most), deeper pending
+    queues beat shallower ones, and the seeded hash settles exact ties
+    without introducing a global round-robin cursor (which would make the
+    schedule depend on unrelated jobs' history).
+    """
+
+    def __init__(self, seed: Optional[int] = None):
+        if seed is None:
+            seed = int(os.environ.get("CDT_STEAL_SEED", "0") or 0)
+        self.seed = seed
+
+    def rank(self, jobs: Sequence[JobView],
+             worker_id: str) -> list[JobView]:
+        candidates = [j for j in jobs if j.pending > 0]
+        return sorted(
+            candidates,
+            key=lambda j: (j.active_workers, -j.pending,
+                           _stable_tiebreak(self.seed, j.seq, worker_id)))
+
+    def pick(self, jobs: Sequence[JobView],
+             worker_id: str) -> Optional[JobView]:
+        ranked = self.rank(jobs, worker_id)
+        return ranked[0] if ranked else None

@@ -1,0 +1,140 @@
+"""Worker lifecycle states for the elastic fleet: leaving ≠ broken.
+
+PR 3's circuit breakers answer "is this worker *failing*?"; this registry
+answers the orthogonal question "is this worker *supposed to be here*?".
+A worker being decommissioned on purpose — autoscaler scale-down, rolling
+restart, operator drain — must be distinguishable from a dead one
+everywhere failure evidence is collected, or every intentional departure
+poisons the fleet's health signals:
+
+- ``select_active_hosts`` would probe it, time out, and feed the failure
+  to its breaker (quarantining a worker that was *asked* to leave);
+- the tile farm would keep assigning it work it is trying to give up;
+- heartbeat eviction would trip its breaker and count its requeues
+  toward the poison-tile bound;
+- the front door's healthy-fraction scaling would shed load for a fleet
+  that is merely *smaller*, not *sicker*.
+
+The registry is process-global on the master (mirroring ``BREAKERS``) and
+thread-safe: asyncio route handlers, the autoscaler loop, and the graph
+executor thread all consult it. States move strictly forward
+(active → draining → decommissioned) except for an explicit
+``reactivate`` — a worker that rejoins (undrain, or a scale-up reusing
+the id) starts clean.
+
+Exported as the ``cdt_worker_drain_state`` gauge (0=active, 1=draining,
+2=decommissioned) and shown on the dashboard next to the breaker badge.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Optional
+
+from ...telemetry import enabled as _tm_enabled, metrics as _tm
+from ...utils.logging import log
+
+ACTIVE, DRAINING, DECOMMISSIONED = "active", "draining", "decommissioned"
+_STATE_VALUE = {ACTIVE: 0, DRAINING: 1, DECOMMISSIONED: 2}
+
+
+class DrainRegistry:
+    """worker_id → lifecycle state (+ drain deadline bookkeeping).
+
+    Unknown workers are ``active`` — the registry only tracks departures,
+    so a fresh fleet costs nothing.
+    """
+
+    def __init__(self, clock: Callable[[], float] = time.monotonic):
+        self._lock = threading.Lock()
+        self._states: dict[str, str] = {}
+        # worker_id → monotonic deadline by which in-flight work must be
+        # finished or handed back (None = no deadline pressure yet)
+        self._deadlines: dict[str, Optional[float]] = {}
+        self._clock = clock
+
+    # --- queries ------------------------------------------------------------
+
+    def state(self, worker_id: str) -> str:
+        with self._lock:
+            return self._states.get(str(worker_id), ACTIVE)
+
+    def is_active(self, worker_id: str) -> bool:
+        return self.state(worker_id) == ACTIVE
+
+    def is_draining(self, worker_id: str) -> bool:
+        return self.state(worker_id) == DRAINING
+
+    def is_leaving(self, worker_id: str) -> bool:
+        """Draining OR decommissioned: every site that must treat the
+        departure as intentional (breakers, healthy-fraction, eviction
+        accounting) checks this, not the individual states."""
+        return self.state(worker_id) != ACTIVE
+
+    def deadline(self, worker_id: str) -> Optional[float]:
+        with self._lock:
+            return self._deadlines.get(str(worker_id))
+
+    def states(self) -> dict[str, str]:
+        with self._lock:
+            return dict(self._states)
+
+    # --- transitions --------------------------------------------------------
+
+    def mark_draining(self, worker_id: str,
+                      deadline_s: Optional[float] = None) -> bool:
+        """Begin an intentional departure. Returns False when the worker
+        is already draining/decommissioned (idempotent — a double drain
+        request must not reset the deadline clock)."""
+        wid = str(worker_id)
+        with self._lock:
+            if self._states.get(wid, ACTIVE) != ACTIVE:
+                return False
+            self._states[wid] = DRAINING
+            self._deadlines[wid] = (
+                self._clock() + deadline_s if deadline_s else None)
+        log(f"drain[{wid}] active -> draining"
+            + (f" (deadline {deadline_s:.0f}s)" if deadline_s else ""))
+        self._export(wid)
+        return True
+
+    def mark_decommissioned(self, worker_id: str) -> None:
+        wid = str(worker_id)
+        with self._lock:
+            before = self._states.get(wid, ACTIVE)
+            self._states[wid] = DECOMMISSIONED
+            self._deadlines.pop(wid, None)
+        if before != DECOMMISSIONED:
+            log(f"drain[{wid}] {before} -> decommissioned")
+        self._export(wid)
+
+    def reactivate(self, worker_id: str) -> bool:
+        """Undrain / rejoin: the worker is part of the fleet again.
+        Returns whether a non-active state was cleared."""
+        wid = str(worker_id)
+        with self._lock:
+            before = self._states.pop(wid, ACTIVE)
+            self._deadlines.pop(wid, None)
+        if before != ACTIVE:
+            log(f"drain[{wid}] {before} -> active (reactivated)")
+        self._export(wid)
+        return before != ACTIVE
+
+    def reset(self) -> None:
+        with self._lock:
+            wids = list(self._states)
+            self._states.clear()
+            self._deadlines.clear()
+        for wid in wids:
+            self._export(wid)
+
+    # --- telemetry ----------------------------------------------------------
+
+    def _export(self, worker_id: str) -> None:
+        if _tm_enabled():
+            _tm.WORKER_DRAIN_STATE.labels(worker=worker_id).set(
+                _STATE_VALUE[self.state(worker_id)])
+
+
+DRAIN = DrainRegistry()

@@ -80,10 +80,18 @@ class TokenBucket:
 
 def breaker_healthy_fraction() -> float:
     """Closed breakers / tracked breakers (half-open counts half); 1.0
-    when nothing is tracked (single-host or fresh boot)."""
+    when nothing is tracked (single-host or fresh boot).
+
+    Workers that are intentionally leaving (draining/decommissioned —
+    ``cluster/elastic/states``) are excluded from BOTH sides of the
+    ratio: a scale-down makes the fleet *smaller*, not *sicker*, and
+    shedding admission capacity for a planned departure would turn every
+    autoscale event into a synthetic brownout."""
+    from ..elastic.states import DRAIN
     from ..resilience import BREAKERS
 
-    states = BREAKERS.states()
+    states = {w: s for w, s in BREAKERS.states().items()
+              if not DRAIN.is_leaving(w)}
     if not states:
         return 1.0
     score = {"closed": 1.0, "half_open": 0.5, "open": 0.0}

@@ -46,6 +46,9 @@ class TileJob:
     job_id: str
     total_tasks: int
     mode: str = "static"                       # "static" | "dynamic"
+    # creation order (process-unique, assigned by the store): the steal
+    # scheduler's deterministic tie-break key (cluster/elastic/scheduler)
+    seq: int = 0
     # task_id → task, for the whole job lifetime (requeue needs ranges back)
     tasks: dict[int, TileTask] = dataclasses.field(default_factory=dict)
     pending: list[TileTask] = dataclasses.field(default_factory=list)

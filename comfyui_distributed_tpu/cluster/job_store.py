@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import asyncio
 import time
-from typing import Any, Optional
+from typing import Any, Optional, Sequence
 
 from ..telemetry import enabled as _tm_enabled, metrics as _tm
 from ..utils import constants
@@ -30,6 +30,7 @@ class JobStore:
         self.collector_jobs: dict[str, CollectorJob] = {}
         self.tile_jobs: dict[str, TileJob] = {}
         self.finished: dict[str, dict] = {}
+        self._job_seq = 0
 
     def _record_tiles(self, event: str, n: int = 1) -> None:
         """Telemetry (call under ``self.lock``): lifecycle counter + the
@@ -100,7 +101,9 @@ class JobStore:
             for start in range(0, total_tasks, chunk):
                 tasks.append(TileTask(tid, start, min(start + chunk, total_tasks)))
                 tid += 1
+            self._job_seq += 1
             job = TileJob(job_id, total_tasks=len(tasks), mode=mode,
+                          seq=self._job_seq,
                           tasks={t.task_id: t for t in tasks}, pending=list(tasks))
             self.tile_jobs[job_id] = job
             self._record_tiles("seeded", len(tasks))
@@ -115,12 +118,52 @@ class JobStore:
             if job is None:
                 return None
             job.heartbeat(worker_id)
-            if not job.pending:
+            return self._grant_locked(job, worker_id)
+
+    def _grant_locked(self, job, worker_id: str) -> Optional[dict]:
+        """Pop + assign one pending task (call under ``self.lock``)."""
+        if not job.pending:
+            return None
+        task = job.pending.pop(0)
+        job.assigned[task.task_id] = worker_id
+        self._record_tiles("assigned")
+        return {**task.as_dict(), "job_id": job.job_id,
+                "estimated_remaining": len(job.pending)}
+
+    async def request_any_work(self, worker_id: str,
+                               policy=None,
+                               exclude: "Sequence[str]" = ()) -> Optional[dict]:
+        """Cross-job pull (``job_id="*"``): grant a task from whichever
+        open tile job the steal policy ranks first — a worker that
+        drained its own job (or just arrived via scale-up) keeps every
+        chip busy on the rest of the mixed load. The grant carries the
+        task's ``job_id`` so the result routes home; per-tile noise keys
+        fold the global tile index, so stealing is numerically invisible
+        (cluster/elastic/scheduler.py).
+
+        ``exclude`` is the puller's can't-serve list (jobs whose
+        weights/workflow it lacks): without it, a top-ranked unservable
+        job would ping-pong its grant (grant → handback → re-grant)
+        and starve every servable job ranked below it."""
+        from .elastic.scheduler import JobView, StealPolicy
+
+        policy = policy or StealPolicy()
+        excluded = set(exclude)
+        async with self.lock:
+            views = []
+            for jid, job in self.tile_jobs.items():
+                if jid in excluded:
+                    continue
+                owners = {w for w in job.assigned.values() if w != "master"}
+                views.append(JobView(job_id=jid, seq=job.seq,
+                                     pending=len(job.pending),
+                                     active_workers=len(owners)))
+            choice = policy.pick(views, worker_id)
+            if choice is None:
                 return None
-            task = job.pending.pop(0)
-            job.assigned[task.task_id] = worker_id
-            self._record_tiles("assigned")
-            return {**task.as_dict(), "estimated_remaining": len(job.pending)}
+            job = self.tile_jobs[choice.job_id]
+            job.heartbeat(worker_id)
+            return self._grant_locked(job, worker_id)
 
     async def submit_result(
         self, job_id: str, worker_id: str, task_id: int, payload: Any,
@@ -214,6 +257,7 @@ class JobStore:
     async def requeue_worker_tasks(
         self, job_id: str, worker_id: str,
         max_requeues: int | None = None,
+        count_requeue: bool = True,
     ) -> list[int]:
         """Requeue the incomplete tasks of a (presumed dead) worker and
         evict it (reference ``_check_and_requeue_timed_out_workers`` apply
@@ -223,6 +267,12 @@ class JobStore:
         times (default ``constants.MAX_TILE_REQUEUES``) moves to the job's
         dead-letter list instead — a tile that deterministically kills its
         host must not cycle through the fleet forever.
+
+        ``count_requeue=False`` is the intentional-departure variant
+        (drain handback, drain-then-silence eviction): the task goes back
+        to the queue but the hop does NOT count toward the poison bound —
+        a tile is only suspect when its host *failed*, not when its host
+        was asked to leave.
         """
         if max_requeues is None:
             max_requeues = constants.MAX_TILE_REQUEUES
@@ -236,6 +286,9 @@ class JobStore:
                 if owner != worker_id or task_id in job.completed:
                     continue
                 del job.assigned[task_id]
+                if not count_requeue:
+                    requeued.append(task_id)
+                    continue
                 count = job.requeue_counts.get(task_id, 0) + 1
                 job.requeue_counts[task_id] = count
                 if count > max_requeues:
@@ -249,12 +302,48 @@ class JobStore:
             if requeued:
                 # push to the FRONT so recovered work is picked up first
                 job.pending[:0] = [job.tasks[tid] for tid in requeued]
-                self._record_tiles("requeued", len(requeued))
+                self._record_tiles(
+                    "requeued" if count_requeue else "handed_back",
+                    len(requeued))
             if poisoned:
                 debug_log(f"tile job {job_id}: dead-lettered poison tasks "
                           f"{poisoned} from {worker_id}")
             job.worker_status.pop(worker_id, None)
             return requeued
+
+    async def worker_held_tasks(self, worker_id: str) -> dict[str, list[int]]:
+        """{job_id: [task ids]} the worker is currently assigned and has
+        not completed, across every open tile job (drain bookkeeping)."""
+        async with self.lock:
+            held: dict[str, list[int]] = {}
+            for jid, job in self.tile_jobs.items():
+                tids = sorted(tid for tid, owner in job.assigned.items()
+                              if owner == worker_id
+                              and tid not in job.completed)
+                if tids:
+                    held[jid] = tids
+            return held
+
+    async def handback_worker_tasks(self, worker_id: str
+                                    ) -> dict[str, list[int]]:
+        """Drain handback: return every task the departing worker still
+        holds (across all open jobs) to the front of its job's queue,
+        WITHOUT counting against the poison bound and WITHOUT touching
+        the worker's breaker. Idempotent with heartbeat eviction — both
+        paths remove from ``assigned`` under the store lock, so a tile
+        can be requeued by at most one of them."""
+        held = await self.worker_held_tasks(worker_id)
+        out: dict[str, list[int]] = {}
+        total = 0
+        for jid in held:
+            requeued = await self.requeue_worker_tasks(
+                jid, worker_id, count_requeue=False)
+            if requeued:
+                out[jid] = requeued
+                total += len(requeued)
+        if total and _tm_enabled():
+            _tm.DRAIN_HANDBACKS.inc(total)
+        return out
 
     async def record_task_failure(
         self, job_id: str, worker_id: str, task_id: int, reason: str,

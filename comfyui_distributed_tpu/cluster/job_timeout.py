@@ -71,6 +71,8 @@ async def check_and_requeue_timed_out_workers(
                 spared.add(w)
 
     # phase 3: apply
+    from .elastic.states import DRAIN
+
     evicted: dict[str, list[int]] = {}
     for w in suspects:
         if w in spared:
@@ -78,6 +80,25 @@ async def check_and_requeue_timed_out_workers(
             log(f"worker {w} silent but busy — heartbeat refreshed (grace)")
             if _tm_enabled():
                 _tm.TILE_WORKER_EVICTIONS.labels(outcome="spared").inc()
+            continue
+        leaving = w != "master" and DRAIN.is_leaving(w)
+        if leaving:
+            # a draining worker that went silent left a little early —
+            # that is still an INTENTIONAL departure: requeue its held
+            # tiles without poison-bound accounting and leave its breaker
+            # alone. The drain handback path and this one both clear
+            # ``assigned`` under the store lock, so whichever runs first
+            # requeues and the other finds nothing (exactly-once).
+            requeued = await store.requeue_worker_tasks(
+                job_id, w, count_requeue=False)
+            if requeued:
+                log(f"draining worker {w} went silent; handed back "
+                    f"tasks {requeued} (no breaker, no requeue count)")
+            evicted[w] = requeued
+            if _tm_enabled():
+                _tm.TILE_WORKER_EVICTIONS.labels(outcome="draining").inc()
+                if requeued:
+                    _tm.DRAIN_HANDBACKS.inc(len(requeued))
             continue
         requeued = await store.requeue_worker_tasks(
             job_id, w, max_requeues=max_requeues)

@@ -331,8 +331,12 @@ class TileFarm:
         pending_flush: list[tuple[int, dict, np.ndarray]] = []
         completed = 0
         while True:
-            task = await self._request_work(session, base, job_id, worker_id)
+            task, draining = await self._request_work(session, base, job_id,
+                                                      worker_id)
             if task is None:
+                if draining:
+                    debug_log(f"tile-farm[{job_id}] worker {worker_id} "
+                              "marked draining; flushing and leaving")
                 break
             arr = await asyncio.to_thread(process_fn, task["start"], task["end"])
             meta = {"task_id": task["task_id"], "start": task["start"],
@@ -348,6 +352,127 @@ class TileFarm:
         debug_log(f"tile-farm[{job_id}] worker {worker_id}: "
                   f"{completed} tasks done")
         return completed
+
+    # --- steal-mode worker role (cluster/elastic/scheduler) -----------------
+
+    def worker_steal_run(self, worker_id: str, master_url: str,
+                         resolve_fn: Callable[[str], Optional[ProcessFn]],
+                         **kw) -> dict[str, int]:
+        return run_in_loop(
+            self.worker_steal_run_async(worker_id, master_url, resolve_fn,
+                                        **kw),
+            self.loop, timeout=None)
+
+    async def worker_steal_run_async(
+        self, worker_id: str, master_url: str,
+        resolve_fn: Callable[[str], Optional[ProcessFn]],
+        max_batch: int | None = None,
+        idle_polls: int = 3, idle_interval: float = 0.5,
+    ) -> dict[str, int]:
+        """Cross-job pull loop: ask the master's steal scheduler for work
+        from ANY open job (``job_id="*"``), process each grant with the
+        job resolved by ``resolve_fn(job_id) -> ProcessFn`` (None =
+        unknown job: the grant is handed straight back), and flush
+        results to the grant's own job. Returns {job_id: completed}.
+
+        This is what a newly arrived (scale-up) worker runs: it serves
+        whichever open job is most starved the moment it comes up,
+        instead of waiting for the next dispatch. The loop ends after
+        ``idle_polls`` consecutive empty pulls (every open queue drained)
+        or the moment the master marks this worker draining.
+        """
+        with _tm_span("tile_job.steal_worker", worker_id=worker_id):
+            return await self._worker_steal_inner(
+                worker_id, master_url, resolve_fn, max_batch,
+                idle_polls, idle_interval)
+
+    async def _worker_steal_inner(
+        self, worker_id: str, master_url: str,
+        resolve_fn: Callable[[str], Optional[ProcessFn]],
+        max_batch: int | None, idle_polls: int, idle_interval: float,
+    ) -> dict[str, int]:
+        max_batch = constants.MAX_BATCH if max_batch is None else max_batch
+        base = normalize_host_url(master_url)
+        session = get_client_session()
+        completed: dict[str, int] = {}
+        # per-job flush buffers: results must route to their own job
+        pending: dict[str, list[tuple[int, dict, np.ndarray]]] = {}
+        unservable: set[str] = set()
+        idle = 0
+        while idle < idle_polls:
+            task, draining = await self._request_work(
+                session, base, "*", worker_id,
+                extra={"exclude_jobs": sorted(unservable)}
+                if unservable else None)
+            if draining:
+                # asked to leave: stop pulling IMMEDIATELY (the refusal
+                # is intentional, not an empty queue) — buffered results
+                # still flush below so a clean drain loses nothing
+                debug_log(f"steal[{worker_id}] marked draining; "
+                          "flushing and exiting")
+                break
+            if task is None:
+                idle += 1
+                # flush everything before idling — a result sitting in
+                # the buffer is still "assigned" master-side and would be
+                # handed back if this worker drains while waiting
+                for jid, batch in list(pending.items()):
+                    if batch:
+                        await self._flush(session, base, jid, worker_id,
+                                          batch)
+                        pending[jid] = []
+                await asyncio.sleep(idle_interval)
+                continue
+            jid = task.get("job_id", "")
+            fn = resolve_fn(jid)
+            if fn is None:
+                # a job this worker can't serve (no weights/workflow):
+                # give the grant straight back so someone else takes it.
+                # A re-grant from a known-unservable job counts as an
+                # idle poll — when unservable jobs are all that's open,
+                # the loop must wind down, not ping-pong the grant
+                debug_log(f"steal[{worker_id}] cannot serve job {jid}; "
+                          "handing the task back")
+                await self._handback_task(session, base, jid, worker_id)
+                if jid in unservable:
+                    idle += 1
+                    await asyncio.sleep(idle_interval)
+                else:
+                    unservable.add(jid)
+                continue
+            idle = 0
+            arr = await asyncio.to_thread(fn, task["start"], task["end"])
+            meta = {"task_id": task["task_id"], "start": task["start"],
+                    "end": task["end"]}
+            pending.setdefault(jid, []).append((task["task_id"], meta, arr))
+            completed[jid] = completed.get(jid, 0) + 1
+            # heartbeat EVERY job we still hold unflushed work in, not
+            # just the latest grant's: job A's monitor must keep seeing
+            # us alive while the scheduler has us grinding job B, or A
+            # falsely evicts us through the failure path (breaker trip +
+            # poison-bound requeue) with its results sitting in our buffer
+            for held_jid in {jid, *(j for j, b in pending.items() if b)}:
+                await self._heartbeat(session, base, held_jid, worker_id)
+            if len(pending[jid]) >= max_batch:
+                await self._flush(session, base, jid, worker_id,
+                                  pending[jid])
+                pending[jid] = []
+        for jid, batch in pending.items():
+            if batch:
+                await self._flush(session, base, jid, worker_id, batch)
+        debug_log(f"steal[{worker_id}] done: {completed}")
+        return completed
+
+    async def _handback_task(self, session, base, job_id, worker_id) -> None:
+        """Give an unservable grant back (drain-handback accounting:
+        the hop is intentional, not failure evidence)."""
+        try:
+            async with session.post(
+                    f"{base}/distributed/handback",
+                    json={"job_id": job_id, "worker_id": worker_id}) as resp:
+                await resp.release()
+        except (aiohttp.ClientError, asyncio.TimeoutError, OSError):
+            pass   # heartbeat silence will requeue it eventually anyway
 
     # --- wire helpers -------------------------------------------------------
 
@@ -376,23 +501,35 @@ class TileFarm:
             await asyncio.sleep(interval)
         return False
 
-    async def _request_work(self, session, base, job_id, worker_id) -> Optional[dict]:
+    async def _request_work(self, session, base, job_id, worker_id,
+                            extra: "Optional[dict]" = None,
+                            ) -> "tuple[Optional[dict], bool]":
         """WORK_REQUEST_BUDGET-bounded, 404-tolerant pull (reference
         ``worker_comms.py:124-169``) through the unified RetryPolicy:
         full-jitter backoff instead of the old fixed ladder, so a worker
         fleet re-polling a restarting master spreads out rather than
-        connecting in lockstep."""
-        async def attempt() -> Optional[dict]:
+        connecting in lockstep.
+
+        ``job_id="*"`` asks the master's cross-job scheduler for work
+        from ANY open job (the grant carries its ``job_id``). Returns
+        ``(task, draining)``: a ``draining: true`` answer means this
+        worker was asked to leave — an intentional refusal, not an empty
+        queue — so the caller must stop pulling NOW (and it never burns
+        the retry budget). ``extra`` merges into the request body (the
+        steal loop sends its ``exclude_jobs`` can't-serve list there)."""
+        async def attempt() -> "tuple[Optional[dict], bool]":
             async with session.post(
                     f"{base}/distributed/request_image",
-                    json={"job_id": job_id, "worker_id": worker_id}) as resp:
+                    json={"job_id": job_id, "worker_id": worker_id,
+                          **(extra or {})}) as resp:
                 if resp.status >= 400:
                     # master mid-restart / job not yet seeded: retryable
                     err = WorkerError(f"work request {resp.status}",
                                       worker_id=worker_id)
                     err.retry_safe = True
                     raise err
-                return (await resp.json()).get("task")
+                body = await resp.json()
+                return body.get("task"), bool(body.get("draining"))
 
         try:
             return await work_request_policy().run(attempt, op="request_work")
@@ -400,7 +537,7 @@ class TileFarm:
                 WorkerError) as e:
             debug_log(f"work request budget exhausted ({e}); "
                       "treating queue as drained")
-            return None
+            return None, False
 
     async def _heartbeat(self, session, base, job_id, worker_id) -> None:
         try:

@@ -73,7 +73,7 @@ TILE_QUEUE_DEPTH = REGISTRY.gauge(
 TILE_WORKER_EVICTIONS = REGISTRY.counter(
     "cdt_tile_worker_evictions_total",
     "Heartbeat-timeout verdicts on tile workers.",
-    ("outcome",))   # evicted | spared
+    ("outcome",))   # evicted | spared | draining
 
 # --- cluster dispatch / probing --------------------------------------------
 
@@ -90,7 +90,7 @@ DISPATCH_PAYLOAD_BYTES = REGISTRY.histogram(
 WORKER_PROBES = REGISTRY.counter(
     "cdt_worker_probe_total",
     "Worker health-probe outcomes (orchestration fan-out).",
-    ("outcome",))   # online | offline | quarantined
+    ("outcome",))   # online | offline | quarantined | draining
 
 MEDIA_SYNC_FILES = REGISTRY.counter(
     "cdt_media_sync_files_total",
@@ -190,6 +190,38 @@ QUEUE_WAIT_SECONDS = REGISTRY.histogram(
     "Time-in-queue per request (submission to execution start, "
     "coalescing window included), by priority class.",
     ("priority",))
+
+# --- elastic fleet (cluster/elastic, docs/elasticity.md) --------------------
+
+AUTOSCALE_DECISIONS = REGISTRY.counter(
+    "cdt_autoscale_decisions_total",
+    "Autoscaler verdicts per evaluation tick. direction=up|down|hold; "
+    "reason names the dominant signal (queue_pressure, idle_fleet, "
+    "cooldown, envelope_min, envelope_max, no_capacity, ...).",
+    ("direction", "reason"))
+
+WORKER_DRAIN_STATE = REGISTRY.gauge(
+    "cdt_worker_drain_state",
+    "Per-worker lifecycle state (0=active, 1=draining, 2=decommissioned). "
+    "Intentional departure — never failure evidence for the breaker.",
+    ("worker",))
+
+FLEET_SIZE = REGISTRY.gauge(
+    "cdt_fleet_size",
+    "Workers known to the elastic manager, by lifecycle state.",
+    ("state",))   # active | draining | decommissioned
+
+DRAIN_HANDBACKS = REGISTRY.counter(
+    "cdt_drain_handbacks_total",
+    "Tile tasks handed back to the queue by a draining worker "
+    "(deadline expiry or early exit) — requeued WITHOUT counting toward "
+    "the poison bound.")
+
+STEAL_ASSIGNMENTS = REGISTRY.counter(
+    "cdt_steal_assignments_total",
+    "Cross-job scheduler grants. kind=own_job (the job the puller named) "
+    "or stolen (work lifted from another open job).",
+    ("kind",))
 
 # --- prompt queue -----------------------------------------------------------
 

@@ -144,6 +144,25 @@ FD_MAX_TENANTS = env_int("CDT_FD_MAX_TENANTS", 1024)
 # Base Retry-After seconds for shed responses (scaled by overload ratio).
 FD_RETRY_AFTER_S = env_float("CDT_FD_RETRY_AFTER_S", 2.0)
 
+# --- elastic fleet (cluster/elastic, docs/elasticity.md) --------------------
+# Graceful drain: how long a draining worker may keep its in-flight work
+# before the master hands it back to the queue (no poison-bound count,
+# no breaker evidence — intentional departure).
+DRAIN_DEADLINE_S = env_float("CDT_DRAIN_DEADLINE_S", 120.0)
+# Autoscaler policy loop (enabled via CDT_AUTOSCALE=1): evaluation
+# cadence, fleet envelope, per-capacity-unit pressure thresholds with
+# hysteresis streaks, and up/down cooldowns (adding capacity is fast,
+# removing it is reluctant).
+AUTOSCALE_INTERVAL_S = env_float("CDT_AUTOSCALE_INTERVAL_S", 5.0)
+AUTOSCALE_MIN = env_int("CDT_AUTOSCALE_MIN", 0)
+AUTOSCALE_MAX = env_int("CDT_AUTOSCALE_MAX", 4)
+AUTOSCALE_UP_DEPTH = env_float("CDT_AUTOSCALE_UP_DEPTH", 4.0)
+AUTOSCALE_DOWN_DEPTH = env_float("CDT_AUTOSCALE_DOWN_DEPTH", 0.5)
+AUTOSCALE_UP_STREAK = env_int("CDT_AUTOSCALE_UP_STREAK", 2)
+AUTOSCALE_DOWN_STREAK = env_int("CDT_AUTOSCALE_DOWN_STREAK", 4)
+AUTOSCALE_UP_COOLDOWN_S = env_float("CDT_AUTOSCALE_UP_COOLDOWN_S", 30.0)
+AUTOSCALE_DOWN_COOLDOWN_S = env_float("CDT_AUTOSCALE_DOWN_COOLDOWN_S", 120.0)
+
 # --- VAE decode tiling ------------------------------------------------------
 # 3D-VAE decodes switch to spatially-tiled mode when the latent frame area
 # exceeds this (latent pixels): a 480p WAN clip decode holds >31 GB of f32

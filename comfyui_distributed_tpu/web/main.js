@@ -42,6 +42,15 @@ function warmupBadge(state) {
   return "";
 }
 
+// Lifecycle suffix (cluster/elastic): a draining/decommissioned worker
+// is leaving ON PURPOSE — badged distinctly from a broken one so an
+// operator never mistakes a scale-down for an outage
+function drainBadge(state) {
+  if (state === "draining") return " · 🪫 draining";
+  if (state === "decommissioned") return " · 🚪 decommissioned";
+  return "";
+}
+
 // ---------------------------------------------------------------------------
 // worker cards
 // ---------------------------------------------------------------------------
@@ -74,7 +83,7 @@ function workerCard(worker) {
   info.querySelector(".meta").textContent =
     `${worker.type || "auto"}${managed ? ` · pid ${managed.pid}` : ""}` +
     `${st.online ? " · online" + qr : " · offline"}` + breaker +
-    warmupBadge(st.warmup);
+    warmupBadge(st.warmup) + drainBadge(st.drain);
 
   const toggle = document.createElement("input");
   toggle.type = "checkbox";

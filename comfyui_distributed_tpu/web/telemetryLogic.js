@@ -108,7 +108,41 @@ export function telemetryRows(metrics) {
   const retries = seriesSum(metrics, "cdt_retry_attempts_total");
   if (retries > 0) rows.push(["Retries", String(retries)]);
   rows.push(["Front door", frontDoorSummary(metrics)]);
+  rows.push(["Elastic fleet", elasticSummary(metrics)]);
   return rows;
+}
+
+// Elastic fleet (cluster/elastic): lifecycle states from the
+// cdt_worker_drain_state gauge (0=active, 1=draining, 2=decommissioned),
+// autoscale verdicts, steal-scheduler grants, and drain handbacks — the
+// numbers that say whether scale events are graceful. Draining workers
+// are named: "which worker is leaving?" is the operator's first question.
+export function elasticSummary(metrics) {
+  const fam = metrics && metrics.cdt_worker_drain_state;
+  const series = (fam && fam.series) || [];
+  const by = { active: [], draining: [], decommissioned: [] };
+  for (const s of series) {
+    const name = s.value >= 2 ? "decommissioned"
+      : s.value >= 1 ? "draining" : "active";
+    by[name].push((s.labels || {}).worker || "?");
+  }
+  const parts = [];
+  if (by.active.length) parts.push(`${by.active.length} active`);
+  if (by.draining.length) parts.push(
+    `${by.draining.length} draining (${by.draining.sort().join(", ")})`);
+  if (by.decommissioned.length) parts.push(
+    `${by.decommissioned.length} decommissioned`);
+  const scaled = countsByLabel(
+    metrics, "cdt_autoscale_decisions_total", "direction");
+  const acted = (scaled.up || 0) + (scaled.down || 0);
+  if (acted > 0) parts.push(
+    `scale ${scaled.up || 0}↑ ${scaled.down || 0}↓`);
+  const stolen = seriesSum(metrics, "cdt_steal_assignments_total",
+                           { kind: "stolen" });
+  if (stolen > 0) parts.push(`${stolen} stolen`);
+  const handbacks = seriesSum(metrics, "cdt_drain_handbacks_total");
+  if (handbacks > 0) parts.push(`${handbacks} handed back`);
+  return parts.length ? parts.join(" · ") : "static fleet";
 }
 
 // Serving front door (cluster/frontdoor): admission outcomes, mean

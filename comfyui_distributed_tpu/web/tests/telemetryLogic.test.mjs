@@ -3,8 +3,8 @@
 import assert from "node:assert/strict";
 import { test } from "node:test";
 
-import { breakerSummary, countsByLabel, fmtSeconds, frontDoorSummary,
-         histQuantile, mergeHistogram, seriesSum,
+import { breakerSummary, countsByLabel, elasticSummary, fmtSeconds,
+         frontDoorSummary, histQuantile, mergeHistogram, seriesSum,
          telemetryRows } from "../telemetryLogic.js";
 
 const METRICS = {
@@ -126,6 +126,55 @@ test("frontDoorSummary reports admissions, occupancy, and queue wait", () => {
   // telemetryRows carries the row
   const byKey = Object.fromEntries(telemetryRows(metrics));
   assert.match(byKey["Front door"], /batch x̄/);
+});
+
+test("elasticSummary names draining workers and counts scale events", () => {
+  assert.equal(elasticSummary({}), "static fleet");
+  const metrics = {
+    cdt_worker_drain_state: {
+      type: "gauge",
+      series: [
+        { labels: { worker: "w0" }, value: 0 },
+        { labels: { worker: "w1" }, value: 1 },
+        { labels: { worker: "w2" }, value: 2 },
+      ],
+    },
+    cdt_autoscale_decisions_total: {
+      type: "counter",
+      series: [
+        { labels: { direction: "up", reason: "queue_pressure" }, value: 2 },
+        { labels: { direction: "down", reason: "idle_fleet" }, value: 1 },
+        { labels: { direction: "hold", reason: "steady" }, value: 40 },
+      ],
+    },
+    cdt_steal_assignments_total: {
+      type: "counter",
+      series: [
+        { labels: { kind: "stolen" }, value: 7 },
+        { labels: { kind: "own_job" }, value: 12 },
+      ],
+    },
+    cdt_drain_handbacks_total: {
+      type: "counter",
+      series: [{ labels: {}, value: 3 }],
+    },
+  };
+  const row = elasticSummary(metrics);
+  assert.match(row, /1 active/);
+  assert.match(row, /1 draining \(w1\)/);
+  assert.match(row, /1 decommissioned/);
+  assert.match(row, /scale 2↑ 1↓/);
+  assert.match(row, /7 stolen/);
+  assert.match(row, /3 handed back/);
+  // telemetryRows carries the row; holds alone don't count as events
+  const byKey = Object.fromEntries(telemetryRows(metrics));
+  assert.match(byKey["Elastic fleet"], /draining \(w1\)/);
+  assert.equal(
+    elasticSummary({ cdt_autoscale_decisions_total: {
+      type: "counter",
+      series: [{ labels: { direction: "hold", reason: "steady" },
+                 value: 9 }] } }),
+    "static fleet");
 });
 
 test("telemetryRows tolerates absent families and renders the rest", () => {

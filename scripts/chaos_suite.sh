@@ -38,6 +38,26 @@ env JAX_PLATFORMS=cpu CDT_CHAOS_SEED="${SEED}" \
 
 # Stage 3 — the rest of the chaos tier
 echo "[chaos] stage 3: full chaos tier"
-exec env JAX_PLATFORMS=cpu CDT_CHAOS_SEED="${SEED}" \
-    python -m pytest tests/ -q -m chaos -k "not warm_restarted and not overload" \
+env JAX_PLATFORMS=cpu CDT_CHAOS_SEED="${SEED}" \
+    python -m pytest tests/ -q -m chaos \
+    -k "not warm_restarted and not overload and not scale_event" \
     -p no:cacheprovider --continue-on-collection-errors "$@"
+
+# Stage 4 — seeded scale events under live load (ISSUE 10,
+# docs/elasticity.md): (a) the chaos-marked acceptance test — a mixed
+# two-job run that scales up mid-job (steal pickup), drains one worker
+# (deadline handback), and rolling-restarts another (drain → undrain),
+# asserting bit-identical outputs vs the static fleet, zero dead-letters,
+# and no breaker opening for any intentional departure; (b) load_smoke
+# --churn — seeded drain/kill/restart events interleaved with the
+# mixed-tenant serving load, exiting 1 on any admitted-job loss or
+# unbounded queue depth.
+echo "[chaos] stage 4: elastic scale events (scale-up / drain / rolling restart)"
+env JAX_PLATFORMS=cpu CDT_CHAOS_SEED="${SEED}" CDT_STEAL_SEED="${SEED}" \
+    python -m pytest tests/ -q -m chaos -k "scale_event" \
+    -p no:cacheprovider --continue-on-collection-errors "$@"
+echo "[chaos] stage 4b: churn load smoke (zero admitted-job loss)"
+exec env JAX_PLATFORMS=cpu PYTHONPATH="$(pwd)" \
+    CDT_CONFIG_PATH="$(mktemp -d)/config.json" \
+    python scripts/load_smoke.py --in-process --churn --n 12 \
+    --concurrency 8 --seed "${SEED}"

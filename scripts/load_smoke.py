@@ -138,11 +138,63 @@ async def run_load(submit: Callable[[dict], Any],
     return stats
 
 
+# --- seeded churn (ISSUE 10: scale events under live load) ------------------
+
+
+def build_churn_plan(seed: int, workers: tuple, n_events: int) -> list:
+    """Deterministic (worker, event) sequence: each tick flips one
+    worker's presence — out via ``drain`` or ``kill`` (seeded pick), back
+    via the matching ``undrain`` / ``restart``. Ends with everyone back
+    in, so the run's terminal fleet state is clean. Same seed → same
+    event schedule, byte for byte."""
+    rng = random.Random(seed * 7919 + 13)
+    state = {w: "in" for w in workers}
+    plan = []
+    for _ in range(n_events):
+        w = workers[rng.randrange(len(workers))]
+        if state[w] == "in":
+            kind = ("drain", "kill")[rng.randrange(2)]
+            state[w] = kind
+        else:
+            kind = "undrain" if state[w] == "drain" else "restart"
+            state[w] = "in"
+        plan.append((w, kind))
+    for w, st in state.items():
+        if st != "in":
+            plan.append((w, "undrain" if st == "drain" else "restart"))
+    return plan
+
+
+async def run_churn(plan: list, act, interval_s: float,
+                    depth_probe) -> dict:
+    """Apply the churn plan at a fixed cadence while the load runs;
+    ``act(worker, kind) -> outcome str`` performs one event,
+    ``depth_probe()`` samples the admission depth signal. Returns the
+    event log + the max depth observed (the bounded-queue assertion)."""
+    log = {"events": [], "max_depth": 0}
+    for w, kind in plan:
+        await asyncio.sleep(interval_s)
+        try:
+            outcome = await act(w, kind)
+        except Exception as e:  # noqa: BLE001 — churn must not sink the
+            # load run; a failed event is itself a reportable outcome
+            outcome = f"error: {e}"
+        log["events"].append({"worker": w, "event": kind,
+                              "outcome": outcome})
+        try:
+            log["max_depth"] = max(log["max_depth"],
+                                   int(await depth_probe()))
+        except Exception:  # noqa: BLE001 — depth is decoration
+            pass
+    return log
+
+
 # --- transports -------------------------------------------------------------
 
 
 async def _run_http(url: str, requests: list[dict], concurrency: int,
-                    wait: bool, timeout_s: float) -> dict:
+                    wait: bool, timeout_s: float,
+                    churn: Optional[dict] = None) -> dict:
     import aiohttp
 
     async with aiohttp.ClientSession() as session:
@@ -169,8 +221,33 @@ async def _run_http(url: str, requests: list[dict], concurrency: int,
                 await asyncio.sleep(0.2)
             return {"status": "timeout"}
 
+        churn_task = None
+        if churn:
+            _ROUTES = {
+                "drain": ("/distributed/worker/{w}/drain",
+                          {"deadline_s": 2.0, "stop_process": False}),
+                "undrain": ("/distributed/worker/{w}/undrain", {}),
+                "kill": ("/distributed/stop_worker", None),
+                "restart": ("/distributed/launch_worker", None),
+            }
+
+            async def act(w, kind):
+                path, body = _ROUTES[kind]
+                payload = body if body is not None else {"worker_id": w}
+                async with session.post(
+                        url + path.format(w=w), json=payload) as resp:
+                    return f"http {resp.status}"
+
+            async def depth_probe():
+                async with session.get(f"{url}/distributed/frontdoor") as r:
+                    return (await r.json()).get("depth", 0)
+
+            churn_task = asyncio.ensure_future(run_churn(
+                churn["plan"], act, churn["interval_s"], depth_probe))
         stats = await run_load(submit, requests, concurrency=concurrency,
                                wait_done=wait_done if wait else None)
+        if churn_task is not None:
+            stats["churn"] = await churn_task
         stats["metrics"] = await _fetch_occupancy(session, url)
         return stats
 
@@ -197,7 +274,8 @@ async def _fetch_occupancy(session, url: str) -> dict:
 
 
 async def _run_in_process(requests: list[dict], concurrency: int,
-                          wait: bool, timeout_s: float) -> dict:
+                          wait: bool, timeout_s: float,
+                          churn: Optional[dict] = None) -> dict:
     from aiohttp.test_utils import TestClient, TestServer
 
     from comfyui_distributed_tpu.api import create_app
@@ -225,8 +303,39 @@ async def _run_in_process(requests: list[dict], concurrency: int,
                 await asyncio.sleep(0.05)
             return {"status": "timeout"}
 
+        churn_task = None
+        if churn:
+            # no real worker processes in-process: drain/undrain drive
+            # the REAL elastic registry (admission's healthy-fraction
+            # sees them), kill/restart the REAL breaker registry — the
+            # master-side state machines the scale events exercise
+            from comfyui_distributed_tpu.cluster.elastic.states import DRAIN
+            from comfyui_distributed_tpu.cluster.resilience import BREAKERS
+
+            async def act(w, kind):
+                if kind == "drain":
+                    controller.elastic.coordinator.begin(
+                        w, deadline_s=2.0, stop_process=False)
+                elif kind == "undrain":
+                    controller.elastic.coordinator.undrain(w)
+                elif kind == "kill":
+                    BREAKERS.trip(w)
+                else:   # restart
+                    BREAKERS.record(w, True)
+                    DRAIN.reactivate(w)
+                return "ok"
+
+            async def depth_probe():
+                fd = controller.frontdoor
+                return (fd.depth() if fd is not None
+                        else controller.queue.queue_remaining)
+
+            churn_task = asyncio.ensure_future(run_churn(
+                churn["plan"], act, churn["interval_s"], depth_probe))
         stats = await run_load(submit, requests, concurrency=concurrency,
                                wait_done=wait_done if wait else None)
+        if churn_task is not None:
+            stats["churn"] = await churn_task
         from comfyui_distributed_tpu import telemetry
 
         if telemetry.enabled():
@@ -252,16 +361,32 @@ def main() -> int:
     ap.add_argument("--no-wait", action="store_true",
                     help="submit only; skip waiting for completion")
     ap.add_argument("--timeout-s", type=float, default=600.0)
+    ap.add_argument("--churn", action="store_true",
+                    help="interleave seeded worker drain/kill/restart "
+                         "events with the load (ISSUE 10 scale events); "
+                         "exit 1 on any admitted-job loss or unbounded "
+                         "queue depth")
+    ap.add_argument("--churn-workers", default="w1,w2",
+                    help="comma-separated worker ids the churn events hit")
+    ap.add_argument("--churn-events", type=int, default=6)
+    ap.add_argument("--churn-interval-s", type=float, default=0.3)
     cli = ap.parse_args()
 
     requests = build_workload(cli.seed, cli.n)
     wait = not cli.no_wait
+    churn = None
+    if cli.churn:
+        workers = tuple(w for w in cli.churn_workers.split(",") if w)
+        churn = {"plan": build_churn_plan(cli.seed, workers,
+                                          cli.churn_events),
+                 "interval_s": cli.churn_interval_s}
     if cli.url:
         stats = asyncio.run(_run_http(cli.url, requests, cli.concurrency,
-                                      wait, cli.timeout_s))
+                                      wait, cli.timeout_s, churn=churn))
     else:
         stats = asyncio.run(_run_in_process(requests, cli.concurrency,
-                                            wait, cli.timeout_s))
+                                            wait, cli.timeout_s,
+                                            churn=churn))
     print(json.dumps(stats, indent=2, default=str))
     accepted = stats["admitted"] + stats["queued"]
     accounted = (stats["completed"] + stats["errors"] + stats["expired"])
@@ -272,6 +397,14 @@ def main() -> int:
     if wait and stats["errors"]:
         print(f"{stats['errors']} request(s) errored", file=sys.stderr)
         return 1
+    if cli.churn:
+        from comfyui_distributed_tpu.utils import constants
+
+        max_depth = (stats.get("churn") or {}).get("max_depth", 0)
+        if max_depth > constants.FD_SHED_DEPTH:
+            print(f"UNBOUNDED DEPTH: observed {max_depth} > shed "
+                  f"threshold {constants.FD_SHED_DEPTH}", file=sys.stderr)
+            return 1
     return 0
 
 

@@ -54,11 +54,14 @@ def _reset_resilience_state():
     a test injects against 'w0' would quarantine 'w0' for every later
     test in the session."""
     from comfyui_distributed_tpu.cluster import faults, resilience
+    from comfyui_distributed_tpu.cluster.elastic import states as _el_states
 
     resilience.BREAKERS.reset()
+    _el_states.DRAIN.reset()
     faults.deactivate()
     yield
     resilience.BREAKERS.reset()
+    _el_states.DRAIN.reset()
     faults.deactivate()
 
 

@@ -1,0 +1,907 @@
+"""Elastic fleet subsystem (cluster/elastic, ISSUE 10): drain states,
+deterministic cross-job stealing, the autoscaler policy loop, graceful
+drain/decommission, and the chaos-marked scale-event acceptance run.
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from comfyui_distributed_tpu.cluster.elastic.autoscaler import (
+    AutoscalePolicy, Autoscaler, FleetSignals)
+from comfyui_distributed_tpu.cluster.elastic.drain import DrainCoordinator
+from comfyui_distributed_tpu.cluster.elastic.scheduler import (
+    JobView, StealPolicy)
+from comfyui_distributed_tpu.cluster.elastic.states import (
+    ACTIVE, DECOMMISSIONED, DRAIN, DRAINING, DrainRegistry)
+from comfyui_distributed_tpu.cluster.job_store import JobStore
+from comfyui_distributed_tpu.cluster.resilience import BREAKERS
+
+
+def make_proc(value_scale=1.5, delay=0.0):
+    """Deterministic on the GLOBAL tile index (same discipline as the
+    chaos tests): any host computing tile i produces identical pixels,
+    so steal/handback/requeue are provably invisible in the output."""
+    import time as _t
+
+    def proc(start, end):
+        if delay:
+            _t.sleep(delay)
+        return np.stack([np.full((4, 4, 3), float(i) * value_scale + 0.25,
+                                 np.float32)
+                         for i in range(start, end)])
+    return proc
+
+
+# ---------------------------------------------------------------------------
+# lifecycle registry
+# ---------------------------------------------------------------------------
+
+
+class TestDrainRegistry:
+    def test_unknown_workers_are_active(self):
+        reg = DrainRegistry()
+        assert reg.state("nobody") == ACTIVE
+        assert not reg.is_leaving("nobody")
+
+    def test_forward_transitions_and_reactivate(self):
+        reg = DrainRegistry(clock=lambda: 100.0)
+        assert reg.mark_draining("w0", deadline_s=5.0)
+        assert reg.state("w0") == DRAINING
+        assert reg.is_leaving("w0") and reg.is_draining("w0")
+        assert reg.deadline("w0") == 105.0
+        reg.mark_decommissioned("w0")
+        assert reg.state("w0") == DECOMMISSIONED
+        assert reg.is_leaving("w0") and not reg.is_draining("w0")
+        assert reg.reactivate("w0")
+        assert reg.state("w0") == ACTIVE
+
+    def test_double_drain_is_idempotent(self):
+        """A second drain request must not reset the deadline clock."""
+        now = [0.0]
+        reg = DrainRegistry(clock=lambda: now[0])
+        assert reg.mark_draining("w0", deadline_s=10.0)
+        now[0] = 5.0
+        assert not reg.mark_draining("w0", deadline_s=10.0)
+        assert reg.deadline("w0") == 10.0   # the ORIGINAL deadline
+
+    def test_reset_clears_everything(self):
+        reg = DrainRegistry()
+        reg.mark_draining("a")
+        reg.mark_decommissioned("b")
+        reg.reset()
+        assert reg.states() == {}
+        assert reg.state("a") == ACTIVE
+
+
+# ---------------------------------------------------------------------------
+# steal scheduler policy
+# ---------------------------------------------------------------------------
+
+
+class TestStealPolicy:
+    VIEWS = [
+        JobView("jobA", seq=1, pending=10, active_workers=2),
+        JobView("jobB", seq=2, pending=3, active_workers=0),
+        JobView("jobC", seq=3, pending=8, active_workers=0),
+        JobView("done", seq=4, pending=0, active_workers=1),
+    ]
+
+    def test_most_starved_first(self):
+        """Fewest workers wins; deeper pending breaks the worker tie;
+        drained jobs never granted."""
+        ranked = StealPolicy(seed=0).rank(self.VIEWS, "w0")
+        assert [v.job_id for v in ranked] == ["jobC", "jobB", "jobA"]
+
+    def test_deterministic_under_seed(self):
+        a = StealPolicy(seed=7).rank(self.VIEWS, "w0")
+        b = StealPolicy(seed=7).rank(self.VIEWS, "w0")
+        assert [v.job_id for v in a] == [v.job_id for v in b]
+
+    def test_exact_ties_settled_by_seeded_hash(self):
+        views = [JobView("x", seq=1, pending=5, active_workers=0),
+                 JobView("y", seq=2, pending=5, active_workers=0)]
+        picks = {StealPolicy(seed=s).pick(views, "w0").job_id
+                 for s in range(16)}
+        # both orders occur across seeds, each seed is stable
+        assert picks == {"x", "y"}
+        for s in range(4):
+            assert (StealPolicy(seed=s).pick(views, "w0").job_id
+                    == StealPolicy(seed=s).pick(views, "w0").job_id)
+
+    def test_empty_when_nothing_pending(self):
+        assert StealPolicy().pick(
+            [JobView("j", seq=1, pending=0, active_workers=0)], "w") is None
+
+
+class TestJobStoreSteal:
+    def test_any_work_grants_across_jobs_with_job_id(self):
+        async def body():
+            store = JobStore()
+            await store.init_tile_job("a", 2)
+            await store.init_tile_job("b", 3)
+            seen = {"a": 0, "b": 0}
+            for _ in range(5):
+                task = await store.request_any_work("w0",
+                                                    policy=StealPolicy(seed=1))
+                assert task is not None and task["job_id"] in seen
+                seen[task["job_id"]] += 1
+            assert seen == {"a": 2, "b": 3}
+            assert await store.request_any_work("w0") is None
+        asyncio.run(body())
+
+    def test_any_work_prefers_the_starved_job(self):
+        """Job a has a worker on it; job b has none — the first "*"
+        grant to a second worker must come from b."""
+        async def body():
+            store = JobStore()
+            await store.init_tile_job("a", 4)
+            await store.init_tile_job("b", 4)
+            assert (await store.request_work("a", "w0")) is not None
+            task = await store.request_any_work("w1",
+                                                policy=StealPolicy(seed=0))
+            assert task["job_id"] == "b"
+        asyncio.run(body())
+
+
+# ---------------------------------------------------------------------------
+# drain handback accounting (leaving ≠ broken)
+# ---------------------------------------------------------------------------
+
+
+class TestHandback:
+    def test_handback_requeues_without_poison_count(self):
+        async def body():
+            store = JobStore()
+            await store.init_tile_job("j", 4)
+            t0 = await store.request_work("j", "w0")
+            t1 = await store.request_work("j", "w0")
+            handed = await store.handback_worker_tasks("w0")
+            assert handed == {"j": [t0["task_id"], t1["task_id"]]}
+            job = store.tile_jobs["j"]
+            # back at the FRONT, exactly once, and NOT counted
+            assert [t.task_id for t in job.pending][:2] == \
+                sorted([t0["task_id"], t1["task_id"]])
+            assert len(job.pending) == 4
+            assert job.requeue_counts == {}
+            assert job.assigned == {}
+            # idempotent: a second handback finds nothing
+            assert await store.handback_worker_tasks("w0") == {}
+        asyncio.run(body())
+
+    def test_handback_never_dead_letters(self, monkeypatch):
+        """Even a task already at the poison bound goes back to the
+        queue on an intentional departure — only FAILURES count."""
+        from comfyui_distributed_tpu.utils import constants
+
+        monkeypatch.setattr(constants, "MAX_TILE_REQUEUES", 1)
+
+        async def body():
+            store = JobStore()
+            await store.init_tile_job("j", 1)
+            task = await store.request_work("j", "w0")
+            store.tile_jobs["j"].requeue_counts[task["task_id"]] = 1
+            handed = await store.handback_worker_tasks("w0")
+            assert handed == {"j": [task["task_id"]]}
+            assert store.tile_jobs["j"].dead_letter == {}
+            assert store.tile_jobs["j"].requeue_counts == \
+                {task["task_id"]: 1}   # untouched
+        asyncio.run(body())
+
+    def test_eviction_of_draining_worker_spares_breaker_once(self):
+        """The heartbeat monitor finding a silent DRAINING worker hands
+        its tiles back (no breaker trip, no requeue count) — and the
+        later coordinator handback finds nothing (exactly-once)."""
+        from comfyui_distributed_tpu.cluster.job_timeout import (
+            check_and_requeue_timed_out_workers)
+
+        async def body():
+            store = JobStore()
+            await store.init_tile_job("j", 3)
+            await store.request_work("j", "w0")
+            await store.request_work("j", "w0")
+            DRAIN.mark_draining("w0")
+            evicted = await check_and_requeue_timed_out_workers(
+                store, "j", timeout=0.0, now=1e9)
+            assert sorted(evicted["w0"]) == [0, 1]
+            assert BREAKERS.state("w0") == "closed"   # never tripped
+            job = store.tile_jobs["j"]
+            assert job.requeue_counts == {}
+            assert len(job.pending) == 3
+            # the drain coordinator's own handback double-checks: empty
+            assert await store.handback_worker_tasks("w0") == {}
+            assert len(store.tile_jobs["j"].pending) == 3
+        asyncio.run(body())
+
+    def test_eviction_of_failed_worker_still_trips_breaker(self):
+        """Control case: a NON-draining silent worker keeps the PR 3
+        behavior — breaker trips, requeues count."""
+        from comfyui_distributed_tpu.cluster.job_timeout import (
+            check_and_requeue_timed_out_workers)
+
+        async def body():
+            store = JobStore()
+            await store.init_tile_job("j", 2)
+            await store.request_work("j", "w1")
+            evicted = await check_and_requeue_timed_out_workers(
+                store, "j", timeout=0.0, now=1e9)
+            assert evicted["w1"] == [0]
+            assert BREAKERS.state("w1") == "open"
+            assert store.tile_jobs["j"].requeue_counts == {0: 1}
+        asyncio.run(body())
+
+
+class TestHealthyFraction:
+    def test_draining_workers_leave_the_denominator(self):
+        from comfyui_distributed_tpu.cluster.frontdoor.admission import (
+            breaker_healthy_fraction)
+
+        BREAKERS.record("w0", True)
+        BREAKERS.trip("w1")
+        assert breaker_healthy_fraction() == 0.5
+        # w1 is not broken — it was told to leave: full health again
+        DRAIN.mark_draining("w1")
+        assert breaker_healthy_fraction() == 1.0
+        # an all-leaving tracked set reads as a fresh fleet, not a dead one
+        DRAIN.mark_draining("w0")
+        assert breaker_healthy_fraction() == 1.0
+
+
+# ---------------------------------------------------------------------------
+# autoscaler policy loop
+# ---------------------------------------------------------------------------
+
+
+class FakeProvider:
+    def __init__(self, launchable=("w1", "w2", "w3")):
+        self.pool = list(launchable)
+        self.running: dict[str, str] = {}
+        self.drained: list[str] = []
+
+    def list_workers(self):
+        return {w: {"state": s, "running": True}
+                for w, s in self.running.items()}
+
+    def scale_up(self):
+        if not self.pool:
+            return None
+        wid = self.pool.pop(0)
+        self.running[wid] = "active"
+        return wid
+
+    def scale_down(self, worker_id):
+        self.running[worker_id] = "draining"
+        self.drained.append(worker_id)
+
+
+def make_scaler(signals_seq, provider=None, policy=None, t0=1000.0):
+    now = {"t": t0}
+    sig_iter = iter(signals_seq)
+    last = {"s": None}
+
+    def signals():
+        try:
+            last["s"] = next(sig_iter)
+        except StopIteration:
+            pass
+        return last["s"]
+
+    scaler = Autoscaler(signals, provider or FakeProvider(),
+                        policy=policy, clock=lambda: now["t"])
+    return scaler, now
+
+
+class TestAutoscaler:
+    POLICY = AutoscalePolicy(min_workers=0, max_workers=2,
+                             scale_up_depth=4.0, scale_down_depth=0.5,
+                             up_streak=2, down_streak=2,
+                             up_cooldown_s=10.0, down_cooldown_s=10.0)
+
+    def test_hysteresis_one_hot_tick_holds(self):
+        provider = FakeProvider()
+        scaler, now = make_scaler(
+            [FleetSignals(20, 0, active_workers=0),
+             FleetSignals(0, 0, active_workers=0)],
+            provider, self.POLICY)
+        assert scaler.evaluate().direction == "hold"   # streak 1 < 2
+        assert scaler.evaluate().direction == "hold"   # pressure gone
+        assert provider.running == {}
+
+    def test_sustained_pressure_scales_up_then_cooldown(self):
+        provider = FakeProvider()
+        sig = FleetSignals(20, 4, active_workers=0)
+        scaler, now = make_scaler([sig] * 10, provider, self.POLICY)
+        assert scaler.evaluate().direction == "hold"
+        d = scaler.evaluate()
+        assert (d.direction, d.worker_id) == ("up", "w1")
+        # still pressured, but the cooldown gates the next launch
+        assert scaler.evaluate().direction == "hold"
+        now["t"] += 11.0
+        d2 = scaler.evaluate()   # streak rebuilt during cooldown ticks
+        assert (d2.direction, d2.worker_id) == ("up", "w2")
+
+    def test_envelope_max_blocks(self):
+        provider = FakeProvider()
+        provider.running = {"w1": "active", "w2": "active"}
+        scaler, _ = make_scaler(
+            [FleetSignals(50, 0, active_workers=2)] * 3,
+            provider, self.POLICY)
+        scaler.evaluate()
+        assert scaler.evaluate().reason == "envelope_max"
+
+    def test_idle_fleet_drains_one_deterministically(self):
+        provider = FakeProvider()
+        provider.running = {"w1": "active", "w2": "active"}
+        scaler, _ = make_scaler(
+            [FleetSignals(0, 0, active_workers=2)] * 3,
+            provider, self.POLICY)
+        scaler.evaluate()
+        d = scaler.evaluate()
+        # scale-down is a DRAIN of the lexicographically-last active
+        assert (d.direction, d.worker_id) == ("down", "w2")
+        assert provider.drained == ["w2"]
+        assert provider.running["w2"] == "draining"
+
+    def test_envelope_min_blocks_drain(self):
+        pol = AutoscalePolicy(min_workers=1, max_workers=2,
+                              scale_up_depth=4.0, scale_down_depth=0.5,
+                              up_streak=2, down_streak=2,
+                              up_cooldown_s=0.0, down_cooldown_s=0.0)
+        provider = FakeProvider()
+        provider.running = {"w1": "active"}
+        scaler, _ = make_scaler(
+            [FleetSignals(0, 0, active_workers=1)] * 3, provider, pol)
+        scaler.evaluate()
+        assert scaler.evaluate().reason == "envelope_min"
+        assert provider.drained == []
+
+    def test_no_capacity_reported(self):
+        provider = FakeProvider(launchable=())
+        scaler, _ = make_scaler(
+            [FleetSignals(50, 0, active_workers=0)] * 3,
+            provider, self.POLICY)
+        scaler.evaluate()
+        assert scaler.evaluate().reason == "no_capacity"
+
+    def test_status_shape(self):
+        scaler, _ = make_scaler(
+            [FleetSignals(2, 1, active_workers=1)], FakeProvider(),
+            self.POLICY)
+        scaler.evaluate()
+        st = scaler.status()
+        assert st["pressure"] == 1.5
+        assert st["policy"]["max_workers"] == 2
+        assert st["recent_decisions"]
+
+
+class TestStepTimeSignal:
+    def test_step_time_p50_reads_merged_histogram(self):
+        """The autoscaler's latency context comes from the shared
+        cdt_sampler_step_seconds family (merged across pipelines)."""
+        from comfyui_distributed_tpu.cluster.elastic import _step_time_p50
+        from comfyui_distributed_tpu.telemetry import metrics as _tm
+
+        for _ in range(64):   # dominate whatever earlier tests observed
+            _tm.SAMPLER_STEP_SECONDS.labels(pipeline="txt2img").observe(0.05)
+        p50 = _step_time_p50()
+        assert p50 is not None and 0.0 < p50 <= 1.0
+
+
+# ---------------------------------------------------------------------------
+# drain coordinator
+# ---------------------------------------------------------------------------
+
+
+class TestDrainCoordinator:
+    def test_clean_drain_waits_for_inflight_then_decommissions(self):
+        async def body():
+            store = JobStore()
+            await store.init_tile_job("j", 2)
+            task = await store.request_work("j", "w0")
+            stopped = []
+            coord = DrainCoordinator(store, poll_interval=0.02,
+                                     process_stopper=lambda w:
+                                     stopped.append(w) or True)
+            report = coord.begin("w0", deadline_s=5.0)
+            assert report["phase"] == "draining"
+            assert DRAIN.is_draining("w0")
+            # the worker finishes its held task → drain completes clean
+            await asyncio.sleep(0.05)
+            await store.submit_result("j", "w0", task["task_id"],
+                                      {"image": np.zeros((1, 4, 4, 3))})
+            final = await coord.wait("w0")
+            assert final["phase"] == "decommissioned"
+            assert final["handed_back"] == {}
+            assert stopped == ["w0"]
+            assert DRAIN.state("w0") == DECOMMISSIONED
+        asyncio.run(body())
+
+    def test_deadline_handback_returns_held_work(self):
+        async def body():
+            store = JobStore()
+            await store.init_tile_job("j", 3)
+            t = await store.request_work("j", "w0")
+            coord = DrainCoordinator(store, poll_interval=0.02,
+                                     process_stopper=None)
+            coord.begin("w0", deadline_s=0.1)
+            final = await coord.wait("w0")
+            assert final["phase"] == "decommissioned"
+            assert final["handed_back"] == {"j": [t["task_id"]]}
+            assert len(store.tile_jobs["j"].pending) == 3
+            assert store.tile_jobs["j"].requeue_counts == {}
+        asyncio.run(body())
+
+    def test_undrain_cancels_and_reactivates(self):
+        async def body():
+            store = JobStore()
+            await store.init_tile_job("j", 2)
+            await store.request_work("j", "w0")
+            coord = DrainCoordinator(store, poll_interval=0.02)
+            coord.begin("w0", deadline_s=30.0)
+            await asyncio.sleep(0.05)
+            assert coord.undrain("w0")
+            await asyncio.sleep(0.05)
+            assert DRAIN.state("w0") == ACTIVE
+            # held work was NOT handed back — the worker is staying
+            assert store.tile_jobs["j"].assigned == {0: "w0"}
+            # the cancelled drain task must not clobber the verdict
+            # on its later CancelledError tick
+            assert coord.reports["w0"]["phase"] == "reactivated"
+        asyncio.run(body())
+
+    def test_begin_is_idempotent_while_draining(self):
+        async def body():
+            store = JobStore()
+            coord = DrainCoordinator(store, poll_interval=0.02)
+            r1 = coord.begin("w0", deadline_s=30.0)
+            r2 = coord.begin("w0", deadline_s=1.0)   # ignored
+            assert r1["deadline_s"] == r2["deadline_s"] == 30.0
+            coord.undrain("w0")
+        asyncio.run(body())
+
+
+# ---------------------------------------------------------------------------
+# HTTP surface + probe integration
+# ---------------------------------------------------------------------------
+
+
+def _serve_master():
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from comfyui_distributed_tpu.api.app import create_app
+    from comfyui_distributed_tpu.cluster.controller import Controller
+
+    controller = Controller()
+    return controller, TestClient(TestServer(create_app(controller)))
+
+
+class TestDrainRoutes:
+    def test_drain_route_stops_grants_and_probes(self, tmp_config):
+        async def body():
+            controller, client = _serve_master()
+            async with client:
+                store = controller.store
+                await store.init_tile_job("j", 4)
+                # pre-drain: w0 gets work
+                resp = await client.post(
+                    "/distributed/request_image",
+                    json={"job_id": "*", "worker_id": "w0"})
+                body0 = await resp.json()
+                assert body0["task"]["job_id"] == "j"
+
+                resp = await client.post(
+                    "/distributed/worker/w0/drain",
+                    json={"deadline_s": 0.2, "stop_process": False})
+                assert resp.status == 200
+                assert (await resp.json())["status"] == "draining"
+
+                # a draining worker is REFUSED work, explicitly
+                resp = await client.post(
+                    "/distributed/request_image",
+                    json={"job_id": "*", "worker_id": "w0"})
+                body1 = await resp.json()
+                assert body1 == {"task": None, "draining": True}
+
+                # probe fan-out skips it without probing or breaker harm
+                from comfyui_distributed_tpu.cluster.dispatch import (
+                    select_active_hosts)
+
+                online, offline = await select_active_hosts(
+                    [{"id": "w0", "host": "127.0.0.1", "port": 1}])
+                assert online == []
+                assert offline[0]["_drain"] == DRAINING
+                assert BREAKERS.state("w0") == "closed"
+
+                # deadline passes → handback + decommission, visible on
+                # the status surface
+                await controller.elastic.coordinator.wait("w0")
+                resp = await client.get("/distributed/elastic")
+                st = await resp.json()
+                assert st["drain"]["states"]["w0"] == DECOMMISSIONED
+                report = st["drain"]["reports"]["w0"]
+                assert report["handed_back"] == {"j": [0]}
+                assert len(store.tile_jobs["j"].pending) == 4
+
+                # undrain re-admits
+                resp = await client.post("/distributed/worker/w0/undrain",
+                                         json={})
+                assert (await resp.json())["cleared"] is True
+                resp = await client.post(
+                    "/distributed/request_image",
+                    json={"job_id": "*", "worker_id": "w0"})
+                assert (await resp.json())["task"] is not None
+        asyncio.run(body())
+
+    def test_drain_route_validates_deadline(self, tmp_config):
+        async def body():
+            _, client = _serve_master()
+            async with client:
+                resp = await client.post(
+                    "/distributed/worker/w0/drain",
+                    json={"deadline_s": "soon"})
+                assert resp.status == 400
+                resp = await client.post(
+                    "/distributed/worker/w0/drain",
+                    json={"deadline_s": -1})
+                assert resp.status == 400
+        asyncio.run(body())
+
+    def test_local_worker_status_carries_drain_state(self, tmp_config):
+        async def body():
+            controller, client = _serve_master()
+            async with client:
+                DRAIN.mark_draining("w7")
+                from comfyui_distributed_tpu.utils.config import (
+                    load_config, update_config)
+
+                update_config(lambda c: c.update(hosts=[
+                    {"id": "w7", "type": "local", "host": "127.0.0.1",
+                     "port": 1, "enabled": True}]))
+                resp = await client.get("/distributed/local-worker-status")
+                workers = (await resp.json())["workers"]
+                assert workers["w7"]["drain"] == DRAINING
+        asyncio.run(body())
+
+
+class TestStealWorkerLoop:
+    def test_steal_loop_serves_both_jobs_and_hands_back_unknown(
+            self, tmp_config):
+        from comfyui_distributed_tpu.cluster.tile_farm import (
+            TileFarm, assemble_tiles)
+
+        async def body():
+            controller, client = _serve_master()
+            async with client:
+                base = f"http://127.0.0.1:{client.port}"
+                farm = controller.tile_farm
+                proc_a, proc_b = make_proc(1.5), make_proc(-2.0)
+                mA = asyncio.create_task(farm.master_run_async(
+                    "jobA", total=6, process_fn=make_proc(1.5, delay=0.2),
+                    chunk=1, heartbeat_interval=0.2))
+                mB = asyncio.create_task(farm.master_run_async(
+                    "jobB", total=6, process_fn=make_proc(-2.0, delay=0.2),
+                    chunk=1, heartbeat_interval=0.2))
+                await asyncio.sleep(0.05)
+
+                worker_farm = TileFarm(JobStore(),
+                                       asyncio.get_running_loop())
+                resolve = {"jobA": proc_a, "jobB": proc_b}.get
+                done = await worker_farm.worker_steal_run_async(
+                    "w0", base, resolve, idle_polls=2, idle_interval=0.1)
+                resA, resB = await asyncio.gather(mA, mB)
+                # the one steal worker served BOTH jobs
+                assert set(done) == {"jobA", "jobB"}
+                assert sum(done.values()) > 0
+                outA = assemble_tiles(resA, 6, 1)
+                outB = assemble_tiles(resB, 6, 1)
+                np.testing.assert_array_equal(outA, np.concatenate(
+                    [proc_a(i, i + 1) for i in range(6)]))
+                np.testing.assert_array_equal(outB, np.concatenate(
+                    [proc_b(i, i + 1) for i in range(6)]))
+        asyncio.run(body())
+
+    def test_unservable_grant_is_handed_back(self, tmp_config):
+        from comfyui_distributed_tpu.cluster.tile_farm import TileFarm
+
+        async def body():
+            controller, client = _serve_master()
+            async with client:
+                base = f"http://127.0.0.1:{client.port}"
+                store = controller.store
+                await store.init_tile_job("alien", 2)
+                worker_farm = TileFarm(JobStore(),
+                                       asyncio.get_running_loop())
+                done = await worker_farm.worker_steal_run_async(
+                    "w0", base, lambda jid: None,
+                    idle_polls=1, idle_interval=0.05)
+                assert done == {}
+                # the grant went back to the queue, uncounted
+                job = store.tile_jobs["alien"]
+                assert len(job.pending) == 2
+                assert job.assigned == {}
+                assert job.requeue_counts == {}
+        asyncio.run(body())
+
+
+    def test_unservable_job_does_not_starve_servable_ones(self,
+                                                          tmp_config):
+        """Regression: the worker sends its can't-serve list with every
+        "*" pull, so a top-ranked unservable job can't ping-pong its
+        grant and starve the servable jobs ranked below it."""
+        from comfyui_distributed_tpu.cluster.tile_farm import TileFarm
+
+        async def body():
+            controller, client = _serve_master()
+            async with client:
+                base = f"http://127.0.0.1:{client.port}"
+                store = controller.store
+                # A ranks first (deepest pending, no workers) but the
+                # worker lacks its weights; B must still fully drain
+                await store.init_tile_job("A", 8)
+                await store.init_tile_job("B", 3)
+                worker_farm = TileFarm(JobStore(),
+                                       asyncio.get_running_loop())
+                resolve = {"B": make_proc(2.0)}.get
+                done = await worker_farm.worker_steal_run_async(
+                    "w0", base, resolve, idle_polls=2, idle_interval=0.05)
+                assert done == {"B": 3}
+                assert len(store.tile_jobs["B"].completed) == 3
+                # A untouched: its grant was handed back, uncounted
+                job_a = store.tile_jobs["A"]
+                assert len(job_a.pending) == 8
+                assert job_a.assigned == {} and job_a.requeue_counts == {}
+        asyncio.run(body())
+
+    def test_steal_loop_heartbeats_every_buffered_job(self, tmp_config,
+                                                      monkeypatch):
+        """Regression: a steal worker holding UNFLUSHED results for job A
+        while the scheduler has it grinding job B must keep heartbeating
+        A — or A's monitor would falsely evict it through the failure
+        path with its results sitting in the buffer."""
+        from comfyui_distributed_tpu.cluster.tile_farm import TileFarm
+
+        beats: list[str] = []
+
+        async def spy_heartbeat(self, session, base, job_id, worker_id):
+            beats.append(job_id)
+
+        monkeypatch.setattr(TileFarm, "_heartbeat", spy_heartbeat)
+
+        async def body():
+            controller, client = _serve_master()
+            async with client:
+                base = f"http://127.0.0.1:{client.port}"
+                store = controller.store
+                # A has ONE task; B has several — after A's single grant
+                # the loop works B while A's result stays buffered
+                # (max_batch high enough that nothing flushes mid-run)
+                await store.init_tile_job("A", 1)
+                await store.init_tile_job("B", 4)
+                worker_farm = TileFarm(JobStore(),
+                                       asyncio.get_running_loop())
+                resolve = {"A": make_proc(1.0), "B": make_proc(2.0)}.get
+                done = await worker_farm.worker_steal_run_async(
+                    "w0", base, resolve, max_batch=100,
+                    idle_polls=1, idle_interval=0.05)
+                assert done == {"A": 1, "B": 4}
+                # every post-A tick heartbeated A as well as B
+                a_beats = beats.count("A")
+                assert a_beats >= 4, beats
+        asyncio.run(body())
+
+    def test_drain_breaks_steal_loop_immediately(self, tmp_config):
+        """Regression: the master marking a steal worker draining must
+        end its pull loop NOW (flushing buffered work), not after the
+        idle-poll budget — with the budget below set to minutes, a
+        prompt exit is only possible via the draining signal."""
+        from comfyui_distributed_tpu.cluster.tile_farm import TileFarm
+
+        async def body():
+            controller, client = _serve_master()
+            async with client:
+                base = f"http://127.0.0.1:{client.port}"
+                store = controller.store
+                await store.init_tile_job("j", 50)
+
+                def proc(start, end):
+                    # drain w0 from inside its second tile: the NEXT
+                    # pull must come back "draining" and end the loop
+                    if start == 1:
+                        DRAIN.mark_draining("w0")
+                    return make_proc(1.0)(start, end)
+
+                worker_farm = TileFarm(JobStore(),
+                                       asyncio.get_running_loop())
+                t0 = asyncio.get_event_loop().time()
+                done = await asyncio.wait_for(
+                    worker_farm.worker_steal_run_async(
+                        "w0", base, lambda jid: proc, max_batch=100,
+                        idle_polls=100, idle_interval=2.0),
+                    timeout=30)
+                elapsed = asyncio.get_event_loop().time() - t0
+                # exited promptly (not 100 × 2 s of idle polling), and
+                # the buffered results were flushed on the way out
+                assert elapsed < 10
+                assert done == {"j": 2}
+                assert len(store.tile_jobs["j"].completed) == 2
+        asyncio.run(body())
+
+
+# ---------------------------------------------------------------------------
+# chaos acceptance: a full scale event, bit-identical
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.chaos
+class TestChaosScaleEvent:
+    """ISSUE 10 acceptance: a 3-worker mixed two-job run that scales up
+    to 4 (the new worker steals pending tiles from the open jobs), drains
+    one worker mid-job (deadline handback), and rolling-restarts another
+    (drain → undrain → rejoin under the same id) completes with zero
+    admitted-job loss, bit-identical outputs vs the static-fleet run,
+    zero dead-letters, and NO breaker ever opening — every departure in
+    this run is intentional."""
+
+    TOTALS = {"sdxl": 30, "usdu": 20}
+
+    def _reference(self):
+        from comfyui_distributed_tpu.cluster.tile_farm import (
+            TileFarm, assemble_tiles)
+
+        async def body():
+            out = {}
+            for jid, total in self.TOTALS.items():
+                farm = TileFarm(JobStore(), asyncio.get_running_loop())
+                res = await farm.master_run_async(
+                    f"ref-{jid}", total=total,
+                    process_fn=self._proc(jid), chunk=1,
+                    heartbeat_interval=0.2)
+                out[jid] = assemble_tiles(res, total, 1)
+            return out
+        return asyncio.run(body())
+
+    @staticmethod
+    def _proc(jid, delay=0.0):
+        return make_proc(1.5 if jid == "sdxl" else -2.0, delay=delay)
+
+    def test_scale_event_is_lossless_and_bit_identical(self, tmp_config):
+        from comfyui_distributed_tpu.cluster.tile_farm import (
+            TileFarm, assemble_tiles)
+
+        ref = self._reference()
+
+        async def chaotic():
+            controller, client = _serve_master()
+            async with client:
+                base = f"http://127.0.0.1:{client.port}"
+                loop = asyncio.get_running_loop()
+                # workers pay a small per-tile cost so the run is still
+                # mid-flight when the scale events land (values depend
+                # only on the global index — delay can't change bits)
+                resolve = {"sdxl": self._proc("sdxl", delay=0.05),
+                           "usdu": self._proc("usdu", delay=0.05)}.get
+
+                def steal_worker(wid):
+                    farm = TileFarm(JobStore(), loop)
+                    return farm.worker_steal_run_async(
+                        wid, base, resolve, idle_polls=3,
+                        idle_interval=0.1)
+
+                # the master grinds slowly so the fleet does real work;
+                # worker_timeout is generous — NOTHING in this run may
+                # leave via the failure path
+                masters = [asyncio.create_task(
+                    controller.tile_farm.master_run_async(
+                        jid, total=total,
+                        process_fn=self._proc(jid, delay=0.2), chunk=1,
+                        heartbeat_interval=0.2, worker_timeout=30.0))
+                    for jid, total in self.TOTALS.items()]
+                await asyncio.sleep(0.05)   # jobs seeded
+
+                # --- the 3-worker fleet: w1 and w2 pull work and HOLD it
+                async def hold(wid, n):
+                    held = []
+                    for _ in range(n):
+                        async with client.session.post(
+                                f"{base}/distributed/request_image",
+                                json={"job_id": "*",
+                                      "worker_id": wid}) as r:
+                            t = (await r.json())["task"]
+                            if t:
+                                held.append((t["job_id"], t["task_id"]))
+                    return held
+
+                held1 = await hold("w1", 2)
+                held2 = await hold("w2", 1)
+                assert held1 and held2
+                w0_task = asyncio.create_task(steal_worker("w0"))
+
+                # --- scale-down: drain w1 while it HOLDS work; the
+                # deadline handback returns its tiles to the queue
+                async with client.session.post(
+                        f"{base}/distributed/worker/w1/drain",
+                        json={"deadline_s": 0.2,
+                              "stop_process": False}) as r:
+                    assert r.status == 200
+                await controller.elastic.coordinator.wait("w1")
+                handed1 = controller.elastic.coordinator.reports[
+                    "w1"]["handed_back"]
+                assert sum(map(len, handed1.values())) == len(held1)
+
+                # --- rolling restart, phase 1: drain w2 (its held tile
+                # comes back via handback); the restarted generation
+                # rejoins AFTER the scale-up below
+                async with client.session.post(
+                        f"{base}/distributed/worker/w2/drain",
+                        json={"deadline_s": 0.2,
+                              "stop_process": False}) as r:
+                    assert r.status == 200
+                await controller.elastic.coordinator.wait("w2")
+
+                # --- scale-up to 4: the AUTOSCALER launches w3 off the
+                # real queue-depth signal; the provider's launch starts
+                # a steal loop, which immediately picks up pending tiles
+                launched: dict[str, asyncio.Task] = {}
+
+                class TestProvider:
+                    def list_workers(self):
+                        return {w: {"state": DRAIN.state(w),
+                                    "running": True} for w in launched}
+
+                    def scale_up(self):
+                        wid = f"w{3 + len(launched)}"
+                        launched[wid] = asyncio.create_task(
+                            steal_worker(wid))
+                        return wid
+
+                    def scale_down(self, wid):
+                        controller.elastic.coordinator.begin(wid)
+
+                def signals():
+                    depth = sum(len(j.pending) for j in
+                                controller.store.tile_jobs.values())
+                    return FleetSignals(queue_depth=0, tile_depth=depth,
+                                        active_workers=len(launched))
+
+                scaler = Autoscaler(
+                    signals, TestProvider(),
+                    policy=AutoscalePolicy(max_workers=1, up_streak=2,
+                                           up_cooldown_s=0.0))
+                decisions = [scaler.evaluate() for _ in range(3)]
+                assert [d.direction for d in decisions].count("up") == 1
+                assert "w3" in launched
+
+                # --- rolling restart, phase 2: w2 rejoins under the
+                # same id (undrain) once the new capacity is up
+                async with client.session.post(
+                        f"{base}/distributed/worker/w2/undrain",
+                        json={}) as r:
+                    assert (await r.json())["cleared"] is True
+                w2_task = asyncio.create_task(steal_worker("w2"))
+
+                results = await asyncio.gather(*masters)
+                done3 = await asyncio.wait_for(launched["w3"], timeout=60)
+                assert sum(done3.values()) > 0, \
+                    "scale-up worker stole nothing"
+                await asyncio.gather(w0_task, w2_task)
+
+                # --- acceptance ---------------------------------------
+                for (jid, total), res in zip(self.TOTALS.items(), results):
+                    out = assemble_tiles(res, total, 1)
+                    np.testing.assert_array_equal(out, ref[jid])
+                for jid in self.TOTALS:
+                    async with client.session.get(
+                            f"{base}/distributed/job_status",
+                            params={"job_id": jid}) as r:
+                        status = await r.json()
+                    assert status["finished"] is True
+                    assert status["dead_letter"] == []
+                    assert status["completed"] == self.TOTALS[jid]
+                # no breaker ever opened: every departure was intentional
+                assert all(s == "closed"
+                           for s in BREAKERS.states().values()), \
+                    BREAKERS.states()
+                assert DRAIN.state("w1") == DECOMMISSIONED
+                assert DRAIN.state("w2") == ACTIVE
+        asyncio.run(chaotic())

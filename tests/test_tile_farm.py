@@ -503,3 +503,126 @@ class TestJournalResume:
             # journal cleared after success
             assert not (tmp_path / "jres").exists()
         run(body())
+
+
+class TestDrainEvictionInterplay:
+    """Heartbeat eviction vs. graceful drain (cluster/elastic, ISSUE 10):
+    a worker that is DRAINING and then goes silent must have its held
+    tiles returned to the queue EXACTLY once — whichever of the eviction
+    monitor or the drain coordinator's handback gets there first — with
+    no poison-bound count, no dead-letter, and no breaker trip."""
+
+    def _serve_master(self):
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from comfyui_distributed_tpu.api.app import create_app
+
+        controller = Controller()
+        app = create_app(controller)
+        return controller, TestClient(TestServer(app))
+
+    def test_draining_then_silent_requeues_exactly_once(self, tmp_config):
+        from comfyui_distributed_tpu.cluster.job_timeout import (
+            check_and_requeue_timed_out_workers)
+        from comfyui_distributed_tpu.cluster.resilience import BREAKERS
+
+        async def body():
+            controller, client = self._serve_master()
+            async with client:
+                base = f"http://127.0.0.1:{client.port}"
+                store = controller.store
+                master_task = asyncio.create_task(
+                    controller.tile_farm.master_run_async(
+                        "jdrain", total=8,
+                        process_fn=make_proc(delay=0.05), chunk=2,
+                        heartbeat_interval=5.0, worker_timeout=30.0))
+                await asyncio.sleep(0.05)
+
+                # the worker pulls two tasks over the wire, then drains
+                # with a LONG deadline and goes silent holding both
+                held = []
+                for _ in range(2):
+                    async with client.session.post(
+                            f"{base}/distributed/request_image",
+                            json={"job_id": "jdrain",
+                                  "worker_id": "wd"}) as r:
+                        held.append((await r.json())["task"]["task_id"])
+                async with client.session.post(
+                        f"{base}/distributed/worker/wd/drain",
+                        json={"deadline_s": 30.0,
+                              "stop_process": False}) as r:
+                    assert r.status == 200
+
+                # the eviction monitor finds it silent FIRST: handback
+                # accounting — requeued, uncounted, breaker untouched.
+                # The busy-probe grace spares the (mid-task) master, as
+                # in production; the drained worker probes dead.
+                async def probe(worker_id):
+                    return ({"queue_remaining": 1}
+                            if worker_id == "master" else None)
+
+                evicted = await check_and_requeue_timed_out_workers(
+                    store, "jdrain", timeout=0.0, probe_fn=probe,
+                    now=asyncio.get_event_loop().time() + 100)
+                assert sorted(evicted["wd"]) == sorted(held)
+                job = store.tile_jobs["jdrain"]
+                assert job.requeue_counts == {}
+                assert job.dead_letter == {}
+                assert BREAKERS.state("wd") == "closed"
+
+                # the drain coordinator then finds NOTHING left to hand
+                # back (exactly-once) and decommissions cleanly
+                await controller.elastic.coordinator.wait("wd")
+                report = controller.elastic.coordinator.reports["wd"]
+                assert report["phase"] == "decommissioned"
+                assert report["handed_back"] == {}
+
+                results = await asyncio.wait_for(master_task, timeout=60)
+                tiles = assemble_tiles(results, 8, 2)
+                np.testing.assert_allclose(tiles[:, 0, 0, 0],
+                                           np.arange(8.0))
+                async with client.session.get(
+                        f"{base}/distributed/job_status",
+                        params={"job_id": "jdrain"}) as r:
+                    status = await r.json()
+                assert status["dead_letter"] == []
+                assert status["completed"] == 4
+                assert BREAKERS.state("wd") == "closed"
+        run(body())
+
+    def test_repeated_drain_departures_never_dead_letter(
+            self, tmp_config, monkeypatch):
+        """Intentional departures do not consume the poison bound: the
+        same task surviving MORE drain-evictions than MAX_TILE_REQUEUES
+        stays live (only failure-path requeues count)."""
+        from comfyui_distributed_tpu.cluster.elastic.states import DRAIN
+        from comfyui_distributed_tpu.cluster.job_timeout import (
+            check_and_requeue_timed_out_workers)
+        from comfyui_distributed_tpu.cluster.resilience import BREAKERS
+        from comfyui_distributed_tpu.utils import constants
+
+        monkeypatch.setattr(constants, "MAX_TILE_REQUEUES", 1)
+
+        async def body():
+            store = JobStore()
+            await store.init_tile_job("j", 2, chunk=1)
+            for round_no in range(3):   # 3 > MAX_TILE_REQUEUES
+                task = await store.request_work("j", "wloop")
+                assert task is not None and task["task_id"] == 0
+                DRAIN.mark_draining("wloop")
+                evicted = await check_and_requeue_timed_out_workers(
+                    store, "j", timeout=0.0, now=1e9)
+                assert evicted["wloop"] == [0]
+                DRAIN.reactivate("wloop")   # the next generation rejoins
+            job = store.tile_jobs["j"]
+            assert job.dead_letter == {}
+            assert job.requeue_counts == {}
+            assert BREAKERS.state("wloop") == "closed"
+            # control: one real (non-drain) eviction past the bound
+            # still dead-letters — the poison path is intact
+            await store.request_work("j", "wbad")
+            await store.requeue_worker_tasks("j", "wbad")
+            await store.request_work("j", "wbad")
+            await store.requeue_worker_tasks("j", "wbad")
+            assert 0 in job.dead_letter
+        run(body())
