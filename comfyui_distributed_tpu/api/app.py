@@ -46,6 +46,7 @@ _CORS_SAFE_PATHS = frozenset({
     "/distributed/metrics",
     "/distributed/metrics.json",
     "/distributed/frontdoor",
+    "/distributed/cache",
     "/prompt",
 })
 
@@ -289,6 +290,7 @@ def create_app(controller: Controller) -> web.Application:
             "trace_id": res.trace_id,
             "outcome": res.outcome,
             "batched": res.batched,
+            "coalesced": res.coalesced,
         })
 
     async def frontdoor_stats(request):
@@ -297,8 +299,28 @@ def create_app(controller: Controller) -> web.Application:
             return web.json_response({"enabled": False})
         return web.json_response(fd.stats())
 
+    # --- content cache (cluster/cache, docs/caching.md) --------------------
+    async def cache_stats(request):
+        cache = getattr(controller, "cache", None)
+        if cache is None:
+            return web.json_response({"enabled": False})
+        return web.json_response(cache.stats())
+
+    async def cache_clear(request):
+        """Operator invalidation: drop both in-memory tiers (persisted
+        entries are keyed content-addressed and stay valid; delete
+        CDT_CACHE_DIR to invalidate them — docs/caching.md)."""
+        cache = getattr(controller, "cache", None)
+        if cache is None:
+            return web.json_response({"enabled": False})
+        dropped = (cache.conditioning.clear_memory()
+                   + cache.results.clear_memory())
+        return web.json_response({"status": "cleared", "dropped": dropped})
+
     r.add_post("/distributed/queue", distributed_queue)
     r.add_get("/distributed/frontdoor", frontdoor_stats)
+    r.add_get("/distributed/cache", cache_stats)
+    r.add_post("/distributed/cache/clear", cache_clear)
 
     # --- collector ingest (reference api/job_routes.py:273-343) ------------
     async def job_complete(request):

@@ -11,8 +11,8 @@ from typing import Any, Optional
 
 from ..utils import constants
 from ..utils.exceptions import ValidationError
-from .schemas import (validate_deadline_ms, validate_priority,
-                      validate_tenant)
+from .schemas import (validate_cache_mode, validate_deadline_ms,
+                      validate_priority, validate_tenant)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -28,6 +28,8 @@ class QueueRequestPayload:
     tenant: str = constants.DEFAULT_TENANT
     priority: str = constants.DEFAULT_PRIORITY
     deadline_ms: Optional[int] = None
+    # content-cache mode (docs/caching.md): "use" | "bypass"
+    cache: str = "use"
 
 
 def parse_queue_request_payload(payload: Any) -> QueueRequestPayload:
@@ -65,6 +67,7 @@ def parse_queue_request_payload(payload: Any) -> QueueRequestPayload:
     deadline_ms = payload.get("deadline_ms")
     if deadline_ms is not None:
         deadline_ms = validate_deadline_ms(deadline_ms)
+    cache = validate_cache_mode(payload.get("cache", "use"))
 
     return QueueRequestPayload(
         prompt=prompt,
@@ -76,4 +79,5 @@ def parse_queue_request_payload(payload: Any) -> QueueRequestPayload:
         tenant=tenant,
         priority=priority,
         deadline_ms=deadline_ms,
+        cache=cache,
     )

@@ -67,6 +67,19 @@ def validate_priority(value: Any) -> str:
     return value
 
 
+def validate_cache_mode(value: Any) -> str:
+    """Per-request content-cache escape hatch (docs/caching.md):
+    ``use`` (default) serves from / coalesces onto the cache; ``bypass``
+    forces a fresh execution (which still refreshes the entry)."""
+    from ..cluster.cache import CACHE_MODES
+
+    if value not in CACHE_MODES:
+        raise ValidationError(
+            f"'cache' must be one of {list(CACHE_MODES)}, got {value!r}",
+            field="cache")
+    return value
+
+
 def validate_deadline_ms(value: Any) -> int:
     if isinstance(value, bool) or not isinstance(value, int) or value <= 0:
         raise ValidationError(

@@ -1,0 +1,152 @@
+"""Content-addressed inference caching + duplicate-request coalescing
+(ROADMAP item 2, docs/caching.md).
+
+At production traffic the request stream is heavily redundant — identical
+prompts, shared negative prompts, seed re-rolls of the same workflow —
+yet without this package every admitted request pays a full text-encode
+and byte-identical submissions pay a full denoise. Three tiers stop the
+fleet recomputing what it already knows:
+
+- **conditioning** (:mod:`conditioning`): ``encode()`` memoized on
+  (encoder identity, token ids, tokenization mode) — CLIP/T5 text
+  encode runs once per unique prompt, fleet-wide via the persisted tier.
+- **in-flight coalescing** (:mod:`coalesce`): byte-identical requests
+  submitted while their twin executes become waiters on ONE execution,
+  each with its own per-request history entry.
+- **result** (:mod:`store` via the front door's microbatch executor):
+  the sampler-program output (denoise + decode) keyed on the full
+  request fingerprint × execution signature. Sound because PRs 6–7
+  established bit-identity invariants for batched and churned execution
+  of exactly the classifier-proven deterministic request class this
+  cache serves.
+
+Every hit frees a TPU slot for non-redundant work, so the hit rate is
+wired into the elastic autoscaler's pressure signal
+(``cluster/elastic``): a hot cache scales the fleet *down*.
+
+Persistence follows ``utils/jsonio`` atomic-merge plus checksummed
+binary sidecars (:mod:`store`); corruption is rejected loudly and
+recomputed, never served. ``CDT_CACHE=0`` removes the subsystem;
+per-request ``cache: "bypass"`` skips serving (but still fills) for one
+request. Eviction is size-capped LRU with pinning, mirroring
+``cluster/residency``.
+"""
+
+from __future__ import annotations
+
+import os
+from collections import deque
+from pathlib import Path
+from typing import Optional
+
+from ...utils import constants
+from ...utils.logging import log
+from .coalesce import InflightCoalescer
+from .conditioning import cached_encode
+from .keys import (conditioning_key, execution_signature,
+                   request_fingerprint, result_key)
+from .store import CacheTier
+
+__all__ = [
+    "CacheManager", "CacheTier", "InflightCoalescer", "build_cache_manager",
+    "cache_enabled", "cached_encode", "conditioning_key",
+    "execution_signature", "request_fingerprint", "result_key",
+]
+
+CACHE_MODES = ("use", "bypass")
+
+
+def cache_enabled() -> bool:
+    return os.environ.get("CDT_CACHE", "1") not in ("0", "false")
+
+
+def cache_dir() -> Optional[Path]:
+    """Resolved persisted-tier directory: ``CDT_CACHE_DIR``, defaulting
+    to a ``content_cache`` sibling of the XLA compile cache (the same
+    shared volume a fleet already mounts for warm restarts). Empty
+    string = memory-only."""
+    env = os.environ.get("CDT_CACHE_DIR")
+    if env is not None:
+        return Path(env) if env else None
+    from ...utils.compile_cache import cache_dir_default
+
+    return Path(cache_dir_default()).parent / "content_cache"
+
+
+class _HitRateWindow:
+    """Sliding window over recent QUEUED-request cache outcomes (a
+    fingerprinted member served by the result tier vs executed). Feeds
+    the autoscaler's pressure discount — instantaneous, not lifetime, so
+    a cold restart doesn't inherit yesterday's optimism. Coalesced joins
+    deliberately do NOT count: a waiter never occupies a queue slot, so
+    folding the coalesce rate in would discount depth that the
+    duplicates already aren't part of (double-counting)."""
+
+    def __init__(self, size: int = 256):
+        self._events: deque = deque(maxlen=size)
+
+    def record(self, hit: bool) -> None:
+        self._events.append(1 if hit else 0)
+
+    def rate(self) -> float:
+        if not self._events:
+            return 0.0
+        return sum(self._events) / len(self._events)
+
+
+class CacheManager:
+    """One controller's cache surface: both tiers + the coalescer +
+    the request-level hit-rate window the autoscaler reads."""
+
+    def __init__(self, directory: "Path | None" = None):
+        self.dir = directory
+        self.conditioning = CacheTier(
+            "conditioning", constants.CACHE_COND_MAX_BYTES,
+            directory=directory,
+            disk_max_bytes=constants.CACHE_DISK_MAX_BYTES)
+        self.results = CacheTier(
+            "result", constants.CACHE_RESULT_MAX_BYTES,
+            directory=directory,
+            disk_max_bytes=constants.CACHE_DISK_MAX_BYTES)
+        self.coalescer = InflightCoalescer()
+        self._window = _HitRateWindow()
+
+    # --- request-level outcomes (autoscaler signal) -------------------------
+
+    def record_request(self, hit: bool) -> None:
+        self._window.record(hit)
+
+    def hit_rate(self) -> float:
+        """Fraction of recent QUEUED fingerprinted requests the result
+        tier answered without a sampler program — the autoscaler's
+        queue-depth discount (coalesced joins are excluded; they never
+        enter the queue)."""
+        return self._window.rate()
+
+    # --- introspection ------------------------------------------------------
+
+    def stats(self) -> dict:
+        return {
+            "enabled": True,
+            "dir": str(self.dir) if self.dir else None,
+            "hit_rate": round(self.hit_rate(), 4),
+            "conditioning": self.conditioning.stats(),
+            "result": self.results.stats(),
+            "coalescer": self.coalescer.stats(),
+        }
+
+
+def build_cache_manager() -> Optional[CacheManager]:
+    """Controller hook: the cache manager, or None under ``CDT_CACHE=0``."""
+    if not cache_enabled():
+        log("content cache disabled (CDT_CACHE=0)")
+        return None
+    d = cache_dir()
+    if d is not None:
+        try:
+            d.mkdir(parents=True, exist_ok=True)
+        except OSError as e:
+            log(f"content cache: persisted tier OFF ({d}: {e}) — "
+                "memory-only")
+            d = None
+    return CacheManager(directory=d)

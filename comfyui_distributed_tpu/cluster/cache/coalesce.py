@@ -1,0 +1,161 @@
+"""Duplicate-request coalescing: one execution, N waiters.
+
+At production traffic, byte-identical submissions arrive *concurrently*
+— seed re-rolls re-submitted by impatient clients, gallery pages
+re-requesting the same workflow, load balancers retrying. The result
+cache only helps once a computation has finished; the coalescer closes
+the window before that: the FIRST submission of a fingerprint becomes
+the **leader** and executes normally, every byte-identical submission
+that arrives while it is in flight becomes a **waiter** — admitted,
+given its own prompt id, but never enqueued. When the leader reaches a
+terminal history entry, the front door copies it to every waiter (each
+gets its own per-request history row, marked with the leader it rode).
+
+Soundness leans on the same invariant as the result cache: the
+classifier only fingerprints the deterministic-batchable request class,
+for which PR 6 established bit-identical execution — so the leader's
+bytes ARE the waiter's bytes.
+
+Runs entirely on the controller's event loop (submit and the job-done
+callback are both loop-side), so no locking is needed; the width
+histogram (``cdt_coalesce_width``) records how many requests each
+executed program actually answered.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Callable, Optional
+
+
+@dataclasses.dataclass
+class _Waiter:
+    member: object            # PromptJob
+    group_key: object         # classifier.GroupKey (for re-dispatch)
+    sampler_node_id: str
+
+
+@dataclasses.dataclass
+class _Flight:
+    leader_id: str
+    waiters: "list[_Waiter]" = dataclasses.field(default_factory=list)
+    opened_at: float = dataclasses.field(default_factory=time.monotonic)
+
+
+class InflightCoalescer:
+    def __init__(self, clock: Callable[[], float] = time.monotonic):
+        self._clock = clock
+        self._flights: dict[str, _Flight] = {}
+        self.resolved_flights = 0
+        self.coalesced_waiters = 0
+        self.redispatched_waiters = 0
+
+    # --- producer side (front door submit) ----------------------------------
+
+    def lead(self, fingerprint: str, prompt_id: str) -> None:
+        """Register the leader for a fingerprint. First writer wins — a
+        bypass request executing the same bytes concurrently simply is
+        not a leader."""
+        if fingerprint not in self._flights:
+            self._flights[fingerprint] = _Flight(leader_id=prompt_id)
+
+    def join(self, fingerprint: str, member, group_key=None,
+             sampler_node_id: str = "") -> bool:
+        """Attach ``member`` (a PromptJob) as a waiter on an in-flight
+        leader. False = nothing in flight, caller must execute.
+        ``group_key``/``sampler_node_id`` let an expired-leader waiter be
+        re-dispatched through the batcher instead of inheriting a
+        deadline verdict that was never its own."""
+        flight = self._flights.get(fingerprint)
+        if flight is None:
+            return False
+        flight.waiters.append(_Waiter(member, group_key, sampler_node_id))
+        return True
+
+    # --- consumer side (job-done callback) ----------------------------------
+
+    def resolve(self, history: dict,
+                redispatch: Optional[Callable] = None) -> int:
+        """Settle every flight whose leader has a terminal history entry.
+        Per waiter, in order of precedence:
+
+        - the waiter's OWN deadline already passed → its row is
+          ``expired`` (deadline_ms is a freshness contract; a result
+          delivered late is exactly what it forbids — a solo submission
+          would have been recorded expired too);
+        - the leader expired → the waiter did NOT ask for that deadline:
+          re-dispatch it through ``redispatch(member, group_key,
+          sampler_node_id)`` as a fresh execution (without a redispatch
+          hook it errors loudly rather than inheriting the verdict);
+        - otherwise (success / error / interrupted — the execution's own
+          outcome, identical for a queued solo twin) → copy the leader's
+          row with a ``coalesced_with`` marker.
+
+        Returns waiters settled (re-dispatched ones are settled later,
+        by their new flight)."""
+        settled = 0
+        now = self._clock()
+        for fp in list(self._flights):
+            flight = self._flights[fp]
+            entry = history.get(flight.leader_id)
+            if entry is None:
+                continue
+            del self._flights[fp]
+            width = 1 + len(flight.waiters)
+            for waiter in flight.waiters:
+                member = waiter.member
+                if getattr(member, "expired", lambda _n: False)(now):
+                    history[member.prompt_id] = {
+                        "status": "expired", "duration": 0.0,
+                        "error": "deadline_ms elapsed before execution",
+                        "coalesced_with": flight.leader_id,
+                    }
+                elif entry.get("status") == "expired":
+                    if redispatch is not None:
+                        self.redispatched_waiters += 1
+                        redispatch(member, waiter.group_key,
+                                   waiter.sampler_node_id)
+                        continue
+                    history[member.prompt_id] = {
+                        "status": "error", "duration": 0.0,
+                        "error": "coalesced leader expired and no "
+                                 "redispatch hook is installed",
+                    }
+                else:
+                    row = dict(entry)
+                    row["coalesced_with"] = flight.leader_id
+                    history[member.prompt_id] = row
+                settled += 1
+            self.resolved_flights += 1
+            self.coalesced_waiters += len(flight.waiters)
+            self._observe_width(width)
+        return settled
+
+    def _observe_width(self, width: int) -> None:
+        try:
+            from ... import telemetry
+            from ...telemetry import metrics as _tm
+
+            if telemetry.enabled():
+                _tm.COALESCE_WIDTH.observe(width)
+        except Exception:  # noqa: BLE001 — telemetry is never load-bearing
+            pass
+
+    # --- introspection ------------------------------------------------------
+
+    @property
+    def inflight(self) -> int:
+        return len(self._flights)
+
+    @property
+    def pending_waiters(self) -> int:
+        return sum(len(f.waiters) for f in self._flights.values())
+
+    def stats(self) -> dict:
+        return {
+            "inflight": self.inflight,
+            "pending_waiters": self.pending_waiters,
+            "resolved_flights": self.resolved_flights,
+            "coalesced_waiters": self.coalesced_waiters,
+        }

@@ -1,0 +1,109 @@
+"""The conditioning cache: text-encode once per unique prompt, fleet-wide.
+
+Text encoding is pure, deterministic, and model-heavy (a T5-XXL forward
+per prompt at FLUX/WAN scale), and the request stream repeats itself —
+the SAME negative prompt rides almost every request, popular prompts
+recur across users and seed re-rolls. This module memoizes the
+``encode(texts) -> (context, pooled)`` surface every text stack in the
+repo exposes (``models/text.TextEncoder``, ``models/clip.CLIPConditioner``,
+the T5 stacks in ``models/t5.py``).
+
+Keying is content-addressed and *tokenization-aware*
+(:func:`..cache.keys.conditioning_key`):
+
+- **encoder identity** comes from the bundle that built the encoder
+  (``ModelRegistry`` stamps ``_cdt_encoder_id``); an encoder without an
+  identity is never cached — unknown identity beats a wrong hit.
+- **token signature** is the encoder's actual token ids (its
+  ``token_signature(texts)`` hook), so the key captures vocab, padding,
+  and truncation exactly.
+- **mode** records real-vs-hash tokenization per tower. A worker whose
+  BPE vocab failed to load (``models/clip.py`` hash fallback) computes
+  ``hash``-mode keys that can never collide with a healthy worker's
+  ``bpe``-mode keys — and hash-mode entries are kept memory-only, so a
+  degraded worker cannot write garbage into the shared persisted tier.
+
+Round-trips are bit-exact: arrays are stored as the numpy bytes jax
+produced and handed back unchanged, so a cached conditioning feeding a
+pipeline is indistinguishable from a recomputed one (asserted end-to-end
+in ``tests/test_cache_integration.py``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ...utils.logging import debug_log
+from . import keys as _keys
+
+# the mode component marking a degraded (vocab-less) tower; entries
+# computed under it never reach the shared persisted tier. Exact
+# component match: "hash-native" (models/text.py — hash BY DESIGN, not a
+# fallback) is not degraded.
+DEGRADED_COMPONENT = "hash"
+
+
+def encoder_identity(encoder) -> Optional[str]:
+    """The registry-stamped identity, or None (= do not cache)."""
+    ident = getattr(encoder, "_cdt_encoder_id", None)
+    return ident if isinstance(ident, str) and ident else None
+
+
+def token_signature(encoder, texts) -> "tuple[list, str]":
+    """(canonical token signature, tokenization mode) for ``texts`` under
+    ``encoder``. Prefers the encoder's own ``token_signature`` hook (the
+    ids that actually enter the forward pass); encoders without one fall
+    back to the raw strings under the distinct ``text`` mode."""
+    hook = getattr(encoder, "token_signature", None)
+    if hook is not None:
+        return hook(texts)
+    return [str(t) for t in texts], "text"
+
+
+def encoder_mode(encoder) -> str:
+    """Degradation summary for the RESULT-cache key: an image computed
+    from hash-tokenized conditioning must never be served to (or from) a
+    healthy worker, so the mode joins the execution signature."""
+    mode = getattr(encoder, "tokenization_mode", None)
+    if isinstance(mode, str):
+        return mode
+    mode = getattr(encoder, "_tokenize_mode", None)
+    return mode if isinstance(mode, str) else "unknown"
+
+
+def degraded(mode: str) -> bool:
+    """True when any tower of a composite mode ("l=bpe,g=hash") fell back
+    to hash tokenization."""
+    import re
+
+    return DEGRADED_COMPONENT in re.split(r"[,=/]", mode)
+
+
+def cached_encode(manager, encoder, texts):
+    """``encoder.encode(texts)`` through the conditioning tier.
+
+    Falls through to a plain encode whenever caching cannot be sound:
+    no manager, unidentified encoder, or a non-roundtrippable dtype
+    (the store skips persisting those)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    texts = [str(t) for t in texts]
+    ident = None if manager is None else encoder_identity(encoder)
+    if ident is None:
+        return encoder.encode(texts)
+    sig, mode = token_signature(encoder, texts)
+    key = _keys.conditioning_key(ident, sig, mode)
+    hit = manager.conditioning.get(key)
+    if hit is not None and "context" in hit and "pooled" in hit:
+        return jnp.asarray(hit["context"]), jnp.asarray(hit["pooled"])
+    context, pooled = encoder.encode(texts)
+    try:
+        manager.conditioning.put(
+            key,
+            {"context": np.asarray(context), "pooled": np.asarray(pooled)},
+            persist=not degraded(mode))
+    except Exception as e:  # noqa: BLE001 — a cache fill must never sink
+        # the request that just computed a perfectly good conditioning
+        debug_log(f"conditioning cache: fill failed for {key[:12]}: {e}")
+    return context, pooled

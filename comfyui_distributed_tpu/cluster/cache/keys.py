@@ -1,0 +1,110 @@
+"""Content-addressed cache keys: same bytes in, same key out — anywhere.
+
+Every tier of the content cache (``cluster/cache``) keys on a SHA-256
+digest of a canonical byte encoding of the inputs that determine the
+output, and nothing else:
+
+- **conditioning**: (encoder identity, tokenized ids, tokenization
+  mode). Keying on the *token ids* rather than the raw string means two
+  prompts that tokenize identically share an entry, and — critically —
+  a worker whose tokenizer failed to load (hash-tokenization fallback,
+  ``models/clip.py``) computes a *different* key than a healthy worker,
+  so a degraded host can never poison the shared tier.
+- **request fingerprint**: the full canonical prompt graph. The
+  classifier's :class:`~..frontdoor.classifier.GroupKey` answers "can
+  these share a program?"; the fingerprint answers "are these the SAME
+  request?" — it covers the prompt text, negative prompt, seed, LoRA
+  nodes, and every other literal in the graph, because they are all
+  nodes/inputs of the prompt dict.
+- **result**: fingerprint × execution signature (mesh topology + jax
+  version). PRs 6–7 established that execution is bit-identical across
+  batching and fleet churn *for a fixed program*; a different device
+  count or XLA version is a different program, so it is a different key,
+  never a wrong hit.
+
+Digests are hex SHA-256 — collision-safe at fleet scale and filesystem-
+safe as sidecar file names.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+from typing import Any, Iterable
+
+
+def canonical_bytes(obj: Any) -> bytes:
+    """Deterministic byte encoding of a JSON-able structure (sorted keys,
+    no whitespace). Non-JSON leaves fall back to ``repr`` — stable for
+    the literal types that appear in prompt graphs."""
+    return json.dumps(obj, sort_keys=True, separators=(",", ":"),
+                      default=repr).encode()
+
+
+def digest(*parts: "bytes | str") -> str:
+    """SHA-256 over length-prefixed parts (prefixing prevents boundary
+    ambiguity: ("ab","c") never collides with ("a","bc"))."""
+    h = hashlib.sha256()
+    for p in parts:
+        b = p.encode() if isinstance(p, str) else p
+        h.update(len(b).to_bytes(8, "little"))
+        h.update(b)
+    return h.hexdigest()
+
+
+def conditioning_key(encoder_id: str, token_sig: Any, mode: str) -> str:
+    return digest("cond", encoder_id, mode, canonical_bytes(token_sig))
+
+
+def request_fingerprint(prompt: dict) -> str:
+    """Identity of one submitted request: the whole (meta-stripped)
+    prompt graph, canonically encoded. Two submissions with equal
+    fingerprints asked for byte-identical work."""
+    return digest("req", canonical_bytes(prompt))
+
+
+def execution_signature(mesh=None) -> str:
+    """The facts that change a compiled program's output without changing
+    the request: mesh topology (per-shard seed fold-in depends on it) and
+    the jax/XLA version. Computed at the execution site, where the mesh
+    is known."""
+    import jax
+
+    if mesh is not None:
+        axes = {str(k): int(v) for k, v in dict(mesh.shape).items()}
+    else:
+        axes = {"dp": len(jax.devices())}
+    return digest("exec", canonical_bytes({"axes": axes,
+                                           "jax": jax.__version__}))
+
+
+def result_key(fingerprint: str, execution_sig: str,
+               conditioning_mode: str = "", weights_id: str = "") -> str:
+    """``conditioning_mode`` (real/hash per the bundle's text stack)
+    joins the key so an image computed from degraded hash-tokenized
+    conditioning is never served to — or from — a healthy worker;
+    ``weights_id`` (bundle provenance: checkpoint path + mtime, or
+    seed + jax version — ``ModelRegistry.weights_identity``) so an
+    in-place checkpoint swap under the same ``ckpt_name`` invalidates
+    rather than serves stale images."""
+    return digest("result", fingerprint, execution_sig, conditioning_mode,
+                  weights_id)
+
+
+def token_array_signature(ids) -> list:
+    """Token-id array → JSON-able nested lists (the canonical form
+    ``conditioning_key`` hashes)."""
+    import numpy as np
+
+    return np.asarray(ids).tolist()
+
+
+def checksum(payload: "bytes | Iterable[bytes]") -> str:
+    """Integrity checksum for persisted sidecar bytes."""
+    h = hashlib.sha256()
+    if isinstance(payload, bytes):
+        h.update(payload)
+    else:
+        for chunk in payload:
+            h.update(chunk)
+    return h.hexdigest()

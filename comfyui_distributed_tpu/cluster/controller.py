@@ -49,13 +49,19 @@ class Controller:
         self.queue = PromptQueue(context_factory=self._execution_context)
         self.orchestrator = Orchestrator(self.store, self.queue,
                                          config_loader=self.load_config)
+        # content-addressed cache (cluster/cache): conditioning + result
+        # tiers and the in-flight coalescer; None under CDT_CACHE=0
+        from .cache import build_cache_manager
+
+        self.cache = build_cache_manager()
         # serving front door (cluster/frontdoor): admission control +
         # cross-user microbatching in front of the queue; None under
         # CDT_FRONTDOOR=0 (the API layer then serves the legacy path)
         from .frontdoor import build_frontdoor
 
         self.frontdoor = build_frontdoor(self.queue, self.orchestrator,
-                                         config_loader=self.load_config)
+                                         config_loader=self.load_config,
+                                         cache=self.cache)
         self.loop: Optional[asyncio.AbstractEventLoop] = None
         self.bridge: Optional[CollectorBridge] = None
         self.tile_farm = None
@@ -125,6 +131,10 @@ class Controller:
             "worker_id": self.worker_id,
             "worker_index": self.worker_index,
             "progress_tracker": self.progress,
+            # content cache (cluster/cache): CLIPTextEncode reads it as a
+            # hidden input; the microbatch executor serves/fills the
+            # result tier through it
+            "content_cache": self.cache,
         }
         if self.bridge is not None:
             ctx["collector_bridge"] = self.bridge
@@ -212,6 +222,10 @@ class Controller:
                           else {"depth": self.frontdoor.depth(),
                                 "coalescing":
                                     self.frontdoor.batcher.pending_count}),
+            # content-cache hit rate (cluster/cache, docs/caching.md) —
+            # the signal that lets the autoscaler shrink a hot-cache fleet
+            "cache": (None if self.cache is None
+                      else {"hit_rate": round(self.cache.hit_rate(), 4)}),
         }
 
     def system_info_no_devices(self) -> dict:

@@ -133,11 +133,17 @@ class ElasticManager:
                        if w.get("state") == DRAINING)
         decommissioned = sum(1 for w in workers.values()
                              if w.get("state") == DECOMMISSIONED)
+        # content-cache hit rate (cluster/cache): a hot cache answers
+        # queued work without a sampler program, so the policy sizes the
+        # fleet on the cache-discounted effective work
+        cache = getattr(c, "cache", None)
+        hit_rate = cache.hit_rate() if cache is not None else 0.0
         return FleetSignals(queue_depth=queue_depth, tile_depth=tile_depth,
                             step_time_p50=_step_time_p50(),
                             active_workers=active,
                             draining_workers=draining,
-                            decommissioned_workers=decommissioned)
+                            decommissioned_workers=decommissioned,
+                            cache_hit_rate=hit_rate)
 
     # --- lifecycle ----------------------------------------------------------
 

@@ -55,10 +55,25 @@ class FleetSignals:
     active_workers: int = 0
     draining_workers: int = 0
     decommissioned_workers: int = 0
+    # recent fraction of QUEUED fingerprinted requests the result cache
+    # answered without a sampler program — the content cache's pressure
+    # discount (cluster/cache, docs/caching.md). Coalesced duplicates
+    # are excluded: they never occupy queue depth in the first place
+    cache_hit_rate: float = 0.0
 
     @property
     def work(self) -> int:
         return self.queue_depth + self.tile_depth
+
+    @property
+    def effective_work(self) -> float:
+        """Queued work discounted by the cache hit rate: a request the
+        cache will answer occupies a queue slot for microseconds, not a
+        TPU program — sizing the fleet on raw depth would keep paying
+        for chips the cache already replaced. Tile backlog is never
+        discounted (tiles don't ride the content cache)."""
+        rate = min(max(self.cache_hit_rate, 0.0), 1.0)
+        return self.queue_depth * (1.0 - rate) + self.tile_depth
 
 
 @dataclasses.dataclass(frozen=True)
@@ -191,9 +206,11 @@ class Autoscaler:
         sig = self.signals()
         now = self._clock()
         # the master always serves, so capacity is never zero — a
-        # 0-worker fleet with deep queues must still read as pressured
+        # 0-worker fleet with deep queues must still read as pressured.
+        # Work is cache-discounted (FleetSignals.effective_work): a hot
+        # cache scales the fleet DOWN even while raw depth stays high
         capacity = max(1, sig.active_workers + 1)
-        pressure = sig.work / capacity
+        pressure = sig.effective_work / capacity
 
         if pressure >= pol.scale_up_depth:
             self._up_streak += 1
@@ -287,7 +304,7 @@ class Autoscaler:
             "policy": dataclasses.asdict(self.policy),
             "signals": dataclasses.asdict(sig),
             "pressure": round(
-                sig.work / max(1, sig.active_workers + 1), 3),
+                sig.effective_work / max(1, sig.active_workers + 1), 3),
             "streaks": {"up": self._up_streak, "down": self._down_streak},
             "recent_decisions": [dataclasses.asdict(d)
                                  for d in self.decisions[-10:]],

@@ -47,6 +47,7 @@ from ..runtime import PromptJob, PromptQueue
 from .admission import AdmissionController, Decision
 from .batcher import CoalescingBatcher
 from .classifier import Classification, classify
+from .classifier import fingerprint as classifier_fingerprint
 
 
 def frontdoor_enabled() -> bool:
@@ -69,6 +70,9 @@ class FrontDoorResult:
     batched: bool = False
     reason: str = ""
     retry_after_s: float = 0.0
+    # this request joined an in-flight byte-identical execution
+    # (cluster/cache/coalesce.py) — it never entered the queue
+    coalesced: bool = False
 
 
 class FrontDoor:
@@ -78,10 +82,14 @@ class FrontDoor:
     """
 
     def __init__(self, queue: PromptQueue, orchestrator,
-                 config_loader=None):
+                 config_loader=None, cache=None):
         self.queue = queue
         self.orchestrator = orchestrator
         self.load_config = config_loader
+        # content cache (cluster/cache): in-flight coalescing happens
+        # HERE, before the batcher — a byte-identical twin of a queued
+        # request must never occupy a second queue slot
+        self.cache = cache
         self.admission = AdmissionController(depth_provider=self.depth)
         # capacity gate = continuous batching: while FD_INFLIGHT batch
         # jobs sit in the queue, ready groups keep absorbing same-shape
@@ -98,8 +106,25 @@ class FrontDoor:
         if self._task is None or self._task.done():
             self._task = asyncio.ensure_future(self.batcher.run())
         # completed jobs free queue slots: wake the batcher so the next
-        # ready group flushes immediately instead of on its timer
-        self.queue.add_job_done_callback(self.batcher.wake)
+        # ready group flushes immediately instead of on its timer, and
+        # settle coalesced waiters whose leader just reached a terminal
+        # history entry
+        self.queue.add_job_done_callback(self._on_job_done)
+
+    def _on_job_done(self) -> None:
+        self.batcher.wake()
+        if self.cache is not None:
+            self.cache.coalescer.resolve(self.queue.history,
+                                         redispatch=self._redispatch)
+
+    def _redispatch(self, member, group_key, sampler_node_id) -> None:
+        """An expired-leader waiter gets a FRESH execution (its own
+        deadline allowed one): it becomes the new leader for the
+        fingerprint and re-enters the batcher."""
+        if member.fingerprint is not None:
+            self.cache.coalescer.lead(member.fingerprint, member.prompt_id)
+        self.batcher.submit(group_key, member,
+                            sampler_node_id=sampler_node_id)
 
     async def stop(self) -> None:
         if self._task is not None:
@@ -170,13 +195,30 @@ class FrontDoor:
         from ...utils.logging import new_trace_id
 
         trace_id = payload.trace_id or new_trace_id()
+        fingerprint = classifier_fingerprint(prompt)
         member = PromptJob(
             prompt_id=f"p_{int(time.time()*1000)}_{secrets.token_hex(3)}",
             prompt=prompt, client_id=payload.client_id,
             trace_id=trace_id,
             tenant=payload.tenant, priority=payload.priority,
             deadline_at=deadline_at,
+            fingerprint=fingerprint, cache_mode=payload.cache,
         )
+        if self.cache is not None and payload.cache != "bypass":
+            if self.cache.coalescer.join(fingerprint, member,
+                                         group_key=cls.group_key,
+                                         sampler_node_id=cls.sampler_node_id):
+                # byte-identical twin already in flight: this request
+                # rides that ONE execution; its own history entry lands
+                # when the leader's does (cluster/cache/coalesce.py).
+                # NOT recorded in the autoscaler's hit window — a waiter
+                # never occupies a queue slot, so discounting queue
+                # depth by the coalesce rate would double-count
+                return FrontDoorResult(outcome=decision.outcome,
+                                       prompt_id=member.prompt_id,
+                                       trace_id=trace_id, batched=True,
+                                       coalesced=True, reason=cls.reason)
+            self.cache.coalescer.lead(fingerprint, member.prompt_id)
         self.batcher.submit(cls.group_key, member,
                             sampler_node_id=cls.sampler_node_id)
         if telemetry.enabled():
@@ -212,13 +254,16 @@ class FrontDoor:
             "classified": dict(self._classified),
             "window_ms": self.batcher.window_ms,
             "max_batch": self.batcher.max_batch,
+            "cache": (None if self.cache is None
+                      else {"hit_rate": round(self.cache.hit_rate(), 4),
+                            **self.cache.coalescer.stats()}),
         }
 
 
 def build_frontdoor(queue: PromptQueue, orchestrator,
-                    config_loader=None) -> Optional[FrontDoor]:
+                    config_loader=None, cache=None) -> Optional[FrontDoor]:
     """Controller hook: the front door, or None under CDT_FRONTDOOR=0."""
     if not frontdoor_enabled():
         log("front door disabled (CDT_FRONTDOOR=0) — legacy queue path")
         return None
-    return FrontDoor(queue, orchestrator, config_loader)
+    return FrontDoor(queue, orchestrator, config_loader, cache=cache)

@@ -84,6 +84,20 @@ class Classification:
     sampler_node_id: Optional[str] = None
 
 
+def fingerprint(prompt: dict) -> str:
+    """The GroupKey extended to a FULL content fingerprint
+    (``cluster/cache/keys.py``): where the group key answers "can these
+    requests share a compiled program?" (model/geometry/steps/sampler),
+    the fingerprint answers "did these requests ask for byte-identical
+    work?" — it digests the entire canonical prompt graph, so the prompt
+    text, negative prompt, seed, LoRA set, and every other literal are
+    all covered. Equal fingerprints coalesce in flight and share
+    completed-result cache entries (docs/caching.md)."""
+    from ..cache.keys import request_fingerprint
+
+    return request_fingerprint(prompt)
+
+
 def _literal_num(v):
     if isinstance(v, bool):
         return None

@@ -74,6 +74,7 @@ class _Prepared:
         self.mesh = None
         self.stackable = False
         self.why_solo = ""
+        self.result_key = None   # content-cache identity (cluster/cache)
 
     def signature(self) -> tuple:
         return (id(self.pipeline), self.spec,
@@ -167,9 +168,72 @@ def execute_group(members: list, sampler_node_ids: dict,
     return results
 
 
+def _cache_key_for(p: _Prepared, cache) -> "str | None":
+    """Result-tier key for one prepared member: request fingerprint ×
+    execution signature × conditioning-degradation mode × weights
+    provenance — or None when the member is uncacheable (no
+    fingerprint, no manager, or a bundle that can't state its weights
+    provenance — an unknown-weights bundle must never share entries)."""
+    if cache is None or p.member.fingerprint is None:
+        return None
+    from ..cache import execution_signature, result_key
+    from ..cache.conditioning import encoder_mode
+
+    weights_fn = getattr(p.model, "weights_identity", None)
+    if weights_fn is None:
+        return None
+    mode = encoder_mode(getattr(p.model, "text_encoder", None))
+    return result_key(p.member.fingerprint, execution_signature(p.mesh),
+                      mode, weights_fn())
+
+
+def _serve_cached(p: _Prepared, cache, results: dict) -> bool:
+    """Serve one member from the completed-result tier; the member still
+    runs its suffix (SaveImage et al. side effects are real), only the
+    sampler program is skipped. ``cache: "bypass"`` members never serve
+    (they re-execute and refresh the entry)."""
+    p.result_key = _cache_key_for(p, cache)
+    if p.result_key is None or p.member.cache_mode == "bypass":
+        return False
+    hit = cache.results.get(p.result_key)
+    if hit is None or "images" not in hit:
+        return False
+    import jax.numpy as jnp
+
+    try:
+        out_cache = _finish(p, jnp.asarray(hit["images"]))
+    except InterruptedError:
+        raise
+    except Exception as e:  # noqa: BLE001 — member isolation barrier
+        results[p.member.prompt_id] = {"status": "error", "error": str(e)}
+        log(f"front door: cached-suffix failed for "
+            f"{p.member.prompt_id}: {e}")
+        return True
+    results[p.member.prompt_id] = {"status": "success",
+                                   "outputs": out_cache,
+                                   "cache": "hit", "batch_size": 0}
+    cache.record_request(hit=True)
+    return True
+
+
+def _fill_cache(p: _Prepared, cache, images) -> None:
+    """Record a freshly computed sampler output (miss or bypass refresh);
+    a fill failure must never sink the request that just computed it."""
+    if cache is None or p.result_key is None:
+        return
+    import numpy as np
+
+    try:
+        cache.results.put(p.result_key, {"images": np.asarray(images)})
+    except Exception as e:  # noqa: BLE001
+        debug_log(f"result cache: fill failed for "
+                  f"{p.result_key[:12]}: {e}")
+
+
 def _execute_group_inner(members: list, sampler_node_ids: dict,
                          base_context: dict, results: dict) -> None:
     t0 = time.monotonic()
+    cache = base_context.get("content_cache")
     prepared: list[_Prepared] = []
 
     for m in members:
@@ -181,6 +245,15 @@ def _execute_group_inner(members: list, sampler_node_ids: dict,
         except Exception as e:  # noqa: BLE001 — member isolation barrier
             results[m.prompt_id] = {"status": "error", "error": str(e)}
             log(f"front door: prefix failed for {m.prompt_id}: {e}")
+
+    # completed-result cache (cluster/cache): a byte-identical request
+    # the fleet has already answered skips its sampler program entirely
+    served = [p for p in prepared if _serve_cached(p, cache, results)]
+    prepared = [p for p in prepared if p not in served]
+    if cache is not None:
+        for p in prepared:
+            if p.member.fingerprint is not None:
+                cache.record_request(hit=False)
 
     # sub-group by runtime signature; order within a sub-group is
     # submission order (members arrive FIFO from the batcher)
@@ -195,9 +268,10 @@ def _execute_group_inner(members: list, sampler_node_ids: dict,
     def run_solo(p: _Prepared, batch_size: int = 1) -> None:
         try:
             images = _solo(p)
-            cache = _finish(p, images)
+            _fill_cache(p, cache, images)
+            out_cache = _finish(p, images)
             results[p.member.prompt_id] = {
-                "status": "success", "outputs": cache,
+                "status": "success", "outputs": out_cache,
                 "batch_size": batch_size}
         except InterruptedError:
             raise
@@ -250,9 +324,10 @@ def _execute_group_inner(members: list, sampler_node_ids: dict,
         _observe_group_shape(lead, len(grp))
         for p, images in zip(grp, outs):
             try:
-                cache = _finish(p, images)
+                _fill_cache(p, cache, images)
+                out_cache = _finish(p, images)
                 results[p.member.prompt_id] = {
-                    "status": "success", "outputs": cache,
+                    "status": "success", "outputs": out_cache,
                     "batch_size": len(grp)}
             except InterruptedError:
                 raise

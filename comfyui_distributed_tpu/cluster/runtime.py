@@ -54,6 +54,12 @@ class PromptJob:
     # deadline) and each member's sampler node id. ``prompt`` is unused.
     group: "list[PromptJob] | None" = None
     sampler_node_ids: dict | None = None
+    # --- content cache (cluster/cache, docs/caching.md) ---------------------
+    # full request fingerprint (set by the front door for the
+    # deterministic-batchable class); cache_mode "bypass" skips serving
+    # this member from the result cache (it still fills it)
+    fingerprint: str | None = None
+    cache_mode: str = "use"
 
     def expired(self, now: float) -> bool:
         return self.deadline_at is not None and now >= self.deadline_at
@@ -184,6 +190,16 @@ class PromptQueue:
             self._job_finished_accounting(job)
         if self._executing:
             self._interrupt.set()
+        if dropped:
+            # dropped jobs reached terminal history WITHOUT passing the
+            # consumer loop — observers (front-door flush, coalescer
+            # waiter resolution) must still see the transition, or a
+            # waiter on an interrupted leader would hang forever
+            for cb in self._job_done_callbacks:
+                try:
+                    cb()
+                except Exception:  # noqa: BLE001 — observer isolation
+                    pass
         return dropped
 
     @property
@@ -331,6 +347,9 @@ class PromptQueue:
             record = {"status": status,
                       "duration": duration,
                       "batch_size": entry.get("batch_size")}
+            if entry.get("cache"):
+                # served from the completed-result tier (cluster/cache)
+                record["cache"] = entry["cache"]
             if entry.get("error"):
                 record["error"] = entry["error"]
             if status == "success":

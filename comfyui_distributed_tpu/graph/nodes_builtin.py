@@ -913,10 +913,18 @@ class ModelSamplingSD3(NodeDef):
 @register_node("CLIPTextEncode")
 class CLIPTextEncode(NodeDef):
     INPUTS = {"text": "STRING", "clip": "CLIP"}
+    HIDDEN = {"content_cache": "*"}
     RETURNS = ("CONDITIONING",)
 
-    def execute(self, text: str, clip, **_):
-        ctx, pooled = clip.encode([str(text)])
+    def execute(self, text: str, clip, content_cache=None, **_):
+        # text-encode through the fleet conditioning cache when the
+        # controller carries one (cluster/cache): identical prompts —
+        # and the negative prompt nearly every request shares — encode
+        # once, fleet-wide. Falls through to a plain encode for
+        # unidentified encoders or CDT_CACHE=0.
+        from ..cluster.cache.conditioning import cached_encode
+
+        ctx, pooled = cached_encode(content_cache, clip, [str(text)])
         return ({"context": ctx, "pooled": pooled},)
 
 

@@ -207,12 +207,32 @@ def validate_tokenizer_vocab(tok, cfg: CLIPTextConfig, name: str) -> None:
             f"eot_token_id={cfg.eot_token_id}")
 
 
-def tokenize_ids(texts, tok, cfg, pad_id: int) -> jax.Array:
+def _count_hash_tokenization(tower: str) -> None:
+    """Export the hash-fallback usage as telemetry: the boot-time warning
+    is one log line on one host, but fleet-wide conditioning degradation
+    must be visible in ``/distributed/metrics``
+    (``cdt_hash_tokenization_total{tower}``)."""
+    try:
+        from .. import telemetry
+        from ..telemetry import metrics as _tm
+
+        if telemetry.enabled():
+            _tm.HASH_TOKENIZATION.labels(tower=tower).inc()
+    except Exception:  # noqa: BLE001 — telemetry is never load-bearing
+        pass
+
+
+def tokenize_ids(texts, tok, cfg, pad_id: int, tower: str = "clip",
+                 count: bool = True) -> jax.Array:
     """Strings → [B, max_len] int32 ids: real BPE when a tokenizer is
     loaded, deterministic hash fallback (correct SOT/EOT framing so EOT
-    pooling works) otherwise."""
+    pooling works) otherwise. ``count=False`` skips the degradation
+    counter — key-signature tokenization must not double-count the
+    encode that follows it."""
     if tok is not None:
         return jnp.asarray([tok.encode(t) for t in texts], jnp.int32)
+    if count:
+        _count_hash_tokenization(tower)
     import hashlib
 
     def fallback(text: str) -> list[int]:
@@ -282,19 +302,52 @@ class CLIPConditioner:
             log("WARNING: no CLIP vocab at CDT_TOKENIZER_DIR — text is "
                 "hash-tokenized; conditioning will not reflect the prompt")
 
-    def _ids(self, texts, tok, cfg, pad_id: int):
-        return tokenize_ids(texts, tok, cfg, pad_id)
+    def _ids(self, texts, tok, cfg, pad_id: int, tower: str):
+        return tokenize_ids(texts, tok, cfg, pad_id, tower=tower)
+
+    def token_signature(self, texts) -> tuple[list, str]:
+        """(token ids per tower, real-vs-hash mode) — the conditioning
+        cache's key material (``cluster/cache/conditioning.py``). Keying
+        on the MODE is load-bearing: a worker whose vocab failed to load
+        computes different keys than a healthy one, so its degraded
+        embeddings can never poison the shared tier."""
+        texts = [str(t) for t in texts]
+        if self.kind == "sdxl":
+            l_cfg = self.stack.clip_l.config
+            g_cfg = self.stack.clip_g.config
+            sig = [
+                tokenize_ids(texts, self.tok_l, l_cfg, l_cfg.eot_token_id,
+                             count=False).tolist(),
+                tokenize_ids(texts, self.tok_g, g_cfg, 0,
+                             count=False).tolist(),
+            ]
+            mode = (f"l={'bpe' if self.tok_l is not None else 'hash'},"
+                    f"g={'bpe' if self.tok_g is not None else 'hash'}")
+            return sig, mode
+        cfg = self.stack.config
+        sig = [tokenize_ids(texts, self.tok_l, cfg, cfg.eot_token_id,
+                            count=False).tolist()]
+        return sig, f"l={'bpe' if self.tok_l is not None else 'hash'}"
+
+    @property
+    def tokenization_mode(self) -> str:
+        """Degradation summary for the result-cache key: "bpe" when every
+        tower has a real tokenizer, "hash" otherwise."""
+        toks = [self.tok_l] + ([self.tok_g] if self.kind == "sdxl" else [])
+        return "bpe" if all(t is not None for t in toks) else "hash"
 
     def encode(self, texts) -> tuple[jax.Array, jax.Array]:
         texts = [str(t) for t in texts]
         if self.kind == "sdxl":
             l_cfg = self.stack.clip_l.config
             g_cfg = self.stack.clip_g.config
-            toks_l = self._ids(texts, self.tok_l, l_cfg, l_cfg.eot_token_id)
-            toks_g = self._ids(texts, self.tok_g, g_cfg, 0)
+            toks_l = self._ids(texts, self.tok_l, l_cfg, l_cfg.eot_token_id,
+                               tower="clip_l")
+            toks_g = self._ids(texts, self.tok_g, g_cfg, 0, tower="clip_g")
             return self.stack.encode_tokens(toks_l, toks_g)
         cfg = self.stack.config
-        toks = self._ids(texts, self.tok_l, cfg, cfg.eot_token_id)
+        toks = self._ids(texts, self.tok_l, cfg, cfg.eot_token_id,
+                         tower="clip_l")
         out = self.stack(toks)
         # SD1.5 convention: final hidden states + EOT pooled
         return out["last_hidden"], out["pooled"]

@@ -217,6 +217,28 @@ PRESETS: dict[str, ModelPreset] = {
 }
 
 
+def _weights_tag(ckpt: "Path | None", seed: int = 0) -> str:
+    """Weights-provenance tag the cache keys carry: random-init weights
+    are pinned to (seed, jax version) — deterministic per jax build
+    only; checkpoint-backed ones to the checkpoint path + mtime, so
+    swapping weights in place invalidates the shared tiers naturally."""
+    if ckpt is None:
+        import jax
+
+        return f"seed{seed}:jax{jax.__version__}"
+    try:
+        return f"ckpt:{Path(ckpt).name}:{int(Path(ckpt).stat().st_mtime)}"
+    except OSError:
+        return f"ckpt:{ckpt}"
+
+
+def _encoder_identity(preset_name: str, stack: str, ckpt: "Path | None",
+                      seed: int = 0) -> str:
+    """Identity string the conditioning cache keys on
+    (``cluster/cache/conditioning.py``)."""
+    return f"{preset_name}/{stack}/{_weights_tag(ckpt, seed)}"
+
+
 class ModelBundle:
     """Loaded stack: pipeline + text encoder, built lazily and cached."""
 
@@ -228,6 +250,8 @@ class ModelBundle:
         (a FLUX-size random init alone is ~48 GB of wasted fp32)."""
         self.preset = preset
         self.clip_stack = None      # built lazily (real-weight path only)
+        self._weights_source = None   # set by the checkpoint loaders
+        self._init_seed = int(seed)
         k1, k2, k3 = jax.random.split(jax.random.key(seed), 3)
         img_hw = (preset.sample_hw[0] * preset.vae.downscale,
                   preset.sample_hw[1] * preset.vae.downscale)
@@ -316,6 +340,26 @@ class ModelBundle:
                 # drop `<name>.safetensors` next to the orbax dirs and the
                 # published checkpoint converts on first load
                 self.load_safetensors_checkpoint(single)
+        self._stamp_text_encoder()
+
+    def _stamp_text_encoder(self) -> None:
+        """Give the active text encoder its conditioning-cache identity
+        (``cluster/cache/conditioning.py``). Re-stamped whenever the
+        encoder object OR the weights behind it change (clip-stack
+        build, every checkpoint loader, standalone text-encoder files);
+        LoRA-patched clones are deliberately NOT stamped — an
+        unidentified encoder is never cached."""
+        stack = self.preset.clip if self.clip_stack is not None else "text"
+        self.text_encoder._cdt_encoder_id = _encoder_identity(
+            self.preset.name, stack or "text", self._weights_source,
+            seed=self._init_seed)
+
+    def weights_identity(self) -> str:
+        """Provenance of this bundle's CORE (denoiser) weights — the
+        result-cache key carries it so an in-place checkpoint swap (same
+        ``ckpt_name``, new bytes, new mtime) can never serve a stale
+        persisted image (``cluster/frontdoor/microbatch.py``)."""
+        return f"{self.preset.name}/{_weights_tag(self._weights_source, self._init_seed)}"
 
     @property
     def kind(self) -> str:
@@ -357,6 +401,7 @@ class ModelBundle:
             self.clip_stack = FluxTextStack.init_random(
                 key, tiny=tiny, abstract_t5=abstract_t5)
             self.text_encoder = self.clip_stack    # encode()-compatible
+            self._stamp_text_encoder()
             return self.clip_stack
         elif kind == "umt5":
             from .t5 import UMT5Conditioner
@@ -364,6 +409,7 @@ class ModelBundle:
             self.clip_stack = UMT5Conditioner.init_random(
                 key, tiny=tiny, abstract_t5=abstract_t5)
             self.text_encoder = self.clip_stack
+            self._stamp_text_encoder()
             return self.clip_stack
         elif kind == "sd3":
             from .t5 import SD3TextStack
@@ -371,11 +417,13 @@ class ModelBundle:
             self.clip_stack = SD3TextStack.init_random(
                 key, tiny=tiny, abstract_t5=abstract_t5)
             self.text_encoder = self.clip_stack
+            self._stamp_text_encoder()
             return self.clip_stack
         else:
             cfg = CLIPTextConfig.tiny() if tiny else CLIPTextConfig.clip_l()
             self.clip_stack = CLIPTextModel(cfg).init(key)
         self.text_encoder = CLIPConditioner(self.clip_stack, kind=kind)
+        self._stamp_text_encoder()
         return self.clip_stack
 
     def _state_entries(self) -> dict:
@@ -442,6 +490,7 @@ class ModelBundle:
                 "re-run `python -m comfyui_distributed_tpu convert`")
         manifest = {}
         mf = ckpt / "cdt_manifest.json"
+        self._weights_source = ckpt
         if mf.is_file():
             manifest = json.loads(mf.read_text())
         saved_arch = manifest.get("arch")
@@ -464,6 +513,10 @@ class ModelBundle:
         with ocp.StandardCheckpointer() as ckptr:
             restored = ckptr.restore(state_dir.resolve(), targets)
         self._apply_entries(restored)
+        # the encoder's weights just changed provenance: a stale
+        # random-init identity here would let this bundle share cache
+        # entries with a genuinely random-init twin
+        self._stamp_text_encoder()
         log(f"loaded checkpoint {ckpt}")
 
     def save_checkpoint(self, ckpt: Path) -> None:
@@ -519,7 +572,9 @@ class ModelBundle:
             # materialize ~19-23 GB of random fp32 T5 weights and, worse,
             # let save_checkpoint persist them as if they were real
             self.build_clip_stack()
+        self._weights_source = Path(path)
         convert_checkpoint(path, self)
+        self._stamp_text_encoder()
 
     def load_safetensors_moe(self, high: Path, low: Path) -> None:
         """Convert a WAN-2.2 dual-expert release: the high-noise
@@ -533,6 +588,7 @@ class ModelBundle:
                 f"preset {self.preset.name!r} is not a dual-expert model; "
                 "use load_safetensors_checkpoint for single-transformer "
                 "releases")
+        self._weights_source = Path(high)
         convert_checkpoint(Path(high), self)
         hi_params = self.pipeline.dit_params
         # the low expert converts against the low template in the same
@@ -543,6 +599,7 @@ class ModelBundle:
             self.pipeline.dit_params_low = self.pipeline.dit_params
         finally:
             self.pipeline.dit_params = hi_params
+        self._stamp_text_encoder()
 
     def load_text_encoder_files(self, t5: Optional[Path] = None,
                                 clip_l: Optional[Path] = None,
@@ -591,6 +648,9 @@ class ModelBundle:
             self.clip_stack.clip_g.params = convert_clip_hf(
                 load_safetensors(Path(clip_g)),
                 self.clip_stack.clip_g.params, self.clip_stack.clip_g.config)
+        if self._weights_source is None and t5 is not None:
+            self._weights_source = Path(t5)
+        self._stamp_text_encoder()
 
     def release_device(self) -> None:
         """Drop everything this bundle holds ON DEVICE so its HBM can be

@@ -253,15 +253,20 @@ def load_t5_tokenizer(tok_dir=None):
         return None
 
 
-def t5_token_ids(cfg: T5Config, tok, texts):
+def t5_token_ids(cfg: T5Config, tok, texts, count: bool = True):
     """Strings → (ids [B,max_len], mask [B,max_len]): SentencePiece when a
     tokenizer is loaded, deterministic hash fallback (with </s> framing so
-    masking works) otherwise."""
+    masking works) otherwise. ``count=False`` skips the degradation
+    counter (cache key-signature tokenization)."""
     if tok is not None:
         enc = tok(list(texts), padding="max_length", truncation=True,
                   max_length=cfg.max_len, return_tensors="np")
         return (jnp.asarray(enc["input_ids"], jnp.int32),
                 jnp.asarray(enc["attention_mask"], jnp.int32))
+    if count:
+        from .clip import _count_hash_tokenization
+
+        _count_hash_tokenization("t5")
     import hashlib
 
     def fallback(text):
@@ -299,6 +304,19 @@ class UMT5Conditioner:
         cfg = (T5Config.tiny(per_layer_rel_bias=True) if tiny
                else T5Config.umt5_xxl())
         return cls(T5Model(cfg).init(rng, abstract=abstract_t5))
+
+    def token_signature(self, texts) -> tuple[list, str]:
+        """Conditioning-cache key material (cluster/cache): ids+mask and
+        the real-vs-hash mode, so a degraded (vocab-less) worker can
+        never poison the shared tier."""
+        ids, mask = t5_token_ids(self.t5.config, self.tok,
+                                 [str(t) for t in texts], count=False)
+        return ([ids.tolist(), mask.tolist()],
+                f"t5={'sp' if self.tok is not None else 'hash'}")
+
+    @property
+    def tokenization_mode(self) -> str:
+        return "sp" if self.tok is not None else "hash"
 
     def encode(self, texts) -> tuple[jax.Array, jax.Array]:
         texts = [str(t) for t in texts]
@@ -351,6 +369,24 @@ class FluxTextStack:
         return cls(T5Model(t5_cfg).init(k1, abstract=abstract_t5),
                    CLIPTextModel(clip_cfg).init(k2))
 
+    def token_signature(self, texts) -> tuple[list, str]:
+        from .clip import tokenize_ids
+
+        texts = [str(t) for t in texts]
+        ids, mask = t5_token_ids(self.t5.config, self.t5_tok, texts,
+                                 count=False)
+        cfg = self.clip_l.config
+        toks = tokenize_ids(texts, self.clip_tok, cfg, cfg.eot_token_id,
+                            count=False)
+        mode = (f"t5={'sp' if self.t5_tok is not None else 'hash'},"
+                f"l={'bpe' if self.clip_tok is not None else 'hash'}")
+        return [ids.tolist(), mask.tolist(), toks.tolist()], mode
+
+    @property
+    def tokenization_mode(self) -> str:
+        return ("real" if (self.t5_tok is not None
+                           and self.clip_tok is not None) else "hash")
+
     def encode(self, texts) -> tuple[jax.Array, jax.Array]:
         from .clip import tokenize_ids
 
@@ -358,7 +394,8 @@ class FluxTextStack:
         ids, mask = t5_token_ids(self.t5.config, self.t5_tok, texts)
         context = self.t5(ids, mask)
         cfg = self.clip_l.config
-        toks = tokenize_ids(texts, self.clip_tok, cfg, cfg.eot_token_id)
+        toks = tokenize_ids(texts, self.clip_tok, cfg, cfg.eot_token_id,
+                            tower="clip_l")
         pooled = self.clip_l(toks)["pooled"]
         return context, pooled
 
@@ -441,14 +478,37 @@ class SD3TextStack:
                    CLIPTextModel(cfg_g).init(k2),
                    T5Model(t5_cfg).init(k3, abstract=abstract_t5))
 
+    def token_signature(self, texts) -> tuple[list, str]:
+        from .clip import tokenize_ids
+
+        texts = [str(t) for t in texts]
+        l_cfg, g_cfg = self.clip_l.config, self.clip_g.config
+        toks_l = tokenize_ids(texts, self.tok_l, l_cfg, l_cfg.eot_token_id,
+                              count=False)
+        toks_g = tokenize_ids(texts, self.tok_g, g_cfg, 0, count=False)
+        ids, mask = t5_token_ids(self.t5.config, self.t5_tok, texts,
+                                 count=False)
+        mode = (f"l={'bpe' if self.tok_l is not None else 'hash'},"
+                f"g={'bpe' if self.tok_g is not None else 'hash'},"
+                f"t5={'sp' if self.t5_tok is not None else 'hash'}")
+        return [toks_l.tolist(), toks_g.tolist(), ids.tolist(),
+                mask.tolist()], mode
+
+    @property
+    def tokenization_mode(self) -> str:
+        return ("real" if (self.tok_l is not None and self.tok_g is not None
+                           and self.t5_tok is not None) else "hash")
+
     def encode(self, texts) -> tuple[jax.Array, jax.Array]:
         from .clip import tokenize_ids
 
         texts = [str(t) for t in texts]
         l_cfg, g_cfg = self.clip_l.config, self.clip_g.config
         out_l = self.clip_l(tokenize_ids(texts, self.tok_l, l_cfg,
-                                         l_cfg.eot_token_id))
-        out_g = self.clip_g(tokenize_ids(texts, self.tok_g, g_cfg, 0))
+                                         l_cfg.eot_token_id,
+                                         tower="clip_l"))
+        out_g = self.clip_g(tokenize_ids(texts, self.tok_g, g_cfg, 0,
+                                         tower="clip_g"))
         clip_ctx = jnp.concatenate(
             [out_l["penultimate"], out_g["penultimate"]], axis=-1)
         d = self.t5.config.d_model

@@ -96,6 +96,12 @@ class TextEncoder:
         self.config = config
         self.module = TextTransformer(config)
         self.params = params
+        # tokenization mode for the conditioning cache key
+        # (cluster/cache/conditioning.py): this encoder hash-tokenizes BY
+        # DESIGN (random-init benchmark stack), which is not the degraded
+        # "hash" fallback of the real CLIP/T5 stacks — hence the distinct
+        # mode name, so its entries may still persist
+        self._tokenize_mode = "custom" if tokenize_fn else "hash-native"
         self._tokenize = tokenize_fn or (
             lambda s: hash_tokenize(s, config.max_len, config.vocab_size)
         )
@@ -107,6 +113,12 @@ class TextEncoder:
 
     def tokenize(self, texts: Sequence[str]) -> jax.Array:
         return jnp.asarray([list(self._tokenize(t)) for t in texts], jnp.int32)
+
+    def token_signature(self, texts: Sequence[str]) -> tuple[list, str]:
+        """(token ids as nested lists, tokenization mode) — the
+        conditioning cache's key material (cluster/cache)."""
+        return ([list(self._tokenize(str(t))) for t in texts],
+                self._tokenize_mode)
 
     def encode(self, texts: Sequence[str]) -> tuple[jax.Array, jax.Array]:
         from .layers import jit_apply

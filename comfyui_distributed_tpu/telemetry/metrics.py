@@ -223,6 +223,58 @@ STEAL_ASSIGNMENTS = REGISTRY.counter(
     "or stolen (work lifted from another open job).",
     ("kind",))
 
+# --- content-addressed cache (cluster/cache, docs/caching.md) ---------------
+
+CACHE_HITS = REGISTRY.counter(
+    "cdt_cache_hits_total",
+    "Content-cache hits by tier (conditioning = a text-encode skipped; "
+    "result = a whole sampler program skipped). Disk hits count here too "
+    "— a hit is a hit wherever the bytes came from.",
+    ("tier",))
+
+CACHE_MISSES = REGISTRY.counter(
+    "cdt_cache_misses_total",
+    "Content-cache misses by tier (the computation ran and filled the "
+    "entry).",
+    ("tier",))
+
+CACHE_BYTES = REGISTRY.gauge(
+    "cdt_cache_bytes",
+    "In-memory bytes held per cache tier (LRU under the "
+    "CDT_CACHE_*_MAX_BYTES caps).",
+    ("tier",))
+
+CACHE_ENTRIES = REGISTRY.gauge(
+    "cdt_cache_entries",
+    "In-memory entries per cache tier.",
+    ("tier",))
+
+CACHE_EVICTIONS = REGISTRY.counter(
+    "cdt_cache_evictions_total",
+    "LRU evictions per cache tier (memory budget or persisted-tier cap).",
+    ("tier",))
+
+CACHE_CORRUPT = REGISTRY.counter(
+    "cdt_cache_corrupt_total",
+    "Persisted cache entries rejected at load: checksum mismatch or "
+    "unreadable sidecar. Always followed by a recompute — corruption is "
+    "never served.",
+    ("tier",))
+
+COALESCE_WIDTH = REGISTRY.histogram(
+    "cdt_coalesce_width",
+    "Requests answered per executed fingerprint (1 = no duplicates were "
+    "in flight; N = one execution fanned out to N-1 waiters).",
+    buckets=(1, 2, 4, 8, 16, 32, 64))
+
+HASH_TOKENIZATION = REGISTRY.counter(
+    "cdt_hash_tokenization_total",
+    "Text encodes that used the deterministic hash-tokenization fallback "
+    "(no BPE vocab loaded), by tower. Nonzero on a production worker "
+    "means conditioning does not reflect the prompt — a boot-time log "
+    "line made fleet-visible (models/clip.py).",
+    ("tower",))
+
 # --- prompt queue -----------------------------------------------------------
 
 PROMPTS_TOTAL = REGISTRY.counter(

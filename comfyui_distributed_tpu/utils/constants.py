@@ -144,6 +144,20 @@ FD_MAX_TENANTS = env_int("CDT_FD_MAX_TENANTS", 1024)
 # Base Retry-After seconds for shed responses (scaled by overload ratio).
 FD_RETRY_AFTER_S = env_float("CDT_FD_RETRY_AFTER_S", 2.0)
 
+# --- content-addressed cache (cluster/cache, docs/caching.md) ---------------
+# In-memory byte caps per tier (LRU, pinned entries untouchable).
+# Conditioning entries are small (a context tensor per unique prompt);
+# result entries are full decoded image batches — budget accordingly.
+CACHE_COND_MAX_BYTES = env_int("CDT_CACHE_COND_MAX_BYTES",
+                               256 * 1024 * 1024)
+CACHE_RESULT_MAX_BYTES = env_int("CDT_CACHE_RESULT_MAX_BYTES",
+                                 1024 * 1024 * 1024)
+# Persisted-tier byte cap per tier (oldest-first eviction). The directory
+# itself is CDT_CACHE_DIR (default: content_cache next to the XLA cache;
+# empty string = memory-only). CDT_CACHE=0 disables the whole subsystem.
+CACHE_DISK_MAX_BYTES = env_int("CDT_CACHE_DISK_MAX_BYTES",
+                               4 * 1024 * 1024 * 1024)
+
 # --- elastic fleet (cluster/elastic, docs/elasticity.md) --------------------
 # Graceful drain: how long a draining worker may keep its in-flight work
 # before the master hands it back to the queue (no poison-bound count,

@@ -108,8 +108,34 @@ export function telemetryRows(metrics) {
   const retries = seriesSum(metrics, "cdt_retry_attempts_total");
   if (retries > 0) rows.push(["Retries", String(retries)]);
   rows.push(["Front door", frontDoorSummary(metrics)]);
+  rows.push(["Content cache", cacheSummary(metrics)]);
   rows.push(["Elastic fleet", elasticSummary(metrics)]);
   return rows;
+}
+
+// Content cache (cluster/cache): per-tier hit rates, coalesce width, and
+// the two loud counters — corruption rejections and hash-tokenization
+// fallbacks — that each mean an operator should look (docs/caching.md).
+export function cacheSummary(metrics) {
+  const hits = countsByLabel(metrics, "cdt_cache_hits_total", "tier");
+  const misses = countsByLabel(metrics, "cdt_cache_misses_total", "tier");
+  const tiers = [...new Set([...Object.keys(hits), ...Object.keys(misses)])]
+    .filter((t) => t).sort();
+  const parts = [];
+  for (const t of tiers) {
+    const h = hits[t] || 0;
+    const total = h + (misses[t] || 0);
+    if (total) parts.push(`${t} ${(100 * h / total).toFixed(0)}% of ${total}`);
+  }
+  const width = mergeHistogram(metrics, "cdt_coalesce_width");
+  if (width && width.count && width.sum > width.count) {
+    parts.push(`coalesce x̄ ${(width.sum / width.count).toFixed(2)}`);
+  }
+  const corrupt = seriesSum(metrics, "cdt_cache_corrupt_total");
+  if (corrupt > 0) parts.push(`${corrupt} CORRUPT rejected`);
+  const hashTok = seriesSum(metrics, "cdt_hash_tokenization_total");
+  if (hashTok > 0) parts.push(`${hashTok} hash-tokenized`);
+  return parts.length ? parts.join(" · ") : "no cacheable traffic";
 }
 
 // Elastic fleet (cluster/elastic): lifecycle states from the

@@ -3,9 +3,9 @@
 import assert from "node:assert/strict";
 import { test } from "node:test";
 
-import { breakerSummary, countsByLabel, elasticSummary, fmtSeconds,
-         frontDoorSummary, histQuantile, mergeHistogram, seriesSum,
-         telemetryRows } from "../telemetryLogic.js";
+import { breakerSummary, cacheSummary, countsByLabel, elasticSummary,
+         fmtSeconds, frontDoorSummary, histQuantile, mergeHistogram,
+         seriesSum, telemetryRows } from "../telemetryLogic.js";
 
 const METRICS = {
   cdt_prompts_total: {
@@ -175,6 +175,52 @@ test("elasticSummary names draining workers and counts scale events", () => {
       series: [{ labels: { direction: "hold", reason: "steady" },
                  value: 9 }] } }),
     "static fleet");
+});
+
+test("cacheSummary reports per-tier hit rates and the loud counters", () => {
+  assert.equal(cacheSummary({}), "no cacheable traffic");
+  const metrics = {
+    cdt_cache_hits_total: {
+      type: "counter",
+      series: [
+        { labels: { tier: "conditioning" }, value: 30 },
+        { labels: { tier: "result" }, value: 6 },
+      ],
+    },
+    cdt_cache_misses_total: {
+      type: "counter",
+      series: [
+        { labels: { tier: "conditioning" }, value: 10 },
+        { labels: { tier: "result" }, value: 6 },
+      ],
+    },
+    cdt_coalesce_width: {
+      type: "histogram",
+      series: [{ labels: {}, buckets: [[1, 4], [2, 6], [4, 8]],
+                 sum: 14, count: 8 }],
+    },
+    cdt_cache_corrupt_total: {
+      type: "counter",
+      series: [{ labels: { tier: "result" }, value: 1 }],
+    },
+    cdt_hash_tokenization_total: {
+      type: "counter",
+      series: [{ labels: { tower: "clip_l" }, value: 5 }],
+    },
+  };
+  const row = cacheSummary(metrics);
+  assert.match(row, /conditioning 75% of 40/);
+  assert.match(row, /result 50% of 12/);
+  assert.match(row, /coalesce x̄ 1.75/);
+  assert.match(row, /1 CORRUPT rejected/);
+  assert.match(row, /5 hash-tokenized/);
+  const byKey = Object.fromEntries(telemetryRows(metrics));
+  assert.match(byKey["Content cache"], /conditioning 75%/);
+  // a width histogram that only ever saw 1s is not worth a fragment
+  assert.equal(cacheSummary({ cdt_coalesce_width: {
+    type: "histogram",
+    series: [{ labels: {}, buckets: [[1, 3]], sum: 3, count: 3 }] } }),
+    "no cacheable traffic");
 });
 
 test("telemetryRows tolerates absent families and renders the rest", () => {

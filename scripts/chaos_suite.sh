@@ -40,7 +40,7 @@ env JAX_PLATFORMS=cpu CDT_CHAOS_SEED="${SEED}" \
 echo "[chaos] stage 3: full chaos tier"
 env JAX_PLATFORMS=cpu CDT_CHAOS_SEED="${SEED}" \
     python -m pytest tests/ -q -m chaos \
-    -k "not warm_restarted and not overload and not scale_event" \
+    -k "not warm_restarted and not overload and not scale_event and not cache_corrupt" \
     -p no:cacheprovider --continue-on-collection-errors "$@"
 
 # Stage 4 — seeded scale events under live load (ISSUE 10,
@@ -57,7 +57,25 @@ env JAX_PLATFORMS=cpu CDT_CHAOS_SEED="${SEED}" CDT_STEAL_SEED="${SEED}" \
     python -m pytest tests/ -q -m chaos -k "scale_event" \
     -p no:cacheprovider --continue-on-collection-errors "$@"
 echo "[chaos] stage 4b: churn load smoke (zero admitted-job loss)"
-exec env JAX_PLATFORMS=cpu PYTHONPATH="$(pwd)" \
+env JAX_PLATFORMS=cpu PYTHONPATH="$(pwd)" \
     CDT_CONFIG_PATH="$(mktemp -d)/config.json" \
     python scripts/load_smoke.py --in-process --churn --n 12 \
+    --concurrency 8 --seed "${SEED}"
+
+# Stage 5 — persisted-cache corruption under live load (ISSUE 11,
+# docs/caching.md): a persisted result-cache entry is byte-flipped while
+# a duplicate-heavy load runs. Asserted: the checksum rejects the entry
+# LOUDLY (cdt_cache_corrupt_total), the request recomputes, every served
+# image is bit-identical to the uncorrupted reference, and zero admitted
+# jobs are lost. Then the dup-rate smoke: a seeded duplicate/near-dup
+# mix through the real front door, exit 1 on any admitted-job loss.
+echo "[chaos] stage 5: cache corruption under load (zero wrong-byte serves)"
+env JAX_PLATFORMS=cpu CDT_CHAOS_SEED="${SEED}" \
+    python -m pytest tests/ -q -m chaos -k "cache_corrupt" \
+    -p no:cacheprovider --continue-on-collection-errors "$@"
+echo "[chaos] stage 5b: duplicate-mix load smoke (dup-rate 0.5)"
+exec env JAX_PLATFORMS=cpu PYTHONPATH="$(pwd)" \
+    CDT_CONFIG_PATH="$(mktemp -d)/config.json" \
+    CDT_CACHE_DIR="$(mktemp -d)" \
+    python scripts/load_smoke.py --in-process --n 12 --dup-rate 0.5 \
     --concurrency 8 --seed "${SEED}"

@@ -53,18 +53,41 @@ def build_workload(seed: int, n: int, *,
                    shapes: tuple = ((16, 2), (24, 2)),
                    tenants: tuple = ("tenant-a", "tenant-b"),
                    priorities: tuple = ("interactive", "batch"),
-                   model: str = "tiny") -> list[dict]:
+                   model: str = "tiny",
+                   dup_rate: float = 0.0,
+                   near_fraction: float = 0.5) -> list[dict]:
     """N deterministic ``POST /distributed/queue`` payloads. Same seed →
-    same workload, byte for byte — chaos runs replay exactly."""
+    same workload, byte for byte — chaos runs replay exactly.
+
+    ``dup_rate`` (0..1) makes that fraction of requests duplicates of an
+    earlier one — the production redundancy the content cache exists for
+    (docs/caching.md). ``near_fraction`` of the duplicates are
+    *near*-duplicates that re-roll only the seed (conditioning-cache
+    traffic: same text, new sampling); the rest repeat the earlier
+    prompt BYTE-IDENTICALLY (coalescer/result-cache traffic).
+    client_id/tenant stay the dup's own — duplicates come from
+    *different* users."""
     rng = random.Random(seed)
     out = []
+    uniques: list[dict] = []
     for i in range(n):
-        wh, steps = shapes[rng.randrange(len(shapes))]
         tenant = tenants[rng.randrange(len(tenants))]
         priority = priorities[rng.randrange(len(priorities))]
+        if uniques and rng.random() < dup_rate:
+            base = uniques[rng.randrange(len(uniques))]
+            prompt = json.loads(json.dumps(base))   # deep copy
+            if rng.random() < near_fraction:
+                # near-duplicate: same prompt text/shape, fresh seed
+                sampler = next(v for v in prompt.values()
+                               if v["class_type"] == "TPUTxt2Img")
+                sampler["inputs"]["seed"] = 5000 + i
+        else:
+            wh, steps = shapes[rng.randrange(len(shapes))]
+            prompt = prompt_for(seed=1000 + i, text=f"load {i}",
+                                wh=wh, steps=steps, model=model)
+            uniques.append(prompt)
         out.append({
-            "prompt": prompt_for(seed=1000 + i, text=f"load {i}",
-                                 wh=wh, steps=steps, model=model),
+            "prompt": prompt,
             "tenant": tenant,
             "priority": priority,
             "client_id": f"load_smoke_{i}",
@@ -253,15 +276,26 @@ async def _run_http(url: str, requests: list[dict], concurrency: int,
 
 
 def _occupancy_from_snapshot(snap: dict) -> dict:
-    """``{batch_programs, mean_batch_size}`` from a metrics.json-shaped
-    snapshot — shared by the HTTP and in-process modes (and consumed by
-    bench.py's serving workload) so the definition can't drift."""
-    fam = (snap.get("metrics") or {}).get("cdt_batch_size") or {}
+    """``{batch_programs, mean_batch_size, cache_hits, coalesce_width}``
+    from a metrics.json-shaped snapshot — shared by the HTTP and
+    in-process modes (and consumed by bench.py's serving/caching
+    workloads) so the definitions can't drift."""
+    metrics = snap.get("metrics") or {}
+    fam = metrics.get("cdt_batch_size") or {}
     series = fam.get("series") or []
     total = sum(s.get("count", 0) for s in series)
     ssum = sum(s.get("sum", 0) for s in series)
-    return {"batch_programs": total,
-            "mean_batch_size": round(ssum / total, 3) if total else None}
+    out = {"batch_programs": total,
+           "mean_batch_size": round(ssum / total, 3) if total else None}
+    hits = (metrics.get("cdt_cache_hits_total") or {}).get("series") or []
+    out["cache_hits"] = {
+        (s.get("labels") or {}).get("tier", ""): s.get("value", 0)
+        for s in hits} or None
+    cw = (metrics.get("cdt_coalesce_width") or {}).get("series") or []
+    n = sum(s.get("count", 0) for s in cw)
+    w = sum(s.get("sum", 0) for s in cw)
+    out["coalesce_width"] = round(w / n, 3) if n else None
+    return out
 
 
 async def _fetch_occupancy(session, url: str) -> dict:
@@ -358,6 +392,11 @@ def main() -> int:
     ap.add_argument("--n", type=int, default=64)
     ap.add_argument("--concurrency", type=int, default=16)
     ap.add_argument("--seed", type=int, default=7)
+    ap.add_argument("--dup-rate", type=float, default=0.0,
+                    help="fraction of requests that duplicate an earlier "
+                         "one (alternating byte-identical and "
+                         "seed-rerolled near-duplicates) — the content "
+                         "cache's traffic shape (docs/caching.md)")
     ap.add_argument("--no-wait", action="store_true",
                     help="submit only; skip waiting for completion")
     ap.add_argument("--timeout-s", type=float, default=600.0)
@@ -372,7 +411,10 @@ def main() -> int:
     ap.add_argument("--churn-interval-s", type=float, default=0.3)
     cli = ap.parse_args()
 
-    requests = build_workload(cli.seed, cli.n)
+    if not 0.0 <= cli.dup_rate <= 1.0:
+        print("--dup-rate must be in [0, 1]", file=sys.stderr)
+        return 2
+    requests = build_workload(cli.seed, cli.n, dup_rate=cli.dup_rate)
     wait = not cli.no_wait
     churn = None
     if cli.churn:

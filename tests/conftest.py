@@ -86,6 +86,17 @@ def _isolate_attn_table(tmp_path_factory, monkeypatch):
         mod.reset_default_table()
 
 
+@pytest.fixture(autouse=True)
+def _isolate_content_cache(tmp_path_factory, monkeypatch):
+    """The content cache (cluster/cache) persists next to the XLA cache
+    by default; point every test at a throwaway directory so no test
+    serves another's entries (or a real leftover). The in-memory tiers
+    are per-Controller, so no global reset is needed."""
+    monkeypatch.setenv(
+        "CDT_CACHE_DIR", str(tmp_path_factory.mktemp("content_cache")))
+    yield
+
+
 @pytest.fixture
 def fault_plan():
     """Activate a seeded FaultPlan for the test; returns an installer:

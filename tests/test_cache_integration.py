@@ -1,0 +1,319 @@
+"""End-to-end acceptance for the content cache (ISSUE 11, docs/caching.md):
+
+- cached-vs-recomputed conditioning is BIT-identical through the real
+  pipeline;
+- N coalesced waiters receive outputs bit-identical to a solo run, each
+  with its own history entry;
+- a corrupted persisted entry is checksum-rejected loudly and recomputed
+  — never served (the chaos-marked case runs it under live load);
+- ``cache: "bypass"`` re-executes and stays bit-identical;
+- ``CDT_CACHE=0`` removes the subsystem.
+
+All drive the REAL controller + HTTP route with the tiny preset on the
+8-device virtual CPU mesh, same geometry as the front-door load tests so
+the compiled programs are shared across the suite.
+"""
+
+import asyncio
+import time
+
+import jax
+import numpy as np
+import pytest
+
+WH, STEPS = 16, 2
+
+
+def _prompt(seed=41, text="a cache cat", wh=WH, steps=STEPS):
+    return {
+        "1": {"class_type": "CheckpointLoader",
+              "inputs": {"ckpt_name": "tiny"}},
+        "2": {"class_type": "CLIPTextEncode",
+              "inputs": {"text": text, "clip": ["1", 1]}},
+        "3": {"class_type": "CLIPTextEncode",
+              "inputs": {"text": "", "clip": ["1", 1]}},
+        "4": {"class_type": "TPUTxt2Img", "inputs": {
+            "model": ["1", 0], "positive": ["2", 0], "negative": ["3", 0],
+            "seed": seed, "steps": steps, "cfg": 2.0,
+            "width": wh, "height": wh}},
+    }
+
+
+async def _with_controller(fn):
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from comfyui_distributed_tpu.api import create_app
+    from comfyui_distributed_tpu.cluster.controller import Controller
+
+    controller = Controller()
+    client = TestClient(TestServer(create_app(controller)))
+    await client.start_server()
+    try:
+        return await fn(controller, client)
+    finally:
+        await client.close()
+
+
+async def _submit(client, payload):
+    resp = await client.post("/distributed/queue", json=payload)
+    return resp.status, await resp.json()
+
+
+async def _wait(controller, pid, timeout=300.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        entry = controller.queue.history.get(pid)
+        if entry is not None:
+            return entry
+        await asyncio.sleep(0.02)
+    raise AssertionError(f"prompt {pid} never reached terminal status")
+
+
+def _images(entry):
+    out = []
+    for nid in sorted(entry.get("outputs") or {}):
+        for v in entry["outputs"][nid]:
+            if hasattr(v, "shape") and getattr(v, "ndim", 0) >= 3:
+                out.append(np.asarray(v))
+    assert out, f"no image outputs in entry: {list(entry)}"
+    return out
+
+
+def test_conditioning_cache_bit_identical_through_real_pipeline(tmp_config):
+    """The same prompt encoded cold and cache-served must produce
+    BIT-identical conditioning AND bit-identical generated images through
+    the real tiny pipeline."""
+    from comfyui_distributed_tpu.cluster.cache import build_cache_manager
+    from comfyui_distributed_tpu.cluster.cache.conditioning import \
+        cached_encode
+    from comfyui_distributed_tpu.diffusion.pipeline import GenerationSpec
+    from comfyui_distributed_tpu.models.registry import ModelRegistry
+    from comfyui_distributed_tpu.parallel import build_mesh
+
+    manager = build_cache_manager()
+    bundle = ModelRegistry().get("tiny")
+    enc = bundle.text_encoder
+    assert enc._cdt_encoder_id            # registry stamped it
+
+    c_cold, p_cold = cached_encode(manager, enc, ["bit identical?"])
+    assert manager.conditioning.counts["miss"] == 1
+    c_hit, p_hit = cached_encode(manager, enc, ["bit identical?"])
+    assert manager.conditioning.counts["hit"] == 1
+    assert np.array_equal(np.asarray(c_cold), np.asarray(c_hit))
+    assert np.array_equal(np.asarray(p_cold), np.asarray(p_hit))
+
+    mesh = build_mesh({"dp": 2})
+    spec = GenerationSpec(height=WH, width=WH, steps=STEPS,
+                          guidance_scale=2.0)
+    uncond, _ = cached_encode(manager, enc, [""])
+    img_cold = np.asarray(bundle.pipeline.generate(
+        mesh, spec, 3, c_cold, uncond))
+    img_hit = np.asarray(bundle.pipeline.generate(
+        mesh, spec, 3, c_hit, uncond))
+    assert np.array_equal(img_cold, img_hit)
+
+
+def test_coalesced_waiters_bit_identical_to_solo(tmp_config):
+    """N byte-identical concurrent submissions: ONE executes, the rest
+    coalesce — and every waiter's bytes equal a solo run's."""
+
+    async def body(controller, client):
+        payload = {"prompt": _prompt(), "client_id": "c"}
+        # solo reference first (its own fingerprint would serve the
+        # waiters from the result tier, so use a distinct seed)
+        ref_payload = {"prompt": _prompt(seed=42), "client_id": "ref"}
+        s, b = await _submit(client, ref_payload)
+        assert s == 200, b
+        ref = _images(await _wait(controller, b["prompt_id"]))
+
+        results = await asyncio.gather(
+            *(_submit(client, dict(payload)) for _ in range(3)))
+        assert all(s == 200 for s, _ in results)
+        coalesced = [b.get("coalesced") for _, b in results]
+        assert coalesced.count(True) == 2, coalesced
+        entries = [await _wait(controller, b["prompt_id"])
+                   for _, b in results]
+        assert all(e["status"] == "success" for e in entries)
+        # every member has its OWN history entry; waiters are marked
+        assert sum(1 for e in entries if e.get("coalesced_with")) == 2
+        imgs = [_images(e) for e in entries]
+        for other in imgs[1:]:
+            for a, b_ in zip(imgs[0], other):
+                assert np.array_equal(a, b_)
+        # the coalesce width histogram observed the 3-wide flight
+        stats = controller.cache.coalescer.stats()
+        assert stats["coalesced_waiters"] == 2
+
+        # solo-vs-coalesced bit-identity: re-run the same prompt with
+        # cache bypassed (fresh execution, no serving) and compare
+        s, b = await _submit(client, dict(payload, cache="bypass"))
+        bypass = _images(await _wait(controller, b["prompt_id"]))
+        for a, b_ in zip(imgs[0], bypass):
+            assert np.array_equal(a, b_)
+        return True
+
+    assert asyncio.run(_with_controller(body))
+
+
+def test_result_cache_serves_resubmission_bit_identical(tmp_config):
+    async def body(controller, client):
+        payload = {"prompt": _prompt(seed=77, text="resubmit"),
+                   "client_id": "c"}
+        s, b = await _submit(client, payload)
+        first = await _wait(controller, b["prompt_id"])
+        assert first["status"] == "success"
+        assert first.get("cache") is None
+
+        s, b = await _submit(client, dict(payload))
+        second = await _wait(controller, b["prompt_id"])
+        assert second["status"] == "success"
+        assert second.get("cache") == "hit"
+        for a, b_ in zip(_images(first), _images(second)):
+            assert np.array_equal(a, b_)
+        assert controller.cache.results.counts["hit"] >= 1
+        return True
+
+    assert asyncio.run(_with_controller(body))
+
+
+@pytest.mark.chaos
+def test_cache_corrupt_entry_under_live_load_never_served(tmp_config):
+    """Chaos stage 5 (scripts/chaos_suite.sh): corrupt a persisted
+    result-cache entry while load is in flight. Asserted: ZERO
+    admitted-job loss, zero wrong-byte serves (every output bit-identical
+    to the uncorrupted reference), and the rejection is loud
+    (checksum-mismatch counter + recompute)."""
+
+    async def body(controller, client):
+        target = {"prompt": _prompt(seed=91, text="corrupt me"),
+                  "client_id": "t"}
+        s, b = await _submit(client, target)
+        reference = _images(await _wait(controller, b["prompt_id"]))
+
+        # drop the memory tier so the next hit MUST come from disk,
+        # then flip a byte in the persisted sidecar
+        tier = controller.cache.results
+        keys = list(tier._read_index())
+        assert keys, "expected a persisted result entry"
+        assert tier.clear_memory() >= 1
+        path = tier._entry_path(keys[0])
+        raw = bytearray(path.read_bytes())
+        raw[len(raw) // 2] ^= 0xFF
+        path.write_bytes(bytes(raw))
+
+        # live load: the corrupted-fingerprint request rides among
+        # fresh traffic
+        mixed = [dict(target)] + [
+            {"prompt": _prompt(seed=100 + i, text=f"load {i}"),
+             "client_id": f"l{i}"} for i in range(3)]
+        results = await asyncio.gather(
+            *(_submit(client, p) for p in mixed))
+        assert all(s == 200 for s, _ in results)
+        entries = [await _wait(controller, b["prompt_id"])
+                   for _, b in results]
+        # zero admitted-job loss: every request reached success
+        assert [e["status"] for e in entries] == ["success"] * 4
+        # zero wrong-byte serves: the corrupted entry was rejected and
+        # recomputed — bytes match the pre-corruption reference
+        for a, b_ in zip(reference, _images(entries[0])):
+            assert np.array_equal(a, b_)
+        assert entries[0].get("cache") is None     # recomputed, not served
+        assert tier.counts["corrupt"] >= 1
+        return True
+
+    assert asyncio.run(_with_controller(body))
+
+
+def test_cache_stats_route_and_clear(tmp_config):
+    async def body(controller, client):
+        payload = {"prompt": _prompt(seed=55, text="stats"),
+                   "client_id": "c"}
+        s, b = await _submit(client, payload)
+        await _wait(controller, b["prompt_id"])
+        resp = await client.get("/distributed/cache")
+        stats = await resp.json()
+        assert stats["enabled"] and "result" in stats
+        assert stats["result"]["put"] >= 1
+        resp = await client.post("/distributed/cache/clear", json={})
+        body_ = await resp.json()
+        assert body_["status"] == "cleared" and body_["dropped"] >= 1
+        # persisted tier survives a memory clear: resubmit still hits
+        s, b = await _submit(client, dict(payload))
+        entry = await _wait(controller, b["prompt_id"])
+        assert entry.get("cache") == "hit"
+        return True
+
+    assert asyncio.run(_with_controller(body))
+
+
+def test_cache_kill_switch_restores_plain_path(tmp_config, monkeypatch):
+    monkeypatch.setenv("CDT_CACHE", "0")
+
+    async def body(controller, client):
+        assert controller.cache is None
+        payload = {"prompt": _prompt(seed=66, text="no cache"),
+                   "client_id": "c"}
+        s, b = await _submit(client, payload)
+        assert s == 200 and not b.get("coalesced")
+        first = await _wait(controller, b["prompt_id"])
+        s, b = await _submit(client, dict(payload))
+        second = await _wait(controller, b["prompt_id"])
+        assert second.get("cache") is None
+        for a, b_ in zip(_images(first), _images(second)):
+            assert np.array_equal(a, b_)
+        resp = await client.get("/distributed/cache")
+        assert (await resp.json()) == {"enabled": False}
+        return True
+
+    assert asyncio.run(_with_controller(body))
+
+
+def test_expired_leader_waiter_gets_fresh_execution(tmp_config):
+    """A leader that expires on ITS deadline must not verdict its
+    deadline-less waiter: the waiter is re-dispatched and completes."""
+
+    async def body(controller, client):
+        # a different-GroupKey blocker occupies the executor so the
+        # leader sits in queue past its deadline
+        blocker = {"prompt": _prompt(seed=301, text="blocker", wh=24),
+                   "client_id": "b"}
+        sb, bb = await _submit(client, blocker)
+        assert sb == 200, bb
+        dup = {"prompt": _prompt(seed=302, text="expiring leader"),
+               "client_id": "c"}
+        s1, b1 = await _submit(client, dict(dup, deadline_ms=50))
+        s2, b2 = await _submit(client, dict(dup))    # waiter, NO deadline
+        assert b2.get("coalesced"), (b1, b2)
+        leader_entry = await _wait(controller, b1["prompt_id"])
+        assert leader_entry["status"] == "expired"
+        waiter_entry = await _wait(controller, b2["prompt_id"])
+        assert waiter_entry["status"] == "success", waiter_entry
+        assert waiter_entry.get("coalesced_with") is None  # fresh run
+        assert controller.cache.coalescer.redispatched_waiters == 1
+        return True
+
+    assert asyncio.run(_with_controller(body))
+
+
+def test_interrupted_leader_resolves_waiters(tmp_config):
+    """A waiter must NEVER hang: interrupting the queue while a leader
+    is pending settles its waiters with the same terminal status."""
+
+    async def body(controller, client):
+        # wedge the queue with a slow job so the leader stays queued
+        blocker = {"prompt": _prompt(seed=201, text="blocker"),
+                   "client_id": "b"}
+        s, b = await _submit(client, blocker)
+        bpid = b["prompt_id"]
+        dup = {"prompt": _prompt(seed=202, text="dup target"),
+               "client_id": "c"}
+        s1, b1 = await _submit(client, dict(dup))
+        s2, b2 = await _submit(client, dict(dup))
+        assert b2.get("coalesced") or b1.get("coalesced")
+        controller.queue.interrupt()
+        for pid in (bpid, b1["prompt_id"], b2["prompt_id"]):
+            entry = await _wait(controller, pid, timeout=300.0)
+            assert entry["status"] in ("interrupted", "success")
+        return True
+
+    assert asyncio.run(_with_controller(body))
