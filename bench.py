@@ -1934,10 +1934,11 @@ def _watchdog_main(cli) -> None:
     — then fall back to a tiny-capped CPU run, loudly and explicitly.
     A short preflight probe runs FIRST: a backend that cannot even
     enumerate devices skips the full-budget attempts entirely."""
-    budget = float(os.environ.get("CDT_BENCH_BUDGET_S", "2400"))
-    attempt_timeout = float(os.environ.get("CDT_BENCH_ATTEMPT_TIMEOUT_S", "1800"))
-    preflight_timeout = float(os.environ.get(
-        "CDT_BENCH_PREFLIGHT_TIMEOUT_S", "120"))
+    from comfyui_distributed_tpu.utils import constants
+
+    budget = constants.BENCH_BUDGET_S.get()
+    attempt_timeout = constants.BENCH_ATTEMPT_TIMEOUT_S.get()
+    preflight_timeout = constants.BENCH_PREFLIGHT_TIMEOUT_S.get()
     start = time.monotonic()
     attempt = 0
     last_err = None

@@ -7,6 +7,7 @@ tree; every handler returns JSON; errors use the standardized payload
 
 from __future__ import annotations
 
+import asyncio
 import hashlib
 import json
 from pathlib import Path
@@ -411,9 +412,7 @@ def create_app(controller: Controller) -> web.Application:
 
     # --- media sync (reference api/job_routes.py:238-270 + /upload/image) --
     def _safe_media_path(rel: str) -> Path:
-        import os
-
-        base = Path(os.environ.get("CDT_INPUT_DIR", "input")).resolve()
+        base = Path(constants.INPUT_DIR.get()).resolve()
         p = (base / rel).resolve()
         if not str(p).startswith(str(base)):
             raise ValidationError("path escapes input directory", field="path")
@@ -427,7 +426,10 @@ def create_app(controller: Controller) -> web.Application:
         p = _safe_media_path(rel)
         if not p.is_file():
             return web.json_response({"exists": False})
-        md5 = hashlib.md5(p.read_bytes()).hexdigest()
+        # media files are multi-MB (videos multi-GB): read + hash must not
+        # stall every other request on the event loop (lint rule A001)
+        md5 = await asyncio.get_running_loop().run_in_executor(
+            None, lambda: hashlib.md5(p.read_bytes()).hexdigest())
         matches = body.get("md5") is None or body["md5"] == md5
         return web.json_response({"exists": True, "md5": md5, "matches": matches})
 
@@ -441,10 +443,18 @@ def create_app(controller: Controller) -> web.Application:
         p = _safe_media_path(rel)
         if not p.is_file():
             return json_error(f"file not found: {rel}", 404)
-        raw = p.read_bytes()
+
+        def read_encode_hash():
+            # b64 + md5 of a multi-MB payload are CPU work too — the
+            # whole read/encode/hash pipeline stays off the event loop
+            raw = p.read_bytes()
+            return base64.b64encode(raw).decode(), hashlib.md5(raw).hexdigest()
+
+        b64, md5 = await asyncio.get_running_loop().run_in_executor(
+            None, read_encode_hash)
         return web.json_response({
-            "image": "data:image/png;base64," + base64.b64encode(raw).decode(),
-            "md5": hashlib.md5(raw).hexdigest(),
+            "image": "data:image/png;base64," + b64,
+            "md5": md5,
         })
 
     async def upload_image(request):
@@ -456,7 +466,9 @@ def create_app(controller: Controller) -> web.Application:
             rel = part.filename or "upload.png"
             p = _safe_media_path(rel)
             p.parent.mkdir(parents=True, exist_ok=True)
-            p.write_bytes(await part.read())
+            data = await part.read()
+            await asyncio.get_running_loop().run_in_executor(
+                None, p.write_bytes, data)
             saved.append(rel)
         return web.json_response({"saved": saved})
 

@@ -8,6 +8,7 @@ from pathlib import Path
 
 from aiohttp import web
 
+from ..utils import constants
 from ..utils.exceptions import ValidationError
 
 
@@ -96,7 +97,7 @@ def register(router, controller) -> None:
 
         from ..utils.logging import get_log_buffer
 
-        log_file = os.environ.get("CDT_LOG_FILE", "")
+        log_file = constants.LOG_FILE.get()
         if log_file and Path(log_file).is_file():
             return web.json_response(
                 {"log": tail_file(Path(log_file)), "available": True})
@@ -130,7 +131,7 @@ def register(router, controller) -> None:
         # peer must not direct filesystem writes)
         from ..utils.names import sanitize_name
 
-        root = os.environ.get("CDT_PROFILE_DIR", "/tmp/cdt_profile")
+        root = constants.PROFILE_DIR.get()
         name = sanitize_name(
             os.path.basename(str(body.get("out") or _t.strftime("%Y%m%d-%H%M%S"))),
             max_len=80, fallback="trace")
@@ -251,9 +252,7 @@ def register(router, controller) -> None:
 
     # --- shipped workflows --------------------------------------------------
     def _workflows_dir() -> Path:
-        import os
-
-        env = os.environ.get("CDT_WORKFLOWS_DIR")
+        env = constants.WORKFLOWS_DIR.get()
         if env:
             return Path(env)
         # repo layout: workflows/ beside the package

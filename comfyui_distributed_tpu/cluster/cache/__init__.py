@@ -34,7 +34,6 @@ request. Eviction is size-capped LRU with pinning, mirroring
 
 from __future__ import annotations
 
-import os
 from collections import deque
 from pathlib import Path
 from typing import Optional
@@ -57,7 +56,7 @@ CACHE_MODES = ("use", "bypass")
 
 
 def cache_enabled() -> bool:
-    return os.environ.get("CDT_CACHE", "1") not in ("0", "false")
+    return constants.CACHE.get()
 
 
 def cache_dir() -> Optional[Path]:
@@ -65,7 +64,7 @@ def cache_dir() -> Optional[Path]:
     to a ``content_cache`` sibling of the XLA compile cache (the same
     shared volume a fleet already mounts for warm restarts). Empty
     string = memory-only."""
-    env = os.environ.get("CDT_CACHE_DIR")
+    env = constants.CACHE_DIR.get()
     if env is not None:
         return Path(env) if env else None
     from ...utils.compile_cache import cache_dir_default

@@ -35,6 +35,7 @@ from typing import Optional
 
 import numpy as np
 
+from ...lint.lockorder import tracked_lock
 from ...utils.jsonio import atomic_write_json, read_json
 from ...utils.logging import debug_log, log
 from . import keys as _keys
@@ -83,7 +84,7 @@ class CacheTier:
         self.dir = Path(directory) if directory else None
         self.disk_max_bytes = int(disk_max_bytes)
         self._entries: "OrderedDict[str, _Entry]" = OrderedDict()
-        self._lock = threading.RLock()
+        self._lock = tracked_lock(f"cache.tier.{tier}", reentrant=True)
         self.counts = {"hit": 0, "miss": 0, "disk_hit": 0, "put": 0,
                        "evicted": 0, "corrupt": 0, "persisted": 0}
 
@@ -271,7 +272,10 @@ class CacheTier:
             row = {"file": path.name, "sha256": _keys.checksum(payload),
                    "bytes": len(payload), "saved_at": time.time()}
             self._write_index(lambda e: e.__setitem__(key, row))
-            self.counts["persisted"] += 1
+            # counts is mutated under self._lock everywhere else; a bare
+            # dict += here is a lost-update race (lint rule L001)
+            with self._lock:
+                self.counts["persisted"] += 1
             self._disk_evict_over_budget()
         except OSError as e:
             debug_log(f"cache[{self.tier}]: persist of {key[:12]} "

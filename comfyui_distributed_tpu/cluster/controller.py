@@ -44,7 +44,7 @@ class Controller:
         # honors the env var on top of this source)
         set_debug_source(
             lambda: bool(peek_setting("debug", False, config_path)))
-        self.is_worker = os.environ.get(IS_WORKER_ENV, "") not in ("", "0")
+        self.is_worker = constants.IS_WORKER.get()
         self.store = JobStore()
         self.queue = PromptQueue(context_factory=self._execution_context)
         self.orchestrator = Orchestrator(self.store, self.queue,
@@ -68,8 +68,8 @@ class Controller:
         self._mesh = None
         self._mesh_devices = mesh_devices
         self._registry = None
-        self.worker_id = os.environ.get("CDT_WORKER_ID", "")
-        self.worker_index = int(os.environ.get("CDT_WORKER_INDEX", "0") or 0)
+        self.worker_id = constants.WORKER_ID.get()
+        self.worker_index = constants.WORKER_INDEX.get()
         from .progress import ProgressTracker
         self.progress = ProgressTracker()
         # AOT warmup state machine (diffusion/warmup.py): health probes
@@ -116,7 +116,7 @@ class Controller:
         if self._registry is None:
             from ..models.registry import ModelRegistry
 
-            root = os.environ.get("CDT_CHECKPOINT_ROOT")
+            root = constants.CHECKPOINT_ROOT.get()
             self._registry = ModelRegistry(Path(root) if root else None)
         return self._registry
 
@@ -124,8 +124,8 @@ class Controller:
         ctx: dict[str, Any] = {
             "mesh": self.mesh,
             "model_registry": self.model_registry,
-            "output_dir": os.environ.get("CDT_OUTPUT_DIR", "output"),
-            "input_dir": os.environ.get("CDT_INPUT_DIR", "input"),
+            "output_dir": constants.OUTPUT_DIR.get(),
+            "input_dir": constants.INPUT_DIR.get(),
             "job_store": self.store,
             "is_worker": self.is_worker,
             "worker_id": self.worker_id,
@@ -165,7 +165,7 @@ class Controller:
             # flag (reference handshake, api/worker_routes.py:115-139);
             # reference kept so the task can't be GC'd before running
             self._ready_task = asyncio.ensure_future(self._report_ready())
-        if os.environ.get("CDT_WARMUP", "") not in ("", "0", "false"):
+        if constants.WARMUP.get():
             # AOT warmup off the request path: compiles run in their own
             # thread (NOT the graph-exec pool — a dispatched prompt must
             # not queue behind the whole catalog); health reports
@@ -179,7 +179,7 @@ class Controller:
 
         from ..utils.network import get_client_session
 
-        master_port = os.environ.get("CDT_MASTER_PORT", "")
+        master_port = constants.MASTER_PORT.get()
         if not master_port:
             return
         url = (f"http://127.0.0.1:{master_port}"

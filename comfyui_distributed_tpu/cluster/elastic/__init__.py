@@ -27,9 +27,9 @@ from __future__ import annotations
 
 import asyncio
 import importlib
-import os
 from typing import Optional
 
+from ...utils import constants
 from ...utils.logging import log
 from .autoscaler import (AutoscalePolicy, Autoscaler, FleetSignals,
                          LocalProcessProvider, ScaleProvider)
@@ -46,7 +46,7 @@ __all__ = [
 
 
 def autoscale_enabled() -> bool:
-    return os.environ.get("CDT_AUTOSCALE", "") not in ("", "0", "false")
+    return constants.AUTOSCALE.get()
 
 
 def _step_time_p50() -> "float | None":
@@ -81,7 +81,7 @@ def _load_provider_factory():
     building a custom :class:`ScaleProvider` (remote/tunnel capacity).
     A broken spec logs and falls back to the local provider — an env
     typo must not take autoscaling down with it."""
-    spec = os.environ.get("CDT_SCALE_PROVIDER", "")
+    spec = constants.SCALE_PROVIDER.get()
     if not spec:
         return None
     try:

@@ -27,8 +27,9 @@ from __future__ import annotations
 
 import dataclasses
 import hashlib
-import os
 from typing import Optional, Sequence
+
+from ...utils import constants
 
 
 def _stable_tiebreak(seed: int, job_seq: int, worker_id: str) -> int:
@@ -61,7 +62,7 @@ class StealPolicy:
 
     def __init__(self, seed: Optional[int] = None):
         if seed is None:
-            seed = int(os.environ.get("CDT_STEAL_SEED", "0") or 0)
+            seed = constants.STEAL_SEED.get()
         self.seed = seed
 
     def rank(self, jobs: Sequence[JobView],

@@ -32,6 +32,7 @@ import threading
 import time
 from typing import Callable, Optional
 
+from ...lint.lockorder import tracked_lock
 from ...telemetry import enabled as _tm_enabled, metrics as _tm
 from ...utils.logging import log
 
@@ -47,7 +48,7 @@ class DrainRegistry:
     """
 
     def __init__(self, clock: Callable[[], float] = time.monotonic):
-        self._lock = threading.Lock()
+        self._lock = tracked_lock("elastic.drain")
         self._states: dict[str, str] = {}
         # worker_id → monotonic deadline by which in-flight work must be
         # finished or handed back (None = no deadline pressure yet)

@@ -40,7 +40,6 @@ check) unless a plan is active. See docs/resilience.md.
 from __future__ import annotations
 
 import asyncio
-import os
 import random
 import re
 import threading
@@ -244,7 +243,9 @@ def active_plan() -> Optional[FaultPlan]:
     global _active, _env_checked
     if not _env_checked:
         _env_checked = True
-        spec = os.environ.get(FAULTS_ENV, "")
+        from ..utils.constants import FAULTS
+
+        spec = FAULTS.get()
         if spec:
             _active = FaultPlan.parse(spec)
             log(f"faults: {FAULTS_ENV} plan active (seed={_active.seed}, "

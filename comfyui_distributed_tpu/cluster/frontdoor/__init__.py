@@ -34,7 +34,6 @@ from __future__ import annotations
 
 import asyncio
 import dataclasses
-import os
 import secrets
 import time
 from typing import Optional
@@ -51,7 +50,7 @@ from .classifier import fingerprint as classifier_fingerprint
 
 
 def frontdoor_enabled() -> bool:
-    return os.environ.get("CDT_FRONTDOOR", "1") not in ("0", "false")
+    return constants.FRONTDOOR.get()
 
 
 @dataclasses.dataclass

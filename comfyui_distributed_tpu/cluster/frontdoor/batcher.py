@@ -21,7 +21,6 @@ from __future__ import annotations
 
 import asyncio
 import dataclasses
-import os
 import time
 from typing import Callable, Optional
 
@@ -31,9 +30,9 @@ from .classifier import GroupKey
 
 
 def _max_wait_ms() -> float:
-    env = os.environ.get("CDT_FD_MAX_WAIT_MS", "")
-    if env:
-        return float(env)
+    env = constants.FD_MAX_WAIT_MS.get()
+    if env is not None:
+        return env
     return constants.FD_WINDOW_MS * 20.0
 
 

@@ -232,7 +232,8 @@ def _fill_cache(p: _Prepared, cache, images) -> None:
 
 def _execute_group_inner(members: list, sampler_node_ids: dict,
                          base_context: dict, results: dict) -> None:
-    t0 = time.monotonic()
+    # telemetry wall-clock only: never feeds keys/outputs
+    t0 = time.monotonic()  # cdtlint: disable=D001
     cache = base_context.get("content_cache")
     prepared: list[_Prepared] = []
 
@@ -338,6 +339,7 @@ def _execute_group_inner(members: list, sampler_node_ids: dict,
                     f"{p.member.prompt_id}: {e}")
 
     debug_log(f"front door: group of {len(members)} done in "
+              # cdtlint: disable=D001 -- telemetry duration only
               f"{time.monotonic() - t0:.2f}s "
               f"({len(groups)} stack(s), {len(singles)} solo)")
 

@@ -18,7 +18,6 @@ from __future__ import annotations
 import asyncio
 import dataclasses
 import hashlib
-import os
 from pathlib import Path
 from typing import Any, Optional
 
@@ -98,7 +97,7 @@ async def fetch_host_path_separator(host: dict, timeout: float = 10.0) -> str:
 
 
 def local_input_dir() -> Path:
-    return Path(os.environ.get("CDT_INPUT_DIR", "input"))
+    return Path(constants.INPUT_DIR.get())
 
 
 def _md5_file(path: Path) -> str:

@@ -33,10 +33,11 @@ from __future__ import annotations
 
 import contextlib
 import dataclasses
-import os
 import threading
 from typing import Callable, Optional
 
+from ..lint.lockorder import tracked_lock
+from ..utils.constants import HBM_BUDGET_GB
 from ..utils.exceptions import DistributedError
 from ..utils.logging import log
 
@@ -47,8 +48,7 @@ class ResidencyError(DistributedError):
 
 def hbm_budget_bytes() -> int:
     """0 = unlimited (planner off)."""
-    gb = float(os.environ.get("CDT_HBM_BUDGET_GB", "0") or 0)
-    return int(gb * (1 << 30))
+    return int(HBM_BUDGET_GB.get() * (1 << 30))
 
 
 @dataclasses.dataclass
@@ -74,7 +74,7 @@ class ResidencyPlanner:
         self.on_evict = on_evict
         self._entries: dict[str, _Entry] = {}
         self._clock = 0
-        self._lock = threading.RLock()
+        self._lock = tracked_lock("residency.planner", reentrant=True)
 
     # --- introspection ------------------------------------------------------
 

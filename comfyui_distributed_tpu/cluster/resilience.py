@@ -39,6 +39,7 @@ import time
 from typing import Any, Awaitable, Callable, Iterable, Optional
 
 from ..telemetry import enabled as _tm_enabled, metrics as _tm
+from ..lint.lockorder import tracked_lock
 from ..utils import constants
 from ..utils.logging import debug_log, log
 
@@ -185,7 +186,7 @@ class CircuitBreaker:
         self.recovery_s = (constants.BREAKER_RECOVERY_S
                            if recovery_s is None else recovery_s)
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = tracked_lock("resilience.breaker")
         self._state = CLOSED
         self._failures = 0
         self._opened_at = 0.0
@@ -237,20 +238,19 @@ class CircuitBreaker:
     def record_failure(self) -> None:
         with self._lock:
             if self._state == HALF_OPEN:
-                self._reopen()
+                self._reopen_locked()
                 return
             self._failures += 1
             if self._state == CLOSED and \
                     self._failures >= self.failure_threshold:
-                self._reopen()
+                self._reopen_locked()
 
     def trip(self) -> None:
         """Force open (eviction-grade evidence)."""
         with self._lock:
-            self._reopen()
+            self._reopen_locked()
 
-    def _reopen(self) -> None:
-        # call under self._lock
+    def _reopen_locked(self) -> None:
         self._state = OPEN
         self._opened_at = self._clock()
         self._trial_inflight = False
@@ -266,7 +266,7 @@ class BreakerRegistry:
     """
 
     def __init__(self, **breaker_kw):
-        self._lock = threading.Lock()
+        self._lock = tracked_lock("resilience.breakers")
         self._breakers: dict[str, CircuitBreaker] = {}
         self._breaker_kw = breaker_kw
 

@@ -29,11 +29,12 @@ from __future__ import annotations
 
 import dataclasses
 import json
-import os
 import threading
 from pathlib import Path
 from typing import Iterable, Optional
 
+from ..lint.lockorder import tracked_lock
+from ..utils import constants
 from ..utils.jsonio import atomic_write_json, read_json
 from ..utils.logging import debug_log, log
 
@@ -90,7 +91,7 @@ class ProgramKey:
 def default_catalog_path() -> Path:
     """Next to the XLA cache by default: the two artifacts are one unit —
     the catalog names the programs, the cache holds their binaries."""
-    env = os.environ.get("CDT_SHAPE_CATALOG")
+    env = constants.SHAPE_CATALOG.get()
     if env:
         return Path(env)
     from ..utils.compile_cache import cache_dir_default
@@ -109,7 +110,7 @@ class ShapeCatalog:
                  autoload: bool = True):
         self.path = Path(path) if path is not None else default_catalog_path()
         self._keys: set[ProgramKey] = set()
-        self._lock = threading.Lock()
+        self._lock = tracked_lock("shape_catalog")
         if autoload:
             self.load()
 
@@ -178,7 +179,7 @@ class ShapeCatalog:
         """Derive keys from the shipped workflow JSONs. Returns the number
         of NEW keys added."""
         if workflows_dir is None:
-            env = os.environ.get("CDT_WORKFLOWS_DIR")
+            env = constants.WORKFLOWS_DIR.get()
             workflows_dir = (Path(env) if env
                              else Path(__file__).resolve().parents[2]
                              / "workflows")
@@ -260,7 +261,7 @@ def _resolve_model_name(link, nodes: dict) -> Optional[str]:
 # --- runtime observation ----------------------------------------------------
 
 _default: "ShapeCatalog | None" = None
-_default_lock = threading.Lock()
+_default_lock = tracked_lock("shape_catalog.default")
 
 
 def default_catalog() -> ShapeCatalog:
@@ -287,7 +288,7 @@ def observe_cap() -> int:
     entry costs an AOT compile on every future worker boot, so an
     unbounded user-driven (or hostile) resolution sweep must not turn
     the warmup pass into the new cold start."""
-    return int(os.environ.get("CDT_SHAPE_CATALOG_MAX", "128") or 0)
+    return constants.SHAPE_CATALOG_MAX.get()
 
 
 def observe(pipeline: str, model: str, height: int, width: int,
@@ -297,7 +298,7 @@ def observe(pipeline: str, model: str, height: int, width: int,
     repeat shapes are a set lookup. Growth is capped
     (``CDT_SHAPE_CATALOG_MAX``, first-observed-wins). Never fatal, and
     a no-op under ``CDT_SHAPE_OBSERVE=0``."""
-    if os.environ.get("CDT_SHAPE_OBSERVE", "1") in ("0", "false"):
+    if not constants.SHAPE_OBSERVE.get():
         return
     try:
         cat = default_catalog()

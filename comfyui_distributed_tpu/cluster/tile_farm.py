@@ -209,10 +209,7 @@ class TileFarm:
         # production (the job still completes) but it starves fault-
         # injection tests that need the worker to HOLD an assignment
         # (tests/test_integration.py). Default 0 = no behavior change.
-        import os as _os
-
-        holdback_s = float(
-            _os.environ.get("CDT_TILE_MASTER_HOLDBACK_S", "0") or 0)
+        holdback_s = constants.TILE_MASTER_HOLDBACK_S.get()
         # 0.0 = disabled (falsy); the release check below also resets it
         holdback_until = time.monotonic() + holdback_s if holdback_s else 0.0
 
@@ -316,9 +313,7 @@ class TileFarm:
         compiles the worker races through — a 20 s budget lost that race
         on a 1-core host and the worker left with 0 tasks."""
         if ready_polls is None:
-            from ..utils.constants import env_int
-
-            ready_polls = env_int("CDT_TILE_READY_POLLS", 120)
+            ready_polls = constants.TILE_READY_POLLS.get()
         max_batch = constants.MAX_BATCH if max_batch is None else max_batch
         base = normalize_host_url(master_url)
         session = get_client_session()

@@ -63,6 +63,7 @@ from ..models.dit import (DiT, DiTConfig, DoubleBlock, MLPEmbedder,
                           Modulation, SingleBlock, _modulate, image_ids,
                           patchify, rope_freqs, sincos_2d, unpatchify)
 from ..models.layers import timestep_embedding
+from ..utils import constants
 
 _GLUE_KEYS = ("img_in", "txt_in", "time_in", "vector_in", "guidance_in",
               "final_mod", "img_out")
@@ -76,20 +77,17 @@ def offload_enabled(default: bool = False) -> bool:
     """One definition of the CDT_OFFLOAD gate. Server paths default OFF
     (resident execution); the accelerator flux bench defaults ON (full
     depth cannot run any other way on one chip)."""
-    v = os.environ.get("CDT_OFFLOAD", "")
-    if v == "":
-        return default
-    return v not in ("0", "false")
+    v = constants.OFFLOAD.get()
+    return default if v is None else v
 
 
 def resident_budget_bytes() -> int:
-    gb = float(os.environ.get("CDT_OFFLOAD_RESIDENT_GB", "13"))
-    return int(gb * (1 << 30))
+    return int(constants.OFFLOAD_RESIDENT_GB.get() * (1 << 30))
 
 
 def stream_dtype_default() -> str:
     """``float8_e4m3fn`` (default) or ``native``."""
-    return os.environ.get("CDT_OFFLOAD_STREAM_DTYPE", _F8)
+    return constants.OFFLOAD_STREAM_DTYPE.get()
 
 
 def ladder_mode() -> str:
@@ -103,10 +101,7 @@ def ladder_mode() -> str:
       ``/distributed/interrupt`` between steps, no per-step-count
       recompiles. Streamed (partially-resident) executors always run
       per step."""
-    v = os.environ.get("CDT_OFFLOAD_LADDER", "jit")
-    if v not in ("jit", "step"):
-        raise ValueError(f"CDT_OFFLOAD_LADDER={v!r} (use 'jit' or 'step')")
-    return v
+    return constants.OFFLOAD_LADDER.get()
 
 
 def normalize_stream_dtype(sd: Optional[str]) -> str:
@@ -291,7 +286,7 @@ def quant_cache_dir() -> Optional[str]:
     """``CDT_OFFLOAD_CACHE_DIR``: directory for cached quantized flat
     blocks. Quantizing a 12B model costs ~5 single-core minutes on every
     process start; the cache cuts a warm executor build to a disk read."""
-    return os.environ.get("CDT_OFFLOAD_CACHE_DIR") or None
+    return constants.OFFLOAD_CACHE_DIR.get() or None
 
 
 def _params_fingerprint(inner, names) -> str:

@@ -27,13 +27,13 @@ try to build FLUX-12B).
 from __future__ import annotations
 
 import dataclasses
-import os
 import threading
 import time
 from pathlib import Path
 from typing import Callable, Iterable, Optional
 
 from ..cluster.shape_catalog import ProgramKey, ShapeCatalog
+from ..utils import constants
 from ..utils.logging import debug_log, log
 
 COLD, WARMING, READY, ERROR = "cold", "warming", "ready", "error"
@@ -166,7 +166,7 @@ def run_warmup(registry, mesh, keys: Iterable[ProgramKey],
     from ..utils.compile_cache import active_cache_dir
 
     if models is None:
-        env = os.environ.get("CDT_WARMUP_MODELS", "")
+        env = constants.WARMUP_MODELS.get()
         models = [m.strip() for m in env.split(",") if m.strip()] or None
     if models is not None and set(models) & {"all", "*"}:
         allowed = None                      # explicit everything

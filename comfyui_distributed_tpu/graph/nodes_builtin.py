@@ -527,9 +527,11 @@ def _resolve_model_file(env_var: str, subdir: str, name: str):
     appears or changes."""
     import os
 
-    root = os.environ.get(env_var) or (
-        os.path.join(os.environ["CDT_CHECKPOINT_ROOT"], subdir)
-        if os.environ.get("CDT_CHECKPOINT_ROOT") else "")
+    from ..utils import constants
+
+    ckpt_root = constants.CHECKPOINT_ROOT.get()
+    root = constants.knob(env_var).get() or (
+        os.path.join(ckpt_root, subdir) if ckpt_root else "")
     if not root:
         return None, "", None
     fname = name if name.endswith(".safetensors") else f"{name}.safetensors"

@@ -1,0 +1,42 @@
+"""cdtlint: the repo-native static-analysis suite (ISSUE 12, docs/lint.md).
+
+Eight PRs of cluster growth accumulated load-bearing invariants — lock-guarded
+shared registries, bit-identity-critical modules, the ``CDT_*`` knob surface,
+traced-function purity, async hot paths — that were enforced only by
+convention and review. This package turns them into code:
+
+- ``python -m comfyui_distributed_tpu.lint`` runs the AST rules (L001, A001,
+  D001, K001, J001) over the package against a committed suppression baseline
+  (``lint/baseline.json``; the CI gate asserts the baseline only shrinks).
+- :mod:`.lockorder` is the companion RUNTIME piece: a dev-mode instrumented
+  lock wrapper (``CDT_LOCK_ORDER=1``) that records cross-registry lock
+  acquisition order and fails loudly on an inversion. The chaos suite runs a
+  stage under it, so every chaos event doubles as a race-detector run.
+
+Dependency-free by design (stdlib ``ast`` only): the linter must run in CI
+images, pre-commit hooks, and broken checkouts where jax cannot import.
+
+Imports here are LAZY (module ``__getattr__``): the serving path imports
+``lint.lockorder`` for :func:`tracked_lock`, and a future syntax error in the
+dev-only analysis engine must not brick a booting controller.
+"""
+
+_EXPORTS = {
+    "Finding": "core", "LintError": "core", "load_baseline": "core",
+    "run_lint": "core", "ALL_RULES": "rules", "rule_by_id": "rules",
+}
+
+__all__ = list(_EXPORTS) + ["lockorder"]
+
+
+def __getattr__(name):
+    if name in _EXPORTS:
+        import importlib
+
+        mod = importlib.import_module(f".{_EXPORTS[name]}", __name__)
+        return getattr(mod, name)
+    if name == "lockorder":
+        import importlib
+
+        return importlib.import_module(".lockorder", __name__)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,141 @@
+"""``python -m comfyui_distributed_tpu.lint`` — the cdtlint CLI.
+
+Exit codes: 0 = clean (all findings baselined, baseline fresh and
+justified), 1 = violations (new findings, stale baseline entries, or
+unjustified baseline entries), 2 = the linter itself failed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+
+from .core import (LintError, apply_baseline, default_baseline_path,
+                   load_baseline, run_lint, split_baseline_scope,
+                   write_baseline)
+from .rules import ALL_RULES, rule_by_id
+
+
+def package_root() -> Path:
+    return Path(__file__).resolve().parents[1]
+
+
+def repo_root() -> Path:
+    return Path(__file__).resolve().parents[2]
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m comfyui_distributed_tpu.lint",
+        description="repo-native static analysis (docs/lint.md)")
+    p.add_argument("paths", nargs="*", type=Path,
+                   help="files/dirs to lint (default: the package)")
+    p.add_argument("--rules", help="comma list of rule ids (default: all)")
+    p.add_argument("--list-rules", action="store_true")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--baseline", type=Path,
+                   help=f"baseline path (default: {default_baseline_path()})")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="report every finding, ignore the baseline")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="write current findings as the baseline "
+                        "(keeps existing justifications; new entries get "
+                        "a TODO placeholder the gate rejects until edited)")
+    p.add_argument("--write-knob-docs", action="store_true",
+                   help="regenerate docs/knobs.md from the knob registry")
+    p.add_argument("--show-baselined", action="store_true",
+                   help="also print grandfathered (baselined) findings")
+    args = p.parse_args(argv)
+
+    if args.list_rules:
+        for r in ALL_RULES:
+            print(f"{r.id}  {r.title}")
+        return 0
+
+    if args.write_knob_docs:
+        from .knobdocs import write
+
+        out = repo_root() / "docs" / "knobs.md"
+        write(out)
+        print(f"wrote {out}")
+        return 0
+
+    rules = ALL_RULES
+    if args.rules:
+        try:
+            rules = [rule_by_id(r.strip())
+                     for r in args.rules.split(",") if r.strip()]
+        except KeyError as exc:
+            print(f"unknown rule {exc}", file=sys.stderr)
+            return 2
+
+    paths = args.paths or [package_root()]
+    linted_rels: list = []
+    try:
+        findings = run_lint(paths, rules, repo_root(),
+                            collect_rels=linted_rels)
+    except LintError as exc:
+        print(f"cdtlint error: {exc}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        path = args.baseline or default_baseline_path()
+        try:
+            old = load_baseline(path)
+        except LintError:
+            old = {}
+        # scoped runs must not drop other rules'/paths' grandfathers
+        _, out_of_scope = split_baseline_scope(old, rules, linted_rels,
+                                               findings)
+        write_baseline(findings, path, justifications=old,
+                       preserve=out_of_scope)
+        print(f"wrote {len(findings)} entries to {path} "
+              f"({len(out_of_scope)} out-of-scope entries preserved)")
+        return 0
+
+    if args.no_baseline:
+        gate = apply_baseline(findings, {})
+    else:
+        try:
+            baseline = load_baseline(args.baseline)
+        except LintError as exc:
+            print(f"cdtlint error: {exc}", file=sys.stderr)
+            return 2
+        # only entries within this run's rule/path scope can go stale —
+        # a scoped run must not flag the rest of the baseline
+        in_scope, _ = split_baseline_scope(baseline, rules, linted_rels,
+                                           findings)
+        gate = apply_baseline(findings, in_scope)
+
+    if args.format == "json":
+        print(json.dumps({
+            "new": [vars(f) for f in gate.new],
+            "stale_baseline": gate.stale,
+            "unjustified_baseline": gate.unjustified,
+            "baselined": [vars(f) for f in gate.baselined],
+            "ok": gate.ok,
+        }, indent=2))
+        return 0 if gate.ok else 1
+
+    for f in gate.new:
+        print(f.render())
+    for s in gate.stale:
+        print(f"STALE baseline entry (site no longer exists — remove it, "
+              f"the baseline only shrinks): {s}")
+    for s in gate.unjustified:
+        print(f"UNJUSTIFIED baseline entry (add a one-line reason): {s}")
+    if args.show_baselined:
+        for f in gate.baselined:
+            print(f"[baselined] {f.render()}")
+    n_rules = ",".join(r.id for r in rules)
+    print(f"cdtlint [{n_rules}]: {len(gate.new)} new, "
+          f"{len(gate.baselined)} baselined, {len(gate.stale)} stale, "
+          f"{len(gate.unjustified)} unjustified"
+          + (" — OK" if gate.ok else " — FAIL"))
+    return 0 if gate.ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

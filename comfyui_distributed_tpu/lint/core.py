@@ -1,0 +1,239 @@
+"""cdtlint engine: findings, module contexts, suppressions, baseline.
+
+The engine is deliberately small: rules get a parsed module
+(:class:`ModuleCtx`) and yield :class:`Finding`\\ s; the engine handles file
+walking, ``# cdtlint: disable=RULE`` suppressions, and the committed
+baseline (grandfathered sites with one-line justifications; the gate fails
+when a finding is not baselined AND when a baseline entry goes stale, so
+the baseline can only shrink).
+
+Site ids are line-number-free on purpose (``rule:path:qualname:token[#n]``):
+a refactor that moves code without changing it must not churn the baseline.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import re
+from pathlib import Path
+from typing import Iterable, Iterator, Optional
+
+SUPPRESS_RE = re.compile(
+    r"#\s*cdtlint:\s*disable=([A-Z]\d{3}(?:\s*,\s*[A-Z]\d{3})*)")
+
+SKIP_DIRS = {"__pycache__", ".git", "web", "native"}
+
+
+class LintError(Exception):
+    """The linter itself failed (unreadable file, bad baseline, ...)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str            # repo-relative, forward slashes
+    line: int
+    message: str
+    site: str            # stable id: rule:path:qualname:token[#n]
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+
+class ModuleCtx:
+    """One parsed module handed to every rule."""
+
+    def __init__(self, path: Path, rel: str, source: str):
+        self.path = path
+        self.rel = rel
+        self.source = source
+        self.lines = source.splitlines()
+        try:
+            self.tree = ast.parse(source, filename=str(path))
+        except SyntaxError as exc:
+            raise LintError(f"{rel}: cannot parse: {exc}") from exc
+        self._site_counts: dict[str, int] = {}
+        # module-level `NAME = "literal"` string constants, for resolving
+        # e.g. os.environ.get(AUTH_ENV) where AUTH_ENV = "CDT_AUTH_TOKEN"
+        self.str_consts: dict[str, str] = {}
+        for node in self.tree.body:
+            if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and isinstance(node.value, ast.Constant)
+                    and isinstance(node.value.value, str)):
+                self.str_consts[node.targets[0].id] = node.value.value
+
+    def suppressed(self, line: int, rule: str) -> bool:
+        """``# cdtlint: disable=RULE`` on the finding's line suppresses
+        it; a comment-ONLY line directly above does too (for statements
+        whose line is already full). A trailing comment on the previous
+        statement deliberately does not reach past its own line."""
+        def match(text: str) -> bool:
+            m = SUPPRESS_RE.search(text)
+            return bool(m and rule in re.split(r"\s*,\s*", m.group(1)))
+
+        if 1 <= line <= len(self.lines) and match(self.lines[line - 1]):
+            return True
+        above = line - 1
+        if 1 <= above <= len(self.lines):
+            text = self.lines[above - 1]
+            if text.lstrip().startswith("#") and match(text):
+                return True
+        return False
+
+    def finding(self, rule: str, node: ast.AST, qualname: str,
+                token: str, message: str) -> Finding:
+        """Build a Finding with a stable, de-duplicated site id."""
+        base = f"{rule}:{self.rel}:{qualname}:{token}"
+        n = self._site_counts.get(base, 0)
+        self._site_counts[base] = n + 1
+        site = base if n == 0 else f"{base}#{n + 1}"
+        return Finding(rule=rule, path=self.rel,
+                       line=getattr(node, "lineno", 1),
+                       message=message, site=site)
+
+
+def iter_py_files(root: Path) -> Iterator[Path]:
+    if root.is_file():
+        yield root
+        return
+    for p in sorted(root.rglob("*.py")):
+        if not any(part in SKIP_DIRS for part in p.parts):
+            yield p
+
+
+def build_contexts(paths: Iterable[Path], repo_root: Path) -> list[ModuleCtx]:
+    ctxs = []
+    for root in paths:
+        for f in iter_py_files(root):
+            try:
+                source = f.read_text(encoding="utf-8")
+            except OSError as exc:
+                raise LintError(f"cannot read {f}: {exc}") from exc
+            try:
+                rel = f.resolve().relative_to(repo_root.resolve()).as_posix()
+            except ValueError:
+                rel = f.as_posix()
+            ctxs.append(ModuleCtx(f, rel, source))
+    return ctxs
+
+
+def run_lint(paths: Iterable[Path], rules, repo_root: Path,
+             collect_rels: Optional[list] = None) -> list[Finding]:
+    """Run every rule over every module (plus project-level ``finalize``
+    hooks), dropping comment-suppressed findings. ``collect_rels``
+    (out-param) receives the repo-relative paths actually linted, so the
+    CLI can scope the baseline gate to this run."""
+    ctxs = build_contexts(paths, repo_root)
+    if collect_rels is not None:
+        collect_rels.extend(c.rel for c in ctxs)
+    findings: list[Finding] = []
+    for rule in rules:
+        for ctx in ctxs:
+            for f in rule.check_module(ctx):
+                if not ctx.suppressed(f.line, f.rule):
+                    findings.append(f)
+        finalize = getattr(rule, "finalize", None)
+        if finalize is not None:
+            findings.extend(finalize(ctxs, repo_root))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.site))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# baseline
+
+
+def default_baseline_path() -> Path:
+    return Path(__file__).resolve().parent / "baseline.json"
+
+
+def load_baseline(path: Optional[Path] = None) -> dict[str, str]:
+    """{site -> justification}. A missing file is an empty baseline."""
+    p = path or default_baseline_path()
+    if not p.is_file():
+        return {}
+    try:
+        data = json.loads(p.read_text(encoding="utf-8"))
+    except (OSError, json.JSONDecodeError) as exc:
+        raise LintError(f"cannot read baseline {p}: {exc}") from exc
+    entries = data.get("entries", [])
+    out: dict[str, str] = {}
+    for e in entries:
+        site = e.get("site", "")
+        if not site:
+            raise LintError(f"baseline {p}: entry without a site: {e!r}")
+        if site in out:
+            raise LintError(f"baseline {p}: duplicate site {site}")
+        out[site] = e.get("justification", "")
+    return out
+
+
+def write_baseline(findings: list[Finding], path: Path,
+                   justifications: Optional[dict[str, str]] = None,
+                   preserve: Optional[dict[str, str]] = None) -> None:
+    """``preserve`` carries {site: justification} entries OUTSIDE the
+    current run's scope (other rules/paths) — a scoped ``--write-baseline``
+    must never silently drop another rule's grandfathers."""
+    just = justifications or {}
+    entries = [{"site": f.site,
+                "justification": just.get(f.site, "TODO: justify"),
+                "message": f.message}
+               for f in findings]
+    seen = {f.site for f in findings}
+    for site, j in sorted((preserve or {}).items()):
+        if site not in seen:
+            entries.append({"site": site, "justification": j})
+    path.write_text(
+        json.dumps({"entries": entries}, indent=2, sort_keys=False) + "\n",
+        encoding="utf-8")
+
+
+def split_baseline_scope(baseline: dict[str, str], rules,
+                         linted_rels: Iterable[str],
+                         findings: Iterable[Finding],
+                         ) -> tuple[dict[str, str], dict[str, str]]:
+    """(in_scope, out_of_scope): an entry is in scope when its rule was
+    active AND its path was linted (or it matched a current finding).
+    Out-of-scope entries are neither reported stale nor dropped by a
+    scoped ``--write-baseline``. Project-level sites (non-``.py`` paths,
+    e.g. the K001 docs sync) are in scope only on a full run — detected
+    by the registry module being among the linted paths."""
+    rule_ids = {r.id for r in rules}
+    rels = set(linted_rels) | {f.path for f in findings}
+    full_run = any(r.endswith("utils/constants.py") for r in rels)
+    in_scope: dict[str, str] = {}
+    out_scope: dict[str, str] = {}
+    for site, just in baseline.items():
+        rule, _, rest = site.partition(":")
+        path = rest.split(":", 1)[0]
+        covered = path in rels or (not path.endswith(".py") and full_run)
+        (in_scope if rule in rule_ids and covered else out_scope)[site] = just
+    return in_scope, out_scope
+
+
+@dataclasses.dataclass
+class GateResult:
+    new: list[Finding]           # findings not in the baseline -> FAIL
+    stale: list[str]             # baseline sites with no finding -> FAIL
+    unjustified: list[str]       # baselined without a justification -> FAIL
+    baselined: list[Finding]     # grandfathered findings (reported, pass)
+
+    @property
+    def ok(self) -> bool:
+        return not (self.new or self.stale or self.unjustified)
+
+
+def apply_baseline(findings: list[Finding],
+                   baseline: dict[str, str]) -> GateResult:
+    by_site = {f.site: f for f in findings}
+    new = [f for f in findings if f.site not in baseline]
+    stale = [s for s in baseline if s not in by_site]
+    unjustified = [s for s, j in baseline.items()
+                   if s in by_site
+                   and (not j.strip() or j.strip().startswith("TODO"))]
+    baselined = [f for f in findings if f.site in baseline]
+    return GateResult(new=new, stale=stale, unjustified=unjustified,
+                      baselined=baselined)

@@ -1,0 +1,78 @@
+"""docs/knobs.md generator: the knob registry rendered as markdown.
+
+The doc is GENERATED — never hand-edit it. Rule K001's project check
+asserts the committed file equals :func:`render_markdown`'s output, so the
+knob surface can never silently drift from its docs again (the rendered
+footer carries the live count).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+HEADER = """\
+# CDT_* knob reference
+
+> **Generated file — do not edit.** This page is rendered from the typed
+> knob registry in `comfyui_distributed_tpu/utils/constants.py` by
+> `python -m comfyui_distributed_tpu.lint --write-knob-docs`, and lint
+> rule **K001** (docs/lint.md) fails tier-1 when it goes stale.
+
+Every `CDT_*` environment knob is declared once in the registry with a
+type, default, and owning subsystem; call sites read through it
+(`constants.<KNOB>.get()`), parse once per value, and raise a descriptive
+`KnobError` on garbage unless the knob explicitly opts into
+warn-and-default (marked *fallback* below).
+"""
+
+
+def _fmt_default(knob) -> str:
+    if knob.default is None:
+        return "*(unset)*"
+    if knob.default == "":
+        return '`""`'
+    return f"`{knob.default!r}`" if isinstance(knob.default, str) \
+        else f"`{knob.default}`"
+
+
+def _fmt_kind(knob) -> str:
+    kind = knob.kind
+    if kind == "enum":
+        kind = "enum(" + ", ".join(f"`{c}`" if c else '`""`'
+                                   for c in knob.choices) + ")"
+    if knob.on_garbage == "default":
+        kind += " *(fallback)*"
+    return kind
+
+
+def render_markdown() -> str:
+    from ..utils.constants import KNOBS
+
+    by_subsystem: dict[str, list] = {}
+    for k in KNOBS.all():
+        by_subsystem.setdefault(k.subsystem, []).append(k)
+
+    out = [HEADER]
+    for subsystem in sorted(by_subsystem):
+        knobs = by_subsystem[subsystem]
+        docs = sorted({k.doc for k in knobs if k.doc})
+        title = f"## {subsystem}"
+        if docs:
+            title += " — " + ", ".join(
+                f"[{Path(d).name}](../{d})" if not d.startswith("docs/")
+                else f"[{d[5:]}]({d[5:]})" for d in docs)
+        out.append(title + "\n")
+        out.append("| knob | type | default | description |")
+        out.append("| --- | --- | --- | --- |")
+        for k in knobs:
+            help_text = " ".join(k.help.split())
+            out.append(f"| `{k.name}` | {_fmt_kind(k)} | "
+                       f"{_fmt_default(k)} | {help_text} |")
+        out.append("")
+    out.append(f"*{len(KNOBS.names())} knobs declared.*")
+    return "\n".join(out) + "\n"
+
+
+def write(path: Path) -> None:
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(render_markdown(), encoding="utf-8")

@@ -1,0 +1,194 @@
+"""Runtime lock-order detector (``CDT_LOCK_ORDER=1``, docs/lint.md).
+
+The static rule L001 proves each registry guards its own state; it cannot
+see CROSS-registry ordering — thread A taking BREAKERS then DRAIN while
+thread B takes DRAIN then BREAKERS is invisible to any per-class check and
+presents in production as an opaque 870 s hang. This module is the runtime
+companion: the shared registries create their locks through
+:func:`tracked_lock`, and when the ``CDT_LOCK_ORDER`` knob is on, every
+acquisition records the (held -> acquired) edge in a process-global order
+graph. Observing both ``A -> B`` and ``B -> A`` is an inversion — a
+potential deadlock — and fails LOUDLY (:class:`LockOrderError`) at the
+moment the second ordering is attempted, with both stacks in the message,
+instead of deadlocking silently some run later.
+
+The knob is latched at process start, so the disabled path costs one
+module-global boolean read per acquire and the wrappers stay on in
+production builds; the chaos suite runs a stage with
+the detector armed, making every chaos event double as a race-detector run.
+
+Known approximation: locks are tracked by ROLE name, not instance — two
+sibling instances of one registry class share a name, so same-name
+re-acquisition is treated as reentrancy rather than an ordering edge. For
+the process-global singletons this module exists for (BREAKERS, DRAIN, the
+default tables) the detection is exact.
+"""
+
+from __future__ import annotations
+
+import threading
+import traceback
+from typing import Optional
+
+from ..utils.constants import LOCK_ORDER
+
+
+class LockOrderError(RuntimeError):
+    """Two locks were taken in both orders — a potential deadlock."""
+
+
+_tls = threading.local()
+
+# process-global order graph, guarded by its own (untracked) meta-lock:
+# (held, acquired) -> formatted stack of the first observation
+_graph_lock = threading.Lock()
+_edges: dict[tuple[str, str], str] = {}
+_inversions: list[dict] = []
+_forced: Optional[bool] = None          # test hook: overrides the latch
+# The knob is latched ONCE at import: the chaos suite arms the detector
+# via env before process start, and tests use force_enabled(). A per-
+# acquire env lookup would tax every telemetry-counter increment and
+# breaker check — the disabled path must stay one module-global read.
+_latched: bool = bool(LOCK_ORDER.get())
+
+
+def enabled() -> bool:
+    if _forced is not None:
+        return _forced
+    return _latched
+
+
+def force_enabled(on: Optional[bool]) -> None:
+    """Test hook: True/False overrides the import-time latch; None
+    restores it (re-reading ``CDT_LOCK_ORDER`` in case the env changed)."""
+    global _forced, _latched
+    _forced = on
+    if on is None:
+        _latched = bool(LOCK_ORDER.get())
+
+
+def reset() -> None:
+    """Drop the recorded graph (test isolation)."""
+    with _graph_lock:
+        _edges.clear()
+        _inversions.clear()
+
+
+def snapshot() -> dict:
+    """{'edges': [[held, acquired], ...], 'inversions': [...]} — what the
+    chaos suite asserts on."""
+    with _graph_lock:
+        return {"edges": sorted(_edges),
+                "inversions": list(_inversions)}
+
+
+def assert_clean() -> None:
+    with _graph_lock:
+        if _inversions:
+            pairs = [(i["first"], i["second"]) for i in _inversions]
+            raise LockOrderError(
+                f"{len(_inversions)} lock-order inversion(s) recorded: "
+                f"{pairs}")
+
+
+def _held_stack() -> list:
+    stack = getattr(_tls, "held", None)
+    if stack is None:
+        stack = _tls.held = []
+    return stack
+
+
+def _record_acquire(name: str) -> None:
+    held = _held_stack()
+    if name in held:            # reentrant (or same-role sibling): no edge
+        held.append(name)
+        return
+    here = "".join(traceback.format_stack(limit=8)[:-2])
+    with _graph_lock:
+        for h in held:
+            edge = (h, name)
+            rev = (name, h)
+            if rev in _edges and edge not in _edges:
+                inv = {"first": f"{name} -> {h}", "second": f"{h} -> {name}",
+                       "first_stack": _edges[rev], "second_stack": here}
+                _inversions.append(inv)
+                # deliberately NOT appended to `held`: the caller releases
+                # the raw lock and re-raises, so this thread never holds
+                # it — a stale entry would fabricate edges forever after
+                raise LockOrderError(
+                    f"lock-order inversion: this thread holds '{h}' and is "
+                    f"acquiring '{name}', but the order '{name}' -> '{h}' "
+                    f"was already observed — potential deadlock.\n"
+                    f"--- first ordering ({name} then {h}):\n"
+                    f"{_edges[rev]}"
+                    f"--- this ordering ({h} then {name}):\n{here}")
+            _edges.setdefault(edge, here)
+    held.append(name)
+
+
+def _record_release(name: str) -> None:
+    held = _held_stack()
+    # release the most recent matching hold (locks release LIFO in the
+    # with-statement idiom this repo uses everywhere)
+    for i in range(len(held) - 1, -1, -1):
+        if held[i] == name:
+            del held[i]
+            return
+
+
+class TrackedLock:
+    """Drop-in ``threading.Lock``/``RLock`` wrapper with a role name.
+
+    Tracking is checked per-acquire against the import-time latch (one
+    module-global boolean read when off), so arming the detector is an
+    env var at process start — no code changes.
+    """
+
+    __slots__ = ("name", "_lock")
+
+    def __init__(self, name: str, reentrant: bool = False):
+        self.name = name
+        self._lock = threading.RLock() if reentrant else threading.Lock()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        got = self._lock.acquire(blocking, timeout)
+        if got and enabled():
+            try:
+                _record_acquire(self.name)
+            except LockOrderError:
+                self._lock.release()
+                raise
+        return got
+
+    def release(self) -> None:
+        # pop bookkeeping whenever this thread has tracked holds, even if
+        # the knob flipped off mid-critical-section — a stale held entry
+        # would fabricate edges forever after
+        if getattr(_tls, "held", None):
+            _record_release(self.name)
+        self._lock.release()
+
+    def __enter__(self) -> "TrackedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        return self._lock.locked() if hasattr(self._lock, "locked") \
+            else False
+
+    def __repr__(self) -> str:                        # pragma: no cover
+        return f"TrackedLock({self.name!r})"
+
+
+def tracked_lock(name: str, reentrant: bool = False) -> TrackedLock:
+    """Factory the shared registries use in place of ``threading.Lock()``.
+
+    Always returns a :class:`TrackedLock`; the disabled-path overhead is
+    one module-global boolean read per acquire. ``CDT_LOCK_ORDER`` is
+    latched at import (set it before process start, as the chaos suite
+    does); in-process tests toggle via :func:`force_enabled`.
+    """
+    return TrackedLock(name, reentrant=reentrant)

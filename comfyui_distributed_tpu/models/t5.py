@@ -237,9 +237,9 @@ def load_t5_tokenizer(tok_dir=None):
     ``CDT_T5_TOKENIZER_DIR`` (the ``spiece.model``/``tokenizer.json`` every
     T5 distribution ships). Returns None when unavailable — callers fall
     back to hash tokens exactly like the CLIP path."""
-    import os
+    from ..utils import constants
 
-    tok_dir = tok_dir or os.environ.get("CDT_T5_TOKENIZER_DIR")
+    tok_dir = tok_dir or constants.T5_TOKENIZER_DIR.get()
     if not tok_dir:
         return None
     try:

@@ -25,7 +25,6 @@ from __future__ import annotations
 
 import functools
 import json
-import os
 from pathlib import Path
 from typing import Optional, Sequence
 
@@ -93,7 +92,9 @@ class CLIPBPETokenizer:
 
     @classmethod
     def from_env(cls, subdir: str = "", **kw) -> Optional["CLIPBPETokenizer"]:
-        root = os.environ.get("CDT_TOKENIZER_DIR")
+        from ..utils import constants
+
+        root = constants.TOKENIZER_DIR.get()
         if not root:
             return None
         path = Path(root) / subdir if subdir else Path(root)

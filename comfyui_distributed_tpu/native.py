@@ -57,8 +57,10 @@ _VERSION = 1
 # decompression ceiling: frames claiming a larger raw size are rejected
 # before any allocation (the wire size itself is already capped per-route
 # by MAX_PAYLOAD_SIZE — this bounds the zlib expansion of what got past)
-MAX_FRAME_RAW_BYTES = int(os.environ.get("CDT_MAX_FRAME_RAW_BYTES",
-                                         str(1 << 30)))
+from .utils.constants import MAX_FRAME_RAW_BYTES as _MAX_FRAME_RAW_KNOB
+from .utils.constants import NO_NATIVE as _NO_NATIVE_KNOB
+
+MAX_FRAME_RAW_BYTES = _MAX_FRAME_RAW_KNOB.get()
 
 _lib: Optional[ctypes.CDLL] = None
 _lib_lock = threading.Lock()
@@ -86,7 +88,7 @@ def _load() -> Optional[ctypes.CDLL]:
         if _lib is not None or _load_attempted:
             return _lib
         _load_attempted = True
-        if os.environ.get("CDT_NO_NATIVE", "").lower() in ("1", "true"):
+        if _NO_NATIVE_KNOB.get():
             return None
         so = _NATIVE_DIR / _LIB_NAME
         if not so.is_file() and _NATIVE_DIR.is_dir():

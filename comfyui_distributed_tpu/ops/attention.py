@@ -52,9 +52,7 @@ def _flash_min_seq() -> int:
     fails the packed floors (the short-K / short-q fall-through below);
     flash-bh's win is memory at long N (ring/SP sequences, video token
     counts)."""
-    from ..utils.constants import env_int
-
-    return env_int("CDT_FLASH_MIN_SEQ", 8192)
+    return constants.FLASH_MIN_SEQ.get()
 
 
 def _flash_enabled(q_len: Optional[int] = None,
@@ -66,13 +64,9 @@ def _flash_enabled(q_len: Optional[int] = None,
     packed-heads layout that is q ≥ 1024 with non-tiny K; for the
     classic transposed layout q ≥ 8192 (both measured r04, overridable
     via ``CDT_FLASH_MIN_SEQ[_PACKED]`` / ``CDT_FLASH_MIN_KV_PACKED``)."""
-    import os
-
-    flag = os.environ.get("CDT_FLASH_ATTENTION", "").lower()
-    if flag in ("1", "true", "on"):
-        return True
-    if flag in ("0", "false", "off"):
-        return False
+    flag = constants.FLASH_ATTENTION.get()
+    if flag is not None:
+        return flag
     try:
         on_tpu = jax.devices()[0].platform == "tpu"
     except RuntimeError:
@@ -163,19 +157,17 @@ def select_kernel(q_len: int, kv_len: int, num_heads: int, head_dim: int,
     table: a table entry saying ``xla`` is ignored there, because the
     sweep optimized for time while the caller needs the streamed
     softmax to fit HBM at all."""
-    import os
-
     from .autotune import KernelChoice, GeometryKey, lookup
 
     geometry = GeometryKey.from_shape(num_heads, head_dim, q_len, kv_len,
                                       dtype).key_str()
-    flag = os.environ.get("CDT_FLASH_ATTENTION", "").lower()
-    if flag in ("0", "false", "off"):
+    flag = constants.FLASH_ATTENTION.get()
+    if flag is False:
         choice = KernelChoice("xla", source="env",
                               reason="CDT_FLASH_ATTENTION=0")
         _note_selection(geometry, choice)
         return choice
-    forced = flag in ("1", "true", "on")
+    forced = flag is True
     try:
         on_tpu = jax.devices()[0].platform == "tpu"
     except RuntimeError:
@@ -285,9 +277,7 @@ def _ring_block() -> int:
     already streaming-softmax, so the identity is exact (floating-point
     round-off differs at the usual flash-blocking level). 0 disables
     sub-blocking (whole hop at once, the pre-r04 behavior)."""
-    from ..utils.constants import env_int
-
-    return env_int("CDT_RING_BLOCK", 1024)
+    return constants.RING_BLOCK.get()
 
 
 def _hop_attend(qf, k_cur, v_cur, m, l, acc, scale):

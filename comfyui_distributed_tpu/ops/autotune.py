@@ -50,6 +50,8 @@ import time
 from pathlib import Path
 from typing import Callable, Iterable, Optional
 
+from ..lint.lockorder import tracked_lock
+from ..utils import constants
 from ..utils.jsonio import atomic_write_json, read_json
 from ..utils.logging import debug_log, log
 
@@ -207,7 +209,7 @@ def validate_entry(key: GeometryKey, choice: KernelChoice) -> list[str]:
 
 
 def table_path() -> Path:
-    env = os.environ.get("CDT_ATTN_TABLE")
+    env = constants.ATTN_TABLE.get()
     if env:
         return Path(env)
     from ..utils.compile_cache import cache_dir_default
@@ -228,7 +230,7 @@ class TuningTable:
     def __init__(self, path: "Path | str | None" = None,
                  shipped: bool = True, autoload: bool = True):
         self.path = Path(path) if path is not None else table_path()
-        self._lock = threading.Lock()
+        self._lock = tracked_lock("autotune.table")
         self._shipped: dict[GeometryKey, KernelChoice] = {}
         self._local: dict[GeometryKey, KernelChoice] = {}
         if autoload:
@@ -316,11 +318,11 @@ class TuningTable:
 # --- process-global default table -------------------------------------------
 
 _default: "TuningTable | None" = None
-_default_lock = threading.Lock()
+_default_lock = tracked_lock("autotune.default")
 
 
 def tuning_enabled() -> bool:
-    return os.environ.get("CDT_ATTN_TUNE", "1") not in ("0", "false", "off")
+    return constants.ATTN_TUNE.get()
 
 
 def default_table() -> TuningTable:

@@ -28,13 +28,14 @@ masked with a static-length comparison — shapes stay static for XLA.
 from __future__ import annotations
 
 import functools
-import os
 from typing import Optional
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+from ..utils import constants as _constants
 
 # lane width: scratch vectors m/l are stored lane-replicated (BQ, 128)
 _LANES = 128
@@ -51,7 +52,7 @@ def _parse_block_env(name: str, multiple: int) -> Optional[int]:
     block size must be a positive multiple of the hardware tile for its
     axis (``block_q``: 8 sublanes, ``block_k``: 128 lanes). Unset/empty
     returns None (caller applies the default)."""
-    raw = os.environ.get(name)
+    raw = _constants.knob(name).raw()
     if raw is None or raw.strip() == "":
         return None
     try:
@@ -583,9 +584,7 @@ def _flash_min_seq_packed() -> int:
     """Engagement floor for the packed-heads layout: measured r04 it
     beats XLA already at SDXL self-attention lengths (docs/roofline.md
     finding 1a) but not below ~1024 tokens."""
-    from ..utils.constants import env_int
-
-    return env_int("CDT_FLASH_MIN_SEQ_PACKED", 1024)
+    return _constants.FLASH_MIN_SEQ_PACKED.get()
 
 
 def _flash_min_kv_packed() -> int:
@@ -594,9 +593,7 @@ def _flash_min_kv_packed() -> int:
     of its K tile and measures behind XLA (1.20 vs 1.04 ms/64-op chain,
     r04) — those sites stay on XLA's fused lowering / the classic bh
     call."""
-    from ..utils.constants import env_int
-
-    return env_int("CDT_FLASH_MIN_KV_PACKED", 256)
+    return _constants.FLASH_MIN_KV_PACKED.get()
 
 
 def _packed_legal(H: int, D: int) -> bool:
@@ -625,9 +622,7 @@ def _layout_packed(H: int, D: int,
     per-call layout override is ``flash_attention(..., layout=...)``.
     Without ``Nq``/``Nk`` (the shape-gate site, which applies its own
     thresholds) only legality and the env override are checked."""
-    import os
-
-    env = os.environ.get("CDT_FLASH_LAYOUT", "").lower()
+    env = _constants.FLASH_LAYOUT.get()
     if env == "bh":
         return False
     if not _packed_legal(H, D):

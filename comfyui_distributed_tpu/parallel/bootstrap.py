@@ -24,7 +24,6 @@ Env-var equivalents (for k8s/pod launchers that template manifests):
 
 from __future__ import annotations
 
-import os
 from typing import Callable, Optional
 
 from ..utils.logging import log
@@ -34,12 +33,12 @@ _initialized = False
 
 def multihost_env() -> dict:
     """The multi-host settings resolved from env (CLI flags override)."""
+    from ..utils import constants
+
     return {
-        "coordinator_address": os.environ.get("CDT_COORDINATOR") or None,
-        "num_processes": int(os.environ["CDT_NUM_HOSTS"])
-        if os.environ.get("CDT_NUM_HOSTS") else None,
-        "process_id": int(os.environ["CDT_HOST_INDEX"])
-        if os.environ.get("CDT_HOST_INDEX") else None,
+        "coordinator_address": constants.COORDINATOR.get() or None,
+        "num_processes": constants.NUM_HOSTS.get(),
+        "process_id": constants.HOST_INDEX.get(),
     }
 
 

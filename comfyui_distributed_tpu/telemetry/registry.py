@@ -23,11 +23,13 @@ never leak memory without bound.
 from __future__ import annotations
 
 import bisect
-import os
 import threading
 from typing import Optional, Sequence
 
-_enabled = os.environ.get("CDT_TELEMETRY", "1") not in ("", "0", "false")
+from ..lint.lockorder import tracked_lock
+from ..utils.constants import TELEMETRY
+
+_enabled = TELEMETRY.get()
 
 
 def enabled() -> bool:
@@ -139,7 +141,7 @@ class _Metric:
         self.name = name
         self.help = help
         self.labelnames = tuple(labelnames)
-        self._lock = threading.Lock()
+        self._lock = tracked_lock("telemetry.family")
         self._children: dict[tuple, object] = {}
         self._dropped = 0
         if not self.labelnames:
@@ -240,7 +242,7 @@ class MetricRegistry:
     a programming error and raises)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = tracked_lock("telemetry.registry")
         self._metrics: dict[str, _Metric] = {}
 
     def _get_or_create(self, cls, name, help, labelnames, **kw):

@@ -316,9 +316,9 @@ class TileUpscaler:
         with tile area, so the default halves as tiles grow past 512².
         ``CDT_TILES_PER_DEVICE`` overrides.
         """
-        from ..utils.constants import env_int
+        from ..utils.constants import TILES_PER_DEVICE
 
-        env = env_int("CDT_TILES_PER_DEVICE", 0)
+        env = TILES_PER_DEVICE.get()
         if env > 0:
             return env
         try:

@@ -18,17 +18,18 @@ exists, printing it once, so the public URL is never born unprotected.
 from __future__ import annotations
 
 import hmac
-import os
 import secrets
 from typing import Any, Optional
 
 AUTH_HEADER = "X-CDT-Auth"
-AUTH_ENV = "CDT_AUTH_TOKEN"
+AUTH_ENV = "CDT_AUTH_TOKEN"      # knob: constants.AUTH_TOKEN
 
 def configured_token(cfg: Optional[dict[str, Any]] = None) -> Optional[str]:
     """The cluster token, if any: the env var wins over the config so an
     operator can rotate without editing files."""
-    env = os.environ.get(AUTH_ENV)
+    from .constants import AUTH_TOKEN
+
+    env = AUTH_TOKEN.get()
     if env:
         return env
     if cfg:
@@ -42,7 +43,9 @@ def resolve_token(config_path=None) -> Optional[str]:
     """Hot-path token lookup: env var, else a no-deepcopy config peek
     (``config.peek_setting`` — one stat when the mtime cache is warm).
     Used by the per-request auth middleware and the outbound session."""
-    env = os.environ.get(AUTH_ENV)
+    from .constants import AUTH_TOKEN
+
+    env = AUTH_TOKEN.get()
     if env:
         return env
     from .config import peek_setting

@@ -37,7 +37,9 @@ def cache_dir_default() -> str:
     """The directory ``enable_compile_cache()`` would resolve to (env or
     default), WITHOUT enabling anything — the shape catalog persists
     next to it even when caching is off."""
-    return os.environ.get("CDT_COMPILE_CACHE_DIR", _DEFAULT) or _DEFAULT
+    from .constants import COMPILE_CACHE_DIR
+
+    return COMPILE_CACHE_DIR.get() or _DEFAULT
 
 
 def active_cache_dir() -> Optional[str]:
@@ -59,8 +61,10 @@ def enable_compile_cache(path: Optional[str] = None,
     (1.0 s) skips trivial programs; bench and warmup pass 0.0 so every
     program a retry might need lands on disk.
     """
-    d = path if path is not None else os.environ.get(
-        "CDT_COMPILE_CACHE_DIR", _DEFAULT)
+    from .constants import COMPILE_CACHE_DIR
+
+    env = COMPILE_CACHE_DIR.get()
+    d = path if path is not None else (_DEFAULT if env is None else env)
     if not d:
         _set_state(None, "disabled (CDT_COMPILE_CACHE_DIR='')")
         return None

@@ -71,7 +71,9 @@ _HOST_DEFAULTS: dict[str, Any] = {
 
 
 def config_path() -> Path:
-    override = os.environ.get(CONFIG_ENV)
+    from .constants import CONFIG_PATH
+
+    override = CONFIG_PATH.get()
     if override:
         return Path(override)
     return Path(__file__).resolve().parent.parent / _DEFAULT_NAME

@@ -9,7 +9,6 @@ config-gated ``debug_log`` whose gate is cached with a short TTL) and
 from __future__ import annotations
 
 import collections
-import os
 import secrets
 import sys
 import time
@@ -51,7 +50,9 @@ def _debug_enabled() -> bool:
     # the env var is ALWAYS honored; an installed source (the config's
     # settings.debug) can only add to it — so sources never need to
     # re-implement the env check
-    enabled = os.environ.get("CDT_DEBUG", "") not in ("", "0", "false")
+    from .constants import DEBUG
+
+    enabled = DEBUG.get()
     if not enabled and _debug_source is not None:
         try:
             enabled = bool(_debug_source())

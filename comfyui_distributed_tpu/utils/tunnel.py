@@ -29,12 +29,13 @@ from collections import deque
 from pathlib import Path
 from typing import Optional
 
+from . import constants
 from .config import load_config, update_config
 from .exceptions import TunnelError
 from .logging import debug_log, log
 
 URL_RE = re.compile(r"https://[a-z0-9-]+\.trycloudflare\.com")
-START_TIMEOUT = float(os.environ.get("CDT_TUNNEL_START_TIMEOUT", "30"))
+START_TIMEOUT = constants.TUNNEL_START_TIMEOUT.get()
 LOG_BUFFER_LINES = 200
 
 
@@ -106,7 +107,7 @@ def download_cloudflared(dest_dir: Optional[Path] = None, fetcher=None,
 
     asset = _platform_asset()
     fetch = fetcher or _http_fetch
-    version = os.environ.get("CDT_CLOUDFLARED_VERSION", PINNED_VERSION)
+    version = constants.CLOUDFLARED_VERSION.get() or PINNED_VERSION
     if version == "latest":
         url = LATEST_URL.format(asset=asset)
     else:
@@ -122,7 +123,7 @@ def download_cloudflared(dest_dir: Optional[Path] = None, fetcher=None,
         log(f"pinned cloudflared {version} unavailable ({e}); "
             "falling back to latest")
         data = fetch(LATEST_URL.format(asset=asset))
-    expected = expected_sha256 or os.environ.get("CDT_CLOUDFLARED_SHA256")
+    expected = expected_sha256 or constants.CLOUDFLARED_SHA256.get()
     digest = hashlib.sha256(data).hexdigest()
     if expected and digest != expected.strip().lower():
         raise TunnelError(
@@ -167,8 +168,7 @@ def ensure_cloudflared(fetcher=None) -> str:
     found = find_cloudflared()
     if found:
         return found
-    auto = os.environ.get("CDT_CLOUDFLARED_AUTO_DOWNLOAD", "1")
-    if auto in ("0", "false", "no"):
+    if not constants.CLOUDFLARED_AUTO_DOWNLOAD.get():
         raise TunnelError(
             "cloudflared binary not found and auto-download is disabled — "
             "install it or set CLOUDFLARED_PATH")
@@ -260,8 +260,11 @@ class TunnelManager:
             self._ensure_auth_token()
             cmd = [binary, "tunnel", "--url", f"http://127.0.0.1:{port}"]
             debug_log(f"starting tunnel: {' '.join(cmd)}")
-            self._proc = subprocess.Popen(
-                cmd, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+            # fork+exec can stall hundreds of ms on a loaded host — keep
+            # it off the event loop (lint rule A001)
+            self._proc = await asyncio.get_running_loop().run_in_executor(
+                None, lambda: subprocess.Popen(
+                    cmd, stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
             self._reader = _ProcessReader(self._proc)
             self._reader.start()
             url = await asyncio.get_running_loop().run_in_executor(

@@ -12,6 +12,7 @@ import shlex
 import sys
 from pathlib import Path
 
+from ..utils.constants import LOG_DIR
 from ..utils.exceptions import ProcessError
 
 _SHELL_META = set(";&|<>`$(){}[]!*?~#\n")
@@ -67,7 +68,7 @@ def log_file_for(worker_id: str, log_dir: Path | None = None) -> Path:
     """Per-worker dated log file (reference ``lifecycle.py:41-65``)."""
     import datetime
 
-    base = log_dir or Path(os.environ.get("CDT_LOG_DIR", "logs"))
+    base = log_dir or Path(LOG_DIR.get())
     base.mkdir(parents=True, exist_ok=True)
     stamp = datetime.date.today().isoformat()
     return base / f"worker_{worker_id}_{stamp}.log"

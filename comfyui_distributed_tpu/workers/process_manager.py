@@ -14,6 +14,7 @@ from pathlib import Path
 from typing import Optional
 
 from ..utils.config import load_config, update_config
+from ..utils.constants import CONFIG_PATH
 from ..utils.exceptions import ProcessError
 from ..utils.logging import log
 from ..utils.process import is_process_alive
@@ -74,7 +75,7 @@ class WorkerProcessManager:
             worker,
             master_port=cfg.get("master", {}).get("port", 8288),
             config_path=str(self.config_path) if self.config_path else
-            os.environ.get("CDT_CONFIG_PATH"),
+            CONFIG_PATH.get(),
             use_watchdog=stop_on_exit,
         )
         self._managed[worker_id] = mp

@@ -14,6 +14,19 @@ SEED="${CDT_CHAOS_SEED:-42}"
 echo "[chaos] fixed seed: ${SEED} (override with CDT_CHAOS_SEED)"
 echo "[chaos] repro: CDT_CHAOS_SEED=${SEED} scripts/chaos_suite.sh $*"
 
+# Stage 0 — machine-checked invariants (ISSUE 12, docs/lint.md): cdtlint
+# over the package against the committed baseline. Fails on any
+# non-baselined finding AND on a stale baseline entry (a site that no
+# longer exists — the baseline only shrinks). Then re-run the stage-1
+# chaos event under the runtime lock-order detector (CDT_LOCK_ORDER=1):
+# every lock the event path takes records its acquisition order, and an
+# inversion fails the test loudly instead of deadlocking a future run.
+echo "[chaos] stage 0: cdtlint (static invariants) + lock-order detector"
+python -m comfyui_distributed_tpu.lint
+env JAX_PLATFORMS=cpu CDT_CHAOS_SEED="${SEED}" CDT_LOCK_ORDER=1 \
+    python -m pytest tests/ -q -m chaos -k "warm_restarted or lock_order" \
+    -p no:cacheprovider --continue-on-collection-errors "$@"
+
 # Stage 1 — seeded rolling-restart event (ISSUE 6): a worker dies
 # mid-job holding work; its warm restart (shared compile cache + shape
 # catalog) must rejoin with a pure cache-hit warmup pass and the job

@@ -1,0 +1,16 @@
+#!/usr/bin/env python
+"""cdtlint entry point (docs/lint.md).
+
+Thin wrapper over ``python -m comfyui_distributed_tpu.lint`` so CI images
+and pre-commit hooks can call a stable script path regardless of cwd.
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from comfyui_distributed_tpu.lint.__main__ import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -45,7 +45,9 @@ def _median_time(fn, *args, runs: int = 10) -> float:
 
     float(fn(jnp.float32(0.0), *args))        # warmup (compile + alloc)
     times = []
-    runs = int(os.environ.get("CDT_PROBE_RUNS", runs))
+    from comfyui_distributed_tpu.utils import constants
+
+    runs = constants.PROBE_RUNS.get() or runs
     for i in range(runs):
         t0 = time.perf_counter()
         float(fn(jnp.float32(i + 1), *args))

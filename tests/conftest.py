@@ -28,11 +28,36 @@ jax.config.update("jax_platforms", "cpu")
 # executables across runs turns the re-run cost into pure execution time.
 # Same mechanism bench.py uses on the TPU (bench.py:90), separate directory so
 # CPU test artifacts never mix with TPU ones.
-_cache_dir = os.environ.get("CDT_TEST_XLA_CACHE", "/tmp/cdt_xla_cache_tests")
+from comfyui_distributed_tpu.utils.constants import TEST_XLA_CACHE  # noqa: E402
+
+_cache_dir = TEST_XLA_CACHE.get()
 jax.config.update("jax_compilation_cache_dir", _cache_dir)
 jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
 
+import faulthandler  # noqa: E402
+
 import pytest  # noqa: E402
+
+# Deadlock evidence (ISSUE 12): a lock inversion used to present as an
+# opaque 870 s hang the outer `timeout -k` kills without a trace. Arm
+# faulthandler so SIGABRT et al. dump all thread stacks, and give every
+# test a watchdog that dumps stacks (repeating, without killing) once it
+# runs past CDT_TEST_WATCHDOG_S — the hang still gets killed by the outer
+# timeout, but now the log shows WHERE every thread was stuck.
+faulthandler.enable()
+
+
+@pytest.fixture(autouse=True)
+def _stack_dump_watchdog():
+    from comfyui_distributed_tpu.utils.constants import TEST_WATCHDOG_S
+
+    secs = TEST_WATCHDOG_S.get()
+    if secs and secs > 0:
+        faulthandler.dump_traceback_later(secs, repeat=True)
+        yield
+        faulthandler.cancel_dump_traceback_later()
+    else:
+        yield
 
 
 @pytest.fixture
@@ -55,9 +80,11 @@ def _reset_resilience_state():
     test in the session."""
     from comfyui_distributed_tpu.cluster import faults, resilience
     from comfyui_distributed_tpu.cluster.elastic import states as _el_states
+    from comfyui_distributed_tpu.lint import lockorder as _lockorder
 
     resilience.BREAKERS.reset()
     _el_states.DRAIN.reset()
+    _lockorder.reset()
     faults.deactivate()
     yield
     resilience.BREAKERS.reset()
