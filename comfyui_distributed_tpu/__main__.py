@@ -13,8 +13,14 @@ import sys
 
 
 def cmd_serve(args: argparse.Namespace) -> None:
-    from .parallel.bootstrap import init_multihost
+    from .parallel.bootstrap import (ensure_virtual_devices,
+                                     init_multihost)
     from .utils.compile_cache import enable_compile_cache
+
+    # CDT_VIRTUAL_DEVICES: stand up the virtual CPU mesh BEFORE anything
+    # touches jax (XLA reads the flag once) — the executed mesh tier is
+    # then serveable on a chipless host (docs/parallelism.md)
+    ensure_virtual_devices()
 
     # persistent XLA compile cache BEFORE the first trace: full-scale
     # sampler/ladder programs take minutes to compile (the offload
@@ -127,6 +133,12 @@ def cmd_convert(args: argparse.Namespace) -> None:
 
 def main(argv: list[str] | None = None) -> None:
     import os
+
+    from .parallel.bootstrap import ensure_virtual_devices
+
+    # CDT_VIRTUAL_DEVICES must land before the FIRST jax touch — which
+    # for the CLI is the JAX_PLATFORMS honor block right below
+    ensure_virtual_devices()
 
     if os.environ.get("JAX_PLATFORMS"):
         # the environment may pre-register an accelerator plugin and set

@@ -118,6 +118,14 @@ class Controller:
 
             root = constants.CHECKPOINT_ROOT.get()
             self._registry = ModelRegistry(Path(root) if root else None)
+            if self._registry.residency is not None:
+                # HBM planning must match the mesh that actually shards
+                # weights: the tp degree of THIS worker's serving mesh
+                # (docs/parallelism.md), not a free-floating knob —
+                # planned bytes and held bytes diverge otherwise
+                self._registry.residency.tp_shards_fn = (
+                    lambda: dict(self.mesh.shape).get(
+                        constants.AXIS_TENSOR, 1))
         return self._registry
 
     def _execution_context(self) -> dict[str, Any]:

@@ -300,6 +300,11 @@ def _execute_group_inner(members: list, sampler_node_ids: dict,
             # bundle mid-program (cluster/residency.pinned_bundle)
             from ..residency import pinned_bundle
 
+            # mesh-tier placement: a tp axis in the worker's mesh routes
+            # the group to the weight-sharded dp×tp program inside
+            # generate_microbatch (microbatch_tp_fn, gated by
+            # CDT_MESH_TIER); the worker mesh's dp width stays
+            # authoritative — it fixes each request's image count
             with pinned_bundle(lead.model):
                 outs = lead.pipeline.generate_microbatch(
                     lead.mesh, lead.spec,

@@ -219,17 +219,71 @@ class ResidencyPlanner:
             pass
 
 
-def bundle_bytes(bundle) -> int:
+def tp_shard_bytes(params, rules, tp: int) -> int:
+    """PER-CHIP bytes of ``params`` under Megatron tp sharding: leaves
+    the placement rules shard contribute ``nbytes/tp``, everything else
+    (norms, embeddings, modulation — and any leaf whose dims don't
+    divide) its full size. This is the tp-shard-granularity arithmetic
+    the mesh serving tier plans HBM with: a 12B model at tp=4 costs each
+    chip a quarter of its matmul weights plus the replicated glue, not
+    the headline parameter count."""
+    import jax
+
+    from ..parallel.tensor import _path_str, spec_for_param
+
+    total = 0
+
+    def visit(path, leaf):
+        nonlocal total
+        nbytes = leaf.size * leaf.dtype.itemsize
+        spec = spec_for_param(_path_str(path), leaf.shape, rules,
+                              "tp", tp)
+        total += nbytes // tp if any(d is not None for d in spec) \
+            else nbytes
+
+    jax.tree_util.tree_map_with_path(visit, params)
+    return total
+
+
+def _tp_rules_for(bundle):
+    """The Megatron placement rule set this bundle's core model shards
+    with — the same tables ``generate_tp_fn`` places weights by, so
+    planning and placement can't disagree about what shards."""
+    from ..parallel.tensor import (DIT_TP_RULES, UNET_TP_RULES,
+                                   WAN_TP_RULES)
+
+    pipe = bundle.pipeline
+    if getattr(pipe, "unet", None) is not None:
+        return UNET_TP_RULES
+    dit = getattr(pipe, "dit", None)
+    if dit is not None and type(dit).__name__.startswith("Wan"):
+        return WAN_TP_RULES
+    return DIT_TP_RULES
+
+
+def bundle_bytes(bundle, tp_shards: int = 1) -> int:
     """Packed parameter bytes of a loaded ``ModelBundle`` — core params
     (+ the low-noise expert for dual-expert WAN), both VAE halves, and
     the active text stack. Same per-leaf arithmetic as the offload
-    placement planner."""
+    placement planner.
+
+    ``tp_shards > 1`` plans at tp-shard granularity: the core model's
+    rule-matched weights divide over the tp axis (``tp_shard_bytes``)
+    while VAE/text — which serve replicated on every chip — count
+    full-size."""
     from ..diffusion.offload import tree_bytes
 
-    total = tree_bytes(bundle._core_params())
+    core = bundle._core_params()
     low = getattr(bundle.pipeline, "dit_params_low", None)
-    if low is not None:
-        total += tree_bytes(low)
+    if tp_shards > 1:
+        rules = _tp_rules_for(bundle)
+        total = tp_shard_bytes(core, rules, tp_shards)
+        if low is not None:
+            total += tp_shard_bytes(low, rules, tp_shards)
+    else:
+        total = tree_bytes(core)
+        if low is not None:
+            total += tree_bytes(low)
     total += tree_bytes(bundle.pipeline.vae.enc_params)
     total += tree_bytes(bundle.pipeline.vae.dec_params)
     params = getattr(bundle.text_encoder, "params", None)
@@ -243,9 +297,21 @@ class BundleResidency:
     ``CDT_HBM_BUDGET_GB`` is set)."""
 
     def __init__(self, registry, budget_bytes: int,
-                 estimator: Callable = bundle_bytes):
+                 estimator: Callable = bundle_bytes,
+                 tp_shards: Optional[int] = None):
+        """``tp_shards``: plan HBM at tp-shard granularity (per-chip
+        slice of rule-matched weights + replicated glue). ``None``
+        resolves per-acquire via ``tp_shards_fn`` — the controller sets
+        it to the SERVING MESH's tp degree, the same axis that routes
+        weight-sharded programs (``generate_microbatch``), so planned
+        bytes can never diverge from held bytes. With neither set,
+        planning stays whole-model (replicated serving)."""
         self._registry = registry
         self._estimator = estimator
+        self._tp_shards = tp_shards
+        # set post-construction by the controller (the mesh is built
+        # lazily there); must mirror the mesh that shards weights
+        self.tp_shards_fn: Optional[Callable[[], int]] = None
         self.planner = ResidencyPlanner(budget_bytes,
                                         on_evict=self._evict_bundle)
 
@@ -253,6 +319,30 @@ class BundleResidency:
         bundle = self._registry._cache.pop(name, None)
         if bundle is not None:
             bundle.release_device()
+
+    def _resolve_tp(self) -> int:
+        if self._tp_shards is not None:
+            return max(1, int(self._tp_shards))
+        from ..parallel.serving import mesh_tier_enabled
+
+        if not mesh_tier_enabled() or self.tp_shards_fn is None:
+            return 1
+        try:
+            return max(1, int(self.tp_shards_fn()))
+        except Exception:  # noqa: BLE001 — planning must not sink a build
+            return 1
+
+    def measure(self, bundle) -> int:
+        """Planner-relevant bytes for one bundle (tp-shard granularity
+        when the mesh tier shards weights; custom estimators without a
+        ``tp_shards`` kwarg keep their whole-model arithmetic)."""
+        tp = self._resolve_tp()
+        if tp > 1:
+            try:
+                return self._estimator(bundle, tp_shards=tp)
+            except TypeError:
+                pass
+        return self._estimator(bundle)
 
     def note_use(self, name: str, bundle, priority: int = 0) -> list[str]:
         """Account a registry hit: first sight measures + acquires
@@ -265,7 +355,7 @@ class BundleResidency:
         if self.planner.is_resident(name):
             self.planner.touch(name)
             return []
-        return self.planner.acquire(name, self._estimator(bundle),
+        return self.planner.acquire(name, self.measure(bundle),
                                     priority=priority)
 
     @contextlib.contextmanager

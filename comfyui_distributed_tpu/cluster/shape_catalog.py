@@ -42,8 +42,10 @@ CATALOG_VERSION = 1
 
 # pipeline-family names match the telemetry ``pipeline`` label
 # (telemetry/metrics.py) so warmup counters and step-time histograms
-# join on the same vocabulary
-PIPELINES = ("txt2img", "flow_dp", "video_dp")
+# join on the same vocabulary. flow_sp / flow_tp are the executed mesh
+# tier's programs (docs/parallelism.md): same model, sequence-sharded
+# (ring attention) and weight-sharded (Megatron dp×tp) placements.
+PIPELINES = ("txt2img", "flow_dp", "video_dp", "flow_sp", "flow_tp")
 
 
 @dataclasses.dataclass(frozen=True, order=True)

@@ -87,6 +87,13 @@ def lower_program(bundle, key: ProgramKey, mesh) -> None:
 
     prng = jax.random.key(0)
     token = _abstract((), jnp.int32)
+    if key.mesh:
+        # mesh-tier program: the key names its own strategy mesh (sp /
+        # dp×tp) over the SAME device set the host serves with
+        from ..parallel.mesh import build_mesh
+
+        mesh = build_mesh(dict(key.mesh),
+                          devices=list(mesh.devices.flat))
     if key.pipeline == "txt2img":
         from .pipeline import GenerationSpec
 
@@ -119,6 +126,47 @@ def lower_program(bundle, key: ProgramKey, mesh) -> None:
         ctx = _abstract((1, bundle.preset.text.max_len, cfg.context_dim))
         pooled = _abstract((1, getattr(cfg, "pooled_dim", 768)))
         args = (prng, ctx, pooled, token)
+    elif key.pipeline == "flow_sp":
+        # mesh tier: single-image latency program — latent rows sharded
+        # over sp, ring attention inside every block
+        from .pipeline_flow import FlowSpec
+
+        spec = FlowSpec(height=key.height, width=key.width,
+                        steps=key.steps, per_device_batch=key.batch)
+        fn = bundle.pipeline.generate_sp_fn(mesh, spec)
+        cfg = bundle.pipeline.dit.config
+        ctx = _abstract((1, bundle.preset.text.max_len, cfg.context_dim))
+        pooled = _abstract((1, cfg.pooled_dim))
+        args = (prng, ctx, pooled)
+    elif key.pipeline == "flow_tp":
+        # mesh tier: dp×tp weight-sharded program. The fanout wrapper's
+        # key fold-in is part of the traced program, so AOT-lower with a
+        # concrete folded key batch (tiny) and abstract conditioning;
+        # tp_shard_scope makes the trace resolve PER-SHARD kernel
+        # choices — the same scope the serving call runs under.
+        from ..ops.attention import tp_shard_scope
+        from .pipeline_flow import FlowSpec
+
+        spec = FlowSpec(height=key.height, width=key.width,
+                        steps=key.steps, per_device_batch=key.batch)
+        fn = bundle.pipeline.generate_tp_fn(mesh, spec)
+        cfg = bundle.pipeline.dit.config
+        B = dict(key.mesh).get("dp", 1) * key.batch
+        # keys must carry the SAME P(dp) placement the serving wrapper
+        # commits (tp_fanout_call) — a differently-sharded argument
+        # lowers a different executable, and the cache entry warmed
+        # here would not be the one serving loads
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        keys = jax.device_put(
+            jax.vmap(lambda i: jax.random.fold_in(prng, i))(
+                jnp.arange(B)),
+            NamedSharding(mesh, PartitionSpec("dp")))
+        ctx = _abstract((1, bundle.preset.text.max_len, cfg.context_dim))
+        pooled = _abstract((1, cfg.pooled_dim))
+        with tp_shard_scope(getattr(fn, "tp_shards", 1)):
+            fn.jitted.lower(*fn.weights, keys, ctx, pooled).compile()
+        return
     else:
         raise ValueError(f"no warmup recipe for pipeline {key.pipeline!r}")
     fn.jitted.lower(fn.weights, *args).compile()
@@ -126,11 +174,62 @@ def lower_program(bundle, key: ProgramKey, mesh) -> None:
 
 def _mesh_matches(key: ProgramKey, mesh) -> bool:
     """Empty key.mesh = "whatever this host runs"; a concrete one must
-    match exactly (a dp=8 program is not a dp=4 program)."""
+    match exactly (a dp=8 program is not a dp=4 program) — OR be a
+    mesh-tier strategy layout (sp / dp×tp) over the same device count,
+    which warmup builds over the host's own devices
+    (``lower_program``)."""
     if not key.mesh:
         return True
-    return tuple(sorted(key.mesh)) == tuple(
-        sorted((str(a), int(n)) for a, n in mesh.shape.items()))
+    if tuple(sorted(key.mesh)) == tuple(
+            sorted((str(a), int(n)) for a, n in mesh.shape.items())):
+        return True
+    import math
+
+    # strategy meshes may be submeshes (sp width is bounded by the
+    # latent row count); lower_program builds them over the host's own
+    # device list
+    return (key.pipeline in ("flow_sp", "flow_tp")
+            and math.prod(n for _, n in key.mesh) <= mesh.devices.size)
+
+
+def mesh_tier_keys(keys: Iterable[ProgramKey], mesh) -> list[ProgramKey]:
+    """The mesh-tier programs a catalog implies: for every flow_dp entry
+    the host serves, an sp (single-image latency) and — when the mesh
+    tier has a tp degree — a dp×tp (weight-sharded) variant on the same
+    geometry, so the front door's default placements are hot from boot
+    instead of compiling on first mesh-tier request. Gated by
+    ``CDT_MESH_TIER``; a single-device host has no mesh tier.
+
+    The tp degree is ``parallel/serving.derive_tp`` — i.e. the pinned
+    ``CDT_MESH_TP`` at key-generation time (model bytes aren't known
+    before bundles build, so the HBM-fit derivation can't run here);
+    an unpinned fleet warms its tp programs on the second boot via the
+    persistent compile cache after the first request resolves them."""
+    from ..parallel import serving
+
+    n = int(mesh.devices.size)
+    if n < 2 or not serving.mesh_tier_enabled():
+        return []
+    tp = serving.derive_tp(n)
+    while tp > 1 and n % tp:
+        tp //= 2
+    out: list[ProgramKey] = []
+    for key in keys:
+        if key.pipeline != "flow_dp":
+            continue
+        # sp needs latent rows (h/8/patch, patch=2 for the DiT family)
+        # to divide the shard count; indivisible geometries stay dp-only
+        sp = n
+        while sp > 1 and (key.height // 16) % sp:
+            sp //= 2
+        if sp > 1:
+            out.append(dataclasses.replace(
+                key, pipeline="flow_sp", mesh=(("sp", sp),)))
+        if tp > 1:
+            out.append(dataclasses.replace(
+                key, pipeline="flow_tp",
+                mesh=(("dp", n // tp), ("tp", tp))))
+    return out
 
 
 def run_warmup(registry, mesh, keys: Iterable[ProgramKey],
@@ -322,8 +421,15 @@ class WarmupManager:
             if extra_keys:
                 known = set(keys)
                 keys += [k for k in extra_keys if k not in known]
+            # mesh tier: warm the sp / dp×tp variants of every flow
+            # program the catalog serves (docs/parallelism.md) — the
+            # default placements must be hot, not benchmark-only
+            mesh = self._mesh_fn()
+            tier = [k for k in mesh_tier_keys(keys, mesh)
+                    if k not in set(keys)]
+            keys += tier
             log(f"warmup: starting pass over {len(keys)} catalog "
-                f"program(s)")
+                f"program(s) ({len(tier)} mesh-tier)")
             # the autotune stage runs INSIDE run_warmup, between bundle
             # build and AOT compile — the worker stays `warming` until
             # every attention geometry its catalog programs serve has a

@@ -96,7 +96,33 @@ def _flash_enabled(q_len: Optional[int] = None,
 # cdt_attn_kernel_selected, and selection_summary() labels pipeline
 # spans so traces show which tier served each step without a profiler.
 
+import contextlib as _contextlib
+import contextvars as _contextvars
 import threading as _threading
+
+# tp shard degree of the program currently being traced: a tp-sharded
+# attention site runs H/tp heads per shard, and the kernel choice must
+# resolve (and legality-check) THAT geometry, not the full-H one the
+# model config states. Set by the dp×tp call wrappers
+# (parallel/tensor.tp_fanout_call) and the warmup pass around tracing.
+_TP_SHARDS: _contextvars.ContextVar = _contextvars.ContextVar(
+    "cdt_attn_tp_shards", default=1)
+
+
+@_contextlib.contextmanager
+def tp_shard_scope(tp: int):
+    """Trace-scope marker: attention sites traced inside this scope
+    resolve their tuning-table entry by PER-SHARD geometry (heads/tp).
+    No-op for tp <= 1."""
+    token = _TP_SHARDS.set(max(int(tp), 1))
+    try:
+        yield
+    finally:
+        _TP_SHARDS.reset(token)
+
+
+def current_tp_shards() -> int:
+    return _TP_SHARDS.get()
 
 _SELECTIONS: "dict[str, str]" = {}
 _SELECTIONS_LOCK = _threading.Lock()
@@ -156,11 +182,20 @@ def select_kernel(q_len: int, kv_len: int, num_heads: int, head_dim: int,
     callers, see ``full_attention``) keeps its guarantee ahead of the
     table: a table entry saying ``xla`` is ignored there, because the
     sweep optimized for time while the caller needs the streamed
-    softmax to fit HBM at all."""
+    softmax to fit HBM at all.
+
+    Mesh-aware: inside a :func:`tp_shard_scope` the head count is
+    divided by the tp degree BEFORE key derivation — the per-shard
+    geometry (H/tp heads) is what actually executes, and a full-H table
+    entry can carry blocks that are illegal (or slow) at H/tp."""
     from .autotune import KernelChoice, GeometryKey, lookup
 
-    geometry = GeometryKey.from_shape(num_heads, head_dim, q_len, kv_len,
-                                      dtype).key_str()
+    # ONE definition of the per-shard rule (GeometryKey.shard): sweeps,
+    # table keys and this dispatch must never disagree about it
+    gkey = GeometryKey.from_shape(num_heads, head_dim, q_len, kv_len,
+                                  dtype).shard(current_tp_shards())
+    num_heads = gkey.num_heads
+    geometry = gkey.key_str()
     flag = constants.FLASH_ATTENTION.get()
     if flag is False:
         choice = KernelChoice("xla", source="env",
@@ -313,6 +348,24 @@ def _hop_attend(qf, k_cur, v_cur, m, l, acc, scale):
     return m, l, acc
 
 
+def _collective_quant() -> "str | None":
+    """Wire format for rotating K/V payloads (``CDT_COLLECTIVE_QUANT``).
+    ``None`` (the default) keeps the ring bit-exact; ``"int8"`` halves
+    the per-hop ICI bytes with one quantization round of error per
+    payload (``parallel/overlap.quant_error_bound``). Resolved at trace
+    time, like every other kernel gate."""
+    mode = constants.COLLECTIVE_QUANT.get()
+    return None if mode == "none" else mode
+
+
+def _ring_rotate(axis: str, n_shards: int, *payloads):
+    """One ring hop of the K/V payload set — (tensor, scale) pairs when
+    quantized (the scale rotates with its tensor), plain tensors
+    otherwise."""
+    perm = [(j, (j + 1) % n_shards) for j in range(n_shards)]
+    return tuple(jax.lax.ppermute(p, axis, perm) for p in payloads)
+
+
 def ring_attention(
     q: jax.Array, k: jax.Array, v: jax.Array,
     axis: str = constants.AXIS_SEQUENCE,
@@ -321,29 +374,56 @@ def ring_attention(
 
     Call inside ``shard_map``: every shard holds [B, N/s, H, D] of q/k/v;
     returns the local query shard's outputs [B, N/s, H, D]. The K/V pair
-    makes ``s`` hops around the ring (``ppermute``), overlapping compute
-    with neighbour transfers.
+    makes ``s`` hops around the ring (``ppermute``) — the collective is
+    already decomposed into per-block steps interleaved with the
+    attention compute each arriving block unblocks, so XLA schedules
+    hop ``i+1``'s neighbour transfer under hop ``i``'s FLOPs (the
+    overlap schedule the fused-collective tiers borrow from here).
+
+    Under ``CDT_COLLECTIVE_QUANT=int8`` each shard quantizes its K/V
+    block ONCE and the int8 payload (+ absmax scale) rotates; every
+    contribution carries exactly one quantization round
+    (``absmax/254`` per element) regardless of ring length. Default is
+    the bit-exact bf16/f32 ring.
     """
     n_shards = _axis_size(axis)
     B, Nq, H, D = q.shape
     scale = 1.0 / (D ** 0.5)
     qf = q.astype(jnp.float32)
-
-    def body(i, carry):
-        m, l, acc, k_cur, v_cur = carry
-        m, l, acc = _hop_attend(qf, k_cur, v_cur, m, l, acc, scale)
-        perm = [(j, (j + 1) % n_shards) for j in range(n_shards)]
-        k_nxt = jax.lax.ppermute(k_cur, axis, perm)
-        v_nxt = jax.lax.ppermute(v_cur, axis, perm)
-        return m, l, acc, k_nxt, v_nxt
+    quant = _collective_quant()
 
     # initial carries must be marked axis-varying for the fori_loop carry
     # types to match (they mix with shard-varying q/k/v on step one)
     m0 = _pvary(jnp.full((B, H, Nq), -jnp.inf, jnp.float32), axis)
     l0 = _pvary(jnp.zeros((B, H, Nq), jnp.float32), axis)
     acc0 = _pvary(jnp.zeros((B, Nq, H, D), jnp.float32), axis)
-    m, l, acc, _, _ = jax.lax.fori_loop(
-        0, n_shards, body, (m0, l0, acc0, k, v))
+
+    if quant == "int8":
+        from ..parallel.overlap import wire_dequantize, wire_quantize
+
+        kq, ks = wire_quantize(k)
+        vq, vs = wire_quantize(v)
+
+        def body(i, carry):
+            m, l, acc, kq, ks, vq, vs = carry
+            m, l, acc = _hop_attend(qf, wire_dequantize(kq, ks),
+                                    wire_dequantize(vq, vs), m, l, acc,
+                                    scale)
+            kq, ks, vq, vs = _ring_rotate(axis, n_shards, kq, ks,
+                                          vq, vs)
+            return m, l, acc, kq, ks, vq, vs
+
+        m, l, acc = jax.lax.fori_loop(
+            0, n_shards, body, (m0, l0, acc0, kq, ks, vq, vs))[:3]
+    else:
+        def body(i, carry):
+            m, l, acc, k_cur, v_cur = carry
+            m, l, acc = _hop_attend(qf, k_cur, v_cur, m, l, acc, scale)
+            k_nxt, v_nxt = _ring_rotate(axis, n_shards, k_cur, v_cur)
+            return m, l, acc, k_nxt, v_nxt
+
+        m, l, acc = jax.lax.fori_loop(
+            0, n_shards, body, (m0, l0, acc0, k, v))[:3]
     out = acc / l.transpose(0, 2, 1)[..., None]
     return out.astype(q.dtype)
 
@@ -361,11 +441,16 @@ def joint_ring_attention(
     first accumulation block (folding them per-hop would double-count).
     ``q`` may contain any mix of text/image queries — every query attends
     over the full joint sequence exactly.
+
+    ``CDT_COLLECTIVE_QUANT=int8`` applies to the ROTATING image K/V only
+    (one quantization round per payload); the replicated text block is
+    never on the wire and stays exact.
     """
     n_shards = _axis_size(axis)
     B, Nq, H, D = q.shape
     scale = 1.0 / (D ** 0.5)
     qf = q.astype(jnp.float32)
+    quant = _collective_quant()
 
     m0 = jnp.full((B, H, Nq), -jnp.inf, jnp.float32)
     l0 = jnp.zeros((B, H, Nq), jnp.float32)
@@ -378,16 +463,32 @@ def joint_ring_attention(
     l0 = _pvary(l0, axis)
     acc0 = _pvary(acc0, axis)
 
-    def body(i, carry):
-        m, l, acc, k_cur, v_cur = carry
-        m, l, acc = _hop_attend(qf, k_cur, v_cur, m, l, acc, scale)
-        perm = [(j, (j + 1) % n_shards) for j in range(n_shards)]
-        return (m, l, acc,
-                jax.lax.ppermute(k_cur, axis, perm),
-                jax.lax.ppermute(v_cur, axis, perm))
+    if quant == "int8":
+        from ..parallel.overlap import wire_dequantize, wire_quantize
 
-    m, l, acc, _, _ = jax.lax.fori_loop(0, n_shards, body,
-                                        (m0, l0, acc0, img_k, img_v))
+        kq, ks = wire_quantize(img_k)
+        vq, vs = wire_quantize(img_v)
+
+        def body(i, carry):
+            m, l, acc, kq, ks, vq, vs = carry
+            m, l, acc = _hop_attend(qf, wire_dequantize(kq, ks),
+                                    wire_dequantize(vq, vs), m, l, acc,
+                                    scale)
+            kq, ks, vq, vs = _ring_rotate(axis, n_shards, kq, ks,
+                                          vq, vs)
+            return m, l, acc, kq, ks, vq, vs
+
+        m, l, acc = jax.lax.fori_loop(
+            0, n_shards, body, (m0, l0, acc0, kq, ks, vq, vs))[:3]
+    else:
+        def body(i, carry):
+            m, l, acc, k_cur, v_cur = carry
+            m, l, acc = _hop_attend(qf, k_cur, v_cur, m, l, acc, scale)
+            k_nxt, v_nxt = _ring_rotate(axis, n_shards, k_cur, v_cur)
+            return m, l, acc, k_nxt, v_nxt
+
+        m, l, acc = jax.lax.fori_loop(
+            0, n_shards, body, (m0, l0, acc0, img_k, img_v))[:3]
     out = acc / l.transpose(0, 2, 1)[..., None]
     return out.astype(q.dtype)
 

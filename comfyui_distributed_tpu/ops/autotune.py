@@ -123,6 +123,19 @@ class GeometryKey:
         return (f"h{self.num_heads}.d{self.head_dim}.q{self.q_bucket}"
                 f".kv{self.kv_bucket}.{self.dtype}")
 
+    def shard(self, tp: int) -> "GeometryKey":
+        """The PER-SHARD geometry a tp-sharded site executes: the
+        Megatron column split lands on the head axis, so each shard
+        runs H/tp heads of the same sequence. Table lookups and
+        legality checks must key on THIS geometry — an entry tuned for
+        the full H can pick blocks that are illegal (or slow) at H/tp.
+        Indivisible head counts don't shard (the TP placement rules
+        fall back to replication there too), so the key is unchanged.
+        """
+        if tp <= 1 or self.num_heads % tp:
+            return self
+        return dataclasses.replace(self, num_heads=self.num_heads // tp)
+
     @classmethod
     def from_key_str(cls, s: str) -> "GeometryKey":
         try:
@@ -602,6 +615,26 @@ def ensure_tuned(geometries: Iterable[GeometryKey],
 # --- geometry derivation (warmup + CLI) --------------------------------------
 
 
+def parse_mesh_spec(spec: str) -> dict[str, int]:
+    """CLI mesh shape: ``'dp4xtp2'`` / ``'tp=2'`` / ``'dp=2,tp=4'`` →
+    ``{'dp': 4, 'tp': 2}``. Raises ``ValueError`` on malformed tokens."""
+    import re
+
+    axes: dict[str, int] = {}
+    for tok in re.split(r"[x,]", spec.strip()):
+        tok = tok.strip()
+        if not tok:
+            continue
+        m = re.fullmatch(r"([a-z]+)=?(\d+)", tok)
+        if not m:
+            raise ValueError(f"malformed mesh token {tok!r} in {spec!r} "
+                             "(want e.g. 'dp4xtp2' or 'tp=2')")
+        axes[m.group(1)] = int(m.group(2))
+    if not axes:
+        raise ValueError(f"empty mesh spec {spec!r}")
+    return axes
+
+
 def _cfg_heads_dim(cfg) -> tuple[int, int]:
     heads = getattr(cfg, "num_heads", None) or getattr(cfg, "heads")
     width = getattr(cfg, "dim", None) or getattr(cfg, "hidden")
@@ -615,9 +648,18 @@ def geometries_for_program(bundle, key) -> list[GeometryKey]:
     reports ready only once its serving geometries are tuned. Geometry
     math mirrors the model definitions (UNet level downsampling, DiT
     patchify, WAN 3D-VAE temporal compression); unknown pipeline shapes
-    raise — the caller records the error per program."""
+    raise — the caller records the error per program.
+
+    Mesh-aware: a ``tp`` axis in ``key.mesh`` divides the head counts
+    (``GeometryKey.shard``) — the per-shard geometry is what the traced
+    kernels execute, so THAT is what must be tuned before warmup bakes
+    kernel choices into the compiled programs. ``flow_sp`` programs run
+    ring attention (their collective is the kernel schedule itself, not
+    a table-dispatched tier), so they contribute no table geometries."""
     out: list[GeometryKey] = []
     text_len = int(bundle.preset.text.max_len)
+    if key.pipeline == "flow_sp":
+        return out
     if key.pipeline == "txt2img":
         cfg = bundle.pipeline.unet.config
         dt = cfg.dtype
@@ -634,7 +676,7 @@ def geometries_for_program(bundle, key) -> list[GeometryKey]:
                                               tokens, dt))
             out.append(GeometryKey.from_shape(heads, head_dim, tokens,
                                               text_len, dt))
-    elif key.pipeline == "flow_dp":
+    elif key.pipeline in ("flow_dp", "flow_tp"):
         cfg = bundle.pipeline.dit.config
         heads, head_dim = _cfg_heads_dim(cfg)
         patch = int(getattr(cfg, "patch_size", 2))
@@ -663,6 +705,9 @@ def geometries_for_program(bundle, key) -> list[GeometryKey]:
     else:
         raise ValueError(f"no geometry recipe for pipeline "
                          f"{key.pipeline!r}")
+    tp = dict(key.mesh).get(constants.AXIS_TENSOR, 1) if key.mesh else 1
+    if tp > 1:
+        out = [g.shard(tp) for g in out]
     return out
 
 

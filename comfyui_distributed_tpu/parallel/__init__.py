@@ -5,18 +5,35 @@ transport/parallelism story (SURVEY §2.10): where the reference fans jobs to
 worker *processes* over HTTP and gathers base64-PNG envelopes
 (``nodes/collector.py``), we shard computations over a ``jax.sharding.Mesh``
 and gather with XLA collectives over ICI.
+
+Exports resolve lazily (PEP 562): ``bootstrap.ensure_virtual_devices``
+must be importable BEFORE jax initializes (``CDT_VIRTUAL_DEVICES`` sets
+``--xla_force_host_platform_device_count``, which XLA reads exactly
+once), so importing this package must not itself pull jax in.
 """
 
-from .mesh import (  # noqa: F401
-    MeshSpec,
-    build_mesh,
-    device_census,
-    local_device_count,
-    mesh_from_config,
-)
-from .rng import participant_key, participant_keys, seed_to_key  # noqa: F401
-from .sharding import (  # noqa: F401
-    batch_sharding,
-    replicated_sharding,
-    shard_batch,
-)
+_EXPORTS = {
+    "MeshSpec": ".mesh",
+    "build_mesh": ".mesh",
+    "device_census": ".mesh",
+    "local_device_count": ".mesh",
+    "mesh_from_config": ".mesh",
+    "participant_key": ".rng",
+    "participant_keys": ".rng",
+    "seed_to_key": ".rng",
+    "batch_sharding": ".sharding",
+    "replicated_sharding": ".sharding",
+    "shard_batch": ".sharding",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name: str):
+    mod = _EXPORTS.get(name)
+    if mod is None:
+        raise AttributeError(
+            f"module {__name__!r} has no attribute {name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(mod, __name__), name)

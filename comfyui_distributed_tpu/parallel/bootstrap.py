@@ -24,11 +24,68 @@ Env-var equivalents (for k8s/pod launchers that template manifests):
 
 from __future__ import annotations
 
+import os
+import re
+import sys
 from typing import Callable, Optional
 
 from ..utils.logging import log
 
 _initialized = False
+
+
+def ensure_virtual_devices(n: Optional[int] = None) -> Optional[int]:
+    """Stand up an ``n``-device virtual CPU mesh BEFORE jax initializes.
+
+    The executed mesh tier (sp / dp×tp shard_map programs,
+    docs/parallelism.md) is tier-1-testable off hardware by running on
+    XLA's virtual host devices (``--xla_force_host_platform_device_count``).
+    ``n`` falls back to ``CDT_VIRTUAL_DEVICES``; unset/0 is a no-op.
+
+    XLA reads the flag once at backend init, so this MUST run before the
+    first ``import jax`` anywhere in the process — a silent late call
+    would leave the caller executing a "mesh" program on one device
+    while believing it validated eight. Fails loudly instead.
+    """
+    from ..utils import constants
+
+    n = n if n is not None else constants.VIRTUAL_DEVICES.get()
+    if not n:
+        return None
+    if n < 2:
+        raise ValueError(f"CDT_VIRTUAL_DEVICES={n}: a virtual mesh needs "
+                         "at least 2 devices")
+    flags = os.environ.get("XLA_FLAGS", "")
+    existing = re.search(
+        r"xla_force_host_platform_device_count=(\d+)", flags)
+    if existing:
+        have = int(existing.group(1))
+        if have != n:
+            # silently proceeding would leave the caller executing an
+            # n-device "mesh" on `have` devices — the exact state this
+            # function exists to prevent
+            raise RuntimeError(
+                f"CDT_VIRTUAL_DEVICES={n} conflicts with XLA_FLAGS "
+                f"already forcing {have} host devices")
+        return n         # already configured (test conftest, driver env)
+    if "xla_force_host_platform_device_count" in flags:
+        raise RuntimeError(
+            "XLA_FLAGS carries a malformed "
+            "xla_force_host_platform_device_count; refusing to guess")
+    if "jax" in sys.modules:
+        raise RuntimeError(
+            f"CDT_VIRTUAL_DEVICES={n} but jax is already imported — the "
+            "virtual device count is frozen at backend init. Set the "
+            "knob (or call ensure_virtual_devices) before anything "
+            "imports jax.")
+    os.environ["XLA_FLAGS"] = (
+        f"{flags} --xla_force_host_platform_device_count={n}".strip())
+    # virtual devices exist only on the host platform; an accelerator
+    # plugin registering first would shadow them
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    log(f"virtual mesh: {n} host devices "
+        f"(--xla_force_host_platform_device_count)")
+    return n
 
 
 def multihost_env() -> dict:

@@ -30,18 +30,46 @@ def gather_batch(x: jax.Array, axis: str = constants.AXIS_DATA) -> jax.Array:
     the reference's ``_reorder_and_combine_tensors``
     (``nodes/collector.py:252-295``).
 
+    Under ``CDT_MESH_OVERLAP`` (default on) the gather is the ring
+    decomposition (``parallel/overlap.all_gather_ring``): n-1 per-block
+    ppermute hops whose already-arrived blocks unblock downstream
+    compute while later hops are in flight. Bit-exact either way —
+    gathering moves bytes, never recomputes them.
+
     Note: under ``jax.shard_map`` the gathered value is equal on every shard
     but is still *tracked* as axis-varying, so callers that declare it
     replicated via ``out_specs=P(None, ...)`` must pass ``check_vma=False``.
     """
+    from .overlap import all_gather_ring, overlap_enabled
+
+    if overlap_enabled():
+        return all_gather_ring(x, axis, dim=0)
     return jax.lax.all_gather(x, axis, axis=0, tiled=True)
 
 
 def mean_over(x: jax.Array, axis: str) -> jax.Array:
+    """Cross-shard mean; the overlap-scheduled ring under
+    ``CDT_MESH_OVERLAP`` (see ``sum_over``)."""
+    from .overlap import overlap_enabled
+
+    if overlap_enabled():
+        from ..utils.jax_compat import axis_size
+
+        return sum_over(x, axis) / axis_size(axis)
     return jax.lax.pmean(x, axis)
 
 
 def sum_over(x: jax.Array, axis: str) -> jax.Array:
+    """Cross-shard sum. ``CDT_MESH_OVERLAP`` (default on) routes it
+    through the ring reduce-scatter + all-gather decomposition
+    (``parallel/overlap.all_reduce`` — per-block ppermute steps XLA can
+    overlap with the compute each block unblocks; the opt-in
+    ``CDT_COLLECTIVE_QUANT`` int8 wire rides the same path); otherwise
+    one fused ``psum``."""
+    from .overlap import all_reduce, overlap_enabled
+
+    if overlap_enabled():
+        return all_reduce(x, axis)
     return jax.lax.psum(x, axis)
 
 

@@ -108,8 +108,19 @@ def build_mesh(
 
 
 def mesh_from_config(config: dict, devices: Sequence[jax.Device] | None = None) -> Mesh:
-    """Mesh from the ``mesh.shape`` config section."""
-    shape = (config.get("mesh") or {}).get("shape") or {"dp": -1}
+    """Mesh from the ``mesh.shape`` config section.
+
+    With no explicit shape the mesh-tier placement planner decides
+    (``parallel/serving.plan_placement``): a pinned ``CDT_MESH_TP``
+    yields the dp×tp layout (tp innermost — ICI-neighbour shards),
+    otherwise the flat dp fan-out, exactly as before. An explicit
+    config shape always wins — operators stay authoritative."""
+    shape = (config.get("mesh") or {}).get("shape")
+    if not shape:
+        from . import serving
+
+        n = len(devices) if devices is not None else len(jax.devices())
+        shape = serving.plan_placement(n, batch=2).mesh_shape
     return build_mesh(MeshSpec.from_mapping(shape), devices)
 
 

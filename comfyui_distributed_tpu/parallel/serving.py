@@ -1,0 +1,122 @@
+"""Mesh serving tier: placement planning for executed sp / dp×tp.
+
+Until ISSUE 13 the multi-chip modes only ever ran under the driver's
+dry-run validation; serving always placed work on a flat ``dp`` mesh.
+This module is the policy that makes the mesh the DEFAULT tier:
+
+- :func:`derive_tp` — the tp degree a model needs on this fleet:
+  ``CDT_MESH_TP`` wins; otherwise the smallest power-of-two shard count
+  whose per-chip weight slice fits the HBM budget (the residency
+  planner's tp-shard arithmetic, ``cluster/residency.py``).
+- :func:`plan_placement` — one strategy per request class:
+  ``dp_tp`` when the weights need sharding (or the operator pinned a tp
+  degree), ``sp`` for single-image latency when the model has a
+  sequence-parallel path, ``dp`` seed fan-out otherwise.
+- :func:`mesh_for` — the concrete ``Mesh`` for a plan, laid out so tp
+  rides the fastest (innermost/ICI-neighbour) axis.
+
+``CDT_MESH_TIER=0`` collapses everything back to the flat dp tier (the
+pre-ISSUE-13 behavior) — the kill switch every serving subsystem ships
+with.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from ..utils import constants
+
+STRATEGIES = ("dp", "dp_tp", "sp")
+
+
+def mesh_tier_enabled() -> bool:
+    return constants.MESH_TIER.get()
+
+
+def derive_tp(n_devices: int, param_bytes: Optional[int] = None,
+              budget_bytes: Optional[int] = None) -> int:
+    """The tp degree serving should shard weights over.
+
+    ``CDT_MESH_TP`` pins it (clamped to the device count). Otherwise,
+    with known weight bytes and a per-chip HBM budget, the smallest
+    power-of-two shard count whose per-chip slice fits; 1 when the
+    weights fit replicated (tp overhead is pure cost then) or when
+    nothing is known.
+    """
+    pinned = constants.MESH_TP.get()
+    if pinned:
+        return max(1, min(int(pinned), n_devices))
+    if not param_bytes or not budget_bytes or budget_bytes <= 0:
+        return 1
+    tp = 1
+    while tp * 2 <= n_devices and param_bytes / tp > budget_bytes:
+        tp *= 2
+    return tp
+
+
+@dataclasses.dataclass(frozen=True)
+class PlacementPlan:
+    """One request class's resolved placement."""
+
+    strategy: str                      # dp | dp_tp | sp
+    n_devices: int
+    tp: int = 1
+    reason: str = ""
+
+    @property
+    def mesh_shape(self) -> dict:
+        if self.strategy == "sp":
+            return {constants.AXIS_SEQUENCE: self.n_devices}
+        if self.strategy == "dp_tp":
+            return {constants.AXIS_DATA: self.n_devices // self.tp,
+                    constants.AXIS_TENSOR: self.tp}
+        return {constants.AXIS_DATA: self.n_devices}
+
+    def to_dict(self) -> dict:
+        return {"strategy": self.strategy, "n_devices": self.n_devices,
+                "tp": self.tp, "mesh": self.mesh_shape,
+                "reason": self.reason}
+
+
+def plan_placement(n_devices: int, *, batch: int = 1,
+                   param_bytes: Optional[int] = None,
+                   budget_bytes: Optional[int] = None,
+                   supports_sp: bool = False,
+                   supports_tp: bool = True) -> PlacementPlan:
+    """Pick the serving strategy for one request class.
+
+    Precedence: weights that don't fit replicated (or a pinned
+    ``CDT_MESH_TP``) force ``dp_tp``; a single-image request on a model
+    with a sequence-parallel path takes ``sp`` (latency scales with
+    chips — the thing the reference architecture explicitly cannot do);
+    everything else fans seeds out over ``dp``. ``CDT_MESH_TIER=0`` or a
+    1-device host always yields flat dp.
+    """
+    if n_devices <= 1 or not mesh_tier_enabled():
+        return PlacementPlan("dp", max(n_devices, 1),
+                             reason="mesh tier off or single device")
+    tp = derive_tp(n_devices, param_bytes, budget_bytes) if supports_tp \
+        else 1
+    if tp > 1:
+        while n_devices % tp:          # keep the mesh factorable
+            tp //= 2
+    if tp > 1:
+        why = ("CDT_MESH_TP pinned" if constants.MESH_TP.get()
+               else f"weights ({param_bytes / 1e9:.1f} GB) exceed the "
+                    f"per-chip budget")
+        return PlacementPlan("dp_tp", n_devices, tp, reason=why)
+    if batch <= 1 and supports_sp:
+        return PlacementPlan("sp", n_devices,
+                             reason="single-image latency: shard the "
+                                    "sequence, not the batch")
+    return PlacementPlan("dp", n_devices, reason="seed fan-out")
+
+
+def mesh_for(plan: PlacementPlan, devices=None):
+    """Concrete ``Mesh`` for a plan. Axis order puts tp LAST so tp
+    shards land on enumeration-adjacent (ICI-neighbour) devices — the
+    all-reduces ride the fastest links while dp stays pure fan-out."""
+    from .mesh import build_mesh
+
+    return build_mesh(plan.mesh_shape, devices)

@@ -114,23 +114,32 @@ def require_tp_match(params: Any, mesh: Mesh,
 
 
 def tp_fanout_call(jitted, weight_args: tuple, mesh: Mesh, dp_axis: str,
-                   B: int):
+                   B: int, tp_axis: str = constants.AXIS_TENSOR):
     """Shared dp×tp call wrapper: folds a base key into ``B`` per-sample
     keys placed over ``dp``, and supplies the (tp-placed) weight args to
     the jitted program. ``.jitted``/``.weights`` expose the AOT handles
-    (same contract as ``diffusion.pipeline.bind_weights``)."""
+    (same contract as ``diffusion.pipeline.bind_weights``);
+    ``.tp_shards`` carries the tp degree so AOT lowerers can restore the
+    same per-shard kernel-selection scope this wrapper traces under."""
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec
 
+    from ..ops.attention import tp_shard_scope
+
     key_sharding = NamedSharding(mesh, PartitionSpec(dp_axis))
+    tp = dict(mesh.shape).get(tp_axis, 1)
 
     def call(key, *rest):
         keys = jax.vmap(lambda i: jax.random.fold_in(key, i))(jnp.arange(B))
-        return jitted(*weight_args, jax.device_put(keys, key_sharding),
-                      *rest)
+        # per-shard geometry scope: tracing (first call) must resolve
+        # attention kernels for H/tp heads — what each shard executes
+        with tp_shard_scope(tp):
+            return jitted(*weight_args, jax.device_put(keys, key_sharding),
+                          *rest)
 
     call.jitted = jitted
     call.weights = weight_args
+    call.tp_shards = tp
     return call
 
 

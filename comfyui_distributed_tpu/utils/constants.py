@@ -656,6 +656,32 @@ HOST_INDEX = knob_int(
     "This host's process id for multi-host init.",
     doc="docs/deployment.md")
 
+# --- executed mesh serving tier (parallel/, docs/parallelism.md) ------------
+VIRTUAL_DEVICES = knob_int(
+    "CDT_VIRTUAL_DEVICES", None, "parallel",
+    "Create this many virtual CPU devices before jax initializes "
+    "(--xla_force_host_platform_device_count); fails loudly if jax is "
+    "already imported.", doc="docs/parallelism.md")
+MESH_TIER = knob_bool(
+    "CDT_MESH_TIER", True, "parallel",
+    "Executed mesh serving tier: warm sp/dp-tp programs and prefer the "
+    "mesh placement for batchable groups (0 = dp-only legacy tier).",
+    doc="docs/parallelism.md")
+MESH_TP = knob_int(
+    "CDT_MESH_TP", 0, "parallel",
+    "tp degree for the mesh serving tier (0 = derive from the mesh "
+    "config / HBM fit).", doc="docs/parallelism.md")
+MESH_OVERLAP = knob_bool(
+    "CDT_MESH_OVERLAP", True, "parallel",
+    "Overlap-schedule mesh collectives: decompose all-reduce/all-gather "
+    "into per-block ppermute rings instead of one fused collective.",
+    doc="docs/parallelism.md")
+COLLECTIVE_QUANT = knob_enum(
+    "CDT_COLLECTIVE_QUANT", "none", ("none", "int8"), "parallel",
+    "Quantized-collective wire format (EQuARX-style bf16->int8); "
+    "'none' (default) keeps every collective bit-exact.",
+    doc="docs/parallelism.md")
+
 # --- compile cache / shape catalog / warmup (PR 4) --------------------------
 COMPILE_CACHE_DIR = knob_str(
     "CDT_COMPILE_CACHE_DIR", None, "warmup",

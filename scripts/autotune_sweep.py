@@ -42,11 +42,25 @@ def main() -> int:
     ap.add_argument("--geometry", action="append", default=[],
                     help="geometry key string (h<H>.d<D>.q<Q>.kv<KV>."
                          "<dtype>); repeatable; default: the model zoo")
+    ap.add_argument("--mesh", default=None,
+                    help="mesh shape ('dp4xtp2', 'tp=2', 'dp=2,tp=4'): "
+                         "sweep the PER-SHARD geometries a tp-sharded "
+                         "site executes (heads divided by the tp degree) "
+                         "instead of the full-H ones")
     ap.add_argument("--runs", type=int, default=3,
                     help="timed-mode runs per candidate")
     cli = ap.parse_args()
 
     from comfyui_distributed_tpu.ops import autotune
+
+    tp = 1
+    if cli.mesh:
+        try:
+            axes = autotune.parse_mesh_spec(cli.mesh)
+        except ValueError as e:
+            print(f"error: {e}", file=sys.stderr)
+            return 1
+        tp = axes.get("tp", 1)
 
     if not cli.dry_run:
         import jax
@@ -73,6 +87,14 @@ def main() -> int:
             return 1
     else:
         geometries = sorted(autotune.model_zoo_geometries().values())
+    if tp > 1:
+        sharded = sorted({g.shard(tp) for g in geometries})
+        skipped = len(geometries) - len(
+            [g for g in geometries if g.num_heads % tp == 0])
+        if skipped:
+            print(f"note: {skipped} geometry(ies) have head counts not "
+                  f"divisible by tp={tp}; swept unsharded", file=sys.stderr)
+        geometries = sharded
 
     mode = "dry" if cli.dry_run else "timed"
     errors = 0
@@ -115,7 +137,9 @@ def main() -> int:
                          save=False)
         table.save()
     print(json.dumps({"written": str(out_path), "entries": len(entries),
-                      "errors": errors, "mode": mode}), flush=True)
+                      "errors": errors, "mode": mode,
+                      "mesh": cli.mesh or None, "tp_shards": tp}),
+          flush=True)
     return 1 if errors else 0
 
 
