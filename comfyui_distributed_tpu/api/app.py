@@ -253,11 +253,25 @@ def create_app(controller: Controller) -> web.Application:
     r.add_get("/distributed/history/{prompt_id}", history)
 
     # --- public queue API (reference api/job_routes.py:206-236) ------------
+    def _import_inline_checkpoint(payload):
+        """Resume fields on the legacy path — one shared policy with
+        the front door (``cluster.preemption.resolve_resume``)."""
+        from ..cluster.preemption import resolve_resume
+
+        return resolve_resume(getattr(controller, "preemption", None),
+                              payload.checkpoint_id, payload.checkpoint)
+
     async def distributed_queue(request):
         payload = parse_queue_request_payload(await _json_body(request))
         fd = getattr(controller, "frontdoor", None)
         if fd is None:
-            # CDT_FRONTDOOR=0: the pre-front-door path, verbatim
+            # CDT_FRONTDOOR=0: the pre-front-door path, verbatim — plus
+            # the resume fields (docs/preemption.md), which predate no
+            # clients and must not vanish with the front door
+            queue_meta = {}
+            cid = _import_inline_checkpoint(payload)
+            if cid is not None:
+                queue_meta["checkpoint_id"] = cid
             result = await controller.orchestrator.orchestrate(
                 payload.prompt,
                 client_id=payload.client_id,
@@ -265,6 +279,7 @@ def create_app(controller: Controller) -> web.Application:
                 delegate_master=payload.delegate_master,
                 load_balance=payload.load_balance,
                 trace_id=payload.trace_id,
+                queue_meta=queue_meta,
             )
             return web.json_response({
                 "prompt_id": result.prompt_id,
@@ -318,10 +333,66 @@ def create_app(controller: Controller) -> web.Application:
                    + cache.results.clear_memory())
         return web.json_response({"status": "cleared", "dropped": dropped})
 
+    # --- step-granular preemption (cluster/preemption.py) ------------------
+    async def preemption_stats(request):
+        pre = getattr(controller, "preemption", None)
+        if pre is None:
+            return web.json_response({"enabled": False})
+        return web.json_response(pre.stats())
+
+    async def checkpoint_export(request):
+        """Wire-form checkpoint for cross-worker resume: the master (or
+        an operator) pulls the parked state off the preempting worker
+        and hands it to any other via POST /distributed/checkpoint or an
+        inline `checkpoint` queue payload (docs/preemption.md)."""
+        pre = getattr(controller, "preemption", None)
+        if pre is None:
+            return web.json_response({"error": "preemption disabled"},
+                                     status=404)
+        cid = request.match_info["checkpoint_id"]
+        # multi-MB base64 off the event loop (the PR 9 media-route
+        # discipline: serialization work never stalls the control plane)
+        payload = await asyncio.get_running_loop().run_in_executor(
+            None, pre.store.export_payload, cid)
+        if payload is None:
+            return web.json_response(
+                {"error": f"unknown checkpoint {cid!r}"}, status=404)
+        return web.json_response(payload)
+
+    async def checkpoint_import(request):
+        """Park a wire-form checkpoint on THIS worker (checksum-verified
+        before a byte is trusted); answer with the local checkpoint id a
+        resume request then names."""
+        from ..diffusion.checkpoint import CheckpointError, LatentCheckpoint
+
+        pre = getattr(controller, "preemption", None)
+        if pre is None:
+            return web.json_response({"error": "preemption disabled"},
+                                     status=404)
+        body = await _json_body(request)
+
+        def _parse_and_park():
+            ckpt = LatentCheckpoint.from_payload(body)
+            return pre.store.park(ckpt), ckpt
+
+        try:
+            # b64 decode + sha256 + npz parse of a multi-MB payload off
+            # the event loop (PR 9 media-route discipline)
+            cid, ckpt = await asyncio.get_running_loop().run_in_executor(
+                None, _parse_and_park)
+        except CheckpointError as e:
+            raise ValidationError(str(e), field="checkpoint")
+        return web.json_response({"status": "ok", "checkpoint_id": cid,
+                                  "step": ckpt.step,
+                                  "total_steps": ckpt.total_steps})
+
     r.add_post("/distributed/queue", distributed_queue)
     r.add_get("/distributed/frontdoor", frontdoor_stats)
     r.add_get("/distributed/cache", cache_stats)
     r.add_post("/distributed/cache/clear", cache_clear)
+    r.add_get("/distributed/preemption", preemption_stats)
+    r.add_get("/distributed/checkpoint/{checkpoint_id}", checkpoint_export)
+    r.add_post("/distributed/checkpoint", checkpoint_import)
 
     # --- collector ingest (reference api/job_routes.py:273-343) ------------
     async def job_complete(request):

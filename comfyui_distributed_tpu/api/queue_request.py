@@ -11,7 +11,8 @@ from typing import Any, Optional
 
 from ..utils import constants
 from ..utils.exceptions import ValidationError
-from .schemas import (validate_cache_mode, validate_deadline_ms,
+from .schemas import (validate_cache_mode, validate_checkpoint_id,
+                      validate_checkpoint_payload, validate_deadline_ms,
                       validate_priority, validate_tenant)
 
 
@@ -30,6 +31,12 @@ class QueueRequestPayload:
     deadline_ms: Optional[int] = None
     # content-cache mode (docs/caching.md): "use" | "bypass"
     cache: str = "use"
+    # --- step-granular preemption (docs/preemption.md) ----------------------
+    # checkpoint_id resumes a checkpoint already parked on this worker;
+    # checkpoint carries the serialized state INLINE (resume-on-any-
+    # worker: the state rides the same queue transport as the prompt)
+    checkpoint_id: Optional[str] = None
+    checkpoint: Optional[dict] = None
 
 
 def parse_queue_request_payload(payload: Any) -> QueueRequestPayload:
@@ -69,6 +76,13 @@ def parse_queue_request_payload(payload: Any) -> QueueRequestPayload:
         deadline_ms = validate_deadline_ms(deadline_ms)
     cache = validate_cache_mode(payload.get("cache", "use"))
 
+    checkpoint_id = payload.get("checkpoint_id")
+    if checkpoint_id is not None:
+        checkpoint_id = validate_checkpoint_id(checkpoint_id)
+    checkpoint = payload.get("checkpoint")
+    if checkpoint is not None:
+        checkpoint = validate_checkpoint_payload(checkpoint)
+
     return QueueRequestPayload(
         prompt=prompt,
         client_id=client_id,
@@ -80,4 +94,6 @@ def parse_queue_request_payload(payload: Any) -> QueueRequestPayload:
         priority=priority,
         deadline_ms=deadline_ms,
         cache=cache,
+        checkpoint_id=checkpoint_id,
+        checkpoint=checkpoint,
     )

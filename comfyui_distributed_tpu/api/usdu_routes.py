@@ -206,7 +206,24 @@ def register(router, controller) -> None:
         job_id = request.query.get("job_id", "")
         if not job_id:
             raise ValidationError("missing job_id query param", field="job_id")
-        return web.json_response(await store.job_status(job_id))
+        status = await store.job_status(job_id)
+        if not status.get("exists") and not status.get("finished"):
+            # not a tile/collector job: maybe a prompt-queue job — a
+            # PREEMPTED one reports its parked position (docs/
+            # preemption.md), e.g. "preempted@12/200"
+            entry = controller.queue.history.get(job_id)
+            if entry is not None:
+                status = {"exists": True, "kind": "prompt",
+                          "status": entry.get("status")}
+                if entry.get("status") == "preempted":
+                    status["preempted"] = (
+                        f"preempted@{entry.get('preempted_at_step')}"
+                        f"/{entry.get('total_steps')}")
+                    status["checkpoint_id"] = entry.get("checkpoint_id")
+                    status["reason"] = entry.get("reason")
+                elif entry.get("preemptions"):
+                    status["preemptions"] = entry["preemptions"]
+        return web.json_response(status)
 
     async def queue_status(request):
         job_id = request.match_info["job_id"]

@@ -28,6 +28,11 @@ import dataclasses
 import time
 from typing import Callable, Optional
 
+# history statuses that mean "still in flight" (step-granular
+# preemption, docs/preemption.md) — never copied to a waiter
+NON_TERMINAL_STATUSES = frozenset(
+    {"preempted", "resume_retry", "resume_scratch"})
+
 
 @dataclasses.dataclass
 class _Waiter:
@@ -100,6 +105,10 @@ class InflightCoalescer:
             flight = self._flights[fp]
             entry = history.get(flight.leader_id)
             if entry is None:
+                continue
+            if entry.get("status") in NON_TERMINAL_STATUSES:
+                # a preempted/resuming leader is still in flight — its
+                # waiters settle when it reaches a REAL terminal row
                 continue
             del self._flights[fp]
             width = 1 + len(flight.waiters)

@@ -54,6 +54,13 @@ class Controller:
         from .cache import build_cache_manager
 
         self.cache = build_cache_manager()
+        # step-granular preemption (cluster/preemption.py): resumable
+        # denoise segments + latent checkpoint parking; None under
+        # CDT_PREEMPT=0 (monolithic sampler programs)
+        from .preemption import build_preemption
+
+        self.preemption = build_preemption(self.queue)
+        self.queue.preemption = self.preemption
         # serving front door (cluster/frontdoor): admission control +
         # cross-user microbatching in front of the queue; None under
         # CDT_FRONTDOOR=0 (the API layer then serves the legacy path)

@@ -104,9 +104,16 @@ class ElasticManager:
         self.controller = controller
         self.registry = DRAIN
         manager = get_worker_manager(controller.config_path)
+
+        def _preempt_for_drain():
+            pre = getattr(controller, "preemption", None)
+            return (pre.preempt_executing("drain")
+                    if pre is not None else None)
+
         self.coordinator = DrainCoordinator(
             controller.store,
-            process_stopper=manager.stop_worker)
+            process_stopper=manager.stop_worker,
+            preempter=_preempt_for_drain)
         factory = _load_provider_factory()
         if factory is not None:
             self.provider: ScaleProvider = factory(controller)

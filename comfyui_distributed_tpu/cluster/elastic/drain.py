@@ -48,13 +48,19 @@ class DrainCoordinator:
 
     def __init__(self, store, *, registry: DrainRegistry = DRAIN,
                  process_stopper: Optional[Callable[[str], bool]] = None,
-                 poll_interval: float = 0.25):
+                 poll_interval: float = 0.25,
+                 preempter: Optional[Callable[[], object]] = None):
         self.store = store
         self.registry = registry
         # stops the local managed process after handback (process
         # manager hook; None for externally-managed / remote workers)
         self.process_stopper = process_stopper
         self.poll_interval = poll_interval
+        # step-granular preemption hook (cluster/preemption.py): a drain
+        # asks the running denoise loop to checkpoint at its next
+        # segment boundary instead of waiting it out — scale-downs free
+        # the slot in one segment, not one job (docs/preemption.md)
+        self.preempter = preempter
         self._tasks: dict[str, asyncio.Task] = {}
         # worker_id → last drain report (kept after completion for the
         # status surface; bounded by fleet size)
@@ -133,6 +139,15 @@ class DrainCoordinator:
     async def _drain(self, wid: str, deadline_s: float,
                      stop_process: bool) -> None:
         report = self.reports[wid]
+        if self.preempter is not None:
+            try:
+                preempted = self.preempter()
+                if preempted:
+                    report["preempted_prompt"] = preempted
+            except Exception as e:  # noqa: BLE001 — the drain proceeds
+                # on the deadline path regardless; preemption only
+                # makes it faster
+                report["preempt_error"] = str(e)
         report["held_at_start"] = await self.store.worker_held_tasks(wid)
         # the registry's deadline (stamped by mark_draining) is the ONE
         # source of truth — it is what the status surface reports, so

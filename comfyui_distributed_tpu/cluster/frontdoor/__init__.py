@@ -157,7 +157,15 @@ class FrontDoor:
 
         deadline_at = (time.monotonic() + payload.deadline_ms / 1000.0
                        if payload.deadline_ms else None)
-        cls: Classification = classify(payload.prompt)
+        checkpoint_id = self._resolve_resume(payload)
+        if checkpoint_id is not None:
+            # resume request (docs/preemption.md): the run continues
+            # mid-ladder from a parked checkpoint — a solo trajectory by
+            # definition, so it bypasses coalescing/batching and rides
+            # the orchestration path with its checkpoint id
+            cls = Classification(batchable=False, reason="resume")
+        else:
+            cls = classify(payload.prompt)
         self._classified[cls.reason] = self._classified.get(cls.reason, 0) + 1
 
         if not cls.batchable:
@@ -172,7 +180,8 @@ class FrontDoor:
                 trace_id=payload.trace_id,
                 queue_meta={"tenant": payload.tenant,
                             "priority": payload.priority,
-                            "deadline_at": deadline_at},
+                            "deadline_at": deadline_at,
+                            "checkpoint_id": checkpoint_id},
             )
             return FrontDoorResult(
                 outcome=decision.outcome, prompt_id=result.prompt_id,
@@ -231,6 +240,16 @@ class FrontDoor:
                                batched=True, reason=cls.reason)
 
     # --- plumbing -----------------------------------------------------------
+
+    def _resolve_resume(self, payload) -> "str | None":
+        """Checkpoint id this request resumes from (resume-on-any-
+        worker: an inline wire-form checkpoint rode the same queue
+        transport as the prompt). One shared policy with the legacy
+        route — ``cluster.preemption.resolve_resume``."""
+        from ..preemption import resolve_resume
+
+        return resolve_resume(getattr(self.queue, "preemption", None),
+                              payload.checkpoint_id, payload.checkpoint)
 
     def _enqueue_group(self, members: list, sampler_node_ids: dict) -> None:
         self.queue.enqueue_batch(members, sampler_node_ids)

@@ -1,0 +1,305 @@
+"""Step-granular preemption: strict interactive latency under mixed load.
+
+The front door has priority classes but — before ISSUE 14 — no
+preemption: a 200-step video-class job held its slot end-to-end and an
+interactive request behind it ate the full residual. The denoise loop
+has natural preemption points at step boundaries, so the serving sampler
+runs in resumable K-step segments (``diffusion/pipeline.py
+generate_preemptible``) and THIS controller decides, between segments,
+whether the running job should yield:
+
+- **priority**: a strictly higher priority class is waiting in the
+  prompt queue (evaluated on every enqueue and execution start);
+- **drain**: the worker is leaving the fleet (``cluster/elastic`` wires
+  the drain coordinator to :meth:`preempt_executing`) — a scale-down no
+  longer waits out a long job;
+- **manual**: an operator asked via the API.
+
+A preempted job parks its :class:`~..diffusion.checkpoint.LatentCheckpoint`
+in the :class:`~..diffusion.checkpoint.CheckpointStore` and is requeued
+at its original queue position — intentional departure in the PR 7
+handback sense: **no poison count, no breaker evidence, nothing lost**.
+Resume happens on the next dequeue (this worker) or, via the checkpoint
+routes / an inline ``checkpoint`` queue payload, on ANY worker with the
+same dp width — bit-identically, per the determinism invariants. Restore
+failures are bounded: ``CDT_PREEMPT_RESUME_RETRIES`` attempts, then the
+checkpoint dead-letters and the job restarts from scratch.
+
+Starvation guard: a job preempted ``CDT_PREEMPT_MAX`` times stops
+yielding to priority traffic (drain still preempts — the slot must
+free). See ``docs/preemption.md``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..diffusion.checkpoint import CheckpointStore, LatentCheckpoint
+from ..lint.lockorder import tracked_lock
+from ..utils import constants
+from ..utils.logging import log
+
+
+def preempt_enabled() -> bool:
+    return constants.PREEMPT.get()
+
+
+def _priority_rank(priority: str) -> int:
+    # the ONE rank definition — queue ordering and preemption triggering
+    # must never disagree about what "higher priority" means
+    from .runtime import _priority_rank as rank
+
+    return rank(priority)
+
+
+class PreemptionToken:
+    """Per-execution handle the sampler node reads from the execution
+    context (hidden input ``preemption``): the segment length, the
+    checkpoint to resume from (if any), and the cheap between-segments
+    ``should_preempt()`` probe (called from the graph-exec thread)."""
+
+    def __init__(self, controller: "PreemptionController", job,
+                 resume: Optional[LatentCheckpoint],
+                 preemptible: bool):
+        self._controller = controller
+        self._job = job
+        self.resume = resume
+        self.preemptible = preemptible
+        self.segment_steps = constants.PREEMPT_SEGMENT_STEPS.get()
+        # set by the sampler node when it actually feeds ``resume`` into
+        # the segmented path — a graph that ignores the token (img2img,
+        # ControlNet) must not be reported as a successful resume
+        self.resume_consumed = False
+
+    def should_preempt(self) -> Optional[str]:
+        reason = self._controller.requested_reason(self._job.prompt_id)
+        if reason is None:
+            return None
+        if not self.preemptible and reason != "drain":
+            # starvation guard: past CDT_PREEMPT_MAX the job runs to
+            # completion — except for a drain, where the slot MUST free
+            return None
+        return reason
+
+
+class PreemptionController:
+    """One per controller; bound to the prompt queue by
+    ``queue.preemption = controller`` (``cluster/controller.py``)."""
+
+    def __init__(self, queue, store: Optional[CheckpointStore] = None):
+        self.queue = queue
+        self.store = store if store is not None else CheckpointStore()
+        self._lock = tracked_lock("preemption", reentrant=True)
+        # prompt_id -> reason; read between segments from the exec thread
+        self._requests: dict[str, str] = {}
+        # prompt_ids currently parked mid-denoise (gauge bookkeeping)
+        self._parked: set[str] = set()
+        self.counts = {"preempted": 0, "resumed": 0, "restore_failed": 0,
+                       "dead_lettered": 0, "preempt_requests": 0}
+
+    # --- execution lifecycle (called by PromptQueue) ------------------------
+
+    def begin(self, job) -> Optional[PreemptionToken]:
+        """Token for a starting solo job (None = run monolithic: knob
+        off, or a batch group — those are one compiled program)."""
+        if not preempt_enabled() or job.group is not None:
+            return None
+        resume = None
+        if job.checkpoint_id:
+            resume = self.store.get(job.checkpoint_id)
+            if resume is None:
+                # lost/corrupt checkpoint: LOUD, then from scratch —
+                # never a wrong byte, never a hang
+                log(f"preemption: checkpoint {job.checkpoint_id} for "
+                    f"{job.prompt_id} is gone — restarting from scratch")
+                job.checkpoint_id = None
+        preemptible = job.preempt_count < constants.PREEMPT_MAX.get()
+        return PreemptionToken(self, job, resume, preemptible)
+
+    def end(self, job) -> None:
+        with self._lock:
+            self._requests.pop(job.prompt_id, None)
+
+    def resolve_success(self, job) -> None:
+        """Terminal success: the parked state (if any) is spent."""
+        if job.checkpoint_id:
+            self.store.mark_restored(job.checkpoint_id)
+            if self.store.drop(job.checkpoint_id):
+                with self._lock:
+                    self.counts["resumed"] += 1
+            job.checkpoint_id = None
+        self._unpark(job.prompt_id)
+
+    def discard(self, job) -> None:
+        """A parked job left the queue WITHOUT resuming (interrupt,
+        deadline expiry): release its checkpoint and gauge slot — a
+        dropped job must not leak store bytes or a forever-nonzero
+        ``cdt_jobs_preempted``."""
+        if getattr(job, "checkpoint_id", None):
+            self.store.drop(job.checkpoint_id)
+            job.checkpoint_id = None
+        self._unpark(job.prompt_id)
+
+    # --- preemption verdicts ------------------------------------------------
+
+    def requested_reason(self, prompt_id: str) -> Optional[str]:
+        with self._lock:
+            return self._requests.get(prompt_id)
+
+    def reevaluate(self) -> None:
+        """Priority policy, run on every queue mutation (enqueue,
+        execution start): preempt the running solo job iff a STRICTLY
+        higher priority class is waiting."""
+        job = getattr(self.queue, "executing_job", None)
+        if job is None or job.group is not None:
+            return
+        rank_exec = _priority_rank(job.priority)
+        best = self.queue.pending_best_rank()
+        if best is None or best >= rank_exec:
+            return
+        self._request(job.prompt_id, "priority")
+
+    def preempt_executing(self, reason: str = "manual") -> Optional[str]:
+        """Unconditional request against the running solo job (drain /
+        operator path). Returns the targeted prompt_id or None."""
+        job = getattr(self.queue, "executing_job", None)
+        if job is None or job.group is not None:
+            return None
+        self._request(job.prompt_id, reason)
+        return job.prompt_id
+
+    def _request(self, prompt_id: str, reason: str) -> None:
+        with self._lock:
+            if self._requests.get(prompt_id) == reason:
+                return
+            # drain outranks priority (the slot must free either way,
+            # and drain bypasses the starvation guard)
+            if self._requests.get(prompt_id) == "drain":
+                return
+            self._requests[prompt_id] = reason
+            self.counts["preempt_requests"] += 1
+
+    # --- parking / resume bookkeeping ---------------------------------------
+
+    def park(self, job, ckpt: LatentCheckpoint, reason: str) -> str:
+        """A job yielded at a segment boundary: park the checkpoint,
+        count the preemption, mark the job for resume."""
+        ckpt.meta.setdefault("prompt_id", job.prompt_id)
+        if job.checkpoint_id:
+            # re-preempted after a resume: the superseded (already
+            # consumed) checkpoint must not leak in the store
+            self.store.drop(job.checkpoint_id)
+        cid = self.store.park(ckpt)
+        job.checkpoint_id = cid
+        job.preempt_count += 1
+        with self._lock:
+            self._requests.pop(job.prompt_id, None)
+            self._parked.add(job.prompt_id)
+            self.counts["preempted"] += 1
+        self._telemetry_preempted(reason)
+        log(f"preempted {job.prompt_id} at step {ckpt.step}/"
+            f"{ckpt.total_steps} ({reason}) -> checkpoint {cid}")
+        return cid
+
+    def restore_failed(self, job, error: str) -> str:
+        """A resume attempt failed. Returns ``"retry"`` (requeue with
+        the checkpoint) or ``"scratch"`` (checkpoint dead-lettered —
+        requeue without it)."""
+        job.resume_attempts += 1
+        with self._lock:
+            self.counts["restore_failed"] += 1
+        attempts = self.store.record_restore_failure(
+            job.checkpoint_id or "?", error)
+        if (job.checkpoint_id is None
+                or attempts >= self.store.resume_retries):
+            with self._lock:
+                self.counts["dead_lettered"] += 1
+            job.checkpoint_id = None
+            job.resume_attempts = 0
+            self._unpark(job.prompt_id)
+            return "scratch"
+        return "retry"
+
+    def _unpark(self, prompt_id: str) -> None:
+        with self._lock:
+            self._parked.discard(prompt_id)
+        self._export_gauge()
+
+    def _telemetry_preempted(self, reason: str) -> None:
+        try:
+            from .. import telemetry
+            from ..telemetry import metrics as _tm
+
+            if telemetry.enabled():
+                _tm.PREEMPTIONS_TOTAL.labels(reason=reason).inc()
+        except Exception:  # noqa: BLE001 — telemetry is never load-bearing
+            pass
+        self._export_gauge()
+
+    def _export_gauge(self) -> None:
+        try:
+            from .. import telemetry
+            from ..telemetry import metrics as _tm
+
+            if telemetry.enabled():
+                with self._lock:
+                    n = len(self._parked)
+                _tm.JOBS_PREEMPTED.set(n)
+        except Exception:  # noqa: BLE001 — telemetry is never load-bearing
+            pass
+
+    # --- surfaces -----------------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            counts = dict(self.counts)
+            requests = dict(self._requests)
+            parked = sorted(self._parked)
+        return {
+            "enabled": preempt_enabled(),
+            "segment_steps": constants.PREEMPT_SEGMENT_STEPS.get(),
+            "parked_jobs": parked,
+            "requests": requests,
+            "store": self.store.stats(),
+            **counts,
+        }
+
+
+def resolve_resume(preemption: Optional[PreemptionController],
+                   checkpoint_id: Optional[str],
+                   checkpoint_payload: Optional[dict]) -> Optional[str]:
+    """The ONE resume-import policy both queue entrances share (front
+    door and the CDT_FRONTDOOR=0 legacy route): returns the checkpoint
+    id to resume from, importing an inline wire-form checkpoint first
+    (checksum-verified). Loud errors — a resume request against a
+    preemption-disabled worker, or a corrupt inline payload, must never
+    silently run from scratch."""
+    if checkpoint_id is None and checkpoint_payload is None:
+        return None
+    from ..utils.exceptions import ValidationError
+
+    if preemption is None:
+        raise ValidationError(
+            "this worker has preemption disabled (CDT_PREEMPT=0); it "
+            "cannot resume checkpoints", field="checkpoint_id")
+    cid = checkpoint_id
+    if checkpoint_payload is not None:
+        from ..diffusion.checkpoint import (CheckpointError,
+                                            LatentCheckpoint)
+
+        try:
+            ckpt = LatentCheckpoint.from_payload(checkpoint_payload)
+        except CheckpointError as e:
+            raise ValidationError(str(e), field="checkpoint")
+        cid = preemption.store.park(ckpt)
+    return cid
+
+
+def build_preemption(queue) -> Optional[PreemptionController]:
+    """Controller hook (mirrors build_frontdoor/build_cache_manager):
+    the preemption controller, or None under CDT_PREEMPT=0."""
+    if not preempt_enabled():
+        log("preemption disabled (CDT_PREEMPT=0) — monolithic sampler "
+            "programs")
+        return None
+    return PreemptionController(queue)

@@ -60,45 +60,80 @@ class PromptJob:
     # this member from the result cache (it still fills it)
     fingerprint: str | None = None
     cache_mode: str = "use"
+    # --- step-granular preemption (cluster/preemption.py) -------------------
+    # checkpoint_id: parked LatentCheckpoint to resume from (set when
+    # this job was preempted, or by a resume request through the front
+    # door); preempt_count bounds yielding (CDT_PREEMPT_MAX);
+    # resume_attempts bounds restore retries before dead-letter
+    checkpoint_id: str | None = None
+    preempt_count: int = 0
+    resume_attempts: int = 0
+    # stable arrival order within a priority class (assigned by _put;
+    # a preempted job keeps its original position on requeue)
+    seq: int = 0
 
     def expired(self, now: float) -> bool:
         return self.deadline_at is not None and now >= self.deadline_at
 
 
 class PromptQueue:
-    """FIFO prompt queue with a single execution worker.
+    """Priority-ordered prompt queue with a single execution worker.
 
     Execution is serialized per controller (one mesh, one program at a
     time — the TPU analogue of one ComfyUI executor per GPU process).
+    Dequeue order is strict priority class, resumes-first within a
+    class, then arrival order — the scheduling half of step-granular
+    preemption (``cluster/preemption.py``): preempting a low-priority
+    job is only useful if the waiting high-priority job actually runs
+    next, and a preempted job's parked work resumes before fresh
+    arrivals of its own class.
     """
 
     def __init__(self, context_factory: Callable[[], dict] | None = None):
+        import itertools
         import threading
 
-        self._queue: asyncio.Queue[PromptJob] = asyncio.Queue()
+        # jobs live in _pending (priority-selected at dequeue); _wake is
+        # the consumer's wakeup channel — one token per _put, tokens may
+        # outnumber jobs after interrupt()/expiry drains, the consumer
+        # just re-checks
+        self._pending: list[PromptJob] = []
+        self._wake: asyncio.Queue[None] = asyncio.Queue()
+        self._seq = itertools.count()
         self._context_factory = context_factory or (lambda: {})
         self._pool = ThreadPoolExecutor(max_workers=1, thread_name_prefix="graph-exec")
         self._task: Optional[asyncio.Task] = None
+        self._sweep_task: Optional[asyncio.Task] = None
         self._executing: Optional[str] = None
+        self.executing_job: Optional[PromptJob] = None
         self._interrupt = threading.Event()
         self.history: dict[str, dict] = {}
         self._job_done_callbacks: list[Callable[[], None]] = []
         self._pending_by_priority: dict[str, int] = {}
+        # step-granular preemption controller (cluster/preemption.py),
+        # attached by the host controller; None = monolithic execution
+        self.preemption = None
 
     # --- lifecycle ---------------------------------------------------------
 
     def start(self) -> None:
         if self._task is None or self._task.done():
             self._task = asyncio.ensure_future(self._run())
+        sweep_s = constants.PREEMPT_SWEEP_S.get()
+        if sweep_s > 0 and (self._sweep_task is None
+                            or self._sweep_task.done()):
+            self._sweep_task = asyncio.ensure_future(
+                self._sweep_loop(sweep_s))
 
     async def stop(self) -> None:
-        if self._task is not None:
-            self._task.cancel()
-            try:
-                await self._task
-            except asyncio.CancelledError:
-                pass
-            self._task = None
+        for task in (self._task, self._sweep_task):
+            if task is not None:
+                task.cancel()
+                try:
+                    await task
+                except asyncio.CancelledError:
+                    pass
+        self._task = self._sweep_task = None
         self._pool.shutdown(wait=False, cancel_futures=True)
 
     def add_job_done_callback(self, cb: Callable[[], None]) -> None:
@@ -115,10 +150,13 @@ class PromptQueue:
                 parent_span_id: str | None = None,
                 tenant: str = constants.DEFAULT_TENANT,
                 priority: str = constants.DEFAULT_PRIORITY,
-                deadline_at: float | None = None) -> tuple[str, list]:
+                deadline_at: float | None = None,
+                checkpoint_id: str | None = None) -> tuple[str, list]:
         """Validate + enqueue; returns (prompt_id, node_errors). Mirrors
         ``queue_prompt_payload``: validation errors reject the prompt
-        before it reaches the queue (``utils/async_helpers.py:108-149``)."""
+        before it reaches the queue (``utils/async_helpers.py:108-149``).
+        ``checkpoint_id`` resumes a parked latent checkpoint
+        (docs/preemption.md) — the sampler picks up mid-ladder."""
         prompt = strip_meta(prompt)
         errors = validate_prompt(prompt)
         if errors:
@@ -126,7 +164,8 @@ class PromptQueue:
         prompt_id = f"p_{int(time.time()*1000)}_{secrets.token_hex(3)}"
         job = PromptJob(prompt_id, prompt, client_id, trace_id,
                         parent_span_id=parent_span_id, tenant=tenant,
-                        priority=priority, deadline_at=deadline_at)
+                        priority=priority, deadline_at=deadline_at,
+                        checkpoint_id=checkpoint_id)
         self._put(job)
         return prompt_id, []
 
@@ -148,14 +187,49 @@ class PromptQueue:
         return [m.prompt_id for m in members]
 
     def _put(self, job: PromptJob) -> None:
-        self._queue.put_nowait(job)
+        if job.seq == 0:
+            job.seq = next(self._seq) + 1
+        self._pending.append(job)
+        self._wake.put_nowait(None)
         for prio, n in _job_members(job):
             self._pending_by_priority[prio] = \
                 self._pending_by_priority.get(prio, 0) + n
         if telemetry.enabled():
             _tm.PROMPT_QUEUE_DEPTH.set(self.queue_remaining)
             self._export_priority_depth()
+        if self.preemption is not None:
+            # a higher class arriving behind a running low-priority job
+            # is THE preemption trigger (cluster/preemption.py)
+            self.preemption.reevaluate()
         self.start()
+
+    def _pop_next(self) -> Optional[PromptJob]:
+        """Highest-priority pending job: class rank, resumes before
+        fresh work within a class, then arrival order."""
+        if not self._pending:
+            return None
+        job = min(self._pending, key=_dequeue_key)
+        self._pending.remove(job)
+        return job
+
+    def _discard_parked(self, job: PromptJob) -> None:
+        """A job dropped from the queue (interrupt, deadline expiry)
+        releases its parked checkpoint — store bytes and the
+        cdt_jobs_preempted gauge must not leak."""
+        if self.preemption is None:
+            return
+        for m in (job.group or [job]):
+            if getattr(m, "checkpoint_id", None):
+                self.preemption.discard(m)
+
+    def pending_best_rank(self) -> Optional[int]:
+        """Best (lowest) priority rank waiting — the preemption
+        controller's trigger signal. Group jobs count at their best
+        member's class."""
+        ranks = [min(_priority_rank(m.priority)
+                     for m in (job.group or [job]))
+                 for job in self._pending]
+        return min(ranks) if ranks else None
 
     def _job_finished_accounting(self, job: PromptJob) -> None:
         for prio, n in _job_members(job):
@@ -170,7 +244,57 @@ class PromptQueue:
 
     @property
     def queue_remaining(self) -> int:
-        return self._queue.qsize() + (1 if self._executing else 0)
+        return len(self._pending) + (1 if self._executing else 0)
+
+    def expire_stale(self, now: float | None = None) -> int:
+        """Terminal-expire queued jobs whose deadline has passed — the
+        sweep half of the freshness contract: a client's deadline is
+        honored PROMPTLY, not only when a dispatch next touches the job
+        (docs/preemption.md). Group jobs expire member-by-member; the
+        job itself leaves the queue once every member is stale. Returns
+        the number of members expired."""
+        if now is None:
+            now = time.monotonic()
+        expired = 0
+        for job in list(self._pending):
+            members = job.group or [job]
+            # a "preempted"/"resume_*" history row is NON-terminal — a
+            # parked job waiting to resume past its deadline must sweep
+            # exactly like a fresh one (its checkpoint is released)
+            stale = [m for m in members if m.expired(now)
+                     and self.history.get(m.prompt_id, {}).get("status")
+                     not in TERMINAL_STATUSES]
+            if not stale:
+                continue
+            if len(stale) < len(members):
+                continue     # partially-stale group: execution expires
+                #              the stale members individually
+            self._pending.remove(job)
+            for m in members:
+                self.history[m.prompt_id] = {
+                    "status": "expired", "duration": 0.0,
+                    "error": "deadline_ms elapsed while queued",
+                }
+                expired += 1
+                log(f"prompt {m.prompt_id} expired in queue (sweep)")
+            self._discard_parked(job)
+            self._job_finished_accounting(job)
+            if telemetry.enabled():
+                for _ in members:
+                    _tm.PROMPTS_TOTAL.labels(status="expired").inc()
+                _tm.PROMPT_QUEUE_DEPTH.set(self.queue_remaining)
+        if expired:
+            for cb in self._job_done_callbacks:
+                try:
+                    cb()
+                except Exception:  # noqa: BLE001 — observer isolation
+                    pass
+        return expired
+
+    async def _sweep_loop(self, interval_s: float) -> None:
+        while True:
+            await asyncio.sleep(interval_s)
+            self.expire_stale()
 
     def interrupt(self) -> int:
         """Drop pending prompts and flag the running one (checked between
@@ -178,15 +302,13 @@ class PromptQueue:
         ``web/workerUtils.js:73-95``). Returns number of dropped jobs
         (batch members count individually)."""
         dropped = 0
-        while True:
-            try:
-                job = self._queue.get_nowait()
-            except asyncio.QueueEmpty:
-                break
+        for job in list(self._pending):
+            self._pending.remove(job)
             for member in (job.group or [job]):
                 self.history[member.prompt_id] = {"status": "interrupted",
                                                   "duration": 0.0}
                 dropped += 1
+            self._discard_parked(job)
             self._job_finished_accounting(job)
         if self._executing:
             self._interrupt.set()
@@ -211,8 +333,12 @@ class PromptQueue:
     async def _run(self) -> None:
         loop = asyncio.get_running_loop()
         while True:
-            job = await self._queue.get()
+            await self._wake.get()
+            job = self._pop_next()
+            if job is None:
+                continue     # interrupt()/sweep drained it first
             self._executing = job.prompt_id
+            self.executing_job = job
             started = time.monotonic()
             self._interrupt.clear()
             statuses: list[str] = []
@@ -222,17 +348,34 @@ class PromptQueue:
                         _tm.QUEUE_WAIT_SECONDS.labels(
                             priority=m.priority).observe(
                                 started - m.enqueued_at)
+                if self.preemption is not None:
+                    # a strictly-higher class may already be waiting when
+                    # a lower job starts (it was the best available)
+                    self.preemption.reevaluate()
                 if job.group is not None:
                     statuses = await self._run_group(loop, job, started)
                 else:
                     statuses = [await self._run_solo(loop, job, started)]
             finally:
                 self._executing = None
+                self.executing_job = None
+                if self.preemption is not None:
+                    self.preemption.end(job)
                 self._job_finished_accounting(job)
                 if telemetry.enabled():
-                    for status in statuses:
+                    # cdt_prompts_total counts TERMINAL statuses only;
+                    # a preempted/resume-retrying dispatch is the same
+                    # logical prompt coming back — preemptions have
+                    # their own counter (cdt_preemptions_total), and a
+                    # partial segment batch must not skew the
+                    # end-to-end duration histogram
+                    terminal = [s for s in statuses
+                                if s in TERMINAL_STATUSES]
+                    for status in terminal:
                         _tm.PROMPTS_TOTAL.labels(status=status).inc()
-                    _tm.PROMPT_SECONDS.observe(time.monotonic() - started)
+                    if terminal and len(terminal) == len(statuses):
+                        _tm.PROMPT_SECONDS.observe(
+                            time.monotonic() - started)
                     _tm.PROMPT_QUEUE_DEPTH.set(self.queue_remaining)
                 for cb in self._job_done_callbacks:
                     try:
@@ -247,11 +390,20 @@ class PromptQueue:
                 "error": "deadline_ms elapsed before execution",
             }
             log(f"prompt {job.prompt_id} expired in queue")
+            self._discard_parked(job)
             return "expired"
+        from ..diffusion.checkpoint import (CheckpointRestoreError,
+                                            PreemptedError)
+
+        token = None
         try:
             context = dict(self._context_factory())
             context["interrupt_event"] = self._interrupt
             context["prompt_id"] = job.prompt_id
+            if self.preemption is not None:
+                token = self.preemption.begin(job)
+                if token is not None:
+                    context["preemption"] = token
             executor = GraphExecutor(context)
             # the execution span adopts the orchestration trace id and
             # parents onto the master's dispatch span (X-CDT-Trace) —
@@ -277,16 +429,79 @@ class PromptQueue:
                     if _is_terminal(job.prompt, nid)
                 },
             }
+            if job.preempt_count:
+                # resumed-and-finished: the record says so (operators
+                # correlate p99 outliers with preemption history)
+                self.history[job.prompt_id]["preemptions"] = \
+                    job.preempt_count
+            if self.preemption is not None:
+                if (job.checkpoint_id and token is not None
+                        and token.resume is not None
+                        and not token.resume_consumed):
+                    # the graph never fed the checkpoint to a sampler
+                    # (img2img / ControlNet path): the run is a success
+                    # but it was NOT a resume — say so loudly instead
+                    # of counting a phantom resume
+                    log(f"prompt {job.prompt_id} IGNORED its resume "
+                        f"checkpoint {job.checkpoint_id} (graph has no "
+                        "preemptible sampler) — ran from scratch")
+                    self.history[job.prompt_id]["resume_ignored"] = True
+                    self.preemption.discard(job)
+                else:
+                    self.preemption.resolve_success(job)
             trace_info(job.trace_id,
                        f"prompt {job.prompt_id} done in "
                        f"{self.history[job.prompt_id]['duration']:.2f}s")
             return "success"
+        except PreemptedError as e:
+            # intentional departure at a segment boundary: park the
+            # checkpoint, requeue at the ORIGINAL queue position (seq is
+            # kept), and record a non-terminal marker — clients polling
+            # history keep waiting, exactly like a still-queued job. No
+            # poison count, no breaker evidence, nothing lost.
+            cid = self.preemption.park(job, e.checkpoint, e.reason)
+            self.history[job.prompt_id] = {
+                "status": "preempted",
+                "preempted_at_step": e.checkpoint.step,
+                "total_steps": e.checkpoint.total_steps,
+                "checkpoint_id": cid,
+                "reason": e.reason,
+                "duration": time.monotonic() - started,
+            }
+            # fresh wait clock: cdt_queue_wait_seconds on the re-dispatch
+            # must measure the RE-queue wait, not fold in the segments
+            # already executed since the original enqueue
+            job.enqueued_at = time.monotonic()
+            # clear executing_job BEFORE the requeue: _put's reevaluate
+            # would otherwise see the just-parked job as still running
+            # and register a spurious second preempt request against it
+            self.executing_job = None
+            self._put(job)
+            return "preempted"
+        except CheckpointRestoreError as e:
+            # bounded resume retries: a checkpoint that repeatedly fails
+            # restore dead-letters (forensics kept) and the job restarts
+            # from scratch — it must never loop (docs/preemption.md)
+            verdict = self.preemption.restore_failed(job, str(e))
+            log(f"prompt {job.prompt_id} checkpoint restore failed "
+                f"({e}) -> {verdict}")
+            self.history[job.prompt_id] = {
+                "status": "resume_retry" if verdict == "retry"
+                else "resume_scratch",
+                "error": str(e),
+                "duration": time.monotonic() - started,
+            }
+            job.enqueued_at = time.monotonic()
+            self.executing_job = None
+            self._put(job)
+            return "resume_failed"
         except InterruptedError:
             self.history[job.prompt_id] = {
                 "status": "interrupted",
                 "duration": time.monotonic() - started,
             }
             log(f"prompt {job.prompt_id} interrupted")
+            self._discard_parked(job)
             return "interrupted"
         except Exception as e:  # noqa: BLE001 — job isolation barrier
             self.history[job.prompt_id] = {
@@ -294,6 +509,7 @@ class PromptQueue:
                 "duration": time.monotonic() - started,
             }
             log(f"prompt {job.prompt_id} failed: {e}")
+            self._discard_parked(job)
             return "error"
 
     async def _run_group(self, loop, job: PromptJob,
@@ -366,11 +582,25 @@ class PromptQueue:
         return statuses
 
 
+# one terminal-status vocabulary for every history observer (pollers,
+# the sweep, the coalescer via its NON_TERMINAL mirror)
+TERMINAL_STATUSES = frozenset({"success", "error", "interrupted",
+                               "expired"})
+
+
 def _priority_rank(priority: str) -> int:
     try:
         return constants.PRIORITY_CLASSES.index(priority)
     except ValueError:
         return len(constants.PRIORITY_CLASSES)
+
+
+def _dequeue_key(job: PromptJob) -> tuple:
+    """Dequeue order: priority class first (group jobs at their best
+    member's class), parked resumes before fresh work within a class
+    (the handback front-of-queue idiom), then arrival order."""
+    rank = min(_priority_rank(m.priority) for m in (job.group or [job]))
+    return (rank, 0 if job.checkpoint_id else 1, job.seq)
 
 
 def _job_members(job: PromptJob) -> "list[tuple[str, int]]":

@@ -1,0 +1,538 @@
+"""Latent checkpoints: the exact state of a denoise loop between segments.
+
+A :class:`LatentCheckpoint` captures the FULL sampler carry at a segment
+boundary (``diffusion/samplers.SamplerProgram``): the latent, every
+multistep history slot (dpmpp_2m/3m_sde carry D-history and h-history,
+uni_pc carries four state-shaped slots), the step cursor, and the run's
+identity metadata (sampler, spec geometry, seed, dp width). Because the
+samplers fold the key by GLOBAL step index and the carry round-trips
+through host numpy bit-exactly, a resumed run — on this worker or any
+other with the same mesh width — is bit-identical to an uninterrupted
+one (tested in ``tests/test_checkpoint.py``).
+
+Wire format: one ``.npz`` payload (header JSON + carry leaves) with a
+SHA-256 recorded next to it. Every load re-checksums; a mismatch is
+LOUD, the entry is dropped, and the caller recomputes — the
+``cluster/cache/store.py`` corruption contract applied to checkpoints.
+``to_payload()`` is the JSON-safe form that rides the existing
+dispatch transport (``POST /distributed/queue`` / the checkpoint
+routes).
+
+The :class:`CheckpointStore` is the parking lot: a byte-capped in-memory
+LRU tier plus an optional persisted tier (``CDT_CKPT_DIR``, atomic
+tmp+replace writes, ``utils/jsonio`` index). Restore failures are
+bounded: past ``CDT_PREEMPT_RESUME_RETRIES`` attempts the entry moves to
+the dead-letter list (forensics survive) and the job restarts from
+scratch instead of looping.
+"""
+
+from __future__ import annotations
+
+import base64
+import dataclasses
+import hashlib
+import io
+import json
+import os
+import time
+from collections import OrderedDict
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+from ..lint.lockorder import tracked_lock
+from ..utils.jsonio import atomic_write_json, read_json
+from ..utils.logging import debug_log, log
+
+CHECKPOINT_VERSION = 1
+
+
+class CheckpointError(Exception):
+    """A checkpoint payload is structurally unusable (bad version,
+    checksum mismatch, garbled npz)."""
+
+
+class CheckpointRestoreError(Exception):
+    """A checkpoint exists but cannot resume THIS run (identity
+    mismatch: different sampler/geometry/seed/mesh width, or corrupt
+    state). Counted against the resume-retry bound."""
+
+
+class PreemptedError(Exception):
+    """Raised out of a sampler node when the run was preempted at a
+    segment boundary; carries the parked state."""
+
+    def __init__(self, checkpoint: "LatentCheckpoint", reason: str):
+        super().__init__(
+            f"preempted@{checkpoint.step}/{checkpoint.total_steps} "
+            f"({reason})")
+        self.checkpoint = checkpoint
+        self.reason = reason
+
+
+def checksum(payload: bytes) -> str:
+    return hashlib.sha256(payload).hexdigest()
+
+
+_ID_RE = __import__("re").compile(r"^[A-Za-z0-9._-]{1,128}$")
+
+
+def valid_checkpoint_id(cid) -> bool:
+    """Checkpoint ids name store keys AND files on the persisted tier —
+    anything outside a conservative charset (no path separators, no
+    control bytes) is rejected so a wire payload can never steer
+    ``_entry_path`` outside ``CDT_CKPT_DIR``."""
+    return isinstance(cid, str) and bool(_ID_RE.match(cid))
+
+
+@dataclasses.dataclass
+class LatentCheckpoint:
+    """One parked denoise run. ``step`` is the NEXT global ladder index
+    (``step`` steps are already folded into ``carry``); ``meta`` is the
+    run-identity dict the resume site validates (sampler aside — that
+    has its own field — it carries spec geometry, seed, dp width,
+    prompt id)."""
+
+    sampler: str
+    step: int
+    total_steps: int
+    carry: tuple
+    meta: dict = dataclasses.field(default_factory=dict)
+    checkpoint_id: str = ""
+    version: int = CHECKPOINT_VERSION
+
+    @property
+    def nbytes(self) -> int:
+        return int(sum(np.asarray(a).nbytes for a in self.carry))
+
+    # --- serialization ------------------------------------------------------
+
+    def to_bytes(self) -> bytes:
+        header = {
+            "version": self.version,
+            "sampler": self.sampler,
+            "step": int(self.step),
+            "total_steps": int(self.total_steps),
+            "meta": self.meta,
+            "n_leaves": len(self.carry),
+        }
+        arrays = {f"carry_{i}": np.asarray(a)
+                  for i, a in enumerate(self.carry)}
+        arrays["header"] = np.frombuffer(
+            json.dumps(header, sort_keys=True).encode(), np.uint8)
+        buf = io.BytesIO()
+        np.savez(buf, **arrays)
+        return buf.getvalue()
+
+    @classmethod
+    def from_bytes(cls, payload: bytes,
+                   checkpoint_id: str = "") -> "LatentCheckpoint":
+        try:
+            with np.load(io.BytesIO(payload)) as z:
+                header = json.loads(bytes(z["header"].tobytes()).decode())
+                carry = tuple(z[f"carry_{i}"]
+                              for i in range(int(header["n_leaves"])))
+        except (KeyError, ValueError, OSError, json.JSONDecodeError) as e:
+            raise CheckpointError(f"unreadable checkpoint payload: {e}")
+        if header.get("version") != CHECKPOINT_VERSION:
+            raise CheckpointError(
+                f"checkpoint version {header.get('version')!r} != "
+                f"{CHECKPOINT_VERSION} (refusing a cross-version restore)")
+        return cls(sampler=header["sampler"], step=int(header["step"]),
+                   total_steps=int(header["total_steps"]), carry=carry,
+                   meta=dict(header.get("meta") or {}),
+                   checkpoint_id=checkpoint_id)
+
+    def to_payload(self) -> dict:
+        """JSON-safe wire form (rides the queue/dispatch transport);
+        the sha256 travels WITH the bytes so the receiving worker
+        verifies integrity before parking."""
+        payload = self.to_bytes()
+        return {
+            "version": CHECKPOINT_VERSION,
+            "checkpoint_id": self.checkpoint_id,
+            "sha256": checksum(payload),
+            "data": base64.b64encode(payload).decode("ascii"),
+        }
+
+    @classmethod
+    def from_payload(cls, obj: dict) -> "LatentCheckpoint":
+        if not isinstance(obj, dict) or "data" not in obj:
+            raise CheckpointError("checkpoint payload must be an object "
+                                  "with a base64 'data' field")
+        try:
+            payload = base64.b64decode(obj["data"], validate=True)
+        except Exception as e:  # noqa: BLE001 — any b64 failure is terminal
+            raise CheckpointError(f"bad base64 checkpoint data: {e}")
+        want = obj.get("sha256")
+        if not want:
+            # the checksum is NOT optional: an unverifiable payload is
+            # an unusable payload (docstring contract everywhere else)
+            raise CheckpointError(
+                "checkpoint payload carries no sha256 — refusing an "
+                "unverifiable restore")
+        if checksum(payload) != want:
+            raise CheckpointError(
+                "checkpoint CHECKSUM MISMATCH on the wire — rejecting "
+                "(a flipped bit must never resume a job)")
+        cid = obj.get("checkpoint_id") or ""
+        if cid and not valid_checkpoint_id(cid):
+            # a hostile/garbled embedded id must never reach the
+            # persisted tier's file paths; a fresh content-derived id
+            # is assigned at park time instead
+            cid = ""
+        return cls.from_bytes(payload, checkpoint_id=cid)
+
+    # --- identity -----------------------------------------------------------
+
+    def validate_meta(self, expect: dict) -> None:
+        """Raise :class:`CheckpointRestoreError` unless every key in
+        ``expect`` matches this checkpoint's meta (plus the sampler
+        name when given)."""
+        for k, want in expect.items():
+            have = (self.sampler if k == "sampler"
+                    else self.meta.get(k))
+            if have != want:
+                raise CheckpointRestoreError(
+                    f"checkpoint {self.checkpoint_id or '?'} does not "
+                    f"match this run: {k}={have!r}, expected {want!r}")
+
+
+def _ckpt_metrics():
+    try:
+        from .. import telemetry
+        from ..telemetry import metrics as _tm
+
+        return telemetry.enabled(), _tm
+    except Exception:  # noqa: BLE001 — telemetry is never load-bearing
+        return False, None
+
+
+class _Parked:
+    __slots__ = ("payload", "sha256", "step", "total_steps", "sampler",
+                 "meta", "nbytes", "restore_attempts", "parked_at")
+
+    def __init__(self, payload: bytes, ckpt: LatentCheckpoint):
+        self.payload = payload
+        self.sha256 = checksum(payload)
+        self.step = ckpt.step
+        self.total_steps = ckpt.total_steps
+        self.sampler = ckpt.sampler
+        self.meta = dict(ckpt.meta)
+        self.nbytes = len(payload)
+        self.restore_attempts = 0
+        self.parked_at = time.monotonic()
+
+
+class CheckpointStore:
+    """Byte-capped LRU over serialized checkpoints, with an optional
+    checksummed persisted tier and bounded-restore dead-lettering."""
+
+    def __init__(self, max_bytes: Optional[int] = None,
+                 directory: "Path | str | None" = None,
+                 resume_retries: Optional[int] = None):
+        from ..utils import constants
+
+        self.max_bytes = (constants.CKPT_MEM_BYTES.get()
+                          if max_bytes is None else int(max_bytes))
+        if directory is None:
+            directory = constants.CKPT_DIR.get()
+        self.dir = Path(directory) if directory else None
+        self.resume_retries = (constants.PREEMPT_RESUME_RETRIES.get()
+                               if resume_retries is None
+                               else int(resume_retries))
+        self._entries: "OrderedDict[str, _Parked]" = OrderedDict()
+        self.dead: dict[str, dict] = {}
+        # restore-attempt counts OUTLIVE the memory entry: a checkpoint
+        # evicted to (or imported straight onto) the persisted tier must
+        # still get its full CDT_PREEMPT_RESUME_RETRIES budget
+        self._attempts: dict[str, int] = {}
+        self._lock = tracked_lock("checkpoint.store", reentrant=True)
+        self.counts = {"parked": 0, "restored": 0, "dropped": 0,
+                       "evicted": 0, "corrupt": 0, "dead_lettered": 0}
+
+    # --- parking ------------------------------------------------------------
+
+    def park(self, ckpt: LatentCheckpoint) -> str:
+        """Serialize + store; returns the checkpoint id (content sha
+        prefixed with the step cursor for log readability). An invalid
+        caller-supplied id is replaced, never trusted — ids become file
+        names on the persisted tier."""
+        payload = ckpt.to_bytes()
+        cid = ckpt.checkpoint_id
+        if not valid_checkpoint_id(cid):
+            cid = f"ck_{ckpt.step:04d}_{checksum(payload)[:16]}"
+        entry = _Parked(payload, ckpt)
+        with self._lock:
+            existing = self._entries.get(cid)
+            if existing is not None and existing.sha256 != entry.sha256:
+                # a caller-supplied id colliding with DIFFERENT parked
+                # state (e.g. a hostile/buggy wire import reusing a
+                # live id) must not clobber someone else's checkpoint
+                fresh = f"ck_{ckpt.step:04d}_{entry.sha256[:16]}"
+                log(f"checkpoint id collision: {cid} holds different "
+                    f"state — parking the new payload as {fresh}")
+                cid = fresh
+            self._entries.pop(cid, None)
+            self._entries[cid] = entry
+            self.counts["parked"] += 1
+            self._evict_over_budget_locked(keep=cid)
+        ckpt.checkpoint_id = cid
+        if self.dir is not None:
+            self._disk_put(cid, entry)
+        self._export_gauges()
+        return cid
+
+    def _evict_over_budget_locked(self, keep: str) -> None:
+        if self.max_bytes <= 0:
+            return
+        used = sum(e.nbytes for e in self._entries.values())
+        for cid in list(self._entries):
+            if used <= self.max_bytes:
+                return
+            if cid == keep:
+                continue        # never evict the entry just parked
+            used -= self._entries.pop(cid).nbytes
+            self.counts["evicted"] += 1
+
+    # --- retrieval ----------------------------------------------------------
+
+    def get(self, checkpoint_id: str) -> Optional[LatentCheckpoint]:
+        """Deserialize a parked checkpoint (memory first, then the
+        persisted tier). Corruption is LOUD and the entry is dropped —
+        the caller restarts from scratch rather than resuming garbage."""
+        cid = str(checkpoint_id)
+        with self._lock:
+            entry = self._entries.get(cid)
+            if entry is not None:
+                self._entries.move_to_end(cid)
+                payload, want = entry.payload, entry.sha256
+            else:
+                payload = want = None
+        if payload is None and self.dir is not None:
+            loaded = self._disk_get(cid)
+            if loaded is None:
+                return None
+            payload, want = loaded
+        if payload is None:
+            return None
+        if checksum(payload) != want:
+            log(f"checkpoint {cid}: CHECKSUM MISMATCH — rejecting and "
+                "dropping (the job restarts from scratch)")
+            self._count_corrupt()
+            self.drop(cid)
+            return None
+        try:
+            return LatentCheckpoint.from_bytes(payload, checkpoint_id=cid)
+        except CheckpointError as e:
+            log(f"checkpoint {cid}: unreadable ({e}) — dropping")
+            self._count_corrupt()
+            self.drop(cid)
+            return None
+
+    def export_payload(self, checkpoint_id: str) -> Optional[dict]:
+        """The wire form for cross-worker transfer (checkpoint routes) —
+        built straight from the stored serialized payload (no
+        deserialize/re-serialize round trip; the recorded sha256 IS the
+        wire checksum)."""
+        cid = str(checkpoint_id)
+        with self._lock:
+            entry = self._entries.get(cid)
+            payload, want = ((entry.payload, entry.sha256)
+                             if entry is not None else (None, None))
+        if payload is None and self.dir is not None:
+            loaded = self._disk_get(cid)
+            if loaded is not None:
+                payload, want = loaded
+        if payload is None:
+            return None
+        return {"version": CHECKPOINT_VERSION, "checkpoint_id": cid,
+                "sha256": want,
+                "data": base64.b64encode(payload).decode("ascii")}
+
+    # --- lifecycle ----------------------------------------------------------
+
+    def drop(self, checkpoint_id: str) -> bool:
+        cid = str(checkpoint_id)
+        with self._lock:
+            existed = self._entries.pop(cid, None) is not None
+            self._attempts.pop(cid, None)
+            if existed:
+                self.counts["dropped"] += 1
+        if self.dir is not None:
+            self._disk_drop(cid)
+        self._export_gauges()
+        return existed
+
+    def record_restore_failure(self, checkpoint_id: str,
+                               reason: str) -> int:
+        """One failed restore attempt. Returns the attempt count; at
+        ``resume_retries`` the entry is dead-lettered (payload gone,
+        forensics kept) and the caller must restart from scratch.
+        Attempts are tracked independently of the memory tier — an
+        entry living only on disk still gets its full retry budget."""
+        cid = str(checkpoint_id)
+        with self._lock:
+            attempts = self._attempts.get(cid, 0) + 1
+            self._attempts[cid] = attempts
+            entry = self._entries.get(cid)
+            if entry is not None:
+                entry.restore_attempts = attempts
+        if attempts >= self.resume_retries:
+            self.dead_letter(cid, reason)
+        return attempts
+
+    def dead_letter(self, checkpoint_id: str, reason: str) -> None:
+        cid = str(checkpoint_id)
+        with self._lock:
+            entry = self._entries.pop(cid, None)
+            attempts = self._attempts.pop(cid, None)
+            self.counts["dead_lettered"] += 1
+            self.dead[cid] = {
+                "checkpoint_id": cid, "reason": reason,
+                "step": getattr(entry, "step", None),
+                "sampler": getattr(entry, "sampler", None),
+                "attempts": attempts if attempts is not None
+                else getattr(entry, "restore_attempts", None),
+            }
+        if self.dir is not None:
+            self._disk_drop(cid)
+        log(f"checkpoint {cid} DEAD-LETTERED ({reason}) — the job "
+            "restarts from scratch instead of looping on restore")
+        enabled, _tm = _ckpt_metrics()
+        if enabled:
+            _tm.CHECKPOINT_DEAD_LETTERS.inc()
+        self._export_gauges()
+
+    def mark_restored(self, checkpoint_id: str) -> None:
+        with self._lock:
+            self.counts["restored"] += 1
+
+    # --- persistence (mirrors cluster/cache/store.py) -----------------------
+
+    def _index_path(self) -> Path:
+        return self.dir / "checkpoint_index.json"
+
+    def _entry_path(self, cid: str) -> Path:
+        return self.dir / f"{cid}.ckpt"
+
+    def _index_flock(self):
+        """Advisory cross-PROCESS lock for the index read-merge-write —
+        the cluster/cache/store.py contract: two workers sharing
+        CDT_CKPT_DIR (the drain-migration deployment) must union their
+        rows, not last-write-win a sidecar into an un-indexed orphan.
+        Degrades to lockless where flock is unavailable — worst case a
+        lost index row, never a wrong byte (entries are checksummed)."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def _cm():
+            try:
+                import fcntl
+            except ImportError:
+                yield
+                return
+            try:
+                fd = os.open(self.dir / "checkpoint_index.lock",
+                             os.O_CREAT | os.O_RDWR)
+            except OSError:
+                yield
+                return
+            try:
+                try:
+                    fcntl.flock(fd, fcntl.LOCK_EX)
+                except OSError:
+                    pass
+                yield
+            finally:
+                os.close(fd)
+
+        return _cm()
+
+    def _write_index(self, mutate) -> None:
+        with self._lock, self._index_flock():
+            data = read_json(self._index_path())
+            entries = (data or {}).get("entries")
+            entries = entries if isinstance(entries, dict) else {}
+            mutate(entries)
+            atomic_write_json(self._index_path(),
+                              {"version": 1, "entries": entries})
+
+    def _disk_put(self, cid: str, entry: _Parked) -> None:
+        try:
+            path = self._entry_path(cid)
+            path.parent.mkdir(parents=True, exist_ok=True)
+            tmp = path.with_suffix(".tmp")
+            tmp.write_bytes(entry.payload)
+            os.replace(tmp, path)
+            row = {"file": path.name, "sha256": entry.sha256,
+                   "bytes": entry.nbytes, "step": entry.step,
+                   "sampler": entry.sampler}
+            self._write_index(lambda e: e.__setitem__(cid, row))
+        except OSError as e:
+            debug_log(f"checkpoint: persist of {cid} failed: {e}")
+
+    def _disk_get(self, cid: str) -> "Optional[tuple[bytes, str]]":
+        data = read_json(self._index_path())
+        row = ((data or {}).get("entries") or {}).get(cid)
+        if not isinstance(row, dict):
+            return None
+        try:
+            payload = self._entry_path(cid).read_bytes()
+        except OSError:
+            return None
+        want = row.get("sha256", "")
+        if checksum(payload) != want:
+            log(f"checkpoint {cid}: persisted CHECKSUM MISMATCH — "
+                "rejecting and deleting")
+            self._count_corrupt()
+            self._disk_drop(cid)
+            return None
+        return payload, want
+
+    def _disk_drop(self, cid: str) -> None:
+        self._write_index(lambda e: e.pop(cid, None))
+        try:
+            self._entry_path(cid).unlink()
+        except OSError:
+            pass
+
+    # --- introspection ------------------------------------------------------
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "bytes": sum(e.nbytes for e in self._entries.values()),
+                "max_bytes": self.max_bytes,
+                "persist_dir": str(self.dir) if self.dir else None,
+                "parked": [
+                    {"checkpoint_id": cid, "step": e.step,
+                     "total_steps": e.total_steps, "sampler": e.sampler,
+                     "bytes": e.nbytes, "attempts": e.restore_attempts}
+                    for cid, e in self._entries.items()],
+                "dead_letter": list(self.dead.values()),
+                **{k: v for k, v in self.counts.items()},
+            }
+
+    def _count_corrupt(self) -> None:
+        with self._lock:
+            self.counts["corrupt"] += 1
+        enabled, _tm = _ckpt_metrics()
+        if enabled:
+            _tm.CACHE_CORRUPT.labels(tier="checkpoint").inc()
+
+    def _export_gauges(self) -> None:
+        enabled, _tm = _ckpt_metrics()
+        if not enabled:
+            return
+        with self._lock:
+            mem = sum(e.nbytes for e in self._entries.values())
+        _tm.CHECKPOINT_BYTES.labels(tier="memory").set(mem)
+        if self.dir is not None:
+            data = read_json(self._index_path())
+            rows = ((data or {}).get("entries") or {})
+            _tm.CHECKPOINT_BYTES.labels(tier="persisted").set(
+                sum(int(r.get("bytes", 0)) for r in rows.values()
+                    if isinstance(r, dict)))

@@ -296,27 +296,18 @@ class Txt2ImgPipeline:
             cache[key] = clone
         return clone
 
-    def _sample_and_decode(self, key, context, uncond_context, y, uncond_y,
-                           spec: GenerationSpec, batch: int, sigmas: jax.Array,
-                           init_latent: Optional[jax.Array] = None,
-                           hint: Optional[jax.Array] = None,
-                           progress=None, weights=None,
-                           inpaint_mask: Optional[jax.Array] = None):
-        """Single-shard work: noise → sampler scan → VAE decode.
-
-        ``init_latent`` switches to img2img: the source latent is noised
-        to the (partial) ladder's head instead of starting from pure
-        noise (k-diffusion img2img convention). ``hint`` feeds the
-        pipeline's ControlNet (``with_control``). ``progress`` is an
-        optional ``(token, shard_index)`` pair that streams per-step x0
-        previews to the host (``diffusion/progress.wrap_denoiser``).
-        ``inpaint_mask`` (latent-res [.,h,w,1], 1 = regenerate) applies
-        ComfyUI's KSamplerX0Inpaint semantics on both sides of each model
-        call: the sampler *input* is recomposited with the source latent
-        re-noised at the current sigma (same fixed noise draw as the
-        initial noising), and the denoised *output* is pinned to the
-        source in unmasked regions — so ancestral/SDE samplers track the
-        reference trajectory at mask boundaries, not just at the end."""
+    def _build_sampling(self, key, context, uncond_context, y, uncond_y,
+                        spec: GenerationSpec, batch: int, sigmas: jax.Array,
+                        init_latent: Optional[jax.Array] = None,
+                        hint: Optional[jax.Array] = None,
+                        progress=None, weights=None,
+                        inpaint_mask: Optional[jax.Array] = None):
+        """Everything before the sampler scan: noise draw + denoiser
+        closure. Returns ``(denoise, x, k_samp)``. ONE definition shared
+        by the monolithic ``_sample_and_decode`` and the preemptible
+        segment programs (``preemptible_fns``) — the key split, noise
+        draw, and guidance wiring must be byte-for-byte the same math on
+        both paths or checkpoint/resume loses bit-identity."""
         k_noise, k_samp = jax.random.split(key)
         if init_latent is None:
             lat_h = spec.height // self.vae.config.downscale
@@ -353,6 +344,33 @@ class Txt2ImgPipeline:
             from .progress import wrap_denoiser
 
             denoise = wrap_denoiser(denoise, progress[0], progress[1])
+        return denoise, x, k_samp
+
+    def _sample_and_decode(self, key, context, uncond_context, y, uncond_y,
+                           spec: GenerationSpec, batch: int, sigmas: jax.Array,
+                           init_latent: Optional[jax.Array] = None,
+                           hint: Optional[jax.Array] = None,
+                           progress=None, weights=None,
+                           inpaint_mask: Optional[jax.Array] = None):
+        """Single-shard work: noise → sampler scan → VAE decode.
+
+        ``init_latent`` switches to img2img: the source latent is noised
+        to the (partial) ladder's head instead of starting from pure
+        noise (k-diffusion img2img convention). ``hint`` feeds the
+        pipeline's ControlNet (``with_control``). ``progress`` is an
+        optional ``(token, shard_index)`` pair that streams per-step x0
+        previews to the host (``diffusion/progress.wrap_denoiser``).
+        ``inpaint_mask`` (latent-res [.,h,w,1], 1 = regenerate) applies
+        ComfyUI's KSamplerX0Inpaint semantics on both sides of each model
+        call: the sampler *input* is recomposited with the source latent
+        re-noised at the current sigma (same fixed noise draw as the
+        initial noising), and the denoised *output* is pinned to the
+        source in unmasked regions — so ancestral/SDE samplers track the
+        reference trajectory at mask boundaries, not just at the end."""
+        denoise, x, k_samp = self._build_sampling(
+            key, context, uncond_context, y, uncond_y, spec, batch, sigmas,
+            init_latent=init_latent, hint=hint, progress=progress,
+            weights=weights, inpaint_mask=inpaint_mask)
         x0 = sample(spec.sampler, denoise, x, sigmas, key=k_samp)
         images = self.vae.decode(
             x0, params=None if weights is None else weights["vae_dec"])
@@ -585,6 +603,259 @@ class Txt2ImgPipeline:
                                                 progress=progress),
             self._CACHE_MAX)
 
+    # --- step-granular preemption (docs/preemption.md) ----------------------
+
+    def preemptible_fns(self, mesh: Mesh, spec: GenerationSpec,
+                        axis: str = constants.AXIS_DATA):
+        """The solo generator split at segment boundaries: three compiled
+        SPMD pieces over the same shard math as :meth:`generate_fn` —
+
+        - ``prep(key, ctx, unc, y, uy) -> carry``: participant key
+          fold-in + noise draw + the sampler's ``init``;
+        - ``seg(L)(key, ctx, unc, y, uy, start, carry) -> carry``: ``L``
+          denoise steps from traced global index ``start`` (one compiled
+          program per distinct length serves every offset);
+        - ``fin(carry) -> images``: output-slot extract + VAE decode.
+
+        The carry rides shard_map per the sampler contract
+        (``diffusion/samplers.py``): state-shaped leaves shard over
+        ``axis``, step-derived scalars replicate. Between segments the
+        carry can be materialized to host numpy (a
+        :class:`~..diffusion.checkpoint.LatentCheckpoint`) and resumed
+        on any worker with the same dp width — bit-identically, because
+        every step applies the same closure at the same global index
+        (tested: ``tests/test_checkpoint.py``,
+        ``tests/test_preemption.py``)."""
+        from .samplers import carry_structure, extract_output, make_program
+        from .samplers import run_segment as _run_segment
+
+        key_cache = (mesh_cache_key(mesh), spec, axis)
+        if not hasattr(self, "_preempt_cache"):
+            self._preempt_cache: "dict[tuple, Any]" = {}
+        bundle = self._preempt_cache.get(key_cache)
+        if bundle is not None:
+            return bundle
+
+        has_y = self.unet.config.adm_in_channels > 0
+        sigmas = make_sigma_ladder(spec, self.schedule)
+        n = len(sigmas) - 1
+        B = spec.per_device_batch
+        lat_h = spec.height // self.vae.config.downscale
+        lat_w = spec.width // self.vae.config.downscale
+        x_shape = (B, lat_h, lat_w, self.latent_channels)
+        x_struct = jax.ShapeDtypeStruct(x_shape, jnp.float32)
+        carry_struct = carry_structure(spec.sampler, x_struct)
+        carry_specs = tuple(
+            P(axis, *(None,) * (len(leaf.shape) - 1))
+            if tuple(leaf.shape) == x_shape else P()
+            for leaf in carry_struct)
+        base_specs = (P(), P(), P(None, None, None), P(None, None, None),
+                      P(None, None), P(None, None))
+        weights = self._weights()
+
+        def build_program(weights, key, context, uncond, y, uy,
+                          token=None):
+            k = participant_key(key, axis)
+            # in-trace progress rides exactly like generate_fn's token
+            # variant: each denoise call streams its x0 preview — the
+            # callback only OBSERVES, so bit-identity is untouched
+            prog_pair = ((token, jax.lax.axis_index(axis))
+                         if token is not None else None)
+            denoise, x, k_samp = self._build_sampling(
+                k, context, uncond,
+                y if has_y else None, uy if has_y else None,
+                spec, B, sigmas, progress=prog_pair, weights=weights)
+            return make_program(spec.sampler, denoise, sigmas,
+                                key=k_samp), x
+
+        def prep_body(weights, key, context, uncond, y, uy):
+            prog, x = build_program(weights, key, context, uncond, y, uy)
+            return prog.init(x)
+
+        prep = bind_weights(jax.jit(shard_map(
+            prep_body, mesh=mesh, in_specs=base_specs,
+            out_specs=carry_specs)), weights)
+
+        def make_seg(length: int, with_token: bool):
+            if with_token:
+                def seg_body(weights, key, context, uncond, y, uy,
+                             start, carry, token):
+                    prog, _ = build_program(weights, key, context,
+                                            uncond, y, uy, token=token)
+                    return _run_segment(prog, tuple(carry), start,
+                                        length)
+
+                in_specs = base_specs + (P(), carry_specs, P())
+            else:
+                def seg_body(weights, key, context, uncond, y, uy,
+                             start, carry):
+                    prog, _ = build_program(weights, key, context,
+                                            uncond, y, uy)
+                    return _run_segment(prog, tuple(carry), start,
+                                        length)
+
+                in_specs = base_specs + (P(), carry_specs)
+            return bind_weights(jax.jit(shard_map(
+                seg_body, mesh=mesh, in_specs=in_specs,
+                out_specs=carry_specs)), weights,
+                label="txt2img_seg", steps=length)
+
+        def fin_body(weights, carry):
+            x0 = extract_output(spec.sampler, tuple(carry))
+            images = self.vae.decode(x0, params=weights["vae_dec"])
+            return jnp.clip(images / 2.0 + 0.5, 0.0, 1.0)
+
+        fin = bind_weights(jax.jit(shard_map(
+            fin_body, mesh=mesh, in_specs=(P(), carry_specs),
+            out_specs=P(axis, None, None, None))), weights)
+
+        segs: "dict[tuple, Any]" = {}
+
+        def seg(length: int, with_token: bool = False):
+            fn = segs.get((length, with_token))
+            if fn is None:
+                fn = segs[(length, with_token)] = make_seg(length,
+                                                           with_token)
+            return fn
+
+        n_dp = dict(mesh.shape)[axis]
+        global_shapes = tuple(
+            (n_dp * B,) + tuple(leaf.shape[1:])
+            if tuple(leaf.shape) == x_shape else tuple(leaf.shape)
+            for leaf in carry_struct)
+        bundle = {"prep": prep, "seg": seg, "fin": fin, "n_steps": n,
+                  "carry_shapes": global_shapes}
+        if len(self._preempt_cache) >= self._CACHE_MAX:
+            self._preempt_cache.pop(next(iter(self._preempt_cache)))
+        self._preempt_cache[key_cache] = bundle
+        return bundle
+
+    def checkpoint_identity(self, mesh: Mesh, spec: GenerationSpec,
+                            seed: int,
+                            axis: str = constants.AXIS_DATA,
+                            conditioning=None) -> dict:
+        """The run-identity dict a checkpoint must match to resume this
+        exact trajectory (validated by ``LatentCheckpoint.validate_meta``
+        — a mismatch is a restore failure, never a silent wrong image).
+        ``conditioning`` (the (context, uncond, y, uy) tuple) binds the
+        checkpoint to the PROMPT CONTENT: without it, a different prompt
+        with coincidentally equal sampler/geometry/seed could resume
+        someone else's half-denoised latent into a blended image."""
+        identity = {
+            "sampler": spec.sampler, "scheduler": spec.scheduler,
+            "steps": int(spec.steps), "height": int(spec.height),
+            "width": int(spec.width), "cfg": float(spec.guidance_scale),
+            "per_device_batch": int(spec.per_device_batch),
+            "seed": int(seed), "n_dp": int(dict(mesh.shape)[axis]),
+        }
+        if conditioning is not None:
+            identity["conditioning"] = _conditioning_digest(*conditioning)
+        return identity
+
+    def generate_preemptible(
+        self,
+        mesh: Mesh,
+        spec: GenerationSpec,
+        seed: int,
+        context: jax.Array,
+        uncond_context: jax.Array,
+        y: Optional[jax.Array] = None,
+        uncond_y: Optional[jax.Array] = None,
+        *,
+        segment_steps: Optional[int] = None,
+        should_preempt=None,
+        resume=None,
+        progress_token: Optional[int] = None,
+    ) -> dict:
+        """Run the solo generation in resumable K-step segments.
+
+        Between segments ``should_preempt()`` is consulted (cheap host
+        callback; returns a reason string or None). On preemption the
+        FULL sampler carry is materialized and returned as
+        ``{"checkpoint": LatentCheckpoint, "reason": str}`` — nothing is
+        decoded, nothing is lost. ``resume`` restores a prior
+        checkpoint (identity-validated; a mismatch raises
+        :class:`~.checkpoint.CheckpointRestoreError` toward the bounded
+        resume-retry machinery). Completion returns
+        ``{"images": array}`` — bit-identical to :meth:`generate` for
+        the same inputs, interrupted or not.
+
+        At least one segment always runs per invocation, so a
+        preempt-storm cannot live-lock a job into never advancing."""
+        import numpy as np
+
+        from ..utils import constants as _c
+        from .checkpoint import CheckpointRestoreError, LatentCheckpoint
+
+        seg_steps = max(1, int(segment_steps
+                               or _c.PREEMPT_SEGMENT_STEPS.get()))
+        bundle = self.preemptible_fns(mesh, spec)
+        n = bundle["n_steps"]
+        if y is None:
+            adm = self.unet.config.adm_in_channels
+            y = jnp.zeros((1, max(adm, 1)), jnp.float32)
+        if uncond_y is None:
+            uncond_y = jnp.zeros_like(y)
+        args = (jax.random.key(seed), context, uncond_context, y, uncond_y)
+        identity = self.checkpoint_identity(
+            mesh, spec, seed,
+            conditioning=(context, uncond_context, y, uncond_y))
+
+        resume_t0 = None
+        if resume is not None:
+            resume.validate_meta(identity)
+            got = tuple(tuple(np.asarray(leaf).shape)
+                        for leaf in resume.carry)
+            if got != bundle["carry_shapes"]:
+                raise CheckpointRestoreError(
+                    f"checkpoint carry shapes {got} do not match this "
+                    f"program's {bundle['carry_shapes']}")
+            if not 0 <= resume.step <= n:
+                raise CheckpointRestoreError(
+                    f"checkpoint step {resume.step} outside ladder "
+                    f"0..{n}")
+            # resume latency: device upload + the first segment program
+            resume_t0 = time.perf_counter()  # cdtlint: disable=D001
+            carry = tuple(jnp.asarray(leaf) for leaf in resume.carry)
+            start = int(resume.step)
+        else:
+            carry = bundle["prep"](*args)
+            start = 0
+
+        done_here = 0
+        while start < n:
+            if done_here > 0 and should_preempt is not None:
+                reason = should_preempt()
+                if reason:
+                    leaves = tuple(np.asarray(leaf)
+                                   for leaf in jax.device_get(carry))
+                    ckpt = LatentCheckpoint(
+                        sampler=spec.sampler, step=start, total_steps=n,
+                        carry=leaves, meta=identity)
+                    return {"checkpoint": ckpt, "reason": reason,
+                            "step": start}
+            length = min(seg_steps, n - start)
+            if progress_token is not None:
+                carry = bundle["seg"](length, True)(
+                    *args, jnp.int32(start), carry,
+                    jnp.asarray(progress_token, jnp.int32))
+            else:
+                carry = bundle["seg"](length)(*args, jnp.int32(start),
+                                              carry)
+            # materialize: the segment boundary IS the preemption point —
+            # an unbounded dispatch pipeline would make it meaningless
+            jax.block_until_ready(carry)
+            if resume_t0 is not None:
+                from .. import telemetry
+                if telemetry.enabled():
+                    from ..telemetry import metrics as _tm
+                    _tm.RESUME_SECONDS.observe(
+                        time.perf_counter() - resume_t0)  # cdtlint: disable=D001
+                resume_t0 = None
+            start += length
+            done_here += length
+        return {"images": bundle["fin"](carry), "step": n}
+
     # --- cross-request microbatching (cluster/frontdoor) -------------------
 
     def microbatch_fn(self, mesh: Mesh, spec: GenerationSpec,
@@ -803,6 +1074,25 @@ class Txt2ImgPipeline:
 # families (euler_ancestral, lcm, dpmpp_sde, ddim with eta>0) draw
 # batch-shaped step noise from a single key and are excluded.
 DETERMINISTIC_SAMPLERS = frozenset({"euler", "heun", "dpmpp_2m", "ddim"})
+
+
+def _conditioning_digest(*arrays) -> str:
+    """Content digest of a conditioning tuple (shape + dtype + bytes per
+    tensor; None slots pinned) — the checkpoint-identity component that
+    ties a parked latent to its PROMPT, not just its geometry."""
+    import hashlib
+
+    import numpy as np
+
+    h = hashlib.sha256()
+    for a in arrays:
+        if a is None:
+            h.update(b"|none")
+            continue
+        arr = np.asarray(a)
+        h.update(f"|{arr.shape}:{arr.dtype}:".encode())
+        h.update(arr.tobytes())
+    return h.hexdigest()
 
 
 def demux_microbatch(out: jax.Array, mesh: Mesh, n_requests: int,

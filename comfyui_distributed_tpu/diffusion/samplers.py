@@ -1,4 +1,4 @@
-"""Samplers as ``lax.scan`` loops in sigma space.
+"""Samplers as ``lax.scan`` loops in sigma space — in resumable form.
 
 A sampler advances ``x`` down a sigma ladder using a *denoiser*
 ``denoise(x, sigma) -> x0_hat``. The denoiser hides the model
@@ -10,11 +10,30 @@ shapes, stochastic steps derive per-step keys with ``fold_in`` — so a whole
 sampling run compiles to a single XLA while/scan and never returns to the
 host between steps (the reference pays a Python round-trip per *tile* per
 step through ComfyUI's sampler; SURVEY §3.3 "GPU HOT LOOP").
+
+Since ISSUE 14 every sampler is expressed as a :class:`SamplerProgram` —
+an explicit ``(init, step, extract)`` triple over a pytree *carry* — so
+the scan can be cut at ANY step boundary: :func:`run_segment` runs steps
+``[start, start+length)`` and returns the carry, which (with the step
+cursor) is the complete sampler state. That is what makes step-granular
+preemption exact (``diffusion/checkpoint.py``): a run split into
+segments, round-tripped through host numpy between them, is bit-identical
+to the monolithic scan because each step applies the SAME step closure to
+the SAME carry values at the SAME global index ``i`` — stochastic
+samplers included, since their per-step noise is ``fold_in(key, i)`` of
+the global index, never of a per-segment counter.
+
+Carry contract (relied on by the sharded preemptible pipeline): every
+leaf is either *state-shaped* (same shape as ``x`` — latents and D/x0
+history slots) or a rank-0 scalar derived only from ``(sigmas, step
+index)`` (step-count flags, h-history) — scalars are therefore identical
+across dp shards and may be carried replicated.
 """
 
 from __future__ import annotations
 
-from typing import Callable, Protocol
+import dataclasses
+from typing import Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -22,24 +41,56 @@ import jax.numpy as jnp
 Denoiser = Callable[[jax.Array, jax.Array], jax.Array]   # (x, sigma[]) -> x0_hat
 
 
+@dataclasses.dataclass(frozen=True)
+class SamplerProgram:
+    """One sampler bound to ``(denoise, sigmas, key, kwargs)``.
+
+    ``init(x) -> carry`` builds the scan carry (a tuple of arrays; slot 0
+    is always the evolving latent unless ``extract`` says otherwise);
+    ``step(carry, i) -> carry`` advances one GLOBAL ladder index;
+    ``extract(carry) -> x0`` picks the output slot after the final step.
+    ``init`` and ``extract`` are pure structure — they never call the
+    denoiser — so carry shapes can be derived abstractly
+    (``jax.eval_shape``) and the output extracted without rebuilding the
+    model closure."""
+
+    name: str
+    n_steps: int
+    init: Callable[[jax.Array], tuple]
+    step: Callable[[tuple, jax.Array], tuple]
+    extract: Callable[[tuple], jax.Array]
+
+
+def run_segment(prog: SamplerProgram, carry: tuple, start,
+                length: int) -> tuple:
+    """Advance ``length`` steps from global index ``start``.
+
+    ``start`` may be traced (one compiled segment program serves every
+    offset of that length); ``length`` is static. The xs are
+    ``start + arange(length)`` so the step closure sees the same global
+    indices the monolithic scan would."""
+    if length <= 0:
+        return carry
+    xs = jnp.asarray(start, jnp.int32) + jnp.arange(length, dtype=jnp.int32)
+    carry, _ = jax.lax.scan(lambda c, i: (prog.step(c, i), None), carry, xs)
+    return carry
+
+
+def run_program(prog: SamplerProgram, x: jax.Array) -> jax.Array:
+    """The monolithic run: init → scan the whole ladder → extract."""
+    carry = prog.init(x)
+    carry, _ = jax.lax.scan(lambda c, i: (prog.step(c, i), None), carry,
+                            jnp.arange(prog.n_steps, dtype=jnp.int32))
+    return prog.extract(carry)
+
+
+def _extract_first(carry: tuple) -> jax.Array:
+    return carry[0]
+
+
 def _to_d(x: jax.Array, sigma: jax.Array, denoised: jax.Array) -> jax.Array:
     """Convert x0 prediction to the k-diffusion ODE derivative."""
     return (x - denoised) / jnp.maximum(sigma, 1e-10)
-
-
-def sample_euler(denoise: Denoiser, x: jax.Array, sigmas: jax.Array,
-                 key: jax.Array | None = None) -> jax.Array:
-    del key
-
-    def step(x, i):
-        sigma, sigma_next = sigmas[i], sigmas[i + 1]
-        denoised = denoise(x, sigma)
-        d = _to_d(x, sigma, denoised)
-        return x + d * (sigma_next - sigma), None
-
-    n = sigmas.shape[0] - 1
-    x, _ = jax.lax.scan(step, x, jnp.arange(n))
-    return x
 
 
 def _ancestral_sigmas(sigma_from, sigma_to, eta):
@@ -52,9 +103,39 @@ def _ancestral_sigmas(sigma_from, sigma_to, eta):
     return sigma_down, sigma_up
 
 
-def sample_euler_ancestral(denoise: Denoiser, x: jax.Array, sigmas: jax.Array,
-                           key: jax.Array, eta: float = 1.0) -> jax.Array:
-    def step(x, i):
+def _t_of(sigma):
+    """log-SNR time t = −log σ (the exponential-integrator clock all the
+    multistep solvers below share)."""
+    return -jnp.log(jnp.maximum(sigma, 1e-10))
+
+
+def _i0(h):
+    """∫₀ʰ e^{τ−h} dτ = 1 − e^{−h} — weight of a constant D over one
+    exponential-integrator step."""
+    return -jnp.expm1(-h)
+
+
+# --- program builders -------------------------------------------------------
+
+
+def _euler_program(denoise, sigmas, key=None) -> SamplerProgram:
+    del key
+
+    def step(carry, i):
+        (x,) = carry
+        sigma, sigma_next = sigmas[i], sigmas[i + 1]
+        denoised = denoise(x, sigma)
+        d = _to_d(x, sigma, denoised)
+        return (x + d * (sigma_next - sigma),)
+
+    return SamplerProgram("euler", sigmas.shape[0] - 1,
+                          lambda x: (x,), step, _extract_first)
+
+
+def _euler_ancestral_program(denoise, sigmas, key,
+                             eta: float = 1.0) -> SamplerProgram:
+    def step(carry, i):
+        (x,) = carry
         sigma, sigma_next = sigmas[i], sigmas[i + 1]
         denoised = denoise(x, sigma)
         sigma_down, sigma_up = _ancestral_sigmas(sigma, sigma_next, eta)
@@ -62,18 +143,17 @@ def sample_euler_ancestral(denoise: Denoiser, x: jax.Array, sigmas: jax.Array,
         x = x + d * (sigma_down - sigma)
         noise = jax.random.normal(jax.random.fold_in(key, i), x.shape, x.dtype)
         # last step has sigma_next == 0 → sigma_up == 0 → no noise added
-        return x + noise * sigma_up, None
+        return (x + noise * sigma_up,)
 
-    n = sigmas.shape[0] - 1
-    x, _ = jax.lax.scan(step, x, jnp.arange(n))
-    return x
+    return SamplerProgram("euler_ancestral", sigmas.shape[0] - 1,
+                          lambda x: (x,), step, _extract_first)
 
 
-def sample_heun(denoise: Denoiser, x: jax.Array, sigmas: jax.Array,
-                key: jax.Array | None = None) -> jax.Array:
+def _heun_program(denoise, sigmas, key=None) -> SamplerProgram:
     del key
 
-    def step(x, i):
+    def step(carry, i):
+        (x,) = carry
         sigma, sigma_next = sigmas[i], sigmas[i + 1]
         denoised = denoise(x, sigma)
         d = _to_d(x, sigma, denoised)
@@ -87,15 +167,13 @@ def sample_heun(denoise: Denoiser, x: jax.Array, sigmas: jax.Array,
 
         # at the final step sigma_next==0: plain euler (no second eval at σ=0)
         x = jax.lax.cond(sigma_next > 0, heun_correct, lambda _: x_euler, None)
-        return x, None
+        return (x,)
 
-    n = sigmas.shape[0] - 1
-    x, _ = jax.lax.scan(step, x, jnp.arange(n))
-    return x
+    return SamplerProgram("heun", sigmas.shape[0] - 1,
+                          lambda x: (x,), step, _extract_first)
 
 
-def sample_dpmpp_2m(denoise: Denoiser, x: jax.Array, sigmas: jax.Array,
-                    key: jax.Array | None = None) -> jax.Array:
+def _dpmpp_2m_program(denoise, sigmas, key=None) -> SamplerProgram:
     """DPM-Solver++(2M): second-order multistep on log-sigma."""
     del key
 
@@ -122,20 +200,21 @@ def sample_dpmpp_2m(denoise: Denoiser, x: jax.Array, sigmas: jax.Array,
         x_new = jax.lax.cond(use_second, second_order, first_order, None)
         # sigma_next == 0: x -> denoised exactly
         x_new = jnp.where(sigma_next > 0, x_new, denoised)
-        return (x_new, denoised, jnp.array(True)), None
+        return (x_new, denoised, jnp.array(True))
 
-    n = sigmas.shape[0] - 1
-    init = (x, jnp.zeros_like(x), jnp.array(False))
-    (x, _, _), _ = jax.lax.scan(step, init, jnp.arange(n))
-    return x
+    return SamplerProgram(
+        "dpmpp_2m", sigmas.shape[0] - 1,
+        lambda x: (x, jnp.zeros_like(x), jnp.array(False)),
+        step, _extract_first)
 
 
-def sample_ddim(denoise: Denoiser, x: jax.Array, sigmas: jax.Array,
-                key: jax.Array | None = None, eta: float = 0.0) -> jax.Array:
+def _ddim_program(denoise, sigmas, key=None,
+                  eta: float = 0.0) -> SamplerProgram:
     """DDIM in sigma space. ``eta=0`` is the deterministic solver (the
     x0-form of Euler); ``eta>0`` interpolates toward ancestral sampling."""
 
-    def step(x, i):
+    def step(carry, i):
+        (x,) = carry
         sigma, sigma_next = sigmas[i], sigmas[i + 1]
         denoised = denoise(x, sigma)
         if eta and key is not None:
@@ -147,33 +226,31 @@ def sample_ddim(denoise: Denoiser, x: jax.Array, sigmas: jax.Array,
             noise = jax.random.normal(jax.random.fold_in(key, i),
                                       x.shape, x.dtype)
             x = x + noise * sigma_up
-        return x, None
+        return (x,)
 
-    n = sigmas.shape[0] - 1
-    x, _ = jax.lax.scan(step, x, jnp.arange(n))
-    return x
+    return SamplerProgram("ddim", sigmas.shape[0] - 1,
+                          lambda x: (x,), step, _extract_first)
 
 
-def sample_lcm(denoise: Denoiser, x: jax.Array, sigmas: jax.Array,
-               key: jax.Array) -> jax.Array:
+def _lcm_program(denoise, sigmas, key) -> SamplerProgram:
     """Latent-consistency sampling: jump to x0, re-noise to the next
     sigma (k-diffusion ``sample_lcm``)."""
 
-    def step(x, i):
+    def step(carry, i):
+        (x,) = carry
         denoised = denoise(x, sigmas[i])
         sigma_next = sigmas[i + 1]
         noise = jax.random.normal(jax.random.fold_in(key, i),
                                   x.shape, x.dtype)
-        return denoised + jnp.where(sigma_next > 0, sigma_next, 0.0) * noise, None
+        return (denoised + jnp.where(sigma_next > 0, sigma_next, 0.0) * noise,)
 
-    n = sigmas.shape[0] - 1
-    x, _ = jax.lax.scan(step, x, jnp.arange(n))
-    return x
+    return SamplerProgram("lcm", sigmas.shape[0] - 1,
+                          lambda x: (x,), step, _extract_first)
 
 
-def sample_dpmpp_sde(denoise: Denoiser, x: jax.Array, sigmas: jax.Array,
-                     key: jax.Array, eta: float = 1.0, s_noise: float = 1.0,
-                     r: float = 0.5) -> jax.Array:
+def _dpmpp_sde_program(denoise, sigmas, key, eta: float = 1.0,
+                       s_noise: float = 1.0,
+                       r: float = 0.5) -> SamplerProgram:
     """DPM-Solver++ (SDE): single-step second-order with an ancestral
     noise injection at the midpoint and endpoint (k-diffusion
     ``sample_dpmpp_sde``)."""
@@ -184,7 +261,8 @@ def sample_dpmpp_sde(denoise: Denoiser, x: jax.Array, sigmas: jax.Array,
     def sigma_of(t):
         return jnp.exp(-t)
 
-    def step(x, i):
+    def step(carry, i):
+        (x,) = carry
         sigma, sigma_next = sigmas[i], sigmas[i + 1]
         denoised = denoise(x, sigma)
 
@@ -215,16 +293,14 @@ def sample_dpmpp_sde(denoise: Denoiser, x: jax.Array, sigmas: jax.Array,
                                        x.shape, x.dtype)
             return x_new + noise2 * su2 * s_noise
 
-        return jax.lax.cond(sigma_next > 0, stage, last, None), None
+        return (jax.lax.cond(sigma_next > 0, stage, last, None),)
 
-    n = sigmas.shape[0] - 1
-    x, _ = jax.lax.scan(step, x, jnp.arange(n))
-    return x
+    return SamplerProgram("dpmpp_sde", sigmas.shape[0] - 1,
+                          lambda x: (x,), step, _extract_first)
 
 
-def sample_dpmpp_2m_sde(denoise: Denoiser, x: jax.Array, sigmas: jax.Array,
-                        key: jax.Array, eta: float = 1.0,
-                        s_noise: float = 1.0) -> jax.Array:
+def _dpmpp_2m_sde_program(denoise, sigmas, key, eta: float = 1.0,
+                          s_noise: float = 1.0) -> SamplerProgram:
     """DPM-Solver++(2M) SDE, midpoint solver (k-diffusion
     ``sample_dpmpp_2m_sde``)."""
 
@@ -256,28 +332,16 @@ def sample_dpmpp_2m_sde(denoise: Denoiser, x: jax.Array, sigmas: jax.Array,
             return x_new, h
 
         x_new, h = jax.lax.cond(sigma_next > 0, stage, last, None)
-        return (x_new, denoised, h, jnp.array(True)), None
+        return (x_new, denoised, h, jnp.array(True))
 
-    n = sigmas.shape[0] - 1
-    init = (x, jnp.zeros_like(x), jnp.zeros(()), jnp.array(False))
-    (x, _, _, _), _ = jax.lax.scan(step, init, jnp.arange(n))
-    return x
-
-
-def _t_of(sigma):
-    """log-SNR time t = −log σ (the exponential-integrator clock all the
-    multistep solvers below share)."""
-    return -jnp.log(jnp.maximum(sigma, 1e-10))
+    return SamplerProgram(
+        "dpmpp_2m_sde", sigmas.shape[0] - 1,
+        lambda x: (x, jnp.zeros_like(x), jnp.zeros(()), jnp.array(False)),
+        step, _extract_first)
 
 
-def _i0(h):
-    """∫₀ʰ e^{τ−h} dτ = 1 − e^{−h} — weight of a constant D over one
-    exponential-integrator step."""
-    return -jnp.expm1(-h)
-
-
-def sample_res_2m(denoise: Denoiser, x: jax.Array, sigmas: jax.Array,
-                  key: jax.Array | None = None, eta: float = 0.0) -> jax.Array:
+def _res_2m_program(denoise, sigmas, key=None,
+                    eta: float = 0.0) -> SamplerProgram:
     """RES second-order multistep (the RES4LYF-family ``res_2m``):
     exponential Adams–Bashforth on the data prediction.
 
@@ -311,17 +375,16 @@ def sample_res_2m(denoise: Denoiser, x: jax.Array, sigmas: jax.Array,
             x_new = x_new + noise * sigma_up
         x_new = jnp.where(sigma_next > 0, x_new, denoised)
         h_real = _t_of(sigma_next) - _t_of(sigma)
-        return (x_new, denoised, h_real, jnp.array(True)), None
+        return (x_new, denoised, h_real, jnp.array(True))
 
-    n = sigmas.shape[0] - 1
-    init = (x, jnp.zeros_like(x), jnp.zeros(()), jnp.array(False))
-    (x, _, _, _), _ = jax.lax.scan(step, init, jnp.arange(n))
-    return x
+    return SamplerProgram(
+        "res_2m", sigmas.shape[0] - 1,
+        lambda x: (x, jnp.zeros_like(x), jnp.zeros(()), jnp.array(False)),
+        step, _extract_first)
 
 
-def sample_res_2s(denoise: Denoiser, x: jax.Array, sigmas: jax.Array,
-                  key: jax.Array | None = None, eta: float = 0.0,
-                  c2: float = 0.5) -> jax.Array:
+def _res_2s_program(denoise, sigmas, key=None, eta: float = 0.0,
+                    c2: float = 0.5) -> SamplerProgram:
     """RES second-order single-step (``res_2s``): two-stage exponential
     Runge–Kutta (Hochbruck–Ostermann ExpRK2) with midpoint stage c2.
 
@@ -331,7 +394,8 @@ def sample_res_2s(denoise: Denoiser, x: jax.Array, sigmas: jax.Array,
     b1+b2 = φ1, b2·c2 = φ2 for any c2 ∈ (0, 1]. Two model calls per
     step. ``eta > 0`` adds an ancestral split (``res_2s_ancestral``)."""
 
-    def step(x, i):
+    def step(carry, i):
+        (x,) = carry
         sigma, sigma_next = sigmas[i], sigmas[i + 1]
         denoised = denoise(x, sigma)
         if eta:
@@ -357,16 +421,14 @@ def sample_res_2s(denoise: Denoiser, x: jax.Array, sigmas: jax.Array,
             noise = jax.random.normal(jax.random.fold_in(key, i),
                                       x.shape, x.dtype)
             x_new = x_new + jnp.where(sigma_next > 0, noise * sigma_up, 0.0)
-        return x_new, None
+        return (x_new,)
 
-    n = sigmas.shape[0] - 1
-    x, _ = jax.lax.scan(step, x, jnp.arange(n))
-    return x
+    return SamplerProgram("res_2s", sigmas.shape[0] - 1,
+                          lambda x: (x,), step, _extract_first)
 
 
-def sample_dpmpp_3m_sde(denoise: Denoiser, x: jax.Array, sigmas: jax.Array,
-                        key: jax.Array, eta: float = 1.0,
-                        s_noise: float = 1.0) -> jax.Array:
+def _dpmpp_3m_sde_program(denoise, sigmas, key, eta: float = 1.0,
+                          s_noise: float = 1.0) -> SamplerProgram:
     """DPM-Solver++(3M) SDE: third-order multistep with exponential-decay
     noise (the k-diffusion ``sample_dpmpp_3m_sde`` algorithm, transcribed
     from its published update rule into a scan).
@@ -409,17 +471,16 @@ def sample_dpmpp_3m_sde(denoise: Denoiser, x: jax.Array, sigmas: jax.Array,
             return x_new, h
 
         x_new, h = jax.lax.cond(sigma_next > 0, stage, last, None)
-        return (x_new, denoised, d1, h, h1, count + 1), None
+        return (x_new, denoised, d1, h, h1, count + 1)
 
-    n = sigmas.shape[0] - 1
-    init = (x, jnp.zeros_like(x), jnp.zeros_like(x), jnp.zeros(()),
-            jnp.zeros(()), jnp.int32(0))
-    (x, _, _, _, _, _), _ = jax.lax.scan(step, init, jnp.arange(n))
-    return x
+    return SamplerProgram(
+        "dpmpp_3m_sde", sigmas.shape[0] - 1,
+        lambda x: (x, jnp.zeros_like(x), jnp.zeros_like(x), jnp.zeros(()),
+                   jnp.zeros(()), jnp.int32(0)),
+        step, _extract_first)
 
 
-def sample_uni_pc(denoise: Denoiser, x: jax.Array, sigmas: jax.Array,
-                  key: jax.Array | None = None) -> jax.Array:
+def _uni_pc_program(denoise, sigmas, key=None) -> SamplerProgram:
     """UniPC (UniP-2 predictor + UniC-3 corrector), data-prediction form,
     one model call per step (the corrector reuses the evaluation made at
     the predicted point, per the published predictor–corrector scheme).
@@ -435,6 +496,7 @@ def sample_uni_pc(denoise: Denoiser, x: jax.Array, sigmas: jax.Array,
       (0, D_n), (h, D̂_{n+1}), third-order accurate; falls back to the
       exponential-trapezoidal (linear through 0, h) on the first
       transition."""
+    del key
 
     def correct(x_prev, d_prev2, d_prev, d_cur, h, h_prev, count):
         """Re-integrate t_{n−1}→t_n with D̂ at the arrival point."""
@@ -475,13 +537,136 @@ def sample_uni_pc(denoise: Denoiser, x: jax.Array, sigmas: jax.Array,
         h = _t_of(sigma_next) - _t_of(sigma)
         x_next = predict(x_cur, d_cur, d_prev, h, h_prev, count)
         x_next = jnp.where(sigma_next > 0, x_next, d_cur)
-        return (x_cur, x_next, d_cur, d_prev, h, h_prev, count + 1), None
+        return (x_cur, x_next, d_cur, d_prev, h, h_prev, count + 1)
 
-    n = sigmas.shape[0] - 1
-    init = (x, x, jnp.zeros_like(x), jnp.zeros_like(x), jnp.zeros(()),
-            jnp.zeros(()), jnp.int32(0))
-    (_, x, _, _, _, _, _), _ = jax.lax.scan(step, init, jnp.arange(n))
-    return x
+    return SamplerProgram(
+        "uni_pc", sigmas.shape[0] - 1,
+        lambda x: (x, x, jnp.zeros_like(x), jnp.zeros_like(x), jnp.zeros(()),
+                   jnp.zeros(()), jnp.int32(0)),
+        step, lambda carry: carry[1])
+
+
+PROGRAMS: dict[str, Callable] = {
+    "euler": _euler_program,
+    "euler_ancestral": _euler_ancestral_program,
+    "heun": _heun_program,
+    "dpmpp_2m": _dpmpp_2m_program,
+    "ddim": _ddim_program,
+    "lcm": _lcm_program,
+    "dpmpp_sde": _dpmpp_sde_program,
+    "dpmpp_2m_sde": _dpmpp_2m_sde_program,
+    "res_2m": _res_2m_program,
+    "res_2s": _res_2s_program,
+    "res_2m_ancestral": lambda d, s, key=None, **kw: _res_2m_program(
+        d, s, key, eta=kw.pop("eta", 1.0), **kw),
+    "res_2s_ancestral": lambda d, s, key=None, **kw: _res_2s_program(
+        d, s, key, eta=kw.pop("eta", 1.0), **kw),
+    "dpmpp_3m_sde": _dpmpp_3m_sde_program,
+    "uni_pc": _uni_pc_program,
+}
+
+
+def make_program(name: str, denoise: Denoiser, sigmas: jax.Array,
+                 key: Optional[jax.Array] = None,
+                 **kwargs) -> SamplerProgram:
+    """The resumable form of :func:`sample`: same dispatch, same kwargs,
+    but the ``(init, step, extract)`` triple instead of a finished run —
+    segment it with :func:`run_segment` (diffusion/checkpoint.py)."""
+    try:
+        builder = PROGRAMS[name]
+    except KeyError:
+        raise ValueError(f"unknown sampler {name!r}; have {sorted(PROGRAMS)}")
+    return builder(denoise, sigmas, key, **kwargs)
+
+
+def carry_structure(name: str, x_struct, **kwargs) -> tuple:
+    """Abstract carry shapes for sampler ``name`` given the latent's
+    ``ShapeDtypeStruct`` — no denoiser needed (``init`` is pure
+    structure). The preemptible pipeline derives shard_map specs and the
+    checkpoint layout from this."""
+    prog = make_program(name, None, jnp.zeros((2,), jnp.float32),
+                        key=None, **kwargs)
+    return jax.eval_shape(prog.init, x_struct)
+
+
+def extract_output(name: str, carry: tuple, **kwargs) -> jax.Array:
+    """Pick sampler ``name``'s output slot out of a finished carry —
+    denoiser-free (used by the preemptible pipeline's decode program)."""
+    prog = make_program(name, None, jnp.zeros((2,), jnp.float32),
+                        key=None, **kwargs)
+    return prog.extract(carry)
+
+
+# --- the classic one-shot API (unchanged signatures) ------------------------
+
+
+def sample_euler(denoise: Denoiser, x: jax.Array, sigmas: jax.Array,
+                 key: jax.Array | None = None) -> jax.Array:
+    return run_program(_euler_program(denoise, sigmas, key), x)
+
+
+def sample_euler_ancestral(denoise: Denoiser, x: jax.Array, sigmas: jax.Array,
+                           key: jax.Array, eta: float = 1.0) -> jax.Array:
+    return run_program(_euler_ancestral_program(denoise, sigmas, key,
+                                                eta=eta), x)
+
+
+def sample_heun(denoise: Denoiser, x: jax.Array, sigmas: jax.Array,
+                key: jax.Array | None = None) -> jax.Array:
+    return run_program(_heun_program(denoise, sigmas, key), x)
+
+
+def sample_dpmpp_2m(denoise: Denoiser, x: jax.Array, sigmas: jax.Array,
+                    key: jax.Array | None = None) -> jax.Array:
+    return run_program(_dpmpp_2m_program(denoise, sigmas, key), x)
+
+
+def sample_ddim(denoise: Denoiser, x: jax.Array, sigmas: jax.Array,
+                key: jax.Array | None = None, eta: float = 0.0) -> jax.Array:
+    return run_program(_ddim_program(denoise, sigmas, key, eta=eta), x)
+
+
+def sample_lcm(denoise: Denoiser, x: jax.Array, sigmas: jax.Array,
+               key: jax.Array) -> jax.Array:
+    return run_program(_lcm_program(denoise, sigmas, key), x)
+
+
+def sample_dpmpp_sde(denoise: Denoiser, x: jax.Array, sigmas: jax.Array,
+                     key: jax.Array, eta: float = 1.0, s_noise: float = 1.0,
+                     r: float = 0.5) -> jax.Array:
+    return run_program(_dpmpp_sde_program(denoise, sigmas, key, eta=eta,
+                                          s_noise=s_noise, r=r), x)
+
+
+def sample_dpmpp_2m_sde(denoise: Denoiser, x: jax.Array, sigmas: jax.Array,
+                        key: jax.Array, eta: float = 1.0,
+                        s_noise: float = 1.0) -> jax.Array:
+    return run_program(_dpmpp_2m_sde_program(denoise, sigmas, key, eta=eta,
+                                             s_noise=s_noise), x)
+
+
+def sample_res_2m(denoise: Denoiser, x: jax.Array, sigmas: jax.Array,
+                  key: jax.Array | None = None, eta: float = 0.0) -> jax.Array:
+    return run_program(_res_2m_program(denoise, sigmas, key, eta=eta), x)
+
+
+def sample_res_2s(denoise: Denoiser, x: jax.Array, sigmas: jax.Array,
+                  key: jax.Array | None = None, eta: float = 0.0,
+                  c2: float = 0.5) -> jax.Array:
+    return run_program(_res_2s_program(denoise, sigmas, key, eta=eta,
+                                       c2=c2), x)
+
+
+def sample_dpmpp_3m_sde(denoise: Denoiser, x: jax.Array, sigmas: jax.Array,
+                        key: jax.Array, eta: float = 1.0,
+                        s_noise: float = 1.0) -> jax.Array:
+    return run_program(_dpmpp_3m_sde_program(denoise, sigmas, key, eta=eta,
+                                             s_noise=s_noise), x)
+
+
+def sample_uni_pc(denoise: Denoiser, x: jax.Array, sigmas: jax.Array,
+                  key: jax.Array | None = None) -> jax.Array:
+    return run_program(_uni_pc_program(denoise, sigmas, key), x)
 
 
 SAMPLERS: dict[str, Callable] = {

@@ -993,14 +993,15 @@ class TPUTxt2Img(NodeDef):
     OPTIONAL = {
         "sampler_name": "STRING", "scheduler": "STRING", "batch_per_device": "INT",
     }
-    HIDDEN = {"mesh": "*", "prompt_id": "STRING", "progress_tracker": "*"}
+    HIDDEN = {"mesh": "*", "prompt_id": "STRING", "progress_tracker": "*",
+              "preemption": "*"}
     RETURNS = ("IMAGE",)
 
     def execute(self, model, positive, negative, seed: int, steps: int,
                 cfg: float, width: int, height: int,
                 sampler_name: str = "euler", scheduler: str = "karras",
                 batch_per_device: int = 1, mesh=None, prompt_id: str = "",
-                progress_tracker=None, **_):
+                progress_tracker=None, preemption=None, **_):
         from ..diffusion.pipeline import GenerationSpec
         from ..parallel.mesh import build_mesh
 
@@ -1018,6 +1019,18 @@ class TPUTxt2Img(NodeDef):
         uy = _adm_from_cond(negative, adm) if adm else None
         pipeline, hint = _control_from_cond(model.pipeline, positive,
                                             spec.height, spec.width)
+        if preemption is not None and hint is None:
+            # serving lane (cluster/preemption.py): resumable K-step
+            # segments, preempt checks at segment boundaries, optional
+            # checkpoint restore. Bit-identical to the monolithic path,
+            # and per-step preview streaming rides the segment programs
+            # exactly like the monolithic token variant. (ControlNet
+            # graphs keep the monolithic path: per-request hints are
+            # not threaded through the segment programs.)
+            with _pinned(model):
+                return (self._execute_preemptible(
+                    pipeline, mesh, spec, int(seed), positive, negative,
+                    y, uy, preemption, progress_tracker, prompt_id),)
         from ..diffusion.progress import total_calls
 
         with _pinned(model), \
@@ -1030,6 +1043,35 @@ class TPUTxt2Img(NodeDef):
             )
             ps.complete(images)
         return (images,)
+
+    def _execute_preemptible(self, pipeline, mesh, spec, seed,
+                             positive, negative, y, uy, token,
+                             progress_tracker, prompt_id):
+        from ..diffusion.checkpoint import PreemptedError
+        from ..diffusion.progress import total_calls
+
+        # identity (incl. the conditioning digest) is validated inside
+        # generate_preemptible; a mismatch raises CheckpointRestoreError
+        # toward the runtime's bounded resume-retry machinery
+        token.resume_consumed = token.resume is not None
+        with _ProgressScope(progress_tracker, prompt_id,
+                            total_calls(spec.sampler, spec.steps)) as ps:
+            result = pipeline.generate_preemptible(
+                mesh, spec, seed, positive["context"],
+                negative["context"], y, uy,
+                segment_steps=token.segment_steps,
+                should_preempt=token.should_preempt, resume=token.resume,
+                progress_token=ps.token,
+            )
+            if "checkpoint" in result:
+                # scope exit freezes the progress bar where it stopped
+                # (preempted ≠ failed-to-0; resume re-registers a fresh
+                # token under the same prompt_id)
+                raise PreemptedError(result["checkpoint"],
+                                     result["reason"])
+            images = result["images"]
+            ps.complete(images)
+        return images
 
 
 @register_node("TPUImg2Img")

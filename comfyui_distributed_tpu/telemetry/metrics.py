@@ -275,6 +275,37 @@ HASH_TOKENIZATION = REGISTRY.counter(
     "line made fleet-visible (models/clip.py).",
     ("tower",))
 
+# --- step-granular preemption (cluster/preemption.py, docs/preemption.md) ---
+
+PREEMPTIONS_TOTAL = REGISTRY.counter(
+    "cdt_preemptions_total",
+    "Jobs preempted at a denoise segment boundary, by reason "
+    "(priority = a higher class was waiting; drain = the worker is "
+    "leaving; manual = operator request). Intentional departure — never "
+    "poison or breaker evidence.",
+    ("reason",))
+
+JOBS_PREEMPTED = REGISTRY.gauge(
+    "cdt_jobs_preempted",
+    "Jobs currently parked mid-denoise (checkpoint held, waiting to "
+    "resume).")
+
+CHECKPOINT_BYTES = REGISTRY.gauge(
+    "cdt_checkpoint_bytes",
+    "Bytes of latent checkpoints held, by tier (memory / persisted).",
+    ("tier",))
+
+RESUME_SECONDS = REGISTRY.histogram(
+    "cdt_resume_seconds",
+    "Restore-to-first-segment-complete wall-clock when a preempted job "
+    "resumes from its checkpoint (device upload + one segment program).")
+
+CHECKPOINT_DEAD_LETTERS = REGISTRY.counter(
+    "cdt_checkpoint_dead_letters_total",
+    "Checkpoints dead-lettered after exhausting the resume-retry bound "
+    "(CDT_PREEMPT_RESUME_RETRIES) — the job restarts from scratch "
+    "instead of looping on a checkpoint that cannot restore.")
+
 # --- prompt queue -----------------------------------------------------------
 
 PROMPTS_TOTAL = REGISTRY.counter(

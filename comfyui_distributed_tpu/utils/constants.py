@@ -527,6 +527,43 @@ AUTOSCALE_DOWN_COOLDOWN_S = knob_float(
     "Min seconds between scale-downs (removing capacity is reluctant).",
     doc="docs/elasticity.md").get()
 
+# --- step-granular preemption (cluster/preemption.py, docs/preemption.md) ---
+PREEMPT = knob_bool(
+    "CDT_PREEMPT", True, "preemption",
+    "Step-granular preemption: run serving sampler loops in resumable "
+    "segments and let higher-priority work (or a drain) preempt the "
+    "running job at the next segment boundary (0 = monolithic scans, "
+    "no preemption).", doc="docs/preemption.md")
+PREEMPT_SEGMENT_STEPS = knob_int(
+    "CDT_PREEMPT_SEGMENT_STEPS", 8, "preemption",
+    "Denoise steps per resumable segment — the preemption granularity "
+    "(smaller = faster preemption, more per-segment dispatch overhead).",
+    doc="docs/preemption.md")
+PREEMPT_MAX = knob_int(
+    "CDT_PREEMPT_MAX", 4, "preemption",
+    "Per-job preemption bound: past this many preemptions a job runs to "
+    "completion (starvation guard).", doc="docs/preemption.md")
+PREEMPT_RESUME_RETRIES = knob_int(
+    "CDT_PREEMPT_RESUME_RETRIES", 2, "preemption",
+    "Restore attempts before a checkpoint is dead-lettered and its job "
+    "restarts from scratch (a checkpoint that cannot restore must not "
+    "loop).", doc="docs/preemption.md")
+PREEMPT_SWEEP_S = knob_float(
+    "CDT_PREEMPT_SWEEP_S", 0.5, "preemption",
+    "Queued-deadline sweep cadence (seconds): a job whose deadline "
+    "passes while queued goes terminal 'expired' within one sweep, not "
+    "only when a dispatch next touches it (0 = sweep off).",
+    doc="docs/preemption.md")
+CKPT_MEM_BYTES = knob_int(
+    "CDT_CKPT_MEM_BYTES", 512 * 1024 * 1024, "preemption",
+    "In-memory latent-checkpoint store cap (bytes, LRU; pinned = the "
+    "currently-resuming entry).", doc="docs/preemption.md")
+CKPT_DIR = knob_str(
+    "CDT_CKPT_DIR", None, "preemption",
+    "Optional persisted checkpoint tier directory (checksummed sidecar "
+    "files; unset/empty = memory-only).", doc="docs/preemption.md",
+    keep_empty=True)
+
 # --- VAE decode tiling ------------------------------------------------------
 # 3D-VAE decodes switch to spatially-tiled mode when the latent frame area
 # exceeds this (latent pixels): a 480p WAN clip decode holds >31 GB of f32

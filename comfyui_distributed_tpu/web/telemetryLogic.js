@@ -110,7 +110,31 @@ export function telemetryRows(metrics) {
   rows.push(["Front door", frontDoorSummary(metrics)]);
   rows.push(["Content cache", cacheSummary(metrics)]);
   rows.push(["Elastic fleet", elasticSummary(metrics)]);
+  rows.push(["Preemption", preemptionSummary(metrics)]);
   return rows;
+}
+
+// Step-granular preemption (cluster/preemption.py): preempt counts by
+// reason, currently-parked jobs, checkpoint footprint, resume p95, and
+// the loud dead-letter counter that means a checkpoint repeatedly
+// failed restore (docs/preemption.md).
+export function preemptionSummary(metrics) {
+  const byReason = countsByLabel(metrics, "cdt_preemptions_total", "reason");
+  const total = Object.values(byReason).reduce((a, b) => a + b, 0);
+  const parked = seriesSum(metrics, "cdt_jobs_preempted");
+  if (!total && !parked) return "none";
+  const parts = [];
+  if (total > 0) parts.push(fmtCounts(byReason));
+  if (parked > 0) parts.push(`${parked} parked`);
+  const bytes = seriesSum(metrics, "cdt_checkpoint_bytes");
+  if (bytes > 0) parts.push(`${(bytes / (1024 * 1024)).toFixed(1)} MB ckpt`);
+  const resume = mergeHistogram(metrics, "cdt_resume_seconds");
+  if (resume && resume.count) {
+    parts.push(`resume p95 ${fmtSeconds(histQuantile(resume, 0.95))}`);
+  }
+  const dead = seriesSum(metrics, "cdt_checkpoint_dead_letters_total");
+  if (dead > 0) parts.push(`${dead} DEAD-LETTERED`);
+  return parts.join(" · ");
 }
 
 // Content cache (cluster/cache): per-tier hit rates, coalesce width, and

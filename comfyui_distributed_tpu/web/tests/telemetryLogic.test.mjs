@@ -5,7 +5,8 @@ import { test } from "node:test";
 
 import { breakerSummary, cacheSummary, countsByLabel, elasticSummary,
          fmtSeconds, frontDoorSummary, histQuantile, mergeHistogram,
-         seriesSum, telemetryRows } from "../telemetryLogic.js";
+         preemptionSummary, seriesSum,
+         telemetryRows } from "../telemetryLogic.js";
 
 const METRICS = {
   cdt_prompts_total: {
@@ -221,6 +222,52 @@ test("cacheSummary reports per-tier hit rates and the loud counters", () => {
     type: "histogram",
     series: [{ labels: {}, buckets: [[1, 3]], sum: 3, count: 3 }] } }),
     "no cacheable traffic");
+});
+
+test("preemptionSummary reports reasons, parked state, and dead-letters", () => {
+  assert.equal(preemptionSummary({}), "none");
+  const metrics = {
+    cdt_preemptions_total: {
+      type: "counter",
+      series: [
+        { labels: { reason: "priority" }, value: 4 },
+        { labels: { reason: "drain" }, value: 1 },
+      ],
+    },
+    cdt_jobs_preempted: {
+      type: "gauge",
+      series: [{ labels: {}, value: 2 }],
+    },
+    cdt_checkpoint_bytes: {
+      type: "gauge",
+      series: [
+        { labels: { tier: "memory" }, value: 3 * 1024 * 1024 },
+        { labels: { tier: "persisted" }, value: 1024 * 1024 },
+      ],
+    },
+    cdt_resume_seconds: {
+      type: "histogram",
+      series: [{ labels: {}, buckets: [[0.1, 0], [1.0, 3], [10.0, 4]],
+                 sum: 2.4, count: 4 }],
+    },
+    cdt_checkpoint_dead_letters_total: {
+      type: "counter",
+      series: [{ labels: {}, value: 1 }],
+    },
+  };
+  const row = preemptionSummary(metrics);
+  assert.match(row, /4 priority/);
+  assert.match(row, /1 drain/);
+  assert.match(row, /2 parked/);
+  assert.match(row, /4\.0 MB ckpt/);
+  assert.match(row, /resume p95 10\.00s/);
+  assert.match(row, /1 DEAD-LETTERED/);
+  const byKey = Object.fromEntries(telemetryRows(metrics));
+  assert.match(byKey["Preemption"], /4 priority/);
+  // a parked job with no preemptions yet (gauge-only) renders WITHOUT
+  // a dangling "none ·" fragment
+  assert.equal(preemptionSummary({ cdt_jobs_preempted: {
+    type: "gauge", series: [{ labels: {}, value: 1 }] } }), "1 parked");
 });
 
 test("telemetryRows tolerates absent families and renders the rest", () => {

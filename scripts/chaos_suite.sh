@@ -53,7 +53,7 @@ env JAX_PLATFORMS=cpu CDT_CHAOS_SEED="${SEED}" \
 echo "[chaos] stage 3: full chaos tier"
 env JAX_PLATFORMS=cpu CDT_CHAOS_SEED="${SEED}" \
     python -m pytest tests/ -q -m chaos \
-    -k "not warm_restarted and not overload and not scale_event and not cache_corrupt and not mesh_drain" \
+    -k "not warm_restarted and not overload and not scale_event and not cache_corrupt and not mesh_drain and not preempt" \
     -p no:cacheprovider --continue-on-collection-errors "$@"
 
 # Stage 4 — seeded scale events under live load (ISSUE 10,
@@ -105,3 +105,27 @@ echo "[chaos] stage 6: mesh-tier drain (bit-identical, lock-order armed)"
 env JAX_PLATFORMS=cpu CDT_CHAOS_SEED="${SEED}" CDT_LOCK_ORDER=1 \
     python -m pytest tests/ -q -m chaos -k "mesh_drain" \
     -p no:cacheprovider --continue-on-collection-errors "$@"
+
+# Stage 7 — step-granular preemption (ISSUE 14, docs/preemption.md):
+# (a) the chaos-marked acceptance tests under the runtime lock-order
+# detector — a job preempted mid-denoise and resumed locally AND on a
+# different worker is bit-identical to an uninterrupted run (zero
+# dead-letters, no breaker opens), a preemption landing mid mesh-tier
+# batch traffic records zero lock inversions, and a checkpoint that
+# cannot restore dead-letters after its bounded retries then completes
+# from scratch; (b) load_smoke --preempt — a long video-class job
+# churns under a seeded interactive workload, exit 1 unless the long
+# job completes, at least one preemption fired, and interactive p99
+# stays bounded (the full-residual failure mode this subsystem
+# removes). The compile cache dir keeps re-runs warm so one-time
+# compiles don't pollute the latency signal.
+echo "[chaos] stage 7: preemption (bit-identical resume, bounded interactive p99)"
+env JAX_PLATFORMS=cpu CDT_CHAOS_SEED="${SEED}" CDT_LOCK_ORDER=1 \
+    python -m pytest tests/ -q -m chaos -k "preempt" \
+    -p no:cacheprovider --continue-on-collection-errors "$@"
+echo "[chaos] stage 7b: preempt load smoke (interactive p99 under a long job)"
+env JAX_PLATFORMS=cpu PYTHONPATH="$(pwd)" \
+    CDT_CONFIG_PATH="$(mktemp -d)/config.json" \
+    CDT_COMPILE_CACHE_DIR="${CDT_COMPILE_CACHE_DIR:-/tmp/cdt_xla_cache_chaos}" \
+    python scripts/load_smoke.py --in-process --preempt --n 6 \
+    --concurrency 4 --seed "${SEED}"

@@ -1,0 +1,893 @@
+"""Step-granular preemption (ISSUE 14, docs/preemption.md).
+
+Layers under test, cheap to expensive:
+
+- priority-ordered dequeue + the queued-deadline sweep (fake clock);
+- the preemption controller's policy (strictly-higher-only, drain
+  override, starvation guard, bounded restore retries);
+- the chaos acceptance with REAL tiny models: a job preempted
+  mid-denoise and resumed — locally and on a DIFFERENT worker — is
+  BIT-identical to an uninterrupted run, with zero dead-letters and no
+  breaker opens; and a preemption landing mid mesh-tier-batch traffic
+  under the runtime lock-order detector.
+"""
+
+import asyncio
+import types
+
+import numpy as np
+import pytest
+
+from comfyui_distributed_tpu.cluster.preemption import (
+    PreemptionController, PreemptionToken)
+from comfyui_distributed_tpu.cluster.runtime import (PromptQueue,
+                                                     _dequeue_key)
+from comfyui_distributed_tpu.diffusion.checkpoint import (CheckpointStore,
+                                                          LatentCheckpoint)
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+def prim_prompt(v=1):
+    return {"1": {"class_type": "PrimitiveInt", "inputs": {"value": v}}}
+
+
+def txt2img_prompt(seed: int, steps: int, text: str = "x",
+                   wh: int = 16) -> dict:
+    return {
+        "1": {"class_type": "CheckpointLoader",
+              "inputs": {"ckpt_name": "tiny"}},
+        "2": {"class_type": "CLIPTextEncode",
+              "inputs": {"text": text, "clip": ["1", 1]}},
+        "3": {"class_type": "CLIPTextEncode",
+              "inputs": {"text": "", "clip": ["1", 1]}},
+        "4": {"class_type": "TPUTxt2Img", "inputs": {
+            "model": ["1", 0], "positive": ["2", 0], "negative": ["3", 0],
+            "seed": seed, "steps": steps, "cfg": 2.0,
+            "width": wh, "height": wh}},
+    }
+
+
+# --------------------------------------------------------------------------
+# priority-ordered dequeue
+# --------------------------------------------------------------------------
+
+
+class TestPriorityDequeue:
+    def test_order_priority_then_resume_then_arrival(self, tmp_config):
+        async def body():
+            q = PromptQueue()
+            # enqueue synchronously (the consumer can't run until we
+            # yield to the loop) and inspect the pop order directly
+            b1, _ = q.enqueue(prim_prompt(1), priority="batch")
+            b2, _ = q.enqueue(prim_prompt(2), priority="batch")
+            i1, _ = q.enqueue(prim_prompt(3), priority="interactive")
+            i2, _ = q.enqueue(prim_prompt(4), priority="interactive")
+            # mark b2 as a parked resume: it beats b1 within the class
+            for job in q._pending:
+                if job.prompt_id == b2:
+                    job.checkpoint_id = "ck_test"
+            order = []
+            while True:
+                job = q._pop_next()
+                if job is None:
+                    break
+                order.append(job.prompt_id)
+            assert order == [i1, i2, b2, b1]
+            await q.stop()
+        run(body())
+
+    def test_pending_best_rank_counts_group_members(self, tmp_config):
+        async def body():
+            from comfyui_distributed_tpu.cluster.runtime import PromptJob
+
+            q = PromptQueue()
+            assert q.pending_best_rank() is None
+            q.enqueue(prim_prompt(), priority="batch")
+            assert q.pending_best_rank() == 1
+            members = [PromptJob(f"m{i}", prim_prompt(), priority="batch")
+                       for i in range(2)]
+            members[1].priority = "interactive"
+            q.enqueue_batch(members, {})
+            assert q.pending_best_rank() == 0
+            await q.stop()
+        run(body())
+
+    def test_dequeue_key_shape(self):
+        from comfyui_distributed_tpu.cluster.runtime import PromptJob
+
+        fresh = PromptJob("a", {}, priority="interactive", seq=5)
+        resume = PromptJob("b", {}, priority="interactive", seq=9,
+                           checkpoint_id="ck")
+        assert _dequeue_key(resume) < _dequeue_key(fresh)
+
+
+# --------------------------------------------------------------------------
+# queued-deadline sweep (satellite 2) — fake clock
+# --------------------------------------------------------------------------
+
+
+class TestDeadlineSweep:
+    def test_expire_stale_fake_clock(self, tmp_config):
+        async def body():
+            q = PromptQueue()
+            fired = []
+            q.add_job_done_callback(lambda: fired.append(1))
+            stale, _ = q.enqueue(prim_prompt(1), priority="batch",
+                                 deadline_at=100.0)
+            fresh, _ = q.enqueue(prim_prompt(2), priority="batch",
+                                 deadline_at=500.0)
+            # the consumer hasn't run (no await since enqueue)
+            assert q.expire_stale(now=200.0) == 1
+            assert q.history[stale]["status"] == "expired"
+            assert "queued" in q.history[stale]["error"]
+            assert fresh not in q.history
+            assert q.queue_remaining == 1
+            assert fired == [1]          # observers saw the transition
+            # idempotent: a second sweep finds nothing
+            assert q.expire_stale(now=200.0) == 0
+            await q.stop()
+        run(body())
+
+    def test_partially_stale_group_waits_for_execution(self, tmp_config):
+        async def body():
+            from comfyui_distributed_tpu.cluster.runtime import PromptJob
+
+            q = PromptQueue()
+            m1 = PromptJob("g1", prim_prompt(), priority="batch",
+                           deadline_at=100.0)
+            m2 = PromptJob("g2", prim_prompt(), priority="batch",
+                           deadline_at=900.0)
+            q.enqueue_batch([m1, m2], {})
+            assert q.expire_stale(now=200.0) == 0     # m2 still fresh
+            assert q.queue_remaining == 1
+            assert q.expire_stale(now=1000.0) == 2    # whole group stale
+            assert q.history["g1"]["status"] == "expired"
+            assert q.history["g2"]["status"] == "expired"
+            assert q.queue_remaining == 0
+            await q.stop()
+        run(body())
+
+    def test_sweep_timer_expires_without_any_queue_touch(
+            self, tmp_config, monkeypatch):
+        """The satellite's point: expiry must NOT wait for a flush or
+        dispatch to touch the queue — the timer alone gets there."""
+        monkeypatch.setenv("CDT_PREEMPT_SWEEP_S", "0.02")
+
+        async def body2():
+            import time as _time
+
+            q = PromptQueue()
+            pid, _ = q.enqueue(prim_prompt(), priority="batch",
+                               deadline_at=_time.monotonic() - 0.01)
+            # the consumer would also expire it at dispatch; beat it by
+            # removing the wake token so ONLY the sweep can act
+            q._wake.get_nowait()
+            assert q._sweep_task is not None and not q._sweep_task.done()
+            for _ in range(100):
+                if q.history.get(pid):
+                    break
+                await asyncio.sleep(0.02)
+            assert q.history[pid]["status"] == "expired"
+            await q.stop()
+        run(body2())
+
+
+# --------------------------------------------------------------------------
+# controller policy (no models)
+# --------------------------------------------------------------------------
+
+
+def _fake_queue(executing=None, best_rank=None):
+    q = types.SimpleNamespace()
+    q.executing_job = executing
+    q.pending_best_rank = lambda: best_rank
+    return q
+
+
+def _job(pid="p1", priority="batch", group=None, checkpoint_id=None,
+         preempt_count=0):
+    from comfyui_distributed_tpu.cluster.runtime import PromptJob
+
+    j = PromptJob(pid, {}, priority=priority, checkpoint_id=checkpoint_id)
+    j.group = group
+    j.preempt_count = preempt_count
+    return j
+
+
+class TestControllerPolicy:
+    def _controller(self, queue, **store_kw):
+        store_kw.setdefault("max_bytes", 1 << 20)
+        store_kw.setdefault("directory", None)
+        return PreemptionController(queue, store=CheckpointStore(**store_kw))
+
+    def test_strictly_higher_priority_triggers(self):
+        job = _job(priority="batch")
+        pre = self._controller(_fake_queue(job, best_rank=0))
+        pre.reevaluate()
+        assert pre.requested_reason(job.prompt_id) == "priority"
+
+    def test_equal_or_lower_priority_does_not(self):
+        job = _job(priority="interactive")
+        pre = self._controller(_fake_queue(job, best_rank=0))
+        pre.reevaluate()                       # equal class: no preempt
+        assert pre.requested_reason(job.prompt_id) is None
+        job2 = _job(priority="batch")
+        pre2 = self._controller(_fake_queue(job2, best_rank=1))
+        pre2.reevaluate()
+        assert pre2.requested_reason(job2.prompt_id) is None
+
+    def test_group_jobs_never_targeted(self):
+        job = _job(priority="batch", group=[_job("m", "batch")])
+        pre = self._controller(_fake_queue(job, best_rank=0))
+        pre.reevaluate()
+        assert pre.requested_reason(job.prompt_id) is None
+        assert pre.preempt_executing("drain") is None
+        assert pre.begin(job) is None
+
+    def test_drain_outranks_priority_request(self):
+        job = _job()
+        pre = self._controller(_fake_queue(job, best_rank=0))
+        pre.preempt_executing("drain")
+        pre.reevaluate()       # must not downgrade the drain request
+        assert pre.requested_reason(job.prompt_id) == "drain"
+
+    def test_starvation_guard_blocks_priority_not_drain(self, monkeypatch):
+        monkeypatch.setenv("CDT_PREEMPT_MAX", "2")
+        job = _job(preempt_count=2)
+        pre = self._controller(_fake_queue(job, best_rank=0))
+        token = pre.begin(job)
+        assert token is not None and not token.preemptible
+        pre._request(job.prompt_id, "priority")
+        assert token.should_preempt() is None
+        pre._requests[job.prompt_id] = "drain"
+        assert token.should_preempt() == "drain"
+
+    def test_begin_with_lost_checkpoint_runs_scratch(self):
+        job = _job(checkpoint_id="ck_gone")
+        pre = self._controller(_fake_queue())
+        token = pre.begin(job)
+        assert token is not None and token.resume is None
+        assert job.checkpoint_id is None
+
+    def test_park_and_resolve_roundtrip(self):
+        job = _job()
+        pre = self._controller(_fake_queue())
+        ck = LatentCheckpoint("euler", 2, 8,
+                              (np.zeros((1, 2, 2, 4), np.float32),))
+        cid = pre.park(job, ck, "priority")
+        assert job.checkpoint_id == cid
+        assert job.preempt_count == 1
+        assert pre.store.get(cid) is not None
+        assert ck.meta["prompt_id"] == job.prompt_id
+        pre.resolve_success(job)
+        assert job.checkpoint_id is None
+        assert pre.store.get(cid) is None
+        assert pre.counts["resumed"] == 1
+        assert pre.store.counts["restored"] == 1
+
+    def test_restore_failed_bounds_then_scratch(self, monkeypatch):
+        job = _job()
+        pre = self._controller(_fake_queue(), resume_retries=2)
+        ck = LatentCheckpoint("euler", 2, 8,
+                              (np.zeros((1, 2, 2, 4), np.float32),))
+        pre.park(job, ck, "priority")
+        assert pre.restore_failed(job, "mismatch") == "retry"
+        assert job.checkpoint_id is not None
+        assert pre.restore_failed(job, "mismatch") == "scratch"
+        assert job.checkpoint_id is None
+        assert pre.counts["dead_lettered"] == 1
+        assert pre.store.stats()["dead_letter"]
+
+    def test_stats_surface(self):
+        pre = self._controller(_fake_queue())
+        st = pre.stats()
+        assert st["enabled"] is True
+        assert "store" in st and "parked_jobs" in st
+
+
+class TestReviewHardening:
+    def test_interrupt_releases_parked_checkpoint(self, tmp_config):
+        """Review-hardening: a parked job dropped by interrupt() must
+        release its checkpoint (store bytes) and its gauge slot."""
+        async def body():
+            q = PromptQueue()
+            q.preemption = PreemptionController(
+                q, store=CheckpointStore(max_bytes=1 << 20,
+                                         directory=None))
+            pid, _ = q.enqueue(prim_prompt(), priority="batch")
+            job = q._pending[0]
+            ck = LatentCheckpoint(
+                "euler", 2, 8,
+                (np.zeros((1, 2, 2, 4), np.float32),))
+            cid = q.preemption.park(job, ck, "priority")
+            assert q.preemption.store.get(cid) is not None
+            q.interrupt()
+            assert q.preemption.store.get(cid) is None
+            assert not q.preemption.stats()["parked_jobs"]
+            await q.stop()
+        run(body())
+
+    def test_expiry_releases_parked_checkpoint(self, tmp_config):
+        async def body():
+            q = PromptQueue()
+            q.preemption = PreemptionController(
+                q, store=CheckpointStore(max_bytes=1 << 20,
+                                         directory=None))
+            pid, _ = q.enqueue(prim_prompt(), priority="batch",
+                               deadline_at=100.0)
+            job = q._pending[0]
+            ck = LatentCheckpoint(
+                "euler", 3, 8,
+                (np.zeros((1, 2, 2, 4), np.float32),))
+            cid = q.preemption.park(job, ck, "priority")
+            assert q.expire_stale(now=200.0) == 1
+            assert q.preemption.store.get(cid) is None
+            assert not q.preemption.stats()["parked_jobs"]
+            await q.stop()
+        run(body())
+
+    def test_dispatch_expiry_releases_parked_checkpoint(self, tmp_config):
+        """Review-hardening round 2: the expired-at-dispatch terminal
+        path (not just the sweep) must release a resumed job's parked
+        checkpoint."""
+        async def body():
+            import time as _time
+
+            q = PromptQueue()
+            q.preemption = PreemptionController(
+                q, store=CheckpointStore(max_bytes=1 << 20,
+                                         directory=None))
+            pid, _ = q.enqueue(prim_prompt(), priority="batch",
+                               deadline_at=_time.monotonic() - 1.0)
+            job = q._pending[0]
+            ck = LatentCheckpoint(
+                "euler", 2, 8,
+                (np.zeros((1, 2, 2, 4), np.float32),))
+            cid = q.preemption.park(job, ck, "priority")
+            entry = await _wait_terminal(q, pid, timeout=10.0)
+            assert entry["status"] == "expired"
+            assert q.preemption.store.get(cid) is None
+            assert not q.preemption.stats()["parked_jobs"]
+            await q.stop()
+        run(body())
+
+    def test_sweep_expires_preempted_job_despite_history_row(
+            self, tmp_config):
+        """Review-hardening round 2: the non-terminal 'preempted'
+        history row must NOT shield a parked job from the deadline
+        sweep."""
+        async def body():
+            q = PromptQueue()
+            q.preemption = PreemptionController(
+                q, store=CheckpointStore(max_bytes=1 << 20,
+                                         directory=None))
+            pid, _ = q.enqueue(prim_prompt(), priority="batch",
+                               deadline_at=100.0)
+            job = q._pending[0]
+            ck = LatentCheckpoint(
+                "euler", 2, 8,
+                (np.zeros((1, 2, 2, 4), np.float32),))
+            cid = q.preemption.park(job, ck, "priority")
+            # what _run_solo writes when it parks: a NON-terminal row
+            q.history[pid] = {"status": "preempted",
+                              "preempted_at_step": 2, "total_steps": 8,
+                              "checkpoint_id": cid}
+            assert q.expire_stale(now=200.0) == 1
+            assert q.history[pid]["status"] == "expired"
+            assert q.preemption.store.get(cid) is None
+            await q.stop()
+        run(body())
+
+    def test_resume_ignored_by_samplerless_graph_is_loud_not_phantom(
+            self, tmp_config):
+        """Review-hardening round 3: a resume request whose graph never
+        feeds the checkpoint to a preemptible sampler completes from
+        scratch with an explicit ``resume_ignored`` marker — never a
+        phantom 'resumed' count."""
+        async def body():
+            q = PromptQueue()
+            q.preemption = PreemptionController(
+                q, store=CheckpointStore(max_bytes=1 << 20,
+                                         directory=None))
+            ck = LatentCheckpoint(
+                "euler", 2, 8,
+                (np.zeros((1, 2, 2, 4), np.float32),),
+                meta={"seed": 1})
+            cid = q.preemption.store.park(ck)
+            pid, _ = q.enqueue(prim_prompt(), priority="batch",
+                               checkpoint_id=cid)
+            entry = await _wait_terminal(q, pid, timeout=10.0)
+            assert entry["status"] == "success"
+            assert entry.get("resume_ignored") is True
+            assert q.preemption.counts["resumed"] == 0
+            assert q.preemption.store.get(cid) is None   # released
+            await q.stop()
+        run(body())
+
+    def test_park_id_collision_assigns_fresh_id(self):
+        """Review-hardening round 3: an import reusing a live id with
+        DIFFERENT state must not clobber the parked checkpoint."""
+        store = CheckpointStore(max_bytes=1 << 20, directory=None)
+        a = LatentCheckpoint("euler", 2, 8,
+                             (np.zeros((1, 2, 2, 4), np.float32),))
+        cid_a = store.park(a)
+        b = LatentCheckpoint("euler", 2, 8,
+                             (np.ones((1, 2, 2, 4), np.float32),),
+                             checkpoint_id=cid_a)
+        cid_b = store.park(b)
+        assert cid_b != cid_a
+        back_a = store.get(cid_a)
+        assert back_a is not None
+        assert float(back_a.carry[0].max()) == 0.0      # A untouched
+        assert float(store.get(cid_b).carry[0].max()) == 1.0
+        # idempotent re-park of IDENTICAL state keeps the id
+        assert store.park(LatentCheckpoint(
+            "euler", 2, 8,
+            (np.zeros((1, 2, 2, 4), np.float32),),
+            checkpoint_id=cid_a)) == cid_a
+
+    def test_stats_exposes_live_request_map(self):
+        pre = PreemptionController(
+            _fake_queue(), store=CheckpointStore(max_bytes=1 << 20,
+                                                 directory=None))
+        pre._request("p_live", "priority")
+        st = pre.stats()
+        # the live map must not be shadowed by the counter (key clash)
+        assert st["requests"] == {"p_live": "priority"}
+        assert st["preempt_requests"] == 1
+
+    def test_checkpoint_identity_binds_conditioning_content(self):
+        """Review-hardening: same sampler/geometry/seed but a DIFFERENT
+        prompt must not pass identity validation — a checkpoint may
+        never resume under someone else's conditioning."""
+        import jax
+        import jax.numpy as jnp
+
+        from comfyui_distributed_tpu.diffusion.pipeline import (
+            GenerationSpec, Txt2ImgPipeline)
+        from comfyui_distributed_tpu.models.unet import (UNetConfig,
+                                                         init_unet)
+        from comfyui_distributed_tpu.models.vae import (AutoencoderKL,
+                                                        VAEConfig)
+        from comfyui_distributed_tpu.parallel.mesh import build_mesh
+
+        model, params = init_unet(UNetConfig.tiny(), jax.random.key(0),
+                                  sample_shape=(8, 8, 4), context_len=16)
+        vae = AutoencoderKL(VAEConfig.tiny()).init(jax.random.key(1),
+                                                   image_hw=(16, 16))
+        pipe = Txt2ImgPipeline(model, params, vae)
+        mesh = build_mesh({"dp": 1})
+        spec = GenerationSpec(height=16, width=16, steps=4)
+        ctx_a = jnp.ones((1, 4, 8), jnp.float32)
+        ctx_b = ctx_a.at[0, 0, 0].set(2.0)
+        unc = jnp.zeros((1, 4, 8), jnp.float32)
+        ident_a = pipe.checkpoint_identity(
+            mesh, spec, 7, conditioning=(ctx_a, unc, None, None))
+        ident_b = pipe.checkpoint_identity(
+            mesh, spec, 7, conditioning=(ctx_b, unc, None, None))
+        assert ident_a["conditioning"] != ident_b["conditioning"]
+        ck = LatentCheckpoint("euler", 1, 4,
+                              (np.zeros((1, 8, 8, 4), np.float32),),
+                              meta=ident_a)
+        ck.validate_meta(ident_a)
+        from comfyui_distributed_tpu.diffusion.checkpoint import (
+            CheckpointRestoreError)
+
+        with pytest.raises(CheckpointRestoreError, match="conditioning"):
+            ck.validate_meta(ident_b)
+
+
+class TestDrainPreempts:
+    def test_drain_coordinator_invokes_preempter(self, tmp_config):
+        from comfyui_distributed_tpu.cluster.elastic.drain import (
+            DrainCoordinator)
+        from comfyui_distributed_tpu.cluster.elastic.states import (
+            DrainRegistry)
+
+        class _Store:
+            async def worker_held_tasks(self, wid):
+                return {}
+
+            async def handback_worker_tasks(self, wid):
+                return {}
+
+        calls = []
+
+        async def body():
+            coord = DrainCoordinator(
+                _Store(), registry=DrainRegistry(),
+                preempter=lambda: calls.append("preempt") or "p_123")
+            coord.begin("w1", deadline_s=1.0, stop_process=False)
+            report = await coord.wait("w1")
+            assert calls == ["preempt"]
+            assert report["preempted_prompt"] == "p_123"
+            assert report["phase"] == "decommissioned"
+        run(body())
+
+    def test_drain_survives_broken_preempter(self, tmp_config):
+        from comfyui_distributed_tpu.cluster.elastic.drain import (
+            DrainCoordinator)
+        from comfyui_distributed_tpu.cluster.elastic.states import (
+            DrainRegistry)
+
+        class _Store:
+            async def worker_held_tasks(self, wid):
+                return {}
+
+            async def handback_worker_tasks(self, wid):
+                return {}
+
+        def boom():
+            raise RuntimeError("no controller")
+
+        async def body():
+            coord = DrainCoordinator(_Store(), registry=DrainRegistry(),
+                                     preempter=boom)
+            coord.begin("w1", deadline_s=1.0, stop_process=False)
+            report = await coord.wait("w1")
+            assert report["phase"] == "decommissioned"
+            assert "no controller" in report["preempt_error"]
+        run(body())
+
+
+# --------------------------------------------------------------------------
+# E2E with real tiny models (chaos acceptance)
+# --------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def exec_context():
+    import jax
+
+    from comfyui_distributed_tpu.models.registry import ModelRegistry
+    from comfyui_distributed_tpu.parallel.mesh import build_mesh
+
+    registry = ModelRegistry(None)
+    mesh = build_mesh({"dp": 1})
+    return lambda: {"mesh": mesh, "model_registry": registry}
+
+
+async def _wait_terminal(q, pid, timeout=240.0):
+    import time as _time
+
+    deadline = _time.monotonic() + timeout
+    while _time.monotonic() < deadline:
+        e = q.history.get(pid)
+        if e is not None and e.get("status") in ("success", "error",
+                                                 "interrupted", "expired"):
+            return e
+        await asyncio.sleep(0.01)
+    raise AssertionError(f"{pid} never terminal: {q.history.get(pid)}")
+
+
+def _assert_no_failure_evidence():
+    from comfyui_distributed_tpu.cluster.resilience import BREAKERS
+
+    for wid, b in getattr(BREAKERS, "_breakers", {}).items():
+        assert getattr(b, "state", "closed") == "closed", (wid, b.state)
+
+
+class TestPreemptionE2E:
+    @pytest.mark.chaos
+    def test_preempt_resume_bit_identical_interactive_first(
+            self, tmp_config, monkeypatch, exec_context):
+        """Acceptance core: the long batch-class job yields at a segment
+        boundary, the interactive request completes FIRST, and the
+        resumed long job's output is bit-identical to an uninterrupted
+        run. Zero dead-letters, no breaker opens."""
+        monkeypatch.setenv("CDT_PREEMPT_SEGMENT_STEPS", "2")
+
+        async def body():
+            # uninterrupted reference
+            ref_q = PromptQueue(context_factory=exec_context)
+            rid, errs = ref_q.enqueue(txt2img_prompt(7, 8, "long"),
+                                      priority="batch")
+            assert not errs
+            ref = await _wait_terminal(ref_q, rid)
+            assert ref["status"] == "success", ref
+            ref_img = np.asarray(ref["outputs"]["4"][0])
+            await ref_q.stop()
+
+            q = PromptQueue(context_factory=exec_context)
+            q.preemption = PreemptionController(
+                q, store=CheckpointStore(max_bytes=1 << 26,
+                                         directory=None))
+            long_id, _ = q.enqueue(txt2img_prompt(7, 8, "long"),
+                                   priority="batch")
+            while q.executing != long_id:
+                await asyncio.sleep(0.005)
+            inter_id, _ = q.enqueue(txt2img_prompt(9, 2, "quick"),
+                                    priority="interactive")
+            inter = await _wait_terminal(q, inter_id)
+            assert inter["status"] == "success"
+            # the long job is preempted (parked or already resuming)
+            # strictly before the interactive result landed
+            long_done = await _wait_terminal(q, long_id)
+            assert long_done["status"] == "success"
+            assert long_done.get("preemptions", 0) >= 1
+            got = np.asarray(long_done["outputs"]["4"][0])
+            assert np.array_equal(got, ref_img), (
+                f"maxdiff={np.abs(got - ref_img).max()}")
+            st = q.preemption.stats()
+            assert st["preempted"] >= 1
+            assert st["dead_lettered"] == 0
+            assert not st["store"]["dead_letter"]
+            _assert_no_failure_evidence()
+            await q.stop()
+        run(body())
+
+    @pytest.mark.chaos
+    def test_preempted_job_resumes_on_different_worker_bit_identical(
+            self, tmp_config, monkeypatch, exec_context):
+        """THE resume-anywhere acceptance: preempt on worker A, move the
+        checkpoint via its wire form (the same payload the checkpoint
+        routes and the inline `checkpoint` queue field carry), resume on
+        a separate worker B — bit-identical to an uninterrupted run,
+        zero dead-letters, no breaker opens."""
+        monkeypatch.setenv("CDT_PREEMPT_SEGMENT_STEPS", "2")
+
+        async def body():
+            from comfyui_distributed_tpu.models.registry import ModelRegistry
+            from comfyui_distributed_tpu.parallel.mesh import build_mesh
+
+            # uninterrupted reference
+            ref_q = PromptQueue(context_factory=exec_context)
+            rid, _ = ref_q.enqueue(txt2img_prompt(21, 8, "video-ish"),
+                                   priority="batch")
+            ref = await _wait_terminal(ref_q, rid)
+            ref_img = np.asarray(ref["outputs"]["4"][0])
+            await ref_q.stop()
+
+            # worker A: run + force a preemption via the drain path
+            qa = PromptQueue(context_factory=exec_context)
+            qa.preemption = PreemptionController(
+                qa, store=CheckpointStore(max_bytes=1 << 26,
+                                          directory=None))
+            aid, _ = qa.enqueue(txt2img_prompt(21, 8, "video-ish"),
+                                priority="batch")
+            while qa.executing != aid:
+                await asyncio.sleep(0.005)
+            qa.preemption.preempt_executing("drain")
+            for _ in range(2000):
+                e = qa.history.get(aid)
+                if e and e.get("status") == "preempted":
+                    break
+                await asyncio.sleep(0.01)
+            entry = qa.history[aid]
+            assert entry["status"] == "preempted", entry
+            cid = entry["checkpoint_id"]
+            # wire form off worker A (what GET /distributed/checkpoint
+            # serves); stop A before it resumes locally
+            payload = qa.preemption.store.export_payload(cid)
+            assert payload is not None and payload["sha256"]
+            await qa.stop()
+
+            # worker B: a DIFFERENT controller instance with its own
+            # model registry (same seed-initialized tiny weights — the
+            # deterministic-weights story real fleets get from shared
+            # checkpoints) imports the payload and resumes
+            registry_b = ModelRegistry(None)
+            mesh_b = build_mesh({"dp": 1})
+            qb = PromptQueue(context_factory=lambda: {
+                "mesh": mesh_b, "model_registry": registry_b})
+            qb.preemption = PreemptionController(
+                qb, store=CheckpointStore(max_bytes=1 << 26,
+                                          directory=None))
+            ck = LatentCheckpoint.from_payload(payload)
+            cid_b = qb.preemption.store.park(ck)
+            bid, errs = qb.enqueue(txt2img_prompt(21, 8, "video-ish"),
+                                   priority="batch", checkpoint_id=cid_b)
+            assert not errs
+            done = await _wait_terminal(qb, bid)
+            assert done["status"] == "success", done
+            got = np.asarray(done["outputs"]["4"][0])
+            assert np.array_equal(got, ref_img), (
+                f"maxdiff={np.abs(got - ref_img).max()}")
+            st = qb.preemption.stats()
+            assert st["dead_lettered"] == 0
+            assert not st["store"]["dead_letter"]
+            _assert_no_failure_evidence()
+            await qb.stop()
+        run(body())
+
+    @pytest.mark.chaos
+    def test_preempt_mid_mesh_tier_batch_lock_order_clean(
+            self, tmp_config, monkeypatch, exec_context):
+        """Chaos stage 7's second leg: a front-door BATCH GROUP
+        (microbatched sampler program — the mesh-tier serving shape)
+        lands while a long solo job runs; the preemption parks the solo
+        job, the group executes as one program, the solo job resumes
+        bit-identically — all under the runtime lock-order detector
+        with zero inversions, and the group itself is never preempted
+        (it is one compiled program)."""
+        from comfyui_distributed_tpu.cluster.runtime import PromptJob
+        from comfyui_distributed_tpu.lint import lockorder
+
+        monkeypatch.setenv("CDT_PREEMPT_SEGMENT_STEPS", "2")
+        lockorder.reset()
+        lockorder.force_enabled(True)
+        try:
+            async def body():
+                ref_q = PromptQueue(context_factory=exec_context)
+                rid, _ = ref_q.enqueue(txt2img_prompt(31, 8, "long"),
+                                       priority="batch")
+                ref = await _wait_terminal(ref_q, rid)
+                ref_img = np.asarray(ref["outputs"]["4"][0])
+                await ref_q.stop()
+
+                q = PromptQueue(context_factory=exec_context)
+                q.preemption = PreemptionController(
+                    q, store=CheckpointStore(max_bytes=1 << 26,
+                                             directory=None))
+                long_id, _ = q.enqueue(txt2img_prompt(31, 8, "long"),
+                                       priority="batch")
+                while q.executing != long_id:
+                    await asyncio.sleep(0.005)
+                members = [
+                    PromptJob(f"mb{i}", txt2img_prompt(40 + i, 2, "mb"),
+                              priority="interactive")
+                    for i in range(2)]
+                q.enqueue_batch(members, {m.prompt_id: "4"
+                                          for m in members})
+                for m in members:
+                    e = await _wait_terminal(q, m.prompt_id)
+                    assert e["status"] == "success", e
+                    assert e.get("batch_size") == 2
+                long_done = await _wait_terminal(q, long_id)
+                assert long_done["status"] == "success"
+                assert long_done.get("preemptions", 0) >= 1
+                got = np.asarray(long_done["outputs"]["4"][0])
+                assert np.array_equal(got, ref_img)
+                st = q.preemption.stats()
+                assert st["dead_lettered"] == 0
+                _assert_no_failure_evidence()
+                await q.stop()
+            run(body())
+            lockorder.assert_clean()
+        finally:
+            lockorder.force_enabled(None)
+            lockorder.reset()
+
+    @pytest.mark.chaos
+    def test_preempt_restore_failure_dead_letters_then_scratch_success(
+            self, tmp_config, monkeypatch, exec_context):
+        """A checkpoint that cannot restore (wrong seed identity) burns
+        its bounded retries, dead-letters LOUDLY, and the job still
+        completes from scratch — no loop, no loss, no breaker."""
+        monkeypatch.setenv("CDT_PREEMPT_RESUME_RETRIES", "1")
+        monkeypatch.setenv("CDT_PREEMPT_SEGMENT_STEPS", "2")
+
+        async def body():
+            q = PromptQueue(context_factory=exec_context)
+            q.preemption = PreemptionController(
+                q, store=CheckpointStore(max_bytes=1 << 26,
+                                         directory=None,
+                                         resume_retries=1))
+            # park a checkpoint whose identity (seed) can't match
+            from comfyui_distributed_tpu.diffusion.pipeline import (
+                GenerationSpec, Txt2ImgPipeline)
+
+            ck = LatentCheckpoint(
+                "euler", 2, 8,
+                (np.zeros((1, 2, 2, 4), np.float32),),
+                meta={"seed": 999999, "sampler": "euler"})
+            cid = q.preemption.store.park(ck)
+            pid, _ = q.enqueue(txt2img_prompt(7, 8, "long"),
+                               priority="batch", checkpoint_id=cid)
+            done = await _wait_terminal(q, pid)
+            assert done["status"] == "success", done
+            st = q.preemption.stats()
+            assert st["dead_lettered"] == 1
+            assert st["store"]["dead_letter"]
+            _assert_no_failure_evidence()
+            await q.stop()
+        run(body())
+
+
+# --------------------------------------------------------------------------
+# API surfaces
+# --------------------------------------------------------------------------
+
+
+class TestPreemptionRoutes:
+    def test_checkpoint_export_import_and_stats_routes(self, tmp_config):
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from comfyui_distributed_tpu.api import create_app
+        from comfyui_distributed_tpu.cluster.controller import Controller
+
+        async def body():
+            controller = Controller()
+            client = TestClient(TestServer(create_app(controller)))
+            await client.start_server()
+            try:
+                ck = LatentCheckpoint(
+                    "euler", 3, 9,
+                    (np.full((1, 2, 2, 4), 2.5, np.float32),),
+                    meta={"seed": 1})
+                cid = controller.preemption.store.park(ck)
+                resp = await client.get(f"/distributed/checkpoint/{cid}")
+                assert resp.status == 200
+                payload = await resp.json()
+                assert payload["sha256"]
+                # import round-trips (same content → same id)
+                resp = await client.post("/distributed/checkpoint",
+                                         json=payload)
+                assert resp.status == 200
+                body_json = await resp.json()
+                assert body_json["checkpoint_id"] == cid
+                assert body_json["step"] == 3
+                # corrupt wire payload is a loud 400
+                bad = dict(payload)
+                bad["sha256"] = "0" * 64
+                resp = await client.post("/distributed/checkpoint",
+                                         json=bad)
+                assert resp.status == 400
+                resp = await client.get("/distributed/checkpoint/nope")
+                assert resp.status == 404
+                resp = await client.get("/distributed/preemption")
+                assert resp.status == 200
+                st = await resp.json()
+                assert st["enabled"] is True
+                assert st["store"]["entries"] >= 1
+            finally:
+                await client.close()
+        run(body())
+
+    def test_job_status_reports_preempted_at_step(self, tmp_config):
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from comfyui_distributed_tpu.api import create_app
+        from comfyui_distributed_tpu.cluster.controller import Controller
+
+        async def body():
+            controller = Controller()
+            controller.queue.history["p_x"] = {
+                "status": "preempted", "preempted_at_step": 12,
+                "total_steps": 200, "checkpoint_id": "ck_0012_ab",
+                "reason": "priority",
+            }
+            controller.queue.history["p_y"] = {
+                "status": "success", "preemptions": 2,
+            }
+            client = TestClient(TestServer(create_app(controller)))
+            await client.start_server()
+            try:
+                resp = await client.get("/distributed/job_status",
+                                        params={"job_id": "p_x"})
+                data = await resp.json()
+                assert data["exists"] and data["kind"] == "prompt"
+                assert data["preempted"] == "preempted@12/200"
+                assert data["checkpoint_id"] == "ck_0012_ab"
+                resp = await client.get("/distributed/job_status",
+                                        params={"job_id": "p_y"})
+                data = await resp.json()
+                assert data["preemptions"] == 2
+            finally:
+                await client.close()
+        run(body())
+
+    def test_queue_payload_validation(self, tmp_config):
+        from comfyui_distributed_tpu.api.queue_request import (
+            parse_queue_request_payload)
+        from comfyui_distributed_tpu.utils.exceptions import ValidationError
+
+        base = {"prompt": prim_prompt()}
+        ok = parse_queue_request_payload(
+            {**base, "checkpoint_id": "ck_0001_abcd"})
+        assert ok.checkpoint_id == "ck_0001_abcd"
+        with pytest.raises(ValidationError):
+            parse_queue_request_payload(
+                {**base, "checkpoint_id": "../evil"})
+        with pytest.raises(ValidationError):
+            parse_queue_request_payload({**base, "checkpoint": "nope"})
+        with pytest.raises(ValidationError):
+            # the sha256 is REQUIRED: unverifiable payloads are refused
+            parse_queue_request_payload(
+                {**base, "checkpoint": {"data": "QUJD"}})
+        ok = parse_queue_request_payload(
+            {**base, "checkpoint": {"data": "QUJD", "sha256": "aa"}})
+        assert ok.checkpoint == {"data": "QUJD", "sha256": "aa"}
