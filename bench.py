@@ -1814,6 +1814,242 @@ def run_caching_benchmark(steps: int, runs: int | None,
     }
 
 
+# denoise-program labels (bind_weights): what counts as "the mesh was
+# denoising" in the stages A/B — fused programs (decode folded in,
+# conservative for the staged claim) and the latent-only stage programs
+_DENOISE_LABELS = frozenset({"txt2img", "txt2img_mb", "txt2img_mb_tp",
+                             "txt2img_seg", "txt2img_lat",
+                             "txt2img_lat_tp"})
+
+
+def _denoise_program_seconds() -> float:
+    """Cumulative wall-clock inside denoise programs (execute + compile)
+    from the telemetry registry — callers take deltas around a leg."""
+    from comfyui_distributed_tpu.telemetry.registry import REGISTRY
+
+    snap = REGISTRY.snapshot()
+    total = 0.0
+    for fam_name in ("cdt_pipeline_execute_seconds",
+                     "cdt_pipeline_compile_seconds"):
+        for s in (snap.get(fam_name) or {}).get("series", []):
+            if (s.get("labels") or {}).get("pipeline") in _DENOISE_LABELS:
+                total += float(s.get("sum", 0.0))
+    return total
+
+
+async def _stages_drive(requests: list, staged: bool,
+                        timeout_s: float) -> dict:
+    """One leg of the stages A/B: the same seeded offered load through a
+    REAL in-process controller + HTTP route, fused (CDT_STAGES=0) or
+    disaggregated. Returns wall, latencies, per-request outputs, the
+    denoise-program seconds spent, and the mesh-lane busy seconds the
+    occupancy divides by (fused: the one graph-exec consumer; staged:
+    the denoise pool)."""
+    import asyncio
+    import math
+
+    import numpy as np
+    from aiohttp.test_utils import TestClient, TestServer
+
+    from comfyui_distributed_tpu.api import create_app
+    from comfyui_distributed_tpu.cluster.controller import Controller
+
+    os.environ["CDT_STAGES"] = "1" if staged else "0"
+    controller = Controller()
+    client = TestClient(TestServer(create_app(controller)))
+    await client.start_server()
+    try:
+        async def submit(payload):
+            resp = await client.post("/distributed/queue", json=payload)
+            return resp.status, await resp.json()
+
+        async def wait_done(pid):
+            deadline = time.monotonic() + timeout_s
+            while time.monotonic() < deadline:
+                entry = controller.queue.history.get(pid)
+                if entry is not None:
+                    return entry
+                await asyncio.sleep(0.02)
+            return {"status": "timeout"}
+
+        async def drive_wave(wave):
+            t_sub = {}
+
+            async def one(payload):
+                t0 = time.perf_counter()
+                status, body = await submit(dict(payload))
+                pid = body.get("prompt_id")
+                if status != 200 or not pid:
+                    return None, None, None
+                entry = await wait_done(pid)
+                return pid, entry, time.perf_counter() - t0
+
+            return await asyncio.gather(*(one(p) for p in wave))
+
+        # untimed warmup wave: the SAME shape/group structure with
+        # re-rolled seeds, so every bucket program (latent, decode,
+        # fused microbatch) compiles OFF the measured clock in both legs
+        warm = []
+        for r in requests:
+            w = json.loads(json.dumps(r))
+            sampler = next(v for v in w["prompt"].values()
+                           if v["class_type"] == "TPUTxt2Img")
+            sampler["inputs"]["seed"] += 100000
+            warm.append(w)
+        await drive_wave(warm)
+
+        busy0 = (controller.stages.denoise.busy_seconds if staged
+                 else controller.queue.busy_seconds)
+        den0 = _denoise_program_seconds()
+        t0 = time.perf_counter()
+        results = await drive_wave(requests)
+        wall = time.perf_counter() - t0
+        den = _denoise_program_seconds() - den0
+        busy = ((controller.stages.denoise.busy_seconds if staged
+                 else controller.queue.busy_seconds) - busy0)
+
+        outputs, lat, completed, errors = [], [], 0, 0
+        for pid, entry, dt in results:
+            entry = entry or {}
+            if entry.get("status") == "success":
+                completed += 1
+                lat.append(dt)
+            else:
+                errors += 1
+            arrays = []
+            for nid in sorted(entry.get("outputs") or {}):
+                for v in entry["outputs"][nid]:
+                    if hasattr(v, "shape"):
+                        arrays.append(np.asarray(v))
+            outputs.append(arrays)
+        lat.sort()
+
+        def pct(q):
+            return (round(lat[min(len(lat) - 1,
+                                  max(0, math.ceil(q * len(lat)) - 1))], 4)
+                    if lat else None)
+
+        leg = {
+            "staged": staged,
+            "wall_s": round(wall, 3),
+            "completed": completed,
+            "errors": errors,
+            "completed_rps": round(completed / wall, 4) if wall else None,
+            "latency_p50_s": pct(0.50),
+            "latency_p99_s": pct(0.99),
+            "denoise_program_s": round(den, 4),
+            "mesh_lane_busy_s": round(busy, 4),
+            # THE acceptance number: the share of the mesh-owning
+            # lane's busy time spent inside denoise programs. Fused,
+            # the lane also encodes and decodes; staged, those moved to
+            # their own pools (docs/stages.md)
+            "denoise_occupancy": (round(den / busy, 4) if busy else None),
+            "denoise_duty_of_wall": (round(den / wall, 4) if wall
+                                     else None),
+            "outputs": outputs,
+        }
+        if staged:
+            stats = controller.stages.stats()
+            leg["pools"] = stats["pools"]
+            leg["redispatched"] = stats["redispatched"]
+            sys.path.insert(0, os.path.join(os.path.dirname(__file__),
+                                            "scripts"))
+            import load_smoke
+
+            from comfyui_distributed_tpu.telemetry.export import \
+                render_json
+            from comfyui_distributed_tpu.telemetry.registry import REGISTRY
+
+            occ = load_smoke._occupancy_from_snapshot(
+                render_json(REGISTRY.snapshot()))
+            # the fused leg never observes cdt_decode_batch_size, so
+            # the cumulative histogram is this leg's alone
+            leg["mean_decode_batch"] = occ.get("mean_decode_batch")
+            leg["mean_batch_size"] = occ.get("mean_batch_size")
+        return leg
+    finally:
+        await client.close()
+
+
+def run_stages_benchmark(steps: int, runs: int | None,
+                         force_cpu: bool) -> dict:
+    """Stage-split serving A/B (ISSUE 15, docs/stages.md): the SAME
+    seeded mixed-shape offered load through the real controller + HTTP
+    route with the fused path (CDT_STAGES=0), then disaggregated.
+    Reported per leg: req/s, submit→terminal p50/p99, and the
+    denoise-pool occupancy (share of the mesh lane's busy time spent in
+    denoise programs — the number the stage split exists to raise);
+    plus the decode batch-size histogram mean for the staged leg.
+    Acceptance: staged occupancy strictly higher at the same offered
+    load, mean decode batch > 1, outputs bit-identical across legs.
+
+    CDT_CACHE=0 pins the content cache out of both legs so the A/B
+    isolates the stage-split lever (the caching workload owns that
+    one); tiny preset on CPU, same controller path on accel."""
+    import asyncio
+
+    import jax
+    import numpy as np
+
+    if force_cpu:
+        jax.config.update("jax_platforms", "cpu")
+    _enable_compile_cache()
+    platform = jax.devices()[0].platform
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "scripts"))
+    import load_smoke
+
+    os.environ.setdefault(
+        "CDT_CONFIG_PATH",
+        os.path.join(tempfile.mkdtemp(prefix="cdt_bench_"), "config.json"))
+    os.environ["CDT_CACHE"] = "0"
+    n = max(16, runs or 16)
+    requests = load_smoke.build_workload(7, n, shapes=((16, 2), (24, 2)))
+
+    fused = asyncio.run(_stages_drive(requests, staged=False,
+                                      timeout_s=1800.0))
+    staged = asyncio.run(_stages_drive(requests, staged=True,
+                                       timeout_s=1800.0))
+
+    mismatches = compared = 0
+    for a_arrays, b_arrays in zip(fused["outputs"], staged["outputs"]):
+        for a, b in zip(a_arrays, b_arrays):
+            compared += 1
+            if a.shape != b.shape or not np.array_equal(a, b):
+                mismatches += 1
+    fused.pop("outputs", None)
+    staged.pop("outputs", None)
+
+    occ_f, occ_s = fused["denoise_occupancy"], staged["denoise_occupancy"]
+    gain = (round(occ_s / occ_f, 4)
+            if occ_f and occ_s else None)
+    return {
+        "metric": ("stages_denoise_occupancy_gain" if platform != "cpu"
+                   else "stages_denoise_occupancy_gain_cpu"),
+        "value": gain,
+        "unit": "x (denoise-pool occupancy, disaggregated vs fused, "
+                "same offered load)",
+        "vs_baseline": 1.0,
+        "vs_baseline_note": "no published stage-split baseline",
+        "platform": platform,
+        "device_kind": getattr(jax.devices()[0], "device_kind", platform),
+        "devices": len(jax.devices()),
+        "requests": n,
+        "shapes": [[16, 2], [24, 2]],
+        "fused": fused,
+        "staged": staged,
+        "occupancy_fused": occ_f,
+        "occupancy_staged": occ_s,
+        "occupancy_strictly_higher": (occ_f is not None
+                                      and occ_s is not None
+                                      and occ_s > occ_f),
+        "mean_decode_batch": staged.get("mean_decode_batch"),
+        "bit_identical": mismatches == 0 and compared > 0,
+        "outputs_compared": compared,
+        "output_mismatches": mismatches,
+    }
+
+
 _WORKLOADS = {
     "txt2img": run_benchmark,
     "usdu": run_usdu_benchmark,
@@ -1825,6 +2061,7 @@ _WORKLOADS = {
     "serving": run_serving_benchmark,
     "elastic": run_elastic_benchmark,
     "caching": run_caching_benchmark,
+    "stages": run_stages_benchmark,
 }
 
 
@@ -2091,7 +2328,7 @@ def main() -> None:
     parser.add_argument("--workload",
                         choices=["txt2img", "usdu", "flux", "wan",
                                  "wan14b", "wan22", "attn", "serving",
-                                 "elastic", "caching"],
+                                 "elastic", "caching", "stages"],
                         default="txt2img",
                         help="txt2img (SDXL images/sec), usdu (4K upscale "
                              "wall-clock), flux (flow images/sec), wan "

@@ -48,6 +48,7 @@ _CORS_SAFE_PATHS = frozenset({
     "/distributed/metrics.json",
     "/distributed/frontdoor",
     "/distributed/cache",
+    "/distributed/stages",
     "/prompt",
 })
 
@@ -333,6 +334,50 @@ def create_app(controller: Controller) -> web.Application:
                    + cache.results.clear_memory())
         return web.json_response({"status": "cleared", "dropped": dropped})
 
+    # --- stage-split serving (cluster/stages, docs/stages.md) --------------
+    async def stages_stats(request):
+        stages = getattr(controller, "stages", None)
+        if stages is None:
+            return web.json_response({"enabled": False})
+        return web.json_response(stages.stats())
+
+    async def stages_decode(request):
+        """Remote decode: accept one wire-form latent handoff
+        (checksum-verified before a byte is trusted), decode it on THIS
+        worker's VAE, answer with the checksummed image payload — the
+        cross-worker decode-pool transport (docs/stages.md). The heavy
+        work (b64 + sha256 + npz + the decode program's host sync) runs
+        off the event loop (PR 9 media-route discipline)."""
+        from ..cluster.stages.latents import (LatentHandoff,
+                                              LatentWireError,
+                                              encode_array_payload)
+
+        body = await _json_body(request)
+
+        def _decode():
+            handoff = LatentHandoff.from_payload(body)
+            model_name = handoff.meta.get("model")
+            if not isinstance(model_name, str) or not model_name:
+                raise LatentWireError(
+                    "handoff meta names no model — cannot pick a VAE")
+            bundle = controller.model_registry.get(model_name)
+            images = bundle.pipeline.decode_latents(
+                controller.mesh, [handoff.latents])
+            import numpy as np
+
+            return handoff.prompt_id, encode_array_payload(
+                np.asarray(images[0]))
+
+        try:
+            prompt_id, images = await asyncio.get_running_loop() \
+                .run_in_executor(None, _decode)
+        except LatentWireError as e:
+            raise ValidationError(str(e), field="latents")
+        except ValueError as e:
+            raise ValidationError(str(e), field="latents")
+        return web.json_response({"status": "ok", "prompt_id": prompt_id,
+                                  "images": images})
+
     # --- step-granular preemption (cluster/preemption.py) ------------------
     async def preemption_stats(request):
         pre = getattr(controller, "preemption", None)
@@ -391,6 +436,8 @@ def create_app(controller: Controller) -> web.Application:
     r.add_get("/distributed/cache", cache_stats)
     r.add_post("/distributed/cache/clear", cache_clear)
     r.add_get("/distributed/preemption", preemption_stats)
+    r.add_get("/distributed/stages", stages_stats)
+    r.add_post("/distributed/stages/decode", stages_decode)
     r.add_get("/distributed/checkpoint/{checkpoint_id}", checkpoint_export)
     r.add_post("/distributed/checkpoint", checkpoint_import)
 

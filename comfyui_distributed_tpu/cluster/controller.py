@@ -61,6 +61,13 @@ class Controller:
 
         self.preemption = build_preemption(self.queue)
         self.queue.preemption = self.preemption
+        # disaggregated stage-split serving (cluster/stages,
+        # docs/stages.md): independent encode/denoise/decode pools for
+        # front-door batch jobs; None under CDT_STAGES=0 (fused path)
+        from .stages import build_stages
+
+        self.stages = build_stages()
+        self.queue.stages = self.stages
         # serving front door (cluster/frontdoor): admission control +
         # cross-user microbatching in front of the queue; None under
         # CDT_FRONTDOOR=0 (the API layer then serves the legacy path)
@@ -68,7 +75,8 @@ class Controller:
 
         self.frontdoor = build_frontdoor(self.queue, self.orchestrator,
                                          config_loader=self.load_config,
-                                         cache=self.cache)
+                                         cache=self.cache,
+                                         stages=self.stages)
         self.loop: Optional[asyncio.AbstractEventLoop] = None
         self.bridge: Optional[CollectorBridge] = None
         self.tile_farm = None
@@ -216,6 +224,11 @@ class Controller:
             await self.elastic.stop()
         if self.frontdoor is not None:
             await self.frontdoor.stop()
+        if self.stages is not None:
+            # stop the stage pools BEFORE the queue: leftover decode
+            # items record interrupted history through the queue's
+            # callbacks, which must still be alive
+            self.stages.stop()
         await self.queue.stop()
         self.progress.close()      # release the global progress sink
         await close_client_session()
@@ -241,6 +254,9 @@ class Controller:
             # the signal that lets the autoscaler shrink a hot-cache fleet
             "cache": (None if self.cache is None
                       else {"hit_rate": round(self.cache.hit_rate(), 4)}),
+            # per-stage pool backlog (cluster/stages, docs/stages.md)
+            "stages": (None if self.stages is None
+                       else self.stages.depths()),
         }
 
     def system_info_no_devices(self) -> dict:

@@ -128,7 +128,15 @@ class ElasticManager:
     def _signals(self) -> FleetSignals:
         c = self.controller
         fd = getattr(c, "frontdoor", None)
-        queue_depth = fd.depth() if fd is not None else c.queue.queue_remaining
+        # DENOISE-facing depth only: fd.depth() also counts the
+        # encode/decode pools' host-side backlog (admission needs
+        # that), but sizing the CHIP fleet on it would scale up denoise
+        # capacity for a decode pile-up — the split FleetSignals carry
+        # the stage backlogs separately (docs/stages.md)
+        queue_depth = (fd.denoise_depth() if fd is not None
+                       else c.queue.queue_remaining)
+        stages = getattr(c, "stages", None)
+        stage_depths = stages.depths() if stages is not None else {}
         # racy unlocked read of list lengths — fine for a gauge-grade
         # signal (the policy's hysteresis absorbs one stale tick)
         tile_depth = sum(len(j.pending)
@@ -150,7 +158,9 @@ class ElasticManager:
                             active_workers=active,
                             draining_workers=draining,
                             decommissioned_workers=decommissioned,
-                            cache_hit_rate=hit_rate)
+                            cache_hit_rate=hit_rate,
+                            encode_depth=stage_depths.get("encode", 0),
+                            decode_depth=stage_depths.get("decode", 0))
 
     # --- lifecycle ----------------------------------------------------------
 

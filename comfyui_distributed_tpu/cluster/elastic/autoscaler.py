@@ -47,9 +47,20 @@ from .states import DRAIN, DrainRegistry
 
 @dataclasses.dataclass(frozen=True)
 class FleetSignals:
-    """One evaluation tick's inputs (all instantaneous reads)."""
+    """One evaluation tick's inputs (all instantaneous reads).
 
-    queue_depth: int            # prompts queued/executing (+ coalescing)
+    Signals are split PER STAGE POOL (docs/stages.md): ``queue_depth``
+    is the DENOISE-facing depth (queued/executing prompts + the
+    coalescing window — work that needs a chip), while
+    ``encode_depth``/``decode_depth`` are the host-side stage pools'
+    backlogs. Only the denoise-facing signals feed ``work`` /
+    ``effective_work`` — a decode pile-up is the stage rebalancer's
+    problem (more decode threads), and folding it into one queue signal
+    would scale up denoise chips that then sit idle (the pre-split
+    bug, pinned by a regression test in tests/test_stages.py)."""
+
+    queue_depth: int            # denoise-facing: queued/executing
+    #                             prompts (+ coalescing window)
     tile_depth: int             # pending tile tasks across open jobs
     step_time_p50: Optional[float] = None   # informational, for reports
     active_workers: int = 0
@@ -60,6 +71,10 @@ class FleetSignals:
     # discount (cluster/cache, docs/caching.md). Coalesced duplicates
     # are excluded: they never occupy queue depth in the first place
     cache_hit_rate: float = 0.0
+    # host-side stage pool backlogs (cluster/stages): reported and
+    # exported, NEVER part of the chip-pressure computation
+    encode_depth: int = 0
+    decode_depth: int = 0
 
     @property
     def work(self) -> int:
@@ -71,7 +86,9 @@ class FleetSignals:
         cache will answer occupies a queue slot for microseconds, not a
         TPU program — sizing the fleet on raw depth would keep paying
         for chips the cache already replaced. Tile backlog is never
-        discounted (tiles don't ride the content cache)."""
+        discounted (tiles don't ride the content cache). Stage-pool
+        backlogs (encode/decode) are deliberately absent: they are
+        host-thread work, not chip work."""
         rate = min(max(self.cache_hit_rate, 0.0), 1.0)
         return self.queue_depth * (1.0 - rate) + self.tile_depth
 

@@ -81,7 +81,7 @@ class FrontDoor:
     """
 
     def __init__(self, queue: PromptQueue, orchestrator,
-                 config_loader=None, cache=None):
+                 config_loader=None, cache=None, stages=None):
         self.queue = queue
         self.orchestrator = orchestrator
         self.load_config = config_loader
@@ -89,6 +89,10 @@ class FrontDoor:
         # HERE, before the batcher — a byte-identical twin of a queued
         # request must never occupy a second queue slot
         self.cache = cache
+        # stage-split serving (cluster/stages): queue slots free at
+        # denoise-done, so admission must ALSO see the encode/decode
+        # backlog or overload would pile up unbounded past the queue
+        self.stages = stages
         self.admission = AdmissionController(depth_provider=self.depth)
         # capacity gate = continuous batching: while FD_INFLIGHT batch
         # jobs sit in the queue, ready groups keep absorbing same-shape
@@ -139,8 +143,23 @@ class FrontDoor:
     def depth(self) -> int:
         """The admission/backpressure signal: everything queued or
         executing on this controller PLUS everything coalescing in the
-        front door — the same quantity ``cdt_prompt_queue_depth`` exports
-        at the queue layer, extended by the pre-queue window."""
+        front door PLUS the stage pools' host-side backlog (stage-split
+        serving frees queue slots at denoise-done — without the stage
+        term, overload would pile up unbounded in the decode pool).
+        This is the quantity admission sheds on; the FLEET autoscaler
+        deliberately reads :meth:`denoise_depth` instead
+        (docs/stages.md)."""
+        depth = self.queue.queue_remaining + self.batcher.pending_count
+        if self.stages is not None:
+            depth += self.stages.depth()
+        return depth
+
+    def denoise_depth(self) -> int:
+        """The DENOISE-facing depth: queued/executing prompts plus the
+        coalescing window — what sizing the chip fleet should read. A
+        decode/encode backlog is a host-pool problem (the stage
+        rebalancer's), never a reason to scale denoise chips — the
+        FleetSignals split (cluster/elastic, docs/stages.md)."""
         return self.queue.queue_remaining + self.batcher.pending_count
 
     # --- the doorway --------------------------------------------------------
@@ -275,13 +294,17 @@ class FrontDoor:
             "cache": (None if self.cache is None
                       else {"hit_rate": round(self.cache.hit_rate(), 4),
                             **self.cache.coalescer.stats()}),
+            "stages": (None if self.stages is None
+                       else self.stages.depths()),
         }
 
 
 def build_frontdoor(queue: PromptQueue, orchestrator,
-                    config_loader=None, cache=None) -> Optional[FrontDoor]:
+                    config_loader=None, cache=None,
+                    stages=None) -> Optional[FrontDoor]:
     """Controller hook: the front door, or None under CDT_FRONTDOOR=0."""
     if not frontdoor_enabled():
         log("front door disabled (CDT_FRONTDOOR=0) — legacy queue path")
         return None
-    return FrontDoor(queue, orchestrator, config_loader, cache=cache)
+    return FrontDoor(queue, orchestrator, config_loader, cache=cache,
+                     stages=stages)

@@ -107,12 +107,20 @@ class PromptQueue:
         self._executing: Optional[str] = None
         self.executing_job: Optional[PromptJob] = None
         self._interrupt = threading.Event()
+        # cumulative seconds the consumer spent on jobs — the fused
+        # path's "mesh lane busy" denominator bench.py's stages A/B
+        # divides denoise-program time by (docs/stages.md)
+        self.busy_seconds = 0.0
         self.history: dict[str, dict] = {}
         self._job_done_callbacks: list[Callable[[], None]] = []
         self._pending_by_priority: dict[str, int] = {}
         # step-granular preemption controller (cluster/preemption.py),
         # attached by the host controller; None = monolithic execution
         self.preemption = None
+        # disaggregated stage-split serving (cluster/stages,
+        # docs/stages.md), attached by the host controller; None =
+        # fused group execution (CDT_STAGES=0)
+        self.stages = None
 
     # --- lifecycle ---------------------------------------------------------
 
@@ -357,6 +365,7 @@ class PromptQueue:
                 else:
                     statuses = [await self._run_solo(loop, job, started)]
             finally:
+                self.busy_seconds += time.monotonic() - started
                 self._executing = None
                 self.executing_job = None
                 if self.preemption is not None:
@@ -534,6 +543,11 @@ class PromptQueue:
         if not live:
             return statuses
 
+        if self.stages is not None and self.stages.eligible(job):
+            staged = await self._run_group_staged(loop, job, live, started)
+            if staged is not None:
+                return statuses + staged
+
         try:
             # context build INSIDE the barrier: a transient factory error
             # (mesh/registry build) must error the members, not kill the
@@ -580,6 +594,85 @@ class PromptQueue:
                    f"batch {job.prompt_id} ({len(live)} member(s)) done "
                    f"in {duration:.2f}s")
         return statuses
+
+    async def _run_group_staged(self, loop, job: PromptJob,
+                                live: "list[PromptJob]",
+                                started: float) -> "list[str] | None":
+        """Route a batch job through the stage pools (cluster/stages,
+        docs/stages.md): encode pool → denoise pool → decode pool. The
+        consumer awaits ONLY the denoise stage — the queue slot frees
+        the moment the mesh is, so the next job's denoise overlaps this
+        job's decode. Per-member terminal history lands from the decode
+        pool via ``_record_staged_member`` (same record shape, same
+        telemetry, same job-done callbacks as the fused path). Returns
+        non-terminal ``"staged"`` markers (the finally-block counts only
+        TERMINAL statuses; the staged completion path owns those), or
+        None if submission itself failed — the fused path then runs."""
+        try:
+            context = dict(self._context_factory())
+            context["interrupt_event"] = self._interrupt
+            denoise_done = loop.create_future()
+            by_id = {m.prompt_id: m for m in live}
+
+            def record(member, entry, last) -> None:
+                self._record_staged_member(job, member, entry, last,
+                                           started)
+
+            self.stages.submit_group(
+                job, live,
+                {pid: job.sampler_node_ids[pid] for pid in by_id},
+                context, loop, denoise_done, record)
+        except Exception as e:  # noqa: BLE001 — submission barrier: the
+            # fused path still exists and must serve the group instead
+            log(f"stages: submit of batch {job.prompt_id} failed "
+                f"({e!r}); falling back to fused execution")
+            return None
+        with telemetry.span("prompt.execute_batch_staged",
+                            trace_id=job.trace_id,
+                            prompt_id=job.prompt_id, batch=len(live)):
+            await denoise_done
+        trace_info(job.trace_id,
+                   f"batch {job.prompt_id} ({len(live)} member(s)) "
+                   f"denoise done in {time.monotonic() - started:.2f}s "
+                   "(decode in flight)")
+        return ["staged"] * len(live)
+
+    def _record_staged_member(self, job: PromptJob, member: PromptJob,
+                              entry: dict, last: bool,
+                              started: float) -> None:
+        """Terminal history for one staged member (runs on the event
+        loop, marshaled from a stage worker). Mirrors the fused
+        ``_run_group`` record shape exactly — pollers and the coalescer
+        cannot tell the paths apart."""
+        status = entry.get("status", "error")
+        record = {"status": status,
+                  "duration": time.monotonic() - started,
+                  "batch_size": entry.get("batch_size")}
+        if entry.get("decode_batch"):
+            record["decode_batch"] = entry["decode_batch"]
+        if entry.get("cache"):
+            record["cache"] = entry["cache"]
+        if entry.get("error"):
+            record["error"] = entry["error"]
+        if status == "success":
+            record["outputs"] = {
+                nid: out
+                for nid, out in (entry.get("outputs") or {}).items()
+                if _is_terminal(member.prompt, nid)
+            }
+        self.history[member.prompt_id] = record
+        if telemetry.enabled():
+            if status in TERMINAL_STATUSES:
+                _tm.PROMPTS_TOTAL.labels(status=status).inc()
+            if last:
+                # end-to-end batch duration (decode included) — the
+                # fused path observes the same quantity once per group
+                _tm.PROMPT_SECONDS.observe(record["duration"])
+        for cb in self._job_done_callbacks:
+            try:
+                cb()
+            except Exception:  # noqa: BLE001 — observer isolation
+                pass
 
 
 # one terminal-status vocabulary for every history observer (pollers,

@@ -1,0 +1,160 @@
+"""The latent wire format: one denoise→decode stage handoff.
+
+A :class:`LatentHandoff` is the unit of work the denoise pool hands the
+decode pool — the request's final ``x0`` latent plus the identity meta
+that ties it to its prompt (conditioning digest, spec geometry, seed,
+model preset). The serialization contract is
+``diffusion/checkpoint.py``'s, applied to handoffs instead of sampler
+carries: one ``.npz`` payload (JSON header + latent array), a SHA-256
+that travels WITH the bytes, and a loader that refuses anything it
+cannot verify — a flipped bit on the wire must re-dispatch the latent,
+never decode into a wrong image.
+
+In-process handoffs skip serialization entirely (the decode pool reads
+the device array the denoise program produced); ``CDT_STAGE_WIRE=1``
+forces every handoff through the full checksummed round trip (the
+cross-worker transport simulation the chaos suite and the decode
+import route exercise). Cross-worker movement rides the existing
+dispatch transport as a JSON payload, exactly like checkpoint
+export/import (docs/stages.md).
+"""
+
+from __future__ import annotations
+
+import base64
+import dataclasses
+import io
+import json
+
+import numpy as np
+
+from ...diffusion.checkpoint import checksum
+
+LATENT_WIRE_VERSION = 1
+
+
+class LatentWireError(Exception):
+    """A latent handoff payload is unusable (bad version, checksum
+    mismatch, garbled npz). The caller re-dispatches or recomputes —
+    corruption is loud and never decoded."""
+
+
+@dataclasses.dataclass
+class LatentHandoff:
+    """One request's denoise output in flight to the decode pool.
+
+    ``latents`` is the GLOBAL ``[n_dp · B, h, w, C]`` f32 array (the
+    exact bytes the fused program would have fed its VAE); ``meta``
+    carries the run identity (model preset, spec geometry, seed, dp
+    width, conditioning digest) a receiving decoder validates before
+    trusting the shape."""
+
+    prompt_id: str
+    latents: np.ndarray
+    meta: dict = dataclasses.field(default_factory=dict)
+    version: int = LATENT_WIRE_VERSION
+
+    @property
+    def nbytes(self) -> int:
+        return int(np.asarray(self.latents).nbytes)
+
+    def bucket_key(self) -> tuple:
+        """Decode-batching bucket: latents sharing this key may decode
+        inside one program (same shape, same dtype)."""
+        arr = np.asarray(self.latents)
+        return (tuple(arr.shape), str(arr.dtype))
+
+    # --- serialization (the checkpoint.py npz contract) ---------------------
+
+    def to_bytes(self) -> bytes:
+        header = {
+            "version": self.version,
+            "prompt_id": self.prompt_id,
+            "meta": self.meta,
+        }
+        buf = io.BytesIO()
+        np.savez(buf, latents=np.asarray(self.latents),
+                 header=np.frombuffer(
+                     json.dumps(header, sort_keys=True).encode(), np.uint8))
+        return buf.getvalue()
+
+    @classmethod
+    def from_bytes(cls, payload: bytes) -> "LatentHandoff":
+        try:
+            with np.load(io.BytesIO(payload)) as z:
+                header = json.loads(bytes(z["header"].tobytes()).decode())
+                latents = z["latents"]
+        except (KeyError, ValueError, OSError, json.JSONDecodeError) as e:
+            raise LatentWireError(f"unreadable latent payload: {e}")
+        if header.get("version") != LATENT_WIRE_VERSION:
+            raise LatentWireError(
+                f"latent wire version {header.get('version')!r} != "
+                f"{LATENT_WIRE_VERSION} (refusing a cross-version decode)")
+        return cls(prompt_id=str(header.get("prompt_id", "")),
+                   latents=latents, meta=dict(header.get("meta") or {}))
+
+    def to_payload(self) -> dict:
+        """JSON-safe wire form (rides the queue/dispatch transport like
+        checkpoint payloads); the sha256 travels WITH the bytes so the
+        receiving decoder verifies integrity before a byte is
+        trusted."""
+        payload = self.to_bytes()
+        return {
+            "version": LATENT_WIRE_VERSION,
+            "prompt_id": self.prompt_id,
+            "sha256": checksum(payload),
+            "data": base64.b64encode(payload).decode("ascii"),
+        }
+
+    @classmethod
+    def from_payload(cls, obj: dict) -> "LatentHandoff":
+        if not isinstance(obj, dict) or "data" not in obj:
+            raise LatentWireError("latent payload must be an object with "
+                                  "a base64 'data' field")
+        try:
+            payload = base64.b64decode(obj["data"], validate=True)
+        except Exception as e:  # noqa: BLE001 — any b64 failure is terminal
+            raise LatentWireError(f"bad base64 latent data: {e}")
+        want = obj.get("sha256")
+        if not want:
+            # NOT optional: an unverifiable payload is an unusable
+            # payload (the checkpoint wire contract)
+            raise LatentWireError(
+                "latent payload carries no sha256 — refusing an "
+                "unverifiable decode")
+        if checksum(payload) != want:
+            raise LatentWireError(
+                "latent CHECKSUM MISMATCH on the wire — rejecting (a "
+                "flipped bit must never decode into an image)")
+        return cls.from_bytes(payload)
+
+
+def encode_array_payload(arr: np.ndarray) -> dict:
+    """Checksummed JSON-safe form of one array — the remote-decode
+    route's response body (``POST /distributed/stages/decode``): same
+    npz + sha256 contract as the handoff itself, so the caller verifies
+    the decoded images exactly like the decoder verified the latents."""
+    buf = io.BytesIO()
+    np.savez(buf, array=np.asarray(arr))
+    payload = buf.getvalue()
+    return {"sha256": checksum(payload),
+            "data": base64.b64encode(payload).decode("ascii")}
+
+
+def decode_array_payload(obj: dict) -> np.ndarray:
+    if not isinstance(obj, dict) or "data" not in obj:
+        raise LatentWireError("array payload must be an object with a "
+                              "base64 'data' field")
+    try:
+        payload = base64.b64decode(obj["data"], validate=True)
+    except Exception as e:  # noqa: BLE001 — any b64 failure is terminal
+        raise LatentWireError(f"bad base64 array data: {e}")
+    want = obj.get("sha256")
+    if not want or checksum(payload) != want:
+        raise LatentWireError("array payload checksum missing or "
+                              "mismatched — rejecting")
+    try:
+        with np.load(io.BytesIO(payload)) as z:
+            return z["array"]
+    except (KeyError, ValueError, OSError) as e:
+        raise LatentWireError(f"unreadable array payload: {e}")

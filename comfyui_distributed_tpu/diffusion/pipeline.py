@@ -367,13 +367,36 @@ class Txt2ImgPipeline:
         initial noising), and the denoised *output* is pinned to the
         source in unmasked regions — so ancestral/SDE samplers track the
         reference trajectory at mask boundaries, not just at the end."""
+        x0 = self._sample_latent(
+            key, context, uncond_context, y, uncond_y, spec, batch, sigmas,
+            init_latent=init_latent, hint=hint, progress=progress,
+            weights=weights, inpaint_mask=inpaint_mask)
+        return self._decode_latent(
+            x0, None if weights is None else weights["vae_dec"])
+
+    def _sample_latent(self, key, context, uncond_context, y, uncond_y,
+                       spec: GenerationSpec, batch: int, sigmas: jax.Array,
+                       init_latent: Optional[jax.Array] = None,
+                       hint: Optional[jax.Array] = None,
+                       progress=None, weights=None,
+                       inpaint_mask: Optional[jax.Array] = None):
+        """The sampling half of :meth:`_sample_and_decode`: noise →
+        sampler scan → final latent ``x0`` (no VAE). ONE definition for
+        the fused path and the stage-split denoise programs
+        (``latent_microbatch_fn``) — the split must be a pure program
+        boundary, never a second copy of the math (docs/stages.md)."""
         denoise, x, k_samp = self._build_sampling(
             key, context, uncond_context, y, uncond_y, spec, batch, sigmas,
             init_latent=init_latent, hint=hint, progress=progress,
             weights=weights, inpaint_mask=inpaint_mask)
-        x0 = sample(spec.sampler, denoise, x, sigmas, key=k_samp)
-        images = self.vae.decode(
-            x0, params=None if weights is None else weights["vae_dec"])
+        return sample(spec.sampler, denoise, x, sigmas, key=k_samp)
+
+    def _decode_latent(self, x0, vae_params):
+        """The decode half: VAE decode + the [0,1] clip. Shared by the
+        fused path, the preemptible ``fin`` program, and the decode
+        pool's batched program (``decode_fn``) so the image math cannot
+        drift between the serving tiers."""
+        images = self.vae.decode(x0, params=vae_params)
         return jnp.clip(images / 2.0 + 0.5, 0.0, 1.0)
 
     def generate_fn(self, mesh: Mesh, spec: GenerationSpec,
@@ -702,8 +725,7 @@ class Txt2ImgPipeline:
 
         def fin_body(weights, carry):
             x0 = extract_output(spec.sampler, tuple(carry))
-            images = self.vae.decode(x0, params=weights["vae_dec"])
-            return jnp.clip(images / 2.0 + 0.5, 0.0, 1.0)
+            return self._decode_latent(x0, weights["vae_dec"])
 
         fin = bind_weights(jax.jit(shard_map(
             fin_body, mesh=mesh, in_specs=(P(), carry_specs),
@@ -1003,26 +1025,12 @@ class Txt2ImgPipeline:
         return bind_weights(jax.jit(run), weights, label="txt2img_mb_tp",
                             steps=len(sigmas) - 1)
 
-    def generate_microbatch(
-        self,
-        mesh: Mesh,
-        spec: GenerationSpec,
-        seeds: "list[int]",
-        contexts: "list[jax.Array]",
-        uncond_contexts: "list[jax.Array]",
-        ys: "list[Optional[jax.Array]] | None" = None,
-        uys: "list[Optional[jax.Array]] | None" = None,
-    ) -> "list[jax.Array]":
-        """Execute N same-shape requests as one microbatched program and
-        demux: returns one ``[n_dp · per_device_batch, H, W, 3]`` array
-        per request, each bit-identical to
-        ``generate(mesh, spec, seeds[r], contexts[r], …)``.
-
-        Group size is bucketed to the next power of two (compile-count
-        bound: programs exist only for R ∈ {2, 4, 8, …}); the pad slots
-        repeat request 0 and their outputs are dropped at demux. Every
-        request's context/uncond/y must share one shape — the front
-        door's batcher sub-groups by shape before calling."""
+    def _stack_requests(self, seeds, contexts, uncond_contexts, ys, uys):
+        """Pad a request list to the next power-of-two bucket and stack
+        the per-request inputs for a microbatched program (compile-count
+        bound: programs exist only for R ∈ {1, 2, 4, 8, …}; pad slots
+        repeat request 0 and are dropped at demux). One definition for
+        the fused and latent (stage-split) microbatch entry points."""
         R = len(seeds)
         if not (R == len(contexts) == len(uncond_contexts)):
             raise ValueError("seeds/contexts/uncond_contexts length mismatch")
@@ -1044,12 +1052,19 @@ class Txt2ImgPipeline:
             list(uncond_contexts) + [uncond_contexts[0]] * pad, axis=0)
         y_s = jnp.concatenate(ys + [ys[0]] * pad, axis=0)
         uy_s = jnp.concatenate(uys + [uys[0]] * pad, axis=0)
+        return bucket, seeds_arr, ctx, unc, y_s, uy_s
 
+    def _microbatch_dispatch(self, mesh, spec, seeds, contexts,
+                             uncond_contexts, ys, uys, latent: bool):
+        """Shared bucket/cache/route/demux core of
+        :meth:`generate_microbatch` and :meth:`generate_latents`."""
+        bucket, seeds_arr, ctx, unc, y_s, uy_s = self._stack_requests(
+            seeds, contexts, uncond_contexts, ys, uys)
         if not hasattr(self, "_mb_cache"):
             self._mb_cache: "dict[tuple, Any]" = {}
         key = (self._mesh_cache_key(mesh), spec, bucket,
                tuple(ctx.shape[1:]), tuple(unc.shape[1:]),
-               tuple(y_s.shape[1:]))
+               tuple(y_s.shape[1:]), latent)
         # mesh tier: a tp axis in the serving mesh routes the group to
         # the tp-sharded program (docs/parallelism.md) — same unrolled
         # subgraphs, weights sharded instead of replicated.
@@ -1060,12 +1075,233 @@ class Txt2ImgPipeline:
         tp = dict(mesh.shape).get(constants.AXIS_TENSOR, 1)
         use_tp = tp > 1 and mesh_tier_enabled()
         key += (use_tp,)
-        build = (lambda: self.microbatch_tp_fn(mesh, spec, bucket)
-                 if use_tp else self.microbatch_fn(mesh, spec, bucket))
+        if latent:
+            build = (lambda: self.latent_microbatch_tp_fn(mesh, spec, bucket)
+                     if use_tp
+                     else self.latent_microbatch_fn(mesh, spec, bucket))
+        else:
+            build = (lambda: self.microbatch_tp_fn(mesh, spec, bucket)
+                     if use_tp else self.microbatch_fn(mesh, spec, bucket))
         fn = cached_build(self._mb_cache, key, build, self._CACHE_MAX)
         out = fn(seeds_arr, ctx, unc, y_s, uy_s)
         return demux_microbatch(out, mesh, bucket,
-                                spec.per_device_batch)[:R]
+                                spec.per_device_batch)[:len(seeds)]
+
+    def generate_microbatch(
+        self,
+        mesh: Mesh,
+        spec: GenerationSpec,
+        seeds: "list[int]",
+        contexts: "list[jax.Array]",
+        uncond_contexts: "list[jax.Array]",
+        ys: "list[Optional[jax.Array]] | None" = None,
+        uys: "list[Optional[jax.Array]] | None" = None,
+    ) -> "list[jax.Array]":
+        """Execute N same-shape requests as one microbatched program and
+        demux: returns one ``[n_dp · per_device_batch, H, W, 3]`` array
+        per request, each bit-identical to
+        ``generate(mesh, spec, seeds[r], contexts[r], …)``.
+
+        Group size is bucketed to the next power of two (compile-count
+        bound: programs exist only for R ∈ {2, 4, 8, …}); the pad slots
+        repeat request 0 and their outputs are dropped at demux. Every
+        request's context/uncond/y must share one shape — the front
+        door's batcher sub-groups by shape before calling."""
+        return self._microbatch_dispatch(mesh, spec, seeds, contexts,
+                                         uncond_contexts, ys, uys,
+                                         latent=False)
+
+    # --- stage-split serving (cluster/stages, docs/stages.md) ---------------
+
+    def latent_microbatch_fn(self, mesh: Mesh, spec: GenerationSpec,
+                             n_requests: int,
+                             axis: str = constants.AXIS_DATA):
+        """:meth:`microbatch_fn` stopped at the final latent: the same
+        unrolled per-request sampling subgraphs (same fold-in, same
+        noise draw, same solo tensor shapes), NO VAE decode. This is the
+        denoise pool's program in stage-split serving — the decode pool
+        finishes the request with :meth:`decode_latents`, and the pair
+        is bit-identical to the fused program (the PR 14 seg/fin
+        precedent: a materialized program boundary on the x0 latent
+        preserves every byte; tested in
+        ``tests/test_stages_equivalence.py``).
+
+        Output: ``[n_dp · R · B, lat_h, lat_w, latent_channels]`` f32,
+        row order per :func:`demux_microbatch`. The weight pytree
+        carries the UNet only — the decode pool holds the VAE, which is
+        exactly the residency win the stage split exists for."""
+        if spec.sampler not in DETERMINISTIC_SAMPLERS:
+            raise ValueError(
+                f"sampler {spec.sampler!r} is stochastic — microbatching "
+                f"requires one of {sorted(DETERMINISTIC_SAMPLERS)}")
+        if getattr(self, "_control", None) is not None:
+            raise ValueError("microbatching does not support ControlNet "
+                             "pipelines (per-request hints are not stacked)")
+        has_y = self.unet.config.adm_in_channels > 0
+        sigmas = make_sigma_ladder(spec, self.schedule)
+        R, B = int(n_requests), spec.per_device_batch
+
+        def shard_body(weights, seeds, contexts, uncond_contexts, ys, uys):
+            outs = []
+            for r in range(R):
+                k = participant_key(jax.random.key(seeds[r]), axis)
+                outs.append(self._sample_latent(
+                    k, contexts[r:r + 1], uncond_contexts[r:r + 1],
+                    ys[r:r + 1] if has_y else None,
+                    uys[r:r + 1] if has_y else None,
+                    spec, B, sigmas, weights=weights))
+            return jnp.concatenate(outs, axis=0)
+
+        f = shard_map(
+            shard_body, mesh=mesh,
+            in_specs=(P(), P(), P(None, None, None), P(None, None, None),
+                      P(None, None), P(None, None)),
+            out_specs=P(axis, None, None, None),
+        )
+        return bind_weights(jax.jit(f), {"unet": self.unet_params},
+                            label="txt2img_lat", steps=len(sigmas) - 1)
+
+    def latent_microbatch_tp_fn(self, mesh: Mesh, spec: GenerationSpec,
+                                n_requests: int,
+                                dp_axis: str = constants.AXIS_DATA,
+                                tp_axis: str = constants.AXIS_TENSOR):
+        """Mesh-tier denoise-only microbatch: :meth:`microbatch_tp_fn`
+        stopped at the final latent. Same equivalence contract as the
+        fused tp program (the repo 2e-4 f32 sharding tolerance, NOT
+        bit-identity — docs/parallelism.md); ``CDT_MESH_TIER=0``
+        restores the bit-identical replicated path."""
+        if spec.sampler not in DETERMINISTIC_SAMPLERS:
+            raise ValueError(
+                f"sampler {spec.sampler!r} is stochastic — microbatching "
+                f"requires one of {sorted(DETERMINISTIC_SAMPLERS)}")
+        if getattr(self, "_control", None) is not None:
+            raise ValueError("microbatching does not support ControlNet "
+                             "pipelines (per-request hints are not stacked)")
+        from ..ops.attention import tp_shard_scope
+        from ..parallel.tensor import (UNET_TP_RULES, require_tp_match,
+                                       shard_params)
+
+        has_y = self.unet.config.adm_in_channels > 0
+        sigmas = make_sigma_ladder(spec, self.schedule)
+        R, B = int(n_requests), spec.per_device_batch
+        shape = dict(mesh.shape)
+        n_dp, tp = shape[dp_axis], shape[tp_axis]
+        require_tp_match(self.unet_params, mesh, UNET_TP_RULES, tp_axis,
+                         "unet")
+        if not hasattr(self, "_tp_weights_cache"):
+            self._tp_weights_cache: "dict[tuple, Any]" = {}
+        weights = cached_build(
+            self._tp_weights_cache, (mesh_cache_key(mesh), tp_axis),
+            lambda: shard_params(self._weights(), mesh, UNET_TP_RULES,
+                                 tp_axis), 2)
+
+        def run(weights, seeds, contexts, uncond_contexts, ys, uys):
+            with tp_shard_scope(tp):
+                def per_dp(i):
+                    outs = []
+                    for r in range(R):
+                        k = jax.random.fold_in(
+                            jax.random.key(seeds[r]), i)
+                        outs.append(self._sample_latent(
+                            k, contexts[r:r + 1],
+                            uncond_contexts[r:r + 1],
+                            ys[r:r + 1] if has_y else None,
+                            uys[r:r + 1] if has_y else None,
+                            spec, B, sigmas, weights=weights))
+                    return jnp.concatenate(outs, axis=0)
+
+                out = jax.vmap(per_dp)(jnp.arange(n_dp))
+            out = jax.lax.with_sharding_constraint(
+                out, NamedSharding(mesh, P(dp_axis, None, None, None,
+                                           None)))
+            return out.reshape((n_dp * R * B,) + out.shape[2:])
+
+        return bind_weights(jax.jit(run), weights,
+                            label="txt2img_lat_tp",
+                            steps=len(sigmas) - 1)
+
+    def generate_latents(
+        self,
+        mesh: Mesh,
+        spec: GenerationSpec,
+        seeds: "list[int]",
+        contexts: "list[jax.Array]",
+        uncond_contexts: "list[jax.Array]",
+        ys: "list[Optional[jax.Array]] | None" = None,
+        uys: "list[Optional[jax.Array]] | None" = None,
+    ) -> "list[jax.Array]":
+        """:meth:`generate_microbatch` for the stage-split denoise pool:
+        one ``[n_dp · per_device_batch, lat_h, lat_w, C]`` latent per
+        request, each carrying exactly the bytes the fused program would
+        have fed its VAE. Feed the results (possibly coalesced across
+        groups) to :meth:`decode_latents`."""
+        return self._microbatch_dispatch(mesh, spec, seeds, contexts,
+                                         uncond_contexts, ys, uys,
+                                         latent=True)
+
+    def decode_fn(self, mesh: Mesh, n_items: int,
+                  axis: str = constants.AXIS_DATA):
+        """Compile ONE batched VAE decode program: ``n_items`` latents
+        (stacked on a leading axis, each ``[n_dp · B, h, w, C]``) decode
+        as unrolled per-item subgraphs — per-shard shapes equal to the
+        fused program's decode, so the images are bit-identical to the
+        fused path while the decode pool amortizes one program over
+        every concurrent request in the shape bucket
+        (docs/stages.md)."""
+
+        def shard_body(weights, lats):
+            # lats per shard: [R, B, h, w, C]; each item decodes at the
+            # solo shape — stacking into the conv batch dim instead
+            # would reassociate reductions (the microbatch_fn lesson)
+            outs = [self._decode_latent(lats[r], weights["vae_dec"])
+                    for r in range(int(n_items))]
+            return jnp.concatenate(outs, axis=0)
+
+        f = shard_map(
+            shard_body, mesh=mesh,
+            in_specs=(P(), P(None, axis, None, None, None)),
+            out_specs=P(axis, None, None, None),
+        )
+        return bind_weights(jax.jit(f), {"vae_dec": self.vae.dec_params},
+                            label="vae_decode_batch")
+
+    def decode_latents(self, mesh: Mesh, latents: "list",
+                       per_device_batch: "int | None" = None) -> "list":
+        """Decode N final latents (any mix of requests sharing one shape
+        bucket) in one batched program; returns one image array per
+        latent, bit-identical to the fused path's decode of the same
+        bytes. Batch count is bucketed to the next power of two (pad
+        repeats item 0, dropped at demux) so compile count stays
+        bounded however the decode pool's windows land."""
+        R = len(latents)
+        if R == 0:
+            return []
+        lats = [jnp.asarray(lat, jnp.float32) for lat in latents]
+        first = tuple(lats[0].shape)
+        for lat in lats[1:]:
+            if tuple(lat.shape) != first:
+                raise ValueError(
+                    f"decode batch mixes latent shapes {first} and "
+                    f"{tuple(lat.shape)} — bucket by shape first")
+        n_dp = dict(mesh.shape)[constants.AXIS_DATA]
+        if first[0] % n_dp:
+            raise ValueError(
+                f"latent rows {first[0]} not divisible by mesh dp width "
+                f"{n_dp}")
+        B = (first[0] // n_dp if per_device_batch is None
+             else int(per_device_batch))
+        bucket = 1
+        while bucket < R:
+            bucket *= 2
+        stacked = jnp.stack(lats + [lats[0]] * (bucket - R), axis=0)
+        if not hasattr(self, "_dec_cache"):
+            self._dec_cache: "dict[tuple, Any]" = {}
+        key = (self._mesh_cache_key(mesh), bucket, first)
+        fn = cached_build(self._dec_cache, key,
+                          lambda: self.decode_fn(mesh, bucket),
+                          self._CACHE_MAX)
+        out = fn(stacked)
+        return demux_microbatch(out, mesh, bucket, B)[:R]
 
 
 # samplers whose trajectory is a pure function of (noise, conditioning):

@@ -710,6 +710,14 @@ class ModelRegistry:
         LRU/priority eviction instead of accumulating until OOM."""
         self.checkpoint_root = Path(checkpoint_root) if checkpoint_root else None
         self._cache: dict[str, ModelBundle] = {}
+        # registry access is lock-serialized: the stage-split encode
+        # pool resolves bundles from N worker threads concurrently, and
+        # an unguarded check-then-build would construct two bundles of
+        # the same preset — distinct pipeline objects whose members then
+        # never stack in one microbatch (cluster/stages, docs/stages.md)
+        from ..lint.lockorder import tracked_lock
+
+        self._lock = tracked_lock("model.registry", reentrant=True)
         self.residency = None
         if hbm_budget_bytes is None:
             from ..cluster.residency import hbm_budget_bytes as _budget
@@ -724,25 +732,26 @@ class ModelRegistry:
         return sorted(PRESETS)
 
     def get(self, name: str) -> ModelBundle:
-        if name not in self._cache:
-            preset = PRESETS.get(name)
-            if preset is None:
-                raise ValidationError(f"unknown model {name!r}; have {self.available()}")
-            ckpt = self.checkpoint_root / name if self.checkpoint_root else None
-            self._cache[name] = ModelBundle(preset, ckpt)
-        bundle = self._cache[name]
-        if self.residency is not None:
-            try:
-                self.residency.note_use(name, bundle)
-            except Exception:
-                # an unplaceable bundle must not squat in the cache
-                # (permanently over budget, unevictable because it was
-                # never registered) — drop it and re-raise
-                self._cache.pop(name, None)
-                bundle.release_device()
-                raise
-            # back-ref so holders (sampler nodes) can pin the bundle for
-            # the duration of a generate call without reaching the
-            # registry (cluster/residency.pinned_bundle)
-            bundle._residency = self.residency
-        return bundle
+        with self._lock:
+            if name not in self._cache:
+                preset = PRESETS.get(name)
+                if preset is None:
+                    raise ValidationError(f"unknown model {name!r}; have {self.available()}")
+                ckpt = self.checkpoint_root / name if self.checkpoint_root else None
+                self._cache[name] = ModelBundle(preset, ckpt)
+            bundle = self._cache[name]
+            if self.residency is not None:
+                try:
+                    self.residency.note_use(name, bundle)
+                except Exception:
+                    # an unplaceable bundle must not squat in the cache
+                    # (permanently over budget, unevictable because it was
+                    # never registered) — drop it and re-raise
+                    self._cache.pop(name, None)
+                    bundle.release_device()
+                    raise
+                # back-ref so holders (sampler nodes) can pin the bundle
+                # for the duration of a generate call without reaching
+                # the registry (cluster/residency.pinned_bundle)
+                bundle._residency = self.residency
+            return bundle

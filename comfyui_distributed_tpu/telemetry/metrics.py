@@ -306,6 +306,56 @@ CHECKPOINT_DEAD_LETTERS = REGISTRY.counter(
     "(CDT_PREEMPT_RESUME_RETRIES) — the job restarts from scratch "
     "instead of looping on a checkpoint that cannot restore.")
 
+# --- disaggregated stage-split serving (cluster/stages, docs/stages.md) -----
+
+STAGE_QUEUE_DEPTH = REGISTRY.gauge(
+    "cdt_stage_queue_depth",
+    "Work items queued per serving stage pool (encode / denoise / "
+    "decode). Each pool scales on ITS OWN depth — a decode backlog must "
+    "never read as denoise pressure (docs/stages.md).",
+    ("stage",))
+
+STAGE_OCCUPANCY = REGISTRY.gauge(
+    "cdt_stage_occupancy",
+    "Fraction of a stage pool's workers currently busy (0..1). The "
+    "denoise pool's value is the number the whole refactor exists to "
+    "raise — the mesh should spend its time denoising, not encoding or "
+    "decoding.",
+    ("stage",))
+
+STAGE_JOBS = REGISTRY.counter(
+    "cdt_stage_jobs_total",
+    "Work items completed per stage pool, by outcome (ok / error / "
+    "redispatch — redispatch = a dead worker's items re-queued to a "
+    "survivor, bounded by CDT_STAGE_MAX_REDISPATCH).",
+    ("stage", "outcome"))
+
+STAGE_STEALS = REGISTRY.counter(
+    "cdt_stage_steals_total",
+    "Cross-stage steals: an idle host-side stage worker served the "
+    "deepest sibling stage's queue (the PR 7 most-starved-first idiom "
+    "generalized across stages).",
+    ("src", "dst"))
+
+DECODE_BATCH_SIZE = REGISTRY.histogram(
+    "cdt_decode_batch_size",
+    "Latents decoded per executed VAE program (cross-request decode "
+    "coalescing per shape bucket). Mean > 1 means the decode pool is "
+    "amortizing programs across concurrent requests.",
+    buckets=(1, 2, 4, 8, 16, 32, 64))
+
+LATENT_TRANSFER_BYTES = REGISTRY.histogram(
+    "cdt_latent_transfer_bytes",
+    "Bytes per denoise-to-decode latent handoff (host materialization, "
+    "plus the checksummed wire round trip under CDT_STAGE_WIRE=1).",
+    buckets=(4096, 65536, 1 << 20, 16 << 20, 256 << 20))
+
+LATENT_TRANSFER_SECONDS = REGISTRY.histogram(
+    "cdt_latent_transfer_seconds",
+    "Wall-clock per latent handoff transfer — overlapped with the "
+    "denoise pool's next program (T3-style), so this shows up in "
+    "decode-lane latency, not denoise occupancy.")
+
 # --- prompt queue -----------------------------------------------------------
 
 PROMPTS_TOTAL = REGISTRY.counter(

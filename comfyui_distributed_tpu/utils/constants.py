@@ -564,6 +564,61 @@ CKPT_DIR = knob_str(
     "files; unset/empty = memory-only).", doc="docs/preemption.md",
     keep_empty=True)
 
+# --- disaggregated stage-split serving (cluster/stages, docs/stages.md) -----
+STAGES = knob_bool(
+    "CDT_STAGES", True, "stages",
+    "Kill switch for disaggregated stage-split serving: 0 restores the "
+    "fused one-program-per-group path (encode + denoise + decode on one "
+    "worker thread).", doc="docs/stages.md")
+STAGE_ENCODE_WORKERS = knob_int(
+    "CDT_STAGE_ENCODE_WORKERS", 2, "stages",
+    "Encode-pool worker threads (graph prefix + text encode; host-side, "
+    "fed through the conditioning cache).", doc="docs/stages.md")
+STAGE_DECODE_WORKERS = knob_int(
+    "CDT_STAGE_DECODE_WORKERS", 2, "stages",
+    "Decode-pool worker threads (batched VAE decode + graph suffix).",
+    doc="docs/stages.md")
+STAGE_MAX_WORKERS = knob_int(
+    "CDT_STAGE_MAX_WORKERS", 4, "stages",
+    "Per-pool ceiling the stage rebalancer may grow encode/decode pools "
+    "to on backlog (the denoise pool is always exactly one — it owns "
+    "the mesh).", doc="docs/stages.md")
+STAGE_SCALE_DEPTH = knob_float(
+    "CDT_STAGE_SCALE_DEPTH", 8.0, "stages",
+    "Queue depth per worker above which a host-side stage pool grows by "
+    "one (its own queue-depth gauge, never another stage's).",
+    doc="docs/stages.md")
+STAGE_DECODE_BATCH = knob_int(
+    "CDT_STAGE_DECODE_BATCH", 8, "stages",
+    "Largest cross-request VAE decode batch one program executes.",
+    doc="docs/stages.md")
+STAGE_DECODE_WINDOW_MS = knob_float(
+    "CDT_STAGE_DECODE_WINDOW_MS", 5.0, "stages",
+    "Decode coalescing window: how long a latent waits for same-bucket "
+    "company before the decode pool flushes the bucket (ms).",
+    doc="docs/stages.md")
+STAGE_SHED_DEPTH = knob_int(
+    "CDT_STAGE_SHED_DEPTH", 128, "stages",
+    "Per-stage backlog cap: stage queue depths past this read as "
+    "overload (they feed the front door's admission depth).",
+    doc="docs/stages.md")
+STAGE_WIRE = knob_bool(
+    "CDT_STAGE_WIRE", False, "stages",
+    "Force every denoise-to-decode handoff through the checksummed "
+    "latent wire format (cross-worker simulation / integrity "
+    "validation; in-process handoffs otherwise skip serialization).",
+    doc="docs/stages.md")
+STAGE_STEAL = knob_bool(
+    "CDT_STAGE_STEAL", True, "stages",
+    "Cross-stage work stealing: an idle encode/decode worker serves the "
+    "deepest sibling host-side stage queue (the denoise pool never "
+    "steals — it owns the mesh).", doc="docs/stages.md")
+STAGE_MAX_REDISPATCH = knob_int(
+    "CDT_STAGE_MAX_REDISPATCH", 3, "stages",
+    "Re-dispatch bound for work a dead stage worker was holding; past "
+    "it the member errors loudly instead of ping-ponging.",
+    doc="docs/stages.md")
+
 # --- VAE decode tiling ------------------------------------------------------
 # 3D-VAE decodes switch to spatially-tiled mode when the latent frame area
 # exceeds this (latent pixels): a 480p WAN clip decode holds >31 GB of f32

@@ -108,10 +108,53 @@ export function telemetryRows(metrics) {
   const retries = seriesSum(metrics, "cdt_retry_attempts_total");
   if (retries > 0) rows.push(["Retries", String(retries)]);
   rows.push(["Front door", frontDoorSummary(metrics)]);
+  rows.push(["Stages", stagesSummary(metrics)]);
   rows.push(["Content cache", cacheSummary(metrics)]);
   rows.push(["Elastic fleet", elasticSummary(metrics)]);
   rows.push(["Preemption", preemptionSummary(metrics)]);
   return rows;
+}
+
+// Disaggregated stage-split serving (cluster/stages): per-pool depth
+// and occupancy, the mean decode batch (cross-request VAE coalescing),
+// latent-transfer volume, and the loud redispatch counter for work a
+// dead stage worker was holding (docs/stages.md).
+export function stagesSummary(metrics) {
+  const depthFam = metrics && metrics.cdt_stage_queue_depth;
+  const occFam = metrics && metrics.cdt_stage_occupancy;
+  const jobs = countsByLabel(metrics, "cdt_stage_jobs_total", "stage");
+  const total = Object.values(jobs).reduce((a, b) => a + b, 0);
+  if (!depthFam && !total) return "fused path";
+  const parts = [];
+  const occBy = {};
+  for (const s of ((occFam && occFam.series) || [])) {
+    occBy[(s.labels || {}).stage || "?"] = s.value;
+  }
+  const depthBy = {};
+  for (const s of ((depthFam && depthFam.series) || [])) {
+    depthBy[(s.labels || {}).stage || "?"] = s.value;
+  }
+  for (const stage of ["encode", "denoise", "decode"]) {
+    if (stage in depthBy || stage in occBy) {
+      const occ = stage in occBy
+        ? ` ${(occBy[stage] * 100).toFixed(0)}%` : "";
+      parts.push(`${stage} q${depthBy[stage] || 0}${occ}`);
+    }
+  }
+  const dec = mergeHistogram(metrics, "cdt_decode_batch_size");
+  if (dec && dec.count) {
+    parts.push(`decode x̄ ${(dec.sum / dec.count).toFixed(2)}`);
+  }
+  const xfer = mergeHistogram(metrics, "cdt_latent_transfer_bytes");
+  if (xfer && xfer.count) {
+    parts.push(`${xfer.count} handoffs ${(xfer.sum / (1024 * 1024)).toFixed(1)} MB`);
+  }
+  const steals = seriesSum(metrics, "cdt_stage_steals_total");
+  if (steals > 0) parts.push(`${steals} steals`);
+  const redisp = countsByLabel(metrics, "cdt_stage_jobs_total", "outcome")
+    .redispatch || 0;
+  if (redisp > 0) parts.push(`${redisp} REDISPATCHED`);
+  return parts.length ? parts.join(" · ") : "fused path";
 }
 
 // Step-granular preemption (cluster/preemption.py): preempt counts by

@@ -5,7 +5,7 @@ import { test } from "node:test";
 
 import { breakerSummary, cacheSummary, countsByLabel, elasticSummary,
          fmtSeconds, frontDoorSummary, histQuantile, mergeHistogram,
-         preemptionSummary, seriesSum,
+         preemptionSummary, seriesSum, stagesSummary,
          telemetryRows } from "../telemetryLogic.js";
 
 const METRICS = {
@@ -268,6 +268,58 @@ test("preemptionSummary reports reasons, parked state, and dead-letters", () => 
   // a dangling "none ·" fragment
   assert.equal(preemptionSummary({ cdt_jobs_preempted: {
     type: "gauge", series: [{ labels: {}, value: 1 }] } }), "1 parked");
+});
+
+test("stagesSummary reports per-pool state, decode coalescing, and redispatches", () => {
+  assert.equal(stagesSummary({}), "fused path");
+  const metrics = {
+    cdt_stage_queue_depth: {
+      type: "gauge",
+      series: [
+        { labels: { stage: "encode" }, value: 2 },
+        { labels: { stage: "denoise" }, value: 1 },
+        { labels: { stage: "decode" }, value: 5 },
+      ],
+    },
+    cdt_stage_occupancy: {
+      type: "gauge",
+      series: [
+        { labels: { stage: "denoise" }, value: 1 },
+        { labels: { stage: "decode" }, value: 0.5 },
+      ],
+    },
+    cdt_stage_jobs_total: {
+      type: "counter",
+      series: [
+        { labels: { stage: "decode", outcome: "ok" }, value: 7 },
+        { labels: { stage: "decode", outcome: "redispatch" }, value: 2 },
+      ],
+    },
+    cdt_decode_batch_size: {
+      type: "histogram",
+      series: [{ labels: {}, buckets: [[1, 1], [2, 3], [4, 4]],
+                 sum: 11, count: 4 }],
+    },
+    cdt_latent_transfer_bytes: {
+      type: "histogram",
+      series: [{ labels: {}, buckets: [[65536, 8]],
+                 sum: 8 * 1024 * 1024, count: 8 }],
+    },
+    cdt_stage_steals_total: {
+      type: "counter",
+      series: [{ labels: { src: "decode", dst: "encode" }, value: 3 }],
+    },
+  };
+  const row = stagesSummary(metrics);
+  assert.match(row, /encode q2/);
+  assert.match(row, /denoise q1 100%/);
+  assert.match(row, /decode q5 50%/);
+  assert.match(row, /decode x̄ 2\.75/);
+  assert.match(row, /8 handoffs 8\.0 MB/);
+  assert.match(row, /3 steals/);
+  assert.match(row, /2 REDISPATCHED/);
+  const byKey = Object.fromEntries(telemetryRows(metrics));
+  assert.match(byKey["Stages"], /decode q5/);
 });
 
 test("telemetryRows tolerates absent families and renders the rest", () => {

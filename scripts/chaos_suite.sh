@@ -53,7 +53,7 @@ env JAX_PLATFORMS=cpu CDT_CHAOS_SEED="${SEED}" \
 echo "[chaos] stage 3: full chaos tier"
 env JAX_PLATFORMS=cpu CDT_CHAOS_SEED="${SEED}" \
     python -m pytest tests/ -q -m chaos \
-    -k "not warm_restarted and not overload and not scale_event and not cache_corrupt and not mesh_drain and not preempt" \
+    -k "not warm_restarted and not overload and not scale_event and not cache_corrupt and not mesh_drain and not preempt and not decode_worker" \
     -p no:cacheprovider --continue-on-collection-errors "$@"
 
 # Stage 4 — seeded scale events under live load (ISSUE 10,
@@ -129,3 +129,24 @@ env JAX_PLATFORMS=cpu PYTHONPATH="$(pwd)" \
     CDT_COMPILE_CACHE_DIR="${CDT_COMPILE_CACHE_DIR:-/tmp/cdt_xla_cache_chaos}" \
     python scripts/load_smoke.py --in-process --preempt --n 6 \
     --concurrency 4 --seed "${SEED}"
+
+# Stage 8 — stage-split serving under decode-worker death (ISSUE 15,
+# docs/stages.md): (a) the chaos-marked acceptance under the runtime
+# lock-order detector — a decode-pool worker is killed while holding a
+# BATCH of transferred latents; the latents re-dispatch to a surviving
+# decoder, every member completes BIT-identically to the fused path,
+# zero dead-letters, no breaker opens, zero lock inversions; (b)
+# load_smoke --stages — the mixed-tenant load through the three pools,
+# exit 1 on any admitted-job loss or a stage backlog past its shed
+# threshold. The compile cache dir keeps the latent/decode programs
+# warm across re-runs.
+echo "[chaos] stage 8: stage-split serving (decode-worker death, bounded backlogs)"
+env JAX_PLATFORMS=cpu CDT_CHAOS_SEED="${SEED}" CDT_LOCK_ORDER=1 \
+    python -m pytest tests/ -q -m chaos -k "decode_worker" \
+    -p no:cacheprovider --continue-on-collection-errors "$@"
+echo "[chaos] stage 8b: stages load smoke (three pools, bounded backlogs)"
+env JAX_PLATFORMS=cpu PYTHONPATH="$(pwd)" \
+    CDT_CONFIG_PATH="$(mktemp -d)/config.json" \
+    CDT_COMPILE_CACHE_DIR="${CDT_COMPILE_CACHE_DIR:-/tmp/cdt_xla_cache_chaos}" \
+    python scripts/load_smoke.py --in-process --stages --n 12 \
+    --concurrency 8 --seed "${SEED}"

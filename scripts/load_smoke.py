@@ -277,10 +277,22 @@ async def run_preempt_leg(submit, wait_done, preempt_stats, *,
 async def _run_http(url: str, requests: list[dict], concurrency: int,
                     wait: bool, timeout_s: float,
                     churn: Optional[dict] = None,
-                    preempt: Optional[dict] = None) -> dict:
+                    preempt: Optional[dict] = None,
+                    stages: bool = False) -> dict:
     import aiohttp
 
     async with aiohttp.ClientSession() as session:
+        stage_sampler = None
+        stage_probe = {"stop": False, "max_depths": {}}
+        if stages:
+            async def get_depths():
+                async with session.get(f"{url}/distributed/stages") as r:
+                    body = await r.json()
+                return {name: p.get("depth", 0)
+                        for name, p in (body.get("pools") or {}).items()}
+
+            stage_sampler = asyncio.ensure_future(
+                _sample_stage_depths(get_depths, stage_probe))
 
         async def submit(payload):
             async with session.post(f"{url}/distributed/queue",
@@ -344,14 +356,26 @@ async def _run_http(url: str, requests: list[dict], concurrency: int,
         if churn_task is not None:
             stats["churn"] = await churn_task
         stats["metrics"] = await _fetch_occupancy(session, url)
+        if stage_sampler is not None:
+            stage_probe["stop"] = True
+            await stage_sampler
+            try:
+                async with session.get(f"{url}/distributed/stages") as r:
+                    stats["stages"] = {
+                        "max_depths": stage_probe["max_depths"],
+                        **(await r.json()),
+                    }
+            except Exception:  # noqa: BLE001 — stats are decoration
+                stats["stages"] = {
+                    "max_depths": stage_probe["max_depths"]}
         return stats
 
 
 def _occupancy_from_snapshot(snap: dict) -> dict:
-    """``{batch_programs, mean_batch_size, cache_hits, coalesce_width}``
-    from a metrics.json-shaped snapshot — shared by the HTTP and
-    in-process modes (and consumed by bench.py's serving/caching
-    workloads) so the definitions can't drift."""
+    """``{batch_programs, mean_batch_size, cache_hits, coalesce_width,
+    mean_decode_batch}`` from a metrics.json-shaped snapshot — shared by
+    the HTTP and in-process modes (and consumed by bench.py's
+    serving/caching/stages workloads) so the definitions can't drift."""
     metrics = snap.get("metrics") or {}
     fam = metrics.get("cdt_batch_size") or {}
     series = fam.get("series") or []
@@ -367,7 +391,28 @@ def _occupancy_from_snapshot(snap: dict) -> dict:
     n = sum(s.get("count", 0) for s in cw)
     w = sum(s.get("sum", 0) for s in cw)
     out["coalesce_width"] = round(w / n, 3) if n else None
+    db = (metrics.get("cdt_decode_batch_size") or {}).get("series") or []
+    dn = sum(s.get("count", 0) for s in db)
+    dw = sum(s.get("sum", 0) for s in db)
+    out["mean_decode_batch"] = round(dw / dn, 3) if dn else None
     return out
+
+
+async def _sample_stage_depths(get_depths, out: dict,
+                               interval_s: float = 0.1) -> None:
+    """Background sampler for the ``--stages`` leg: track the max
+    backlog each stage pool ever showed — the bounded-queue assertion
+    (any stage past CDT_STAGE_SHED_DEPTH is overload the admission
+    layer failed to shed)."""
+    while not out.get("stop"):
+        try:
+            depths = await get_depths()
+            for k, v in (depths or {}).items():
+                out["max_depths"][k] = max(out["max_depths"].get(k, 0),
+                                           int(v))
+        except Exception:  # noqa: BLE001 — sampling is decoration
+            pass
+        await asyncio.sleep(interval_s)
 
 
 async def _fetch_occupancy(session, url: str) -> dict:
@@ -382,7 +427,8 @@ async def _fetch_occupancy(session, url: str) -> dict:
 async def _run_in_process(requests: list[dict], concurrency: int,
                           wait: bool, timeout_s: float,
                           churn: Optional[dict] = None,
-                          preempt: Optional[dict] = None) -> dict:
+                          preempt: Optional[dict] = None,
+                          stages: bool = False) -> dict:
     from aiohttp.test_utils import TestClient, TestServer
 
     from comfyui_distributed_tpu.api import create_app
@@ -391,7 +437,15 @@ async def _run_in_process(requests: list[dict], concurrency: int,
     controller = Controller()
     client = TestClient(TestServer(create_app(controller)))
     await client.start_server()
+    stage_sampler = None
+    stage_probe = {"stop": False, "max_depths": {}}
     try:
+        if stages and controller.stages is not None:
+            async def get_depths():
+                return controller.stages.depths()
+
+            stage_sampler = asyncio.ensure_future(
+                _sample_stage_depths(get_depths, stage_probe))
 
         async def submit(payload):
             resp = await client.post("/distributed/queue", json=payload)
@@ -466,6 +520,16 @@ async def _run_in_process(requests: list[dict], concurrency: int,
 
             stats["metrics"] = _occupancy_from_snapshot(
                 render_json(REGISTRY.snapshot()))
+        # mesh-lane accounting the stages A/B divides by (bench.py)
+        stats["queue_busy_seconds"] = round(
+            controller.queue.busy_seconds, 4)
+        if stage_sampler is not None:
+            stage_probe["stop"] = True
+            await stage_sampler
+            stats["stages"] = {
+                "max_depths": stage_probe["max_depths"],
+                **controller.stages.stats(),
+            }
         return stats
     finally:
         await client.close()
@@ -503,6 +567,13 @@ def main() -> int:
                          "exit 1 unless the long job completes, at "
                          "least one preemption fired, and interactive "
                          "p99 stays under the budget")
+    ap.add_argument("--stages", action="store_true",
+                    help="stage-split leg (ISSUE 15, docs/stages.md): "
+                         "drive the mixed-tenant load through the "
+                         "encode/denoise/decode pools, sampling each "
+                         "pool's backlog; exit 1 on admitted-job loss "
+                         "or any stage queue exceeding its shed "
+                         "threshold (CDT_STAGE_SHED_DEPTH)")
     ap.add_argument("--preempt-long-steps", type=int, default=48)
     ap.add_argument("--preempt-p99-budget-s", type=float, default=None,
                     help="interactive p99 ceiling (default: "
@@ -534,11 +605,12 @@ def main() -> int:
     if cli.url:
         stats = asyncio.run(_run_http(cli.url, requests, cli.concurrency,
                                       wait, cli.timeout_s, churn=churn,
-                                      preempt=preempt))
+                                      preempt=preempt, stages=cli.stages))
     else:
         stats = asyncio.run(_run_in_process(requests, cli.concurrency,
                                             wait, cli.timeout_s,
-                                            churn=churn, preempt=preempt))
+                                            churn=churn, preempt=preempt,
+                                            stages=cli.stages))
     print(json.dumps(stats, indent=2, default=str))
     accepted = stats["admitted"] + stats["queued"]
     accounted = (stats["completed"] + stats["errors"] + stats["expired"])
@@ -556,6 +628,24 @@ def main() -> int:
         if max_depth > constants.FD_SHED_DEPTH:
             print(f"UNBOUNDED DEPTH: observed {max_depth} > shed "
                   f"threshold {constants.FD_SHED_DEPTH}", file=sys.stderr)
+            return 1
+    if cli.stages:
+        from comfyui_distributed_tpu.utils import constants
+
+        shed = constants.STAGE_SHED_DEPTH.get()
+        stage_stats = stats.get("stages") or {}
+        # HTTP mode answers {"enabled": false} when the server runs
+        # CDT_STAGES=0 — a truthy dict, so the presence check alone
+        # would pass vacuously without ever exercising the pools
+        if not stage_stats or stage_stats.get("enabled") is False:
+            print("NO STAGE STATS: --stages leg ran without the stage "
+                  "pools (CDT_STAGES=0?)", file=sys.stderr)
+            return 1
+        max_depths = stage_stats.get("max_depths") or {}
+        over = {k: v for k, v in max_depths.items() if v > shed}
+        if over:
+            print(f"STAGE BACKLOG PAST SHED THRESHOLD ({shed}): {over}",
+                  file=sys.stderr)
             return 1
     if cli.preempt:
         lj = stats.get("long_job") or {}

@@ -47,7 +47,14 @@ def _fake_image(seed: int, h: int, w: int):
 @pytest.fixture
 def stub_sampler(monkeypatch):
     """Replace both generate paths with seed-tagged stubs + a step-time
-    sleep; record every microbatch occupancy."""
+    sleep; record every microbatch occupancy.
+
+    Pins the FUSED group path (CDT_STAGES=0): these stubs replace
+    ``generate``/``generate_microbatch``, which the stage-split lane
+    never calls (it runs ``generate_latents`` + ``decode_latents``).
+    This file is the fused scheduler harness; the staged lane has its
+    own load and equivalence tests (tests/test_stages*.py)."""
+    monkeypatch.setenv("CDT_STAGES", "0")
     batches: list[int] = []
 
     def fake_generate(self, mesh, spec, seed, context, uncond_context,

@@ -1,0 +1,275 @@
+"""Stage-split vs fused output equivalence (ISSUE 15 acceptance).
+
+The property the whole stage split rests on: the disaggregated path —
+latent-only microbatch in the denoise pool, batched VAE decode in the
+decode pool, with a host round trip (and optionally the full checksummed
+wire format) between them — produces outputs BIT-identical to the fused
+path for the tier-1 matrix:
+
+- batched decode (a group of 2 sharing one decode program);
+- solo decode (a group of 1 — a decode batch of 1);
+- encode-cache MISS (cold conditioning) and encode-cache HIT (the
+  second request's text encode served from the conditioning tier);
+- ``CDT_STAGE_WIRE=1`` (every handoff through the checksummed npz wire
+  format);
+- a non-batchable member (stochastic sampler) degrading to the fused
+  solo path inside the denoise stage.
+
+Why this is provable rather than approximate: each stage boundary is a
+pure program split on a materialized value (the PR 14 seg/fin
+precedent), every unrolled subgraph keeps the solo program's tensor
+shapes, and host numpy round trips are bit-exact
+(``diffusion/pipeline.py`` latent_microbatch_fn / decode_fn).
+"""
+
+import asyncio
+import time
+
+import numpy as np
+import pytest
+
+from comfyui_distributed_tpu.cluster.runtime import PromptJob, PromptQueue
+from comfyui_distributed_tpu.cluster.stages import StageManager
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+def txt2img_prompt(seed: int, steps: int = 2, text: str = "x",
+                   wh: int = 16, sampler: str | None = None) -> dict:
+    inputs = {
+        "model": ["1", 0], "positive": ["2", 0], "negative": ["3", 0],
+        "seed": seed, "steps": steps, "cfg": 2.0,
+        "width": wh, "height": wh}
+    if sampler is not None:
+        inputs["sampler_name"] = sampler
+    return {
+        "1": {"class_type": "CheckpointLoader",
+              "inputs": {"ckpt_name": "tiny"}},
+        "2": {"class_type": "CLIPTextEncode",
+              "inputs": {"text": text, "clip": ["1", 1]}},
+        "3": {"class_type": "CLIPTextEncode",
+              "inputs": {"text": "", "clip": ["1", 1]}},
+        "4": {"class_type": "TPUTxt2Img", "inputs": inputs},
+    }
+
+
+@pytest.fixture
+def exec_context(tmp_config):
+    from comfyui_distributed_tpu.cluster.cache import build_cache_manager
+    from comfyui_distributed_tpu.models.registry import ModelRegistry
+    from comfyui_distributed_tpu.parallel.mesh import build_mesh
+
+    registry = ModelRegistry(None)
+    mesh = build_mesh({"dp": 2})
+    cache = build_cache_manager()
+    return lambda: {"mesh": mesh, "model_registry": registry,
+                    "content_cache": cache}
+
+
+async def _wait(q, pid, timeout=300.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        e = q.history.get(pid)
+        if e is not None and e.get("status") in ("success", "error",
+                                                 "interrupted", "expired"):
+            return e
+        await asyncio.sleep(0.01)
+    raise AssertionError(f"{pid} never terminal: {q.history.get(pid)}")
+
+
+async def _solo_ref(exec_context, seed, steps=2, text="x",
+                    sampler=None):
+    """Fused solo reference: a bare queue (stages=None) running the
+    monolithic path."""
+    q = PromptQueue(context_factory=exec_context)
+    pid, errs = q.enqueue(txt2img_prompt(seed, steps, text,
+                                         sampler=sampler))
+    assert not errs
+    e = await _wait(q, pid)
+    assert e["status"] == "success", e
+    img = np.asarray(e["outputs"]["4"][0])
+    await q.stop()
+    return img
+
+
+def _member(pid, seed, steps=2, text="x", sampler=None):
+    return PromptJob(pid, txt2img_prompt(seed, steps, text,
+                                         sampler=sampler),
+                     priority="interactive")
+
+
+async def _staged_group(exec_context, members, timeout=300.0):
+    q = PromptQueue(context_factory=exec_context)
+    q.stages = StageManager()
+    try:
+        q.enqueue_batch(members, {m.prompt_id: "4" for m in members})
+        entries = {}
+        for m in members:
+            entries[m.prompt_id] = await _wait(q, m.prompt_id, timeout)
+        return entries, q.stages.stats()
+    finally:
+        q.stages.stop()
+        await q.stop()
+
+
+def test_batched_decode_bit_identical_to_fused(tmp_config, exec_context):
+    """Group of 2 (distinct seeds AND distinct conditioning): one latent
+    program + ONE batched decode program, outputs bit-identical to the
+    fused solo path."""
+
+    async def body():
+        ref_a = await _solo_ref(exec_context, 11, text="a cat")
+        ref_b = await _solo_ref(exec_context, 22, text="a dog")
+        entries, stats = await _staged_group(
+            exec_context, [_member("e1", 11, text="a cat"),
+                           _member("e2", 22, text="a dog")])
+        for pid, e in entries.items():
+            assert e["status"] == "success", e
+        assert entries["e1"]["decode_batch"] == 2
+        got_a = np.asarray(entries["e1"]["outputs"]["4"][0])
+        got_b = np.asarray(entries["e2"]["outputs"]["4"][0])
+        assert np.array_equal(got_a, ref_a), \
+            f"maxdiff={np.abs(got_a - ref_a).max()}"
+        assert np.array_equal(got_b, ref_b)
+        assert stats["pools"]["denoise"]["done"] == 1
+
+    run(body())
+
+
+def test_solo_decode_bit_identical_to_fused(tmp_config, exec_context):
+    """Group of 1: the degenerate staged path (latent program of one,
+    decode batch of one) still matches the fused path byte for byte."""
+
+    async def body():
+        ref = await _solo_ref(exec_context, 33, text="solo lane")
+        entries, _ = await _staged_group(
+            exec_context, [_member("s1", 33, text="solo lane")])
+        e = entries["s1"]
+        assert e["status"] == "success", e
+        assert e["decode_batch"] == 1
+        got = np.asarray(e["outputs"]["4"][0])
+        assert np.array_equal(got, ref)
+
+    run(body())
+
+
+def test_encode_cache_hit_and_miss_bit_identical(tmp_config,
+                                                 exec_context):
+    """The encode-cache matrix leg: request 1 encodes COLD (miss),
+    request 2 re-uses the text (conditioning-tier HIT, fresh seed so the
+    result tier cannot answer) — both bit-identical to fused refs."""
+
+    async def body():
+        ctx = exec_context()
+        cache = ctx["content_cache"]
+        ref_1 = await _solo_ref(exec_context, 41, text="same words")
+        ref_2 = await _solo_ref(exec_context, 42, text="same words")
+        cond_hits_before = cache.conditioning.counts["hit"]
+
+        entries, _ = await _staged_group(
+            exec_context, [_member("m1", 41, text="same words")])
+        assert np.array_equal(
+            np.asarray(entries["m1"]["outputs"]["4"][0]), ref_1)
+
+        entries, _ = await _staged_group(
+            exec_context, [_member("m2", 42, text="same words")])
+        assert np.array_equal(
+            np.asarray(entries["m2"]["outputs"]["4"][0]), ref_2)
+        # the second staged encode was served by the conditioning tier
+        assert cache.conditioning.counts["hit"] > cond_hits_before
+
+    run(body())
+
+
+def test_wire_format_round_trip_bit_identical(tmp_config, exec_context,
+                                              monkeypatch):
+    """CDT_STAGE_WIRE=1: every denoise→decode handoff makes the full
+    checksummed serialize/verify/parse round trip (the cross-worker
+    transport) — and the output is still bit-identical."""
+    monkeypatch.setenv("CDT_STAGE_WIRE", "1")
+
+    async def body():
+        ref = await _solo_ref(exec_context, 55, text="over the wire")
+        entries, stats = await _staged_group(
+            exec_context, [_member("w1", 55, text="over the wire")])
+        e = entries["w1"]
+        assert e["status"] == "success", e
+        assert stats["wire"] is True
+        got = np.asarray(e["outputs"]["4"][0])
+        assert np.array_equal(got, ref)
+
+    run(body())
+
+
+@pytest.mark.slow
+def test_stochastic_member_degrades_to_fused_solo(tmp_config,
+                                                  exec_context):
+    """A stochastic-sampler member is not latent-stackable; the denoise
+    stage runs it through the fused solo pass-through — same output as
+    the solo queue path, and the group's deterministic member still
+    rides the staged lane."""
+
+    async def body():
+        ref_det = await _solo_ref(exec_context, 61, text="det")
+        ref_sto = await _solo_ref(exec_context, 62, text="sto",
+                                  sampler="euler_ancestral")
+        members = [_member("g1", 61, text="det"),
+                   _member("g2", 62, text="sto",
+                           sampler="euler_ancestral")]
+        entries, _ = await _staged_group(exec_context, members)
+        assert np.array_equal(
+            np.asarray(entries["g1"]["outputs"]["4"][0]), ref_det)
+        assert np.array_equal(
+            np.asarray(entries["g2"]["outputs"]["4"][0]), ref_sto)
+        # the stochastic member never got a decode_batch (fused solo)
+        assert "decode_batch" not in entries["g2"]
+
+    run(body())
+
+
+@pytest.mark.slow
+def test_pipeline_level_latent_plus_decode_matrix(tmp_config):
+    """Direct pipeline-level matrix incl. the pad path (R=3 → bucket 4):
+    generate_latents + decode_latents ≡ generate, bit for bit."""
+    import jax
+
+    from comfyui_distributed_tpu.diffusion.pipeline import (
+        GenerationSpec, Txt2ImgPipeline)
+    from comfyui_distributed_tpu.models.text import (TextEncoder,
+                                                     TextEncoderConfig)
+    from comfyui_distributed_tpu.models.unet import UNetConfig, init_unet
+    from comfyui_distributed_tpu.models.vae import (AutoencoderKL,
+                                                    VAEConfig)
+    from comfyui_distributed_tpu.parallel import build_mesh
+
+    model, params = init_unet(UNetConfig.tiny(), jax.random.key(0),
+                              sample_shape=(8, 8, 4), context_len=16)
+    vae = AutoencoderKL(VAEConfig.tiny()).init(jax.random.key(1),
+                                               image_hw=(16, 16))
+    pipe = Txt2ImgPipeline(model, params, vae)
+    enc = TextEncoder(TextEncoderConfig.tiny()).init(jax.random.key(2))
+    ctx_a, _ = enc.encode(["a"])
+    ctx_b, _ = enc.encode(["b"])
+    unc, _ = enc.encode([""])
+    mesh = build_mesh({"dp": 2})
+    spec = GenerationSpec(height=16, width=16, steps=3,
+                          guidance_scale=2.0)
+    seeds = [11, 22, 33]
+    ctxs = [ctx_a, ctx_b, ctx_a]
+    solo = [np.asarray(pipe.generate(mesh, spec, seed=s, context=c,
+                                     uncond_context=unc))
+            for s, c in zip(seeds, ctxs)]
+    lats = pipe.generate_latents(mesh, spec, seeds, ctxs, [unc] * 3)
+    # host round trip exactly like the transfer stage
+    host = [np.asarray(lat) for lat in lats]
+    imgs = pipe.decode_latents(mesh, host)
+    for got, want in zip(imgs, solo):
+        assert np.array_equal(np.asarray(got), want)
+    # mixed-order decode batch (items from "different groups"):
+    shuffled = [host[2], host[0], host[1]]
+    imgs2 = pipe.decode_latents(mesh, shuffled)
+    assert np.array_equal(np.asarray(imgs2[0]), solo[2])
+    assert np.array_equal(np.asarray(imgs2[1]), solo[0])
+    assert np.array_equal(np.asarray(imgs2[2]), solo[1])
