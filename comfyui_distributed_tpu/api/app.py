@@ -10,6 +10,7 @@ from __future__ import annotations
 import asyncio
 import hashlib
 import json
+import re
 from pathlib import Path
 
 from aiohttp import web
@@ -334,6 +335,67 @@ def create_app(controller: Controller) -> web.Application:
                    + cache.results.clear_memory())
         return web.json_response({"status": "cleared", "dropped": dropped})
 
+    def _cache_entry_key(request) -> str:
+        key = str(request.match_info.get("key", ""))
+        if not re.fullmatch(r"[0-9a-f]{64}", key):
+            raise ValidationError("key must be a 64-hex content digest",
+                                  field="key")
+        return key
+
+    async def cache_entry_get(request):
+        """Fleet-tier remote serve: the shard owner answers from its
+        LOCAL tiers only (memory → disk) — never re-forwards around the
+        ring, so a stale ring view can't create probe loops. 404 is the
+        normal miss signal (the prober recomputes)."""
+        cache = getattr(controller, "cache", None)
+        if cache is None:
+            return json_error("content cache disabled", status=404)
+        key = _cache_entry_key(request)
+        arrays = cache.results.get(key)
+        if arrays is None:
+            return json_error("no such entry", status=404)
+        from ..cluster.stages.latents import encode_array_payload
+
+        def _encode():
+            return {"key": key,
+                    "arrays": {n: encode_array_payload(a)
+                               for n, a in arrays.items()}}
+
+        # npz+b64+sha256 of image bundles off the event loop (same
+        # media-route discipline as /distributed/stages/decode)
+        body = await asyncio.get_running_loop().run_in_executor(
+            None, _encode)
+        return web.json_response(body)
+
+    async def cache_entry_put(request):
+        """Fleet-tier fill/handback target: checksum-verified npz
+        payloads land in this host's result tier. An unverifiable
+        payload is rejected loudly (400), never stored."""
+        cache = getattr(controller, "cache", None)
+        if cache is None:
+            return json_error("content cache disabled", status=404)
+        key = _cache_entry_key(request)
+        body = await _json_body(request)
+        payloads = body.get("arrays")
+        if not isinstance(payloads, dict) or not payloads:
+            raise ValidationError("missing 'arrays' object",
+                                  field="arrays")
+        from ..cluster.stages.latents import LatentWireError, \
+            decode_array_payload
+
+        def _decode():
+            return {str(n): decode_array_payload(p)
+                    for n, p in payloads.items()}
+
+        try:
+            arrays = await asyncio.get_running_loop().run_in_executor(
+                None, _decode)
+        except LatentWireError as e:
+            raise ValidationError(str(e), field="arrays")
+        cache.results.put(key, arrays)
+        return web.json_response({"status": "stored", "key": key,
+                                  "arrays": len(arrays)})
+
     # --- stage-split serving (cluster/stages, docs/stages.md) --------------
     async def stages_stats(request):
         stages = getattr(controller, "stages", None)
@@ -435,6 +497,8 @@ def create_app(controller: Controller) -> web.Application:
     r.add_get("/distributed/frontdoor", frontdoor_stats)
     r.add_get("/distributed/cache", cache_stats)
     r.add_post("/distributed/cache/clear", cache_clear)
+    r.add_get("/distributed/cache/entry/{key}", cache_entry_get)
+    r.add_put("/distributed/cache/entry/{key}", cache_entry_put)
     r.add_get("/distributed/preemption", preemption_stats)
     r.add_get("/distributed/stages", stages_stats)
     r.add_post("/distributed/stages/decode", stages_decode)

@@ -42,17 +42,20 @@ from ...utils import constants
 from ...utils.logging import log
 from .coalesce import InflightCoalescer
 from .conditioning import cached_encode
-from .keys import (conditioning_key, execution_signature,
-                   request_fingerprint, result_key)
+from .keys import (conditioning_key, execution_signature, near_fingerprint,
+                   near_key, request_fingerprint, result_key)
 from .store import CacheTier
 
 __all__ = [
     "CacheManager", "CacheTier", "InflightCoalescer", "build_cache_manager",
     "cache_enabled", "cached_encode", "conditioning_key",
-    "execution_signature", "request_fingerprint", "result_key",
+    "execution_signature", "near_fingerprint", "near_key",
+    "request_fingerprint", "result_key",
 ]
 
-CACHE_MODES = ("use", "bypass")
+# "near" opts one request into the approximate trajectory-reuse tier
+# (cluster/cache/fleet.py) — exact tiers still serve it first
+CACHE_MODES = ("use", "bypass", "near")
 
 
 def cache_enabled() -> bool:
@@ -109,6 +112,12 @@ class CacheManager:
             disk_max_bytes=constants.CACHE_DISK_MAX_BYTES)
         self.coalescer = InflightCoalescer()
         self._window = _HitRateWindow()
+        # fleet tier (cluster/cache/fleet.py), attached by the
+        # controller when CDT_FLEET_CACHE=1; None = per-host only.
+        # Remote serves go through the same record_request(hit=True)
+        # path as local ones, so the autoscaler's hit-rate window
+        # discounts work the fleet (not just this host) already has.
+        self.fleet = None
 
     # --- request-level outcomes (autoscaler signal) -------------------------
 
@@ -119,7 +128,11 @@ class CacheManager:
         """Fraction of recent QUEUED fingerprinted requests the result
         tier answered without a sampler program — the autoscaler's
         queue-depth discount (coalesced joins are excluded; they never
-        enter the queue)."""
+        enter the queue). Fleet-tier REMOTE serves count as hits: the
+        serving ladder records them through the same
+        ``record_request(hit=True)`` path as local serves, so a fleet
+        with a hot remote tier scales down on work it never executes.
+        Near-tier serves stay misses — a reduced program still runs."""
         return self._window.rate()
 
     # --- introspection ------------------------------------------------------
@@ -132,6 +145,7 @@ class CacheManager:
             "conditioning": self.conditioning.stats(),
             "result": self.results.stats(),
             "coalescer": self.coalescer.stats(),
+            "fleet": self.fleet.stats() if self.fleet is not None else None,
         }
 
 

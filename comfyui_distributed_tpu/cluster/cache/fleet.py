@@ -1,0 +1,546 @@
+"""Fleet tier of the content cache: one keyspace over N workers.
+
+PR 8's :mod:`cluster.cache` is strictly per-host — memory LRU plus a
+flock'd local disk — so the fleet's hit rate is capped by which worker a
+duplicate request happens to land on. This module promotes that cache to
+a fleet tier:
+
+- **Consistent-hash ring** (:class:`HashRing`): virtual nodes with
+  seeded SHA-256 placement map the content-addressed keyspace over the
+  active workers. Placement is a pure function of (seed, member id,
+  vnode index), so every worker that shares ``CDT_FLEET_CACHE_SEED``
+  computes the *same* ring from the same membership — no coordination
+  round, no gossip. Membership churn is fed by the elastic
+  :data:`~..elastic.states.DRAIN` lifecycle registry: a joining worker
+  claims only its own vnode arcs (no global rehash), and a draining
+  worker hands its shard's hot entries to their post-drain owners
+  exactly once (PR 7 handback semantics — intentional departure, never
+  breaker evidence).
+
+- **Remote fills and serves** ride the checksummed npz+sha256 wire
+  contract (:func:`~..stages.latents.encode_array_payload`) over
+  ``GET/PUT /distributed/cache/entry/{key}``, with breaker gating and a
+  small retry budget from :mod:`cluster.resilience`. The fallback ladder
+  is strict and total: local memory → local disk → ring owner →
+  recompute. A dead, slow, or disagreeing owner degrades to a miss —
+  the fleet tier can *never* turn a cacheable request into an error.
+  Remote failures are also never fed to the owner's breaker: the probe
+  is best-effort scavenging, and poisoning a worker's breaker over a
+  cache miss would shed serving capacity to save a recompute.
+
+- **Asynchronous fills**: the serve path calls :meth:`FleetCache.fill`
+  after a local fill and returns immediately; the PUT propagates on the
+  controller loop in the background.
+
+- **Near tier** (:class:`NearTier`, opt-in via ``cache: "near"``): a
+  near-duplicate request — same fingerprint *modulo seed* — reuses a
+  cached mid-trajectory latent checkpoint (PR 14's
+  :class:`~...diffusion.checkpoint.CheckpointStore` + identity meta) as
+  its init, cutting the denoise roughly in half for re-roll traffic.
+  Near serves are NEVER bit-identical to a from-scratch run and never
+  fill the exact result tier — see docs/caching.md for the soundness
+  argument.
+
+``CDT_FLEET_CACHE=0`` disables all of it: :func:`build_fleet_cache`
+returns None and every call site falls back to PR 8 behavior verbatim.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import bisect
+from collections import OrderedDict
+from typing import Callable, Optional
+
+import numpy as np
+
+from ...lint.lockorder import tracked_lock
+from ...utils import constants
+from ...utils.logging import debug_log, log
+from ..elastic.states import DRAIN, DRAINING
+from ..resilience import BREAKERS, RetryPolicy
+from . import keys as _keys
+
+
+def _fleet_metrics():
+    try:
+        from ... import telemetry
+        from ...telemetry import metrics as _tm
+
+        return telemetry.enabled(), _tm
+    except Exception:  # noqa: BLE001 — telemetry is never load-bearing
+        return False, None
+
+
+def _count_remote(op: str, outcome: str) -> None:
+    enabled, _tm = _fleet_metrics()
+    if enabled:
+        _tm.FLEET_CACHE_REMOTE.labels(op=op, outcome=outcome).inc()
+
+
+class HashRing:
+    """Deterministic consistent-hash ring over worker ids.
+
+    Every vnode position is ``digest("ring", seed, member, i)`` and a
+    key's position is ``digest("ring-key", key)`` — pure SHA-256 of the
+    inputs, so two processes with the same (members, vnodes, seed)
+    agree on every owner without exchanging a byte. Adding or removing
+    one member moves only that member's arcs (the consistent-hashing
+    property the tests pin down).
+    """
+
+    def __init__(self, members, vnodes: Optional[int] = None,
+                 seed: Optional[str] = None):
+        self.vnodes = (constants.FLEET_CACHE_VNODES.get()
+                       if vnodes is None else int(vnodes))
+        self.seed = (constants.FLEET_CACHE_SEED.get()
+                     if seed is None else str(seed))
+        points: list[tuple[int, str]] = []
+        for member in sorted(set(str(m) for m in members)):
+            for i in range(max(1, self.vnodes)):
+                pos = int(_keys.digest("ring", self.seed, member,
+                                       str(i))[:16], 16)
+                points.append((pos, member))
+        points.sort()
+        self._points = points
+        self._positions = [p for p, _ in points]
+
+    def members(self) -> list:
+        return sorted(set(m for _, m in self._points))
+
+    def __len__(self) -> int:
+        return len(self.members())
+
+    def owner(self, key: str) -> Optional[str]:
+        """The worker owning ``key``'s shard (clockwise-next vnode,
+        wrapping), or None on an empty ring."""
+        if not self._points:
+            return None
+        pos = int(_keys.digest("ring-key", str(key))[:16], 16)
+        idx = bisect.bisect_right(self._positions, pos) % len(self._points)
+        return self._points[idx][1]
+
+
+class NearTier:
+    """Opt-in approximate tier: seedless near-key → donor checkpoint.
+
+    Holds mid-trajectory latent checkpoints parked by exact-path
+    executions, keyed by :func:`~.keys.near_key` (the request identity
+    with every integer seed masked). A ``cache:"near"`` re-roll that
+    matches a donor resumes denoising from the donor's carry instead of
+    pure noise — roughly half the steps — under its OWN fresh seed.
+    The donor's identity meta (sampler, scheduler, geometry, dp width,
+    conditioning digest — everything except seed) is validated before
+    reuse; any mismatch is a miss, never a wrong init.
+    """
+
+    def __init__(self, max_entries: Optional[int] = None):
+        from ...diffusion.checkpoint import CheckpointStore
+
+        # memory-only store: donor carries are bf16/f32 jax leaves whose
+        # value is warm-path reuse, not durability
+        self.store = CheckpointStore(directory="")
+        self.max_entries = (constants.FLEET_CACHE_NEAR_MAX.get()
+                            if max_entries is None else int(max_entries))
+        self._map: "OrderedDict[str, str]" = OrderedDict()
+        self._lock = tracked_lock("cache.fleet.near")
+        self.counts = {"donor": 0, "reuse": 0, "steps_saved": 0,
+                       "mismatch": 0}
+
+    def offer(self, near_k: str, ckpt) -> Optional[str]:
+        """Park a donor under its near key (latest donor wins; LRU cap
+        ``CDT_FLEET_CACHE_NEAR_MAX``). Returns the checkpoint id."""
+        if self.max_entries <= 0:
+            return None
+        cid = self.store.park(ckpt)
+        dropped: list[str] = []
+        with self._lock:
+            old = self._map.pop(near_k, None)
+            self._map[near_k] = cid
+            if old is not None and old != cid:
+                dropped.append(old)
+            while len(self._map) > self.max_entries:
+                _, evicted = self._map.popitem(last=False)
+                if evicted != cid:
+                    dropped.append(evicted)
+            self.counts["donor"] += 1
+        for c in dropped:
+            self.store.drop(c)
+        return cid
+
+    def lookup(self, near_k: str, expect_meta: dict):
+        """A donor checkpoint matching ``expect_meta`` (which must NOT
+        contain ``seed`` — matching modulo seed is the whole point), or
+        None. A meta mismatch or corrupt donor is dropped and counted,
+        and the caller computes from scratch."""
+        with self._lock:
+            cid = self._map.get(near_k)
+        if cid is None:
+            return None
+        ckpt = self.store.get(cid)
+        if ckpt is None:
+            with self._lock:
+                if self._map.get(near_k) == cid:
+                    del self._map[near_k]
+            return None
+        try:
+            ckpt.validate_meta(expect_meta)
+        except Exception as e:  # noqa: BLE001 — mismatch is a miss
+            debug_log(f"fleet.near: donor {cid} rejected: {e}")
+            with self._lock:
+                self.counts["mismatch"] += 1
+                if self._map.get(near_k) == cid:
+                    del self._map[near_k]
+            self.store.drop(cid)
+            return None
+        with self._lock:
+            if near_k in self._map:
+                self._map.move_to_end(near_k)
+        return ckpt
+
+    def record_reuse(self, steps_saved: int) -> None:
+        with self._lock:
+            self.counts["reuse"] += 1
+            self.counts["steps_saved"] += int(steps_saved)
+        enabled, _tm = _fleet_metrics()
+        if enabled:
+            _tm.FLEET_NEAR_REUSE.inc()
+            _tm.FLEET_NEAR_STEPS_SAVED.inc(int(steps_saved))
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"entries": len(self._map),
+                    "max_entries": self.max_entries, **self.counts}
+
+
+class FleetCache:
+    """The fleet tier: ring ownership + remote serve/fill/handback.
+
+    ``membership`` is a zero-arg callable returning
+    ``{worker_id: base_url_or_None}`` for the configured fleet (the
+    controller wires it to its host config); workers the DRAIN registry
+    marks as leaving are excluded from the ring here, so call sites
+    don't each re-implement lifecycle filtering. ``transport`` lets
+    tests inject an async ``(op, owner, url, key, arrays) -> result``
+    in place of real HTTP.
+    """
+
+    def __init__(self, manager, self_id: str,
+                 membership: Callable[[], dict],
+                 transport: Optional[Callable] = None):
+        self.manager = manager
+        self.self_id = str(self_id) or "master"
+        self._membership = membership
+        self._transport = transport
+        self.loop: Optional[asyncio.AbstractEventLoop] = None
+        self._lock = tracked_lock("cache.fleet")
+        self._ring_cache: Optional[tuple] = None
+        # strong refs to in-flight async fills/handbacks (a bare
+        # run_coroutine_threadsafe future is garbage-collectable
+        # mid-flight)
+        self._pending: set = set()
+        self._handed: set = set()
+        self.counts = {"remote_hit": 0, "remote_miss": 0,
+                       "remote_error": 0, "remote_skipped": 0,
+                       "fill": 0, "fill_error": 0, "handback": 0}
+        self.near = NearTier()
+        # tight budget: the ladder's next rung is a recompute, not an
+        # error, so retrying hard buys little and holds the serve path
+        self._retry = RetryPolicy(max_attempts=2, base=0.1, cap=0.5)
+        DRAIN.subscribe(self._on_lifecycle)
+
+    # --- lifecycle ----------------------------------------------------------
+
+    def attach_loop(self, loop: asyncio.AbstractEventLoop) -> None:
+        """Controller startup hands over its running loop; until then
+        probes/fills are skipped (ladder degrades to local-only)."""
+        self.loop = loop
+
+    def close(self) -> None:
+        DRAIN.unsubscribe(self._on_lifecycle)
+
+    def _on_lifecycle(self, worker_id: str, state: str) -> None:
+        with self._lock:
+            self._ring_cache = None  # any transition can change the ring
+        if worker_id == self.self_id and state == DRAINING:
+            loop = self.loop
+            if loop is not None and loop.is_running():
+                fut = asyncio.run_coroutine_threadsafe(self.handback(),
+                                                       loop)
+                self._track(fut)
+
+    def _track(self, fut) -> None:
+        self._pending.add(fut)
+        fut.add_done_callback(self._pending.discard)
+
+    # --- ring ---------------------------------------------------------------
+
+    def _raw_members(self) -> dict:
+        try:
+            members = dict(self._membership() or {})
+        except Exception as e:  # noqa: BLE001 — membership must not throw
+            debug_log(f"fleet: membership callable failed: {e}")
+            members = {}
+        members.setdefault(self.self_id, None)
+        return {str(k): v for k, v in members.items()}
+
+    def _active_members(self, include_self_drain: bool = False) -> dict:
+        members = self._raw_members()
+        return {wid: url for wid, url in members.items()
+                if (include_self_drain and wid == self.self_id)
+                or not DRAIN.is_leaving(wid)}
+
+    def ring(self) -> tuple:
+        """(HashRing, {member: url}) over the current active membership.
+        The ring is rebuilt only when the sorted member set changes —
+        lifecycle transitions invalidate the cache via the DRAIN feed."""
+        members = self._active_members()
+        signature = tuple(sorted(members))
+        with self._lock:
+            cached = self._ring_cache
+            if cached is not None and cached[0] == signature:
+                return cached[1], members
+        ring = HashRing(signature)
+        with self._lock:
+            self._ring_cache = (signature, ring)
+        enabled, _tm = _fleet_metrics()
+        if enabled:
+            _tm.FLEET_RING_SIZE.set(len(ring))
+        return ring, members
+
+    def owner_of(self, key: str) -> tuple:
+        ring, members = self.ring()
+        owner = ring.owner(key)
+        return owner, members.get(owner)
+
+    # --- remote serve (ladder rung 3) ---------------------------------------
+
+    def probe(self, key: str) -> Optional[dict]:
+        """Ask ``key``'s ring owner for the entry. Called synchronously
+        from the graph-exec / encode-pool thread after both local tiers
+        missed; every failure mode — no loop, breaker open, timeout,
+        checksum reject, owner disagreement — returns None (recompute).
+        Never raises, never blocks past ``CDT_FLEET_CACHE_TIMEOUT_S``."""
+        try:
+            owner, url = self.owner_of(key)
+        except Exception:  # noqa: BLE001 — ring trouble is a miss
+            return None
+        if owner is None or owner == self.self_id or not url:
+            return None
+        if not BREAKERS.allow(owner):
+            self._count("remote_skipped")
+            _count_remote("get", "skipped")
+            return None
+        loop = self.loop
+        if loop is None or not loop.is_running():
+            self._count("remote_skipped")
+            _count_remote("get", "skipped")
+            return None
+        try:
+            running = asyncio.get_running_loop()
+        except RuntimeError:
+            running = None
+        if running is loop:
+            # blocking the loop on itself would deadlock; async callers
+            # don't exist today (probe sites are worker threads), so
+            # degrade to a miss rather than gamble
+            self._count("remote_skipped")
+            _count_remote("get", "skipped")
+            return None
+        try:
+            fut = asyncio.run_coroutine_threadsafe(
+                self._get_remote(owner, url, key), loop)
+            arrays = fut.result(constants.FLEET_CACHE_TIMEOUT_S.get())
+        except Exception as e:  # noqa: BLE001 — ladder: degrade to miss
+            debug_log(f"fleet: probe of {owner} for {key[:12]}… "
+                      f"failed: {e}")
+            self._count("remote_error")
+            _count_remote("get", "error")
+            return None
+        if arrays is None:
+            self._count("remote_miss")
+            _count_remote("get", "miss")
+            return None
+        self._count("remote_hit")
+        _count_remote("get", "hit")
+        return arrays
+
+    async def _get_remote(self, owner: str, url: str,
+                          key: str) -> Optional[dict]:
+        if self._transport is not None:
+            result = await self._transport("get", owner, url, key, None)
+            BREAKERS.record(owner, ok=True)
+            return result
+        import aiohttp
+
+        from ...utils.network import get_client_session
+        from ..stages.latents import decode_array_payload
+
+        timeout = constants.FLEET_CACHE_TIMEOUT_S.get()
+
+        async def _once():
+            session = get_client_session()
+            async with session.get(
+                    f"{url}/distributed/cache/entry/{key}",
+                    timeout=aiohttp.ClientTimeout(total=timeout)) as resp:
+                if resp.status == 404:
+                    return None
+                resp.raise_for_status()
+                body = await resp.json()
+
+            def _decode():
+                payloads = body.get("arrays")
+                if not isinstance(payloads, dict) or not payloads:
+                    return None
+                return {name: decode_array_payload(p)
+                        for name, p in payloads.items()}
+
+            # b64+npz+sha256 off the event loop (media-route discipline)
+            return await asyncio.get_running_loop().run_in_executor(
+                None, _decode)
+
+        result = await self._retry.run(_once, op="fleet.get")
+        # success feeds the breaker; failure deliberately does NOT — a
+        # cache probe must never accumulate evidence against a worker
+        # that is still serving fine (chaos stage 9 pins this down)
+        BREAKERS.record(owner, ok=True)
+        return result
+
+    # --- async fill (never blocks the serve path) ---------------------------
+
+    def fill(self, key: str, arrays: dict) -> None:
+        """Propagate a freshly computed entry to its ring owner,
+        fire-and-forget. No-op when this worker owns the shard, the
+        owner's breaker is open, or no loop is attached."""
+        try:
+            owner, url = self.owner_of(key)
+        except Exception:  # noqa: BLE001
+            return
+        if owner is None or owner == self.self_id or not url:
+            return
+        if not BREAKERS.allow(owner):
+            _count_remote("put", "skipped")
+            return
+        loop = self.loop
+        if loop is None or not loop.is_running():
+            return
+        arrays = {n: np.asarray(a) for n, a in arrays.items()}
+        fut = asyncio.run_coroutine_threadsafe(
+            self._put_remote(owner, url, key, arrays, op="put"), loop)
+        self._track(fut)
+
+    async def _put_remote(self, owner: str, url: str, key: str,
+                          arrays: dict, op: str = "put") -> bool:
+        try:
+            if self._transport is not None:
+                await self._transport("put", owner, url, key, arrays)
+            else:
+                await self._put_http(url, key, arrays)
+        except Exception as e:  # noqa: BLE001 — a lost fill is a lost hit
+            debug_log(f"fleet: {op} to {owner} for {key[:12]}… "
+                      f"failed: {e}")
+            self._count("fill_error")
+            _count_remote(op, "error")
+            return False
+        BREAKERS.record(owner, ok=True)
+        self._count("fill" if op == "put" else "handback")
+        _count_remote(op, "hit")
+        return True
+
+    async def _put_http(self, url: str, key: str, arrays: dict) -> None:
+        import aiohttp
+
+        from ...utils.network import get_client_session
+        from ..stages.latents import encode_array_payload
+
+        timeout = constants.FLEET_CACHE_TIMEOUT_S.get()
+
+        def _encode():
+            return {"key": key,
+                    "arrays": {n: encode_array_payload(a)
+                               for n, a in arrays.items()}}
+
+        body = await asyncio.get_running_loop().run_in_executor(
+            None, _encode)
+
+        async def _once():
+            session = get_client_session()
+            async with session.put(
+                    f"{url}/distributed/cache/entry/{key}", json=body,
+                    timeout=aiohttp.ClientTimeout(total=timeout)) as resp:
+                resp.raise_for_status()
+
+        await self._retry.run(_once, op="fleet.put")
+
+    # --- drain handback (PR 7 semantics on cache shards) --------------------
+
+    async def handback(self) -> list:
+        """Move this (draining) worker's shard entries to their
+        post-drain owners. Exactly once per key — a repeated drain
+        signal or overlapping handback re-sends nothing — and only
+        in-memory entries move (disk entries are already durable and
+        content-addressed). Returns the moved keys."""
+        raw = self._raw_members()
+        pre_members = {wid for wid in raw
+                       if wid == self.self_id or not DRAIN.is_leaving(wid)}
+        pre = HashRing(tuple(sorted(pre_members)))
+        post_members = {wid: u for wid, u in raw.items()
+                        if wid != self.self_id
+                        and not DRAIN.is_leaving(wid) and u}
+        if not post_members:
+            return []
+        post = HashRing(tuple(sorted(post_members)))
+        tier = self.manager.results
+        moved = []
+        for key in tier.keys():
+            if pre.owner(key) != self.self_id:
+                continue
+            with self._lock:
+                if key in self._handed:
+                    continue
+            new_owner = post.owner(key)
+            url = post_members.get(new_owner)
+            if not url:
+                continue
+            arrays = tier.peek(key)
+            if arrays is None:
+                continue
+            if await self._put_remote(new_owner, url, key, arrays,
+                                      op="handback"):
+                with self._lock:
+                    self._handed.add(key)
+                # stop serving from this LRU so the entry lives in
+                # exactly one memory tier (the sidecar stays valid)
+                tier.drop_memory(key)
+                moved.append(key)
+        if moved:
+            log(f"fleet: drain handback moved {len(moved)} cache "
+                f"entries off {self.self_id}")
+        return moved
+
+    # --- bookkeeping --------------------------------------------------------
+
+    def _count(self, outcome: str) -> None:
+        with self._lock:
+            self.counts[outcome] = self.counts.get(outcome, 0) + 1
+
+    def stats(self) -> dict:
+        ring, members = self.ring()
+        with self._lock:
+            counts = dict(self.counts)
+        return {"self": self.self_id, "ring_size": len(ring),
+                "members": ring.members(),
+                "vnodes": ring.vnodes, **counts,
+                "near": self.near.stats()}
+
+
+def build_fleet_cache(manager, self_id: str,
+                      membership: Callable[[], dict],
+                      transport: Optional[Callable] = None
+                      ) -> Optional[FleetCache]:
+    """The fleet tier, or None when disabled (``CDT_FLEET_CACHE=0``) or
+    when the per-host cache itself is off — None means every call site
+    behaves exactly as PR 8 shipped."""
+    if manager is None or not constants.FLEET_CACHE.get():
+        return None
+    return FleetCache(manager, self_id, membership, transport=transport)

@@ -91,6 +91,35 @@ def result_key(fingerprint: str, execution_sig: str,
                   weights_id)
 
 
+def near_fingerprint(prompt: dict) -> str:
+    """Identity of a request *modulo seed*: the prompt graph with every
+    integer ``seed`` input zeroed before canonical encoding. Two re-rolls
+    of the same prompt (same graph, different seed) share this value —
+    the near tier's notion of "the same work, different noise". Only
+    integer ``seed`` literals are masked; a seed wired from another node
+    (a list input) is part of the graph structure and stays."""
+    import copy
+
+    masked = copy.deepcopy(prompt)
+    for node in masked.values():
+        if not isinstance(node, dict):
+            continue
+        inputs = node.get("inputs")
+        if isinstance(inputs, dict) and isinstance(inputs.get("seed"), int):
+            inputs["seed"] = 0
+    return digest("near", canonical_bytes(masked))
+
+
+def near_key(fingerprint: str, execution_sig: str,
+             conditioning_mode: str = "", weights_id: str = "") -> str:
+    """Near-tier lookup key: same factors as :func:`result_key` but over
+    the seedless :func:`near_fingerprint` — the execution signature,
+    conditioning mode, and weights identity still join, because a donor
+    trajectory from a different program/weights is a different work."""
+    return digest("near-result", fingerprint, execution_sig,
+                  conditioning_mode, weights_id)
+
+
 def token_array_signature(ids) -> list:
     """Token-id array → JSON-able nested lists (the canonical form
     ``conditioning_key`` hashes)."""

@@ -110,6 +110,29 @@ class CacheTier:
                 **counts,
             }
 
+    def keys(self) -> list:
+        """In-memory keys, LRU-oldest first (fleet handback enumerates
+        these to find the shard's hot entries)."""
+        with self._lock:
+            return list(self._entries)
+
+    def peek(self, key: str) -> Optional[dict]:
+        """Arrays for ``key`` from memory only — no disk consult, no LRU
+        touch, no hit/miss accounting. The fleet tier's remote-serve and
+        handback paths use it so a neighbor's probe doesn't distort this
+        host's local hit-rate window or recency order."""
+        with self._lock:
+            e = self._entries.get(key)
+            return dict(e.arrays) if e is not None else None
+
+    def drop_memory(self, key: str) -> None:
+        """Drop one entry from memory only (exactly-once drain handback:
+        after a successful move the donor must stop serving the entry
+        from its LRU, but the checksummed sidecar stays valid)."""
+        with self._lock:
+            self._entries.pop(key, None)
+        self._export_gauges()
+
     # --- pinning (mirrors cluster/residency) --------------------------------
 
     def pin(self, key: str) -> bool:

@@ -85,6 +85,15 @@ class Controller:
         self._registry = None
         self.worker_id = constants.WORKER_ID.get()
         self.worker_index = constants.WORKER_INDEX.get()
+        # fleet cache tier (cluster/cache/fleet.py): consistent-hash
+        # shards over the configured hosts + drain handback + near tier;
+        # None under CDT_FLEET_CACHE=0 or CDT_CACHE=0 (per-host only)
+        if self.cache is not None:
+            from .cache.fleet import build_fleet_cache
+
+            self.cache.fleet = build_fleet_cache(
+                self.cache, self.worker_id or "master",
+                self._fleet_membership)
         from .progress import ProgressTracker
         self.progress = ProgressTracker()
         # AOT warmup state machine (diffusion/warmup.py): health probes
@@ -102,6 +111,23 @@ class Controller:
 
     def load_config(self) -> dict:
         return load_config(self.config_path)
+
+    def _fleet_membership(self) -> dict:
+        """Fleet-cache ring membership: every configured host id → base
+        URL, plus this worker (URL None — it never probes itself). The
+        fleet tier itself filters DRAIN-leaving workers, so this stays a
+        plain config read."""
+        from ..utils.network import build_host_url
+
+        members: dict = {(self.worker_id or "master"): None}
+        try:
+            for h in self.load_config().get("hosts", []):
+                hid = str(h.get("id") or "")
+                if hid and hid not in members:
+                    members[hid] = build_host_url(h) or None
+        except Exception:  # noqa: BLE001 — a bad config is an empty fleet
+            pass
+        return members
 
     def host_by_id(self, host_id: str) -> Optional[dict]:
         """Config host entry for a worker/host id (busy-probe resolver)."""
@@ -174,6 +200,10 @@ class Controller:
         self.bridge = CollectorBridge(self.store, self.loop,
                                       host_resolver=self.host_by_id)
         self.tile_farm = TileFarm(self.store, self.loop)
+        if self.cache is not None and self.cache.fleet is not None:
+            # remote probes/fills bridge from worker threads onto this
+            # loop; until attach the ladder degrades to local-only
+            self.cache.fleet.attach_loop(self.loop)
         self.queue.start()
         if self.frontdoor is not None:
             self.frontdoor.start()
@@ -230,6 +260,8 @@ class Controller:
             # callbacks, which must still be alive
             self.stages.stop()
         await self.queue.stop()
+        if self.cache is not None and self.cache.fleet is not None:
+            self.cache.fleet.close()   # unsubscribe from the DRAIN feed
         self.progress.close()      # release the global progress sink
         await close_client_session()
 
@@ -253,7 +285,11 @@ class Controller:
             # content-cache hit rate (cluster/cache, docs/caching.md) —
             # the signal that lets the autoscaler shrink a hot-cache fleet
             "cache": (None if self.cache is None
-                      else {"hit_rate": round(self.cache.hit_rate(), 4)}),
+                      else {"hit_rate": round(self.cache.hit_rate(), 4),
+                            "fleet_ring":
+                                (len(self.cache.fleet.ring()[0])
+                                 if self.cache.fleet is not None
+                                 else 0)}),
             # per-stage pool backlog (cluster/stages, docs/stages.md)
             "stages": (None if self.stages is None
                        else self.stages.depths()),

@@ -69,7 +69,10 @@ class FleetSignals:
     # recent fraction of QUEUED fingerprinted requests the result cache
     # answered without a sampler program — the content cache's pressure
     # discount (cluster/cache, docs/caching.md). Coalesced duplicates
-    # are excluded: they never occupy queue depth in the first place
+    # are excluded: they never occupy queue depth in the first place.
+    # Fleet-tier remote serves (cluster/cache/fleet.py) are INCLUDED:
+    # a request answered from another worker's shard ran no program
+    # here, so it discounts exactly like a local hit
     cache_hit_rate: float = 0.0
     # host-side stage pool backlogs (cluster/stages): reported and
     # exported, NEVER part of the chip-pressure computation

@@ -54,6 +54,10 @@ class DrainRegistry:
         # finished or handed back (None = no deadline pressure yet)
         self._deadlines: dict[str, Optional[float]] = {}
         self._clock = clock
+        # lifecycle listeners: fn(worker_id, state) called OUTSIDE the
+        # lock after every transition (fleet cache ring rebuild / drain
+        # handback subscribe here)
+        self._listeners: list[Callable[[str, str], None]] = []
 
     # --- queries ------------------------------------------------------------
 
@@ -81,6 +85,32 @@ class DrainRegistry:
         with self._lock:
             return dict(self._states)
 
+    # --- lifecycle feed -----------------------------------------------------
+
+    def subscribe(self, fn: Callable[[str, str], None]) -> None:
+        """Register ``fn(worker_id, new_state)``, invoked after every
+        transition, outside the registry lock (a listener may re-enter
+        queries). Listener exceptions are swallowed — lifecycle
+        bookkeeping must never be blocked by an observer."""
+        with self._lock:
+            if fn not in self._listeners:
+                self._listeners.append(fn)
+
+    def unsubscribe(self, fn: Callable[[str, str], None]) -> None:
+        with self._lock:
+            if fn in self._listeners:
+                self._listeners.remove(fn)
+
+    def _notify(self, worker_id: str) -> None:
+        with self._lock:
+            listeners = list(self._listeners)
+        state = self.state(worker_id)
+        for fn in listeners:
+            try:
+                fn(worker_id, state)
+            except Exception:  # noqa: BLE001 — observers never block lifecycle
+                pass
+
     # --- transitions --------------------------------------------------------
 
     def mark_draining(self, worker_id: str,
@@ -98,6 +128,7 @@ class DrainRegistry:
         log(f"drain[{wid}] active -> draining"
             + (f" (deadline {deadline_s:.0f}s)" if deadline_s else ""))
         self._export(wid)
+        self._notify(wid)
         return True
 
     def mark_decommissioned(self, worker_id: str) -> None:
@@ -109,6 +140,7 @@ class DrainRegistry:
         if before != DECOMMISSIONED:
             log(f"drain[{wid}] {before} -> decommissioned")
         self._export(wid)
+        self._notify(wid)
 
     def reactivate(self, worker_id: str) -> bool:
         """Undrain / rejoin: the worker is part of the fleet again.
@@ -120,6 +152,7 @@ class DrainRegistry:
         if before != ACTIVE:
             log(f"drain[{wid}] {before} -> active (reactivated)")
         self._export(wid)
+        self._notify(wid)
         return before != ACTIVE
 
     def reset(self) -> None:

@@ -75,6 +75,7 @@ class _Prepared:
         self.stackable = False
         self.why_solo = ""
         self.result_key = None   # content-cache identity (cluster/cache)
+        self.near_key = None     # seedless identity (fleet near tier)
 
     def signature(self) -> tuple:
         return (id(self.pipeline), self.spec,
@@ -191,11 +192,21 @@ def _serve_cached(p: _Prepared, cache, results: dict) -> bool:
     """Serve one member from the completed-result tier; the member still
     runs its suffix (SaveImage et al. side effects are real), only the
     sampler program is skipped. ``cache: "bypass"`` members never serve
-    (they re-execute and refresh the entry)."""
+    (they re-execute and refresh the entry). The fallback ladder is
+    local memory → local disk (both inside ``results.get``) → fleet
+    ring owner (``fleet.probe``) → recompute."""
     p.result_key = _cache_key_for(p, cache)
     if p.result_key is None or p.member.cache_mode == "bypass":
         return False
     hit = cache.results.get(p.result_key)
+    if hit is None:
+        fleet = getattr(cache, "fleet", None)
+        if fleet is not None:
+            hit = fleet.probe(p.result_key)
+            if hit is not None and "images" in hit:
+                # promote memory-only: the entry's durable home is its
+                # ring owner's shard, not every prober's disk
+                cache.results.put(p.result_key, hit, persist=False)
     if hit is None or "images" not in hit:
         return False
     import jax.numpy as jnp
@@ -224,10 +235,138 @@ def _fill_cache(p: _Prepared, cache, images) -> None:
     import numpy as np
 
     try:
-        cache.results.put(p.result_key, {"images": np.asarray(images)})
+        arrays = {"images": np.asarray(images)}
+        cache.results.put(p.result_key, arrays)
+        fleet = getattr(cache, "fleet", None)
+        if fleet is not None:
+            # fire-and-forget to the ring owner — the serve path never
+            # blocks on a remote PUT
+            fleet.fill(p.result_key, arrays)
     except Exception as e:  # noqa: BLE001
         debug_log(f"result cache: fill failed for "
                   f"{p.result_key[:12]}: {e}")
+
+
+def _filled_adm(p: _Prepared) -> tuple:
+    """(y, uy) with the same zero-ADM defaults ``generate_preemptible``
+    applies before computing the checkpoint identity — the near tier's
+    ``expect`` meta must hash the SAME conditioning tuple the donor's
+    identity hashed, or every lookup is a spurious mismatch."""
+    import jax.numpy as jnp
+
+    y, uy = p.y, p.uy
+    if y is None:
+        adm = p.pipeline.unet.config.adm_in_channels
+        y = jnp.zeros((1, max(adm, 1)), jnp.float32)
+    if uy is None:
+        uy = jnp.zeros_like(y)
+    return y, uy
+
+
+def _near_key_for(p: _Prepared, cache) -> "str | None":
+    """Seedless near-tier identity: the same factors as
+    :func:`_cache_key_for` over the seed-masked fingerprint — or None
+    when the member didn't opt in (``cache: "near"``), the fleet tier is
+    off, or the member can't stack (the donor path needs the pipeline
+    APIs stackability proves)."""
+    if cache is None or getattr(cache, "fleet", None) is None:
+        return None
+    if not p.stackable or p.member.cache_mode != "near":
+        return None
+    from ..cache import execution_signature, near_fingerprint, near_key
+    from ..cache.conditioning import encoder_mode
+
+    weights_fn = getattr(p.model, "weights_identity", None)
+    if weights_fn is None:
+        return None
+    mode = encoder_mode(getattr(p.model, "text_encoder", None))
+    return near_key(near_fingerprint(p.member.prompt),
+                    execution_signature(p.mesh), mode, weights_fn())
+
+
+def _serve_near(p: _Prepared, cache, results: dict) -> bool:
+    """Serve one opted-in member from a donor mid-trajectory checkpoint:
+    the donor's carry becomes the init of a partial-ladder re-roll under
+    the member's OWN seed (roughly half the steps). The output is
+    approximate BY DESIGN (docs/caching.md) and never fills the exact
+    result tier; any failure degrades to a full compute."""
+    p.near_key = _near_key_for(p, cache)
+    if p.near_key is None or not hasattr(p.pipeline, "generate_near"):
+        return False
+    import dataclasses
+
+    import numpy as np
+
+    fleet = cache.fleet
+    y, uy = _filled_adm(p)
+    expect = p.pipeline.checkpoint_identity(
+        p.mesh, p.spec, p.seed,
+        conditioning=(p.context, p.uncond, y, uy))
+    expect.pop("seed", None)       # near = the same work modulo seed
+    ckpt = fleet.near.lookup(p.near_key, expect)
+    if ckpt is None:
+        return False
+    remaining = int(ckpt.total_steps) - int(ckpt.step)
+    if remaining <= 0 or remaining >= int(ckpt.total_steps):
+        return False
+    lat = next((np.asarray(leaf) for leaf in ckpt.carry
+                if np.asarray(leaf).ndim == 4), None)
+    if lat is None:
+        return False
+    try:
+        spec_near = dataclasses.replace(
+            p.spec, denoise=remaining / int(ckpt.total_steps))
+        images = p.pipeline.generate_near(
+            p.mesh, spec_near, p.seed,
+            lat[: p.spec.per_device_batch], p.context, p.uncond, y, uy)
+        out_cache = _finish(p, images)
+    except InterruptedError:
+        raise
+    except Exception as e:  # noqa: BLE001 — member isolation barrier
+        log(f"front door: near-tier serve failed for "
+            f"{p.member.prompt_id} ({e}); computing from scratch")
+        return False
+    results[p.member.prompt_id] = {"status": "success",
+                                   "outputs": out_cache,
+                                   "cache": "near", "batch_size": 0}
+    fleet.near.record_reuse(int(ckpt.step))
+    return True
+
+
+def _run_near_donor(p: _Prepared, cache):
+    """Run a near-mode miss through the preemptible sampler, parking the
+    midpoint carry as a donor for future re-rolls. Completion is
+    bit-identical to the plain program (PR 14's invariant), so the
+    caller fills the exact tier with the result as usual. Returns the
+    images, or None to fall back to the plain solo path."""
+    fleet = getattr(cache, "fleet", None)
+    if fleet is None or not hasattr(p.pipeline, "generate_preemptible"):
+        return None
+    half = int(p.spec.steps) // 2
+    if half < 1 or half >= int(p.spec.steps):
+        return None                # a 1-step run has no midpoint
+    fired = []
+
+    def _once():
+        if fired:
+            return None
+        fired.append(1)
+        return "near_donor"
+
+    out = p.pipeline.generate_preemptible(
+        p.mesh, p.spec, p.seed, p.context, p.uncond, p.y, p.uy,
+        segment_steps=half, should_preempt=_once)
+    if "images" in out:
+        return out["images"]
+    ckpt = out["checkpoint"]
+    try:
+        fleet.near.offer(p.near_key, ckpt)
+    except Exception as e:  # noqa: BLE001 — donor parking is best-effort
+        debug_log(f"fleet.near: donor park failed: {e}")
+    out = p.pipeline.generate_preemptible(
+        p.mesh, p.spec, p.seed, p.context, p.uncond, p.y, p.uy,
+        segment_steps=max(1, int(p.spec.steps)), resume=ckpt)
+    return out.get("images")
 
 
 def _execute_group_inner(members: list, sampler_node_ids: dict,
@@ -256,6 +395,19 @@ def _execute_group_inner(members: list, sampler_node_ids: dict,
             if p.member.fingerprint is not None:
                 cache.record_request(hit=False)
 
+    # opt-in near tier (cluster/cache/fleet.py): a cache:"near" re-roll
+    # that missed the exact tiers resumes a donor mid-trajectory
+    # checkpoint instead of denoising from pure noise. A reduced program
+    # still runs, so near serves stay misses in the autoscaler window
+    # (counted above). Near misses are forced solo so the donor path
+    # can park their midpoint for the next re-roll.
+    near_served = [p for p in prepared if _serve_near(p, cache, results)]
+    prepared = [p for p in prepared if p not in near_served]
+    for p in prepared:
+        if p.near_key is not None and p.stackable:
+            p.stackable = False
+            p.why_solo = "near_donor"
+
     # sub-group by runtime signature; order within a sub-group is
     # submission order (members arrive FIFO from the batcher)
     groups: dict[tuple, list[_Prepared]] = {}
@@ -268,7 +420,17 @@ def _execute_group_inner(members: list, sampler_node_ids: dict,
 
     def run_solo(p: _Prepared, batch_size: int = 1) -> None:
         try:
-            images = _solo(p)
+            images = None
+            if p.near_key is not None:
+                try:
+                    images = _run_near_donor(p, cache)
+                except InterruptedError:
+                    raise
+                except Exception as e:  # noqa: BLE001 — plain solo next
+                    debug_log(f"front door: near donor path failed for "
+                              f"{p.member.prompt_id}: {e}")
+            if images is None:
+                images = _solo(p)
             _fill_cache(p, cache, images)
             out_cache = _finish(p, images)
             results[p.member.prompt_id] = {

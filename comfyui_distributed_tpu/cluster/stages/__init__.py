@@ -238,8 +238,15 @@ class StageManager:
 
     def eligible(self, job) -> bool:
         """Only front-door batch jobs ride the stages: solo prompts keep
-        the fused path (preemption, progress streaming, ControlNet)."""
-        return getattr(job, "group", None) is not None
+        the fused path (preemption, progress streaming, ControlNet).
+        ``cache: "near"`` members also keep the fused path — the near
+        tier's donor/serve machinery (cluster/cache/fleet.py) rides the
+        fused preemptible sampler, which has no stage-split analogue."""
+        group = getattr(job, "group", None)
+        if group is None:
+            return False
+        return not any(getattr(m, "cache_mode", "use") == "near"
+                       for m in group)
 
     def submit_group(self, job, members, sampler_node_ids, context, loop,
                      denoise_done, record) -> None:
@@ -346,7 +353,10 @@ class StageManager:
             results: dict = {}
             if _serve_cached(p, cache, results):
                 # completed-result tier answered in the ENCODE stage —
-                # the request never touches the mesh at all
+                # the request never touches the mesh at all. The probe
+                # inside _serve_cached walks the full fleet ladder
+                # (local memory → disk → ring owner), so a remote shard
+                # hit also resolves here, before any pool hand-off.
                 with self._counts_lock:
                     self.counts["cache_hits"] += 1
                 self._complete(ticket, member, results[member.prompt_id])

@@ -878,6 +878,69 @@ class Txt2ImgPipeline:
             done_here += length
         return {"images": bundle["fin"](carry), "step": n}
 
+    # --- near-tier trajectory reuse (cluster/cache/fleet.py) ---------------
+
+    def near_fn(self, mesh: Mesh, spec: GenerationSpec,
+                axis: str = constants.AXIS_DATA):
+        """Compile the trajectory-reuse program: a replicated donor
+        LATENT (a mid-trajectory sampler state from the fleet cache's
+        near tier) is re-noised at the partial ladder's head with each
+        shard's own participant-folded key, then the remaining tail is
+        sampled and decoded. This is :meth:`img2img_fn`'s math with the
+        VAE encode replaced by the donor latent — ``spec.denoise``
+        (remaining/total) selects the tail. Deliberately NOT
+        bit-identical to a from-scratch run: the donor state stands in
+        for a clean init, and the fresh draw re-rolls the trajectory
+        under the request's own seed (docs/caching.md, "Fleet tier")."""
+        has_y = self.unet.config.adm_in_channels > 0
+        sigmas = make_sigma_ladder(spec, self.schedule)
+
+        def shard_body(weights, latent, key, context, uncond_context, y,
+                       uncond_y):
+            k = participant_key(key, axis)
+            return self._sample_and_decode(
+                k, context, uncond_context,
+                y if has_y else None, uncond_y if has_y else None,
+                spec, latent.shape[0], sigmas,
+                init_latent=latent.astype(jnp.float32), weights=weights,
+            )
+
+        in_specs = (P(), P(None, None, None, None), P(),
+                    P(None, None, None), P(None, None, None),
+                    P(None, None), P(None, None))
+        f = shard_map(shard_body, mesh=mesh, in_specs=in_specs,
+                      out_specs=P(axis, None, None, None))
+        return bind_weights(jax.jit(f), self._weights(),
+                            label="txt2img_near",
+                            steps=len(sigmas) - 1)
+
+    def generate_near(
+        self,
+        mesh: Mesh,
+        spec: GenerationSpec,
+        seed: int,
+        latent: jax.Array,
+        context: jax.Array,
+        uncond_context: jax.Array,
+        y: Optional[jax.Array] = None,
+        uncond_y: Optional[jax.Array] = None,
+    ) -> jax.Array:
+        """One-shot near-tier generation from a donor latent
+        (value-keyed compile cache; ``spec.denoise`` must carry the
+        remaining-step fraction)."""
+        key = ("near", self._mesh_cache_key(mesh), spec,
+               tuple(latent.shape))
+        fn = cached_build(self, key,
+                          lambda: self.near_fn(mesh, spec),
+                          self._CACHE_MAX)
+        if y is None:
+            adm = self.unet.config.adm_in_channels
+            y = jnp.zeros((1, max(adm, 1)), jnp.float32)
+        if uncond_y is None:
+            uncond_y = jnp.zeros_like(y)
+        return fn(jnp.asarray(latent, jnp.float32), jax.random.key(seed),
+                  context, uncond_context, y, uncond_y)
+
     # --- cross-request microbatching (cluster/frontdoor) -------------------
 
     def microbatch_fn(self, mesh: Mesh, spec: GenerationSpec,

@@ -267,6 +267,33 @@ COALESCE_WIDTH = REGISTRY.histogram(
     "in flight; N = one execution fanned out to N-1 waiters).",
     buckets=(1, 2, 4, 8, 16, 32, 64))
 
+# --- fleet cache tier (cluster/cache/fleet.py, docs/caching.md) -------------
+
+FLEET_CACHE_REMOTE = REGISTRY.counter(
+    "cdt_fleet_cache_remote_total",
+    "Fleet-tier remote operations by op (get = probe of the ring owner; "
+    "put = async fill; handback = drain-time shard move) and outcome "
+    "(hit / miss / error / skipped). Every error degrades to a local "
+    "recompute — the ladder never turns a slow owner into a failed "
+    "request.",
+    ("op", "outcome"))
+
+FLEET_RING_SIZE = REGISTRY.gauge(
+    "cdt_fleet_ring_size",
+    "Workers currently owning arcs on the fleet-cache consistent-hash "
+    "ring (active members; draining workers leave before decommission).")
+
+FLEET_NEAR_REUSE = REGISTRY.counter(
+    "cdt_fleet_near_reuse_total",
+    "Opt-in near-tier serves: a cache:\"near\" request resumed from a "
+    "donor mid-trajectory checkpoint instead of denoising from pure "
+    "noise. Never bit-identical — see docs/caching.md.")
+
+FLEET_NEAR_STEPS_SAVED = REGISTRY.counter(
+    "cdt_fleet_near_steps_saved_total",
+    "Denoise steps the near tier skipped (donor checkpoint step count, "
+    "summed over reuses).")
+
 HASH_TOKENIZATION = REGISTRY.counter(
     "cdt_hash_tokenization_total",
     "Text encodes that used the deterministic hash-tokenization fallback "

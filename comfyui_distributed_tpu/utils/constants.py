@@ -473,6 +473,33 @@ CACHE_DISK_MAX_BYTES = knob_int(
     "Persisted-tier byte cap (oldest-first eviction).",
     doc="docs/caching.md").get()
 
+# --- fleet-wide distributed cache (cluster/cache/fleet.py) ------------------
+# Runtime-read (no .get() at import): the fleet tier is rebuilt per
+# controller in tests/bench, so these must track the live environment.
+FLEET_CACHE = knob_bool(
+    "CDT_FLEET_CACHE", True, "caching",
+    "Kill switch for the fleet cache tier (consistent-hash shards, remote "
+    "fills, near tier); 0 restores strictly per-host PR 8 behavior.",
+    doc="docs/caching.md")
+FLEET_CACHE_VNODES = knob_int(
+    "CDT_FLEET_CACHE_VNODES", 64, "caching",
+    "Virtual nodes per worker on the consistent-hash ring (more = smoother "
+    "shard balance, slower ring rebuild).", doc="docs/caching.md")
+FLEET_CACHE_SEED = knob_str(
+    "CDT_FLEET_CACHE_SEED", "cdt-fleet-ring-v1", "caching",
+    "Ring placement seed — every worker in a fleet must share it or they "
+    "disagree on shard ownership (a disagreement degrades to misses, "
+    "never wrong bytes).", doc="docs/caching.md")
+FLEET_CACHE_TIMEOUT_S = knob_float(
+    "CDT_FLEET_CACHE_TIMEOUT_S", 2.0, "caching",
+    "Remote-serve budget (seconds): a ring owner slower than this degrades "
+    "to a local miss (recompute), never an error.", doc="docs/caching.md")
+FLEET_CACHE_NEAR_MAX = knob_int(
+    "CDT_FLEET_CACHE_NEAR_MAX", 64, "caching",
+    "Mid-trajectory donor checkpoints the near tier keeps (LRU; only "
+    "consulted by opt-in cache:\"near\" requests).",
+    doc="docs/caching.md")
+
 # --- elastic fleet (cluster/elastic, docs/elasticity.md) --------------------
 AUTOSCALE = knob_bool(
     "CDT_AUTOSCALE", False, "elasticity",

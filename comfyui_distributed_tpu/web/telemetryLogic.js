@@ -110,6 +110,7 @@ export function telemetryRows(metrics) {
   rows.push(["Front door", frontDoorSummary(metrics)]);
   rows.push(["Stages", stagesSummary(metrics)]);
   rows.push(["Content cache", cacheSummary(metrics)]);
+  rows.push(["Fleet cache", fleetCacheSummary(metrics)]);
   rows.push(["Elastic fleet", elasticSummary(metrics)]);
   rows.push(["Preemption", preemptionSummary(metrics)]);
   return rows;
@@ -203,6 +204,39 @@ export function cacheSummary(metrics) {
   const hashTok = seriesSum(metrics, "cdt_hash_tokenization_total");
   if (hashTok > 0) parts.push(`${hashTok} hash-tokenized`);
   return parts.length ? parts.join(" · ") : "no cacheable traffic";
+}
+
+// Fleet cache tier (cluster/cache/fleet.py): consistent-hash ring size,
+// remote serve outcomes over GET /distributed/cache/entry/{key}, async
+// fill/handback traffic, and the opt-in near tier's reuse counters
+// (docs/caching.md "Fleet tier").
+export function fleetCacheSummary(metrics) {
+  const fam = "cdt_fleet_cache_remote_total";
+  const ring = seriesSum(metrics, "cdt_fleet_ring_size");
+  const remoteHits = seriesSum(metrics, fam, { op: "get", outcome: "hit" });
+  const remoteOther =
+    seriesSum(metrics, fam, { op: "get", outcome: "miss" }) +
+    seriesSum(metrics, fam, { op: "get", outcome: "error" }) +
+    seriesSum(metrics, fam, { op: "get", outcome: "skipped" });
+  const fills = seriesSum(metrics, fam, { op: "put", outcome: "hit" });
+  const handback = seriesSum(metrics, fam, { op: "handback", outcome: "hit" });
+  const nearReuse = seriesSum(metrics, "cdt_fleet_near_reuse_total");
+  if (!ring && !remoteHits && !remoteOther && !nearReuse) {
+    return "per-host only";
+  }
+  const parts = [`ring ${ring}`];
+  const probes = remoteHits + remoteOther;
+  if (probes) {
+    parts.push(`remote ${remoteHits}/${probes} ` +
+      `(${(100 * remoteHits / probes).toFixed(0)}%)`);
+  }
+  if (fills) parts.push(`${fills} fills`);
+  if (handback) parts.push(`${handback} handed back`);
+  if (nearReuse) {
+    const saved = seriesSum(metrics, "cdt_fleet_near_steps_saved_total");
+    parts.push(`near ${nearReuse} reuse (${saved} steps saved)`);
+  }
+  return parts.join(" · ");
 }
 
 // Elastic fleet (cluster/elastic): lifecycle states from the

@@ -4,8 +4,8 @@ import assert from "node:assert/strict";
 import { test } from "node:test";
 
 import { breakerSummary, cacheSummary, countsByLabel, elasticSummary,
-         fmtSeconds, frontDoorSummary, histQuantile, mergeHistogram,
-         preemptionSummary, seriesSum, stagesSummary,
+         fleetCacheSummary, fmtSeconds, frontDoorSummary, histQuantile,
+         mergeHistogram, preemptionSummary, seriesSum, stagesSummary,
          telemetryRows } from "../telemetryLogic.js";
 
 const METRICS = {
@@ -222,6 +222,51 @@ test("cacheSummary reports per-tier hit rates and the loud counters", () => {
     type: "histogram",
     series: [{ labels: {}, buckets: [[1, 3]], sum: 3, count: 3 }] } }),
     "no cacheable traffic");
+});
+
+test("fleetCacheSummary reports ring size, remote outcomes, near reuse", () => {
+  assert.equal(fleetCacheSummary({}), "per-host only");
+  const metrics = {
+    cdt_fleet_ring_size: {
+      type: "gauge",
+      series: [{ labels: {}, value: 3 }],
+    },
+    cdt_fleet_cache_remote_total: {
+      type: "counter",
+      series: [
+        { labels: { op: "get", outcome: "hit" }, value: 6 },
+        { labels: { op: "get", outcome: "miss" }, value: 2 },
+        { labels: { op: "get", outcome: "error" }, value: 1 },
+        { labels: { op: "get", outcome: "skipped" }, value: 1 },
+        { labels: { op: "put", outcome: "hit" }, value: 5 },
+        { labels: { op: "handback", outcome: "hit" }, value: 4 },
+      ],
+    },
+    cdt_fleet_near_reuse_total: {
+      type: "counter",
+      series: [{ labels: {}, value: 2 }],
+    },
+    cdt_fleet_near_steps_saved_total: {
+      type: "counter",
+      series: [{ labels: {}, value: 8 }],
+    },
+  };
+  const row = fleetCacheSummary(metrics);
+  assert.match(row, /ring 3/);
+  // errors and breaker-skips read as non-hits: 6 of 10 probes served
+  assert.match(row, /remote 6\/10 \(60%\)/);
+  assert.match(row, /5 fills/);
+  assert.match(row, /4 handed back/);
+  assert.match(row, /near 2 reuse \(8 steps saved\)/);
+  // telemetryRows carries the row
+  const byKey = Object.fromEntries(telemetryRows(metrics));
+  assert.match(byKey["Fleet cache"], /ring 3/);
+  // a ring with no traffic still renders (membership is a fact worth
+  // showing before the first probe)
+  assert.equal(
+    fleetCacheSummary({ cdt_fleet_ring_size: {
+      type: "gauge", series: [{ labels: {}, value: 2 }] } }),
+    "ring 2");
 });
 
 test("preemptionSummary reports reasons, parked state, and dead-letters", () => {

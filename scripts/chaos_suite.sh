@@ -53,7 +53,7 @@ env JAX_PLATFORMS=cpu CDT_CHAOS_SEED="${SEED}" \
 echo "[chaos] stage 3: full chaos tier"
 env JAX_PLATFORMS=cpu CDT_CHAOS_SEED="${SEED}" \
     python -m pytest tests/ -q -m chaos \
-    -k "not warm_restarted and not overload and not scale_event and not cache_corrupt and not mesh_drain and not preempt and not decode_worker" \
+    -k "not warm_restarted and not overload and not scale_event and not cache_corrupt and not mesh_drain and not preempt and not decode_worker and not fleet_shard" \
     -p no:cacheprovider --continue-on-collection-errors "$@"
 
 # Stage 4 — seeded scale events under live load (ISSUE 10,
@@ -149,4 +149,26 @@ env JAX_PLATFORMS=cpu PYTHONPATH="$(pwd)" \
     CDT_CONFIG_PATH="$(mktemp -d)/config.json" \
     CDT_COMPILE_CACHE_DIR="${CDT_COMPILE_CACHE_DIR:-/tmp/cdt_xla_cache_chaos}" \
     python scripts/load_smoke.py --in-process --stages --n 12 \
+    --concurrency 8 --seed "${SEED}"
+
+# Stage 9 — fleet cache under shard-owner death (ISSUE 17,
+# docs/caching.md): (a) the chaos-marked acceptance under the runtime
+# lock-order detector — two real controllers on one consistent-hash
+# ring; a duplicate is served REMOTELY from the shard owner's tier,
+# then the owner is killed mid dup-heavy load. The survivor recomputes
+# BIT-identically (the fallback ladder's last rung), zero admitted-job
+# loss, and the dead owner's breaker holds no cache-probe evidence
+# (probes are scavenging, not health checks); (b) load_smoke --fleet —
+# duplicates routed to the worker that did NOT compute the original,
+# exit 1 unless the cross-worker hit rate beats the per-host
+# (CDT_FLEET_CACHE=0) baseline.
+echo "[chaos] stage 9: fleet cache (shard-owner death, cross-worker serves)"
+env JAX_PLATFORMS=cpu CDT_CHAOS_SEED="${SEED}" CDT_LOCK_ORDER=1 \
+    python -m pytest tests/ -q -m chaos -k "fleet_shard" \
+    -p no:cacheprovider --continue-on-collection-errors "$@"
+echo "[chaos] stage 9b: fleet load smoke (cross-worker hit rate beats per-host)"
+env JAX_PLATFORMS=cpu PYTHONPATH="$(pwd)" \
+    CDT_CONFIG_PATH="$(mktemp -d)/config.json" \
+    CDT_COMPILE_CACHE_DIR="${CDT_COMPILE_CACHE_DIR:-/tmp/cdt_xla_cache_chaos}" \
+    python scripts/load_smoke.py --fleet --fleet-n 4 \
     --concurrency 8 --seed "${SEED}"

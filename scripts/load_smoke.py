@@ -271,6 +271,121 @@ async def run_preempt_leg(submit, wait_done, preempt_stats, *,
     return stats
 
 
+# --- fleet cache leg (ISSUE 17: cross-worker serves, docs/caching.md) -------
+
+
+async def _run_fleet_leg(seed: int, n: int, concurrency: int,
+                         timeout_s: float) -> dict:
+    """Two in-process controllers, each with its OWN disk tier, joined
+    into one consistent-hash ring. Wave 1 (originals) lands on worker A;
+    wave 2 (byte-identical duplicates) lands on worker B — the routing
+    split that per-host caching cannot serve. Run twice: per-host
+    baseline (``CDT_FLEET_CACHE=0``) and fleet. The caller exits 1
+    unless the fleet leg's cross-worker hit rate beats the baseline."""
+    wave = [{"prompt": prompt_for(seed=2000 + i, text=f"fleet {i}",
+                                  wh=16, steps=2),
+             "client_id": f"fleet_{i}"} for i in range(n)]
+
+    async def leg(fleet_on: bool) -> dict:
+        import os
+        import tempfile
+
+        from aiohttp.test_utils import TestClient, TestServer
+
+        from comfyui_distributed_tpu.api import create_app
+        from comfyui_distributed_tpu.cluster.controller import Controller
+
+        saved = {k: os.environ.get(k)
+                 for k in ("CDT_FLEET_CACHE", "CDT_CACHE_DIR")}
+        os.environ["CDT_FLEET_CACHE"] = "1" if fleet_on else "0"
+        ctls, clients = [], []
+        try:
+            for name in ("wA", "wB"):
+                os.environ["CDT_CACHE_DIR"] = tempfile.mkdtemp(
+                    prefix=f"fleet_smoke_{name}_")
+                ctl = Controller()
+                client = TestClient(TestServer(create_app(ctl)))
+                await client.start_server()
+                ctls.append(ctl)
+                clients.append(client)
+            urls = [str(c.make_url("")).rstrip("/") for c in clients]
+            if fleet_on:
+                names = ("wA", "wB")
+                for i, ctl in enumerate(ctls):
+                    fleet = ctl.cache.fleet
+                    me, peer, peer_url = names[i], names[1 - i], urls[1 - i]
+                    fleet.self_id = me
+                    fleet._membership = (
+                        lambda me=me, peer=peer, u=peer_url:
+                        {me: None, peer: u})
+                    with fleet._lock:
+                        fleet._ring_cache = None
+
+            async def drive(client, ctl, payloads):
+                sem = asyncio.Semaphore(concurrency)
+                entries: list = []
+
+                async def one(p):
+                    async with sem:
+                        resp = await client.post("/distributed/queue",
+                                                 json=p)
+                        body = await resp.json()
+                        pid = body.get("prompt_id")
+                        if resp.status != 200 or not pid:
+                            entries.append({"status": f"rejected "
+                                            f"({resp.status})"})
+                            return
+                        deadline = time.monotonic() + timeout_s
+                        while time.monotonic() < deadline:
+                            entry = ctl.queue.history.get(pid)
+                            if entry is not None and entry.get(
+                                    "status") in ("success", "error",
+                                                  "interrupted",
+                                                  "expired"):
+                                entries.append(entry)
+                                return
+                            await asyncio.sleep(0.05)
+                        entries.append({"status": "timeout"})
+
+                await asyncio.gather(*(one(p) for p in payloads))
+                return entries
+
+            originals = await drive(clients[0], ctls[0], wave)
+            if fleet_on:
+                # let fire-and-forget fills land on their ring owners
+                deadline = time.monotonic() + 10
+                while (ctls[0].cache.fleet._pending
+                       and time.monotonic() < deadline):
+                    await asyncio.sleep(0.05)
+            dups = await drive(clients[1], ctls[1],
+                               [dict(p) for p in wave])
+            served = sum(1 for e in dups if e.get("cache") == "hit")
+            out = {
+                "requests": len(wave) * 2,
+                "completed": sum(1 for e in originals + dups
+                                 if e.get("status") == "success"),
+                "dup_cache_hits": served,
+                "cross_worker_hit_rate": round(served / len(wave), 3),
+            }
+            if fleet_on:
+                out["fleet"] = {
+                    name: dict(ctl.cache.fleet.counts)
+                    for name, ctl in zip(("wA", "wB"), ctls)}
+            return out
+        finally:
+            for client in clients:
+                await client.close()
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    baseline = await leg(fleet_on=False)
+    fleet = await leg(fleet_on=True)
+    return {"baseline": baseline, "fleet": fleet}
+
+
 # --- transports -------------------------------------------------------------
 
 
@@ -574,6 +689,17 @@ def main() -> int:
                          "pool's backlog; exit 1 on admitted-job loss "
                          "or any stage queue exceeding its shed "
                          "threshold (CDT_STAGE_SHED_DEPTH)")
+    ap.add_argument("--fleet", action="store_true",
+                    help="fleet cache leg (ISSUE 17, docs/caching.md): "
+                         "two in-process controllers with separate disk "
+                         "tiers on one consistent-hash ring; duplicates "
+                         "are routed to the worker that did NOT compute "
+                         "the original. Exit 1 on admitted-job loss or "
+                         "unless the cross-worker hit rate beats the "
+                         "per-host (CDT_FLEET_CACHE=0) baseline")
+    ap.add_argument("--fleet-n", type=int, default=6,
+                    help="originals per wave in the --fleet leg (each "
+                         "is a real tiny-preset generation)")
     ap.add_argument("--preempt-long-steps", type=int, default=48)
     ap.add_argument("--preempt-p99-budget-s", type=float, default=None,
                     help="interactive p99 ceiling (default: "
@@ -585,6 +711,26 @@ def main() -> int:
     if not 0.0 <= cli.dup_rate <= 1.0:
         print("--dup-rate must be in [0, 1]", file=sys.stderr)
         return 2
+    if cli.fleet:
+        stats = asyncio.run(_run_fleet_leg(cli.seed, cli.fleet_n,
+                                           cli.concurrency,
+                                           cli.timeout_s))
+        print(json.dumps(stats, indent=2, default=str))
+        for name in ("baseline", "fleet"):
+            leg = stats[name]
+            if leg["completed"] != leg["requests"]:
+                print(f"LOSS ({name}): {leg['requests']} accepted but "
+                      f"only {leg['completed']} completed",
+                      file=sys.stderr)
+                return 1
+        base_rate = stats["baseline"]["cross_worker_hit_rate"]
+        fleet_rate = stats["fleet"]["cross_worker_hit_rate"]
+        if fleet_rate <= base_rate:
+            print(f"NO FLEET WIN: cross-worker hit rate {fleet_rate} "
+                  f"does not beat per-host baseline {base_rate}",
+                  file=sys.stderr)
+            return 1
+        return 0
     requests = build_workload(cli.seed, cli.n, dup_rate=cli.dup_rate)
     wait = not cli.no_wait
     churn = None
