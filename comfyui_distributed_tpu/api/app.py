@@ -527,6 +527,7 @@ def create_app(controller: Controller) -> web.Application:
         reader = await request.multipart()
         meta = None
         frames: dict[int, "np.ndarray"] = {}
+        loop = asyncio.get_running_loop()
         async for part in reader:
             if part.name == "metadata":
                 try:
@@ -538,8 +539,11 @@ def create_app(controller: Controller) -> web.Application:
                     idx = int(part.name[len("frame_"):])
                 except ValueError:
                     raise ValidationError(f"bad frame part name {part.name!r}")
+                data = await part.read()
                 try:
-                    frames[idx] = native.unpack_frame(await part.read())
+                    # zlib inflate + crc per multi-MB frame: off the loop
+                    frames[idx] = await loop.run_in_executor(
+                        None, native.unpack_frame, data)
                 except ValueError as e:
                     raise ValidationError(f"frame {idx}: {e}")
         if meta is None:

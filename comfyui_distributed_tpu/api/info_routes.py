@@ -3,6 +3,7 @@
 
 from __future__ import annotations
 
+import asyncio
 import socket
 from pathlib import Path
 
@@ -99,8 +100,10 @@ def register(router, controller) -> None:
 
         log_file = constants.LOG_FILE.get()
         if log_file and Path(log_file).is_file():
-            return web.json_response(
-                {"log": tail_file(Path(log_file)), "available": True})
+            loop = asyncio.get_running_loop()
+            text = await loop.run_in_executor(
+                None, tail_file, Path(log_file))
+            return web.json_response({"log": text, "available": True})
         lines = get_log_buffer()
         return web.json_response(
             {"log": "\n".join(lines), "available": bool(lines)})
@@ -123,6 +126,10 @@ def register(router, controller) -> None:
             body = await request.json()
         except Exception:
             pass
+        if not isinstance(body, dict):
+            raise ValidationError("body must be a JSON object")
+        if "out" in body and not isinstance(body["out"], str):
+            raise ValidationError("'out' must be a string", field="out")
         import os
         import time as _t
 

@@ -8,6 +8,7 @@ plain JSON elsewhere.
 
 from __future__ import annotations
 
+import asyncio
 import json
 
 import numpy as np
@@ -141,8 +142,10 @@ def register(router, controller) -> None:
             data = b"".join(buf[i] for i in range(count))
             partial_frames.pop(key, None)
             partial_seen.pop(key, None)
+            loop = asyncio.get_running_loop()
             try:
-                arr = native.unpack_frame(data)
+                arr = await loop.run_in_executor(
+                    None, native.unpack_frame, data)
             except ValueError as e:
                 raise ValidationError(f"reassembled frame: {e}")
             ok = await store.submit_result(
@@ -150,6 +153,7 @@ def register(router, controller) -> None:
             return web.json_response({"status": "ok", "accepted": int(ok)})
 
         tiles: dict[str, np.ndarray] = {}
+        loop = asyncio.get_running_loop()
         for name, (raw, ctype) in raw_parts.items():
             if ctype == "application/x-cdt-frame":
                 # CDTF float32 frames: the native transport (lossless,
@@ -157,11 +161,13 @@ def register(router, controller) -> None:
                 from .. import native
 
                 try:
-                    tiles[name] = native.unpack_frame(raw)
+                    tiles[name] = await loop.run_in_executor(
+                        None, native.unpack_frame, raw)
                 except ValueError as e:
                     raise ValidationError(f"{name}: {e}")
             else:
-                tiles[name] = decode_png(raw)
+                tiles[name] = await loop.run_in_executor(
+                    None, decode_png, raw)
         entries = metadata.get("tiles", [])
         accepted = 0
         for entry in entries:
@@ -185,7 +191,10 @@ def register(router, controller) -> None:
         task_id = parse_positive_int(body.get("task_id"), "task_id")
         from ..utils.image import decode_image_b64
 
-        payload = {"image": decode_image_b64(body.get("image", ""))}
+        loop = asyncio.get_running_loop()
+        image = await loop.run_in_executor(
+            None, decode_image_b64, body.get("image", ""))
+        payload = {"image": image}
         ok = await store.submit_result(
             body["job_id"], validate_worker_id(body["worker_id"]), task_id, payload)
         return web.json_response({"status": "ok", "accepted": int(ok)})

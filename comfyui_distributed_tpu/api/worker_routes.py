@@ -67,7 +67,9 @@ def register(router, controller) -> None:
         path = Path(info["log"])
         if not path.is_file():
             return web.json_response({"log": "", "available": False})
-        return web.json_response({"log": tail_file(path), "available": True})
+        loop = asyncio.get_running_loop()
+        text = await loop.run_in_executor(None, tail_file, path)
+        return web.json_response({"log": text, "available": True})
 
     async def clear_launching(request):
         """Worker self-reports ready (reference ``:115-139``)."""

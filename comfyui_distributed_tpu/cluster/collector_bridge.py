@@ -68,16 +68,20 @@ class CollectorBridge:
                                          job_id, worker_id, arr, audio):
             return
         url = normalize_host_url(master_url) + "/distributed/job_complete"
+        loop = asyncio.get_running_loop()
         for i in range(n):
+            image_b64 = await loop.run_in_executor(
+                None, encode_image_b64, arr[i])
             envelope: dict[str, Any] = {
                 "job_id": job_id,
                 "worker_id": worker_id,
                 "batch_idx": i,
-                "image": encode_image_b64(arr[i]),
+                "image": image_b64,
                 "is_last": i == n - 1,
             }
             if i == n - 1 and audio is not None:
-                envelope["audio"] = encode_audio(audio)
+                envelope["audio"] = await loop.run_in_executor(
+                    None, encode_audio, audio)
             await self._post_with_retry(session, url, envelope)
         if n == 0:
             # audio-only contribution (e.g. DistributedEmptyImage feeding
@@ -88,7 +92,8 @@ class CollectorBridge:
                 "image": "", "is_last": True,
             }
             if audio is not None:
-                envelope["audio"] = encode_audio(audio)
+                envelope["audio"] = await loop.run_in_executor(
+                    None, encode_audio, audio)
             await self._post_with_retry(session, url, envelope)
         debug_log(f"collector[{job_id}] worker {worker_id} sent {n} images")
 
@@ -102,15 +107,23 @@ class CollectorBridge:
         from .. import native
 
         url = base_url + "/distributed/job_complete_frames"
+        loop = asyncio.get_running_loop()
         form = aiohttp.FormData()
         meta: dict[str, Any] = {"job_id": job_id, "worker_id": worker_id,
                                 "count": int(arr.shape[0])}
         if audio is not None:
-            meta["audio"] = encode_audio(audio)
+            meta["audio"] = await loop.run_in_executor(
+                None, encode_audio, audio)
         form.add_field("metadata", json.dumps(meta),
                        content_type="application/json")
-        for i in range(arr.shape[0]):
-            form.add_field(f"frame_{i}", native.pack_frame(arr[i], level=1),
+        # pack the whole batch in ONE executor hop — zlib deflate + crc
+        # per multi-MB frame must not run on the event loop
+        packed = await loop.run_in_executor(
+            None,
+            lambda: [native.pack_frame(arr[i], level=1)
+                     for i in range(arr.shape[0])])
+        for i, blob in enumerate(packed):
+            form.add_field(f"frame_{i}", blob,
                            filename=f"frame_{i}.cdtf",
                            content_type="application/x-cdt-frame")
         try:
@@ -206,16 +219,19 @@ class CollectorBridge:
             except asyncio.TimeoutError:
                 continue
             w = envelope.get("worker_id", "")
+            loop = asyncio.get_running_loop()
             if envelope.get("image_arr") is not None:
                 per_worker.setdefault(w, {})[int(envelope.get("batch_idx", 0))] = (
                     from_uint8(envelope["image_arr"])
                 )
             elif envelope.get("image"):
                 per_worker.setdefault(w, {})[int(envelope.get("batch_idx", 0))] = (
-                    decode_image_b64(envelope["image"])
+                    await loop.run_in_executor(
+                        None, decode_image_b64, envelope["image"])
                 )
             if envelope.get("audio"):
-                audio_parts[w] = decode_audio(envelope["audio"])
+                audio_parts[w] = await loop.run_in_executor(
+                    None, decode_audio, envelope["audio"])
             if envelope.get("is_last"):
                 drained_done.add(w)
 

@@ -93,7 +93,10 @@ def _prepare(member, sampler_id: str, base_context: dict) -> _Prepared:
     context["prompt_id"] = member.prompt_id
     executor = GraphExecutor(context)
     order = topo_order(prompt)
-    down = downstream_nodes(prompt, sampler_id)
+    # set used for MEMBERSHIP only (`n not in down` below); iteration
+    # order is never observed, so set-order nondeterminism can't leak
+    # into the executed prefix
+    down = downstream_nodes(prompt, sampler_id)  # cdtlint: disable=D002
     prefix = [n for n in order if n != sampler_id and n not in down]
     cache: dict[str, tuple] = {}
     executor.execute_nodes(prompt, prefix, cache)

@@ -557,10 +557,15 @@ class TileFarm:
         # sane when tests shrink MAX_PAYLOAD_SIZE
         cap = max(constants.MAX_PAYLOAD_SIZE - (1 << 20),
                   constants.MAX_PAYLOAD_SIZE // 2, 1)
+        loop = asyncio.get_running_loop()
         group: list[tuple[int, dict, bytes]] = []
         size = 0
         for task_id, meta, arr in batch:
-            frame = native.pack_frame(np.asarray(arr, np.float32), level=1)
+            # zlib deflate + crc of a full tile: off the event loop
+            frame = await loop.run_in_executor(
+                None,
+                lambda a=arr: native.pack_frame(
+                    np.asarray(a, np.float32), level=1))
             if len(frame) > cap:
                 if group:
                     await self._post_tiles(session, base, job_id, worker_id, group)

@@ -12,6 +12,10 @@ convention and review. This package turns them into code:
   lock wrapper (``CDT_LOCK_ORDER=1``) that records cross-registry lock
   acquisition order and fails loudly on an inversion. The chaos suite runs a
   stage under it, so every chaos event doubles as a race-detector run.
+- :mod:`.loopstall` is the second runtime companion (ISSUE 20): a
+  ``CDT_LOOP_STALL=1`` watchdog that samples the asyncio loop and records
+  any callback blocking it past ``CDT_LOOP_STALL_MS``, with the offending
+  stack — the runtime complement of A001/A002's static executor discipline.
 
 Dependency-free by design (stdlib ``ast`` only): the linter must run in CI
 images, pre-commit hooks, and broken checkouts where jax cannot import.
@@ -26,7 +30,7 @@ _EXPORTS = {
     "run_lint": "core", "ALL_RULES": "rules", "rule_by_id": "rules",
 }
 
-__all__ = list(_EXPORTS) + ["lockorder"]
+__all__ = list(_EXPORTS) + ["lockorder", "loopstall"]
 
 
 def __getattr__(name):
@@ -35,8 +39,8 @@ def __getattr__(name):
 
         mod = importlib.import_module(f".{_EXPORTS[name]}", __name__)
         return getattr(mod, name)
-    if name == "lockorder":
+    if name in ("lockorder", "loopstall"):
         import importlib
 
-        return importlib.import_module(".lockorder", __name__)
+        return importlib.import_module(f".{name}", __name__)
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -26,6 +26,39 @@ def repo_root() -> Path:
     return Path(__file__).resolve().parents[2]
 
 
+def changed_package_files(ref: str):
+    """Package ``.py`` files changed vs ``ref`` (committed diff plus
+    untracked), as absolute paths; ``None`` means git itself failed."""
+    import subprocess
+
+    root = repo_root()
+    names: set[str] = set()
+    for cmd in (["git", "diff", "--name-only", ref, "--"],
+                ["git", "ls-files", "--others", "--exclude-standard"]):
+        try:
+            out = subprocess.run(cmd, cwd=root, capture_output=True,
+                                 text=True, check=True)
+        except (OSError, subprocess.CalledProcessError) as exc:
+            err = getattr(exc, "stderr", "") or str(exc)
+            print(f"cdtlint --diff: {' '.join(cmd)} failed: "
+                  f"{err.strip()}", file=sys.stderr)
+            return None
+        names.update(line.strip() for line in out.stdout.splitlines())
+    pkg_prefix = package_root().name + "/"
+    changed = {n for n in names
+               if n.endswith(".py") and n.startswith(pkg_prefix)
+               and (root / n).is_file()}       # deleted files drop out
+    # W001 checks the FULL route surface against docs/api.md; with only
+    # the diffed files in scope, routes registered in unchanged api/
+    # modules would read as missing and fail the fast path spuriously —
+    # so any api/ change pulls the whole (small) api/ package in
+    if any(n.startswith(pkg_prefix + "api/") for n in changed):
+        changed.update(
+            str(p.relative_to(root))
+            for p in (package_root() / "api").glob("*.py"))
+    return [root / n for n in sorted(changed)]
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(
         prog="python -m comfyui_distributed_tpu.lint",
@@ -47,6 +80,12 @@ def main(argv=None) -> int:
                    help="regenerate docs/knobs.md from the knob registry")
     p.add_argument("--show-baselined", action="store_true",
                    help="also print grandfathered (baselined) findings")
+    p.add_argument("--diff", metavar="REF",
+                   help="lint only package files changed vs the git REF "
+                        "(diff + untracked) — the fast pre-commit path; "
+                        "note the flow rules (A002/L002/D002/W001) see "
+                        "only the changed files' call graph, so CI still "
+                        "runs the full gate")
     args = p.parse_args(argv)
 
     if args.list_rules:
@@ -72,6 +111,15 @@ def main(argv=None) -> int:
             return 2
 
     paths = args.paths or [package_root()]
+    if args.diff:
+        changed = changed_package_files(args.diff)
+        if changed is None:
+            return 2
+        if not changed:
+            print(f"cdtlint --diff {args.diff}: no package files changed "
+                  "— OK")
+            return 0
+        paths = changed
     linted_rels: list = []
     try:
         findings = run_lint(paths, rules, repo_root(),

@@ -1,0 +1,584 @@
+"""Project-wide call graph for cdtlint v2 (docs/lint.md).
+
+The v1 rules (A001/D001/L001) see one function body at a time: a blocking
+``np.savez``+``sha256`` buried two frames under an async route, or a
+``time.time()`` laundered through a helper into a cache key, pass the gate.
+This module gives the flow rules (lint/flowrules.py) the interprocedural
+substrate, still stdlib-``ast``-only so the linter keeps running where jax
+cannot import:
+
+- :class:`ModuleImports` — import/alias resolution with RELATIVE imports
+  resolved against the module's dotted name (``from ..utils import x`` in
+  ``api/app.py`` -> ``comfyui_distributed_tpu.utils.x``).
+- :class:`ProjectGraph` — one :class:`FunctionInfo` per function/method
+  (nested defs included), with every call site resolved to an internal
+  function key (``module:qualname``) or an external dotted name, and
+  per-function :class:`Summary` facts computed to a fixpoint: blocks?,
+  awaits?, does heavy encode/checksum work?, acquires which locks?
+
+Executor-offload sanitizer (the A001 false-positive fix A002 inherits):
+callables handed to ``run_in_executor`` / ``asyncio.to_thread`` /
+``Executor.submit`` run OFF the loop, so they must not contribute
+blocking taint — whether passed directly (``run_in_executor(None, work)``),
+wrapped in ``functools.partial(work, x)``, wrapped in a ``lambda``, or
+bound to a local name first (``run = lambda: ...; run_in_executor(None,
+run)``). The unwrap is surgical: a call nested in a partial's ARGUMENT
+list (``partial(open(path).read)``) still executes on the loop at wrapper
+construction time and stays un-sanitized.
+
+The converse edge matters too: callables handed to the LOOP's own
+schedulers (``call_soon``, ``call_later``, ``call_at``,
+``call_soon_threadsafe``, ``add_done_callback``) run ON the loop, so a
+``partial(blocking_helper)`` scheduled there propagates blocking taint
+exactly like a direct call.
+
+Resolution is best-effort, not sound (docs/lint.md#limits): calls through
+unknown objects, dynamic dispatch, and inheritance are not followed — the
+flow rules are tripwires, not proofs.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Iterator, Optional
+
+from .core import ModuleCtx
+
+PACKAGE = "comfyui_distributed_tpu"
+
+
+# ---------------------------------------------------------------------------
+# call-semantics tables (shared with A001 in rules.py)
+
+BLOCKING_EXACT = {
+    "time.sleep": "time.sleep blocks the event loop — use "
+                  "`await asyncio.sleep(...)`",
+    "os.system": "os.system blocks the event loop",
+    "os.popen": "os.popen blocks the event loop",
+    "open": "sync file I/O in async def — offload via "
+            "loop.run_in_executor / asyncio.to_thread",
+}
+BLOCKING_PREFIX = {
+    "subprocess.": "subprocess in async def blocks the event loop — "
+                   "use asyncio.create_subprocess_* or an executor",
+    "fcntl.": "fcntl file locking blocks the event loop — offload to "
+              "an executor",
+}
+BLOCKING_METHODS = {
+    "read_text": "sync file I/O", "write_text": "sync file I/O",
+    "read_bytes": "sync file I/O", "write_bytes": "sync file I/O",
+}
+
+# Heavy CPU work on the wire path (W001): not "blocking" in A001's sense,
+# but multi-MB encode/checksum on the loop stalls every other request just
+# the same — the PR 9/14/17 media-and-checkpoint-route executor discipline.
+HEAVY_EXACT = {
+    "base64.b64encode": "base64 encode of a payload",
+    "base64.b64decode": "base64 decode of a payload",
+    "numpy.savez": "npz serialization", "numpy.savez_compressed":
+        "npz serialization", "numpy.load": "npz parse",
+    "np.savez": "npz serialization", "np.savez_compressed":
+        "npz serialization", "np.load": "npz parse",
+}
+HEAVY_PREFIX = {
+    "hashlib.": "checksum work",
+    "zlib.": "compression work",
+}
+# wire-codec entry points by trailing name (cross-module spellings vary)
+HEAVY_TAILS = {
+    "encode_array_payload": "npz+b64+sha256 wire encode",
+    "decode_array_payload": "b64+sha256+npz wire decode",
+}
+
+# Callables handed to these run OFF the loop: sanitize blocking taint.
+EXECUTOR_TAILS = ("run_in_executor", "to_thread", "submit")
+# Callables handed to these run ON the loop: propagate blocking taint.
+LOOP_SCHEDULE_TAILS = ("call_soon", "call_soon_threadsafe", "call_later",
+                       "call_at", "add_done_callback")
+
+
+def classify_blocking(name: str, call: ast.Call) -> Optional[str]:
+    """Why a resolved call name is loop-blocking ('' sentinel never used)."""
+    if name in BLOCKING_EXACT:
+        return BLOCKING_EXACT[name]
+    for prefix, why in BLOCKING_PREFIX.items():
+        if name.startswith(prefix):
+            return why
+    if isinstance(call.func, ast.Attribute):
+        attr = call.func.attr
+        if attr == "result" and not call.args and not call.keywords:
+            return "blocking .result() — await the future instead"
+        if attr in BLOCKING_METHODS:
+            return f"{BLOCKING_METHODS[attr]} (.{attr}())"
+    return None
+
+
+def classify_heavy(name: str) -> Optional[str]:
+    if name in HEAVY_EXACT:
+        return HEAVY_EXACT[name]
+    for prefix, why in HEAVY_PREFIX.items():
+        if name.startswith(prefix):
+            return why
+    return HEAVY_TAILS.get(name.split(".")[-1])
+
+
+# ---------------------------------------------------------------------------
+# imports
+
+
+class ModuleImports:
+    """Import table resolving LOCAL names to ABSOLUTE dotted targets,
+    relative imports included (needs the module's own dotted name)."""
+
+    def __init__(self, tree: ast.AST, module: str, is_package: bool):
+        self.module = module
+        self.module_alias: dict[str, str] = {}           # local -> module
+        self.from_name: dict[str, tuple[str, str]] = {}  # local -> (mod, orig)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.asname:
+                        self.module_alias[a.asname] = a.name
+                    else:
+                        self.module_alias[a.name.split(".")[0]] = \
+                            a.name.split(".")[0]
+            elif isinstance(node, ast.ImportFrom):
+                mod = self._abs_module(node, module, is_package)
+                for a in node.names:
+                    self.from_name[a.asname or a.name] = (mod, a.name)
+
+    @staticmethod
+    def _abs_module(node: ast.ImportFrom, module: str,
+                    is_package: bool) -> str:
+        if not node.level:
+            return node.module or ""
+        parts = module.split(".")
+        if not is_package:
+            parts = parts[:-1]
+        if node.level > 1:
+            parts = parts[:max(0, len(parts) - (node.level - 1))]
+        base = ".".join(parts)
+        if node.module:
+            return f"{base}.{node.module}" if base else node.module
+        return base
+
+    def resolve(self, func: ast.AST) -> str:
+        """Dotted name of a call target, import-aware; unknown roots keep
+        their literal spelling (same contract as rules.Imports)."""
+        parts: list[str] = []
+        node = func
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if isinstance(node, ast.Name):
+            base = node.id
+            if base in self.from_name:
+                mod, orig = self.from_name[base]
+                base = f"{mod}.{orig}" if mod else orig
+            elif base in self.module_alias:
+                base = self.module_alias[base]
+            parts.append(base)
+        elif isinstance(node, ast.Call):
+            parts.append("()")
+        else:
+            parts.append("?")
+        return ".".join(reversed(parts))
+
+
+def module_name_of(rel: str) -> str:
+    """``comfyui_distributed_tpu/lint/core.py`` ->
+    ``comfyui_distributed_tpu.lint.core``; ``pkg/__init__.py`` -> ``pkg``;
+    bare fixture files keep their stem (``snippet.py`` -> ``snippet``)."""
+    parts = rel.split("/")
+    if parts[-1] == "__init__.py":
+        parts = parts[:-1]
+    else:
+        parts[-1] = parts[-1][:-3] if parts[-1].endswith(".py") else parts[-1]
+    return ".".join(parts)
+
+
+# ---------------------------------------------------------------------------
+# per-function facts
+
+
+@dataclasses.dataclass
+class CallInfo:
+    """One resolved call site."""
+    node: ast.Call
+    name: str                      # absolute dotted spelling
+    target: Optional[str] = None   # internal key "module:qualname"
+    sanitized: bool = False        # inside an executor-offloaded wrapper
+    deferred: bool = False         # inside a lambda body (runs later, maybe)
+    on_loop: bool = False          # scheduled via call_soon/call_later/...
+
+
+@dataclasses.dataclass
+class RefInfo:
+    """A function REFERENCE (not call) scheduled onto the loop — e.g.
+    ``loop.call_soon(helper)`` or ``call_soon(partial(helper, x))``."""
+    node: ast.AST
+    target: Optional[str]
+    name: str
+
+
+@dataclasses.dataclass
+class Summary:
+    blocks: Optional[tuple[str, ...]] = None   # call chain ending at leaf
+    blocks_why: str = ""
+    heavy: Optional[tuple[str, ...]] = None
+    heavy_why: str = ""
+    awaits: bool = False
+    acquires: tuple[str, ...] = ()             # lock spellings (with stmts)
+
+
+class FunctionInfo:
+    def __init__(self, ctx: ModuleCtx, module: str, qualname: str,
+                 node, self_class: Optional[str]):
+        self.ctx = ctx
+        self.module = module
+        self.qualname = qualname
+        self.node = node
+        self.self_class = self_class       # qualname of class `self` binds to
+        self.is_async = isinstance(node, ast.AsyncFunctionDef)
+        self.key = f"{module}:{qualname}"
+        self.calls: list[CallInfo] = []
+        self.loop_refs: list[RefInfo] = []
+        self.sanitized_ids: set[int] = set()
+        self.summary = Summary()
+
+    @property
+    def short(self) -> str:
+        return self.qualname.split(".")[-1]
+
+    def __repr__(self) -> str:                         # pragma: no cover
+        return f"FunctionInfo({self.key})"
+
+
+def iter_functions_cls(tree: ast.AST) -> Iterator[
+        tuple[str, Optional[str], object]]:
+    """(qualname, self-class-qualname, node) for every function; the
+    self-class propagates into defs nested inside methods (their ``self``
+    closes over the method's)."""
+
+    def walk(node, prefix, cls):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                q = f"{prefix}.{child.name}" if prefix else child.name
+                yield q, cls, child
+                yield from walk(child, q, cls)
+            elif isinstance(child, ast.ClassDef):
+                q = f"{prefix}.{child.name}" if prefix else child.name
+                yield from walk(child, q, q)
+            else:
+                yield from walk(child, prefix, cls)
+
+    yield from walk(tree, "", None)
+
+
+def walk_own(fn, include_lambdas: bool = False) -> Iterator[ast.AST]:
+    """Walk a function body WITHOUT descending into nested defs (they are
+    their own FunctionInfo); lambdas optionally included (their bodies
+    execute in this function's context when invoked)."""
+
+    def walk(node):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if isinstance(child, ast.Lambda) and not include_lambdas:
+                continue
+            yield child
+            yield from walk(child)
+
+    yield from walk(fn)
+
+
+def wrapper_binds(fn) -> dict[str, ast.AST]:
+    """Local ``run = lambda: ...`` / ``run = partial(f, ...)`` bindings,
+    so ``loop.run_in_executor(None, run)`` sanitizes through the alias
+    (the worker_routes.warmup_start idiom)."""
+    binds: dict[str, ast.AST] = {}
+    for node in walk_own(fn):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, (ast.Lambda, ast.Call)):
+            binds[node.targets[0].id] = node.value
+    return binds
+
+
+def callable_args(call: ast.Call, tail: str) -> list[ast.AST]:
+    """The argument of an executor/scheduler call that names the deferred
+    work: ``run_in_executor(exec, fn, *a)`` -> fn; ``call_later(delay,
+    cb)`` / ``call_at(when, cb)`` -> cb; ``to_thread/submit/call_soon/
+    add_done_callback(fn, *a)`` -> fn."""
+    idx = 1 if tail in ("run_in_executor", "call_later", "call_at") else 0
+    return call.args[idx:idx + 1]
+
+
+def _mark_offloaded(arg, imp: ModuleImports, sanitized: set[int],
+                    binds: dict[str, ast.AST]) -> None:
+    """Sanitize a callable handed to an executor, unwrapping partial and
+    lambda wrappers (and one level of local-name aliasing). Calls nested
+    in a partial's ARGUMENT list execute at wrapper-build time ON the
+    loop, so they are deliberately NOT sanitized."""
+    if isinstance(arg, ast.Name) and arg.id in binds:
+        arg = binds[arg.id]
+    if isinstance(arg, ast.Lambda):
+        for sub in ast.walk(arg):
+            sanitized.add(id(sub))
+    elif isinstance(arg, ast.Call) \
+            and imp.resolve(arg.func).split(".")[-1] == "partial":
+        sanitized.add(id(arg))
+        for sub in ast.walk(arg):
+            if not isinstance(sub, ast.Call) or sub is arg:
+                sanitized.add(id(sub))
+    else:
+        sanitized.add(id(arg))         # bare reference: no call node anyway
+
+
+def offload_sanitized_ids(fn, imp: ModuleImports) -> set[int]:
+    """Node ids inside ``fn`` that are executor-offloaded and therefore
+    exempt from on-loop blocking checks (A001 uses this directly; the
+    graph bakes it into each CallInfo for A002)."""
+    sanitized: set[int] = set()
+    binds = wrapper_binds(fn)
+    for node in walk_own(fn):
+        if isinstance(node, ast.Call):
+            tail = imp.resolve(node.func).split(".")[-1]
+            if tail in EXECUTOR_TAILS:
+                for arg in callable_args(node, tail):
+                    _mark_offloaded(arg, imp, sanitized, binds)
+    return sanitized
+
+
+def lock_spelling(expr: ast.AST, imp: ModuleImports) -> Optional[str]:
+    """``with self._lock`` / ``with some_lock`` — an attribute or name
+    whose spelling contains "lock" (the L001 heuristic, shared)."""
+    if isinstance(expr, ast.Attribute) and "lock" in expr.attr.lower():
+        return imp.resolve(expr)
+    if isinstance(expr, ast.Name) and "lock" in expr.id.lower():
+        return expr.id
+    return None
+
+
+# ---------------------------------------------------------------------------
+# the graph
+
+
+class ProjectGraph:
+    """Build once per lint run from the full ModuleCtx list."""
+
+    def __init__(self, ctxs: list[ModuleCtx]):
+        self.ctxs = ctxs
+        self.functions: dict[str, FunctionInfo] = {}
+        self.imports: dict[str, ModuleImports] = {}
+        self.modules: dict[str, ModuleCtx] = {}
+        # parent-qualname -> {local def name -> key} (parent "" = module)
+        self._children: dict[str, dict[str, str]] = {}
+        self._lambda_cache: dict[int, set[int]] = {}
+        for ctx in ctxs:
+            self._index_module(ctx)
+        for fi in list(self.functions.values()):
+            self._resolve_function(fi)
+        self._fixpoint()
+
+    # -- indexing ------------------------------------------------------
+
+    def _index_module(self, ctx: ModuleCtx) -> None:
+        module = module_name_of(ctx.rel)
+        self.modules[module] = ctx
+        self.imports[module] = ModuleImports(
+            ctx.tree, module, ctx.rel.endswith("__init__.py"))
+        for qual, cls, fn in iter_functions_cls(ctx.tree):
+            fi = FunctionInfo(ctx, module, qual, fn, cls)
+            self.functions[fi.key] = fi
+            parent = qual.rsplit(".", 1)[0] if "." in qual else ""
+            self._children.setdefault(f"{module}:{parent}", {})[
+                fn.name] = fi.key
+
+    def lookup(self, module: str, qualname: str) -> Optional[FunctionInfo]:
+        return self.functions.get(f"{module}:{qualname}")
+
+    def child_of(self, module: str, parent_qual: str,
+                 name: str) -> Optional[str]:
+        return self._children.get(f"{module}:{parent_qual}", {}).get(name)
+
+    # -- reference resolution ------------------------------------------
+
+    def resolve_ref(self, fi: FunctionInfo,
+                    node: ast.AST) -> tuple[str, Optional[str]]:
+        """(absolute dotted name, internal key or None) for a callable
+        reference — a Name, an Attribute chain, or ``self.method``."""
+        imp = self.imports[fi.module]
+        name = imp.resolve(node)
+
+        # self.method() -> the class `self` binds to (no inheritance walk)
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self" and fi.self_class):
+            key = self.child_of(fi.module, fi.self_class, node.attr)
+            if key:
+                return name, key
+
+        if isinstance(node, ast.Name):
+            # innermost enclosing scope outward: nested defs, then
+            # siblings at each level, then module level, then imports
+            qual = fi.qualname
+            scopes = [qual]
+            while "." in qual:
+                qual = qual.rsplit(".", 1)[0]
+                scopes.append(qual)
+            scopes.append("")
+            for scope in scopes:
+                key = self.child_of(fi.module, scope, node.id)
+                if key:
+                    return name, key
+            if node.id in imp.from_name:
+                mod, orig = imp.from_name[node.id]
+                key = f"{mod}:{orig}"
+                if key in self.functions:
+                    return name, key
+            return name, None
+
+        if isinstance(node, ast.Attribute):
+            # mod.f() via `import mod` / `from pkg import mod`
+            base = node.value
+            attr = node.attr
+            if isinstance(base, ast.Name):
+                target_mod = None
+                if base.id in imp.module_alias:
+                    target_mod = imp.module_alias[base.id]
+                elif base.id in imp.from_name:
+                    m, o = imp.from_name[base.id]
+                    candidate = f"{m}.{o}" if m else o
+                    if candidate in self.modules:
+                        target_mod = candidate
+                if target_mod and target_mod in self.modules:
+                    key = self.child_of(target_mod, "", attr)
+                    if key:
+                        return name, key
+        return name, None
+
+    # -- per-function call extraction ----------------------------------
+
+    def _resolve_function(self, fi: FunctionInfo) -> None:
+        imp = self.imports[fi.module]
+        fi.sanitized_ids = offload_sanitized_ids(fi.node, imp)
+        sanitized = fi.sanitized_ids
+        on_loop_ids: set[int] = set()
+        binds = wrapper_binds(fi.node)
+
+        # pass 1: find loop-scheduler entries (deferred on-loop edges)
+        for node in walk_own(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            tail = imp.resolve(node.func).split(".")[-1]
+            if tail in LOOP_SCHEDULE_TAILS:
+                for arg in callable_args(node, tail):
+                    self._mark_on_loop(fi, arg, imp, on_loop_ids, binds)
+
+        # pass 2: classify every call site
+        for node in walk_own(fi.node, include_lambdas=True):
+            if isinstance(node, ast.Await):
+                fi.summary.awaits = True
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    lock = lock_spelling(item.context_expr, imp)
+                    if lock and lock not in fi.summary.acquires:
+                        fi.summary.acquires += (lock,)
+            if not isinstance(node, ast.Call):
+                continue
+            name, target = self.resolve_ref(fi, node.func)
+            info = CallInfo(node=node, name=name, target=target)
+            info.sanitized = id(node) in sanitized
+            info.on_loop = id(node) in on_loop_ids
+            info.deferred = (not info.on_loop
+                             and id(node) in self._lambda_ids(fi.node))
+            fi.calls.append(info)
+
+    def _mark_on_loop(self, fi, arg, imp, on_loop_ids: set[int],
+                      local_wrappers: dict[str, ast.AST]) -> None:
+        """A callable scheduled ON the loop: lambda bodies become on-loop
+        calls; partial/bare references become loop refs (deferred edges
+        that propagate blocking taint like direct calls)."""
+        if isinstance(arg, ast.Name) and arg.id in local_wrappers:
+            arg = local_wrappers[arg.id]
+        if isinstance(arg, ast.Lambda):
+            for sub in ast.walk(arg.body):
+                on_loop_ids.add(id(sub))
+            return
+        ref: Optional[ast.AST] = None
+        if isinstance(arg, ast.Call) \
+                and imp.resolve(arg.func).split(".")[-1] == "partial" \
+                and arg.args:
+            ref = arg.args[0]
+        elif isinstance(arg, (ast.Name, ast.Attribute)):
+            ref = arg
+        if ref is not None:
+            name, target = self.resolve_ref(fi, ref)
+            fi.loop_refs.append(RefInfo(node=ref, target=target, name=name))
+
+    def _lambda_ids(self, fn) -> set[int]:
+        cached = self._lambda_cache.get(id(fn))
+        if cached is None:
+            cached = set()
+            for node in walk_own(fn, include_lambdas=True):
+                if isinstance(node, ast.Lambda):
+                    for sub in ast.walk(node.body):
+                        cached.add(id(sub))
+            self._lambda_cache[id(fn)] = cached
+        return cached
+
+    # -- summaries ------------------------------------------------------
+
+    def _fixpoint(self) -> None:
+        """Propagate blocks/heavy through SYNC call edges until stable.
+        Async callees do not propagate (calling one just builds a
+        coroutine; its own body is the async rules' jurisdiction)."""
+        changed = True
+        guard = 0
+        while changed and guard < 50:
+            changed = False
+            guard += 1
+            for fi in self.functions.values():
+                changed |= self._update_summary(fi)
+
+    def _update_summary(self, fi: FunctionInfo) -> bool:
+        s = fi.summary
+        changed = False
+        for c in fi.calls:
+            if c.sanitized or c.deferred:
+                continue
+            # `# cdtlint: disable=A002` on the SOURCE line exempts the
+            # whole transitive class: one justified comment at the root
+            # (e.g. an mtime-cached config read) instead of a baseline
+            # entry per caller (docs/lint.md)
+            if fi.ctx.suppressed(getattr(c.node, "lineno", 1), "A002"):
+                continue
+            if s.blocks is None:
+                why = classify_blocking(c.name, c.node)
+                if why is not None:
+                    s.blocks, s.blocks_why = (c.name,), why
+                    changed = True
+                elif c.target:
+                    callee = self.functions[c.target]
+                    if not callee.is_async and callee.summary.blocks:
+                        s.blocks = (callee.short,) + callee.summary.blocks
+                        s.blocks_why = callee.summary.blocks_why
+                        changed = True
+            if s.heavy is None:
+                why = classify_heavy(c.name)
+                if why is not None:
+                    s.heavy, s.heavy_why = (c.name,), why
+                    changed = True
+                elif c.target:
+                    callee = self.functions[c.target]
+                    if not callee.is_async and callee.summary.heavy:
+                        s.heavy = (callee.short,) + callee.summary.heavy
+                        s.heavy_why = callee.summary.heavy_why
+                        changed = True
+        return changed
+
+
+def build_graph(ctxs: list[ModuleCtx]) -> ProjectGraph:
+    return ProjectGraph(ctxs)

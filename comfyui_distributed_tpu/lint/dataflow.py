@@ -1,0 +1,261 @@
+"""Source→sink taint engine for cdtlint v2 (docs/lint.md).
+
+Sits on top of :mod:`lint.callgraph` and answers one question per
+function: *does its return value derive from a nondeterministic source?*
+D001 catches ``time.time()`` typed directly into ``cluster/cache/keys.py``;
+it cannot catch the laundered version — a helper in ``utils/`` that
+returns ``f"{job_id}-{time.time_ns()}"`` and is called from the digest
+path two modules away. This engine computes per-function **return
+taint** to a fixpoint over the project call graph so D002 can flag the
+call site inside the bit-identity-critical module.
+
+Taint kinds:
+
+- ``nondet`` — wall-clock / random / uuid / OS-entropy / filesystem-order
+  reads (the D001 source tables, shared so the two rules never disagree).
+- ``set-order`` — iteration over a set (order is hash-seed-dependent).
+  ``sorted(...)`` is the sanitizer: sorting a set-derived value restores
+  determinism, so it kills this taint kind (and only this kind).
+- ``env`` — raw ``os.environ`` / ``os.getenv`` reads. The sanctioned path
+  is the typed knob registry (utils/constants.py): knob reads are
+  deliberate, documented, and K001-checked, so calls resolving into the
+  registry (``knob_bool``/``knob_int``/``knob_float`` and anything defined
+  in utils.constants) never carry env taint.
+
+Propagation is a light def-use pass, deliberately simple (docs/lint.md#limits):
+assignments to plain names, returns, f-strings/binops/containers, attribute
+and subscript access on tainted values, and calls — an internal callee's
+return taint flows out; an external call is conservatively tainted when any
+argument is (``str(t)``, ``repr(t)``, ``sha(t)``...). No per-parameter
+tracking: a tainted value passed INTO a helper is the caller's problem at
+the call site, not traced through the callee body.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Optional
+
+from .callgraph import PACKAGE, FunctionInfo, ProjectGraph
+
+# -- nondeterminism sources (shared with D001 in rules.py) ------------------
+
+NONDET_EXACT = {
+    "time.time": "wall-clock read", "time.time_ns": "wall-clock read",
+    "time.monotonic": "clock read", "time.perf_counter": "clock read",
+    "uuid.uuid1": "nondeterministic uuid",
+    "uuid.uuid4": "nondeterministic uuid",
+    "os.urandom": "OS entropy", "os.listdir": "filesystem order is "
+                                              "not deterministic",
+    "glob.glob": "filesystem order is not deterministic",
+    "glob.iglob": "filesystem order is not deterministic",
+}
+NONDET_PREFIX = {
+    "random.": "module-level random.* (use a seeded "
+               "Random/jax.random key threaded from the request)",
+    "secrets.": "OS entropy",
+    "datetime.datetime.now": "wall-clock read",
+    "datetime.datetime.utcnow": "wall-clock read",
+}
+
+ENV_SOURCES = ("os.getenv", "os.environ.get")
+
+# calls resolving here never carry env taint (the sanctioned read path)
+KNOB_REGISTRY_MODULE = f"{PACKAGE}.utils.constants"
+KNOB_TAILS = ("knob_bool", "knob_int", "knob_float", "knob_str")
+
+
+def classify_nondet(name: str) -> Optional[str]:
+    if name in NONDET_EXACT:
+        return NONDET_EXACT[name]
+    for prefix, why in NONDET_PREFIX.items():
+        if name.startswith(prefix):
+            return why
+    return None
+
+
+@dataclasses.dataclass(frozen=True)
+class Taint:
+    kind: str                 # "nondet" | "set-order" | "env"
+    chain: tuple[str, ...]    # call path, source last
+    why: str
+
+    def via(self, hop: str) -> "Taint":
+        return Taint(self.kind, (hop,) + self.chain, self.why)
+
+
+class TaintAnalysis:
+    """Per-function return taint, computed to a fixpoint over the graph.
+
+    ``returns[key]`` maps ``module:qualname`` -> :class:`Taint` for every
+    function whose return value derives from a source. Async functions
+    participate like sync ones: awaiting a tainted coroutine's result is
+    just as nondeterministic.
+    """
+
+    def __init__(self, graph: ProjectGraph):
+        self.graph = graph
+        self.returns: dict[str, Taint] = {}
+        changed = True
+        guard = 0
+        while changed and guard < 50:
+            changed = False
+            guard += 1
+            for fi in graph.functions.values():
+                t = self._return_taint(fi)
+                if t is not None and fi.key not in self.returns:
+                    self.returns[fi.key] = t
+                    changed = True
+
+    # -- per-function pass ---------------------------------------------
+
+    def _return_taint(self, fi: FunctionInfo) -> Optional[Taint]:
+        if fi.module == KNOB_REGISTRY_MODULE:
+            return None              # the registry IS the sanitizer
+        tainted: dict[str, Taint] = {}
+        found: list[Taint] = []
+        self._scan_body(fi, fi.node.body, tainted, found)
+        return found[0] if found else None
+
+    def _scan_body(self, fi, body, tainted: dict[str, Taint],
+                   found: list[Taint]) -> None:
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue              # their own FunctionInfo
+            if isinstance(stmt, ast.Assign):
+                t = self.expr_taint(fi, stmt.value, tainted)
+                if t:
+                    for target in stmt.targets:
+                        for n in ast.walk(target):
+                            if isinstance(n, ast.Name):
+                                tainted.setdefault(n.id, t)
+            elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+                value = stmt.value
+                t = self.expr_taint(fi, value, tainted) if value else None
+                if t and isinstance(stmt.target, ast.Name):
+                    tainted.setdefault(stmt.target.id, t)
+            elif isinstance(stmt, ast.Return) and stmt.value is not None:
+                t = self.expr_taint(fi, stmt.value, tainted)
+                if t:
+                    found.append(t)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                t = self._iter_taint(fi, stmt.iter, tainted)
+                if t:
+                    for n in ast.walk(stmt.target):
+                        if isinstance(n, ast.Name):
+                            tainted.setdefault(n.id, t)
+            # recurse into compound statements
+            for attr in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, attr, None)
+                if sub and not isinstance(stmt, (ast.FunctionDef,
+                                                 ast.AsyncFunctionDef,
+                                                 ast.ClassDef)):
+                    self._scan_body(fi, sub, tainted, found)
+            for handler in getattr(stmt, "handlers", ()) or ():
+                self._scan_body(fi, handler.body, tainted, found)
+            for item in getattr(stmt, "items", ()) or ():
+                pass                  # `with` ctx exprs carry no value taint
+
+    def _iter_taint(self, fi, it: ast.AST,
+                    tainted: dict[str, Taint]) -> Optional[Taint]:
+        imp = self.graph.imports[fi.module]
+        if isinstance(it, (ast.Set, ast.SetComp)):
+            return Taint("set-order", ("set-iteration",),
+                         "iteration order over a set is not deterministic")
+        if isinstance(it, ast.Call) and imp.resolve(it.func) in (
+                "set", "frozenset"):
+            return Taint("set-order", ("set-iteration",),
+                         "iteration order over a set is not deterministic")
+        return self.expr_taint(fi, it, tainted)
+
+    # -- expression taint ----------------------------------------------
+
+    def expr_taint(self, fi, expr: ast.AST,
+                   tainted: dict[str, Taint]) -> Optional[Taint]:
+        imp = self.graph.imports[fi.module]
+
+        if isinstance(expr, ast.Name):
+            return tainted.get(expr.id)
+        if isinstance(expr, ast.Await):
+            return self.expr_taint(fi, expr.value, tainted)
+        if isinstance(expr, ast.Attribute):
+            return self.expr_taint(fi, expr.value, tainted)
+        if isinstance(expr, ast.Subscript):
+            # os.environ["X"] is an env source; t[i] propagates t's taint
+            if isinstance(expr.value, ast.Attribute) \
+                    and imp.resolve(expr.value) == "os.environ":
+                return Taint("env", ("os.environ[...]",), "raw env read")
+            return self.expr_taint(fi, expr.value, tainted)
+        if isinstance(expr, (ast.BinOp, ast.BoolOp, ast.JoinedStr,
+                             ast.Tuple, ast.List, ast.Set, ast.Dict,
+                             ast.FormattedValue, ast.IfExp, ast.Starred,
+                             ast.UnaryOp, ast.Compare)):
+            for child in ast.iter_child_nodes(expr):
+                t = self.expr_taint(fi, child, tainted)
+                if t:
+                    return t
+            return None
+
+        if not isinstance(expr, ast.Call):
+            return None
+
+        name, target = self.graph.resolve_ref(fi, expr.func)
+        tail = name.split(".")[-1]
+
+        # sanitizers first
+        if tail in KNOB_TAILS or name.startswith("constants.") \
+                or name.startswith(KNOB_REGISTRY_MODULE + "."):
+            return None
+        arg_taints = [t for t in (
+            self.expr_taint(fi, a, tainted) for a in expr.args)
+            if t is not None]
+        if tail == "sorted":
+            # sorting restores a deterministic order — kills set-order
+            arg_taints = [t for t in arg_taints if t.kind != "set-order"]
+            return arg_taints[0] if arg_taints else None
+
+        # sources
+        why = classify_nondet(name)
+        if why is not None:
+            return Taint("nondet", (name,), why)
+        if name in ENV_SOURCES or name.startswith("os.environ."):
+            return Taint("env", (name,), "raw env read")
+        if name in ("set", "frozenset"):
+            # building a set is fine; ITERATING it is the hazard — but a
+            # set fed onward (e.g. "".join(set(x))) is order-tainted
+            return Taint("set-order", (name,),
+                         "set ordering is not deterministic")
+
+        # internal callee: its return taint flows out
+        if target is not None and target in self.returns:
+            return self.returns[target].via(
+                self.graph.functions[target].short)
+        if target is not None:
+            return arg_taints[0] if arg_taints else None
+
+        # external call: conservatively tainted when an argument is
+        # (str(t), sha256(t), "".join(t)...)
+        if arg_taints:
+            return arg_taints[0]
+        for kw in expr.keywords:
+            t = self.expr_taint(fi, kw.value, tainted)
+            if t:
+                return t
+        return None
+
+    # -- rule-facing helpers -------------------------------------------
+
+    def tainted_call_sites(self, fi: FunctionInfo):
+        """(CallInfo, Taint) for call sites in ``fi`` that invoke an
+        INTERNAL function whose return value is tainted — the ≥1-hop
+        laundering case D001 cannot see."""
+        for c in fi.calls:
+            if c.target and c.target in self.returns:
+                yield c, self.returns[c.target].via(
+                    self.graph.functions[c.target].short)
+
+
+def analyze(graph: ProjectGraph) -> TaintAnalysis:
+    return TaintAnalysis(graph)

@@ -1,0 +1,415 @@
+"""cdtlint v2 flow rules: project-wide checks on the call graph
+(docs/lint.md).
+
+=====  =====================================================================
+A002   transitive async-blocking: an ``async def`` reaching a blocking
+       call (``time.sleep``, sync file I/O, ``subprocess``) or heavy
+       encode/checksum work (b64/npz/sha256/wire codecs) through ≥1 sync
+       call hops — or any function scheduling a blocking callable onto
+       the event loop via ``call_soon``/``call_later``/callbacks. The
+       executor exemption unwraps ``functools.partial`` and lambda
+       wrappers (shared with A001 via lint/callgraph.py).
+L002   lock-held-across-await/blocking: a sync ``with <lock>:`` block in
+       an ``async def`` whose body awaits or (transitively) blocks — the
+       static complement of the runtime lock-order detector. ``async
+       with`` is the sanctioned pattern and is exempt.
+D002   interprocedural nondeterminism taint: a wall-clock / random /
+       uuid / env / set-order source laundered through ≥1 helper into a
+       bit-identity-critical module (lint/dataflow.py computes the
+       per-function return taint; D001 still owns the direct calls).
+W001   wire/route contract: every aiohttp route registered via
+       ``add_get``/``add_post``/``add_put`` must appear in docs/api.md
+       (two-way sync, like K001<->knobs.md), and body-reading POST/PUT
+       handlers must validate their payload through api/schemas.
+       Heavy-work-on-the-loop for handlers is A002's jurisdiction.
+=====  =====================================================================
+
+All four rules do their work in ``finalize`` (they need the whole
+project), so ``check_module`` is a no-op and suppression comments are
+applied manually, exactly like K001. The graph and taint analysis are
+built once per run and shared across the rules.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+from typing import Iterator, Optional
+
+from .core import Finding, ModuleCtx
+from . import callgraph as cg
+from . import dataflow as df
+
+PACKAGE = cg.PACKAGE
+
+
+def _shared(ctxs: list[ModuleCtx]):
+    """(ProjectGraph, TaintAnalysis), built once per run_lint call and
+    cached on the first ctx (the ctx list is per-run, so this never
+    leaks across runs)."""
+    anchor = ctxs[0]
+    cached = getattr(anchor, "_cdt_flow_cache", None)
+    if cached is None:
+        graph = cg.build_graph(ctxs)
+        cached = (graph, df.analyze(graph))
+        anchor._cdt_flow_cache = cached
+    return cached
+
+
+def _chain(parts) -> str:
+    return " -> ".join(parts)
+
+
+# ---------------------------------------------------------------------------
+# A002 — transitive async-blocking
+
+
+class TransitiveAsyncRule:
+    id = "A002"
+    title = "async def reaches blocking/heavy work through call hops"
+
+    def check_module(self, ctx: ModuleCtx) -> Iterator[Finding]:
+        return iter(())
+
+    def finalize(self, ctxs, repo_root: Path) -> list[Finding]:
+        graph, _ = _shared(ctxs)
+        findings: list[Finding] = []
+        for fi in graph.functions.values():
+            findings.extend(self._check_fn(graph, fi))
+        return [f for f in findings if not self._suppressed(graph, f)]
+
+    def _suppressed(self, graph, f: Finding) -> bool:
+        ctx = next((c for c in graph.ctxs if c.rel == f.path), None)
+        return ctx is not None and ctx.suppressed(f.line, self.id)
+
+    def _check_fn(self, graph, fi) -> Iterator[Finding]:
+        for c in fi.calls:
+            if c.sanitized or c.deferred:
+                continue
+            if fi.is_async:
+                yield from self._check_async_call(graph, fi, c)
+            elif c.on_loop:
+                # sync code scheduling a lambda onto the loop: its body
+                # runs ON the loop, so direct blocking there counts
+                why = cg.classify_blocking(c.name, c.node)
+                if why is not None:
+                    yield fi.ctx.finding(
+                        self.id, c.node, fi.qualname, c.name.split(".")[-1],
+                        f"{c.name} scheduled onto the event loop from "
+                        f"`{fi.short}`: {why}")
+        for ref in fi.loop_refs:
+            yield from self._check_loop_ref(graph, fi, ref)
+
+    def _check_async_call(self, graph, fi, c) -> Iterator[Finding]:
+        # ≥1 hop: a sync internal callee that (transitively) blocks.
+        # Depth 0 is A001's jurisdiction and is not re-reported here.
+        if c.target is not None:
+            callee = graph.functions[c.target]
+            if not callee.is_async and callee.summary.blocks:
+                chain = (callee.short,) + callee.summary.blocks
+                yield fi.ctx.finding(
+                    self.id, c.node, fi.qualname, callee.short,
+                    f"async def {fi.short} reaches blocking "
+                    f"{chain[-1]} via {_chain(chain)}: "
+                    f"{callee.summary.blocks_why}")
+            if not callee.is_async and callee.summary.heavy:
+                chain = (callee.short,) + callee.summary.heavy
+                yield fi.ctx.finding(
+                    self.id, c.node, fi.qualname, callee.short,
+                    f"async def {fi.short} does {callee.summary.heavy_why} "
+                    f"on the event loop via {_chain(chain)} — offload to "
+                    "an executor")
+        else:
+            # 0-hop heavy work directly in an async def (A001 only covers
+            # blocking calls, so this is new surface, not a duplicate)
+            why = cg.classify_heavy(c.name)
+            if why is not None:
+                yield fi.ctx.finding(
+                    self.id, c.node, fi.qualname, c.name,
+                    f"async def {fi.short} does {why} ({c.name}) on the "
+                    "event loop — offload to an executor")
+
+    def _check_loop_ref(self, graph, fi, ref) -> Iterator[Finding]:
+        # `loop.call_soon(partial(helper))` / `fut.add_done_callback(f)`:
+        # the referenced callable runs ON the loop later
+        if ref.target is not None:
+            callee = graph.functions[ref.target]
+            if not callee.is_async and callee.summary.blocks:
+                chain = (callee.short,) + callee.summary.blocks
+                yield fi.ctx.finding(
+                    self.id, ref.node, fi.qualname, callee.short,
+                    f"`{fi.short}` schedules {callee.short} onto the event "
+                    f"loop but it blocks via {_chain(chain)}: "
+                    f"{callee.summary.blocks_why}")
+        elif ref.name in cg.BLOCKING_EXACT or any(
+                ref.name.startswith(p) for p in cg.BLOCKING_PREFIX):
+            yield fi.ctx.finding(
+                self.id, ref.node, fi.qualname, ref.name,
+                f"`{fi.short}` schedules blocking {ref.name} onto the "
+                "event loop")
+
+
+# ---------------------------------------------------------------------------
+# L002 — lock held across await / blocking call
+
+
+class LockHeldAcrossAwaitRule:
+    id = "L002"
+    title = "sync lock held across an await or blocking call in async code"
+
+    def check_module(self, ctx: ModuleCtx) -> Iterator[Finding]:
+        return iter(())
+
+    def finalize(self, ctxs, repo_root: Path) -> list[Finding]:
+        graph, _ = _shared(ctxs)
+        findings: list[Finding] = []
+        for fi in graph.functions.values():
+            if not fi.is_async:
+                continue
+            imp = graph.imports[fi.module]
+            for node in cg.walk_own(fi.node):
+                if not isinstance(node, ast.With):
+                    continue
+                for item in node.items:
+                    lock = cg.lock_spelling(item.context_expr, imp)
+                    if lock:
+                        findings.extend(
+                            self._check_with(graph, fi, node, lock))
+        return [f for f in findings
+                if not self._suppressed(graph, f)]
+
+    def _suppressed(self, graph, f: Finding) -> bool:
+        ctx = next((c for c in graph.ctxs if c.rel == f.path), None)
+        return ctx is not None and ctx.suppressed(f.line, self.id)
+
+    def _check_with(self, graph, fi, with_node, lock) -> Iterator[Finding]:
+        by_id = {id(c.node): c for c in fi.calls}
+        for stmt in with_node.body:
+            for node in self._iter(stmt):
+                if isinstance(node, ast.Await):
+                    yield fi.ctx.finding(
+                        self.id, node, fi.qualname, lock,
+                        f"`with {lock}:` held across an await in async "
+                        f"def {fi.short} — a sync lock parks every other "
+                        "task; use asyncio.Lock or release before "
+                        "awaiting")
+                elif isinstance(node, ast.Call):
+                    c = by_id.get(id(node))
+                    if c is None or c.sanitized or c.deferred:
+                        continue
+                    why = cg.classify_blocking(c.name, node)
+                    chain: Optional[tuple] = None
+                    if why is not None:
+                        chain = (c.name,)
+                    elif c.target is not None:
+                        callee = graph.functions[c.target]
+                        if not callee.is_async and callee.summary.blocks:
+                            chain = ((callee.short,)
+                                     + callee.summary.blocks)
+                            why = callee.summary.blocks_why
+                    if chain:
+                        yield fi.ctx.finding(
+                            self.id, node, fi.qualname, lock,
+                            f"`with {lock}:` held across blocking "
+                            f"{_chain(chain)} in async def {fi.short}: "
+                            f"{why}")
+
+    @staticmethod
+    def _iter(stmt):
+        yield stmt
+        yield from cg.walk_own(stmt, include_lambdas=False)
+
+
+# ---------------------------------------------------------------------------
+# D002 — interprocedural nondeterminism taint
+
+
+class TaintedDeterminismRule:
+    """D001's interprocedural sibling: the direct ``time.time()`` in a
+    bit-identity module is D001; the helper two modules away that RETURNS
+    a wall-clock-derived value INTO the digest path is D002."""
+
+    id = "D002"
+    title = "nondeterministic value laundered into a bit-identity module"
+
+    SINKS = (
+        f"{PACKAGE}/cluster/cache/keys.py",
+        f"{PACKAGE}/cluster/frontdoor/microbatch.py",
+        f"{PACKAGE}/cluster/elastic/scheduler.py",
+        f"{PACKAGE}/diffusion/pipeline*.py",
+        f"{PACKAGE}/diffusion/checkpoint.py",
+        f"{PACKAGE}/cluster/stages/latents.py",
+    )
+
+    def check_module(self, ctx: ModuleCtx) -> Iterator[Finding]:
+        return iter(())
+
+    def in_scope(self, ctx: ModuleCtx) -> bool:
+        import fnmatch
+        if any(fnmatch.fnmatch(ctx.rel, pat) for pat in self.SINKS):
+            return True
+        for node in ctx.tree.body:
+            if (isinstance(node, ast.Assign)
+                    and any(isinstance(t, ast.Name)
+                            and t.id == "__bit_identity_critical__"
+                            for t in node.targets)
+                    and isinstance(node.value, ast.Constant)
+                    and node.value.value is True):
+                return True
+        return False
+
+    def finalize(self, ctxs, repo_root: Path) -> list[Finding]:
+        graph, taint = _shared(ctxs)
+        findings: list[Finding] = []
+        sink_modules = {cg.module_name_of(c.rel)
+                        for c in ctxs if self.in_scope(c)}
+        for fi in graph.functions.values():
+            if fi.module not in sink_modules:
+                continue
+            for c, t in taint.tainted_call_sites(fi):
+                if fi.ctx.suppressed(c.node.lineno, self.id):
+                    continue
+                findings.append(fi.ctx.finding(
+                    self.id, c.node, fi.qualname, c.name.split(".")[-1],
+                    f"{c.name}() returns a value derived from "
+                    f"{t.chain[-1]} ({t.why}) — flows {_chain(t.chain)} "
+                    "into a bit-identity-critical module"))
+        return findings
+
+
+# ---------------------------------------------------------------------------
+# W001 — wire/route contract
+
+
+class WireContractRule:
+    id = "W001"
+    title = "route missing doc row / payload validation"
+
+    APP_MODULE = f"{PACKAGE}/api/app.py"
+    SCHEMAS_MODULE = f"{PACKAGE}.api.schemas"
+    DOC = "docs/api.md"
+    EXEMPT_PATHS = {"/"}
+    ROUTE_TAILS = {"add_get": "GET", "add_post": "POST", "add_put": "PUT"}
+    DOC_PATH_RE = re.compile(
+        r"(/(?:distributed|prompt|upload)[A-Za-z0-9_/{}.-]*|/prompt)")
+
+    def check_module(self, ctx: ModuleCtx) -> Iterator[Finding]:
+        return iter(())
+
+    @staticmethod
+    def _norm(path: str) -> str:
+        """Strip query strings, collapse `{param}` spellings so
+        `/x/{id}` and `/x/{worker_id}` compare equal."""
+        return re.sub(r"\{[^}]*\}", "{}", path.split("?")[0]).rstrip("/")
+
+    def finalize(self, ctxs, repo_root: Path) -> list[Finding]:
+        app_ctx = next((c for c in ctxs if c.rel == self.APP_MODULE), None)
+        if app_ctx is None:
+            return []        # fixture-snippet runs: contract not in scope
+        graph, _ = _shared(ctxs)
+        findings: list[Finding] = []
+
+        routes = list(self._routes(graph))
+        doc_text = ""
+        doc_file = repo_root / self.DOC
+        if doc_file.exists():
+            doc_text = doc_file.read_text(encoding="utf-8")
+        doc_norms = {self._norm(p)
+                     for p in self.DOC_PATH_RE.findall(doc_text)}
+        code_norms = {self._norm(path) for _, path, *_ in routes}
+
+        for method, path, fi, call, handler_key in routes:
+            if fi.ctx.suppressed(call.lineno, self.id):
+                continue
+            if path not in self.EXEMPT_PATHS \
+                    and self._norm(path) not in doc_norms:
+                findings.append(fi.ctx.finding(
+                    self.id, call, fi.qualname, path,
+                    f"route {method} {path} is not documented in "
+                    f"{self.DOC} — the doc and the route table are a "
+                    "two-way contract (like K001<->knobs.md)"))
+            if method in ("POST", "PUT") and handler_key:
+                findings.extend(
+                    self._check_validation(graph, fi, call, method,
+                                           path, handler_key))
+
+        # stale doc rows: documented paths no route serves anymore
+        for norm in sorted(doc_norms - code_norms
+                           - {self._norm(p) for p in self.EXEMPT_PATHS}):
+            findings.append(app_ctx.finding(
+                self.id, app_ctx.tree, "<docs>", norm,
+                f"{self.DOC} documents {norm} but no route registers "
+                "that path — remove the row or restore the route"))
+        return findings
+
+    def _routes(self, graph):
+        for fi in graph.functions.values():
+            for c in fi.calls:
+                tail = c.name.split(".")[-1]
+                if tail not in self.ROUTE_TAILS:
+                    continue
+                args = c.node.args
+                if len(args) < 2 or not (
+                        isinstance(args[0], ast.Constant)
+                        and isinstance(args[0].value, str)):
+                    continue
+                _, handler_key = graph.resolve_ref(fi, args[1])
+                yield (self.ROUTE_TAILS[tail], args[0].value, fi,
+                       c.node, handler_key)
+
+    # -- payload validation --------------------------------------------
+
+    def _check_validation(self, graph, reg_fi, call, method, path,
+                          handler_key) -> Iterator[Finding]:
+        handler = graph.functions.get(handler_key)
+        if handler is None:
+            return
+        if not self._reaches(graph, handler, self._reads_body):
+            return           # no body parse (path/query-only POST)
+        if self._reaches(graph, handler, self._validates):
+            return
+        yield reg_fi.ctx.finding(
+            self.id, call, reg_fi.qualname, f"{path}:validate",
+            f"handler `{handler.short}` for {method} {path} parses a "
+            "JSON body but never reaches an api/schemas validator — "
+            "unvalidated wire input feeds the cluster control plane")
+
+    def _reaches(self, graph, handler, pred, depth: int = 3) -> bool:
+        seen = {handler.key}
+        frontier = [handler]
+        while frontier and depth >= 0:
+            nxt = []
+            for fi in frontier:
+                for c in fi.calls:
+                    if pred(graph, c):
+                        return True
+                    if c.target and c.target not in seen:
+                        seen.add(c.target)
+                        nxt.append(graph.functions[c.target])
+            frontier = nxt
+            depth -= 1
+        return False
+
+    @staticmethod
+    def _reads_body(graph, c) -> bool:
+        return c.name.split(".")[-1] == "json" \
+            and isinstance(c.node.func, ast.Attribute)
+
+    def _validates(self, graph, c) -> bool:
+        if c.target is not None:
+            mod = c.target.split(":", 1)[0]
+            if mod == self.SCHEMAS_MODULE or mod.endswith(".schemas") \
+                    or mod == "schemas":
+                return True
+        tail = c.name.split(".")[-1]
+        if ".schemas." in c.name or c.name.startswith("schemas."):
+            return True
+        # raising schemas.ValidationError inline IS contract enforcement
+        # (error_middleware converts it to a structured 400)
+        return tail in ("require_fields", "ValidationError") \
+            or tail.startswith(("validate_", "parse_positive"))
+
+
+FLOW_RULES = (TransitiveAsyncRule(), LockHeldAcrossAwaitRule(),
+              TaintedDeterminismRule(), WireContractRule())

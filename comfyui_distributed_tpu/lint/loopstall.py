@@ -1,0 +1,215 @@
+"""Runtime event-loop stall sanitizer (``CDT_LOOP_STALL=1``, docs/lint.md).
+
+The static rules A001/A002 prove that *known* blocking work stays off the
+event loop; they cannot see work that only BECOMES blocking at runtime — a
+C extension holding the GIL, a "fast" codec handed a pathological input, a
+lock wait inside a third-party callback. This module is the runtime
+companion, mirroring :mod:`.lockorder`: when the ``CDT_LOOP_STALL`` knob
+is on, every asyncio callback records its start into a process-global
+in-flight slot (via a patched ``asyncio.events.Handle._run``), and a
+daemon sampler thread watches that slot. A callback still running past
+``CDT_LOOP_STALL_MS`` is recorded as a **stall** together with the loop
+thread's live stack at the moment of observation (``sys._current_frames``)
+— so the report names the exact frame that was hogging the loop, not just
+"p99 went bad".
+
+The knob is latched at process start like ``CDT_LOCK_ORDER``: the chaos
+suite arms it via env before launching the smoke drivers, and in-process
+tests toggle :func:`force_enabled`. Disabled, the patched ``Handle._run``
+costs one module-global boolean read per callback.
+
+Known approximations:
+
+- Sampling granularity is ``threshold/4`` (floor 5 ms): a stall that both
+  starts and finishes between two samples is still caught — the patched
+  wrapper double-checks elapsed time on completion and records the stall
+  without a stack (``observed="completed"``).
+- One in-flight slot per process, not per loop: if two threads each run
+  an event loop, a sample may attribute a stall to whichever callback
+  wrote the slot last. The serving stack runs ONE loop (the controller's),
+  so in practice attribution is exact.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+import traceback
+from typing import Optional
+
+from ..utils.constants import LOOP_STALL, LOOP_STALL_MS
+
+
+class LoopStallError(RuntimeError):
+    """The event loop was blocked past the configured threshold."""
+
+
+# in-flight slot written by the loop thread, read by the sampler:
+# [t0_monotonic, callback_name, loop_thread_id, sampler_reported?]
+# (a fresh list per callback — identity distinguishes invocations)
+_inflight: Optional[list] = None
+
+_meta = threading.Lock()          # guards _stalls + the reported flag
+_stalls: list[dict] = []
+_forced: Optional[bool] = None    # test hook: overrides the latch
+# Latched ONCE at import, same discipline as lockorder: per-callback env
+# lookups would tax every timer tick and socket event on the loop.
+_latched: bool = bool(LOOP_STALL.get())
+
+_installed = False
+_orig_run = None
+_sampler_started = False
+
+
+def enabled() -> bool:
+    if _forced is not None:
+        return _forced
+    return _latched
+
+
+def force_enabled(on: Optional[bool]) -> None:
+    """Test hook: True/False overrides the import-time latch; None
+    restores it (re-reading ``CDT_LOOP_STALL`` in case the env changed).
+    Enabling also installs the patch + sampler if not yet running."""
+    global _forced, _latched
+    _forced = on
+    if on is None:
+        _latched = bool(LOOP_STALL.get())
+    if enabled():
+        install()
+
+
+def threshold_ms() -> float:
+    try:
+        return float(LOOP_STALL_MS.get())
+    except (TypeError, ValueError):
+        return 100.0
+
+
+def reset() -> None:
+    """Drop recorded stalls (test isolation)."""
+    with _meta:
+        _stalls.clear()
+
+
+def snapshot() -> dict:
+    """{'stalls': [{duration_ms, callback, stack, observed}, ...]} —
+    what the chaos suite asserts on."""
+    with _meta:
+        return {"stalls": [dict(s) for s in _stalls]}
+
+
+def assert_clean() -> None:
+    with _meta:
+        if _stalls:
+            worst = max(_stalls, key=lambda s: s["duration_ms"])
+            raise LoopStallError(
+                f"{len(_stalls)} event-loop stall(s) recorded "
+                f"(threshold {threshold_ms():.0f} ms); worst: "
+                f"{worst['callback']} blocked the loop for "
+                f"{worst['duration_ms']:.0f} ms\n{worst['stack']}")
+
+
+def _callback_name(handle) -> str:
+    cb = getattr(handle, "_callback", None)
+    if cb is None:
+        return repr(handle)
+    # unwrap functools.partial / method wrappers to a readable qualname
+    inner = getattr(cb, "func", cb)
+    name = getattr(inner, "__qualname__", None) or repr(inner)
+    code = getattr(inner, "__code__", None)
+    if code is not None:
+        return f"{name} ({code.co_filename}:{code.co_firstlineno})"
+    return str(name)
+
+
+def _record(entry: list, duration_ms: float, stack: str,
+            observed: str) -> None:
+    with _meta:
+        if entry[3] is not False:
+            # sampler already reported mid-flight with a partial elapsed
+            # time; the completion path upgrades it to the full duration
+            if observed == "completed":
+                entry[3]["duration_ms"] = round(duration_ms, 1)
+            return
+        report = {
+            "duration_ms": round(duration_ms, 1),
+            "callback": entry[1],
+            "stack": stack,
+            "observed": observed,
+        }
+        entry[3] = report
+        _stalls.append(report)
+    # outside the lock: one log line so stalls are visible in live server
+    # logs too, not only to in-process snapshot() readers
+    sys.stderr.write(
+        f"[loopstall] {report['callback']} blocked the event loop for "
+        f"{report['duration_ms']:.0f} ms ({observed})\n")
+
+
+def _patched_run(self):
+    if not enabled():
+        return _orig_run(self)
+    global _inflight
+    entry = [time.monotonic(), _callback_name(self),
+             threading.get_ident(), False]
+    _inflight = entry
+    try:
+        return _orig_run(self)
+    finally:
+        _inflight = None
+        dt = (time.monotonic() - entry[0]) * 1000.0
+        if dt >= threshold_ms():
+            # stall shorter than one sampler period: no live stack was
+            # captured, but the offender still gets named
+            _record(entry, dt, "(completed before the sampler fired — "
+                    "no live stack)", observed="completed")
+
+
+def _sample_once() -> None:
+    entry = _inflight
+    if entry is None or entry[3]:
+        return
+    dt = (time.monotonic() - entry[0]) * 1000.0
+    if dt < threshold_ms():
+        return
+    frame = sys._current_frames().get(entry[2])
+    stack = ("".join(traceback.format_stack(frame)) if frame is not None
+             else "(loop thread frame unavailable)")
+    _record(entry, dt, stack, observed="sampled")
+
+
+def _sampler_loop() -> None:          # pragma: no cover - timing loop
+    while True:
+        interval = max(threshold_ms() / 4.0, 5.0) / 1000.0
+        time.sleep(min(interval, 0.25))
+        if enabled():
+            try:
+                _sample_once()
+            except Exception:
+                pass                  # the watchdog must never kill itself
+
+
+def install() -> None:
+    """Patch ``asyncio.events.Handle._run`` and start the sampler thread.
+
+    Idempotent and process-global. Called automatically at import when
+    ``CDT_LOOP_STALL`` is set (the chaos-suite path) and by
+    :func:`force_enabled` (the in-process test path)."""
+    global _installed, _orig_run, _sampler_started
+    if not _installed:
+        import asyncio.events
+
+        _orig_run = asyncio.events.Handle._run
+        asyncio.events.Handle._run = _patched_run
+        _installed = True
+    if not _sampler_started:
+        t = threading.Thread(target=_sampler_loop,
+                             name="cdt-loopstall-sampler", daemon=True)
+        t.start()
+        _sampler_started = True
+
+
+if _latched:                          # armed via env before process start
+    install()

@@ -29,6 +29,8 @@ from pathlib import Path
 from typing import Iterator, Optional
 
 from .core import Finding, ModuleCtx
+from . import callgraph as _callgraph
+from . import dataflow as _dataflow
 
 CDT_NAME_RE = re.compile(r"CDT_[A-Z0-9_]*[A-Z0-9]$")
 
@@ -286,24 +288,11 @@ class AsyncHygieneRule:
     id = "A001"
     title = "blocking call directly in an async def body"
 
-    BLOCKING_EXACT = {
-        "time.sleep": "time.sleep blocks the event loop — use "
-                      "`await asyncio.sleep(...)`",
-        "os.system": "os.system blocks the event loop",
-        "os.popen": "os.popen blocks the event loop",
-        "open": "sync file I/O in async def — offload via "
-                "loop.run_in_executor / asyncio.to_thread",
-    }
-    BLOCKING_PREFIX = {
-        "subprocess.": "subprocess in async def blocks the event loop — "
-                       "use asyncio.create_subprocess_* or an executor",
-        "fcntl.": "fcntl file locking blocks the event loop — offload to "
-                  "an executor",
-    }
-    BLOCKING_METHODS = {
-        "read_text": "sync file I/O", "write_text": "sync file I/O",
-        "read_bytes": "sync file I/O", "write_bytes": "sync file I/O",
-    }
+    # single source of truth shared with the call-graph engine, so A001
+    # and A002 can never disagree about what "blocking" means
+    BLOCKING_EXACT = _callgraph.BLOCKING_EXACT
+    BLOCKING_PREFIX = _callgraph.BLOCKING_PREFIX
+    BLOCKING_METHODS = _callgraph.BLOCKING_METHODS
 
     def check_module(self, ctx: ModuleCtx) -> Iterator[Finding]:
         imp = imports_of(ctx)
@@ -313,14 +302,26 @@ class AsyncHygieneRule:
             yield from self._check_async_fn(ctx, imp, qual, fn)
 
     def _check_async_fn(self, ctx, imp, qual, fn) -> Iterator[Finding]:
+        # Executor-offload exemption (ISSUE 20): callables handed to
+        # run_in_executor / to_thread / submit run OFF the loop, so
+        # blocking calls inside their partial/lambda wrappers (including
+        # `run = lambda: ...; run_in_executor(None, run)` aliases) are
+        # exempt. Everything else — lambdas included, since a lambda
+        # invoked inline or scheduled via call_soon runs ON the loop —
+        # is checked. A call nested in a partial's ARGUMENT list
+        # (`partial(open(path).read)`) evaluates at wrapper-build time
+        # on the loop and stays flagged.
+        sanitized = _callgraph.offload_sanitized_ids(fn, imp)
+
         def walk(node):
             for child in ast.iter_child_nodes(node):
                 # nested defs run on their own schedule (and nested async
                 # defs are visited separately)
-                if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
-                                      ast.Lambda)):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
                     continue
-                if isinstance(child, ast.Call):
+                if isinstance(child, ast.Call) \
+                        and id(child) not in sanitized:
                     yield from check_call(child)
                 yield from walk(child)
 
@@ -372,23 +373,10 @@ class DeterminismRule:
         f"{PACKAGE}/diffusion/pipeline*.py",
     )
 
-    BANNED_EXACT = {
-        "time.time": "wall-clock read", "time.time_ns": "wall-clock read",
-        "time.monotonic": "clock read", "time.perf_counter": "clock read",
-        "uuid.uuid1": "nondeterministic uuid",
-        "uuid.uuid4": "nondeterministic uuid",
-        "os.urandom": "OS entropy", "os.listdir": "filesystem order is "
-                                                  "not deterministic",
-        "glob.glob": "filesystem order is not deterministic",
-        "glob.iglob": "filesystem order is not deterministic",
-    }
-    BANNED_PREFIX = {
-        "random.": "module-level random.* (use a seeded "
-                   "Random/jax.random key threaded from the request)",
-        "secrets.": "OS entropy",
-        "datetime.datetime.now": "wall-clock read",
-        "datetime.datetime.utcnow": "wall-clock read",
-    }
+    # shared with the taint engine (lint/dataflow.py) so D001's direct
+    # checks and D002's interprocedural taint use identical source tables
+    BANNED_EXACT = _dataflow.NONDET_EXACT
+    BANNED_PREFIX = _dataflow.NONDET_PREFIX
 
     def in_scope(self, ctx: ModuleCtx) -> bool:
         if any(fnmatch.fnmatch(ctx.rel, pat) for pat in self.MODULES):
@@ -641,8 +629,10 @@ class TracedPurityRule:
                     f"{name} inside {how}-traced `{qual}`: {why}")
 
 
+from .flowrules import FLOW_RULES  # noqa: E402
+
 ALL_RULES = (LockDisciplineRule(), AsyncHygieneRule(), DeterminismRule(),
-             KnobDisciplineRule(), TracedPurityRule())
+             KnobDisciplineRule(), TracedPurityRule()) + FLOW_RULES
 
 
 def rule_by_id(rule_id: str):

@@ -114,7 +114,9 @@ def load_config(path: Path | None = None) -> dict[str, Any]:
         if _cache is not None and _cache[0] == p and _cache[1] == mtime:
             return copy.deepcopy(_cache[2])
         try:
-            with open(p, "r", encoding="utf-8") as f:
+            # cold read only: mtime-cached above, so async callers hit
+            # this open() once per config EDIT, for a few-KB local JSON
+            with open(p, "r", encoding="utf-8") as f:  # cdtlint: disable=A002
                 loaded = json.load(f)
         except (OSError, json.JSONDecodeError) as e:
             raise ConfigError(f"cannot read config {p}: {e}") from e

@@ -938,6 +938,17 @@ LOCK_ORDER = knob_bool(
     "Dev-mode runtime lock-order detector: record cross-registry lock "
     "acquisition order and fail loudly on an inversion.",
     doc="docs/lint.md")
+LOOP_STALL = knob_bool(
+    "CDT_LOOP_STALL", False, "lint",
+    "Dev-mode event-loop stall sanitizer: sample the asyncio loop and "
+    "record any callback that blocks it past CDT_LOOP_STALL_MS, with "
+    "the offending stack.",
+    doc="docs/lint.md")
+LOOP_STALL_MS = knob_float(
+    "CDT_LOOP_STALL_MS", 100.0, "lint",
+    "Stall threshold (milliseconds) for the CDT_LOOP_STALL sanitizer: a "
+    "loop callback running longer than this is recorded as a stall.",
+    doc="docs/lint.md")
 TEST_WATCHDOG_S = knob_float(
     "CDT_TEST_WATCHDOG_S", 300.0, "testing",
     "Per-test watchdog: dump all thread stacks (faulthandler) after this "

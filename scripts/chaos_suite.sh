@@ -14,14 +14,18 @@ SEED="${CDT_CHAOS_SEED:-42}"
 echo "[chaos] fixed seed: ${SEED} (override with CDT_CHAOS_SEED)"
 echo "[chaos] repro: CDT_CHAOS_SEED=${SEED} scripts/chaos_suite.sh $*"
 
-# Stage 0 — machine-checked invariants (ISSUE 12, docs/lint.md): cdtlint
-# over the package against the committed baseline. Fails on any
-# non-baselined finding AND on a stale baseline entry (a site that no
-# longer exists — the baseline only shrinks). Then re-run the stage-1
-# chaos event under the runtime lock-order detector (CDT_LOCK_ORDER=1):
+# Stage 0 — machine-checked invariants (ISSUE 12 + 20, docs/lint.md):
+# cdtlint v2 over the package against the committed baseline — the
+# per-function rules (L001/A001/D001/K001/J001) plus the project-wide
+# flow rules on the call graph + taint engine (A002 transitive
+# async-blocking, L002 lock-held-across-await, D002 interprocedural
+# nondeterminism taint, W001 wire/route<->docs/api.md contract). Fails
+# on any non-baselined finding AND on a stale or unjustified baseline
+# entry (the baseline only shrinks). Then re-run the stage-1 chaos
+# event under the runtime lock-order detector (CDT_LOCK_ORDER=1):
 # every lock the event path takes records its acquisition order, and an
 # inversion fails the test loudly instead of deadlocking a future run.
-echo "[chaos] stage 0: cdtlint (static invariants) + lock-order detector"
+echo "[chaos] stage 0: cdtlint v2 (call-graph + taint invariants) + lock-order detector"
 python -m comfyui_distributed_tpu.lint
 env JAX_PLATFORMS=cpu CDT_CHAOS_SEED="${SEED}" CDT_LOCK_ORDER=1 \
     python -m pytest tests/ -q -m chaos -k "warm_restarted or lock_order" \
@@ -170,5 +174,30 @@ echo "[chaos] stage 9b: fleet load smoke (cross-worker hit rate beats per-host)"
 env JAX_PLATFORMS=cpu PYTHONPATH="$(pwd)" \
     CDT_CONFIG_PATH="$(mktemp -d)/config.json" \
     CDT_COMPILE_CACHE_DIR="${CDT_COMPILE_CACHE_DIR:-/tmp/cdt_xla_cache_chaos}" \
+    python scripts/load_smoke.py --fleet --fleet-n 4 \
+    --concurrency 8 --seed "${SEED}"
+
+# Stage 10 — event-loop stall sanitizer (ISSUE 20, docs/lint.md): re-run
+# the stage-split and fleet-cache smokes with CDT_LOOP_STALL=1 — every
+# asyncio callback is timed (lint/loopstall.py patches Handle._run at
+# import) and a sampler thread captures the live stack of any callback
+# blocking the loop past CDT_LOOP_STALL_MS. load_smoke exits 1 on ANY
+# recorded stall, so the executor discipline the static rules (A001/
+# A002) prove on the AST is also proven at runtime under real serving
+# load — including blocking work static analysis can't see (C
+# extensions, pathological codec inputs). The threshold is held above
+# the default: on CI-shared CPU the first-compile XLA callbacks and the
+# GIL under 8-way concurrency make sub-100ms guarantees unmeasurable.
+echo "[chaos] stage 10: loop-stall sanitizer (stage-split + fleet smokes armed)"
+env JAX_PLATFORMS=cpu PYTHONPATH="$(pwd)" \
+    CDT_CONFIG_PATH="$(mktemp -d)/config.json" \
+    CDT_COMPILE_CACHE_DIR="${CDT_COMPILE_CACHE_DIR:-/tmp/cdt_xla_cache_chaos}" \
+    CDT_LOOP_STALL=1 CDT_LOOP_STALL_MS="${CDT_LOOP_STALL_MS:-250}" \
+    python scripts/load_smoke.py --in-process --stages --n 12 \
+    --concurrency 8 --seed "${SEED}"
+env JAX_PLATFORMS=cpu PYTHONPATH="$(pwd)" \
+    CDT_CONFIG_PATH="$(mktemp -d)/config.json" \
+    CDT_COMPILE_CACHE_DIR="${CDT_COMPILE_CACHE_DIR:-/tmp/cdt_xla_cache_chaos}" \
+    CDT_LOOP_STALL=1 CDT_LOOP_STALL_MS="${CDT_LOOP_STALL_MS:-250}" \
     python scripts/load_smoke.py --fleet --fleet-n 4 \
     --concurrency 8 --seed "${SEED}"

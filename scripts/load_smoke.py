@@ -650,6 +650,30 @@ async def _run_in_process(requests: list[dict], concurrency: int,
         await client.close()
 
 
+def _check_loop_stalls() -> int:
+    """When ``CDT_LOOP_STALL=1`` armed the event-loop stall sanitizer
+    (lint/loopstall.py latches it at import, patching every loop
+    callback), any recorded stall fails the smoke with the offending
+    stack — the chaos suite re-runs the stage-split and fleet legs
+    under it."""
+    from comfyui_distributed_tpu.lint import loopstall
+
+    if not loopstall.enabled():
+        return 0
+    stalls = loopstall.snapshot()["stalls"]
+    if not stalls:
+        print(f"[loopstall] armed (threshold "
+              f"{loopstall.threshold_ms():.0f} ms): zero stalls recorded",
+              file=sys.stderr)
+        return 0
+    worst = max(stalls, key=lambda s: s["duration_ms"])
+    print(f"EVENT-LOOP STALLS: {len(stalls)} callback(s) blocked the "
+          f"loop past {loopstall.threshold_ms():.0f} ms; worst "
+          f"{worst['duration_ms']:.0f} ms in {worst['callback']}\n"
+          f"{worst['stack']}", file=sys.stderr)
+    return 1
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--url", default=None,
@@ -730,7 +754,7 @@ def main() -> int:
                   f"does not beat per-host baseline {base_rate}",
                   file=sys.stderr)
             return 1
-        return 0
+        return _check_loop_stalls()
     requests = build_workload(cli.seed, cli.n, dup_rate=cli.dup_rate)
     wait = not cli.no_wait
     churn = None
@@ -818,7 +842,7 @@ def main() -> int:
                   f"{budget:.2f}s while the long job churned "
                   f"(wall {lj.get('wall_s')}s)", file=sys.stderr)
             return 1
-    return 0
+    return _check_loop_stalls()
 
 
 if __name__ == "__main__":

@@ -81,10 +81,15 @@ def _reset_resilience_state():
     from comfyui_distributed_tpu.cluster import faults, resilience
     from comfyui_distributed_tpu.cluster.elastic import states as _el_states
     from comfyui_distributed_tpu.lint import lockorder as _lockorder
+    from comfyui_distributed_tpu.lint import loopstall as _loopstall
 
     resilience.BREAKERS.reset()
     _el_states.DRAIN.reset()
     _lockorder.reset()
+    # arm the loop-stall sanitizer for the whole suite when the env asks
+    # (the chaos suite exports CDT_LOOP_STALL=1); always drop recorded
+    # stalls between tests so one slow callback can't fail its neighbors
+    _loopstall.reset()
     faults.deactivate()
     yield
     resilience.BREAKERS.reset()

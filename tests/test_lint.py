@@ -662,3 +662,498 @@ class TestLockOrderChaos:
         snap = lockorder.snapshot()
         assert snap["inversions"] == [], snap
         assert snap["edges"], "detector armed but recorded no edges"
+
+
+# ---------------------------------------------------------------------------
+# cdtlint v2 flow rules (ISSUE 20): call graph + taint + wire contract
+
+
+def lint_files(tmp_path, files, rules=None):
+    """Multi-file variant of lint_snippet for cross-module flow tests.
+    Non-.py entries (e.g. a fixture docs/api.md) are written but not
+    linted — W001 reads them from the repo root."""
+    paths = []
+    for rel, src in files.items():
+        f = tmp_path / rel
+        f.parent.mkdir(parents=True, exist_ok=True)
+        f.write_text(textwrap.dedent(src), encoding="utf-8")
+        if f.suffix == ".py":
+            paths.append(f)
+    return run_lint(paths, rules or ALL_RULES, tmp_path)
+
+
+class TestA002:
+    def test_transitive_blocking_chain_named(self, tmp_path):
+        found = lint_snippet(tmp_path, """
+            import time
+
+            def leaf():
+                time.sleep(0.5)
+
+            def outer():
+                leaf()
+
+            async def handler():
+                outer()
+            """)
+        a002 = [f for f in found if f.rule == "A002"]
+        assert len(a002) == 1, found
+        msg = a002[0].render()
+        # the finding must name the full hop chain, not just the leaf
+        assert "outer" in msg and "leaf" in msg and "time.sleep" in msg
+
+    def test_cross_module_chain(self, tmp_path):
+        found = lint_files(tmp_path, {
+            "helpers.py": """
+                import subprocess
+
+                def run_tool():
+                    subprocess.run(["true"])
+                """,
+            "routes.py": """
+                import helpers
+
+                async def handler(request):
+                    helpers.run_tool()
+                """,
+        })
+        a002 = [f for f in found if f.rule == "A002"]
+        assert len(a002) == 1 and a002[0].path == "routes.py", found
+        assert "run_tool" in a002[0].render()
+
+    def test_heavy_codec_chain_flagged(self, tmp_path):
+        found = lint_snippet(tmp_path, """
+            import base64
+
+            def encode(buf):
+                return base64.b64encode(buf)
+
+            async def handler(buf):
+                return encode(buf)
+            """)
+        assert any(f.rule == "A002" and "b64" in f.render().lower()
+                   for f in found), found
+
+    def test_executor_offload_sanitizes_the_chain(self, tmp_path):
+        found = lint_snippet(tmp_path, """
+            import asyncio
+            import functools
+            import time
+
+            def leaf():
+                time.sleep(0.5)
+
+            async def fine(loop):
+                await loop.run_in_executor(None, leaf)
+
+            async def fine_partial(loop):
+                await loop.run_in_executor(None, functools.partial(leaf))
+
+            async def fine_to_thread():
+                await asyncio.to_thread(leaf)
+            """)
+        assert [f for f in found if f.rule in ("A001", "A002")] == [], found
+
+    def test_blocking_scheduled_onto_loop_flagged(self, tmp_path):
+        found = lint_snippet(tmp_path, """
+            import time
+
+            def leaf():
+                time.sleep(0.5)
+
+            def sync_caller(loop):
+                loop.call_soon(leaf)
+            """)
+        a002 = [f for f in found if f.rule == "A002"]
+        assert len(a002) == 1 and "leaf" in a002[0].render(), found
+
+    def test_source_line_suppression_kills_whole_class(self, tmp_path):
+        """`# cdtlint: disable=A002` on the LEAF call's line exempts every
+        transitive caller — one justified comment at the root instead of a
+        baseline entry per call site (the load_config precedent)."""
+        found = lint_snippet(tmp_path, """
+            import time
+
+            def leaf():
+                time.sleep(0.01)  # cdtlint: disable=A002
+
+            def outer():
+                leaf()
+
+            async def h1():
+                outer()
+
+            async def h2():
+                outer()
+            """)
+        assert [f for f in found if f.rule == "A002"] == [], found
+
+
+class TestExecutorWrapperExemption:
+    """Satellite (ISSUE 20): A001's executor exemption unwraps partial /
+    lambda wrappers — and keeps the eager-evaluation true positive."""
+
+    def test_partial_and_lambda_args_exempt(self, tmp_path):
+        found = lint_snippet(tmp_path, """
+            import functools
+            import time
+
+            async def ok_partial(loop):
+                await loop.run_in_executor(
+                    None, functools.partial(time.sleep, 1))
+
+            async def ok_lambda(loop, path):
+                await loop.run_in_executor(
+                    None, lambda: open(path).read())
+
+            async def ok_local_alias(loop, path):
+                run = lambda: open(path).read()
+                await loop.run_in_executor(None, run)
+            """)
+        assert [f for f in found if f.rule in ("A001", "A002")] == [], found
+
+    def test_eager_call_inside_partial_still_flagged(self, tmp_path):
+        # partial(open(path).read) EVALUATES open() on the loop before
+        # the executor ever runs — the exemption must not swallow it
+        found = lint_snippet(tmp_path, """
+            import functools
+
+            async def still_bad(loop, path):
+                await loop.run_in_executor(
+                    None, functools.partial(open(path).read))
+            """)
+        assert any(f.rule == "A001" for f in found), found
+
+    def test_unwrapped_direct_call_still_flagged(self, tmp_path):
+        found = lint_snippet(tmp_path, """
+            import time
+
+            async def bad():
+                time.sleep(1)
+            """)
+        assert any(f.rule == "A001" for f in found), found
+
+
+class TestL002:
+    def test_lock_held_across_await(self, tmp_path):
+        found = lint_snippet(tmp_path, """
+            import asyncio
+            import threading
+
+            class Registry:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                async def bad(self):
+                    with self._lock:
+                        await asyncio.sleep(0)
+            """)
+        l002 = [f for f in found if f.rule == "L002"]
+        assert len(l002) == 1 and "_lock" in l002[0].render(), found
+
+    def test_lock_held_across_transitive_blocking(self, tmp_path):
+        found = lint_snippet(tmp_path, """
+            import threading
+            import time
+
+            _lock = threading.Lock()
+
+            def slow():
+                time.sleep(0.5)
+
+            async def bad():
+                with _lock:
+                    slow()
+            """)
+        l002 = [f for f in found if f.rule == "L002"]
+        assert len(l002) == 1, found
+        assert "slow" in l002[0].render()
+
+    def test_async_with_and_release_before_await_clean(self, tmp_path):
+        found = lint_snippet(tmp_path, """
+            import asyncio
+            import threading
+
+            _lock = threading.Lock()
+
+            async def good_async_with():
+                async with asyncio.Lock():
+                    await asyncio.sleep(0)
+
+            async def good_release_first():
+                with _lock:
+                    x = 1
+                await asyncio.sleep(0)
+                return x
+            """)
+        assert [f for f in found if f.rule == "L002"] == [], found
+
+
+class TestD002:
+    def test_cross_module_laundering_into_sink(self, tmp_path):
+        found = lint_files(tmp_path, {
+            "helpers.py": """
+                import time
+
+                def now_key():
+                    return f"k-{time.time()}"
+                """,
+            "sink.py": """
+                __bit_identity_critical__ = True
+
+                import helpers
+
+                def cache_key():
+                    return helpers.now_key()
+                """,
+        })
+        d002 = [f for f in found if f.rule == "D002"]
+        assert len(d002) == 1 and d002[0].path == "sink.py", found
+        msg = d002[0].render()
+        assert "now_key" in msg and "time.time" in msg
+
+    def test_knob_read_sanitizes_env_taint(self, tmp_path):
+        found = lint_files(tmp_path, {
+            "helpers.py": """
+                from comfyui_distributed_tpu.utils.constants import knob_int
+
+                KNOB = knob_int("CDT_X", 1, "test", "help")
+
+                def knob_val():
+                    return KNOB.get()
+                """,
+            "sink.py": """
+                __bit_identity_critical__ = True
+
+                import helpers
+
+                def cache_key():
+                    return helpers.knob_val()
+                """,
+        })
+        assert [f for f in found if f.rule == "D002"] == [], found
+
+    def test_sorted_kills_set_order_taint(self, tmp_path):
+        found = lint_files(tmp_path, {
+            "helpers.py": """
+                def ordered_ids(items):
+                    return sorted(set(items))
+
+                def unordered_ids(items):
+                    return list(set(items))
+                """,
+            "sink.py": """
+                __bit_identity_critical__ = True
+
+                import helpers
+
+                def good(items):
+                    return helpers.ordered_ids(items)
+
+                def bad(items):
+                    return helpers.unordered_ids(items)
+                """,
+        })
+        d002 = [f for f in found if f.rule == "D002"]
+        assert len(d002) == 1 and "unordered_ids" in d002[0].render(), found
+
+    def test_non_sink_module_ignored(self, tmp_path):
+        found = lint_files(tmp_path, {
+            "helpers.py": """
+                import time
+
+                def now_key():
+                    return time.time()
+                """,
+            "plain.py": """
+                import helpers
+
+                def whatever():
+                    return helpers.now_key()
+                """,
+        })
+        assert [f for f in found if f.rule == "D002"] == [], found
+
+
+class TestW001:
+    APP = "comfyui_distributed_tpu/api/app.py"
+
+    def _files(self, doc_rows):
+        return {
+            self.APP: """
+                from aiohttp import web
+
+                from .schemas import require_fields
+
+                async def ok(request):
+                    return web.json_response({})
+
+                async def raw(request):
+                    body = await request.json()
+                    return web.json_response(body)
+
+                async def checked(request):
+                    body = await request.json()
+                    require_fields(body, "x")
+                    return web.json_response(body)
+
+                def create_app(router):
+                    router.add_get("/distributed/ok", ok)
+                    router.add_post("/distributed/undocumented", ok)
+                    router.add_post("/distributed/raw", raw)
+                    router.add_post("/distributed/checked", checked)
+                """,
+            "docs/api.md": "\n".join(
+                f"| {row} | stuff |" for row in doc_rows) + "\n",
+        }
+
+    def test_contract_violations(self, tmp_path):
+        found = lint_files(tmp_path, self._files(
+            ["/distributed/ok", "/distributed/raw",
+             "/distributed/checked", "/distributed/ghost"]))
+        w = sorted(f.render() for f in found if f.rule == "W001")
+        assert len(w) == 3, w
+        assert any("undocumented" in m and "not documented" in m for m in w)
+        assert any("raw" in m and "validat" in m for m in w)
+        assert any("ghost" in m and "no route registers" in m for m in w)
+
+    def test_in_sync_app_is_clean(self, tmp_path):
+        found = lint_files(tmp_path, self._files(
+            ["/distributed/ok", "/distributed/undocumented",
+             "/distributed/raw", "/distributed/checked"]))
+        w = [f for f in found if f.rule == "W001"]
+        # only the unvalidated-body finding remains
+        assert len(w) == 1 and "raw" in w[0].render(), w
+
+    def test_without_app_module_rule_is_gated_off(self, tmp_path):
+        found = lint_snippet(tmp_path, """
+            def create_app(router, h):
+                router.add_get("/distributed/whatever", h)
+            """)
+        assert [f for f in found if f.rule == "W001"] == [], found
+
+
+class TestFlowSeededRegressions:
+    def test_repo_gate_style_seeds_are_caught(self, tmp_path):
+        """ISSUE 20 acceptance: one real violation per flow rule, planted
+        in scratch modules, must each be caught (mirrors the ISSUE 12
+        seeded-violation pattern so the v2 gate can't rot silently)."""
+        found = lint_files(tmp_path, {
+            "seed_helpers.py": """
+                import threading
+                import time
+
+                _lock = threading.Lock()
+
+                def wall_key():
+                    return time.time()
+
+                def chain_leaf():
+                    time.sleep(0.1)
+
+                def chain_mid():
+                    chain_leaf()
+                """,
+            "seed_async.py": """
+                import asyncio
+
+                import seed_helpers
+
+                async def a002_seed():
+                    seed_helpers.chain_mid()
+
+                async def l002_seed():
+                    with seed_helpers._lock:
+                        await asyncio.sleep(0)
+                """,
+            "seed_sink.py": """
+                __bit_identity_critical__ = True
+
+                import seed_helpers
+
+                def d002_seed():
+                    return seed_helpers.wall_key()
+                """,
+        })
+        rules = {f.rule for f in found}
+        assert {"A002", "L002", "D002"} <= rules, sorted(
+            f.render() for f in found)
+
+
+# ---------------------------------------------------------------------------
+# runtime event-loop stall sanitizer (lint/loopstall.py)
+
+
+@pytest.fixture
+def stall_tracking():
+    from comfyui_distributed_tpu.lint import loopstall
+
+    loopstall.reset()
+    loopstall.force_enabled(True)
+    yield loopstall
+    loopstall.force_enabled(None)
+    loopstall.reset()
+
+
+class TestLoopStall:
+    def test_seeded_stall_names_the_frame(self, stall_tracking):
+        """ISSUE 20 acceptance: a deliberate 200 ms loop block must be
+        recorded with the offending callback NAMED (default threshold
+        CDT_LOOP_STALL_MS=100)."""
+        import asyncio
+        import time
+
+        loopstall = stall_tracking
+
+        def seeded_block():
+            time.sleep(0.2)
+
+        async def main():
+            asyncio.get_running_loop().call_soon(seeded_block)
+            await asyncio.sleep(0.45)
+
+        asyncio.run(main())
+        stalls = loopstall.snapshot()["stalls"]
+        assert len(stalls) == 1, stalls
+        s = stalls[0]
+        assert "seeded_block" in s["callback"]
+        assert s["duration_ms"] >= 150
+        if s["observed"] == "sampled":
+            # the sampler caught it live: the stack must name the frame
+            assert "seeded_block" in s["stack"]
+        with pytest.raises(loopstall.LoopStallError) as exc:
+            loopstall.assert_clean()
+        assert "seeded_block" in str(exc.value)
+
+    def test_fast_callbacks_record_nothing(self, stall_tracking):
+        import asyncio
+
+        loopstall = stall_tracking
+
+        async def main():
+            for _ in range(20):
+                await asyncio.sleep(0)
+
+        asyncio.run(main())
+        assert loopstall.snapshot()["stalls"] == []
+        loopstall.assert_clean()
+
+    def test_disabled_records_nothing(self):
+        import asyncio
+        import time
+
+        from comfyui_distributed_tpu.lint import loopstall
+
+        loopstall.reset()
+        loopstall.force_enabled(False)
+        try:
+            async def main():
+                asyncio.get_running_loop().call_soon(
+                    lambda: time.sleep(0.15))
+                await asyncio.sleep(0.25)
+
+            asyncio.run(main())
+            assert loopstall.snapshot()["stalls"] == []
+        finally:
+            loopstall.force_enabled(None)
+            loopstall.reset()
